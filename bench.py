@@ -1,0 +1,61 @@
+"""Headline benchmark: RS(12,4) erasure-encode throughput per chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the BASELINE.json north star is >= 40 GiB/s RS(12,4) encode on a
+v5e-8 (8 chips), i.e. 5 GiB/s per chip of *data* consumed. vs_baseline is
+measured single-chip GiB/s divided by that 5 GiB/s per-chip share.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+K, M = 12, 4
+SHARD_BYTES = 1 << 20  # 1 MiB shards (the reference's default chunk size)
+BATCH = 12             # 144 MiB of data per step
+WARMUP, ITERS = 2, 8
+BASELINE_PER_CHIP_GIBPS = 40.0 / 8
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu3fs.ops.rs import RSCode
+
+    dev = jax.devices()[0]
+    rs = RSCode(K, M)
+
+    rng = np.random.default_rng(0)
+    host = rng.integers(0, 256, (BATCH, K, SHARD_BYTES), dtype=np.uint8)
+    data = jax.device_put(jnp.asarray(host), dev)
+
+    encode = jax.jit(rs._encode)
+    for _ in range(WARMUP):
+        jax.block_until_ready(encode(data))
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = encode(data)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    data_bytes = BATCH * K * SHARD_BYTES
+    gibps = data_bytes * ITERS / dt / (1 << 30)
+    print(
+        json.dumps(
+            {
+                "metric": "rs_encode_12_4_data_throughput_per_chip",
+                "value": round(gibps, 3),
+                "unit": "GiB/s",
+                "vs_baseline": round(gibps / BASELINE_PER_CHIP_GIBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

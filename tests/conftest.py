@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's trick of running the full multi-node suite in one
+process (tests/lib/UnitTestFabric.h): multi-chip sharding is validated on a
+virtual CPU mesh, while real-TPU benches run separately via bench.py.
+
+Note: this image's sitecustomize registers an `axon` TPU backend and calls
+``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter start, so an
+env-var override is not enough — we must set the config after importing jax.
+Set TPU3FS_TEST_PLATFORM=axon to run the suite on real hardware instead.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ.get("TPU3FS_TEST_PLATFORM", "cpu"))

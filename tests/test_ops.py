@@ -1,0 +1,156 @@
+"""Kernel gold tests: GF(2^8), RS(k,m), CRC32C (bit-exact vs known vectors).
+
+Mirrors the reference's strategy of validating checksum paths against known
+implementations (folly::crc32c there; standard vectors here).
+"""
+
+import numpy as np
+import pytest
+
+from tpu3fs.ops.gf256 import GF
+from tpu3fs.ops.rs import RSCode
+from tpu3fs.ops.crc32c import BatchCrc32c, crc32c, crc32c_combine
+
+
+class TestGF:
+    def test_mul_identity_zero(self):
+        a = np.arange(256, dtype=np.uint8)
+        assert np.array_equal(GF.mul(a, 1), a)
+        assert np.array_equal(GF.mul(a, 0), np.zeros(256, dtype=np.uint8))
+
+    def test_mul_commutative_associative(self):
+        rng = np.random.default_rng(0)
+        a, b, c = rng.integers(0, 256, (3, 64)).astype(np.uint8)
+        assert np.array_equal(GF.mul(a, b), GF.mul(b, a))
+        assert np.array_equal(GF.mul(GF.mul(a, b), c), GF.mul(a, GF.mul(b, c)))
+
+    def test_distributive_over_xor(self):
+        rng = np.random.default_rng(1)
+        a, b, c = rng.integers(0, 256, (3, 64)).astype(np.uint8)
+        assert np.array_equal(GF.mul(a, b ^ c), GF.mul(a, b) ^ GF.mul(a, c))
+
+    def test_inverse(self):
+        for x in range(1, 256):
+            assert int(GF.mul(x, GF.inv(x))) == 1
+
+    def test_mat_inv(self):
+        rng = np.random.default_rng(2)
+        for _ in range(5):
+            n = 6
+            while True:
+                A = rng.integers(0, 256, (n, n)).astype(np.uint8)
+                try:
+                    Ainv = GF.mat_inv(A)
+                    break
+                except np.linalg.LinAlgError:
+                    continue
+            assert np.array_equal(GF.matmul(A, Ainv), np.eye(n, dtype=np.uint8))
+
+    def test_cauchy_mds(self):
+        # any k rows of [I; C] must be invertible
+        k, m = 4, 3
+        gen = np.concatenate(
+            [np.eye(k, dtype=np.uint8), GF.cauchy_parity_matrix(m, k)], axis=0
+        )
+        import itertools
+
+        for rows in itertools.combinations(range(k + m), k):
+            GF.mat_inv(gen[list(rows), :])  # raises if singular
+
+    def test_const_bit_matrix(self):
+        # bit matrix of c applied to bits of x == bits of mul(c, x)
+        rng = np.random.default_rng(3)
+        for _ in range(20):
+            c = int(rng.integers(0, 256))
+            x = int(rng.integers(0, 256))
+            M = GF.const_bit_matrix(c)
+            xb = ((x >> np.arange(8)) & 1).astype(np.uint8)
+            yb = (M.astype(np.int64) @ xb.astype(np.int64)) & 1
+            y = int((yb << np.arange(8)).sum())
+            assert y == int(GF.mul(c, x))
+
+
+class TestRS:
+    @pytest.mark.parametrize("k,m", [(3, 1), (3, 2), (8, 2), (12, 4)])
+    def test_encode_matches_gold(self, k, m):
+        rng = np.random.default_rng(42)
+        rs = RSCode(k, m)
+        data = rng.integers(0, 256, (2, k, 256)).astype(np.uint8)
+        gold = rs.encode_np(data)
+        got = np.asarray(rs.encode(data))
+        assert np.array_equal(got, gold)
+
+    @pytest.mark.parametrize("k,m", [(3, 2), (12, 4)])
+    def test_reconstruct_any_m_erasures(self, k, m):
+        import itertools
+
+        rng = np.random.default_rng(7)
+        rs = RSCode(k, m)
+        data = rng.integers(0, 256, (1, k, 128)).astype(np.uint8)
+        parity = rs.encode_np(data)
+        shards = np.concatenate([data, parity], axis=1)  # (1, k+m, S)
+        combos = list(itertools.combinations(range(k + m), m))
+        rng.shuffle(combos)
+        for lost in combos[:10]:
+            present = tuple(i for i in range(k + m) if i not in lost)[:k]
+            rebuilt = np.asarray(
+                rs.reconstruct(present, lost, shards[:, list(present), :])
+            )
+            assert np.array_equal(rebuilt, shards[:, list(lost), :]), (lost, present)
+
+    def test_reconstruct_gold_matches_jax(self):
+        rs = RSCode(4, 2)
+        rng = np.random.default_rng(9)
+        data = rng.integers(0, 256, (3, 4, 64)).astype(np.uint8)
+        parity = rs.encode_np(data)
+        shards = np.concatenate([data, parity], axis=1)
+        present, lost = (0, 2, 4, 5), (1, 3)
+        np_out = rs.reconstruct_np(present, lost, shards[:, list(present), :])
+        jx_out = np.asarray(rs.reconstruct(present, lost, shards[:, list(present), :]))
+        assert np.array_equal(np_out, jx_out)
+
+    def test_zero_data_zero_parity(self):
+        rs = RSCode(5, 3)
+        data = np.zeros((1, 5, 32), dtype=np.uint8)
+        assert not np.asarray(rs.encode(data)).any()
+
+
+class TestCrc32c:
+    def test_known_vectors(self):
+        # Standard CRC32C test vectors
+        assert crc32c(b"") == 0
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert crc32c(b"\xff" * 32) == 0x62A8AB43
+
+    def test_chaining(self):
+        data = b"hello world, this is tpu3fs"
+        assert crc32c(data[10:], crc32c(data[:10])) == crc32c(data)
+
+    def test_combine(self):
+        rng = np.random.default_rng(11)
+        a = rng.integers(0, 256, 1000).astype(np.uint8).tobytes()
+        b = rng.integers(0, 256, 777).astype(np.uint8).tobytes()
+        assert crc32c_combine(crc32c(a), crc32c(b), len(b)) == crc32c(a + b)
+        assert crc32c_combine(crc32c(a), crc32c(b""), 0) == crc32c(a)
+
+    @pytest.mark.parametrize("size,block", [(512, 512), (4096, 512), (8192, 1024)])
+    def test_batch_matches_scalar(self, size, block):
+        rng = np.random.default_rng(13)
+        batch = 4
+        chunks = rng.integers(0, 256, (batch, size)).astype(np.uint8)
+        bc = BatchCrc32c(size, block)
+        got = np.asarray(bc(chunks))
+        want = np.array([crc32c(chunks[i].tobytes()) for i in range(batch)],
+                        dtype=np.uint32)
+        assert np.array_equal(got, want)
+
+    def test_batch_zero_and_ones(self):
+        size = 1024
+        bc = BatchCrc32c(size, 256)
+        chunks = np.stack(
+            [np.zeros(size, dtype=np.uint8), np.full(size, 0xFF, dtype=np.uint8)]
+        )
+        got = np.asarray(bc(chunks))
+        assert got[0] == crc32c(b"\x00" * size)
+        assert got[1] == crc32c(b"\xff" * size)

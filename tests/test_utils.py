@@ -1,0 +1,108 @@
+"""Foundation tests: Result/Status, Config hot update, fault injection."""
+
+import pytest
+
+from tpu3fs.utils import Code, Config, ConfigItem, FsError, Result
+from tpu3fs.utils.fault_injection import fault_injection, inject
+
+
+class TestResult:
+    def test_ok(self):
+        r = Result.ok(42)
+        assert r and r.is_ok() and r.value == 42 and r.code == Code.OK
+
+    def test_err_raises_on_value(self):
+        r = Result.err(Code.META_NOT_FOUND, "no such file")
+        assert not r
+        with pytest.raises(FsError) as ei:
+            _ = r.value
+        assert ei.value.code == Code.META_NOT_FOUND
+
+    def test_retryable(self):
+        assert Result.err(Code.KV_CONFLICT).status.retryable()
+        assert not Result.err(Code.META_EXISTS).status.retryable()
+
+
+class SampleConfig(Config):
+    io_depth = ConfigItem(32, hot=True, checker=lambda v: v > 0)
+    name = ConfigItem("default")
+
+    class aio(Config):
+        threads = ConfigItem(8, hot=True)
+        use_uring = ConfigItem(True)
+
+
+class TestConfig:
+    def test_attribute_access_returns_values(self):
+        cfg = SampleConfig()
+        assert cfg.io_depth == 32
+        assert cfg.name == "default"
+        assert cfg.aio.threads == 8
+        assert cfg.get("aio.use_uring") is True
+
+    def test_set_and_string_coercion_before_checker(self):
+        cfg = SampleConfig()
+        cfg.set("io_depth", "64")  # flag-style string input
+        assert cfg.io_depth == 64
+        with pytest.raises(ValueError):
+            cfg.set("io_depth", "-1")  # checker sees typed value
+
+    def test_flag_overrides(self):
+        cfg = SampleConfig()
+        rest = cfg.apply_flag_overrides(
+            ["--config.aio.threads=16", "--port=99", "--config.name=x"]
+        )
+        assert rest == ["--port=99"]
+        assert cfg.aio.threads == 16 and cfg.name == "x"
+
+    def test_hot_update_coerces_and_fires_section_callbacks(self):
+        cfg = SampleConfig()
+        fired = []
+        cfg.aio.add_callback(lambda c: fired.append(("aio", c.threads)))
+        cfg.add_callback(lambda c: fired.append(("root", c.io_depth)))
+        cfg.hot_update({"aio.threads": "4", "io_depth": 128})
+        assert cfg.aio.threads == 4  # coerced to int
+        assert ("aio", 4) in fired and ("root", 128) in fired
+
+    def test_hot_update_rejects_cold_items_atomically(self):
+        cfg = SampleConfig()
+        with pytest.raises(ValueError):
+            cfg.hot_update({"io_depth": 64, "name": "nope"})  # name is cold
+        assert cfg.io_depth == 32  # nothing applied
+
+    def test_unknown_item(self):
+        cfg = SampleConfig()
+        with pytest.raises(KeyError):
+            cfg.set("nope", 1)
+        with pytest.raises(KeyError):
+            cfg.hot_update({"aio.nope": 1})
+
+    def test_toml_roundtrip(self):
+        cfg = SampleConfig()
+        cfg.set("io_depth", 7)
+        text = cfg.render_toml()
+        cfg2 = SampleConfig()
+        cfg2.load_toml(text)
+        assert cfg2.to_dict() == cfg.to_dict()
+
+
+class TestFaultInjection:
+    def test_fires_within_budget(self):
+        hits = 0
+        with fault_injection(1.0, times=2):
+            for _ in range(5):
+                try:
+                    inject("p")
+                except FsError as e:
+                    assert e.code == Code.FAULT_INJECTION
+                    hits += 1
+        assert hits == 2
+
+    def test_inactive_outside_context(self):
+        inject("p")  # no-op
+
+    def test_point_filter(self):
+        with fault_injection(1.0, only_points=["a"]):
+            inject("b")  # filtered
+            with pytest.raises(FsError):
+                inject("a")

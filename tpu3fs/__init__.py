@@ -1,0 +1,32 @@
+"""tpu3fs — a TPU-native distributed storage framework with the capabilities of 3FS.
+
+A brand-new design (not a port) re-expressing the reference's capability surface
+(see SURVEY.md) idiomatically for TPU + JAX/XLA/Pallas:
+
+- ``ops``       — data-plane math: GF(2^8) Reed-Solomon and CRC32C as batched
+                  bit-plane matmuls on the MXU (ref: per-chunk CPU CRC in
+                  src/storage/store/ChunkReplica.cc; RS is added capability).
+- ``parallel``  — CRAQ chain fan-out as collective_permute rings over ICI,
+                  failed-target rebuild as all-gather + RS-decode matmul,
+                  shuffle as all_to_all (ref: RDMA chain forwarding in
+                  src/storage/service/StorageOperator.cc).
+- ``kv``        — transactional KV abstraction + in-memory engine with conflict
+                  detection and versionstamps (ref: src/common/kv, src/fdb).
+- ``meta``      — stateless file metadata over transactional KV (ref: src/meta).
+- ``mgmtd``     — cluster manager: lease election, heartbeats, chain state
+                  machine, routing info (ref: src/mgmtd).
+- ``storage``   — chunk stores + CRAQ write/commit state machine (ref:
+                  src/storage/{store,chunk_engine,service}).
+- ``client``    — Storage/Meta/Mgmtd clients with retry ladders (ref: src/client).
+- ``rpc``       — reflection serde RPC with service/method ids (ref:
+                  src/common/serde, src/common/net).
+- ``fabric``    — single-process multi-node test cluster (ref:
+                  tests/lib/UnitTestFabric).
+- ``placement`` — chain-table placement solver on device (ref:
+                  deploy/data_placement).
+- ``usrbio``    — batched zero-copy shared-memory ring API (ref: src/lib/api,
+                  src/fuse/IoRing).
+- ``monitor``   — metric recorders and collectors (ref: src/common/monitor).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from tpu3fs.ops.gf256 import GF  # noqa: F401
+from tpu3fs.ops.rs import RSCode  # noqa: F401
+from tpu3fs.ops.crc32c import crc32c, crc32c_combine, BatchCrc32c  # noqa: F401

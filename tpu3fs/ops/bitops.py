@@ -1,0 +1,85 @@
+"""Bit-plane pack/unpack helpers shared by the RS and CRC kernels.
+
+The TPU hot path represents bytes as 8 GF(2) bit-planes so that GF(2^8)/CRC
+linear algebra becomes int8 matmuls on the MXU (accumulate in int32, reduce
+mod 2). These helpers are pure jnp so XLA can fuse the unpack/pack into the
+surrounding matmul; the Pallas kernel in pallas_rs.py fuses them explicitly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def unpack_bits(x: jnp.ndarray, axis: int = -2) -> jnp.ndarray:
+    """uint8 (..., k, S) -> int8 bit-planes (..., 8k, S), LSB-first per symbol.
+
+    Row 8*j+t of the result is bit t of symbol row j, matching
+    GF.expand_to_bits column convention.
+    """
+    assert axis == -2, "bit-plane axis must be the second-to-last"
+    x = x.astype(jnp.uint8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    # (..., k, S) -> (..., k, 8, S)
+    bits = (x[..., :, None, :] >> shifts[:, None]) & jnp.uint8(1)
+    shape = x.shape[:-2] + (x.shape[-2] * 8, x.shape[-1])
+    return bits.astype(jnp.int8).reshape(shape)
+
+
+def pack_bits(bits: jnp.ndarray, axis: int = -2) -> jnp.ndarray:
+    """{0,1} int (..., 8m, S) -> uint8 (..., m, S), inverse of unpack_bits."""
+    assert axis == -2
+    shape = bits.shape[:-2] + (bits.shape[-2] // 8, 8, bits.shape[-1])
+    b = bits.astype(jnp.int32).reshape(shape)
+    weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))[:, None]
+    return (b * weights).sum(axis=-2).astype(jnp.uint8)
+
+
+def unpack_bits_last(x: jnp.ndarray) -> jnp.ndarray:
+    """uint8 (..., S) -> int8 (..., 8S) with bit index 8*p+t (LSB-first)."""
+    x = x.astype(jnp.uint8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (x[..., :, None] >> shifts) & jnp.uint8(1)
+    return bits.astype(jnp.int8).reshape(x.shape[:-1] + (x.shape[-1] * 8,))
+
+
+def pack_u32(bits: jnp.ndarray) -> jnp.ndarray:
+    """{0,1} (..., 32) -> uint32 (...), LSB-first."""
+    b = bits.astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return (b * weights).sum(axis=-1)
+
+
+# -- numpy-side GF(2) linear algebra (setup/gold) ---------------------------
+
+def np_unpack_bits(x: np.ndarray, symbol_axis_rows: bool = True) -> np.ndarray:
+    x = np.asarray(x, dtype=np.uint8)
+    bits = ((x[..., :, None, :] >> np.arange(8, dtype=np.uint8)[:, None]) & 1)
+    shape = x.shape[:-2] + (x.shape[-2] * 8, x.shape[-1])
+    return bits.astype(np.uint8).reshape(shape)
+
+
+def np_mat2_mul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """GF(2) matrix product of {0,1} uint8 matrices."""
+    return (A.astype(np.int64) @ B.astype(np.int64) & 1).astype(np.uint8)
+
+
+def np_mat2_pow(A: np.ndarray, n: int) -> np.ndarray:
+    """GF(2) matrix power by binary exponentiation."""
+    result = np.eye(A.shape[0], dtype=np.uint8)
+    base = A.copy()
+    while n:
+        if n & 1:
+            result = np_mat2_mul(result, base)
+        base = np_mat2_mul(base, base)
+        n >>= 1
+    return result
+
+
+def np_u32_to_bits(v: int) -> np.ndarray:
+    return ((int(v) >> np.arange(32)) & 1).astype(np.uint8)
+
+
+def np_bits_to_u32(bits: np.ndarray) -> int:
+    return int((bits.astype(np.uint64) << np.arange(32, dtype=np.uint64)).sum())

@@ -1,0 +1,158 @@
+"""Reed-Solomon RS(k, m) erasure coding as batched TPU bit-plane matmuls.
+
+Design: a systematic Cauchy generator [I_k ; C] over GF(2^8). Encode/decode
+are GF(2^8) matrix products, which we lower to the MXU by expanding the small
+coefficient matrix into its (8m x 8k) GF(2) bit matrix and multiplying
+bit-planes of the data as int8 (accumulate int32, reduce mod 2) — the
+"bit-sliced XOR formulation" TPUs want, since they have no carry-less multiply.
+
+The reference replicates via CRAQ instead of RS (docs/design_notes.md "Data
+replication"); RS(k,m) is the added capability from BASELINE.json, and "EC"
+exists in the reference only as a chain-table type in the placement solver
+(deploy/data_placement/src/model/data_placement.py:30). The encode path plugs
+into storage targets behind the same engine switch the reference uses for its
+chunk engines (src/storage/store/StorageTarget.h:162).
+
+Layouts: data shards are (..., k, S) uint8; parity (..., m, S); a "shard set"
+is the concatenation (..., k+m, S). S is the shard size in bytes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu3fs.ops.bitops import pack_bits, unpack_bits
+from tpu3fs.ops.gf256 import GF
+
+
+def _bit_matmul(A_bits: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """Apply an (8m, 8k) GF(2) matrix to uint8 data (..., k, S) -> (..., m, S)."""
+    bits = unpack_bits(data)  # (..., 8k, S) int8
+    acc = jnp.einsum(
+        "ij,...js->...is", A_bits, bits, preferred_element_type=jnp.int32
+    )
+    return pack_bits(acc & 1)
+
+
+class RSCode:
+    """RS(k, m): k data shards, m parity shards, tolerates any m erasures."""
+
+    def __init__(self, k: int, m: int):
+        if k < 1 or m < 0 or k + m > 256:
+            raise ValueError(f"bad RS parameters k={k} m={m}")
+        self.k = k
+        self.m = m
+        self.parity_matrix = GF.cauchy_parity_matrix(m, k)  # (m, k) GF(2^8)
+        self.generator = np.concatenate(
+            [np.eye(k, dtype=np.uint8), self.parity_matrix], axis=0
+        )  # (k+m, k)
+        self._parity_bits = jnp.asarray(
+            GF.expand_to_bits(self.parity_matrix).astype(np.int8)
+        )
+        self._encode_jit = jax.jit(self._encode)
+        # per-instance caches keyed on (present, lost) — instance-held so the
+        # device matrices/compiled fns die with the RSCode object
+        self._reconstruct_mats: dict = {}
+        self._reconstruct_fns: dict = {}
+
+    # -- encode ------------------------------------------------------------
+    def _encode(self, data: jnp.ndarray) -> jnp.ndarray:
+        return _bit_matmul(self._parity_bits, data)
+
+    def encode(self, data: jnp.ndarray) -> jnp.ndarray:
+        """(..., k, S) uint8 data -> (..., m, S) parity. Jitted."""
+        assert data.shape[-2] == self.k, (data.shape, self.k)
+        return self._encode_jit(data)
+
+    def encode_np(self, data: np.ndarray) -> np.ndarray:
+        """Gold-path numpy encode via GF tables (slow, exact)."""
+        data = np.asarray(data, dtype=np.uint8)
+        *lead, k, s = data.shape
+        assert k == self.k
+        flat = data.reshape(-1, k, s)
+        out = np.zeros((flat.shape[0], self.m, s), dtype=np.uint8)
+        for i in range(self.m):
+            for j in range(k):
+                out[:, i, :] ^= GF.mul(self.parity_matrix[i, j], flat[:, j, :])
+        return out.reshape(*lead, self.m, s)
+
+    # -- decode ------------------------------------------------------------
+    def _reconstruct_matrix(
+        self, present: Tuple[int, ...], lost: Tuple[int, ...]
+    ) -> np.ndarray:
+        """GF matrix R (len(lost), k) with lost = R @ shards[present]."""
+        key = (present, lost)
+        cached = self._reconstruct_mats.get(key)
+        if cached is not None:
+            return cached
+        assert len(present) == self.k
+        sub = self.generator[list(present), :]  # (k, k)
+        inv = GF.mat_inv(sub)  # data = inv @ present
+        rows = []
+        for idx in lost:
+            # row of the generator for the lost shard, composed with inv
+            rows.append(GF.matmul(self.generator[idx : idx + 1, :], inv)[0])
+        R = np.stack(rows, axis=0)
+        self._reconstruct_mats[key] = R
+        return R
+
+    def reconstruct_fn(
+        self, present_idx: Sequence[int], lost_idx: Sequence[int]
+    ):
+        """Jitted fn mapping (..., k, S) surviving shards -> (..., lost, S).
+
+        The single decode entry point: reconstruct() and the distributed
+        rebuild path (tpu3fs.parallel.rebuild) both go through here, so a
+        kernel swap (e.g. Pallas) lands in one place.
+        """
+        present = tuple(int(i) for i in present_idx)
+        lost = tuple(int(i) for i in lost_idx)
+        key = (present, lost)
+        fn = self._reconstruct_fns.get(key)
+        if fn is None:
+            R = self._reconstruct_matrix(present, lost)
+            R_bits = jnp.asarray(GF.expand_to_bits(R).astype(np.int8))
+            fn = jax.jit(functools.partial(_bit_matmul, R_bits))
+            self._reconstruct_fns[key] = fn
+        return fn
+
+    def reconstruct(
+        self,
+        present_idx: Sequence[int],
+        lost_idx: Sequence[int],
+        present_shards: jnp.ndarray,
+    ) -> jnp.ndarray:
+        """Rebuild lost shards from any k surviving shards.
+
+        present_idx: k shard indices in [0, k+m) matching present_shards rows
+        present_shards: (..., k, S) uint8
+        returns (..., len(lost_idx), S) uint8
+        """
+        return self.reconstruct_fn(present_idx, lost_idx)(present_shards)
+
+    def reconstruct_np(
+        self,
+        present_idx: Sequence[int],
+        lost_idx: Sequence[int],
+        present_shards: np.ndarray,
+    ) -> np.ndarray:
+        """Gold-path numpy reconstruction."""
+        R = self._reconstruct_matrix(
+            tuple(int(i) for i in present_idx), tuple(int(i) for i in lost_idx)
+        )
+        shards = np.asarray(present_shards, dtype=np.uint8)
+        *lead, k, s = shards.shape
+        flat = shards.reshape(-1, k, s)
+        out = np.zeros((flat.shape[0], R.shape[0], s), dtype=np.uint8)
+        for i in range(R.shape[0]):
+            for j in range(k):
+                out[:, i, :] ^= GF.mul(R[i, j], flat[:, j, :])
+        return out.reshape(*lead, R.shape[0], s)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"RSCode(k={self.k}, m={self.m})"

@@ -1,0 +1,4 @@
+from tpu3fs.parallel.mesh import make_storage_mesh  # noqa: F401
+from tpu3fs.parallel.chain import chain_replicate, chain_write_step  # noqa: F401
+from tpu3fs.parallel.rebuild import rebuild_lost_shard  # noqa: F401
+from tpu3fs.parallel.shuffle import shuffle_partitions  # noqa: F401

@@ -1,0 +1,71 @@
+"""Failed-target rebuild: all-gather surviving shards + RS-decode matmul.
+
+The reference recovers a failed target by full-chunk-replace forwarding from
+chain peers (src/storage/sync/ResyncWorker.cc:101-460). With RS(k,m) targets,
+the TPU-native rebuild gathers any k surviving shards over ICI and
+reconstructs the lost shard(s) with a single GF(2)-bit matmul on the MXU —
+this is the BASELINE.json north-star path ("rebuild 14 TiB target <5 min").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu3fs.ops.rs import RSCode
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def rebuild_lost_shard(
+    mesh: Mesh,
+    shards: jnp.ndarray,
+    rs: RSCode,
+    lost_idx: Sequence[int],
+    shard_axis: str = "chain",
+):
+    """Reconstruct lost shard rows from the surviving ones, on-device.
+
+    shards: (k+m, batch, S) uint8 global, sharded over ``shard_axis`` on axis 0
+            (one EC-group member per mesh position along that axis). Rows at
+            ``lost_idx`` hold garbage (the failed targets).
+    Returns (len(lost_idx), batch, S): the rebuilt shards, replicated along the
+    shard axis (every survivor can serve them; in the service layer only the
+    replacement target persists them).
+    """
+    n = rs.k + rs.m
+    if mesh.shape[shard_axis] != n:
+        raise ValueError(
+            f"mesh axis {shard_axis}={mesh.shape[shard_axis]} != k+m={n}"
+        )
+    lost = tuple(int(i) for i in lost_idx)
+    if len(lost) > rs.m:
+        raise ValueError(f"cannot rebuild {len(lost)} shards with m={rs.m}")
+    present = tuple(i for i in range(n) if i not in lost)[: rs.k]
+    decode = rs.reconstruct_fn(present, lost)
+    other_specs = tuple(None for _ in range(shards.ndim - 1))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(shard_axis, *other_specs),
+        out_specs=P(*((None,) + other_specs)),
+        check_vma=False,
+    )
+    def rebuild(local):
+        # local: (1, batch, S) — this member's shard. Gather survivors on ICI.
+        gathered = lax.all_gather(local[0], shard_axis, axis=0)  # (n, batch, S)
+        surv = gathered[jnp.asarray(present), :, :]  # (k, batch, S)
+        # (batch, k, S) -> (batch, lost, S), via the shared decode entry point
+        out = decode(jnp.moveaxis(surv, 0, -2))
+        return jnp.moveaxis(out, -2, 0)  # (lost, batch, S)
+
+    return rebuild(shards)

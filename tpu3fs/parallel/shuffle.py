@@ -1,0 +1,48 @@
+"""Bulk shuffle (GraySort-style partition exchange) as all_to_all over the mesh.
+
+The reference's GraySort number (BASELINE.md: 3.66 TiB/min via smallpond on
+3FS) is a disk-mediated shuffle: every compute node writes partitioned runs
+and reads its own partition back. On TPU the same exchange inside a pod is a
+single ``lax.all_to_all`` over ICI; across pods it decomposes into an
+intra-pod all_to_all plus host-mediated storage I/O through the chunk store.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def shuffle_partitions(mesh: Mesh, data: jnp.ndarray, axis: str = "dp"):
+    """Exchange partitions so device j ends with everyone's j-th partition.
+
+    data: (n_dev * n_dev, block, S) sharded over ``axis`` on dim 0 — each
+    device holds (n_dev, block, S), row j destined for device j.
+    Returns the same global shape, where device j's local rows are the j-th
+    partitions from every source device (sorted-run gather).
+    """
+    n = mesh.shape[axis]
+    other = tuple(None for _ in range(data.ndim - 1))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, *other),
+        out_specs=P(axis, *other),
+        check_vma=False,
+    )
+    def exchange(local):
+        # local: (n, block, S); send row j to device j, receive into row i
+        # from device i.
+        return lax.all_to_all(local, axis, split_axis=0, concat_axis=0, tiled=True)
+
+    return exchange(data)

@@ -1,0 +1,8 @@
+from tpu3fs.utils.result import (  # noqa: F401
+    Code,
+    FsError,
+    Result,
+    Status,
+    make_error,
+)
+from tpu3fs.utils.config import Config, ConfigItem  # noqa: F401

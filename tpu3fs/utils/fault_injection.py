@@ -1,0 +1,70 @@
+"""Request-scoped fault injection (ref: src/common/utils/FaultInjection.h:15-29).
+
+``with fault_injection(prob, times):`` arms injection for the current context;
+``inject("point-name")`` then raises FsError(FAULT_INJECTION) with probability
+``prob`` for at most ``times`` firings. Server code threads the armed state
+through request debug flags, mirroring FAULT_INJECTION_POINT usage in
+StorageOperator.cc:103-105.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tpu3fs.utils.result import Code, FsError, Status
+
+
+@dataclass
+class _Injection:
+    prob: float
+    times: int
+    only_points: Optional[List[str]] = None
+    fired: int = field(default=0)
+
+    def should_fire(self, point: str) -> bool:
+        if self.times >= 0 and self.fired >= self.times:
+            return False
+        if self.only_points is not None and point not in self.only_points:
+            return False
+        if random.random() >= self.prob:
+            return False
+        self.fired += 1
+        return True
+
+
+_current: contextvars.ContextVar[Optional[_Injection]] = contextvars.ContextVar(
+    "tpu3fs_fault_injection", default=None
+)
+
+
+@contextlib.contextmanager
+def fault_injection(prob: float, times: int = -1, only_points: Optional[List[str]] = None):
+    """Arm fault injection in this context. times<0 means unlimited."""
+    token = _current.set(_Injection(prob, times, only_points))
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+def current_injection() -> Optional[_Injection]:
+    return _current.get()
+
+
+def inject(point: str) -> None:
+    """Raise FsError(FAULT_INJECTION) if an armed injection fires for point."""
+    inj = _current.get()
+    if inj is not None and inj.should_fire(point):
+        raise FsError(Status(Code.FAULT_INJECTION, f"injected at {point}"))
+
+
+def inject_result(point: str) -> Optional[Status]:
+    """Non-raising form: returns an error Status when the injection fires."""
+    inj = _current.get()
+    if inj is not None and inj.should_fire(point):
+        return Status(Code.FAULT_INJECTION, f"injected at {point}")
+    return None

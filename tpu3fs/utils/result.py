@@ -1,0 +1,208 @@
+"""Result/Status error model.
+
+Re-expresses the reference's ``Result<T> = Expected<T, Status>`` and the
+per-subsystem error taxonomy (ref: src/common/utils/Result.h,
+src/common/utils/StatusCode.h) as a small Python type. Services return
+``Result`` values instead of raising, so RPC layers can serialize failures and
+clients can drive retry ladders off the code class.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Code(enum.IntEnum):
+    """Error taxonomy, grouped by subsystem in disjoint ranges.
+
+    Mirrors the reference's StatusCode/MetaCode/StorageCode/RPCCode split
+    (src/common/utils/StatusCode.h); numbering is our own.
+    """
+
+    OK = 0
+
+    # generic 1xx
+    INVALID_ARG = 100
+    NOT_IMPLEMENTED = 101
+    TIMEOUT = 102
+    CANCELLED = 103
+    INTERNAL = 104
+    FAULT_INJECTION = 105
+    QUEUE_FULL = 106
+    SHUTTING_DOWN = 107
+
+    # RPC 2xx
+    RPC_CONNECT_FAILED = 200
+    RPC_SEND_FAILED = 201
+    RPC_TIMEOUT = 202
+    RPC_BAD_REQUEST = 203
+    RPC_METHOD_NOT_FOUND = 204
+    RPC_SERVICE_NOT_FOUND = 205
+    RPC_PEER_CLOSED = 206
+
+    # KV / transaction 3xx
+    KV_CONFLICT = 300
+    KV_NOT_FOUND = 301
+    KV_TXN_TOO_OLD = 302
+    KV_MAYBE_COMMITTED = 303
+    KV_RETRYABLE = 304
+
+    # meta 4xx
+    META_NOT_FOUND = 400
+    META_EXISTS = 401
+    META_NOT_DIRECTORY = 402
+    META_IS_DIRECTORY = 403
+    META_NOT_EMPTY = 404
+    META_NO_PERMISSION = 405
+    META_TOO_MANY_SYMLINKS = 406
+    META_LOOP = 407          # rename would create a directory cycle
+    META_BUSY = 408          # open write sessions exist
+    META_NO_SESSION = 409
+    META_BAD_LAYOUT = 410
+    META_NAME_TOO_LONG = 411
+    META_INVALID_PATH = 412
+    META_NOT_FILE = 413
+
+    # storage 5xx (update-code taxonomy, ref StorageOperator.cc:401-434)
+    CHUNK_NOT_FOUND = 500
+    CHUNK_NOT_COMMIT = 501        # read saw an uncommitted head version
+    CHUNK_STALE_UPDATE = 502      # update ver <= committed ver (duplicate)
+    CHUNK_MISSING_UPDATE = 503    # update ver > committed+1 (gap)
+    CHUNK_ADVANCE_UPDATE = 504    # retry raced ahead of a pending update
+    CHUNK_COMMITTED_UPDATE = 505  # commit for an already-committed ver
+    CHUNK_CHECKSUM_MISMATCH = 506
+    NO_SPACE = 507
+    TARGET_NOT_FOUND = 508
+    TARGET_OFFLINE = 509
+    CHAIN_VERSION_MISMATCH = 510
+    CHAIN_NOT_FOUND = 511
+    NOT_HEAD = 512                # client write sent to a non-head target
+    NO_SUCCESSOR = 513
+    SYNCING = 514                 # target still receiving full-chunk-replace
+    ENGINE_ERROR = 515
+    NONHEAD_WRITE_REJECTED = 516
+
+    # mgmtd 6xx
+    MGMTD_NOT_PRIMARY = 600
+    MGMTD_LEASE_EXPIRED = 601
+    MGMTD_STALE_HEARTBEAT = 602
+    MGMTD_NODE_NOT_FOUND = 603
+    MGMTD_CHAIN_NOT_FOUND = 604
+    MGMTD_INVALID_TRANSITION = 605
+    MGMTD_REGISTERED = 606
+
+    # client 7xx
+    CLIENT_RETRIES_EXHAUSTED = 700
+    CLIENT_NO_CHANNEL = 701
+    CLIENT_ROUTING_STALE = 702
+
+
+#: Codes on which a client-side retry ladder may re-issue the request.
+RETRYABLE_CODES = frozenset(
+    {
+        Code.TIMEOUT,
+        Code.RPC_CONNECT_FAILED,
+        Code.RPC_SEND_FAILED,
+        Code.RPC_TIMEOUT,
+        Code.RPC_PEER_CLOSED,
+        Code.KV_CONFLICT,
+        Code.KV_TXN_TOO_OLD,
+        Code.KV_RETRYABLE,
+        Code.CHUNK_NOT_COMMIT,
+        Code.CHAIN_VERSION_MISMATCH,
+        Code.CHUNK_ADVANCE_UPDATE,
+        Code.TARGET_OFFLINE,
+        Code.SYNCING,
+        Code.CLIENT_ROUTING_STALE,
+        Code.QUEUE_FULL,
+    }
+)
+
+
+@dataclass(frozen=True)
+class Status:
+    code: Code
+    message: str = ""
+
+    def is_ok(self) -> bool:
+        return self.code == Code.OK
+
+    def retryable(self) -> bool:
+        return self.code in RETRYABLE_CODES
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return f"{self.code.name}({int(self.code)}): {self.message}"
+
+
+OK_STATUS = Status(Code.OK)
+
+
+class FsError(Exception):
+    """Exception carrying a Status, for code that prefers raising."""
+
+    def __init__(self, status: Status):
+        super().__init__(str(status))
+        self.status = status
+
+    @property
+    def code(self) -> Code:
+        return self.status.code
+
+
+class Result(Generic[T]):
+    """Either a value or a Status error. ``Result.ok(v)`` / ``Result.err(...)``."""
+
+    __slots__ = ("_value", "_status")
+
+    def __init__(self, value: Optional[T], status: Status):
+        self._value = value
+        self._status = status
+
+    @classmethod
+    def ok(cls, value: T = None) -> "Result[T]":
+        return cls(value, OK_STATUS)
+
+    @classmethod
+    def err(cls, code: Code, message: str = "") -> "Result[T]":
+        return cls(None, Status(code, message))
+
+    @classmethod
+    def from_status(cls, status: Status) -> "Result[T]":
+        return cls(None, status)
+
+    def is_ok(self) -> bool:
+        return self._status.is_ok()
+
+    @property
+    def status(self) -> Status:
+        return self._status
+
+    @property
+    def code(self) -> Code:
+        return self._status.code
+
+    @property
+    def value(self) -> T:
+        """The success value; raises FsError if this is an error result."""
+        if not self.is_ok():
+            raise FsError(self._status)
+        return self._value
+
+    def value_or(self, default: T) -> T:
+        return self._value if self.is_ok() else default
+
+    def __bool__(self) -> bool:
+        return self.is_ok()
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        if self.is_ok():
+            return f"Result.ok({self._value!r})"
+        return f"Result.err({self._status})"
+
+
+def make_error(code: Code, message: str = "") -> Result:
+    return Result.err(code, message)
