@@ -1,0 +1,200 @@
+"""MemKV transaction semantics tests (mirrors tests/common/kv/mem of the ref)."""
+
+import threading
+
+import pytest
+
+from tpu3fs.kv import MemKVEngine, with_transaction
+from tpu3fs.kv.kv import RetryConfig
+from tpu3fs.utils.result import Code, FsError
+
+
+@pytest.fixture
+def eng():
+    return MemKVEngine()
+
+
+def commit(eng, **kvs):
+    txn = eng.transaction()
+    for k, v in kvs.items():
+        txn.set(k.encode(), v.encode())
+    txn.commit()
+
+
+class TestBasics:
+    def test_set_get_roundtrip(self, eng):
+        commit(eng, a="1")
+        txn = eng.transaction()
+        assert txn.get(b"a") == b"1"
+        assert txn.get(b"missing") is None
+
+    def test_read_your_writes(self, eng):
+        txn = eng.transaction()
+        txn.set(b"x", b"1")
+        assert txn.get(b"x") == b"1"
+        txn.clear(b"x")
+        assert txn.get(b"x") is None
+
+    def test_clear_range_local_and_committed(self, eng):
+        commit(eng, a="1", b="2", c="3")
+        txn = eng.transaction()
+        txn.set(b"bb", b"new")
+        txn.clear_range(b"b", b"c")
+        assert txn.get(b"b") is None
+        assert txn.get(b"bb") is None
+        assert txn.get(b"c") == b"3"
+        txn.commit()
+        txn2 = eng.transaction()
+        assert txn2.get(b"b") is None and txn2.get(b"c") == b"3"
+
+    def test_get_range(self, eng):
+        commit(eng, a="1", b="2", c="3", d="4")
+        txn = eng.transaction()
+        pairs = txn.get_range(b"b", b"d")
+        assert [(p.key, p.value) for p in pairs] == [(b"b", b"2"), (b"c", b"3")]
+        pairs = txn.get_range(b"a", b"z", limit=2)
+        assert [p.key for p in pairs] == [b"a", b"b"]
+        pairs = txn.get_range(b"a", b"z", reverse=True, limit=1)
+        assert [p.key for p in pairs] == [b"d"]
+
+
+class TestSnapshotIsolation:
+    def test_reads_pin_to_read_version(self, eng):
+        commit(eng, k="old")
+        txn = eng.transaction()
+        assert txn.get(b"k") == b"old"
+        commit(eng, k="new")  # concurrent commit
+        assert txn.get(b"k") == b"old"  # still the snapshot
+
+    def test_range_sees_snapshot(self, eng):
+        commit(eng, a="1")
+        txn = eng.transaction()
+        commit(eng, b="2")
+        assert [p.key for p in txn.get_range(b"a", b"z")] == [b"a"]
+
+
+class TestConflicts:
+    def test_write_read_conflict(self, eng):
+        commit(eng, k="0")
+        t1 = eng.transaction()
+        t1.get(b"k")
+        t1.set(b"out", b"x")
+        commit(eng, k="1")  # concurrent write to t1's read
+        with pytest.raises(FsError) as ei:
+            t1.commit()
+        assert ei.value.code == Code.KV_CONFLICT
+
+    def test_blind_writes_do_not_conflict(self, eng):
+        t1 = eng.transaction()
+        t1.set(b"k", b"a")
+        commit(eng, k="b")
+        t1.commit()  # blind write: no read set, no conflict
+        assert eng.transaction().get(b"k") == b"a"
+
+    def test_snapshot_read_no_conflict(self, eng):
+        commit(eng, k="0")
+        t1 = eng.transaction()
+        t1.snapshot_get(b"k")
+        t1.set(b"out", b"x")
+        commit(eng, k="1")
+        t1.commit()  # snapshot reads are not in the conflict set
+
+    def test_range_read_conflict(self, eng):
+        t1 = eng.transaction()
+        t1.get_range(b"a", b"m")
+        t1.set(b"out", b"x")
+        commit(eng, c="new")  # lands inside [a, m)
+        with pytest.raises(FsError):
+            t1.commit()
+
+    def test_range_clear_conflicts_with_point_read(self, eng):
+        commit(eng, c="1")
+        t1 = eng.transaction()
+        t1.get(b"c")
+        t1.set(b"out", b"x")
+        t2 = eng.transaction()
+        t2.clear_range(b"a", b"m")
+        t2.commit()
+        with pytest.raises(FsError):
+            t1.commit()
+
+    def test_manual_read_conflict(self, eng):
+        t1 = eng.transaction()
+        t1.add_read_conflict(b"k")
+        t1.set(b"out", b"1")
+        commit(eng, k="x")
+        with pytest.raises(FsError):
+            t1.commit()
+
+
+class TestVersionstamp:
+    def test_versionstamped_keys_order(self, eng):
+        txn = eng.transaction()
+        txn.set_versionstamped_key(b"LOG/", b"", b"first")
+        txn.commit()
+        txn = eng.transaction()
+        txn.set_versionstamped_key(b"LOG/", b"", b"second")
+        txn.commit()
+        scan = eng.transaction().get_range(b"LOG/", b"LOG0")
+        assert [p.value for p in scan] == [b"first", b"second"]
+        assert scan[0].key < scan[1].key
+
+    def test_committed_version_monotonic(self, eng):
+        t1 = eng.transaction()
+        t1.set(b"a", b"1")
+        t1.commit()
+        t2 = eng.transaction()
+        t2.set(b"b", b"2")
+        t2.commit()
+        assert t2.committed_version > t1.committed_version
+
+
+class TestWithTransaction:
+    def test_retries_conflict_until_success(self, eng):
+        commit(eng, counter="0")
+        calls = {"n": 0}
+
+        def bump(txn):
+            calls["n"] += 1
+            cur = int(txn.get(b"counter"))
+            if calls["n"] == 1:
+                # sneak in a conflicting commit mid-transaction
+                commit(eng, counter=str(cur + 100))
+            txn.set(b"counter", str(cur + 1).encode())
+            return cur + 1
+
+        with_transaction(eng, bump)
+        assert calls["n"] == 2
+        assert eng.transaction().get(b"counter") == b"101"
+
+    def test_gives_up_after_max_retries(self, eng):
+        def always_conflict(txn):
+            txn.get(b"k")
+            commit(eng, k="x")
+            txn.set(b"out", b"1")
+
+        with pytest.raises(FsError):
+            with_transaction(
+                eng, always_conflict,
+                RetryConfig(max_retries=2, backoff_base_s=0, backoff_max_s=0),
+            )
+
+    def test_concurrent_increments_all_land(self, eng):
+        commit(eng, n="0")
+
+        def bump(txn):
+            txn.set(b"n", str(int(txn.get(b"n")) + 1).encode())
+
+        threads = [
+            threading.Thread(
+                target=lambda: with_transaction(
+                    eng, bump, RetryConfig(max_retries=100)
+                )
+            )
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert eng.transaction().get(b"n") == b"8"

@@ -1,0 +1,342 @@
+"""Per-op metadata tests on MemKV (mirrors tests/meta/store/ops of the ref)."""
+
+import threading
+
+import pytest
+
+from tpu3fs.kv import MemKVEngine
+from tpu3fs.meta import MetaStore, OpenFlags
+from tpu3fs.meta.store import ChainAllocator, User
+from tpu3fs.meta.types import InodeType
+from tpu3fs.utils.result import Code, FsError
+
+
+@pytest.fixture
+def store():
+    return MetaStore(MemKVEngine(), ChainAllocator(1, [101, 102, 103, 104]))
+
+
+ALICE = User(uid=1000, gid=100)
+BOB = User(uid=2000, gid=200)
+
+
+def code_of(exc_info):
+    return exc_info.value.code
+
+
+class TestCreateStat:
+    def test_create_and_stat(self, store):
+        res = store.create("/f1", stripe=2)
+        assert res.inode.is_file()
+        assert len(res.inode.layout.chains) == 2
+        got = store.stat("/f1")
+        assert got.id == res.inode.id
+
+    def test_create_missing_parent(self, store):
+        with pytest.raises(FsError) as ei:
+            store.create("/nodir/f")
+        assert code_of(ei) == Code.META_NOT_FOUND
+
+    def test_create_excl_conflict(self, store):
+        store.create("/f")
+        with pytest.raises(FsError) as ei:
+            store.create("/f", flags=OpenFlags.EXCL)
+        assert code_of(ei) == Code.META_EXISTS
+
+    def test_create_open_existing(self, store):
+        a = store.create("/f")
+        b = store.create("/f")  # no EXCL: opens
+        assert a.inode.id == b.inode.id
+
+    def test_stat_missing(self, store):
+        with pytest.raises(FsError) as ei:
+            store.stat("/ghost")
+        assert code_of(ei) == Code.META_NOT_FOUND
+
+    def test_relative_path_rejected(self, store):
+        with pytest.raises(FsError) as ei:
+            store.stat("oops")
+        assert code_of(ei) == Code.META_INVALID_PATH
+
+    def test_chains_round_robin(self, store):
+        c1 = store.create("/a", stripe=2).inode.layout.chains
+        c2 = store.create("/b", stripe=2).inode.layout.chains
+        assert c1 != c2  # cursor advanced
+
+    def test_batch_stat(self, store):
+        a = store.create("/a").inode
+        got = store.batch_stat([a.id, 99999])
+        assert got[0].id == a.id and got[1] is None
+
+    def test_batch_stat_by_path(self, store):
+        store.create("/a")
+        got = store.batch_stat_by_path(["/a", "/nope"])
+        assert got[0] is not None and got[1] is None
+
+
+class TestMkdirsList:
+    def test_mkdirs_recursive(self, store):
+        d = store.mkdirs("/a/b/c", recursive=True)
+        assert d.is_dir()
+        assert store.stat("/a/b").is_dir()
+
+    def test_mkdirs_nonrecursive_missing(self, store):
+        with pytest.raises(FsError) as ei:
+            store.mkdirs("/x/y")
+        assert code_of(ei) == Code.META_NOT_FOUND
+
+    def test_mkdirs_exists(self, store):
+        store.mkdirs("/d")
+        with pytest.raises(FsError) as ei:
+            store.mkdirs("/d")
+        assert code_of(ei) == Code.META_EXISTS
+
+    def test_list(self, store):
+        store.mkdirs("/d")
+        store.create("/d/f1")
+        store.create("/d/f2")
+        store.mkdirs("/d/sub")
+        names = [e.name for e in store.list_dir("/d")]
+        assert names == ["f1", "f2", "sub"]
+
+    def test_list_prefix_and_limit(self, store):
+        store.mkdirs("/d")
+        for n in ("aa", "ab", "ba"):
+            store.create(f"/d/{n}")
+        assert [e.name for e in store.list_dir("/d", prefix="a")] == ["aa", "ab"]
+        assert len(store.list_dir("/d", limit=2)) == 2
+
+    def test_list_file_fails(self, store):
+        store.create("/f")
+        with pytest.raises(FsError) as ei:
+            store.list_dir("/f")
+        assert code_of(ei) == Code.META_NOT_DIRECTORY
+
+
+class TestOpenCloseSessions:
+    def test_write_open_creates_session(self, store):
+        res = store.create("/f", flags=OpenFlags.WRITE, client_id="c1")
+        assert res.session_id
+        sessions = store.list_sessions(res.inode.id)
+        assert len(sessions) == 1 and sessions[0].client_id == "c1"
+
+    def test_close_settles_length_and_drops_session(self, store):
+        res = store.create("/f", flags=OpenFlags.WRITE, client_id="c1")
+        inode = store.close(res.inode.id, res.session_id, length_hint=12345)
+        assert inode.length == 12345
+        assert store.list_sessions(res.inode.id) == []
+
+    def test_close_idempotent_via_request_id(self, store):
+        res = store.create("/f", flags=OpenFlags.WRITE, client_id="c1")
+        store.close(res.inode.id, res.session_id, length_hint=10,
+                    client_id="c1", request_id="r1")
+        # retry with the same request id succeeds despite the session being gone
+        inode = store.close(res.inode.id, res.session_id, length_hint=10,
+                            client_id="c1", request_id="r1")
+        assert inode.length == 10
+
+    def test_close_unknown_session(self, store):
+        res = store.create("/f")
+        with pytest.raises(FsError) as ei:
+            store.close(res.inode.id, "nope")
+        assert code_of(ei) == Code.META_NO_SESSION
+
+    def test_trunc_resets_length(self, store):
+        res = store.create("/f", flags=OpenFlags.WRITE, client_id="c")
+        store.close(res.inode.id, res.session_id, length_hint=100)
+        r2 = store.open("/f", flags=OpenFlags.WRITE | OpenFlags.TRUNC, client_id="c")
+        assert store.stat("/f").length == 0
+        assert r2.session_id
+
+    def test_prune_session(self, store):
+        store.create("/f1", flags=OpenFlags.WRITE, client_id="dead")
+        store.create("/f2", flags=OpenFlags.WRITE, client_id="dead")
+        store.create("/f3", flags=OpenFlags.WRITE, client_id="alive")
+        assert store.prune_session("dead") == 2
+        assert len(store.list_sessions()) == 1
+
+    def test_sync_monotonic_hint(self, store):
+        res = store.create("/f")
+        store.sync(res.inode.id, length_hint=100)
+        store.sync(res.inode.id, length_hint=50)  # stale hint ignored
+        assert store.stat("/f").length == 100
+
+    def test_file_length_hook_wins(self):
+        store = MetaStore(
+            MemKVEngine(), ChainAllocator(1, [1]),
+            file_length_hook=lambda inode: 777,
+        )
+        res = store.create("/f", flags=OpenFlags.WRITE, client_id="c")
+        inode = store.close(res.inode.id, res.session_id, length_hint=5)
+        assert inode.length == 777
+
+
+class TestRemoveGc:
+    def test_remove_file_goes_to_gc(self, store):
+        res = store.create("/f")
+        store.remove("/f")
+        with pytest.raises(FsError):
+            store.stat("/f")
+        gc = store.gc_scan()
+        assert [i.id for i in gc] == [res.inode.id]
+        store.gc_finish(res.inode.id)
+        assert store.gc_scan() == []
+
+    def test_remove_nonempty_dir(self, store):
+        store.mkdirs("/d")
+        store.create("/d/f")
+        with pytest.raises(FsError) as ei:
+            store.remove("/d")
+        assert code_of(ei) == Code.META_NOT_EMPTY
+
+    def test_remove_recursive(self, store):
+        store.mkdirs("/d/sub", recursive=True)
+        store.create("/d/sub/f")
+        store.remove("/d", recursive=True)
+        with pytest.raises(FsError):
+            store.stat("/d")
+        assert len(store.gc_scan()) == 1  # the file under /d/sub
+
+    def test_remove_idempotent(self, store):
+        store.create("/f")
+        store.remove("/f", client_id="c", request_id="rq")
+        store.remove("/f", client_id="c", request_id="rq")  # retry: ok
+        with pytest.raises(FsError):
+            store.remove("/f", client_id="c", request_id="rq2")
+
+    def test_hardlink_remove_keeps_inode(self, store):
+        store.create("/f")
+        store.hard_link("/f", "/g")
+        store.remove("/f")
+        assert store.stat("/g").nlink == 1
+        assert store.gc_scan() == []  # still linked
+        store.remove("/g")
+        assert len(store.gc_scan()) == 1
+
+
+class TestRename:
+    def test_rename_file(self, store):
+        a = store.create("/a").inode
+        store.rename("/a", "/b")
+        assert store.stat("/b").id == a.id
+        with pytest.raises(FsError):
+            store.stat("/a")
+
+    def test_rename_replaces_existing_file(self, store):
+        store.create("/a")
+        old = store.create("/b").inode
+        store.rename("/a", "/b")
+        assert [i.id for i in store.gc_scan()] == [old.id]
+
+    def test_rename_dir_updates_parent(self, store):
+        store.mkdirs("/d1/sub", recursive=True)
+        store.mkdirs("/d2")
+        store.rename("/d1/sub", "/d2/sub")
+        assert store.stat("/d2/sub").is_dir()
+        assert store.get_real_path("/d2/sub") == "/d2/sub"
+
+    def test_rename_loop_detected(self, store):
+        store.mkdirs("/a/b", recursive=True)
+        with pytest.raises(FsError) as ei:
+            store.rename("/a", "/a/b/c")
+        assert code_of(ei) == Code.META_LOOP
+
+    def test_rename_to_self_noop(self, store):
+        store.create("/a")
+        store.rename("/a", "/a")
+        assert store.stat("/a")
+
+
+class TestSymlinks:
+    def test_symlink_resolution(self, store):
+        store.mkdirs("/real")
+        store.create("/real/f")
+        store.symlink("/link", "/real")
+        assert store.stat("/link/f").is_file()
+
+    def test_symlink_nofollow(self, store):
+        store.create("/t")
+        store.symlink("/l", "/t")
+        assert store.stat("/l", follow=False).is_symlink()
+        assert store.stat("/l").is_file()
+
+    def test_relative_symlink(self, store):
+        store.mkdirs("/d")
+        store.create("/d/f")
+        store.symlink("/d/l", "f")
+        assert store.stat("/d/l").is_file()
+
+    def test_symlink_loop(self, store):
+        store.symlink("/l1", "/l2")
+        store.symlink("/l2", "/l1")
+        with pytest.raises(FsError) as ei:
+            store.stat("/l1")
+        assert code_of(ei) == Code.META_TOO_MANY_SYMLINKS
+
+
+class TestPermissions:
+    def test_non_owner_cannot_write_dir(self, store):
+        store.mkdirs("/home", perm=0o755)  # owned by root
+        with pytest.raises(FsError) as ei:
+            store.create("/home/f", user=ALICE)
+        assert code_of(ei) == Code.META_NO_PERMISSION
+
+    def test_owner_can_write(self, store):
+        store.mkdirs("/home", perm=0o777)
+        store.mkdirs("/home/alice", user=ALICE, perm=0o700)
+        store.create("/home/alice/f", user=ALICE)
+        with pytest.raises(FsError):
+            store.stat("/home/alice/f", user=BOB)  # no X on alice's dir
+
+    def test_chmod_chown(self, store):
+        store.create("/f")
+        store.set_attr("/f", perm=0o600, uid=1000, gid=100)
+        inode = store.stat("/f")
+        assert inode.acl.perm == 0o600 and inode.acl.uid == 1000
+        with pytest.raises(FsError):
+            store.set_attr("/f", user=BOB, perm=0o777)
+
+    def test_lock_directory(self, store):
+        store.mkdirs("/d", perm=0o777)
+        store.lock_directory("/d", "holder1")
+        with pytest.raises(FsError) as ei:
+            store.create("/d/f", user=ALICE)
+        assert code_of(ei) == Code.META_NO_PERMISSION
+        store.lock_directory("/d", "")  # unlock
+        store.create("/d/f", user=ALICE)
+
+
+class TestMisc:
+    def test_truncate(self, store):
+        store.create("/f")
+        store.truncate("/f", 4096)
+        assert store.stat("/f").length == 4096
+
+    def test_get_real_path(self, store):
+        store.mkdirs("/a/b", recursive=True)
+        store.create("/a/b/f")
+        assert store.get_real_path("/a/b/f") == "/a/b/f"
+        store.symlink("/l", "/a/b")
+        assert store.get_real_path("/l/f") == "/a/b/f"
+
+    def test_stat_fs(self, store):
+        r = store.create("/f", flags=OpenFlags.WRITE, client_id="c")
+        store.close(r.inode.id, r.session_id, length_hint=1000)
+        fs = store.stat_fs()
+        assert fs.files == 1 and fs.used == 1000
+
+    def test_concurrent_creates_unique_ids(self, store):
+        ids = []
+        lock = threading.Lock()
+
+        def make(i):
+            inode = store.create(f"/f{i}").inode
+            with lock:
+                ids.append(inode.id)
+
+        threads = [threading.Thread(target=make, args=(i,)) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(ids)) == 16

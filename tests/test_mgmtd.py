@@ -1,0 +1,261 @@
+"""Cluster manager tests: chain state machine (incl. randomized schedules),
+lease election, heartbeats, routing versioning."""
+
+import random
+
+import pytest
+
+from tpu3fs.kv import MemKVEngine
+from tpu3fs.mgmtd import (
+    ChainTarget,
+    LocalTargetState as LS,
+    Mgmtd,
+    MgmtdConfig,
+    NodeType,
+    PublicTargetState as PS,
+    generate_new_chain,
+)
+from tpu3fs.mgmtd.chain_sm import step_chain
+from tpu3fs.mgmtd.types import ChainInfo
+from tpu3fs.utils.result import Code, FsError
+
+
+def chain(*specs):
+    return [ChainTarget(i + 1, ps, ls) for i, (ps, ls) in enumerate(specs)]
+
+
+def states(targets):
+    return [(t.target_id, t.public_state) for t in targets]
+
+
+class TestChainSM:
+    def test_steady_state_no_change(self):
+        c = chain((PS.SERVING, LS.UPTODATE), (PS.SERVING, LS.UPTODATE))
+        out = generate_new_chain(c)
+        assert states(out) == [(1, PS.SERVING), (2, PS.SERVING)]
+
+    def test_tail_death_rotates_to_end(self):
+        c = chain(
+            (PS.SERVING, LS.UPTODATE),
+            (PS.SERVING, LS.OFFLINE),
+            (PS.SERVING, LS.UPTODATE),
+        )
+        out = generate_new_chain(c)
+        assert states(out) == [(1, PS.SERVING), (3, PS.SERVING), (2, PS.OFFLINE)]
+
+    def test_all_serving_die_first_becomes_lastsrv(self):
+        c = chain((PS.SERVING, LS.OFFLINE), (PS.SERVING, LS.OFFLINE))
+        out = generate_new_chain(c)
+        assert states(out) == [(1, PS.LASTSRV), (2, PS.OFFLINE)]
+
+    def test_lastsrv_returns_to_serving(self):
+        c = chain((PS.LASTSRV, LS.ONLINE), (PS.OFFLINE, LS.OFFLINE))
+        out = generate_new_chain(c)
+        assert out[0].public_state == PS.SERVING
+
+    def test_lastsrv_demoted_when_serving_exists(self):
+        c = chain((PS.SERVING, LS.UPTODATE), (PS.LASTSRV, LS.OFFLINE))
+        out = generate_new_chain(c)
+        assert states(out) == [(1, PS.SERVING), (2, PS.OFFLINE)]
+
+    def test_offline_returns_via_waiting_then_syncing(self):
+        c = chain((PS.SERVING, LS.UPTODATE), (PS.OFFLINE, LS.ONLINE))
+        out = generate_new_chain(c)
+        # serving source exists and nothing is syncing: start recovery
+        assert states(out) == [(1, PS.SERVING), (2, PS.SYNCING)]
+
+    def test_only_one_syncing_at_a_time(self):
+        c = chain(
+            (PS.SERVING, LS.UPTODATE),
+            (PS.SYNCING, LS.ONLINE),
+            (PS.OFFLINE, LS.ONLINE),
+        )
+        out = generate_new_chain(c)
+        assert states(out) == [(1, PS.SERVING), (2, PS.SYNCING), (3, PS.WAITING)]
+
+    def test_sync_completion_promotes_to_serving(self):
+        c = chain((PS.SERVING, LS.UPTODATE), (PS.SYNCING, LS.UPTODATE))
+        out = generate_new_chain(c)
+        assert states(out) == [(1, PS.SERVING), (2, PS.SERVING)]
+
+    def test_syncing_without_source_falls_to_waiting(self):
+        c = chain((PS.SERVING, LS.OFFLINE), (PS.SYNCING, LS.ONLINE))
+        out = generate_new_chain(c)
+        assert states(out) == [(1, PS.LASTSRV), (2, PS.WAITING)]
+
+    def test_version_bumps_only_on_change(self):
+        c = ChainInfo(1, 1, chain((PS.SERVING, LS.UPTODATE)))
+        c2, changed = step_chain(c)
+        assert not changed and c2.chain_version == 1
+        c2.targets[0].local_state = LS.OFFLINE
+        c3, changed = step_chain(c2)
+        assert changed and c3.chain_version == 2
+
+    def test_randomized_schedules_invariants(self):
+        """Model-check style: random kill/recover schedules preserve the
+        invariants of the design-notes state machine (the reference checks
+        these with P specs, specs/DataStorage)."""
+        rng = random.Random(0)
+        for trial in range(200):
+            n = rng.randint(1, 5)
+            targets = chain(*[(PS.SERVING, LS.UPTODATE)] * n)
+            info = ChainInfo(1, 1, targets)
+            for _step in range(30):
+                # random local-state events
+                for t in info.targets:
+                    r = rng.random()
+                    if t.local_state == LS.OFFLINE:
+                        if r < 0.3:
+                            t.local_state = LS.ONLINE
+                    elif r < 0.2:
+                        t.local_state = LS.OFFLINE
+                    elif t.public_state == PS.SYNCING and r < 0.5:
+                        t.local_state = LS.UPTODATE
+                info, _ = step_chain(info)
+                sts = [t.public_state for t in info.targets]
+                assert len(info.targets) == n
+                assert sts.count(PS.LASTSRV) <= 1
+                assert sts.count(PS.SYNCING) <= 1
+                assert not (PS.SERVING in sts and PS.LASTSRV in sts)
+                for t in info.targets:
+                    if t.local_state == LS.OFFLINE:
+                        assert t.public_state in (PS.OFFLINE, PS.LASTSRV)
+                # order: serving first, offline last
+                order = [t.public_state for t in info.targets]
+                serving_idx = [i for i, s in enumerate(order) if s == PS.SERVING]
+                offline_idx = [i for i, s in enumerate(order) if s == PS.OFFLINE]
+                if serving_idx and offline_idx:
+                    assert max(serving_idx) < min(offline_idx)
+            # full recovery: everyone comes back; chain must converge to all
+            # SERVING after enough steps (one syncing at a time -> n steps)
+            for t in info.targets:
+                if t.local_state == LS.OFFLINE:
+                    t.local_state = LS.ONLINE
+            for _ in range(3 * n + 2):
+                for t in info.targets:
+                    if t.public_state == PS.SYNCING:
+                        t.local_state = LS.UPTODATE  # sync completes
+                info, _ = step_chain(info)
+            assert all(t.public_state == PS.SERVING for t in info.targets), (
+                trial,
+                states(info.targets),
+            )
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def cluster():
+    eng = MemKVEngine()
+    clock = FakeClock()
+    m = Mgmtd(1, eng, MgmtdConfig(lease_length_s=60, heartbeat_timeout_s=60),
+              clock=clock)
+    m.extend_lease()
+    return m, eng, clock
+
+
+class TestLease:
+    def test_first_wins(self, cluster):
+        m1, eng, clock = cluster
+        m2 = Mgmtd(2, eng, clock=clock)
+        assert m1.is_primary()
+        lease = m2.extend_lease()
+        assert lease.primary_node_id == 1
+        assert not m2.is_primary()
+
+    def test_takeover_after_expiry(self, cluster):
+        m1, eng, clock = cluster
+        m2 = Mgmtd(2, eng, clock=clock)
+        clock.t += 61
+        lease = m2.extend_lease()
+        assert lease.primary_node_id == 2
+        assert lease.release_version == 2
+        assert not m1.is_primary()
+
+    def test_deposed_primary_mutation_fails(self, cluster):
+        m1, eng, clock = cluster
+        m2 = Mgmtd(2, eng, clock=clock)
+        clock.t += 61
+        m2.extend_lease()
+        with pytest.raises(FsError) as ei:
+            m1.create_target(1)
+        assert ei.value.code == Code.MGMTD_NOT_PRIMARY
+
+
+class TestHeartbeatAndChains:
+    def _boot(self, m):
+        for node in (10, 11, 12):
+            m.register_node(node, NodeType.STORAGE)
+        for t, node in ((101, 10), (102, 11), (103, 12)):
+            m.create_target(t, node_id=node)
+        m.upload_chain(900001, [101, 102, 103])
+        m.upload_chain_table(1, [900001])
+        for i, node in enumerate((10, 11, 12)):
+            m.heartbeat(node, 1, {101 + i: LS.UPTODATE})
+
+    def test_routing_versioning(self, cluster):
+        m, _, _ = cluster
+        self._boot(m)
+        ri = m.get_routing_info()
+        assert ri.version > 0
+        assert m.get_routing_info(ri.version) is None  # up-to-date client
+        chain_info = ri.chains[900001]
+        assert [t.target_id for t in chain_info.targets] == [101, 102, 103]
+
+    def test_stale_heartbeat_rejected(self, cluster):
+        m, _, _ = cluster
+        m.register_node(10, NodeType.STORAGE)
+        m.heartbeat(10, 5)
+        with pytest.raises(FsError) as ei:
+            m.heartbeat(10, 4)
+        assert ei.value.code == Code.MGMTD_STALE_HEARTBEAT
+
+    def test_dead_node_triggers_chain_update(self, cluster):
+        m, _, clock = cluster
+        self._boot(m)
+        v0 = m.get_routing_info().version
+        # node 11 goes silent past T
+        clock.t += 61
+        m.heartbeat(10, 2, {101: LS.UPTODATE})
+        m.heartbeat(12, 2, {103: LS.UPTODATE})
+        m.tick()
+        ri = m.get_routing_info()
+        assert ri.version > v0
+        c = ri.chains[900001]
+        assert states(c.targets) == [
+            (101, PS.SERVING), (103, PS.SERVING), (102, PS.OFFLINE)
+        ]
+        assert c.chain_version == 2
+        # node 11 comes back: waiting -> syncing
+        m.heartbeat(11, 3, {102: LS.ONLINE})
+        m.tick()
+        c = m.get_routing_info().chains[900001]
+        assert c.targets[-1].public_state == PS.SYNCING
+        # sync completes
+        m.heartbeat(11, 4, {102: LS.UPTODATE})
+        m.tick()
+        c = m.get_routing_info().chains[900001]
+        assert all(t.public_state == PS.SERVING for t in c.targets)
+
+    def test_config_distribution(self, cluster):
+        m, _, _ = cluster
+        m.register_node(10, NodeType.STORAGE)
+        v = m.set_config(NodeType.STORAGE, "io_depth = 64\n")
+        reply = m.heartbeat(10, 1)
+        assert reply.config_version == v
+        assert "io_depth" in reply.config_content
+
+    def test_persistence_reload(self, cluster):
+        m, eng, clock = cluster
+        self._boot(m)
+        v = m.get_routing_info().version
+        m2 = Mgmtd(1, eng, clock=clock)  # restart: reload from KV
+        ri = m2.get_routing_info()
+        assert ri.version == v
+        assert 900001 in ri.chains and len(ri.targets) == 3

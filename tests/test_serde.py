@@ -1,0 +1,87 @@
+"""Serde reflection tests (mirrors tests/common/serde/TestSerde.cc intent)."""
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import pytest
+
+from tpu3fs.rpc.serde import deserialize, serde_json, serialize
+
+
+class Color(enum.IntEnum):
+    RED = 1
+    BLUE = 2
+
+
+@dataclass
+class Inner:
+    x: int
+    tag: str
+
+
+@dataclass
+class Outer:
+    a: int
+    b: bool
+    c: float
+    name: str
+    blob: bytes
+    color: Color
+    items: List[Inner]
+    table: Dict[str, int]
+    maybe: Optional[Inner]
+
+
+def sample():
+    return Outer(
+        a=-12345678901234,
+        b=True,
+        c=3.5,
+        name="héllo",
+        blob=b"\x00\xff\x10",
+        color=Color.BLUE,
+        items=[Inner(1, "one"), Inner(-2, "two")],
+        table={"k1": 10, "k2": -20},
+        maybe=Inner(7, "seven"),
+    )
+
+
+class TestSerde:
+    def test_roundtrip(self):
+        v = sample()
+        assert deserialize(serialize(v), Outer) == v
+
+    def test_none_optional(self):
+        v = sample()
+        v.maybe = None
+        assert deserialize(serialize(v), Outer) == v
+
+    def test_negative_and_large_ints(self):
+        for n in (0, -1, 1, 2**62, -(2**62), 127, -128):
+            assert deserialize(serialize(n, int), int) == n
+
+    def test_trailing_field_evolution(self):
+        @dataclass
+        class V1:
+            x: int
+
+        @dataclass
+        class V2:
+            x: int
+            y: str = "default"
+
+        wire = serialize(V1(5))
+        got = deserialize(wire, V2)
+        assert got.x == 5 and got.y == "default"
+
+    def test_trailing_garbage_rejected(self):
+        wire = serialize(sample()) + b"\x00"
+        with pytest.raises(ValueError):
+            deserialize(wire, Outer)
+
+    def test_json_render(self):
+        j = serde_json(sample())
+        assert j["color"] == "BLUE"
+        assert j["blob"] == "00ff10"
+        assert j["items"][0] == {"x": 1, "tag": "one"}

@@ -1,0 +1,6 @@
+from tpu3fs.client.storage_client import (  # noqa: F401
+    StorageClient,
+    TargetSelectionMode,
+    UpdateChannelAllocator,
+)
+from tpu3fs.client.file_io import FileIoClient  # noqa: F401

@@ -1,0 +1,114 @@
+"""File-level IO: byte ranges -> per-chunk chain ops against a file's layout.
+
+The client-side equivalent of the FUSE daemon's PioV (src/fuse/PioV.cc):
+split a file-offset range into per-chunk ReadIO/WriteIOs routed by
+Layout.chain_of_chunk, issue them through the StorageClient, and reassemble.
+Also provides the precise-length callback used by meta close/fsync
+(ref src/meta/components/FileHelper.cc queryLastChunk).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from tpu3fs.client.storage_client import StorageClient
+from tpu3fs.meta.types import Inode, Layout
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils.result import Code, FsError, Status
+
+
+class FileIoClient:
+    def __init__(self, storage: StorageClient):
+        self._storage = storage
+
+    @staticmethod
+    def _split(
+        layout: Layout, offset: int, size: int
+    ) -> List[Tuple[int, int, int, int]]:
+        """-> [(chunk_index, chain_id, offset_in_chunk, length)] covering the
+        range."""
+        out = []
+        cs = layout.chunk_size
+        pos = offset
+        end = offset + size
+        while pos < end:
+            idx = pos // cs
+            in_off = pos % cs
+            n = min(end - pos, cs - in_off)
+            out.append((idx, layout.chain_of_chunk(idx), in_off, n))
+            pos += n
+        return out
+
+    def write(self, inode: Inode, offset: int, data: bytes) -> int:
+        layout = inode.layout
+        assert layout is not None, "write() needs a file inode with layout"
+        written = 0
+        for idx, chain_id, in_off, n in self._split(layout, offset, len(data)):
+            reply = self._storage.write_chunk(
+                chain_id,
+                ChunkId(inode.id, idx),
+                in_off,
+                data[written : written + n],
+                chunk_size=layout.chunk_size,
+            )
+            if not reply.ok:
+                raise FsError(Status(reply.code, reply.message))
+            written += n
+        return written
+
+    def read(self, inode: Inode, offset: int, size: int) -> bytes:
+        """POSIX-style read: holes and short chunks inside the file read as
+        zeros; the result is clamped to the inode's length (short read at EOF).
+        Each chunk part is padded to its slot so later chunks keep their file
+        offsets."""
+        layout = inode.layout
+        assert layout is not None
+        if inode.length:
+            size = max(0, min(size, inode.length - offset))
+        if size == 0:
+            return b""
+        parts: List[bytes] = []
+        for idx, chain_id, in_off, n in self._split(layout, offset, size):
+            reply = self._storage.read_chunk(
+                chain_id, ChunkId(inode.id, idx), in_off, n
+            )
+            if reply.code == Code.CHUNK_NOT_FOUND:
+                parts.append(b"\x00" * n)  # hole
+                continue
+            if not reply.ok:
+                raise FsError(Status(reply.code))
+            parts.append(reply.data.ljust(n, b"\x00"))  # pad short chunk
+        return b"".join(parts)
+
+    def file_length(self, inode: Inode) -> int:
+        """Precise length: max over chains of last chunk end (FileHelper)."""
+        layout = inode.layout
+        if layout is None:
+            return 0
+        best = 0
+        for chain_id in set(layout.chains):
+            idx, length = self._storage.query_last_chunk(chain_id, inode.id)
+            if idx >= 0:
+                best = max(best, idx * layout.chunk_size + length)
+        return best
+
+    def remove_chunks(self, inode: Inode) -> None:
+        layout = inode.layout
+        if layout is None:
+            return
+        for chain_id in set(layout.chains):
+            self._storage.remove_file_chunks(chain_id, inode.id)
+
+    def truncate_chunks(self, inode: Inode, length: int) -> None:
+        """Drop chunks past the new EOF and trim the boundary chunk, down
+        every chain of the layout (the storage half of meta truncate)."""
+        layout = inode.layout
+        if layout is None:
+            return
+        cs = layout.chunk_size
+        last_idx = (length - 1) // cs if length > 0 else -1
+        last_len = (length - last_idx * cs) if last_idx >= 0 else 0
+        for chain_id in set(layout.chains):
+            self._storage.truncate_file_chunks(
+                chain_id, inode.id, last_idx, last_len
+            )

@@ -1,0 +1,285 @@
+"""Storage client: chain-aware writes, apportioned reads, retry ladders.
+
+Re-expresses src/client/storage/StorageClientImpl.cc: writes go to the chain
+HEAD with an exactly-once (client, channel, seqnum) identity reused across
+retries (UpdateChannelAllocator.h:11-34); retries refresh routing on
+chain-version bumps (batchWriteWithRetry :1771); reads pick any SERVING
+target by a selection strategy (TargetSelection.h:29-46) and fail over to the
+remaining replicas; batches group per node (groupOpsByNodeId :1030).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import random
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpu3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
+from tpu3fs.storage.craq import Messenger, ReadReply, ReadReq, UpdateReply, WriteReq
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils.result import Code, FsError, Status
+
+
+class TargetSelectionMode(enum.Enum):
+    """ref TargetSelection.h:29-46."""
+
+    LOAD_BALANCE = "load_balance"   # random among serving (spreads load)
+    ROUND_ROBIN = "round_robin"
+    RANDOM = "random"
+    HEAD = "head"
+    TAIL = "tail"                   # strongest freshness (already committed)
+
+
+class UpdateChannelAllocator:
+    """Exclusive channel ids; a channel+seqnum names one logical update."""
+
+    def __init__(self, capacity: int = 1024):
+        self._free = list(range(1, capacity + 1))
+        self._seq: Dict[int, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def acquire(self) -> Tuple[int, int]:
+        with self._lock:
+            if not self._free:
+                raise FsError(Status(Code.CLIENT_NO_CHANNEL, "channel pool empty"))
+            ch = self._free.pop()
+            self._seq[ch] += 1
+            return ch, self._seq[ch]
+
+    def release(self, channel_id: int) -> None:
+        with self._lock:
+            self._free.append(channel_id)
+
+
+@dataclass
+class RetryOptions:
+    max_retries: int = 8
+    backoff_base_s: float = 0.002
+    backoff_max_s: float = 0.25
+
+
+class StorageClient:
+    def __init__(
+        self,
+        client_id: str,
+        routing_provider: Callable[[], RoutingInfo],
+        messenger: Messenger,
+        *,
+        retry: Optional[RetryOptions] = None,
+        selection: TargetSelectionMode = TargetSelectionMode.LOAD_BALANCE,
+        seed: int = 0,
+    ):
+        self.client_id = client_id
+        self._routing = routing_provider
+        self._messenger = messenger
+        self._retry = retry or RetryOptions()
+        self._selection = selection
+        self._channels = UpdateChannelAllocator()
+        self._rr = itertools.count()
+        self._rng = random.Random(seed)
+
+    # -- internals ----------------------------------------------------------
+    def _chain(self, chain_id: int) -> ChainInfo:
+        chain = self._routing().chains.get(chain_id)
+        if chain is None:
+            raise FsError(Status(Code.CHAIN_NOT_FOUND, str(chain_id)))
+        return chain
+
+    def _sleep(self, attempt: int) -> None:
+        delay = min(
+            self._retry.backoff_max_s, self._retry.backoff_base_s * (2 ** attempt)
+        )
+        time.sleep(delay * (0.5 + self._rng.random() / 2))
+
+    # -- writes ---------------------------------------------------------------
+    def write_chunk(
+        self,
+        chain_id: int,
+        chunk_id: ChunkId,
+        offset: int,
+        data: bytes,
+        *,
+        chunk_size: int = 1 << 20,
+    ) -> UpdateReply:
+        """Write with the full retry ladder; exactly-once via channel identity."""
+        channel, seq = self._channels.acquire()
+        try:
+            last: Optional[UpdateReply] = None
+            for attempt in range(self._retry.max_retries + 1):
+                try:
+                    chain = self._chain(chain_id)
+                except FsError as e:
+                    return UpdateReply(e.code, message=e.status.message)
+                head = chain.head()
+                if head is None:
+                    last = UpdateReply(Code.TARGET_OFFLINE, message="no head")
+                    self._sleep(attempt)
+                    continue
+                node = self._routing().node_of_target(head.target_id)
+                if node is None:
+                    last = UpdateReply(Code.TARGET_NOT_FOUND, message="no head node")
+                    self._sleep(attempt)
+                    continue
+                req = WriteReq(
+                    chain_id=chain_id,
+                    chain_ver=chain.chain_version,
+                    chunk_id=chunk_id,
+                    offset=offset,
+                    data=data,
+                    chunk_size=chunk_size,
+                    client_id=self.client_id,
+                    channel_id=channel,
+                    seqnum=seq,
+                )
+                try:
+                    reply = self._messenger(node.node_id, "write", req)
+                except FsError as e:
+                    reply = UpdateReply(e.code, message=e.status.message)
+                if reply.ok:
+                    return reply
+                last = reply
+                if Status(reply.code).retryable() or reply.code in (
+                    Code.NOT_HEAD,
+                    Code.RPC_PEER_CLOSED,
+                ):
+                    self._sleep(attempt)
+                    continue
+                return reply
+            return last or UpdateReply(Code.CLIENT_RETRIES_EXHAUSTED)
+        finally:
+            self._channels.release(channel)
+
+    # -- reads ----------------------------------------------------------------
+    def _pick_targets(self, chain: ChainInfo) -> List[int]:
+        serving = [
+            t.target_id
+            for t in chain.targets
+            if t.public_state == PublicTargetState.SERVING
+        ]
+        if not serving:
+            return []
+        mode = self._selection
+        if mode == TargetSelectionMode.HEAD:
+            order = serving
+        elif mode == TargetSelectionMode.TAIL:
+            order = serving[::-1]
+        elif mode == TargetSelectionMode.ROUND_ROBIN:
+            k = next(self._rr) % len(serving)
+            order = serving[k:] + serving[:k]
+        else:  # LOAD_BALANCE / RANDOM
+            order = list(serving)
+            self._rng.shuffle(order)
+        return order
+
+    def read_chunk(
+        self,
+        chain_id: int,
+        chunk_id: ChunkId,
+        offset: int = 0,
+        length: int = -1,
+    ) -> ReadReply:
+        last = ReadReply(Code.TARGET_NOT_FOUND)
+        for attempt in range(self._retry.max_retries + 1):
+            try:
+                chain = self._chain(chain_id)
+            except FsError as e:
+                return ReadReply(e.code)
+            targets = self._pick_targets(chain)
+            routing = self._routing()
+            for target_id in targets:
+                node = routing.node_of_target(target_id)
+                if node is None:
+                    continue
+                req = ReadReq(chain_id, chunk_id, offset, length, target_id)
+                try:
+                    reply = self._messenger(node.node_id, "read", req)
+                except FsError as e:
+                    reply = ReadReply(e.code)
+                if reply.ok or reply.code == Code.CHUNK_NOT_FOUND:
+                    return reply
+                last = reply
+            if last.code in (Code.CHUNK_NOT_COMMIT,) or Status(last.code).retryable():
+                self._sleep(attempt)
+                continue
+            return last
+        return last
+
+    def batch_read(
+        self, reqs: List[ReadReq]
+    ) -> List[ReadReply]:
+        """Group per node (ref groupOpsByNodeId) then issue node batches."""
+        routing = self._routing()
+        plan: List[Tuple[int, int, ReadReq]] = []  # (node, original idx, req)
+        replies: List[Optional[ReadReply]] = [None] * len(reqs)
+        for i, req in enumerate(reqs):
+            chain = routing.chains.get(req.chain_id)
+            if chain is None:
+                replies[i] = ReadReply(Code.CHAIN_NOT_FOUND)
+                continue
+            targets = self._pick_targets(chain)
+            if not targets:
+                replies[i] = ReadReply(Code.TARGET_OFFLINE)
+                continue
+            target_id = req.target_id or targets[0]
+            node = routing.node_of_target(target_id)
+            if node is None:
+                replies[i] = ReadReply(Code.TARGET_NOT_FOUND)
+                continue
+            plan.append((node.node_id, i, ReadReq(
+                req.chain_id, req.chunk_id, req.offset, req.length, target_id
+            )))
+        by_node: Dict[int, List[Tuple[int, ReadReq]]] = defaultdict(list)
+        for node_id, i, req in plan:
+            by_node[node_id].append((i, req))
+        for node_id, batch in by_node.items():
+            for i, req in batch:
+                try:
+                    replies[i] = self._messenger(node_id, "read", req)
+                except FsError as e:
+                    replies[i] = ReadReply(e.code)
+        # fall back to the single-op retry ladder for failures
+        for i, r in enumerate(replies):
+            if r is None or (not r.ok and r.code != Code.CHUNK_NOT_FOUND):
+                replies[i] = self.read_chunk(
+                    reqs[i].chain_id, reqs[i].chunk_id, reqs[i].offset, reqs[i].length
+                )
+        return replies  # type: ignore[return-value]
+
+    # -- maintenance ----------------------------------------------------------
+    def remove_file_chunks(self, chain_id: int, file_id: int) -> None:
+        chain = self._chain(chain_id)
+        head = chain.head()
+        if head is None:
+            raise FsError(Status(Code.TARGET_OFFLINE, "no head"))
+        node = self._routing().node_of_target(head.target_id)
+        self._messenger(node.node_id, "remove_file_chunks", (chain_id, file_id))
+
+    def truncate_file_chunks(
+        self, chain_id: int, file_id: int, last_index: int, last_length: int
+    ) -> None:
+        chain = self._chain(chain_id)
+        head = chain.head()
+        if head is None:
+            raise FsError(Status(Code.TARGET_OFFLINE, "no head"))
+        node = self._routing().node_of_target(head.target_id)
+        self._messenger(
+            node.node_id,
+            "truncate_file_chunks",
+            (chain_id, file_id, last_index, last_length),
+        )
+
+    def query_last_chunk(self, chain_id: int, file_id: int) -> Tuple[int, int]:
+        chain = self._chain(chain_id)
+        for t in chain.targets[::-1]:  # prefer tail: committed state
+            if t.public_state != PublicTargetState.SERVING:
+                continue
+            node = self._routing().node_of_target(t.target_id)
+            if node is None:
+                continue
+            return self._messenger(node.node_id, "query_last_chunk", (chain_id, file_id))
+        return -1, 0

@@ -1,0 +1,1 @@
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig  # noqa: F401

@@ -1,0 +1,241 @@
+"""Single-process multi-node cluster for tests and benches.
+
+Clone of the reference's test::UnitTestFabric (tests/lib/UnitTestFabric.h:169):
+boots a real Mgmtd, N real StorageService nodes, the MetaStore and real
+clients in one process, parameterized like SystemSetupConfig
+(UnitTestFabric.h:86-135 — chunk size, num_chains/num_replicas/
+num_storage_nodes). Node "RPC" is direct dispatch through a messenger that
+honors kill/restart, so fail-stop and recovery paths run exactly as they
+would over sockets (the RPC layer drops in the same messenger signature).
+
+A controllable clock drives heartbeat timeouts deterministically.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from tpu3fs.client.file_io import FileIoClient
+from tpu3fs.client.storage_client import StorageClient
+from tpu3fs.kv import MemKVEngine
+from tpu3fs.meta.store import ChainAllocator, MetaStore
+from tpu3fs.mgmtd.service import Mgmtd, MgmtdConfig
+from tpu3fs.mgmtd.types import LocalTargetState, NodeType, PublicTargetState
+from tpu3fs.storage.craq import StorageService
+from tpu3fs.storage.resync import ResyncWorker
+from tpu3fs.storage.target import StorageTarget
+from tpu3fs.utils.result import Code, FsError, Status
+
+
+class FabricClock:
+    def __init__(self, t: float = 10_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@dataclass
+class SystemSetupConfig:
+    num_storage_nodes: int = 3
+    num_chains: int = 2
+    num_replicas: int = 2
+    chunk_size: int = 1 << 16
+    engine: str = "mem"
+    heartbeat_timeout_s: float = 60.0
+
+
+class _Node:
+    def __init__(self, node_id: int, service: StorageService):
+        self.node_id = node_id
+        self.service = service
+        self.alive = True
+        self.hb_version = 0
+
+
+class Fabric:
+    MGMTD_NODE_ID = 1
+    FIRST_STORAGE_NODE_ID = 10
+    FIRST_TARGET_ID = 1000
+    FIRST_CHAIN_ID = 900_000
+
+    def __init__(self, cfg: Optional[SystemSetupConfig] = None):
+        self.cfg = cfg or SystemSetupConfig()
+        self.clock = FabricClock()
+        self.kv = MemKVEngine()
+        self.mgmtd = Mgmtd(
+            self.MGMTD_NODE_ID,
+            self.kv,
+            MgmtdConfig(heartbeat_timeout_s=self.cfg.heartbeat_timeout_s),
+            clock=self.clock,
+        )
+        self.mgmtd.extend_lease()
+        self.nodes: Dict[int, _Node] = {}
+        self.chain_ids: List[int] = []
+        self._boot_topology()
+        self.meta = MetaStore(
+            self.kv,
+            ChainAllocator(1, self.chain_ids),
+            file_length_hook=self._file_length,
+            truncate_hook=self._truncate_chunks,
+            default_chunk_size=self.cfg.chunk_size,
+        )
+        self._client_seq = itertools.count(1)
+
+    # -- topology -----------------------------------------------------------
+    def _boot_topology(self) -> None:
+        cfg = self.cfg
+        for i in range(cfg.num_storage_nodes):
+            node_id = self.FIRST_STORAGE_NODE_ID + i
+            service = StorageService(
+                node_id, self.routing, self.send
+            )
+            self.nodes[node_id] = _Node(node_id, service)
+            self.mgmtd.register_node(node_id, NodeType.STORAGE)
+        # chains: targets assigned round-robin over nodes (a chain's replicas
+        # land on distinct nodes)
+        tid = self.FIRST_TARGET_ID
+        node_ids = sorted(self.nodes)
+        node_cursor = 0
+        for c in range(cfg.num_chains):
+            chain_id = self.FIRST_CHAIN_ID + c + 1
+            target_ids = []
+            for _ in range(cfg.num_replicas):
+                node_id = node_ids[node_cursor % len(node_ids)]
+                node_cursor += 1
+                self.mgmtd.create_target(tid, node_id=node_id)
+                target = StorageTarget(
+                    tid, chain_id, engine=cfg.engine, chunk_size=cfg.chunk_size
+                )
+                self.nodes[node_id].service.add_target(target)
+                target_ids.append(tid)
+                tid += 1
+            self.mgmtd.upload_chain(chain_id, target_ids)
+            self.chain_ids.append(chain_id)
+        self.mgmtd.upload_chain_table(1, self.chain_ids)
+        self.heartbeat_all()
+
+    # -- plumbing -----------------------------------------------------------
+    def routing(self):
+        return self.mgmtd.get_routing_info()
+
+    def send(self, node_id: int, method: str, payload):
+        """Direct-dispatch messenger with fail-stop semantics."""
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            raise FsError(Status(Code.RPC_CONNECT_FAILED, f"node {node_id} down"))
+        svc = node.service
+        if method == "write":
+            return svc.write(payload)
+        if method == "update":
+            return svc.update(payload)
+        if method == "read":
+            return svc.read(payload)
+        if method == "dump_chunkmeta":
+            return svc.dump_chunkmeta(payload)
+        if method == "sync_done":
+            return svc.sync_done(payload)
+        if method == "remove_chunk":
+            return svc.remove_chunk(*payload)
+        if method == "remove_file_chunks":
+            return svc.remove_file_chunks(*payload)
+        if method == "query_last_chunk":
+            return svc.query_last_chunk(*payload)
+        if method == "truncate_file_chunks":
+            return svc.truncate_file_chunks(*payload)
+        raise FsError(Status(Code.RPC_METHOD_NOT_FOUND, method))
+
+    # -- clients ------------------------------------------------------------
+    def storage_client(self, **kw) -> StorageClient:
+        return StorageClient(
+            f"client-{next(self._client_seq)}", self.routing, self.send, **kw
+        )
+
+    def file_client(self, **kw) -> FileIoClient:
+        return FileIoClient(self.storage_client(**kw))
+
+    def _file_length(self, inode) -> int:
+        return self.file_client().file_length(inode)
+
+    def _truncate_chunks(self, inode, length: int) -> None:
+        self.file_client().truncate_chunks(inode, length)
+
+    # -- cluster life -------------------------------------------------------
+    def heartbeat_all(self) -> None:
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            node.hb_version += 1
+            states = {
+                t.target_id: t.local_state for t in node.service.targets()
+            }
+            self.mgmtd.heartbeat(node.node_id, node.hb_version, states)
+
+    def tick(self, *, heartbeat: bool = True) -> None:
+        if heartbeat:
+            self.heartbeat_all()
+        self.mgmtd.tick()
+
+    def kill_node(self, node_id: int) -> None:
+        node = self.nodes[node_id]
+        node.alive = False
+        node.service.stopped = True
+
+    def fail_node(self, node_id: int) -> None:
+        """Kill + advance time past the heartbeat timeout + chain update."""
+        self.kill_node(node_id)
+        self.clock.advance(self.cfg.heartbeat_timeout_s + 1)
+        self.heartbeat_all()
+        self.mgmtd.tick()
+
+    def restart_node(self, node_id: int) -> None:
+        """Bring a node back following the recovery protocol: its targets
+        report ONLINE (not up-to-date) and go through WAITING->SYNCING
+        (design_notes "Data recovery" step 1)."""
+        node = self.nodes[node_id]
+        node.alive = True
+        node.service.stopped = False
+        for target in node.service.targets():
+            public = self.routing().targets.get(target.target_id)
+            if public is not None and public.public_state in (
+                PublicTargetState.OFFLINE,
+                PublicTargetState.WAITING,
+                PublicTargetState.LASTSRV,
+            ):
+                target.local_state = LocalTargetState.ONLINE
+            # else keep UPTODATE (e.g. clean restart before mgmtd noticed)
+        self.heartbeat_all()
+        self.mgmtd.tick()
+
+    def resync_all(self, rounds: int = 4) -> int:
+        """Run resync workers on all live nodes until chains converge."""
+        moved = 0
+        for _ in range(rounds):
+            for node in self.nodes.values():
+                if node.alive:
+                    moved += ResyncWorker(node.service, self.send).run_once()
+            self.tick()
+            if all(
+                t.public_state == PublicTargetState.SERVING
+                for chain in self.routing().chains.values()
+                for t in chain.targets
+            ):
+                break
+        return moved
+
+    # -- GC (driving MetaStore's queue against storage; ref GcManager) -------
+    def run_gc(self) -> int:
+        removed = 0
+        fio = self.file_client()
+        for inode in self.meta.gc_scan():
+            if self.meta.has_sessions(inode.id):
+                continue  # still write-open somewhere
+            fio.remove_chunks(inode)
+            self.meta.gc_finish(inode.id)
+            removed += 1
+        return removed

@@ -1,0 +1,2 @@
+from tpu3fs.kv.kv import IKVEngine, ITransaction, KeyPrefix, with_transaction  # noqa: F401
+from tpu3fs.kv.mem import MemKVEngine  # noqa: F401

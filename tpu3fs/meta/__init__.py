@@ -1,0 +1,9 @@
+from tpu3fs.meta.types import (  # noqa: F401
+    Acl,
+    DirEntry,
+    Inode,
+    InodeType,
+    Layout,
+    ROOT_INODE_ID,
+)
+from tpu3fs.meta.store import MetaStore, OpenFlags  # noqa: F401

@@ -1,0 +1,15 @@
+from tpu3fs.mgmtd.types import (  # noqa: F401
+    ChainInfo,
+    ChainTable,
+    ChainTarget,
+    LeaseInfo,
+    LocalTargetState,
+    NodeInfo,
+    NodeStatus,
+    NodeType,
+    PublicTargetState,
+    RoutingInfo,
+    TargetInfo,
+)
+from tpu3fs.mgmtd.chain_sm import generate_new_chain  # noqa: F401
+from tpu3fs.mgmtd.service import Mgmtd, MgmtdConfig  # noqa: F401

@@ -1,0 +1,427 @@
+"""Cluster manager: lease-based primary election, heartbeats, chain updates,
+versioned routing distribution, config distribution.
+
+Re-expresses src/mgmtd: MgmtdState guarded state persisted through the KV
+store (MgmtdStore.cc — "SING"/"CHIT"/"CHIF"/"TGIF"/"NODE" prefixes), lease
+election by compare-and-set inside a transaction (MgmtdStore::extendLease,
+store/MgmtdStore.h:19-46), versioned heartbeats with staleness rejection
+(ops/HeartbeatOperation.cc:36-134), the background chain updater applying the
+state machine (background/MgmtdChainsUpdater), and per-node-type config blobs
+pushed via heartbeat responses (CoreServiceDef.h getConfig/hotUpdateConfig).
+
+Only the primary mutates cluster state; every mutation re-validates the lease
+inside the same KV transaction that writes, so a deposed primary's writes
+fail atomically.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, List, Optional
+
+from tpu3fs.kv.kv import IKVEngine, ITransaction, KeyPrefix, with_transaction
+from tpu3fs.mgmtd.chain_sm import step_chain
+from tpu3fs.mgmtd.types import (
+    ChainInfo,
+    ChainTable,
+    ChainTarget,
+    LeaseInfo,
+    LocalTargetState,
+    NodeInfo,
+    NodeStatus,
+    NodeType,
+    PublicTargetState,
+    RoutingInfo,
+    TargetInfo,
+)
+from tpu3fs.rpc.serde import deserialize, serialize
+from tpu3fs.utils.result import Code, FsError, Status
+
+_LEASE_KEY = KeyPrefix.LEASE.value + b"primary"
+_ROUTING_VER_KEY = b"RTVR"
+
+
+def _node_key(node_id: int) -> bytes:
+    return KeyPrefix.NODE.value + struct.pack(">Q", node_id)
+
+
+def _chain_key(chain_id: int) -> bytes:
+    return KeyPrefix.CHAIN_INFO.value + struct.pack(">Q", chain_id)
+
+
+def _table_key(table_id: int) -> bytes:
+    return KeyPrefix.CHAIN_TABLE.value + struct.pack(">Q", table_id)
+
+
+def _target_key(target_id: int) -> bytes:
+    return KeyPrefix.TARGET_INFO.value + struct.pack(">Q", target_id)
+
+
+def _config_key(node_type: NodeType) -> bytes:
+    return KeyPrefix.CONFIG.value + struct.pack(">B", int(node_type))
+
+
+@dataclass
+class MgmtdConfig:
+    lease_length_s: float = 60.0
+    # T: silence after which a node is declared failed; services must
+    # self-exit at T/2 without mgmtd contact (design_notes "Failure detection")
+    heartbeat_timeout_s: float = 60.0
+    new_chain_version_grace_s: float = 0.0
+
+
+@dataclass
+class ConfigBlob:
+    content: str = ""
+    version: int = 0
+
+
+@dataclass
+class HeartbeatReply:
+    routing_version: int
+    config_version: int
+    config_content: str = ""
+    lease: Optional[LeaseInfo] = None
+
+
+class Mgmtd:
+    """One cluster-manager instance. Several may run; the lease picks one."""
+
+    def __init__(
+        self,
+        node_id: int,
+        engine: IKVEngine,
+        config: Optional[MgmtdConfig] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.node_id = node_id
+        self._engine = engine
+        self.config = config or MgmtdConfig()
+        self._clock = clock
+        # in-memory routing snapshot, rebuilt from KV (primary only serves it)
+        self._routing = RoutingInfo()
+        self._configs: Dict[NodeType, ConfigBlob] = {}
+        self._load()
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> None:
+        def op(txn: ITransaction):
+            routing = RoutingInfo()
+            ver = txn.get(_ROUTING_VER_KEY)
+            routing.version = int(ver) if ver else 0
+            for pair in txn.get_range(
+                KeyPrefix.NODE.value, KeyPrefix.NODE.value + b"\xff" * 9,
+                snapshot=True,
+            ):
+                info = deserialize(pair.value, NodeInfo)
+                routing.nodes[info.node_id] = info
+            for pair in txn.get_range(
+                KeyPrefix.CHAIN_INFO.value, KeyPrefix.CHAIN_INFO.value + b"\xff" * 9,
+                snapshot=True,
+            ):
+                info = deserialize(pair.value, ChainInfo)
+                routing.chains[info.chain_id] = info
+            for pair in txn.get_range(
+                KeyPrefix.CHAIN_TABLE.value, KeyPrefix.CHAIN_TABLE.value + b"\xff" * 9,
+                snapshot=True,
+            ):
+                tbl = deserialize(pair.value, ChainTable)
+                routing.chain_tables[tbl.table_id] = tbl
+            for pair in txn.get_range(
+                KeyPrefix.TARGET_INFO.value, KeyPrefix.TARGET_INFO.value + b"\xff" * 9,
+                snapshot=True,
+            ):
+                info = deserialize(pair.value, TargetInfo)
+                routing.targets[info.target_id] = info
+            configs = {}
+            for pair in txn.get_range(
+                KeyPrefix.CONFIG.value, KeyPrefix.CONFIG.value + b"\xff" * 2,
+                snapshot=True,
+            ):
+                nt = NodeType(pair.key[len(KeyPrefix.CONFIG.value)])
+                configs[nt] = deserialize(pair.value, ConfigBlob)
+            return routing, configs
+
+        self._routing, self._configs = with_transaction(
+            self._engine, op, read_only=True
+        )
+
+    def _bump_routing_in_txn(self, txn: ITransaction) -> int:
+        """Bump the persisted routing version; the caller installs the
+        returned value into the in-memory snapshot only AFTER the transaction
+        commits (so deposed-primary/conflict aborts leave memory untouched)."""
+        ver = txn.get(_ROUTING_VER_KEY)
+        new = (int(ver) if ver else 0) + 1
+        txn.set(_ROUTING_VER_KEY, str(new).encode())
+        return new
+
+    # -- lease election (ref MgmtdStore::extendLease) ------------------------
+    def extend_lease(self, now: Optional[float] = None) -> LeaseInfo:
+        """CAS on the lease record: acquire if free/expired, extend if held."""
+        now = self._clock() if now is None else now
+
+        def op(txn: ITransaction) -> LeaseInfo:
+            raw = txn.get(_LEASE_KEY)
+            lease = deserialize(raw, LeaseInfo) if raw else LeaseInfo()
+            if lease.primary_node_id == self.node_id:
+                lease.lease_end = now + self.config.lease_length_s
+            elif lease.primary_node_id == 0 or now > lease.lease_end:
+                lease = LeaseInfo(
+                    primary_node_id=self.node_id,
+                    lease_start=now,
+                    lease_end=now + self.config.lease_length_s,
+                    release_version=lease.release_version + 1,
+                )
+            txn.set(_LEASE_KEY, serialize(lease))
+            return lease
+
+        return with_transaction(self._engine, op)
+
+    def current_lease(self) -> LeaseInfo:
+        def op(txn: ITransaction) -> LeaseInfo:
+            raw = txn.get(_LEASE_KEY)
+            return deserialize(raw, LeaseInfo) if raw else LeaseInfo()
+
+        return with_transaction(self._engine, op, read_only=True)
+
+    def is_primary(self, now: Optional[float] = None) -> bool:
+        now = self._clock() if now is None else now
+        lease = self.current_lease()
+        return lease.primary_node_id == self.node_id and now <= lease.lease_end
+
+    def _ensure_primary_in_txn(self, txn: ITransaction, now: float) -> None:
+        """Re-validate the lease inside the mutating transaction, so writes of
+        a deposed primary conflict-abort instead of landing."""
+        raw = txn.get(_LEASE_KEY)
+        lease = deserialize(raw, LeaseInfo) if raw else LeaseInfo()
+        if lease.primary_node_id != self.node_id or now > lease.lease_end:
+            raise FsError(
+                Status(Code.MGMTD_NOT_PRIMARY, f"primary={lease.primary_node_id}")
+            )
+
+    # -- admin: bootstrap topology ------------------------------------------
+    def create_target(
+        self, target_id: int, node_id: int = 0, disk_index: int = 0
+    ) -> None:
+        info = TargetInfo(target_id, node_id=node_id, disk_index=disk_index)
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, self._clock())
+            txn.set(_target_key(target_id), serialize(info))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        self._routing.targets[target_id] = info
+        self._routing.version = ver
+
+    def upload_chain(self, chain_id: int, target_ids: List[int]) -> None:
+        """Create a chain over existing targets, all SERVING/UPTODATE."""
+        targets = [
+            ChainTarget(t, PublicTargetState.SERVING, LocalTargetState.UPTODATE)
+            for t in target_ids
+        ]
+        chain = ChainInfo(chain_id, 1, targets, list(target_ids))
+        staged_infos = []
+        for tid in target_ids:
+            info = self._routing.targets.get(tid)
+            info = replace(info) if info is not None else TargetInfo(tid)
+            info.chain_id = chain_id
+            info.public_state = PublicTargetState.SERVING
+            info.local_state = LocalTargetState.UPTODATE
+            staged_infos.append(info)
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, self._clock())
+            txn.set(_chain_key(chain_id), serialize(chain))
+            for info in staged_infos:
+                txn.set(_target_key(info.target_id), serialize(info))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        self._routing.chains[chain_id] = chain
+        for info in staged_infos:
+            self._routing.targets[info.target_id] = info
+        self._routing.version = ver
+
+    def upload_chain_table(self, table_id: int, chain_ids: List[int]) -> None:
+        old = self._routing.chain_tables.get(table_id)
+        tbl = ChainTable(table_id, (old.version + 1) if old else 1, list(chain_ids))
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, self._clock())
+            txn.set(_table_key(table_id), serialize(tbl))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        self._routing.chain_tables[table_id] = tbl
+        self._routing.version = ver
+
+    # -- registration & heartbeat -------------------------------------------
+    def register_node(
+        self, node_id: int, node_type: NodeType, host: str = "", port: int = 0
+    ) -> None:
+        def op(txn: ITransaction):
+            info = NodeInfo(
+                node_id, node_type, NodeStatus.HEARTBEAT_CONNECTING, host, port
+            )
+            existing = txn.get(_node_key(node_id))
+            if existing is not None:
+                old = deserialize(existing, NodeInfo)
+                info.heartbeat_version = old.heartbeat_version
+            txn.set(_node_key(node_id), serialize(info))
+            return info, self._bump_routing_in_txn(txn)
+
+        info, ver = with_transaction(self._engine, op)
+        self._routing.nodes[node_id] = info
+        self._routing.version = ver
+
+    def heartbeat(
+        self,
+        node_id: int,
+        hb_version: int,
+        local_states: Optional[Dict[int, LocalTargetState]] = None,
+        now: Optional[float] = None,
+    ) -> HeartbeatReply:
+        """Versioned heartbeat; stale versions rejected
+        (ref HeartbeatOperation.cc:36-134)."""
+        now = self._clock() if now is None else now
+        node = self._routing.nodes.get(node_id)
+        if node is None:
+            raise FsError(Status(Code.MGMTD_NODE_NOT_FOUND, str(node_id)))
+        if hb_version < node.heartbeat_version:
+            raise FsError(
+                Status(
+                    Code.MGMTD_STALE_HEARTBEAT,
+                    f"{hb_version} < {node.heartbeat_version}",
+                )
+            )
+
+        def op(txn: ITransaction) -> None:
+            node.heartbeat_version = hb_version
+            node.last_heartbeat = now
+            node.status = NodeStatus.HEARTBEAT_CONNECTED
+            txn.set(_node_key(node_id), serialize(node))
+
+        with_transaction(self._engine, op)
+        if local_states:
+            for target_id, ls in local_states.items():
+                info = self._routing.targets.get(target_id)
+                if info is not None:
+                    info.local_state = ls
+                    info.node_id = node_id
+                chain = self._routing.chain_of_target(target_id)
+                if chain is not None:
+                    for t in chain.targets:
+                        if t.target_id == target_id:
+                            t.local_state = ls
+        blob = self._configs.get(node.type, ConfigBlob())
+        return HeartbeatReply(
+            routing_version=self._routing.version,
+            config_version=blob.version,
+            config_content=blob.content,
+            lease=self.current_lease(),
+        )
+
+    def check_heartbeats(self, now: Optional[float] = None) -> List[int]:
+        """Declare silent nodes dead; their targets' local states go OFFLINE.
+        Returns the node ids newly declared failed."""
+        now = self._clock() if now is None else now
+        dead = []
+        for node in self._routing.nodes.values():
+            if node.status == NodeStatus.HEARTBEAT_CONNECTED and (
+                now - node.last_heartbeat > self.config.heartbeat_timeout_s
+            ):
+                node.status = NodeStatus.HEARTBEAT_FAILED
+                dead.append(node.node_id)
+        if not dead:
+            return dead
+
+        def op(txn: ITransaction) -> None:
+            for node_id in dead:
+                txn.set(_node_key(node_id), serialize(self._routing.nodes[node_id]))
+
+        with_transaction(self._engine, op)
+        dead_set = set(dead)
+        for chain in self._routing.chains.values():
+            for t in chain.targets:
+                info = self._routing.targets.get(t.target_id)
+                if info is not None and info.node_id in dead_set:
+                    t.local_state = LocalTargetState.OFFLINE
+                    info.local_state = LocalTargetState.OFFLINE
+        return dead
+
+    # -- chain updater (ref MgmtdChainsUpdater) ------------------------------
+    def update_chains(self, now: Optional[float] = None) -> int:
+        """Run the state machine over every chain; persist & bump routing
+        version if anything changed. Returns number of updated chains."""
+        now = self._clock() if now is None else now
+        # stage everything; nothing is installed in memory until the
+        # lease-validated transaction commits
+        new_chains = {}
+        changed_chains = []
+        staged_infos = {}
+        for chain in self._routing.chains.values():
+            new_chain, changed = step_chain(chain)
+            new_chains[chain.chain_id] = new_chain
+            if changed:
+                changed_chains.append(new_chain)
+            for t in new_chain.targets:
+                info = self._routing.targets.get(t.target_id)
+                if info is not None and info.public_state != t.public_state:
+                    staged = replace(info)
+                    staged.public_state = t.public_state
+                    staged_infos[t.target_id] = staged
+        if not changed_chains:
+            # local-state refreshes only: no version bump, no persistence
+            self._routing.chains.update(new_chains)
+            return 0
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, now)
+            for chain in changed_chains:
+                txn.set(_chain_key(chain.chain_id), serialize(chain))
+            for info in staged_infos.values():
+                txn.set(_target_key(info.target_id), serialize(info))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        self._routing.chains.update(new_chains)
+        self._routing.targets.update(staged_infos)
+        self._routing.version = ver
+        return len(changed_chains)
+
+    # -- routing distribution -----------------------------------------------
+    def get_routing_info(self, known_version: int = -1) -> Optional[RoutingInfo]:
+        """None when the caller is already up to date (version match)."""
+        if known_version == self._routing.version:
+            return None
+        return self._routing
+
+    # -- config distribution (ref SetConfig/GetConfig ops) -------------------
+    def set_config(self, node_type: NodeType, content: str) -> int:
+        old = self._configs.get(node_type, ConfigBlob())
+        blob = ConfigBlob(content, old.version + 1)
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, self._clock())
+            txn.set(_config_key(node_type), serialize(blob))
+            return blob.version
+
+        ver = with_transaction(self._engine, op)
+        self._configs[node_type] = blob
+        return ver
+
+    def get_config(self, node_type: NodeType) -> ConfigBlob:
+        return self._configs.get(node_type, ConfigBlob())
+
+    # -- main periodic driver ------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        """One background round: lease, failure detection, chain updates."""
+        now = self._clock() if now is None else now
+        lease = self.extend_lease(now)
+        if lease.primary_node_id != self.node_id:
+            return
+        self.check_heartbeats(now)
+        self.update_chains(now)

@@ -1,0 +1,147 @@
+"""Cluster-manager schema: nodes, targets, chains, routing info, lease.
+
+Re-expresses src/fbs/mgmtd (RoutingInfo.h:11-41, MgmtdTypes.h,
+MgmtdLeaseInfo.h:9-22): versioned routing snapshots of nodes + chain tables +
+chains + targets, public/local target states from docs/design_notes.md
+"Failure detection", and the primary-election lease record.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class NodeType(enum.IntEnum):
+    MGMTD = 1
+    META = 2
+    STORAGE = 3
+    CLIENT = 4
+    FUSE = 5
+
+
+class NodeStatus(enum.IntEnum):
+    HEARTBEAT_CONNECTING = 0
+    HEARTBEAT_CONNECTED = 1      # ref MgmtdTypes.h:30-36
+    HEARTBEAT_FAILED = 2
+    DISABLED = 3
+
+
+class PublicTargetState(enum.IntEnum):
+    """Read/write admission per design_notes table:
+    serving R+W, syncing W-only, waiting/lastsrv/offline none."""
+
+    SERVING = 1
+    SYNCING = 2
+    WAITING = 3
+    LASTSRV = 4
+    OFFLINE = 5
+
+    @property
+    def can_read(self) -> bool:
+        return self == PublicTargetState.SERVING
+
+    @property
+    def can_write(self) -> bool:
+        return self in (PublicTargetState.SERVING, PublicTargetState.SYNCING)
+
+
+class LocalTargetState(enum.IntEnum):
+    UPTODATE = 1
+    ONLINE = 2
+    OFFLINE = 3
+
+
+@dataclass
+class ChainTarget:
+    """A target's position in a chain, with both state views."""
+
+    target_id: int
+    public_state: PublicTargetState = PublicTargetState.SERVING
+    local_state: LocalTargetState = LocalTargetState.UPTODATE
+
+
+@dataclass
+class TargetInfo:
+    target_id: int
+    node_id: int = 0
+    disk_index: int = 0
+    chain_id: int = 0
+    public_state: PublicTargetState = PublicTargetState.OFFLINE
+    local_state: LocalTargetState = LocalTargetState.OFFLINE
+    used_size: int = 0
+
+
+@dataclass
+class ChainInfo:
+    chain_id: int
+    chain_version: int = 1
+    targets: List[ChainTarget] = field(default_factory=list)
+    preferred_order: List[int] = field(default_factory=list)
+
+    def serving_targets(self) -> List[ChainTarget]:
+        return [t for t in self.targets if t.public_state == PublicTargetState.SERVING]
+
+    def head(self) -> Optional[ChainTarget]:
+        serving = self.serving_targets()
+        return serving[0] if serving else None
+
+    def tail(self) -> Optional[ChainTarget]:
+        serving = self.serving_targets()
+        return serving[-1] if serving else None
+
+    def writer_chain(self) -> List[ChainTarget]:
+        """Targets that receive writes, in propagation order (serving+syncing)."""
+        return [t for t in self.targets if t.public_state.can_write]
+
+
+@dataclass
+class ChainTable:
+    table_id: int
+    version: int = 1
+    chain_ids: List[int] = field(default_factory=list)
+
+
+@dataclass
+class NodeInfo:
+    node_id: int
+    type: NodeType
+    status: NodeStatus = NodeStatus.HEARTBEAT_CONNECTING
+    host: str = ""
+    port: int = 0
+    last_heartbeat: float = 0.0
+    heartbeat_version: int = 0
+    config_version: int = 0
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class LeaseInfo:
+    """Primary election record (ref MgmtdLeaseInfo.h:9-22); mutated only via
+    KV compare-and-set inside a transaction (MgmtdStore::extendLease)."""
+
+    primary_node_id: int = 0
+    lease_start: float = 0.0
+    lease_end: float = 0.0
+    release_version: int = 0
+
+
+@dataclass
+class RoutingInfo:
+    """Versioned cluster snapshot served to all services and clients
+    (ref src/fbs/mgmtd/RoutingInfo.h:11-41)."""
+
+    version: int = 0
+    nodes: Dict[int, NodeInfo] = field(default_factory=dict)
+    chain_tables: Dict[int, ChainTable] = field(default_factory=dict)
+    chains: Dict[int, ChainInfo] = field(default_factory=dict)
+    targets: Dict[int, TargetInfo] = field(default_factory=dict)
+
+    def chain_of_target(self, target_id: int) -> Optional[ChainInfo]:
+        info = self.targets.get(target_id)
+        return self.chains.get(info.chain_id) if info else None
+
+    def node_of_target(self, target_id: int) -> Optional[NodeInfo]:
+        info = self.targets.get(target_id)
+        return self.nodes.get(info.node_id) if info else None

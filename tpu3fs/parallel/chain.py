@@ -24,10 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # JAX >= 0.5 exports shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from tpu3fs.parallel.mesh import shard_map
 
 
 def _xor_fold_crc(chunks: jnp.ndarray) -> jnp.ndarray:

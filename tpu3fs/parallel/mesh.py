@@ -19,6 +19,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+try:  # JAX >= 0.5 exports shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
 
 def make_storage_mesh(
     chain_len: int,

@@ -19,10 +19,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu3fs.ops.rs import RSCode
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from tpu3fs.parallel.mesh import shard_map
 
 
 def rebuild_lost_shard(

@@ -16,10 +16,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from tpu3fs.parallel.mesh import shard_map
 
 
 def shuffle_partitions(mesh: Mesh, data: jnp.ndarray, axis: str = "dp"):

@@ -1,0 +1,1 @@
+from tpu3fs.rpc.serde import serialize, deserialize, serde_json  # noqa: F401

@@ -1,0 +1,4 @@
+from tpu3fs.storage.types import ChunkId, ChunkMeta, UpdateType  # noqa: F401
+from tpu3fs.storage.engine import MemChunkEngine, ChunkEngine  # noqa: F401
+from tpu3fs.storage.target import StorageTarget  # noqa: F401
+from tpu3fs.storage.craq import StorageService, WriteReq, ReadReq  # noqa: F401

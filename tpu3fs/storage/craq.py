@@ -1,0 +1,523 @@
+"""The CRAQ storage operator: write/update/forward/commit, reads, dedupe.
+
+Re-expresses src/storage/service/StorageOperator.cc — the chain-replication
+brain:
+
+- client writes land on the HEAD target only (write(), ref :233-282);
+- each hop stages a pending version u = v+1 (COW), forwards down the chain,
+  cross-checks the successor's checksum (ref :464-482), then commits
+  (commit ver := update ver) once the suffix acknowledged (ref :333-514);
+- the chain version is re-checked AFTER taking the chunk lock — the
+  membership/data-path race rule (ref :377-382);
+- forwarding retries across chain-version bumps until the successor accepts
+  or the chain says there is no successor (ReliableForwarding.h:15-40);
+- a syncing successor gets a full-chunk-replace instead of the delta
+  (design_notes "Data recovery");
+- client retries are deduplicated by (client, channel, seqnum) so each update
+  applies exactly once per chain (ReliableUpdate.h:19-31);
+- reads are apportioned: any SERVING target answers from its committed
+  version; an uncommitted head version returns CHUNK_NOT_COMMIT for client
+  retry (design_notes read rules).
+
+Transport is injected (`messenger`): the single-process fabric wires direct
+calls, the RPC layer wires sockets — same operator either way.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpu3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
+from tpu3fs.storage.target import StorageTarget
+from tpu3fs.storage.types import Checksum, ChunkId, ChunkMeta
+from tpu3fs.utils.fault_injection import inject
+from tpu3fs.utils.result import Code, FsError, Status
+from tpu3fs.utils.result import err as _err
+
+
+@dataclass
+class WriteReq:
+    chain_id: int
+    chain_ver: int
+    chunk_id: ChunkId
+    offset: int
+    data: bytes
+    chunk_size: int
+    # exactly-once identity (ref UpdateChannelAllocator.h:11-34)
+    client_id: str = ""
+    channel_id: int = 0
+    seqnum: int = 0
+    # chain-internal:
+    update_ver: int = 0          # 0 = head assigns committed+1
+    full_replace: bool = False
+    from_target: int = 0         # predecessor's target id (0 = from client)
+
+
+@dataclass
+class UpdateReply:
+    code: Code
+    update_ver: int = 0
+    commit_ver: int = 0
+    checksum: Checksum = field(default_factory=Checksum)
+    message: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.code == Code.OK
+
+
+@dataclass
+class ReadReq:
+    chain_id: int
+    chunk_id: ChunkId
+    offset: int = 0
+    length: int = -1
+    target_id: int = 0           # the selected serving target
+
+
+@dataclass
+class ReadReply:
+    code: Code
+    data: bytes = b""
+    commit_ver: int = 0
+    checksum: Checksum = field(default_factory=Checksum)
+
+    @property
+    def ok(self) -> bool:
+        return self.code == Code.OK
+
+
+# messenger: (node_id, "update"|"sync_dump"|..., payload) -> reply
+Messenger = Callable[[int, str, object], object]
+
+
+class _ChannelTable:
+    """(client, channel) -> (seqnum, cached reply): exactly-once per chain."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots: Dict[Tuple[str, int], Tuple[int, UpdateReply]] = {}
+
+    def check(self, req: WriteReq) -> Optional[UpdateReply]:
+        if not req.client_id or req.channel_id == 0:
+            return None
+        with self._lock:
+            slot = self._slots.get((req.client_id, req.channel_id))
+            if slot is None:
+                return None
+            seq, reply = slot
+            if req.seqnum == seq:
+                return reply            # duplicate of the applied update
+            if req.seqnum < seq:
+                return UpdateReply(Code.CHUNK_STALE_UPDATE, message="stale seqnum")
+            return None
+
+    def store(self, req: WriteReq, reply: UpdateReply) -> None:
+        if not req.client_id or req.channel_id == 0:
+            return
+        with self._lock:
+            self._slots[(req.client_id, req.channel_id)] = (req.seqnum, reply)
+
+
+class StorageService:
+    """All targets of one storage node + the chain write/read operators."""
+
+    def __init__(
+        self,
+        node_id: int,
+        routing_provider: Callable[[], RoutingInfo],
+        messenger: Optional[Messenger] = None,
+        *,
+        max_forward_retries: int = 8,
+    ):
+        self.node_id = node_id
+        self._routing = routing_provider
+        self._messenger = messenger
+        self._targets: Dict[int, StorageTarget] = {}
+        self._locks: Dict[Tuple[int, bytes], threading.Lock] = {}
+        self._locks_guard = threading.Lock()
+        self._channels = _ChannelTable()
+        self._max_forward_retries = max_forward_retries
+        self.stopped = False
+
+    # -- wiring -------------------------------------------------------------
+    def add_target(self, target: StorageTarget) -> None:
+        self._targets[target.target_id] = target
+
+    def target(self, target_id: int) -> Optional[StorageTarget]:
+        return self._targets.get(target_id)
+
+    def targets(self) -> List[StorageTarget]:
+        return list(self._targets.values())
+
+    def set_messenger(self, messenger: Messenger) -> None:
+        self._messenger = messenger
+
+    def _chunk_lock(self, target_id: int, chunk_id: ChunkId) -> threading.Lock:
+        key = (target_id, chunk_id.to_bytes())
+        with self._locks_guard:
+            lock = self._locks.get(key)
+            if lock is None:
+                lock = threading.Lock()
+                self._locks[key] = lock
+            return lock
+
+    def _chain(self, chain_id: int) -> ChainInfo:
+        chain = self._routing().chains.get(chain_id)
+        if chain is None:
+            raise _err(Code.CHAIN_NOT_FOUND, str(chain_id))
+        return chain
+
+    def _local_writer_position(self, chain: ChainInfo) -> Optional[int]:
+        """Index of this node's target in the chain's writer list, or None."""
+        writers = chain.writer_chain()
+        for i, t in enumerate(writers):
+            if t.target_id in self._targets:
+                return i
+        return None
+
+    # -- client write (HEAD only; ref StorageOperator.cc:233-282) ------------
+    def write(self, req: WriteReq) -> UpdateReply:
+        if self.stopped:
+            return UpdateReply(Code.RPC_PEER_CLOSED, message="node stopped")
+        try:
+            chain = self._chain(req.chain_id)
+        except FsError as e:
+            return UpdateReply(e.code, message=e.status.message)
+        if req.chain_ver != chain.chain_version:
+            return UpdateReply(
+                Code.CHAIN_VERSION_MISMATCH,
+                message=f"client {req.chain_ver} != {chain.chain_version}",
+            )
+        head = chain.head()
+        if head is None:
+            return UpdateReply(Code.TARGET_OFFLINE, message="no serving head")
+        if head.target_id not in self._targets:
+            return UpdateReply(
+                Code.NOT_HEAD, message=f"head target {head.target_id} not local"
+            )
+        cached = self._channels.check(req)
+        if cached is not None:
+            return cached
+        reply = self._handle_update(self._targets[head.target_id], req)
+        if reply.ok:
+            self._channels.store(req, reply)
+        return reply
+
+    # -- chain-internal update (from predecessor; ref :284-331) --------------
+    def update(self, req: WriteReq) -> UpdateReply:
+        if self.stopped:
+            return UpdateReply(Code.RPC_PEER_CLOSED, message="node stopped")
+        try:
+            chain = self._chain(req.chain_id)
+        except FsError as e:
+            return UpdateReply(e.code, message=e.status.message)
+        mine = None
+        for t in chain.writer_chain():
+            if t.target_id in self._targets:
+                mine = t
+                break
+        if mine is None:
+            return UpdateReply(
+                Code.TARGET_NOT_FOUND, message="no local writer target in chain"
+            )
+        return self._handle_update(self._targets[mine.target_id], req)
+
+    # -- the shared brain (ref handleUpdate :333-514) -------------------------
+    def _handle_update(self, target: StorageTarget, req: WriteReq) -> UpdateReply:
+        lock = self._chunk_lock(target.target_id, req.chunk_id)
+        with lock:
+            try:
+                inject("storage.update")
+                # re-check the chain AFTER taking the chunk lock (ref :377-382)
+                chain = self._chain(req.chain_id)
+                if req.chain_ver != chain.chain_version and req.from_target == 0:
+                    return UpdateReply(
+                        Code.CHAIN_VERSION_MISMATCH,
+                        message=f"{req.chain_ver} != {chain.chain_version}",
+                    )
+                chain_ver = chain.chain_version
+                engine = target.engine
+                meta = engine.get_meta(req.chunk_id)
+                update_ver = req.update_ver
+                if update_ver == 0:
+                    update_ver = (meta.committed_ver if meta else 0) + 1
+                # stage pending version (COW)
+                try:
+                    engine.update(
+                        req.chunk_id,
+                        update_ver,
+                        chain_ver,
+                        req.data,
+                        req.offset,
+                        full_replace=req.full_replace,
+                        chunk_size=req.chunk_size or target.chunk_size,
+                    )
+                except FsError as e:
+                    if e.code == Code.CHUNK_STALE_UPDATE:
+                        # duplicate of an already-committed update: report the
+                        # committed state (idempotent success)
+                        cur = engine.get_meta(req.chunk_id)
+                        return UpdateReply(
+                            Code.OK,
+                            update_ver=update_ver,
+                            commit_ver=cur.committed_ver if cur else 0,
+                            checksum=cur.checksum if cur else Checksum(),
+                        )
+                    return UpdateReply(e.code, message=e.status.message)
+                if req.full_replace:
+                    # recovery write: installed as committed already; still
+                    # forward if a successor exists in the writer chain
+                    our_meta = engine.get_meta(req.chunk_id)
+                    fwd = self._forward(target, req, update_ver, chain)
+                    if fwd is not None and not fwd.ok:
+                        return fwd
+                    return UpdateReply(
+                        Code.OK,
+                        update_ver=update_ver,
+                        commit_ver=our_meta.committed_ver,
+                        checksum=our_meta.checksum,
+                    )
+                # checksum of the full pending content for the cross-check
+                pending = self._pending_content(target, req.chunk_id)
+                our_sum = Checksum.of(pending)
+                fwd = self._forward(
+                    target, req, update_ver, chain, pending_content=pending
+                )
+                if fwd is not None:
+                    if not fwd.ok:
+                        return fwd
+                    if fwd.checksum.value != our_sum.value:
+                        return UpdateReply(
+                            Code.CHUNK_CHECKSUM_MISMATCH,
+                            message=(
+                                f"successor {fwd.checksum.value:#x} != "
+                                f"ours {our_sum.value:#x}"
+                            ),
+                        )
+                # suffix acked (or we are tail): commit (ref doCommit :611-631)
+                meta = engine.commit(req.chunk_id, update_ver, chain_ver)
+                return UpdateReply(
+                    Code.OK,
+                    update_ver=update_ver,
+                    commit_ver=meta.committed_ver,
+                    checksum=our_sum,
+                )
+            except FsError as e:
+                return UpdateReply(e.code, message=e.status.message)
+
+    def _pending_content(self, target: StorageTarget, chunk_id: ChunkId) -> bytes:
+        # engine internals expose committed only; rebuild pending view
+        engine = target.engine
+        meta = engine.get_meta(chunk_id)
+        if meta is None:
+            return b""
+        slot = getattr(engine, "_slot", None)
+        if slot is not None:
+            s = slot(chunk_id)
+            if s is not None and s.pending is not None:
+                return s.pending
+            return s.committed if s is not None else b""
+        return engine.read(chunk_id)
+
+    # -- forwarding (ref ReliableForwarding.h:15-40) --------------------------
+    def _forward(
+        self,
+        target: StorageTarget,
+        req: WriteReq,
+        update_ver: int,
+        chain: ChainInfo,
+        pending_content: bytes = b"",
+    ) -> Optional[UpdateReply]:
+        """Forward to the successor; None when this target is the tail."""
+        for attempt in range(self._max_forward_retries):
+            writers = chain.writer_chain()
+            my_idx = next(
+                (i for i, t in enumerate(writers) if t.target_id == target.target_id),
+                None,
+            )
+            if my_idx is None or my_idx + 1 >= len(writers):
+                return None  # tail
+            succ = writers[my_idx + 1]
+            routing = self._routing()
+            node = routing.node_of_target(succ.target_id)
+            if node is None or self._messenger is None:
+                return UpdateReply(Code.NO_SUCCESSOR, message="no route to successor")
+            freq = replace(req, from_target=target.target_id, update_ver=update_ver)
+            if succ.public_state == PublicTargetState.SYNCING and not req.full_replace:
+                # syncing successor gets the whole chunk (full-chunk-replace)
+                freq = replace(
+                    freq,
+                    full_replace=True,
+                    data=pending_content,
+                    offset=0,
+                )
+            freq = replace(freq, chain_ver=chain.chain_version)
+            try:
+                reply = self._messenger(node.node_id, "update", freq)
+            except FsError as e:
+                reply = UpdateReply(e.code, message=e.status.message)
+            if isinstance(reply, UpdateReply) and reply.code in (
+                Code.CHAIN_VERSION_MISMATCH,
+                Code.TARGET_NOT_FOUND,
+                Code.RPC_PEER_CLOSED,
+                Code.RPC_CONNECT_FAILED,
+                Code.TIMEOUT,
+            ):
+                # chain may have moved under us: refresh and retry (the
+                # successor may have been offlined, making us the tail)
+                chain = self._chain(req.chain_id)
+                continue
+            return reply  # success or a hard error
+        return UpdateReply(
+            Code.CLIENT_RETRIES_EXHAUSTED, message="forwarding retries exhausted"
+        )
+
+    # -- reads (apportioned; ref batchRead :82-231) ---------------------------
+    def read(self, req: ReadReq) -> ReadReply:
+        if self.stopped:
+            return ReadReply(Code.RPC_PEER_CLOSED)
+        try:
+            inject("storage.read")
+            chain = self._chain(req.chain_id)
+            target_id = req.target_id
+            if target_id == 0:
+                local_serving = [
+                    t.target_id
+                    for t in chain.targets
+                    if t.public_state == PublicTargetState.SERVING
+                    and t.target_id in self._targets
+                ]
+                if not local_serving:
+                    return ReadReply(Code.TARGET_NOT_FOUND)
+                target_id = local_serving[0]
+            chain_target = next(
+                (t for t in chain.targets if t.target_id == target_id), None
+            )
+            if chain_target is None or target_id not in self._targets:
+                return ReadReply(Code.TARGET_NOT_FOUND)
+            if not chain_target.public_state.can_read:
+                return ReadReply(Code.TARGET_OFFLINE)
+            engine = self._targets[target_id].engine
+            data = engine.read(req.chunk_id, req.offset, req.length)
+            meta = engine.get_meta(req.chunk_id)
+            return ReadReply(
+                Code.OK,
+                data=data,
+                commit_ver=meta.committed_ver,
+                checksum=Checksum.of(data),
+            )
+        except FsError as e:
+            return ReadReply(e.code)
+
+    # -- file-level helpers (meta service hooks) ------------------------------
+    def query_last_chunk(self, chain_id: int, file_id: int) -> Tuple[int, int]:
+        """-> (max chunk index, its committed length) for a file on this node's
+        target of the chain; (-1, 0) if none (ref queryLastChunk)."""
+        chain = self._chain(chain_id)
+        for t in chain.targets:
+            if t.target_id in self._targets:
+                metas = self._targets[t.target_id].engine.query(
+                    ChunkId.file_prefix(file_id)
+                )
+                metas = [m for m in metas if m.committed_ver > 0]
+                if not metas:
+                    return -1, 0
+                last = max(metas, key=lambda m: m.chunk_id.index)
+                return last.chunk_id.index, last.length
+        return -1, 0
+
+    def remove_file_chunks(self, chain_id: int, file_id: int) -> int:
+        """Remove all chunks of a file on the local target and forward down
+        the chain (removes are idempotent; ref removeChunks)."""
+        chain = self._chain(chain_id)
+        removed = 0
+        mine = None
+        for t in chain.writer_chain():
+            if t.target_id in self._targets:
+                mine = t
+                break
+        if mine is None:
+            return 0
+        engine = self._targets[mine.target_id].engine
+        for meta in engine.query(ChunkId.file_prefix(file_id)):
+            engine.remove(meta.chunk_id)
+            removed += 1
+        # forward
+        writers = chain.writer_chain()
+        my_idx = next(
+            i for i, t in enumerate(writers) if t.target_id == mine.target_id
+        )
+        if my_idx + 1 < len(writers) and self._messenger is not None:
+            node = self._routing().node_of_target(writers[my_idx + 1].target_id)
+            if node is not None:
+                self._messenger(
+                    node.node_id, "remove_file_chunks", (chain_id, file_id)
+                )
+        return removed
+
+    def truncate_file_chunks(
+        self, chain_id: int, file_id: int, last_index: int, last_length: int
+    ) -> int:
+        """Truncate a file's chunks on the local target: remove chunks past
+        last_index, trim the boundary chunk, and forward down the chain
+        (idempotent, like removes; ref truncateChunks)."""
+        chain = self._chain(chain_id)
+        mine = None
+        for t in chain.writer_chain():
+            if t.target_id in self._targets:
+                mine = t
+                break
+        if mine is None:
+            return 0
+        engine = self._targets[mine.target_id].engine
+        touched = 0
+        for meta in engine.query(ChunkId.file_prefix(file_id)):
+            idx = meta.chunk_id.index
+            if idx > last_index:
+                with self._chunk_lock(mine.target_id, meta.chunk_id):
+                    engine.remove(meta.chunk_id)
+                touched += 1
+            elif idx == last_index and meta.length > last_length:
+                with self._chunk_lock(mine.target_id, meta.chunk_id):
+                    engine.truncate(meta.chunk_id, last_length, chain.chain_version)
+                touched += 1
+        writers = chain.writer_chain()
+        my_idx = next(
+            i for i, t in enumerate(writers) if t.target_id == mine.target_id
+        )
+        if my_idx + 1 < len(writers) and self._messenger is not None:
+            node = self._routing().node_of_target(writers[my_idx + 1].target_id)
+            if node is not None:
+                self._messenger(
+                    node.node_id,
+                    "truncate_file_chunks",
+                    (chain_id, file_id, last_index, last_length),
+                )
+        return touched
+
+    # -- sync / recovery (receiver side; ref syncStart/syncDone) --------------
+    def dump_chunkmeta(self, target_id: int) -> List[ChunkMeta]:
+        target = self._targets.get(target_id)
+        if target is None:
+            raise _err(Code.TARGET_NOT_FOUND, str(target_id))
+        return target.engine.all_metadata()
+
+    def remove_chunk(self, target_id: int, chunk_id: ChunkId) -> bool:
+        """Remove a single chunk (resync cleanup of stale successor chunks)."""
+        target = self._targets.get(target_id)
+        if target is None:
+            raise _err(Code.TARGET_NOT_FOUND, str(target_id))
+        return target.engine.remove(chunk_id)
+
+    def sync_done(self, target_id: int) -> None:
+        """All chunks transferred: target is up-to-date (reported in the next
+        heartbeat; design_notes "Data recovery" step 4)."""
+        target = self._targets.get(target_id)
+        if target is None:
+            raise _err(Code.TARGET_NOT_FOUND, str(target_id))
+        from tpu3fs.mgmtd.types import LocalTargetState
+
+        target.local_state = LocalTargetState.UPTODATE

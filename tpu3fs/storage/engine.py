@@ -1,0 +1,227 @@
+"""Chunk engines: the per-target local store with COW updates + atomic commit.
+
+Port of the *semantics* of the reference's Rust chunk engine
+(src/storage/chunk_engine/src/core/engine.rs:31-685): a chunk has a committed
+version and at most one pending version (u = v+1); updates are copy-on-write
+against the committed content; commit atomically promotes the pending version;
+a full-chunk-replace write abandons any pending state and installs new
+committed content directly (the recovery path, design_notes "Data recovery").
+
+Engines are swappable behind StorageTarget (like the reference's
+only_chunk_engine switch, src/storage/store/StorageTarget.h:85-162):
+  - MemChunkEngine: dict-backed, for tests and the single-process fabric.
+  - NativeChunkEngine (tpu3fs.storage.native_engine): C++ group-allocator
+    store via ctypes.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional
+
+from tpu3fs.storage.types import Checksum, ChunkId, ChunkMeta
+from tpu3fs.utils.result import Code
+from tpu3fs.utils.result import err as _err
+
+
+class ChunkEngine(abc.ABC):
+    """Engine interface (semantics of chunk_engine's public API)."""
+
+    @abc.abstractmethod
+    def get_meta(self, chunk_id: ChunkId) -> Optional[ChunkMeta]: ...
+
+    @abc.abstractmethod
+    def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1) -> bytes:
+        """Read committed content. Raises CHUNK_NOT_FOUND / CHUNK_NOT_COMMIT."""
+
+    @abc.abstractmethod
+    def update(
+        self,
+        chunk_id: ChunkId,
+        update_ver: int,
+        chain_ver: int,
+        data: bytes,
+        offset: int,
+        *,
+        full_replace: bool = False,
+        chunk_size: int,
+    ) -> ChunkMeta:
+        """Stage pending version `update_ver` (COW write of [offset, offset+len))."""
+
+    @abc.abstractmethod
+    def commit(self, chunk_id: ChunkId, ver: int, chain_ver: int) -> ChunkMeta:
+        """Atomically promote pending `ver` to committed."""
+
+    @abc.abstractmethod
+    def remove(self, chunk_id: ChunkId) -> bool: ...
+
+    @abc.abstractmethod
+    def truncate(self, chunk_id: ChunkId, length: int, chain_ver: int) -> ChunkMeta: ...
+
+    @abc.abstractmethod
+    def query(self, prefix: bytes) -> List[ChunkMeta]:
+        """All chunk metas whose id bytes start with prefix, ordered."""
+
+    @abc.abstractmethod
+    def all_metadata(self) -> List[ChunkMeta]: ...
+
+    @abc.abstractmethod
+    def used_size(self) -> int: ...
+
+    def close(self) -> None:  # pragma: no cover - engines may override
+        pass
+
+
+@dataclass
+class _Slot:
+    meta: ChunkMeta
+    committed: bytes = b""
+    pending: Optional[bytes] = None
+
+
+class MemChunkEngine(ChunkEngine):
+    """In-memory engine with exact version/commit semantics."""
+
+    def __init__(self):
+        self._chunks: Dict[bytes, _Slot] = {}
+        self._lock = threading.RLock()
+
+    # -- helpers -----------------------------------------------------------
+    def _slot(self, chunk_id: ChunkId) -> Optional[_Slot]:
+        return self._chunks.get(chunk_id.to_bytes())
+
+    # -- reads -------------------------------------------------------------
+    def get_meta(self, chunk_id: ChunkId) -> Optional[ChunkMeta]:
+        with self._lock:
+            slot = self._slot(chunk_id)
+            return replace(slot.meta) if slot else None
+
+    def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1) -> bytes:
+        with self._lock:
+            slot = self._slot(chunk_id)
+            if slot is None:
+                raise _err(Code.CHUNK_NOT_FOUND, str(chunk_id))
+            if slot.meta.committed_ver == 0:
+                # only a pending write exists; reader must retry after commit
+                # (ref ChunkReplica.cc:62-67 kChunkNotCommit)
+                raise _err(Code.CHUNK_NOT_COMMIT, str(chunk_id))
+            data = slot.committed
+            if length < 0:
+                return data[offset:]
+            return data[offset : offset + length]
+
+    # -- updates (COW + version algebra) -------------------------------------
+    def update(
+        self,
+        chunk_id: ChunkId,
+        update_ver: int,
+        chain_ver: int,
+        data: bytes,
+        offset: int,
+        *,
+        full_replace: bool = False,
+        chunk_size: int,
+    ) -> ChunkMeta:
+        if offset + len(data) > chunk_size:
+            raise _err(Code.INVALID_ARG, "write exceeds chunk size")
+        with self._lock:
+            key = chunk_id.to_bytes()
+            slot = self._chunks.get(key)
+            if slot is None:
+                slot = _Slot(ChunkMeta(chunk_id, chain_ver))
+                self._chunks[key] = slot
+            meta = slot.meta
+            if full_replace:
+                # recovery write: abandon pending, install as committed
+                # directly (design_notes "Data recovery" step 2)
+                slot.committed = bytes(data)
+                slot.pending = None
+                meta.committed_ver = update_ver
+                meta.pending_ver = 0
+                meta.chain_ver = chain_ver
+                meta.length = len(data)
+                meta.checksum = Checksum.of(slot.committed)
+                return replace(meta)
+            # update-code taxonomy (ref StorageOperator.cc:401-434)
+            if update_ver <= meta.committed_ver:
+                raise _err(
+                    Code.CHUNK_STALE_UPDATE,
+                    f"update {update_ver} <= committed {meta.committed_ver}",
+                )
+            if update_ver > meta.committed_ver + 1:
+                raise _err(
+                    Code.CHUNK_MISSING_UPDATE,
+                    f"update {update_ver} > committed {meta.committed_ver}+1",
+                )
+            if meta.pending_ver and meta.pending_ver != update_ver:
+                raise _err(
+                    Code.CHUNK_ADVANCE_UPDATE,
+                    f"pending {meta.pending_ver} != update {update_ver}",
+                )
+            # COW: base is committed content (re-applying the same pending
+            # update is idempotent)
+            base = bytearray(slot.committed)
+            if offset + len(data) > len(base):
+                base.extend(b"\x00" * (offset + len(data) - len(base)))
+            base[offset : offset + len(data)] = data
+            slot.pending = bytes(base)
+            meta.pending_ver = update_ver
+            meta.chain_ver = chain_ver
+            return replace(meta)
+
+    def commit(self, chunk_id: ChunkId, ver: int, chain_ver: int) -> ChunkMeta:
+        with self._lock:
+            slot = self._slot(chunk_id)
+            if slot is None:
+                raise _err(Code.CHUNK_NOT_FOUND, str(chunk_id))
+            meta = slot.meta
+            if meta.committed_ver >= ver:
+                # duplicate commit: fine (ref COMMITTED update code)
+                return replace(meta)
+            if meta.pending_ver != ver or slot.pending is None:
+                raise _err(
+                    Code.CHUNK_MISSING_UPDATE,
+                    f"no pending {ver} (pending={meta.pending_ver})",
+                )
+            slot.committed = slot.pending
+            slot.pending = None
+            meta.committed_ver = ver
+            meta.pending_ver = 0
+            meta.chain_ver = chain_ver
+            meta.length = len(slot.committed)
+            meta.checksum = Checksum.of(slot.committed)
+            return replace(meta)
+
+    # -- maintenance ---------------------------------------------------------
+    def remove(self, chunk_id: ChunkId) -> bool:
+        with self._lock:
+            return self._chunks.pop(chunk_id.to_bytes(), None) is not None
+
+    def truncate(self, chunk_id: ChunkId, length: int, chain_ver: int) -> ChunkMeta:
+        with self._lock:
+            slot = self._slot(chunk_id)
+            if slot is None:
+                raise _err(Code.CHUNK_NOT_FOUND, str(chunk_id))
+            slot.committed = slot.committed[:length].ljust(length, b"\x00")
+            meta = slot.meta
+            meta.length = length
+            meta.chain_ver = chain_ver
+            meta.committed_ver += 1
+            meta.pending_ver = 0
+            slot.pending = None
+            meta.checksum = Checksum.of(slot.committed)
+            return replace(meta)
+
+    def query(self, prefix: bytes) -> List[ChunkMeta]:
+        with self._lock:
+            keys = sorted(k for k in self._chunks if k.startswith(prefix))
+            return [replace(self._chunks[k].meta) for k in keys]
+
+    def all_metadata(self) -> List[ChunkMeta]:
+        return self.query(b"")
+
+    def used_size(self) -> int:
+        with self._lock:
+            return sum(len(s.committed) for s in self._chunks.values())

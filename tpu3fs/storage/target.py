@@ -1,0 +1,50 @@
+"""Per-target facade: engine dispatch + state mirror.
+
+Mirrors src/storage/store/StorageTarget.{h,cc}: a target belongs to one chain,
+owns one engine instance (engine choice gated by config exactly like the
+reference's only_chunk_engine switch at StorageTarget.h:85-162), and reports a
+local state through heartbeats.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu3fs.mgmtd.types import LocalTargetState
+from tpu3fs.storage.engine import ChunkEngine, MemChunkEngine
+from tpu3fs.storage.types import DEFAULT_CHUNK_SIZE, SpaceInfo
+
+
+def make_engine(kind: str = "mem", path: Optional[str] = None) -> ChunkEngine:
+    if kind == "mem":
+        return MemChunkEngine()
+    if kind == "native":
+        from tpu3fs.storage.native_engine import NativeChunkEngine
+
+        return NativeChunkEngine(path)
+    raise ValueError(f"unknown chunk engine kind: {kind}")
+
+
+class StorageTarget:
+    def __init__(
+        self,
+        target_id: int,
+        chain_id: int,
+        *,
+        engine: str = "mem",
+        path: Optional[str] = None,
+        chunk_size: int = DEFAULT_CHUNK_SIZE,
+    ):
+        self.target_id = target_id
+        self.chain_id = chain_id
+        self.engine = make_engine(engine, path)
+        self.chunk_size = chunk_size
+        self.local_state = LocalTargetState.UPTODATE
+
+    def space_info(self) -> SpaceInfo:
+        metas = self.engine.all_metadata()
+        return SpaceInfo(
+            capacity=0,
+            used=self.engine.used_size(),
+            chunk_count=len(metas),
+        )

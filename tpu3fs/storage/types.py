@@ -1,0 +1,88 @@
+"""Storage wire/engine types: chunk ids, versions, checksums, update kinds.
+
+Re-expresses src/fbs/storage/Common.h: ChunkId, the committed/pending version
+algebra (committed version v, pending u = v+1 — docs/design_notes.md "Data
+replication"), CRC32C ChecksumInfo with combine() (Common.h:66-199), and
+UpdateType (Common.h:51). Default chunk size 1 MiB (kChunkSize, Common.h:118).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+
+from tpu3fs.ops.crc32c import crc32c, crc32c_combine
+
+DEFAULT_CHUNK_SIZE = 1 << 20
+
+
+class UpdateType(enum.IntEnum):
+    WRITE = 1
+    REMOVE = 2
+    TRUNCATE = 3
+    EXTEND = 4
+
+
+@dataclass(frozen=True, order=True)
+class ChunkId:
+    """(file inode id, chunk index): prefix-scannable per file."""
+
+    file_id: int
+    index: int
+
+    def to_bytes(self) -> bytes:
+        return struct.pack(">QI", self.file_id, self.index)
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "ChunkId":
+        f, i = struct.unpack(">QI", raw)
+        return ChunkId(f, i)
+
+    @staticmethod
+    def file_prefix(file_id: int) -> bytes:
+        return struct.pack(">Q", file_id)
+
+
+@dataclass
+class Checksum:
+    """CRC32C checksum (ref ChecksumInfo, fbs/storage/Common.h:66-199)."""
+
+    value: int = 0
+    length: int = 0
+
+    @staticmethod
+    def of(data: bytes) -> "Checksum":
+        return Checksum(crc32c(data), len(data))
+
+    def combine(self, other: "Checksum") -> "Checksum":
+        return Checksum(
+            crc32c_combine(self.value, other.value, other.length),
+            self.length + other.length,
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Checksum)
+            and self.value == other.value
+            and self.length == other.length
+        )
+
+
+@dataclass
+class ChunkMeta:
+    """Per-chunk metadata as stored by the engine."""
+
+    chunk_id: ChunkId
+    chain_ver: int = 1
+    committed_ver: int = 0
+    pending_ver: int = 0          # 0 = no pending update
+    length: int = 0               # committed content length
+    checksum: Checksum = field(default_factory=Checksum)
+
+
+@dataclass
+class SpaceInfo:
+    capacity: int = 0
+    used: int = 0
+    chunk_count: int = 0

@@ -206,3 +206,8 @@ class Result(Generic[T]):
 
 def make_error(code: Code, message: str = "") -> Result:
     return Result.err(code, message)
+
+
+def err(code: Code, message: str = "") -> FsError:
+    """Shorthand constructor for raising: ``raise err(Code.X, "...")``."""
+    return FsError(Status(code, message))
