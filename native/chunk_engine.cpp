@@ -1,0 +1,668 @@
+// tpu3fs native chunk engine.
+//
+// C++ re-design of the reference's Rust chunk engine semantics
+// (src/storage/chunk_engine/src/core/engine.rs:31-685 and its README):
+//   - physical blocks drawn from power-of-two size classes (the reference
+//     uses 64KiB..64MiB x11, constants.rs:3-8; here 4KiB..64MiB to let tests
+//     run with tiny chunks), one data file per class, group-bitmap allocator
+//     (256 chunks per group, first-zero-bit scan like the Rust allocator);
+//   - copy-on-write updates: a pending version (u = v+1) lands in a freshly
+//     allocated block; commit atomically flips the metadata to point at it
+//     and frees the old block; full-chunk-replace installs committed state
+//     directly (recovery path);
+//   - crash consistency via a metadata write-ahead log replayed on open
+//     (the reference uses a RocksDB WriteBatch per commit; a WAL + snapshot
+//     is the equivalent atomicity contract without the dependency);
+//   - CRC32C maintained per committed chunk (slice-by-8; bit-exact with the
+//     framework's TPU/MXU batched CRC kernels).
+//
+// Exposed as a C ABI consumed through ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+// ---- error codes (mirrors tpu3fs.utils.result codes the wrapper maps) ----
+enum ErrCode : int {
+  OK = 0,
+  E_NOT_FOUND = -1,
+  E_NOT_COMMIT = -2,
+  E_STALE_UPDATE = -3,
+  E_MISSING_UPDATE = -4,
+  E_ADVANCE_UPDATE = -5,
+  E_IO = -6,
+  E_INVALID = -7,
+  E_NO_SPACE = -8,
+};
+
+constexpr int kMinClassShift = 12;           // 4 KiB
+constexpr int kMaxClassShift = 26;           // 64 MiB
+constexpr int kNumClasses = kMaxClassShift - kMinClassShift + 1;
+constexpr uint32_t kGroupChunks = 256;       // bitmap group size (ref allocator)
+constexpr size_t kKeyLen = 12;               // file_id(8) + chunk_index(4)
+
+struct Key {
+  uint8_t b[kKeyLen];
+  bool operator<(const Key& o) const { return memcmp(b, o.b, kKeyLen) < 0; }
+  bool operator==(const Key& o) const { return memcmp(b, o.b, kKeyLen) == 0; }
+};
+
+// ---- CRC32C (Castagnoli, reflected), slice-by-8 ---------------------------
+struct Crc32cTables {
+  uint32_t t[8][256];
+  Crc32cTables() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+      for (int s = 1; s < 8; s++)
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xFF];
+  }
+};
+const Crc32cTables kCrc;
+
+uint32_t crc32c(const uint8_t* data, size_t n, uint32_t crc = 0) {
+  uint32_t c = ~crc;
+  while (n >= 8) {
+    uint64_t w;
+    memcpy(&w, data, 8);
+    w ^= c;
+    c = kCrc.t[7][w & 0xFF] ^ kCrc.t[6][(w >> 8) & 0xFF] ^
+        kCrc.t[5][(w >> 16) & 0xFF] ^ kCrc.t[4][(w >> 24) & 0xFF] ^
+        kCrc.t[3][(w >> 32) & 0xFF] ^ kCrc.t[2][(w >> 40) & 0xFF] ^
+        kCrc.t[1][(w >> 48) & 0xFF] ^ kCrc.t[0][(w >> 56) & 0xFF];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) c = (c >> 8) ^ kCrc.t[0][(c ^ *data++) & 0xFF];
+  return ~c;
+}
+
+// ---- block reference ------------------------------------------------------
+struct BlockRef {
+  int8_t cls = -1;        // size class, -1 = none
+  uint32_t idx = 0;       // block index within the class file
+  uint32_t length = 0;    // content bytes
+  uint32_t crc = 0;
+  bool valid() const { return cls >= 0; }
+};
+
+struct ChunkMeta {
+  uint64_t committed_ver = 0;
+  uint64_t pending_ver = 0;
+  uint64_t chain_ver = 0;
+  BlockRef committed;
+  BlockRef pending;
+};
+
+// ---- WAL record -----------------------------------------------------------
+// Fixed-size state record: last-wins per key on replay; remove = tombstone.
+struct WalRecord {
+  uint32_t magic = 0x33465354;  // "3FST"
+  uint8_t op = 0;               // 1 = state, 2 = remove
+  uint8_t key[kKeyLen] = {0};
+  uint64_t committed_ver = 0, pending_ver = 0, chain_ver = 0;
+  int8_t c_cls = -1, p_cls = -1;
+  uint32_t c_idx = 0, c_len = 0, c_crc = 0;
+  uint32_t p_idx = 0, p_len = 0, p_crc = 0;
+  uint32_t rec_crc = 0;         // crc of the record up to this field
+
+  void seal() {
+    rec_crc = crc32c(reinterpret_cast<const uint8_t*>(this),
+                     offsetof(WalRecord, rec_crc));
+  }
+  bool check() const {
+    return magic == 0x33465354 &&
+           rec_crc == crc32c(reinterpret_cast<const uint8_t*>(this),
+                             offsetof(WalRecord, rec_crc));
+  }
+};
+
+// ---- per-class allocator + data file --------------------------------------
+struct SizeClass {
+  int fd = -1;
+  uint32_t block_size = 0;
+  std::vector<uint64_t> bitmap;  // 1 bit per block, grouped 256/group
+  uint32_t allocated = 0;
+
+  int32_t allocate() {
+    for (size_t w = 0; w < bitmap.size(); w++) {
+      uint64_t inv = ~bitmap[w];
+      if (inv) {
+        int bit = __builtin_ctzll(inv);
+        bitmap[w] |= (1ull << bit);
+        allocated++;
+        return static_cast<int32_t>(w * 64 + bit);
+      }
+    }
+    // grow by one group (256 chunks -> 4 words)
+    size_t base = bitmap.size() * 64;
+    bitmap.resize(bitmap.size() + kGroupChunks / 64, 0);
+    bitmap[base / 64] |= 1ull;
+    allocated++;
+    return static_cast<int32_t>(base);
+  }
+
+  void mark(uint32_t idx) {
+    size_t w = idx / 64;
+    if (w >= bitmap.size()) bitmap.resize((w / 4 + 1) * 4, 0);
+    if (!(bitmap[w] & (1ull << (idx % 64)))) {
+      bitmap[w] |= (1ull << (idx % 64));
+      allocated++;
+    }
+  }
+
+  void release(uint32_t idx) {
+    size_t w = idx / 64;
+    if (w < bitmap.size() && (bitmap[w] & (1ull << (idx % 64)))) {
+      bitmap[w] &= ~(1ull << (idx % 64));
+      allocated--;
+    }
+  }
+};
+
+int class_for(uint32_t chunk_bytes) {
+  if (chunk_bytes == 0) return 0;
+  uint32_t need = chunk_bytes;
+  int shift = kMinClassShift;
+  while ((1u << shift) < need && shift < kMaxClassShift) shift++;
+  if ((1u << shift) < need) return -1;
+  return shift - kMinClassShift;
+}
+
+// ---- engine ---------------------------------------------------------------
+struct Engine {
+  std::string dir;
+  std::map<Key, ChunkMeta> metas;
+  SizeClass classes[kNumClasses];
+  int wal_fd = -1;
+  uint64_t wal_records = 0;
+  bool fsync_wal = false;
+  // blocks freed by a state change stay quarantined (unallocatable) until
+  // the WAL record superseding them is appended (and fsynced in durable
+  // mode) — otherwise replay could resurrect a meta pointing at a reused,
+  // overwritten block
+  std::vector<std::pair<int8_t, uint32_t>> quarantine;
+  std::mutex mu;
+
+  std::string class_path(int c) const {
+    return dir + "/data_" + std::to_string(c) + ".bin";
+  }
+  std::string wal_path() const { return dir + "/wal.log"; }
+
+  int open_files() {
+    for (int c = 0; c < kNumClasses; c++) {
+      classes[c].block_size = 1u << (c + kMinClassShift);
+      classes[c].fd = ::open(class_path(c).c_str(), O_RDWR | O_CREAT, 0644);
+      if (classes[c].fd < 0) return E_IO;
+    }
+    wal_fd = ::open(wal_path().c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
+    return wal_fd < 0 ? E_IO : OK;
+  }
+
+  int replay() {
+    FILE* f = fopen(wal_path().c_str(), "rb");
+    if (!f) return OK;
+    WalRecord rec;
+    while (fread(&rec, sizeof(rec), 1, f) == 1) {
+      if (!rec.check()) break;  // torn tail: stop replay
+      wal_records++;
+      Key k;
+      memcpy(k.b, rec.key, kKeyLen);
+      if (rec.op == 2) {
+        metas.erase(k);
+        continue;
+      }
+      ChunkMeta m;
+      m.committed_ver = rec.committed_ver;
+      m.pending_ver = rec.pending_ver;
+      m.chain_ver = rec.chain_ver;
+      m.committed = {rec.c_cls, rec.c_idx, rec.c_len, rec.c_crc};
+      m.pending = {rec.p_cls, rec.p_idx, rec.p_len, rec.p_crc};
+      metas[k] = m;
+    }
+    fclose(f);
+    // rebuild allocator occupancy from live references
+    for (auto& [k, m] : metas) {
+      if (m.committed.valid()) classes[m.committed.cls].mark(m.committed.idx);
+      if (m.pending.valid()) classes[m.pending.cls].mark(m.pending.idx);
+    }
+    return OK;
+  }
+
+  int log_state(const Key& k, const ChunkMeta& m) {
+    WalRecord rec;
+    rec.op = 1;
+    memcpy(rec.key, k.b, kKeyLen);
+    rec.committed_ver = m.committed_ver;
+    rec.pending_ver = m.pending_ver;
+    rec.chain_ver = m.chain_ver;
+    rec.c_cls = m.committed.cls;
+    rec.c_idx = m.committed.idx;
+    rec.c_len = m.committed.length;
+    rec.c_crc = m.committed.crc;
+    rec.p_cls = m.pending.cls;
+    rec.p_idx = m.pending.idx;
+    rec.p_len = m.pending.length;
+    rec.p_crc = m.pending.crc;
+    rec.seal();
+    if (write(wal_fd, &rec, sizeof(rec)) != sizeof(rec)) return E_IO;
+    if (fsync_wal) fsync(wal_fd);
+    wal_records++;
+    drain_quarantine();
+    return OK;
+  }
+
+  int log_remove(const Key& k) {
+    WalRecord rec;
+    rec.op = 2;
+    memcpy(rec.key, k.b, kKeyLen);
+    rec.seal();
+    if (write(wal_fd, &rec, sizeof(rec)) != sizeof(rec)) return E_IO;
+    if (fsync_wal) fsync(wal_fd);
+    wal_records++;
+    drain_quarantine();
+    return OK;
+  }
+
+  int compact() {
+    // rewrite the WAL as one state record per live chunk
+    std::string tmp = wal_path() + ".tmp";
+    int fd = ::open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return E_IO;
+    for (auto& [k, m] : metas) {
+      WalRecord rec;
+      rec.op = 1;
+      memcpy(rec.key, k.b, kKeyLen);
+      rec.committed_ver = m.committed_ver;
+      rec.pending_ver = m.pending_ver;
+      rec.chain_ver = m.chain_ver;
+      rec.c_cls = m.committed.cls;
+      rec.c_idx = m.committed.idx;
+      rec.c_len = m.committed.length;
+      rec.c_crc = m.committed.crc;
+      rec.p_cls = m.pending.cls;
+      rec.p_idx = m.pending.idx;
+      rec.p_len = m.pending.length;
+      rec.p_crc = m.pending.crc;
+      rec.seal();
+      if (write(fd, &rec, sizeof(rec)) != sizeof(rec)) {
+        close(fd);
+        return E_IO;
+      }
+    }
+    fsync(fd);
+    close(fd);
+    if (rename(tmp.c_str(), wal_path().c_str()) != 0) return E_IO;
+    close(wal_fd);
+    wal_fd = ::open(wal_path().c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
+    wal_records = metas.size();
+    return wal_fd < 0 ? E_IO : OK;
+  }
+
+  void maybe_compact() {
+    if (wal_records > 4 * metas.size() + 4096) compact();
+  }
+
+  // -- block IO ------------------------------------------------------------
+  int write_block(const BlockRef& ref, const uint8_t* data, uint32_t len) {
+    SizeClass& sc = classes[ref.cls];
+    off_t off = static_cast<off_t>(ref.idx) * sc.block_size;
+    ssize_t n = pwrite(sc.fd, data, len, off);
+    if (n != static_cast<ssize_t>(len)) return E_IO;
+    // durable mode: block content must be on disk before the WAL record
+    // that references it
+    if (fsync_wal && fdatasync(sc.fd) != 0) return E_IO;
+    return OK;
+  }
+
+  int read_block(const BlockRef& ref, uint8_t* out, uint32_t off_in,
+                 uint32_t len) const {
+    const SizeClass& sc = classes[ref.cls];
+    off_t off = static_cast<off_t>(ref.idx) * sc.block_size + off_in;
+    ssize_t n = pread(sc.fd, out, len, off);
+    return n == static_cast<ssize_t>(len) ? OK : E_IO;
+  }
+
+  void free_block(BlockRef& ref) {
+    if (ref.valid()) {
+      quarantine.emplace_back(ref.cls, ref.idx);
+      ref = BlockRef{};
+    }
+  }
+
+  void drain_quarantine() {
+    for (auto& [cls, idx] : quarantine) classes[cls].release(idx);
+    quarantine.clear();
+  }
+
+  // -- engine ops ----------------------------------------------------------
+  int update(const Key& k, uint64_t update_ver, uint64_t chain_ver,
+             const uint8_t* data, uint32_t data_len, uint32_t offset,
+             int full_replace, uint32_t chunk_size) {
+    if (offset + data_len > chunk_size) return E_INVALID;
+    // validate against the existing meta (or an empty one) BEFORE inserting,
+    // so rejected updates leave no phantom committed_ver=0 chunk behind
+    {
+      auto it = metas.find(k);
+      uint64_t cv = it != metas.end() ? it->second.committed_ver : 0;
+      uint64_t pv = it != metas.end() ? it->second.pending_ver : 0;
+      if (!full_replace) {
+        if (update_ver <= cv) return E_STALE_UPDATE;
+        if (pv && pv != update_ver) return E_ADVANCE_UPDATE;
+        if (update_ver > cv + 1) return E_MISSING_UPDATE;
+      }
+    }
+    ChunkMeta& m = metas[k];
+    if (full_replace) {
+      int cls = class_for(std::max<uint32_t>(data_len, 1));
+      if (cls < 0) return E_INVALID;
+      BlockRef nb{static_cast<int8_t>(cls),
+                  static_cast<uint32_t>(classes[cls].allocate()), data_len,
+                  crc32c(data, data_len)};
+      int rc = write_block(nb, data, data_len);
+      if (rc != OK) return rc;
+      free_block(m.committed);
+      free_block(m.pending);
+      m.committed = nb;
+      m.committed_ver = update_ver;
+      m.pending_ver = 0;
+      m.chain_ver = chain_ver;
+      return log_state(k, m);
+    }
+    // COW: base = committed content extended to cover the write
+    uint32_t new_len = std::max(m.committed.length, offset + data_len);
+    std::vector<uint8_t> buf(new_len, 0);
+    if (m.committed.valid() && m.committed.length) {
+      int rc = read_block(m.committed, buf.data(), 0, m.committed.length);
+      if (rc != OK) return rc;
+    }
+    memcpy(buf.data() + offset, data, data_len);
+    int cls = class_for(std::max<uint32_t>(new_len, 1));
+    if (cls < 0) return E_INVALID;
+    free_block(m.pending);  // re-staging the same pending ver is idempotent
+    BlockRef nb{static_cast<int8_t>(cls),
+                static_cast<uint32_t>(classes[cls].allocate()), new_len,
+                crc32c(buf.data(), new_len)};
+    int rc = write_block(nb, buf.data(), new_len);
+    if (rc != OK) return rc;
+    m.pending = nb;
+    m.pending_ver = update_ver;
+    m.chain_ver = chain_ver;
+    return log_state(k, m);
+  }
+
+  int commit(const Key& k, uint64_t ver, uint64_t chain_ver) {
+    auto it = metas.find(k);
+    if (it == metas.end()) return E_NOT_FOUND;
+    ChunkMeta& m = it->second;
+    if (m.committed_ver >= ver) return OK;  // duplicate commit
+    if (m.pending_ver != ver || !m.pending.valid()) return E_MISSING_UPDATE;
+    free_block(m.committed);
+    m.committed = m.pending;
+    m.pending = BlockRef{};
+    m.committed_ver = ver;
+    m.pending_ver = 0;
+    m.chain_ver = chain_ver;
+    int rc = log_state(k, m);
+    maybe_compact();
+    return rc;
+  }
+
+  int read(const Key& k, uint8_t* out, uint64_t cap, uint32_t offset,
+           int64_t length, int64_t* out_len) const {
+    auto it = metas.find(k);
+    if (it == metas.end()) return E_NOT_FOUND;
+    const ChunkMeta& m = it->second;
+    if (m.committed_ver == 0) return E_NOT_COMMIT;
+    if (offset >= m.committed.length) {
+      *out_len = 0;
+      return OK;
+    }
+    uint32_t avail = m.committed.length - offset;
+    uint32_t n = length < 0 ? avail
+                            : std::min<uint32_t>(static_cast<uint32_t>(length),
+                                                 avail);
+    // clamp to the caller's buffer: the meta the caller sized from may be
+    // stale by the time we hold the mutex (concurrent commit can grow the
+    // chunk); never write past the Python-owned buffer
+    n = std::min<uint64_t>(n, cap);
+    int rc = read_block(m.committed, out, offset, n);
+    if (rc != OK) return rc;
+    *out_len = n;
+    return OK;
+  }
+
+  int read_pending(const Key& k, uint8_t* out, uint64_t cap,
+                   int64_t* out_len) const {
+    // full content of the staged pending version (committed if none):
+    // feeds the chain checksum cross-check
+    auto it = metas.find(k);
+    if (it == metas.end()) return E_NOT_FOUND;
+    const ChunkMeta& m = it->second;
+    const BlockRef& ref = m.pending.valid() ? m.pending : m.committed;
+    if (!ref.valid()) {
+      *out_len = 0;
+      return OK;
+    }
+    uint32_t n = std::min<uint64_t>(ref.length, cap);
+    int rc = read_block(ref, out, 0, n);
+    if (rc != OK) return rc;
+    *out_len = n;
+    return OK;
+  }
+
+  int remove(const Key& k) {
+    auto it = metas.find(k);
+    if (it == metas.end()) return E_NOT_FOUND;
+    free_block(it->second.committed);
+    free_block(it->second.pending);
+    metas.erase(it);
+    return log_remove(k);
+  }
+
+  int truncate(const Key& k, uint32_t new_len, uint64_t chain_ver) {
+    auto it = metas.find(k);
+    if (it == metas.end()) return E_NOT_FOUND;
+    ChunkMeta& m = it->second;
+    std::vector<uint8_t> buf(new_len, 0);
+    if (m.committed.valid() && m.committed.length) {
+      uint32_t copy = std::min(new_len, m.committed.length);
+      if (copy) {
+        int rc = read_block(m.committed, buf.data(), 0, copy);
+        if (rc != OK) return rc;
+      }
+    }
+    int cls = class_for(std::max<uint32_t>(new_len, 1));
+    if (cls < 0) return E_INVALID;
+    BlockRef nb{static_cast<int8_t>(cls),
+                static_cast<uint32_t>(classes[cls].allocate()), new_len,
+                crc32c(buf.data(), new_len)};
+    int rc = write_block(nb, buf.data(), new_len);
+    if (rc != OK) return rc;
+    free_block(m.committed);
+    free_block(m.pending);
+    m.committed = nb;
+    m.committed_ver += 1;
+    m.pending_ver = 0;
+    m.chain_ver = chain_ver;
+    return log_state(k, m);
+  }
+
+  uint64_t used_size() const {
+    uint64_t total = 0;
+    for (auto& [k, m] : metas) total += m.committed.length;
+    return total;
+  }
+};
+
+}  // namespace
+
+// ---- C ABI ---------------------------------------------------------------
+
+extern "C" {
+
+// meta output layout for queries (packed, mirrors python struct fmt "<QQQIIq")
+struct CMeta {
+  uint64_t committed_ver;
+  uint64_t pending_ver;
+  uint64_t chain_ver;
+  uint32_t length;
+  uint32_t crc;
+  uint32_t pending_length;
+  uint8_t key[kKeyLen];
+};
+
+void* ce_open(const char* dir, int fsync_wal) {
+  auto* e = new Engine();
+  e->dir = dir;
+  e->fsync_wal = fsync_wal != 0;
+  ::mkdir(dir, 0755);
+  if (e->open_files() != OK || e->replay() != OK) {
+    delete e;
+    return nullptr;
+  }
+  return e;
+}
+
+void ce_close(void* h) {
+  auto* e = static_cast<Engine*>(h);
+  if (!e) return;
+  e->compact();
+  for (int c = 0; c < kNumClasses; c++)
+    if (e->classes[c].fd >= 0) close(e->classes[c].fd);
+  if (e->wal_fd >= 0) close(e->wal_fd);
+  delete e;
+}
+
+int ce_update(void* h, const uint8_t* key, uint64_t update_ver,
+              uint64_t chain_ver, const uint8_t* data, uint32_t data_len,
+              uint32_t offset, int full_replace, uint32_t chunk_size) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  Key k;
+  memcpy(k.b, key, kKeyLen);
+  return e->update(k, update_ver, chain_ver, data, data_len, offset,
+                   full_replace, chunk_size);
+}
+
+int ce_commit(void* h, const uint8_t* key, uint64_t ver, uint64_t chain_ver) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  Key k;
+  memcpy(k.b, key, kKeyLen);
+  return e->commit(k, ver, chain_ver);
+}
+
+int ce_read(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
+            uint32_t offset, int64_t length, int64_t* out_len) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  Key k;
+  memcpy(k.b, key, kKeyLen);
+  return e->read(k, out, cap, offset, length, out_len);
+}
+
+int ce_read_pending(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
+                    int64_t* out_len) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  Key k;
+  memcpy(k.b, key, kKeyLen);
+  return e->read_pending(k, out, cap, out_len);
+}
+
+int ce_get_meta(void* h, const uint8_t* key, CMeta* out) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  Key k;
+  memcpy(k.b, key, kKeyLen);
+  auto it = e->metas.find(k);
+  if (it == e->metas.end()) return E_NOT_FOUND;
+  const ChunkMeta& m = it->second;
+  out->committed_ver = m.committed_ver;
+  out->pending_ver = m.pending_ver;
+  out->chain_ver = m.chain_ver;
+  out->length = m.committed.length;
+  out->crc = m.committed.crc;
+  out->pending_length = m.pending.valid() ? m.pending.length : 0;
+  memcpy(out->key, k.b, kKeyLen);
+  return OK;
+}
+
+int ce_remove(void* h, const uint8_t* key) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  Key k;
+  memcpy(k.b, key, kKeyLen);
+  return e->remove(k);
+}
+
+int ce_truncate(void* h, const uint8_t* key, uint32_t new_len,
+                uint64_t chain_ver) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  Key k;
+  memcpy(k.b, key, kKeyLen);
+  return e->truncate(k, new_len, chain_ver);
+}
+
+// query: fill up to max_out metas whose key starts with prefix (ordered);
+// returns count (>=0) or error (<0)
+int ce_query(void* h, const uint8_t* prefix, uint32_t prefix_len, CMeta* out,
+             int max_out) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  if (prefix_len > kKeyLen) return E_INVALID;
+  int n = 0;
+  for (auto& [k, m] : e->metas) {
+    if (prefix_len && memcmp(k.b, prefix, prefix_len) != 0) continue;
+    if (n >= max_out) break;
+    CMeta& o = out[n++];
+    o.committed_ver = m.committed_ver;
+    o.pending_ver = m.pending_ver;
+    o.chain_ver = m.chain_ver;
+    o.length = m.committed.length;
+    o.crc = m.committed.crc;
+    o.pending_length = m.pending.valid() ? m.pending.length : 0;
+    memcpy(o.key, k.b, kKeyLen);
+  }
+  return n;
+}
+
+int64_t ce_used_size(void* h) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return static_cast<int64_t>(e->used_size());
+}
+
+int64_t ce_chunk_count(void* h) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return static_cast<int64_t>(e->metas.size());
+}
+
+int ce_compact(void* h) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return e->compact();
+}
+
+uint32_t ce_crc32c(const uint8_t* data, uint64_t n) { return crc32c(data, n); }
+
+}  // extern "C"

@@ -1,0 +1,240 @@
+"""Chunk engine contract tests, run against BOTH engines (mem + native C++),
+mirroring the reference's trick of running one suite over multiple stores.
+Plus native-only durability tests (WAL replay after close/reopen)."""
+
+import numpy as np
+import pytest
+
+from tpu3fs.storage.engine import MemChunkEngine
+from tpu3fs.storage.native_engine import NativeChunkEngine, _load_lib
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils.result import Code, FsError
+from tpu3fs.ops.crc32c import crc32c
+
+CS = 1 << 16  # chunk size for tests
+
+
+@pytest.fixture(params=["mem", "native"])
+def engine(request, tmp_path):
+    if request.param == "mem":
+        eng = MemChunkEngine()
+    else:
+        eng = NativeChunkEngine(str(tmp_path / "engine"))
+    yield eng
+    eng.close()
+
+
+def cid(i, j=0):
+    return ChunkId(i, j)
+
+
+class TestEngineContract:
+    def test_update_commit_read(self, engine):
+        engine.update(cid(1), 1, 1, b"hello", 0, chunk_size=CS)
+        with pytest.raises(FsError) as ei:
+            engine.read(cid(1))
+        assert ei.value.code == Code.CHUNK_NOT_COMMIT  # pending only
+        meta = engine.commit(cid(1), 1, 1)
+        assert meta.committed_ver == 1 and meta.length == 5
+        assert engine.read(cid(1)) == b"hello"
+        assert meta.checksum.value == crc32c(b"hello")
+
+    def test_partial_cow_update(self, engine):
+        engine.update(cid(1), 1, 1, b"A" * 100, 0, chunk_size=CS)
+        engine.commit(cid(1), 1, 1)
+        engine.update(cid(1), 2, 1, b"B" * 50, 25, chunk_size=CS)
+        # committed content unchanged until commit
+        assert engine.read(cid(1)) == b"A" * 100
+        engine.commit(cid(1), 2, 1)
+        assert engine.read(cid(1)) == b"A" * 25 + b"B" * 50 + b"A" * 25
+
+    def test_version_taxonomy(self, engine):
+        engine.update(cid(1), 1, 1, b"x", 0, chunk_size=CS)
+        engine.commit(cid(1), 1, 1)
+        with pytest.raises(FsError) as ei:
+            engine.update(cid(1), 1, 1, b"y", 0, chunk_size=CS)
+        assert ei.value.code == Code.CHUNK_STALE_UPDATE
+        with pytest.raises(FsError) as ei:
+            engine.update(cid(1), 3, 1, b"y", 0, chunk_size=CS)
+        assert ei.value.code == Code.CHUNK_MISSING_UPDATE
+        engine.update(cid(1), 2, 1, b"y", 0, chunk_size=CS)
+        with pytest.raises(FsError) as ei:
+            engine.update(cid(1), 3, 1, b"z", 0, chunk_size=CS)
+        assert ei.value.code == Code.CHUNK_ADVANCE_UPDATE
+
+    def test_restage_same_pending_idempotent(self, engine):
+        engine.update(cid(1), 1, 1, b"first", 0, chunk_size=CS)
+        engine.update(cid(1), 1, 1, b"retry", 0, chunk_size=CS)  # same ver
+        engine.commit(cid(1), 1, 1)
+        assert engine.read(cid(1)) == b"retry"
+
+    def test_duplicate_commit_ok(self, engine):
+        engine.update(cid(1), 1, 1, b"x", 0, chunk_size=CS)
+        engine.commit(cid(1), 1, 1)
+        meta = engine.commit(cid(1), 1, 1)  # duplicate
+        assert meta.committed_ver == 1
+
+    def test_full_replace_abandons_pending(self, engine):
+        engine.update(cid(1), 1, 1, b"old", 0, chunk_size=CS)
+        engine.commit(cid(1), 1, 1)
+        engine.update(cid(1), 2, 1, b"pending", 0, chunk_size=CS)
+        engine.update(cid(1), 5, 2, b"replaced", 0, full_replace=True,
+                      chunk_size=CS)
+        meta = engine.get_meta(cid(1))
+        assert meta.committed_ver == 5 and meta.pending_ver == 0
+        assert engine.read(cid(1)) == b"replaced"
+
+    def test_remove_and_query_prefix(self, engine):
+        for i in range(3):
+            engine.update(cid(7, i), 1, 1, b"d", 0, chunk_size=CS)
+            engine.commit(cid(7, i), 1, 1)
+        engine.update(cid(8, 0), 1, 1, b"d", 0, chunk_size=CS)
+        engine.commit(cid(8, 0), 1, 1)
+        metas = engine.query(ChunkId.file_prefix(7))
+        assert [m.chunk_id.index for m in metas] == [0, 1, 2]
+        assert engine.remove(cid(7, 1))
+        assert not engine.remove(cid(7, 1))  # already gone
+        assert [m.chunk_id.index for m in engine.query(ChunkId.file_prefix(7))] == [0, 2]
+
+    def test_truncate(self, engine):
+        engine.update(cid(1), 1, 1, b"0123456789", 0, chunk_size=CS)
+        engine.commit(cid(1), 1, 1)
+        meta = engine.truncate(cid(1), 4, 2)
+        assert meta.length == 4
+        assert engine.read(cid(1)) == b"0123"
+        # extend-truncate zero-fills
+        engine.truncate(cid(1), 8, 2)
+        assert engine.read(cid(1)) == b"0123\x00\x00\x00\x00"
+
+    def test_read_offsets(self, engine):
+        engine.update(cid(1), 1, 1, b"abcdefgh", 0, chunk_size=CS)
+        engine.commit(cid(1), 1, 1)
+        assert engine.read(cid(1), 2, 3) == b"cde"
+        assert engine.read(cid(1), 6) == b"gh"
+        assert engine.read(cid(1), 100, 5) == b""  # past end
+
+    def test_oversized_write_rejected(self, engine):
+        with pytest.raises(FsError) as ei:
+            engine.update(cid(1), 1, 1, b"x" * (CS + 1), 0, chunk_size=CS)
+        assert ei.value.code == Code.INVALID_ARG
+
+    def test_used_size(self, engine):
+        engine.update(cid(1), 1, 1, b"x" * 1000, 0, chunk_size=CS)
+        engine.commit(cid(1), 1, 1)
+        assert engine.used_size() == 1000
+
+    def test_large_random_roundtrip(self, engine):
+        rng = np.random.default_rng(0)
+        blob = rng.integers(0, 256, 50_000).astype("u1").tobytes()
+        engine.update(cid(2), 1, 1, blob, 0, chunk_size=1 << 20)
+        engine.commit(cid(2), 1, 1)
+        assert engine.read(cid(2)) == blob
+        assert engine.get_meta(cid(2)).checksum.value == crc32c(blob)
+
+
+class TestNativeDurability:
+    def test_wal_replay_after_reopen(self, tmp_path):
+        path = str(tmp_path / "e")
+        eng = NativeChunkEngine(path)
+        eng.update(cid(1), 1, 7, b"persist-me", 0, chunk_size=CS)
+        eng.commit(cid(1), 1, 7)
+        eng.update(cid(2), 1, 7, b"pending-only", 0, chunk_size=CS)
+        eng.close()
+        eng2 = NativeChunkEngine(path)
+        assert eng2.read(cid(1)) == b"persist-me"
+        meta = eng2.get_meta(cid(2))
+        assert meta.pending_ver == 1 and meta.committed_ver == 0
+        eng2.commit(cid(2), 1, 7)  # pending survives restart and can commit
+        assert eng2.read(cid(2)) == b"pending-only"
+        eng2.close()
+
+    def test_torn_wal_tail_ignored(self, tmp_path):
+        path = str(tmp_path / "e")
+        eng = NativeChunkEngine(path)
+        eng.update(cid(1), 1, 1, b"good", 0, chunk_size=CS)
+        eng.commit(cid(1), 1, 1)
+        eng.close()
+        with open(path + "/wal.log", "ab") as f:
+            f.write(b"\x01\x02torn-garbage")
+        eng2 = NativeChunkEngine(path)
+        assert eng2.read(cid(1)) == b"good"
+        eng2.close()
+
+    def test_compaction_preserves_state(self, tmp_path):
+        path = str(tmp_path / "e")
+        eng = NativeChunkEngine(path)
+        for ver in range(1, 30):
+            eng.update(cid(1), ver, 1, bytes([ver]) * 64, 0, chunk_size=CS)
+            eng.commit(cid(1), ver, 1)
+        eng.compact()
+        eng.close()
+        eng2 = NativeChunkEngine(path)
+        assert eng2.read(cid(1)) == bytes([29]) * 64
+        assert eng2.get_meta(cid(1)).committed_ver == 29
+        eng2.close()
+
+    def test_native_crc_matches_python(self):
+        lib = _load_lib()
+        data = b"The quick brown fox jumps over the lazy dog"
+        assert lib.ce_crc32c(data, len(data)) == crc32c(data)
+
+    def test_block_reuse_after_remove(self, tmp_path):
+        import os
+
+        path = str(tmp_path / "e")
+        eng = NativeChunkEngine(path)
+        for i in range(20):
+            eng.update(cid(1, i), 1, 1, b"z" * 4096, 0, chunk_size=CS)
+            eng.commit(cid(1, i), 1, 1)
+        size_before = os.path.getsize(path + "/data_0.bin")
+        for i in range(20):
+            eng.remove(cid(1, i))
+        for i in range(20):
+            eng.update(cid(2, i), 1, 1, b"w" * 4096, 0, chunk_size=CS)
+            eng.commit(cid(2, i), 1, 1)
+        # freed blocks were reused: the class file did not grow
+        assert os.path.getsize(path + "/data_0.bin") <= size_before * 2
+        assert eng.read(cid(2, 5)) == b"w" * 4096
+        eng.close()
+
+
+class TestNativeFabric:
+    def test_cluster_on_native_engine(self, tmp_path):
+        from tpu3fs.fabric import Fabric, SystemSetupConfig
+        from tpu3fs.meta import OpenFlags
+
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=2, num_chains=2,
+                                       num_replicas=2, chunk_size=4096,
+                                       engine="native"))
+        fio = fab.file_client()
+        res = fab.meta.create("/f", flags=OpenFlags.WRITE, client_id="c",
+                              stripe=2)
+        blob = np.random.default_rng(1).integers(0, 256, 20_000).astype("u1").tobytes()
+        fio.write(res.inode, 0, blob)
+        inode = fab.meta.close(res.inode.id, res.session_id)
+        assert inode.length == len(blob)
+        assert fio.read(inode, 0, len(blob)) == blob
+
+
+class TestRegressionFixes:
+    def test_rejected_update_leaves_no_phantom(self, engine):
+        """A rejected chain-internal update must not materialize an empty
+        chunk (which would turn holes into spurious CHUNK_NOT_COMMIT)."""
+        with pytest.raises(FsError) as ei:
+            engine.update(cid(42), 5, 1, b"late", 0, chunk_size=CS)
+        assert ei.value.code == Code.CHUNK_MISSING_UPDATE
+        assert engine.get_meta(cid(42)) is None
+        with pytest.raises(FsError) as ei:
+            engine.read(cid(42))
+        assert ei.value.code == Code.CHUNK_NOT_FOUND
+
+    def test_empty_file_reads_empty(self):
+        from tpu3fs.fabric import Fabric, SystemSetupConfig
+        from tpu3fs.meta import OpenFlags
+
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=2, num_chains=1,
+                                       num_replicas=2, chunk_size=4096))
+        fio = fab.file_client()
+        res = fab.meta.create("/empty", flags=OpenFlags.WRITE, client_id="c")
+        inode = fab.meta.close(res.inode.id, res.session_id)
+        assert fio.read(inode, 0, 4096) == b""  # EOF, not fabricated zeros

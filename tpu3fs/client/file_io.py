@@ -68,6 +68,7 @@ class FileIoClient:
         if size == 0:
             return b""
         parts: List[bytes] = []
+        any_data = False
         for idx, chain_id, in_off, n in self._split(layout, offset, size):
             reply = self._storage.read_chunk(
                 chain_id, ChunkId(inode.id, idx), in_off, n
@@ -77,7 +78,12 @@ class FileIoClient:
                 continue
             if not reply.ok:
                 raise FsError(Status(reply.code))
+            any_data = True
             parts.append(reply.data.ljust(n, b"\x00"))  # pad short chunk
+        if not any_data and inode.length == 0:
+            # untracked-length inode with no chunks at all: true EOF, not a
+            # hole — POSIX read of an empty file returns 0 bytes
+            return b""
         return b"".join(parts)
 
     def file_length(self, inode: Inode) -> int:

@@ -28,11 +28,48 @@ class MemKVEngine(IKVEngine):
         self._sorted_keys: List[bytes] = []
         # commit log for conflict detection: (version, point_keys, ranges)
         self._commits: List[Tuple[int, List[bytes], List[Tuple[bytes, bytes]]]] = []
+        # read versions of live transactions: lower-bounds pruning
+        self._active: Dict[int, int] = {}
+        self._commits_since_prune = 0
 
     # -- engine API --------------------------------------------------------
     def transaction(self) -> "MemTransaction":
         with self._lock:
-            return MemTransaction(self, self._version)
+            txn = MemTransaction(self, self._version)
+            self._active[id(txn)] = self._version
+            return txn
+
+    def _finish_txn(self, txn: "MemTransaction") -> None:
+        with self._lock:
+            self._active.pop(id(txn), None)
+
+    def _maybe_prune(self) -> None:
+        """Drop commit-log entries and MVCC history no live transaction can
+        see — long-running services (mgmtd lease/heartbeat loops) would
+        otherwise grow without bound. Caller holds the lock."""
+        self._commits_since_prune += 1
+        if self._commits_since_prune < 256:
+            return
+        self._commits_since_prune = 0
+        floor = min(self._active.values(), default=self._version)
+        # conflict checks only scan commits with ver > a live read_version
+        self._commits = [c for c in self._commits if c[0] > floor]
+        dead_keys = []
+        for key, history in self._data.items():
+            # keep the newest entry at-or-below the floor + all newer entries
+            cut = 0
+            for i, (ver, _val) in enumerate(history):
+                if ver <= floor:
+                    cut = i
+            if cut:
+                del history[:cut]
+            if len(history) == 1 and history[0][1] is None and history[0][0] <= floor:
+                dead_keys.append(key)  # fully-pruned tombstone
+        for key in dead_keys:
+            del self._data[key]
+            idx = bisect.bisect_left(self._sorted_keys, key)
+            if idx < len(self._sorted_keys) and self._sorted_keys[idx] == key:
+                del self._sorted_keys[idx]
 
     @property
     def version(self) -> int:
@@ -191,6 +228,7 @@ class MemTransaction(ITransaction):
         self._done = True
         eng = self._engine
         with eng._lock:
+            eng._active.pop(id(self), None)
             if eng._check_conflicts(
                 self._read_version, self._read_keys, self._read_ranges
             ):
@@ -209,9 +247,11 @@ class MemTransaction(ITransaction):
                 (version, list(writes.keys()), list(self._clear_ranges))
             )
             self._committed_version = version
+            eng._maybe_prune()
 
     def cancel(self) -> None:
         self._done = True
+        self._engine._finish_txn(self)
 
     @property
     def committed_version(self) -> Optional[int]:

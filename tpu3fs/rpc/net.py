@@ -1,0 +1,348 @@
+"""TCP RPC transport: length-prefixed serde packets, threaded server, pooled
+blocking client.
+
+Re-expresses the reference's net + serde-RPC stack for the control plane
+(src/common/net/{Server,Transport,IOWorker}.cc + src/common/serde/
+MessagePacket.h): every request/response travels as a MessagePacket envelope
+carrying service id, method id, a status code and an 8-point timestamp for
+latency decomposition (MessagePacket.h:36-52). The reference's RDMA data
+plane maps to ICI collectives on TPU (tpu3fs.parallel); control RPCs are not
+throughput-critical, so this transport favors simplicity: one thread per
+server connection, one in-flight request per pooled client connection.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+from tpu3fs.rpc.serde import deserialize, serialize
+from tpu3fs.utils.result import Code, FsError, Status
+
+
+@dataclass
+class Timestamps:
+    """8 clock points: client build/send + server receive/queue/run/reply +
+    client receive/done (ref MessagePacket.h Timestamp)."""
+
+    client_build: float = 0.0
+    client_send: float = 0.0
+    server_receive: float = 0.0
+    server_dequeue: float = 0.0
+    server_run_start: float = 0.0
+    server_run_end: float = 0.0
+    client_receive: float = 0.0
+    client_done: float = 0.0
+
+    def server_latency(self) -> float:
+        return self.server_run_end - self.server_receive
+
+    def network_latency(self) -> float:
+        total = self.client_receive - self.client_send
+        return max(0.0, total - self.server_latency())
+
+
+FLAG_IS_REQ = 1
+FLAG_COMPRESS = 2     # reserved (ref UseCompress)
+FLAG_CONTROL_RDMA = 4  # reserved (ref ControlRDMA)
+
+
+@dataclass
+class MessagePacket:
+    uuid: str
+    service_id: int
+    method_id: int
+    flags: int
+    status: int                    # Code of the reply (OK for requests)
+    payload: bytes
+    message: str = ""
+    timestamps: Timestamps = field(default_factory=Timestamps)
+
+
+_LEN = struct.Struct(">I")
+MAX_PACKET = 64 << 20
+
+
+def _send_packet(sock: socket.socket, pkt: MessagePacket, lock: threading.Lock) -> None:
+    raw = serialize(pkt)
+    with lock:
+        sock.sendall(_LEN.pack(len(raw)) + raw)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("peer closed")
+        buf += part
+    return bytes(buf)
+
+
+def _recv_packet(sock: socket.socket) -> MessagePacket:
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    if n > MAX_PACKET:
+        raise ConnectionError(f"oversized packet: {n}")
+    return deserialize(_recv_exact(sock, n), MessagePacket)
+
+
+# -- service declaration ----------------------------------------------------
+
+@dataclass
+class MethodDef:
+    method_id: int
+    name: str
+    req_type: Type
+    rsp_type: Type
+    handler: Callable[[Any], Any]
+
+
+class ServiceDef:
+    """A service = u16 id + method table (ref SERDE_SERVICE, Service.h:80-128)."""
+
+    def __init__(self, service_id: int, name: str):
+        self.service_id = service_id
+        self.name = name
+        self.methods: Dict[int, MethodDef] = {}
+
+    def method(
+        self, method_id: int, name: str, req_type: Type, rsp_type: Type,
+        handler: Callable[[Any], Any],
+    ) -> None:
+        if method_id in self.methods:
+            raise ValueError(f"duplicate method id {method_id} in {self.name}")
+        self.methods[method_id] = MethodDef(method_id, name, req_type, rsp_type, handler)
+
+
+class RpcServer:
+    """Threaded TCP server dispatching packets to registered services
+    (ref net::Server + ServiceGroup)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._services: Dict[int, ServiceDef] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.host, self.port = self._sock.getsockname()
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+
+    def add_service(self, service: ServiceDef) -> None:
+        if service.service_id in self._services:
+            raise ValueError(f"duplicate service id {service.service_id}")
+        self._services[service.service_id] = service
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.host, self.port
+
+    def start(self) -> None:
+        self._running = True
+        self._sock.listen(64)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        write_lock = threading.Lock()
+        try:
+            while self._running:
+                pkt = _recv_packet(conn)
+                pkt.timestamps.server_receive = time.monotonic()
+                reply = self._dispatch(pkt)
+                _send_packet(conn, reply, write_lock)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, pkt: MessagePacket) -> MessagePacket:
+        ts = pkt.timestamps
+        ts.server_dequeue = time.monotonic()
+        service = self._services.get(pkt.service_id)
+        if service is None:
+            return self._error_reply(pkt, Code.RPC_SERVICE_NOT_FOUND,
+                                     str(pkt.service_id))
+        mdef = service.methods.get(pkt.method_id)
+        if mdef is None:
+            return self._error_reply(pkt, Code.RPC_METHOD_NOT_FOUND,
+                                     f"{service.name}.{pkt.method_id}")
+        try:
+            req = deserialize(pkt.payload, mdef.req_type)
+        except Exception as e:  # malformed payload
+            return self._error_reply(pkt, Code.RPC_BAD_REQUEST, repr(e))
+        ts.server_run_start = time.monotonic()
+        try:
+            rsp = mdef.handler(req)
+            payload = serialize(rsp, mdef.rsp_type)
+            status, message = int(Code.OK), ""
+        except FsError as e:
+            payload, status, message = b"", int(e.code), e.status.message
+        except Exception as e:  # handler bug: surface as INTERNAL
+            payload, status, message = b"", int(Code.INTERNAL), repr(e)
+        ts.server_run_end = time.monotonic()
+        return MessagePacket(
+            uuid=pkt.uuid,
+            service_id=pkt.service_id,
+            method_id=pkt.method_id,
+            flags=0,
+            status=status,
+            payload=payload,
+            message=message,
+            timestamps=ts,
+        )
+
+    @staticmethod
+    def _error_reply(pkt: MessagePacket, code: Code, msg: str) -> MessagePacket:
+        return MessagePacket(
+            uuid=pkt.uuid, service_id=pkt.service_id, method_id=pkt.method_id,
+            flags=0, status=int(code), payload=b"", message=msg,
+            timestamps=pkt.timestamps,
+        )
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for conn in self._conns:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+class _PooledConn:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.lock = threading.Lock()  # one in-flight request per connection
+        self.write_lock = threading.Lock()
+
+
+class RpcClient:
+    """Blocking client with a per-address connection pool
+    (ref net::Client + TransportPool)."""
+
+    def __init__(self, connect_timeout: float = 5.0, call_timeout: float = 30.0):
+        self._pools: Dict[Tuple[str, int], List[_PooledConn]] = {}
+        self._lock = threading.Lock()
+        self._connect_timeout = connect_timeout
+        self._call_timeout = call_timeout
+
+    def _get_conn(self, addr: Tuple[str, int]) -> _PooledConn:
+        with self._lock:
+            pool = self._pools.setdefault(addr, [])
+            for conn in pool:
+                if conn.lock.acquire(blocking=False):
+                    return conn
+        try:
+            sock = socket.create_connection(addr, timeout=self._connect_timeout)
+        except OSError as e:
+            raise FsError(Status(Code.RPC_CONNECT_FAILED, f"{addr}: {e}"))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self._call_timeout)
+        conn = _PooledConn(sock)
+        conn.lock.acquire()
+        with self._lock:
+            self._pools[addr].append(conn)
+        return conn
+
+    def _drop_conn(self, addr: Tuple[str, int], conn: _PooledConn) -> None:
+        with self._lock:
+            pool = self._pools.get(addr, [])
+            if conn in pool:
+                pool.remove(conn)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def call(
+        self,
+        addr: Tuple[str, int],
+        service_id: int,
+        method_id: int,
+        req: Any,
+        rsp_type: Type,
+        *,
+        req_type: Optional[Type] = None,
+    ) -> Any:
+        """Raises FsError carrying the remote (or transport) status code."""
+        pkt = MessagePacket(
+            uuid=uuid_mod.uuid4().hex,
+            service_id=service_id,
+            method_id=method_id,
+            flags=FLAG_IS_REQ,
+            status=int(Code.OK),
+            payload=serialize(req, req_type or type(req)),
+        )
+        pkt.timestamps.client_build = time.monotonic()
+        conn = self._get_conn(addr)
+        try:
+            # the connection must not return to the pool until the stream is
+            # known to be in sync (uuid validated) — releasing earlier would
+            # let another thread claim a connection we may still drop/close
+            try:
+                pkt.timestamps.client_send = time.monotonic()
+                _send_packet(conn.sock, pkt, conn.write_lock)
+                reply = _recv_packet(conn.sock)
+                reply.timestamps.client_receive = time.monotonic()
+            except (ConnectionError, OSError, socket.timeout) as e:
+                self._drop_conn(addr, conn)
+                code = (
+                    Code.RPC_TIMEOUT
+                    if isinstance(e, socket.timeout)
+                    else Code.RPC_PEER_CLOSED
+                )
+                raise FsError(Status(code, f"{addr}: {e}"))
+            if reply.uuid != pkt.uuid:
+                self._drop_conn(addr, conn)
+                raise FsError(Status(Code.RPC_PEER_CLOSED, "uuid mismatch"))
+        finally:
+            if conn.lock.locked():
+                conn.lock.release()
+        if reply.status != int(Code.OK):
+            raise FsError(Status(Code(reply.status), reply.message))
+        reply.timestamps.client_done = time.monotonic()
+        rsp = deserialize(reply.payload, rsp_type)
+        return rsp
+
+    def close(self) -> None:
+        with self._lock:
+            for pool in self._pools.values():
+                for conn in pool:
+                    try:
+                        conn.sock.close()
+                    except OSError:
+                        pass
+            self._pools.clear()

@@ -1,0 +1,561 @@
+"""RPC service bindings: storage / meta / mgmtd / core over the TCP transport.
+
+Service and method ids mirror the reference's registry: StorageSerde id 3
+(src/fbs/storage/Service.h:8-23), MetaSerde id 4 (src/fbs/meta/
+Service.h:709-746), Mgmtd id 217 (src/fbs/mgmtd/MgmtdServiceDef.h:3-26), Core
+id 10001 on every server (src/fbs/core/service/CoreServiceDef.h:3-8).
+
+Each binding pairs wire dataclasses with handlers over the in-process
+operators, plus a client-side stub exposing the same methods; the storage
+stub implements the Messenger signature so the CRAQ forwarding path and the
+ResyncWorker run unchanged over sockets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tpu3fs.meta.store import MetaStore, OpenResult, StatFs, User
+from tpu3fs.meta.types import DirEntry, Inode
+from tpu3fs.mgmtd.service import HeartbeatReply, Mgmtd
+from tpu3fs.mgmtd.types import LocalTargetState, NodeType, RoutingInfo
+from tpu3fs.rpc.net import RpcClient, RpcServer, ServiceDef
+from tpu3fs.storage.craq import ReadReply, ReadReq, StorageService, UpdateReply, WriteReq
+from tpu3fs.storage.types import ChunkId, ChunkMeta
+from tpu3fs.utils.result import Code, FsError, Status
+
+STORAGE_SERVICE_ID = 3     # ref fbs/storage/Service.h
+META_SERVICE_ID = 4        # ref fbs/meta/Service.h
+MGMTD_SERVICE_ID = 217     # ref fbs/mgmtd/MgmtdServiceDef.h
+CORE_SERVICE_ID = 10001    # ref fbs/core/service/CoreServiceDef.h
+
+
+# -- small wire wrappers ----------------------------------------------------
+
+@dataclass
+class TargetIdReq:
+    target_id: int
+
+
+@dataclass
+class ChunkMetaList:
+    metas: List[ChunkMeta] = field(default_factory=list)
+
+
+@dataclass
+class RemoveChunkReq:
+    target_id: int
+    chunk_id: ChunkId
+
+
+@dataclass
+class FileChunksReq:
+    chain_id: int
+    file_id: int
+
+
+@dataclass
+class TruncateChunksReq:
+    chain_id: int
+    file_id: int
+    last_index: int
+    last_length: int
+
+
+@dataclass
+class IntReply:
+    value: int = 0
+
+
+@dataclass
+class PairReply:
+    a: int = 0
+    b: int = 0
+
+
+@dataclass
+class Empty:
+    pass
+
+
+@dataclass
+class EchoReq:
+    text: str = ""
+
+
+@dataclass
+class EchoRsp:
+    text: str = ""
+
+
+@dataclass
+class HeartbeatReq:
+    node_id: int
+    hb_version: int
+    local_states: Dict[int, int] = field(default_factory=dict)
+
+
+@dataclass
+class RoutingReq:
+    known_version: int = -1
+
+
+@dataclass
+class RoutingRsp:
+    changed: bool = False
+    routing: Optional[RoutingInfo] = None
+
+
+@dataclass
+class RegisterNodeReq:
+    node_id: int
+    node_type: int
+    host: str = ""
+    port: int = 0
+
+
+# -- storage ----------------------------------------------------------------
+
+def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
+    s = ServiceDef(STORAGE_SERVICE_ID, "StorageSerde")
+    s.method(1, "write", WriteReq, UpdateReply, svc.write)
+    s.method(2, "update", WriteReq, UpdateReply, svc.update)
+    s.method(3, "read", ReadReq, ReadReply, svc.read)
+    s.method(4, "dumpChunkMeta", TargetIdReq, ChunkMetaList,
+             lambda r: ChunkMetaList(svc.dump_chunkmeta(r.target_id)))
+    s.method(5, "syncDone", TargetIdReq, Empty,
+             lambda r: (svc.sync_done(r.target_id), Empty())[1])
+    s.method(6, "removeChunk", RemoveChunkReq, IntReply,
+             lambda r: IntReply(int(svc.remove_chunk(r.target_id, r.chunk_id))))
+    s.method(7, "removeFileChunks", FileChunksReq, IntReply,
+             lambda r: IntReply(svc.remove_file_chunks(r.chain_id, r.file_id)))
+    s.method(8, "queryLastChunk", FileChunksReq, PairReply,
+             lambda r: PairReply(*svc.query_last_chunk(r.chain_id, r.file_id)))
+    s.method(9, "truncateChunks", TruncateChunksReq, IntReply,
+             lambda r: IntReply(svc.truncate_file_chunks(
+                 r.chain_id, r.file_id, r.last_index, r.last_length)))
+    server.add_service(s)
+
+
+class RpcMessenger:
+    """Messenger over sockets: node id -> address via routing info.
+
+    The same signature the fabric's direct-dispatch messenger has, so
+    StorageService forwarding, ResyncWorker and the clients are transport
+    agnostic.
+    """
+
+    def __init__(self, routing_provider, client: Optional[RpcClient] = None):
+        self._routing = routing_provider
+        self._client = client or RpcClient()
+
+    def _addr(self, node_id: int) -> Tuple[str, int]:
+        node = self._routing().nodes.get(node_id)
+        if node is None or not node.host:
+            raise FsError(Status(Code.RPC_CONNECT_FAILED, f"no address for node {node_id}"))
+        return node.host, node.port
+
+    def __call__(self, node_id: int, method: str, payload):
+        addr = self._addr(node_id)
+        c = self._client
+        sid = STORAGE_SERVICE_ID
+        if method == "write":
+            return c.call(addr, sid, 1, payload, UpdateReply)
+        if method == "update":
+            return c.call(addr, sid, 2, payload, UpdateReply)
+        if method == "read":
+            return c.call(addr, sid, 3, payload, ReadReply)
+        if method == "dump_chunkmeta":
+            return c.call(addr, sid, 4, TargetIdReq(payload), ChunkMetaList).metas
+        if method == "sync_done":
+            c.call(addr, sid, 5, TargetIdReq(payload), Empty)
+            return None
+        if method == "remove_chunk":
+            return bool(c.call(addr, sid, 6, RemoveChunkReq(*payload), IntReply).value)
+        if method == "remove_file_chunks":
+            return c.call(addr, sid, 7, FileChunksReq(*payload), IntReply).value
+        if method == "query_last_chunk":
+            r = c.call(addr, sid, 8, FileChunksReq(*payload), PairReply)
+            return r.a, r.b
+        if method == "truncate_file_chunks":
+            return c.call(addr, sid, 9, TruncateChunksReq(*payload), IntReply).value
+        raise FsError(Status(Code.RPC_METHOD_NOT_FOUND, method))
+
+
+# -- mgmtd ------------------------------------------------------------------
+
+def bind_mgmtd_service(server: RpcServer, mgmtd: Mgmtd) -> None:
+    s = ServiceDef(MGMTD_SERVICE_ID, "Mgmtd")
+
+    def heartbeat(req: HeartbeatReq) -> HeartbeatReply:
+        states = {t: LocalTargetState(v) for t, v in req.local_states.items()}
+        return mgmtd.heartbeat(req.node_id, req.hb_version, states)
+
+    def routing(req: RoutingReq) -> RoutingRsp:
+        ri = mgmtd.get_routing_info(req.known_version)
+        return RoutingRsp(changed=ri is not None, routing=ri)
+
+    def register(req: RegisterNodeReq) -> Empty:
+        mgmtd.register_node(
+            req.node_id, NodeType(req.node_type), req.host, req.port
+        )
+        return Empty()
+
+    s.method(1, "heartbeat", HeartbeatReq, HeartbeatReply, heartbeat)
+    s.method(2, "getRoutingInfo", RoutingReq, RoutingRsp, routing)
+    s.method(3, "registerNode", RegisterNodeReq, Empty, register)
+    server.add_service(s)
+
+
+class MgmtdRpcClient:
+    """Routing-info poller + heartbeat sender over RPC (ref MgmtdClient's
+    ForClient/ForServer split: this class serves both roles)."""
+
+    def __init__(self, addr: Tuple[str, int], client: Optional[RpcClient] = None):
+        self._addr = addr
+        self._client = client or RpcClient()
+        self._routing: Optional[RoutingInfo] = None
+
+    def register_node(self, node_id: int, node_type: NodeType,
+                      host: str = "", port: int = 0) -> None:
+        self._client.call(
+            self._addr, MGMTD_SERVICE_ID, 3,
+            RegisterNodeReq(node_id, int(node_type), host, port), Empty,
+        )
+
+    def heartbeat(
+        self, node_id: int, hb_version: int,
+        local_states: Optional[Dict[int, LocalTargetState]] = None,
+    ) -> HeartbeatReply:
+        req = HeartbeatReq(
+            node_id, hb_version,
+            {t: int(v) for t, v in (local_states or {}).items()},
+        )
+        return self._client.call(self._addr, MGMTD_SERVICE_ID, 1, req, HeartbeatReply)
+
+    def refresh_routing(self) -> RoutingInfo:
+        known = self._routing.version if self._routing else -1
+        rsp = self._client.call(
+            self._addr, MGMTD_SERVICE_ID, 2, RoutingReq(known), RoutingRsp
+        )
+        if rsp.changed and rsp.routing is not None:
+            self._routing = rsp.routing
+        assert self._routing is not None
+        return self._routing
+
+    def routing(self) -> RoutingInfo:
+        if self._routing is None:
+            return self.refresh_routing()
+        return self._routing
+
+
+# -- meta -------------------------------------------------------------------
+
+@dataclass
+class PathReq:
+    path: str
+    uid: int = 0
+    gid: int = 0
+    follow: bool = True
+
+
+@dataclass
+class CreateReq:
+    path: str
+    uid: int = 0
+    gid: int = 0
+    perm: int = 0o644
+    flags: int = 0
+    chunk_size: int = 0
+    stripe: int = 0
+    client_id: str = ""
+
+
+@dataclass
+class OpenReq:
+    path: str
+    uid: int = 0
+    gid: int = 0
+    flags: int = 1
+    client_id: str = ""
+
+
+@dataclass
+class CloseReq:
+    inode_id: int
+    session_id: str
+    length_hint: int = -1
+    client_id: str = ""
+    request_id: str = ""
+
+
+@dataclass
+class MkdirsReq:
+    path: str
+    uid: int = 0
+    gid: int = 0
+    perm: int = 0o755
+    recursive: bool = False
+
+
+@dataclass
+class RemoveReq:
+    path: str
+    uid: int = 0
+    gid: int = 0
+    recursive: bool = False
+    client_id: str = ""
+    request_id: str = ""
+
+
+@dataclass
+class RenameReq:
+    src: str
+    dst: str
+    uid: int = 0
+    gid: int = 0
+
+
+@dataclass
+class SymlinkReq:
+    path: str
+    target: str
+    uid: int = 0
+    gid: int = 0
+
+
+@dataclass
+class HardLinkReq:
+    src: str
+    dst: str
+    uid: int = 0
+    gid: int = 0
+
+
+@dataclass
+class ListReq:
+    path: str
+    uid: int = 0
+    gid: int = 0
+    limit: int = 0
+    prefix: str = ""
+
+
+@dataclass
+class ListRsp:
+    entries: List[DirEntry] = field(default_factory=list)
+
+
+@dataclass
+class SetAttrReq:
+    path: str
+    uid: int = 0
+    gid: int = 0
+    perm: int = -1
+    new_uid: int = -1
+    new_gid: int = -1
+
+
+@dataclass
+class TruncateReq:
+    path: str
+    length: int
+    uid: int = 0
+    gid: int = 0
+
+
+@dataclass
+class SyncReq:
+    inode_id: int
+    length_hint: int = -1
+
+
+@dataclass
+class PruneSessionReq:
+    client_id: str
+
+
+@dataclass
+class BatchStatReq:
+    inode_ids: List[int] = field(default_factory=list)
+
+
+@dataclass
+class BatchStatRsp:
+    inodes: List[Optional[Inode]] = field(default_factory=list)
+
+
+@dataclass
+class StrReply:
+    value: str = ""
+
+
+@dataclass
+class InodeRsp:
+    inode: Inode
+
+
+@dataclass
+class OpenRsp:
+    inode: Inode
+    session_id: str = ""
+
+
+def bind_meta_service(server: RpcServer, meta: MetaStore) -> None:
+    s = ServiceDef(META_SERVICE_ID, "MetaSerde")
+
+    def u(req) -> User:
+        return User(req.uid, req.gid)
+
+    s.method(1, "statFs", Empty, StatFs, lambda r: meta.stat_fs())
+    s.method(2, "stat", PathReq, InodeRsp,
+             lambda r: InodeRsp(meta.stat(r.path, u(r), follow=r.follow)))
+    s.method(3, "create", CreateReq, OpenRsp, lambda r: _open_rsp(
+        meta.create(r.path, u(r), r.perm, flags=r.flags,
+                    chunk_size=r.chunk_size or None, stripe=r.stripe or None,
+                    client_id=r.client_id)))
+    s.method(4, "mkdirs", MkdirsReq, InodeRsp, lambda r: InodeRsp(
+        meta.mkdirs(r.path, u(r), r.perm, recursive=r.recursive)))
+    s.method(5, "symlink", SymlinkReq, InodeRsp,
+             lambda r: InodeRsp(meta.symlink(r.path, r.target, u(r))))
+    s.method(6, "hardLink", HardLinkReq, InodeRsp,
+             lambda r: InodeRsp(meta.hard_link(r.src, r.dst, u(r))))
+    s.method(7, "remove", RemoveReq, Empty, lambda r: (
+        meta.remove(r.path, u(r), recursive=r.recursive,
+                    client_id=r.client_id, request_id=r.request_id), Empty())[1])
+    s.method(8, "open", OpenReq, OpenRsp, lambda r: _open_rsp(
+        meta.open(r.path, u(r), flags=r.flags, client_id=r.client_id)))
+    s.method(9, "sync", SyncReq, InodeRsp, lambda r: InodeRsp(meta.sync(
+        r.inode_id, length_hint=None if r.length_hint < 0 else r.length_hint)))
+    s.method(10, "close", CloseReq, InodeRsp, lambda r: InodeRsp(meta.close(
+        r.inode_id, r.session_id,
+        length_hint=None if r.length_hint < 0 else r.length_hint,
+        client_id=r.client_id, request_id=r.request_id)))
+    s.method(11, "rename", RenameReq, Empty,
+             lambda r: (meta.rename(r.src, r.dst, u(r)), Empty())[1])
+    s.method(12, "list", ListReq, ListRsp, lambda r: ListRsp(
+        meta.list_dir(r.path, u(r), limit=r.limit, prefix=r.prefix)))
+    s.method(13, "truncate", TruncateReq, InodeRsp,
+             lambda r: InodeRsp(meta.truncate(r.path, r.length, u(r))))
+    s.method(14, "getRealPath", PathReq, StrReply,
+             lambda r: StrReply(meta.get_real_path(r.path, u(r))))
+    s.method(15, "setAttr", SetAttrReq, InodeRsp, lambda r: InodeRsp(
+        meta.set_attr(r.path, u(r),
+                      perm=None if r.perm < 0 else r.perm,
+                      uid=None if r.new_uid < 0 else r.new_uid,
+                      gid=None if r.new_gid < 0 else r.new_gid)))
+    s.method(16, "pruneSession", PruneSessionReq, IntReply,
+             lambda r: IntReply(meta.prune_session(r.client_id)))
+    s.method(17, "batchStat", BatchStatReq, BatchStatRsp,
+             lambda r: BatchStatRsp(meta.batch_stat(r.inode_ids)))
+    server.add_service(s)
+
+
+def _open_rsp(res: OpenResult) -> OpenRsp:
+    return OpenRsp(res.inode, res.session_id)
+
+
+class MetaRpcClient:
+    """Full meta API over RPC with server failover
+    (ref MetaClient.h:55-226 + ServerSelectionStrategy)."""
+
+    def __init__(
+        self,
+        addrs: List[Tuple[str, int]],
+        client: Optional[RpcClient] = None,
+        client_id: str = "",
+    ):
+        if not addrs:
+            raise ValueError("need at least one meta server address")
+        self._addrs = list(addrs)
+        self._client = client or RpcClient()
+        self.client_id = client_id
+        self._cursor = 0
+
+    def _call(self, method_id: int, req, rsp_type):
+        last: Optional[FsError] = None
+        for i in range(len(self._addrs)):
+            addr = self._addrs[(self._cursor + i) % len(self._addrs)]
+            try:
+                out = self._client.call(addr, META_SERVICE_ID, method_id, req, rsp_type)
+                self._cursor = (self._cursor + i) % len(self._addrs)
+                return out
+            except FsError as e:
+                if e.status.retryable():
+                    last = e
+                    continue  # evict failing server: try the next
+                raise
+        assert last is not None
+        raise last
+
+    def stat(self, path: str, follow: bool = True) -> Inode:
+        return self._call(2, PathReq(path, follow=follow), InodeRsp).inode
+
+    def create(self, path: str, **kw) -> OpenRsp:
+        return self._call(3, CreateReq(path, client_id=self.client_id, **kw), OpenRsp)
+
+    def mkdirs(self, path: str, recursive: bool = False) -> Inode:
+        return self._call(4, MkdirsReq(path, recursive=recursive), InodeRsp).inode
+
+    def remove(self, path: str, recursive: bool = False, request_id: str = "") -> None:
+        self._call(7, RemoveReq(path, recursive=recursive,
+                                client_id=self.client_id, request_id=request_id), Empty)
+
+    def open(self, path: str, flags: int = 1) -> OpenRsp:
+        return self._call(8, OpenReq(path, flags=flags, client_id=self.client_id), OpenRsp)
+
+    def close(self, inode_id: int, session_id: str, length_hint: int = -1,
+              request_id: str = "") -> Inode:
+        return self._call(10, CloseReq(inode_id, session_id, length_hint,
+                                       self.client_id, request_id), InodeRsp).inode
+
+    def rename(self, src: str, dst: str) -> None:
+        self._call(11, RenameReq(src, dst), Empty)
+
+    def list_dir(self, path: str, limit: int = 0, prefix: str = "") -> List[DirEntry]:
+        return self._call(12, ListReq(path, limit=limit, prefix=prefix), ListRsp).entries
+
+    def stat_fs(self) -> StatFs:
+        return self._call(1, Empty(), StatFs)
+
+    def get_real_path(self, path: str) -> str:
+        return self._call(14, PathReq(path), StrReply).value
+
+
+# -- core (embedded in every server; ref CoreService) ------------------------
+
+def bind_core_service(server: RpcServer, *, config=None, on_shutdown=None) -> None:
+    s = ServiceDef(CORE_SERVICE_ID, "Core")
+    s.method(1, "echo", EchoReq, EchoRsp, lambda r: EchoRsp(r.text))
+
+    def render(_r: Empty) -> StrReply:
+        return StrReply(config.render_toml() if config is not None else "")
+
+    def hot_update(req: StrReply) -> Empty:
+        if config is not None:
+            import tomllib
+
+            config.hot_update(_flatten(tomllib.loads(req.value)))
+        return Empty()
+
+    s.method(2, "renderConfig", Empty, StrReply, render)
+    s.method(3, "hotUpdateConfig", StrReply, Empty, hot_update)
+
+    def shutdown(_r: Empty) -> Empty:
+        if on_shutdown is not None:
+            on_shutdown()
+        return Empty()
+
+    s.method(4, "shutdown", Empty, Empty, shutdown)
+    server.add_service(s)
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, dict):
+            out.update(_flatten(v, f"{prefix}{k}."))
+        else:
+            out[f"{prefix}{k}"] = v
+    return out

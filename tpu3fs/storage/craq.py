@@ -170,13 +170,14 @@ class StorageService:
             raise _err(Code.CHAIN_NOT_FOUND, str(chain_id))
         return chain
 
-    def _local_writer_position(self, chain: ChainInfo) -> Optional[int]:
-        """Index of this node's target in the chain's writer list, or None."""
+    def _local_writer(self, chain: ChainInfo):
+        """This node's target in the chain's writer list (or None), plus the
+        writer list — the shared find-my-position step of every chain op."""
         writers = chain.writer_chain()
         for i, t in enumerate(writers):
             if t.target_id in self._targets:
-                return i
-        return None
+                return t, i, writers
+        return None, -1, writers
 
     # -- client write (HEAD only; ref StorageOperator.cc:233-282) ------------
     def write(self, req: WriteReq) -> UpdateReply:
@@ -214,11 +215,7 @@ class StorageService:
             chain = self._chain(req.chain_id)
         except FsError as e:
             return UpdateReply(e.code, message=e.status.message)
-        mine = None
-        for t in chain.writer_chain():
-            if t.target_id in self._targets:
-                mine = t
-                break
+        mine, _, _ = self._local_writer(chain)
         if mine is None:
             return UpdateReply(
                 Code.TARGET_NOT_FOUND, message="no local writer target in chain"
@@ -309,18 +306,7 @@ class StorageService:
                 return UpdateReply(e.code, message=e.status.message)
 
     def _pending_content(self, target: StorageTarget, chunk_id: ChunkId) -> bytes:
-        # engine internals expose committed only; rebuild pending view
-        engine = target.engine
-        meta = engine.get_meta(chunk_id)
-        if meta is None:
-            return b""
-        slot = getattr(engine, "_slot", None)
-        if slot is not None:
-            s = slot(chunk_id)
-            if s is not None and s.pending is not None:
-                return s.pending
-            return s.committed if s is not None else b""
-        return engine.read(chunk_id)
+        return target.engine.pending_content(chunk_id)
 
     # -- forwarding (ref ReliableForwarding.h:15-40) --------------------------
     def _forward(
@@ -434,22 +420,13 @@ class StorageService:
         the chain (removes are idempotent; ref removeChunks)."""
         chain = self._chain(chain_id)
         removed = 0
-        mine = None
-        for t in chain.writer_chain():
-            if t.target_id in self._targets:
-                mine = t
-                break
+        mine, my_idx, writers = self._local_writer(chain)
         if mine is None:
             return 0
         engine = self._targets[mine.target_id].engine
         for meta in engine.query(ChunkId.file_prefix(file_id)):
             engine.remove(meta.chunk_id)
             removed += 1
-        # forward
-        writers = chain.writer_chain()
-        my_idx = next(
-            i for i, t in enumerate(writers) if t.target_id == mine.target_id
-        )
         if my_idx + 1 < len(writers) and self._messenger is not None:
             node = self._routing().node_of_target(writers[my_idx + 1].target_id)
             if node is not None:
@@ -465,11 +442,7 @@ class StorageService:
         last_index, trim the boundary chunk, and forward down the chain
         (idempotent, like removes; ref truncateChunks)."""
         chain = self._chain(chain_id)
-        mine = None
-        for t in chain.writer_chain():
-            if t.target_id in self._targets:
-                mine = t
-                break
+        mine, my_idx, writers = self._local_writer(chain)
         if mine is None:
             return 0
         engine = self._targets[mine.target_id].engine
@@ -484,10 +457,6 @@ class StorageService:
                 with self._chunk_lock(mine.target_id, meta.chunk_id):
                     engine.truncate(meta.chunk_id, last_length, chain.chain_version)
                 touched += 1
-        writers = chain.writer_chain()
-        my_idx = next(
-            i for i, t in enumerate(writers) if t.target_id == mine.target_id
-        )
         if my_idx + 1 < len(writers) and self._messenger is not None:
             node = self._routing().node_of_target(writers[my_idx + 1].target_id)
             if node is not None:

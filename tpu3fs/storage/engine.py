@@ -70,6 +70,11 @@ class ChunkEngine(abc.ABC):
     @abc.abstractmethod
     def used_size(self) -> int: ...
 
+    @abc.abstractmethod
+    def pending_content(self, chunk_id: ChunkId) -> bytes:
+        """Full content of the staged pending version (committed if none;
+        b"" if the chunk is unknown). Feeds the chain checksum cross-check."""
+
     def close(self) -> None:  # pragma: no cover - engines may override
         pass
 
@@ -129,6 +134,28 @@ class MemChunkEngine(ChunkEngine):
         with self._lock:
             key = chunk_id.to_bytes()
             slot = self._chunks.get(key)
+            # validate BEFORE inserting, so a rejected update leaves no
+            # phantom committed_ver=0 chunk behind (which would turn
+            # CHUNK_NOT_FOUND holes into spurious CHUNK_NOT_COMMIT retries)
+            if not full_replace:
+                cv = slot.meta.committed_ver if slot else 0
+                pv = slot.meta.pending_ver if slot else 0
+                if update_ver <= cv:
+                    raise _err(
+                        Code.CHUNK_STALE_UPDATE,
+                        f"update {update_ver} <= committed {cv}",
+                    )
+                if pv and pv != update_ver:
+                    # a retry racing past a staged pending update
+                    raise _err(
+                        Code.CHUNK_ADVANCE_UPDATE,
+                        f"pending {pv} != update {update_ver}",
+                    )
+                if update_ver > cv + 1:
+                    raise _err(
+                        Code.CHUNK_MISSING_UPDATE,
+                        f"update {update_ver} > committed {cv}+1",
+                    )
             if slot is None:
                 slot = _Slot(ChunkMeta(chunk_id, chain_ver))
                 self._chunks[key] = slot
@@ -144,22 +171,6 @@ class MemChunkEngine(ChunkEngine):
                 meta.length = len(data)
                 meta.checksum = Checksum.of(slot.committed)
                 return replace(meta)
-            # update-code taxonomy (ref StorageOperator.cc:401-434)
-            if update_ver <= meta.committed_ver:
-                raise _err(
-                    Code.CHUNK_STALE_UPDATE,
-                    f"update {update_ver} <= committed {meta.committed_ver}",
-                )
-            if update_ver > meta.committed_ver + 1:
-                raise _err(
-                    Code.CHUNK_MISSING_UPDATE,
-                    f"update {update_ver} > committed {meta.committed_ver}+1",
-                )
-            if meta.pending_ver and meta.pending_ver != update_ver:
-                raise _err(
-                    Code.CHUNK_ADVANCE_UPDATE,
-                    f"pending {meta.pending_ver} != update {update_ver}",
-                )
             # COW: base is committed content (re-applying the same pending
             # update is idempotent)
             base = bytearray(slot.committed)
@@ -225,3 +236,10 @@ class MemChunkEngine(ChunkEngine):
     def used_size(self) -> int:
         with self._lock:
             return sum(len(s.committed) for s in self._chunks.values())
+
+    def pending_content(self, chunk_id: ChunkId) -> bytes:
+        with self._lock:
+            slot = self._slot(chunk_id)
+            if slot is None:
+                return b""
+            return slot.pending if slot.pending is not None else slot.committed

@@ -1,0 +1,245 @@
+"""ctypes wrapper for the native C++ chunk engine (native/chunk_engine.cpp).
+
+Implements the same ChunkEngine interface as MemChunkEngine, so StorageTarget
+swaps engines by config exactly like the reference's only_chunk_engine switch
+(src/storage/store/StorageTarget.h:85-162; native engine semantics ported
+from src/storage/chunk_engine). The library auto-builds via make on first use
+if missing (dev convenience; deployments prebuild).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import tempfile
+import threading
+from typing import List, Optional
+
+from tpu3fs.storage.engine import ChunkEngine
+from tpu3fs.storage.types import Checksum, ChunkId, ChunkMeta
+from tpu3fs.utils.result import Code, err as _err
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libtpu3fs_engine.so"))
+
+_ERR_TO_CODE = {
+    -1: Code.CHUNK_NOT_FOUND,
+    -2: Code.CHUNK_NOT_COMMIT,
+    -3: Code.CHUNK_STALE_UPDATE,
+    -4: Code.CHUNK_MISSING_UPDATE,
+    -5: Code.CHUNK_ADVANCE_UPDATE,
+    -6: Code.ENGINE_ERROR,
+    -7: Code.INVALID_ARG,
+    -8: Code.NO_SPACE,
+}
+
+_KEYLEN = 12
+
+
+class _CMeta(ctypes.Structure):
+    _fields_ = [
+        ("committed_ver", ctypes.c_uint64),
+        ("pending_ver", ctypes.c_uint64),
+        ("chain_ver", ctypes.c_uint64),
+        ("length", ctypes.c_uint32),
+        ("crc", ctypes.c_uint32),
+        ("pending_length", ctypes.c_uint32),
+        ("key", ctypes.c_uint8 * _KEYLEN),
+    ]
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ce_open.restype = ctypes.c_void_p
+        lib.ce_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ce_close.argtypes = [ctypes.c_void_p]
+        lib.ce_update.restype = ctypes.c_int
+        lib.ce_update.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int,
+            ctypes.c_uint32,
+        ]
+        lib.ce_commit.restype = ctypes.c_int
+        lib.ce_commit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.ce_read.restype = ctypes.c_int
+        lib.ce_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint32, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.ce_read_pending.restype = ctypes.c_int
+        lib.ce_read_pending.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.ce_get_meta.restype = ctypes.c_int
+        lib.ce_get_meta.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(_CMeta),
+        ]
+        lib.ce_remove.restype = ctypes.c_int
+        lib.ce_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ce_truncate.restype = ctypes.c_int
+        lib.ce_truncate.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64,
+        ]
+        lib.ce_query.restype = ctypes.c_int
+        lib.ce_query.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(_CMeta), ctypes.c_int,
+        ]
+        lib.ce_used_size.restype = ctypes.c_int64
+        lib.ce_used_size.argtypes = [ctypes.c_void_p]
+        lib.ce_chunk_count.restype = ctypes.c_int64
+        lib.ce_chunk_count.argtypes = [ctypes.c_void_p]
+        lib.ce_compact.restype = ctypes.c_int
+        lib.ce_compact.argtypes = [ctypes.c_void_p]
+        lib.ce_crc32c.restype = ctypes.c_uint32
+        lib.ce_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        _lib = lib
+        return lib
+
+
+def _check(rc: int, what: str = "") -> int:
+    if rc < 0:
+        raise _err(_ERR_TO_CODE.get(rc, Code.ENGINE_ERROR), what)
+    return rc
+
+
+def _meta_from_c(m: _CMeta) -> ChunkMeta:
+    key = bytes(m.key)
+    return ChunkMeta(
+        chunk_id=ChunkId.from_bytes(key),
+        chain_ver=m.chain_ver,
+        committed_ver=m.committed_ver,
+        pending_ver=m.pending_ver,
+        length=m.length,
+        checksum=Checksum(m.crc, m.length),
+    )
+
+
+class NativeChunkEngine(ChunkEngine):
+    def __init__(self, path: Optional[str] = None, *, fsync_wal: bool = False):
+        self._lib = _load_lib()
+        self._path = path or tempfile.mkdtemp(prefix="tpu3fs-engine-")
+        self._h = self._lib.ce_open(self._path.encode(), int(fsync_wal))
+        if not self._h:
+            raise _err(Code.ENGINE_ERROR, f"ce_open failed for {self._path}")
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def get_meta(self, chunk_id: ChunkId) -> Optional[ChunkMeta]:
+        out = _CMeta()
+        rc = self._lib.ce_get_meta(self._h, chunk_id.to_bytes(), ctypes.byref(out))
+        if rc == -1:
+            return None
+        _check(rc, "get_meta")
+        return _meta_from_c(out)
+
+    def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1) -> bytes:
+        meta = self.get_meta(chunk_id)
+        if meta is None:
+            raise _err(Code.CHUNK_NOT_FOUND, str(chunk_id))
+        # size from the (possibly stale) meta; the C side clamps to this
+        # capacity under its mutex, so a concurrent commit that grows the
+        # chunk can shorten the read but never overrun the buffer
+        cap = meta.length if length < 0 else min(length, 1 << 27)
+        buf = ctypes.create_string_buffer(max(cap, 1))
+        out_len = ctypes.c_int64()
+        rc = self._lib.ce_read(
+            self._h, chunk_id.to_bytes(), buf, len(buf.raw), offset, length,
+            ctypes.byref(out_len),
+        )
+        _check(rc, "read")
+        return buf.raw[: out_len.value]
+
+    def pending_content(self, chunk_id: ChunkId) -> bytes:
+        out = _CMeta()
+        rc = self._lib.ce_get_meta(self._h, chunk_id.to_bytes(), ctypes.byref(out))
+        if rc == -1:
+            return b""
+        _check(rc, "get_meta")
+        cap = max(out.pending_length, out.length, 1)
+        buf = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_int64()
+        rc = self._lib.ce_read_pending(
+            self._h, chunk_id.to_bytes(), buf, len(buf.raw), ctypes.byref(out_len)
+        )
+        _check(rc, "read_pending")
+        return buf.raw[: out_len.value]
+
+    def update(
+        self,
+        chunk_id: ChunkId,
+        update_ver: int,
+        chain_ver: int,
+        data: bytes,
+        offset: int,
+        *,
+        full_replace: bool = False,
+        chunk_size: int,
+    ) -> ChunkMeta:
+        rc = self._lib.ce_update(
+            self._h, chunk_id.to_bytes(), update_ver, chain_ver,
+            bytes(data), len(data), offset, int(full_replace), chunk_size,
+        )
+        _check(rc, "update")
+        return self.get_meta(chunk_id)
+
+    def commit(self, chunk_id: ChunkId, ver: int, chain_ver: int) -> ChunkMeta:
+        rc = self._lib.ce_commit(self._h, chunk_id.to_bytes(), ver, chain_ver)
+        _check(rc, "commit")
+        return self.get_meta(chunk_id)
+
+    def remove(self, chunk_id: ChunkId) -> bool:
+        rc = self._lib.ce_remove(self._h, chunk_id.to_bytes())
+        if rc == -1:
+            return False
+        _check(rc, "remove")
+        return True
+
+    def truncate(self, chunk_id: ChunkId, length: int, chain_ver: int) -> ChunkMeta:
+        rc = self._lib.ce_truncate(self._h, chunk_id.to_bytes(), length, chain_ver)
+        _check(rc, "truncate")
+        return self.get_meta(chunk_id)
+
+    def query(self, prefix: bytes) -> List[ChunkMeta]:
+        count = int(self._lib.ce_chunk_count(self._h))
+        if count == 0:
+            return []
+        arr = (_CMeta * count)()
+        rc = self._lib.ce_query(self._h, prefix, len(prefix), arr, count)
+        _check(rc, "query")
+        return [_meta_from_c(arr[i]) for i in range(rc)]
+
+    def all_metadata(self) -> List[ChunkMeta]:
+        return self.query(b"")
+
+    def used_size(self) -> int:
+        return int(self._lib.ce_used_size(self._h))
+
+    def compact(self) -> None:
+        _check(int(self._lib.ce_compact(self._h)), "compact")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ce_close(self._h)
+            self._h = None
