@@ -1,0 +1,20 @@
+-- ClickHouse schema for tpu3fs metrics (analogue of deploy/sql/3fs-monitor.sql
+-- in the reference). The collector's JSONL sink rows map 1:1 onto this table.
+CREATE TABLE IF NOT EXISTS tpu3fs_monitor.samples
+(
+    `name` LowCardinality(String),
+    `ts` DateTime64(3),
+    `tags` Map(String, String),
+    `value` Float64,
+    `count` UInt64,
+    `min` Float64,
+    `max` Float64,
+    `mean` Float64,
+    `p50` Float64,
+    `p90` Float64,
+    `p99` Float64
+)
+ENGINE = MergeTree
+PARTITION BY toYYYYMMDD(ts)
+ORDER BY (name, ts)
+TTL toDateTime(ts) + INTERVAL 30 DAY;

@@ -1,0 +1,182 @@
+"""Monitor recorders/collector + admin CLI tests."""
+
+import pytest
+
+from tpu3fs.fabric import Fabric, SystemSetupConfig
+from tpu3fs.cli import AdminCli
+from tpu3fs.monitor.collector import (
+    Ack,
+    CollectorService,
+    CollectorSink,
+    SampleBatch,
+    bind_collector_service,
+)
+from tpu3fs.monitor.recorder import (
+    CounterRecorder,
+    DistributionRecorder,
+    LatencyRecorder,
+    MemorySink,
+    Monitor,
+)
+from tpu3fs.rpc.net import RpcClient, RpcServer
+
+
+class TestRecorders:
+    def test_counter_delta_semantics(self):
+        mon = Monitor()
+        c = CounterRecorder("ops", {"svc": "x"}, monitor=mon)
+        c.add(3)
+        c.add(2)
+        samples = mon.collect()
+        assert len(samples) == 1 and samples[0].count == 5
+        assert mon.collect() == []  # reset after collection
+
+    def test_distribution_quantiles(self):
+        mon = Monitor()
+        d = DistributionRecorder("lat", monitor=mon)
+        for v in range(1, 101):
+            d.record(float(v))
+        (s,) = mon.collect()
+        assert s.count == 100 and s.min == 1 and s.max == 100
+        assert 45 <= s.p50 <= 56 and s.p99 >= 95
+
+    def test_latency_recorder_success_failure(self):
+        mon = Monitor()
+        rec = LatencyRecorder("op", monitor=mon)
+        with rec.record():
+            pass
+        try:
+            with rec.record():
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        samples = {s.name: s for s in mon.collect()}
+        assert samples["op.succeeded"].count == 1
+        assert samples["op.failed"].count == 1
+        assert samples["op.latency_us"].count == 2
+
+    def test_storage_ops_emit_metrics(self):
+        sink = MemorySink()
+        Monitor.default().add_sink(sink)
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=2, num_chains=1,
+                                       num_replicas=2, chunk_size=4096))
+        from tpu3fs.storage.types import ChunkId
+
+        sc = fab.storage_client()
+        sc.write_chunk(fab.chain_ids[0], ChunkId(1, 0), 0, b"x", chunk_size=4096)
+        sc.read_chunk(fab.chain_ids[0], ChunkId(1, 0))
+        samples = Monitor.default().collect()
+        names = {s.name for s in samples}
+        assert "storage.write.succeeded" in names
+        assert "storage.read.succeeded" in names
+
+    def test_collector_over_rpc(self):
+        sink = MemorySink()
+        svc = CollectorService(sink)
+        server = RpcServer()
+        bind_collector_service(server, svc)
+        server.start()
+        try:
+            mon = Monitor()
+            c = CounterRecorder("pushed", monitor=mon)
+            c.add(7)
+            mon.add_sink(CollectorSink(server.address, RpcClient()))
+            mon.collect()
+            svc.flush()
+            assert sink.samples and sink.samples[0].name == "pushed"
+            assert sink.samples[0].count == 7
+        finally:
+            server.stop()
+
+
+@pytest.fixture
+def cli():
+    fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=2,
+                                   num_replicas=2, chunk_size=4096))
+    return AdminCli(fab), fab
+
+
+class TestAdminCli:
+    def test_help_lists_commands(self, cli):
+        c, _ = cli
+        out = c.run("help")
+        for cmd in ("list-nodes", "upload-chain", "offline-target", "bench"):
+            assert cmd in out
+
+    def test_cluster_inspection(self, cli):
+        c, fab = cli
+        assert "STORAGE" in c.run("list-nodes")
+        chains = c.run("list-chains")
+        assert str(fab.chain_ids[0]) in chains and "SERVING" in chains
+        assert "SERVING" in c.run("list-targets")
+        assert "table 1" in c.run("list-chain-tables")
+        assert "version" in c.run("routing-info")
+
+    def test_fs_shell_roundtrip(self, cli):
+        c, _ = cli
+        assert "created" in c.run("mkdir -p /a/b")
+        assert "wrote 11 bytes" in c.run('write /a/b/f.txt "hello world"')
+        assert c.run("read /a/b/f.txt") == "hello world"
+        assert "length=11" in c.run("stat /a/b/f.txt")
+        assert "f.txt" in c.run("ls /a/b")
+        assert "crc32c=" in c.run("checksum /a/b/f.txt")
+        c.run("mv /a/b/f.txt /a/g.txt")
+        assert "g.txt" in c.run("ls /a")
+        c.run("rm /a/g.txt")
+        assert "gc reclaimed 1" in c.run("gc-run")
+        assert "files=0" in c.run("stat-fs")
+
+    def test_topology_commands(self, cli):
+        c, fab = cli
+        assert "created" in c.run("create-target --target-id 5000 --node-id 10")
+        assert "5000" in c.run("list-targets")
+
+    def test_offline_target_degrades_chain(self, cli):
+        c, fab = cli
+        chain = fab.routing().chains[fab.chain_ids[0]]
+        victim = chain.targets[-1].target_id
+        out = c.run(f"offline-target --target-id {victim}")
+        assert "offlined" in out
+        assert "OFFLINE" in c.run("list-chains")
+
+    def test_solve_placement_outputs_commands(self, cli):
+        c, _ = cli
+        out = c.run(
+            "solve-placement --nodes 4 --group-size 2 --targets-per-node 2 "
+            "--steps 30"
+        )
+        assert "create-target" in out and "upload-chain-table" in out
+
+    def test_bench_runs(self, cli):
+        c, _ = cli
+        out = c.run("bench --chunks 4 --size 4096")
+        assert "MB/s" in out
+
+    def test_unknown_and_errors(self, cli):
+        c, _ = cli
+        assert "unknown command" in c.run("frobnicate")
+        assert "error:" in c.run("stat /does-not-exist")
+
+
+class TestRobustness:
+    def test_flaky_sink_does_not_stop_collection(self):
+        mon = Monitor()
+
+        class Boom:
+            def write(self, samples):
+                raise RuntimeError("sink down")
+
+        mon.add_sink(Boom())
+        c = CounterRecorder("x", monitor=mon)
+        c.add(1)
+        mon.collect()  # must not raise
+        c.add(2)
+        good = MemorySink()
+        mon.add_sink(good)
+        mon.collect()
+        assert any(s.count == 2 for s in good.samples)
+
+    def test_cli_missing_flags_usage_error(self, cli):
+        c, _ = cli
+        assert "usage error" in c.run("create-target")
+        assert "usage error" in c.run("upload-chain --chain-id 1")

@@ -1,0 +1,89 @@
+"""Placement solver tests: structure validation, balance quality on known
+instances, command generation (mirrors deploy/data_placement tests/usage)."""
+
+import numpy as np
+import pytest
+
+from tpu3fs.placement import (
+    PlacementProblem,
+    check_solution,
+    gen_chain_table_commands,
+    solve_placement,
+)
+from tpu3fs.placement.solver import _score_np, recovery_traffic_factor
+
+
+class TestProblem:
+    def test_group_count_and_bounds(self):
+        p = PlacementProblem(num_nodes=6, group_size=3, targets_per_node=3)
+        assert p.num_groups == 6
+        assert p.lambda_lower_bound == 2  # 6*3*2 / (6*5) = 1.2 -> 2
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            PlacementProblem(num_nodes=5, group_size=3, targets_per_node=1)
+        with pytest.raises(ValueError):
+            PlacementProblem(num_nodes=2, group_size=3, targets_per_node=3)
+
+
+class TestSolve:
+    def test_small_cr_instance(self):
+        p = PlacementProblem(num_nodes=6, group_size=3, targets_per_node=3)
+        M = solve_placement(p, steps=200, seed=0)
+        assert check_solution(M, p)
+        mx, _ = _score_np(M)
+        assert mx <= 2, f"unbalanced: lambda={mx}"
+
+    def test_fano_like_instance(self):
+        # v=7, k=3, r=3, b=7: a (7,3,1)-BIBD (Fano plane) achieves lambda=1
+        p = PlacementProblem(num_nodes=7, group_size=3, targets_per_node=3)
+        assert p.lambda_lower_bound == 1
+        M = solve_placement(p, steps=600, proposals_per_step=256, seed=1)
+        assert check_solution(M, p)
+        mx, _ = _score_np(M)
+        assert mx <= 2  # annealer reaches 1 often; never worse than 2
+
+    def test_ec_style_wide_groups(self):
+        # EC-like: wide groups (k=6) over 12 nodes
+        p = PlacementProblem(num_nodes=12, group_size=6, targets_per_node=3)
+        M = solve_placement(p, steps=200, seed=2)
+        assert check_solution(M, p)
+
+    def test_recovery_traffic_balanced(self):
+        p = PlacementProblem(num_nodes=8, group_size=2, targets_per_node=7)
+        # k=2, r=7, b=28: complete graph — perfectly balanced lambda=1
+        M = solve_placement(p, steps=400, seed=3)
+        assert check_solution(M, p)
+        traffic = recovery_traffic_factor(M, 0)
+        assert traffic.sum() == 7 * (2 - 1)  # r*(k-1) total peer shares
+        assert traffic.max() <= 2
+
+    def test_check_rejects_bad(self):
+        p = PlacementProblem(num_nodes=6, group_size=3, targets_per_node=3)
+        M = solve_placement(p, steps=10)
+        bad = M.copy()
+        bad[0, :] = 0
+        assert not check_solution(bad, p)
+
+
+class TestCommandGen:
+    def test_commands_cover_topology(self):
+        p = PlacementProblem(num_nodes=4, group_size=2, targets_per_node=2)
+        M = solve_placement(p, steps=50)
+        cmds = gen_chain_table_commands(M)
+        creates = [c for c in cmds if c.startswith("create-target")]
+        chains = [c for c in cmds if c.startswith("upload-chain ")]
+        tables = [c for c in cmds if c.startswith("upload-chain-table")]
+        assert len(creates) == p.num_groups * p.group_size
+        assert len(chains) == p.num_groups
+        assert len(tables) == 1
+        assert "--chains 900001" in tables[0]
+
+
+class TestRegressions:
+    def test_full_replication_group_equals_nodes(self):
+        # k == v: every group contains every node (was an infinite loop)
+        p = PlacementProblem(num_nodes=3, group_size=3, targets_per_node=3)
+        M = solve_placement(p, steps=10)
+        assert check_solution(M, p)
+        assert (M == 1).all()

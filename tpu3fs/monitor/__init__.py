@@ -1,0 +1,7 @@
+from tpu3fs.monitor.recorder import (  # noqa: F401
+    CounterRecorder,
+    DistributionRecorder,
+    LatencyRecorder,
+    Monitor,
+    Sample,
+)

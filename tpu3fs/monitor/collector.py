@@ -1,0 +1,73 @@
+"""monitor_collector: the central sample-ingest service + push client.
+
+Re-expresses src/monitor_collector (MonitorCollectorService.h:24-31): every
+server's Monitor pushes Sample batches over RPC; the collector buffers and
+batch-commits (4096 per flush, like the reference) to its sink — JSONL here,
+ClickHouse via deploy/sql/tpu3fs-monitor.sql in a real deployment.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List
+
+from tpu3fs.monitor.recorder import Sample
+from tpu3fs.rpc.net import RpcClient, RpcServer, ServiceDef
+
+COLLECTOR_SERVICE_ID = 5  # ref fbs/monitor_collector
+FLUSH_BATCH = 4096
+
+
+@dataclass
+class SampleBatch:
+    samples: List[Sample] = field(default_factory=list)
+
+
+@dataclass
+class Ack:
+    accepted: int = 0
+
+
+class CollectorService:
+    def __init__(self, sink):
+        self._sink = sink
+        self._buffer: List[Sample] = []
+        self._lock = threading.Lock()
+
+    def write(self, batch: SampleBatch) -> Ack:
+        with self._lock:
+            self._buffer.extend(batch.samples)
+            if len(self._buffer) >= FLUSH_BATCH:
+                self._flush_locked()
+        return Ack(len(batch.samples))
+
+    def _flush_locked(self) -> None:
+        buf, self._buffer = self._buffer, []
+        self._sink.write(buf)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+
+def bind_collector_service(server: RpcServer, service: CollectorService) -> None:
+    s = ServiceDef(COLLECTOR_SERVICE_ID, "MonitorCollector")
+    s.method(1, "write", SampleBatch, Ack, service.write)
+    server.add_service(s)
+
+
+class CollectorSink:
+    """Monitor sink pushing to a remote collector (ref
+    MonitorCollectorClient)."""
+
+    def __init__(self, addr, client: RpcClient | None = None):
+        self._addr = addr
+        self._client = client or RpcClient()
+
+    def write(self, samples: List[Sample]) -> None:
+        if not samples:
+            return
+        self._client.call(
+            self._addr, COLLECTOR_SERVICE_ID, 1, SampleBatch(list(samples)), Ack
+        )

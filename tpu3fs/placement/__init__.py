@@ -1,0 +1,6 @@
+from tpu3fs.placement.solver import (  # noqa: F401
+    PlacementProblem,
+    check_solution,
+    gen_chain_table_commands,
+    solve_placement,
+)

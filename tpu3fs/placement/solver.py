@@ -1,0 +1,232 @@
+"""Chain-table placement: the BIBD integer program, solved on device.
+
+Re-expresses deploy/data_placement/src/model/data_placement.py (a Pyomo MILP
+solved with HiGHS): choose an incidence of v nodes into b chain groups of
+size k, each node serving in exactly r groups, such that the pairwise
+co-occurrence λ[i,j] (how many groups nodes i and j share) is balanced —
+λ bounds the recovery traffic any single peer absorbs when a node fails
+(docs/design_notes.md "Balanced traffic during recovery"; the solver's
+`recovery_traffic_factor` distinguishes "CR" chain-replication from "EC"
+tables, data_placement.py:30,~92).
+
+Instead of a branch-and-bound MILP, the search is a batched annealer: at each
+step a batch of candidate swap moves is scored *in parallel on device* (one
+jitted evaluation of all proposed incidence matrices) and the best accepted —
+the classic simulated-annealing reformulation of BIBD construction, shaped
+for the MXU (scores are b x v matmuls). Falls back to greedy round-robin
+whenever the annealer cannot beat it.
+
+check_solution mirrors the reference's validation; gen_chain_table_commands
+emits the admin command file like deploy/data_placement/src/setup/
+gen_chain_table.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class PlacementProblem:
+    num_nodes: int           # v
+    group_size: int          # k (= replication factor / EC group width)
+    targets_per_node: int    # r
+
+    def __post_init__(self):
+        v, k, r = self.num_nodes, self.group_size, self.targets_per_node
+        if k > v:
+            raise ValueError(f"group size {k} > nodes {v}")
+        if (v * r) % k != 0:
+            raise ValueError(f"v*r={v*r} not divisible by group size {k}")
+
+    @property
+    def num_groups(self) -> int:  # b
+        return self.num_nodes * self.targets_per_node // self.group_size
+
+    @property
+    def lambda_lower_bound(self) -> int:
+        """ceil of average pairwise co-occurrence: b*k*(k-1) / (v*(v-1))."""
+        v, k, b = self.num_nodes, self.group_size, self.num_groups
+        num = b * k * (k - 1)
+        den = v * (v - 1)
+        return -(-num // den)
+
+
+def _greedy_incidence(problem: PlacementProblem) -> np.ndarray:
+    """Round-robin start: group g holds the k consecutive nodes from a
+    rolling cursor (mod v) — k <= v guarantees distinct members."""
+    v, k, b = problem.num_nodes, problem.group_size, problem.num_groups
+    M = np.zeros((b, v), dtype=np.int8)
+    pos = 0
+    for g in range(b):
+        for i in range(k):
+            M[g, (pos + i) % v] = 1
+        pos += k
+    return M
+
+
+def _score_np(M: np.ndarray) -> Tuple[int, int]:
+    C = M.T.astype(np.int32) @ M.astype(np.int32)
+    off = C - np.diag(np.diag(C))
+    return int(off.max()), int((off * off).sum())
+
+
+def solve_placement(
+    problem: PlacementProblem,
+    *,
+    steps: int = 300,
+    proposals_per_step: int = 128,
+    seed: int = 0,
+    target_lambda: Optional[int] = None,
+) -> np.ndarray:
+    """-> incidence matrix (b, v) with row sums k and column sums r."""
+    v, k, b, r = (
+        problem.num_nodes,
+        problem.group_size,
+        problem.num_groups,
+        problem.targets_per_node,
+    )
+    M = _greedy_incidence(problem).astype(np.int8)
+    # column sums may be off after greedy fixup: repair by moving memberships
+    # from overloaded to underloaded nodes
+    for _ in range(v * b):
+        col = M.sum(axis=0)
+        hi, lo = int(np.argmax(col)), int(np.argmin(col))
+        if col[hi] <= r and col[lo] >= r:
+            break
+        # find a group containing hi but not lo
+        for g in range(b):
+            if M[g, hi] and not M[g, lo]:
+                M[g, hi], M[g, lo] = 0, 1
+                break
+    tgt = target_lambda if target_lambda is not None else problem.lambda_lower_bound
+    best_max, best_ssq = _score_np(M)
+    if best_max <= tgt:
+        return M
+
+    P = proposals_per_step
+
+    @jax.jit
+    def score_batch(Ms):
+        # Ms: (P, b, v) int8 -> (max offdiag, ssq offdiag) per proposal
+        C = jnp.einsum("pbv,pbw->pvw", Ms, Ms, preferred_element_type=jnp.int32)
+        eye = jnp.eye(v, dtype=jnp.int32)
+        off = C * (1 - eye)
+        return off.max(axis=(1, 2)), (off * off).sum(axis=(1, 2))
+
+    rng = np.random.default_rng(seed)
+    temperature = 1.0
+    for _step in range(steps):
+        # propose P swap moves: (group g, member out, member in) exchanged
+        # with another group g2 that has `in` but not `out` — preserving both
+        # row and column sums
+        cand = np.repeat(M[None, :, :], P, axis=0)
+        for p in range(P):
+            for _try in range(8):
+                g1, g2 = rng.integers(0, b, 2)
+                if g1 == g2:
+                    continue
+                in_g1 = np.nonzero(cand[p, g1] & ~cand[p, g2])[0]
+                in_g2 = np.nonzero(cand[p, g2] & ~cand[p, g1])[0]
+                if len(in_g1) == 0 or len(in_g2) == 0:
+                    continue
+                a = int(rng.choice(in_g1))
+                c = int(rng.choice(in_g2))
+                cand[p, g1, a], cand[p, g1, c] = 0, 1
+                cand[p, g2, c], cand[p, g2, a] = 0, 1
+                break
+        maxs, ssqs = jax.device_get(score_batch(jnp.asarray(cand)))
+        order = np.lexsort((ssqs, maxs))
+        bi = order[0]
+        accept = (
+            (maxs[bi], ssqs[bi]) < (best_max, best_ssq)
+            or rng.random() < 0.02 * temperature
+        )
+        if accept:
+            M = cand[bi]
+            best_max, best_ssq = int(maxs[bi]), int(ssqs[bi])
+        temperature *= 0.99
+        if best_max <= tgt:
+            break
+    return M
+
+
+def check_solution(
+    M: np.ndarray, problem: PlacementProblem, lambda_max: Optional[int] = None
+) -> bool:
+    """Validate structure + balanced peer recovery traffic (ref
+    check_solution in data_placement.py)."""
+    v, k, b, r = (
+        problem.num_nodes,
+        problem.group_size,
+        problem.num_groups,
+        problem.targets_per_node,
+    )
+    M = np.asarray(M)
+    if M.shape != (b, v):
+        return False
+    if not ((M == 0) | (M == 1)).all():
+        return False
+    if not (M.sum(axis=1) == k).all():
+        return False
+    if not (M.sum(axis=0) == r).all():
+        return False
+    if lambda_max is not None:
+        mx, _ = _score_np(M)
+        if mx > lambda_max:
+            return False
+    return True
+
+
+def recovery_traffic_factor(M: np.ndarray, node: int) -> np.ndarray:
+    """Per-peer share of traffic when `node` fails: co-occurrence row
+    (how many of the failed node's groups each peer serves)."""
+    M = np.asarray(M, dtype=np.int32)
+    C = M.T @ M
+    row = C[node].copy()
+    row[node] = 0
+    return row
+
+
+def gen_chain_table_commands(
+    M: np.ndarray,
+    *,
+    first_target_id: int = 1000,
+    first_chain_id: int = 900_001,
+    table_id: int = 1,
+    node_ids: Optional[List[int]] = None,
+) -> List[str]:
+    """Admin command lines (create-target / upload-chains / upload-chain-table)
+    like the reference's generated command files."""
+    M = np.asarray(M)
+    b, v = M.shape
+    node_ids = node_ids or [10 + i for i in range(v)]
+    lines: List[str] = []
+    chains: List[List[int]] = []
+    tid = first_target_id
+    for g in range(b):
+        members = np.nonzero(M[g])[0]
+        targets = []
+        for n in members:
+            lines.append(
+                f"create-target --target-id {tid} --node-id {node_ids[n]} "
+                f"--chain-id {first_chain_id + g}"
+            )
+            targets.append(tid)
+            tid += 1
+        chains.append(targets)
+    for g, targets in enumerate(chains):
+        lines.append(
+            f"upload-chain --chain-id {first_chain_id + g} --targets "
+            + ",".join(map(str, targets))
+        )
+    lines.append(
+        f"upload-chain-table --table-id {table_id} --chains "
+        + ",".join(str(first_chain_id + g) for g in range(b))
+    )
+    return lines

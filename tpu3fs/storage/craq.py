@@ -141,6 +141,13 @@ class StorageService:
         self._channels = _ChannelTable()
         self._max_forward_retries = max_forward_retries
         self.stopped = False
+        # per-op latency/success metrics (ref monitor::OperationRecorder
+        # usage throughout StorageOperator.cc:87,89,139)
+        from tpu3fs.monitor.recorder import LatencyRecorder
+
+        tags = {"node": str(node_id)}
+        self._write_rec = LatencyRecorder("storage.write", tags)
+        self._read_rec = LatencyRecorder("storage.read", tags)
 
     # -- wiring -------------------------------------------------------------
     def add_target(self, target: StorageTarget) -> None:
@@ -181,6 +188,13 @@ class StorageService:
 
     # -- client write (HEAD only; ref StorageOperator.cc:233-282) ------------
     def write(self, req: WriteReq) -> UpdateReply:
+        with self._write_rec.record() as op:
+            reply = self._write_impl(req)
+            if not reply.ok:
+                op.fail()
+            return reply
+
+    def _write_impl(self, req: WriteReq) -> UpdateReply:
         if self.stopped:
             return UpdateReply(Code.RPC_PEER_CLOSED, message="node stopped")
         try:
@@ -363,6 +377,13 @@ class StorageService:
 
     # -- reads (apportioned; ref batchRead :82-231) ---------------------------
     def read(self, req: ReadReq) -> ReadReply:
+        with self._read_rec.record() as op:
+            reply = self._read_impl(req)
+            if not reply.ok:
+                op.fail()
+            return reply
+
+    def _read_impl(self, req: ReadReq) -> ReadReply:
         if self.stopped:
             return ReadReply(Code.RPC_PEER_CLOSED)
         try:

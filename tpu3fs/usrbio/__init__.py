@@ -1,0 +1,3 @@
+from tpu3fs.usrbio.ring import Iov, IoRing, Sqe, Cqe  # noqa: F401
+from tpu3fs.usrbio.api import UsrbioClient  # noqa: F401
+from tpu3fs.usrbio.agent import UsrbioAgent  # noqa: F401

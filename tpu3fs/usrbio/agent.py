@@ -1,0 +1,140 @@
+"""The USRBIO agent: serves registered rings against the storage cluster.
+
+The FUSE-daemon half of the reference (src/fuse/IovTable.h:10-39 iov
+registration; src/fuse/FuseClients.cc:150,218 — watch threads poll submit
+semaphores, ioRingWorkers run IoRing::process; src/fuse/PioV.cc splits ring
+entries into chunk IOs). Here the agent owns Meta/Storage clients and worker
+threads: each submission wakes a priority lane, SQEs are translated to chunk
+reads/writes through FileIoClient, and data moves directly between the
+chunk store and the client's registered shm buffer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tpu3fs.client.file_io import FileIoClient
+from tpu3fs.meta.store import MetaStore, OpenFlags
+from tpu3fs.meta.types import Inode
+from tpu3fs.usrbio.ring import Iov, IoRing
+from tpu3fs.utils.result import Code, FsError
+
+
+class _RingState:
+    def __init__(self, ring: IoRing, iovs: List[Iov]):
+        self.ring = ring
+        self.iovs = iovs
+        self.worker: Optional[threading.Thread] = None
+        self.running = True
+
+
+class UsrbioAgent:
+    """One agent per host, shared by all local USRBIO clients."""
+
+    def __init__(self, meta: MetaStore, file_client: FileIoClient,
+                 client_id: str = "usrbio-agent"):
+        self._meta = meta
+        self._fio = file_client
+        self._client_id = client_id
+        # fd table (ref hf3fs_reg_fd): small int -> (inode, session)
+        self._fds: Dict[int, Tuple[Inode, str]] = {}
+        self._next_fd = 100
+        self._rings: Dict[str, _RingState] = {}
+        self._lock = threading.Lock()
+
+    # -- control plane (the reference's ClientAgent service, fbs/lib) --------
+    def open(self, path: str, *, write: bool = False) -> int:
+        """Open + register a file; returns the fd for prep_io."""
+        flags = OpenFlags.READ | (OpenFlags.WRITE if write else 0)
+        try:
+            res = self._meta.open(path, flags=flags, client_id=self._client_id)
+        except FsError as e:
+            if e.code == Code.META_NOT_FOUND and write:
+                res = self._meta.create(
+                    path, flags=flags, client_id=self._client_id
+                )
+            else:
+                raise
+        with self._lock:
+            fd = self._next_fd
+            self._next_fd += 1
+            self._fds[fd] = (res.inode, res.session_id)
+        return fd
+
+    def close_fd(self, fd: int, length_hint: Optional[int] = None) -> None:
+        with self._lock:
+            inode, session = self._fds.pop(fd)
+        if session:
+            self._meta.close(inode.id, session, length_hint=length_hint)
+
+    def register_iov(self, name: str, size: int) -> Iov:
+        """Map a client's shm buffer into the agent (ref IovTable.addIov —
+        where the reference also registers it for RDMA)."""
+        return Iov(size, name=name, create=False)
+
+    def register_ring(self, name: str, entries: int, iovs: List[Iov],
+                      *, for_read: bool = True, priority: int = 1) -> None:
+        ring = IoRing(entries, name=name, create=False, for_read=for_read,
+                      priority=priority)
+        state = _RingState(ring, iovs)
+        t = threading.Thread(
+            target=self._ring_worker, args=(state,), daemon=True,
+            name=f"usrbio-{name}",
+        )
+        state.worker = t
+        with self._lock:
+            self._rings[name] = state
+        t.start()
+
+    def deregister_ring(self, name: str) -> None:
+        with self._lock:
+            state = self._rings.pop(name, None)
+        if state is not None:
+            state.running = False
+            state.ring.submit_sem.post()  # wake the worker so it exits
+            if state.worker:
+                state.worker.join(timeout=5)
+            state.ring.close()
+
+    # -- data plane ----------------------------------------------------------
+    def _ring_worker(self, state: _RingState) -> None:
+        ring = state.ring
+        while state.running:
+            if not ring.submit_sem.wait(timeout=0.5):
+                continue
+            if not state.running:
+                return
+            for sqe in ring.drain_sqes():
+                result = self._process_sqe(state, sqe)
+                ring.push_cqe(result, sqe.userdata)
+
+    def _process_sqe(self, state: _RingState, sqe) -> int:
+        """-> bytes moved, or negative Code on failure."""
+        entry = self._fds.get(sqe.fd)
+        if entry is None:
+            return -int(Code.META_NOT_FOUND)
+        inode, _session = entry
+        if sqe.iov_id >= len(state.iovs):
+            return -int(Code.INVALID_ARG)
+        iov = state.iovs[sqe.iov_id]
+        if sqe.iov_offset + sqe.length > iov.size:
+            return -int(Code.INVALID_ARG)
+        try:
+            if sqe.is_read:
+                # refresh length so EOF clamping sees recent writes
+                fresh = self._meta.batch_stat([inode.id])[0]
+                src = fresh if fresh is not None else inode
+                data = self._fio.read(src, sqe.file_offset, sqe.length)
+                iov.write(sqe.iov_offset, data)
+                return len(data)
+            data = iov.read(sqe.iov_offset, sqe.length)
+            written = self._fio.write(inode, sqe.file_offset, data)
+            self._meta.sync(inode.id, length_hint=sqe.file_offset + written)
+            return written
+        except FsError as e:
+            return -int(e.code)
+
+    def stop(self) -> None:
+        for name in list(self._rings):
+            self.deregister_ring(name)

@@ -1,0 +1,242 @@
+"""Shared-memory Iov buffers and SQ/CQ rings — the USRBIO data plane.
+
+Re-expresses the reference's shared-memory machinery (src/fuse/IoRing.h:
+43-264 — submission/completion rings in shm with semaphore wakeups;
+src/lib/common/Shm.cc — user-registered buffers): a client process creates a
+buffer (Iov) and a ring (IoRing) in /dev/shm, hands their names to the agent,
+then submits batched IO by writing SQEs and posting the submit semaphore.
+The agent moves bytes directly between storage and the client's Iov (the
+zero-copy contract the reference implements with RDMA into user shm) and
+posts CQEs + the completion semaphore.
+
+Layouts are fixed C structs (struct module) so non-Python clients can speak
+the ABI.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import uuid
+from typing import Optional
+
+from tpu3fs.usrbio.sem import NamedSemaphore
+
+SHM_DIR = "/dev/shm"
+
+_HDR = struct.Struct("<IIQQQQII")          # magic, entries, sq_head, sq_tail,
+                                           # cq_head, cq_tail, flags, pad
+_SQE = struct.Struct("<QQQiIQIi")          # iov_offset, length, file_offset,
+                                           # fd, flags, userdata, iov_id, pad
+_CQE = struct.Struct("<qQQ")               # result, userdata, reserved
+MAGIC = 0x3F5B10
+SQE_FLAG_READ = 1
+
+HDR_SIZE = 64
+assert _HDR.size <= HDR_SIZE
+
+
+class Iov:
+    """A registered shared-memory buffer (ref hf3fs_iov)."""
+
+    def __init__(self, size: int, name: Optional[str] = None, create: bool = True):
+        self.name = name or f"tpu3fs-iov-{uuid.uuid4().hex[:12]}"
+        self.size = size
+        self.path = os.path.join(SHM_DIR, self.name)
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(self.path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, size)
+            self.buf = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+
+    def write(self, offset: int, data: bytes) -> None:
+        self.buf[offset : offset + len(data)] = data
+
+    def read(self, offset: int, length: int) -> bytes:
+        return bytes(self.buf[offset : offset + length])
+
+    def close(self, unlink: bool = False) -> None:
+        self.buf.close()
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+
+
+class IoRing:
+    """SQ/CQ ring pair in one shm segment + submit/complete semaphores.
+
+    Single-producer SQ (the client), single-consumer agent; monotonically
+    increasing head/tail counters, slot = counter % entries. ``priority``
+    selects which of the agent's priority lanes serves this ring (ref
+    IoRing.h:259-264's three submit semaphores).
+    """
+
+    def __init__(
+        self,
+        entries: int,
+        name: Optional[str] = None,
+        create: bool = True,
+        for_read: bool = True,
+        io_depth: int = 0,
+        priority: int = 1,
+    ):
+        assert entries > 0 and (entries & (entries - 1)) == 0, "entries: power of 2"
+        self.name = name or f"tpu3fs-ior-{uuid.uuid4().hex[:12]}"
+        self.entries = entries
+        self.for_read = for_read
+        self.io_depth = io_depth
+        self.priority = priority
+        self.path = os.path.join(SHM_DIR, self.name)
+        size = HDR_SIZE + entries * (_SQE.size + _CQE.size)
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(self.path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, size)
+            self.buf = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._sq_base = HDR_SIZE
+        self._cq_base = HDR_SIZE + entries * _SQE.size
+        if create:
+            self._write_header(MAGIC, entries, 0, 0, 0, 0, 0)
+        self.submit_sem = NamedSemaphore(f"{self.name}-sq", create=create)
+        self.complete_sem = NamedSemaphore(f"{self.name}-cq", create=create)
+
+    # -- header accessors ----------------------------------------------------
+    def _write_header(self, *vals) -> None:
+        self.buf[: _HDR.size] = _HDR.pack(*vals, 0)
+
+    def _counters(self):
+        magic, entries, sq_h, sq_t, cq_h, cq_t, flags, _ = _HDR.unpack(
+            self.buf[: _HDR.size]
+        )
+        return sq_h, sq_t, cq_h, cq_t
+
+    def _set_counter(self, index: int, value: int) -> None:
+        # counters sit at offsets 8, 16, 24, 32 (8-byte aligned: atomic store)
+        off = 8 + index * 8
+        self.buf[off : off + 8] = struct.pack("<Q", value)
+
+    # -- client side ---------------------------------------------------------
+    def prep_io(
+        self,
+        iov_offset: int,
+        length: int,
+        file_offset: int,
+        fd: int,
+        *,
+        read: bool,
+        userdata: int = 0,
+        iov_id: int = 0,
+    ) -> int:
+        """Queue one SQE; returns its slot or -1 if the ring is full.
+
+        Fullness is measured against cq_head (submitted-but-unreaped), not
+        sq_head: that bounds total in-flight ops at `entries`, which in turn
+        guarantees the agent can never overwrite an unreaped CQE."""
+        sq_h, sq_t, cq_h, _ = self._counters()
+        if sq_t - cq_h >= self.entries:
+            return -1
+        slot = sq_t % self.entries
+        off = self._sq_base + slot * _SQE.size
+        self.buf[off : off + _SQE.size] = _SQE.pack(
+            iov_offset, length, file_offset, fd,
+            SQE_FLAG_READ if read else 0, userdata, iov_id, 0,
+        )
+        self._set_counter(1, sq_t + 1)  # sq_tail
+        return slot
+
+    def submit(self) -> None:
+        """Wake the agent (ref hf3fs_submit_ios: a hint, batching-friendly)."""
+        self.submit_sem.post()
+
+    def wait_for_ios(self, min_results: int, timeout: Optional[float] = None):
+        """Block until >= min_results CQEs have been reaped; returns the
+        accumulated list of (result, userdata) — possibly partial on timeout."""
+        out = []
+        while True:
+            out.extend(self.reap())
+            if len(out) >= min_results:
+                return out
+            if not self.complete_sem.wait(timeout):
+                return out  # timeout: possibly partial
+
+    def reap(self):
+        """Consume all available CQEs (non-blocking)."""
+        _, _, cq_h, cq_t = self._counters()
+        out = []
+        while cq_h < cq_t:
+            slot = cq_h % self.entries
+            off = self._cq_base + slot * _CQE.size
+            result, userdata, _ = _CQE.unpack(self.buf[off : off + _CQE.size])
+            out.append((result, userdata))
+            cq_h += 1
+        self._set_counter(2, cq_h)  # cq_head
+        return out
+
+    # -- agent side ----------------------------------------------------------
+    def drain_sqes(self):
+        """Consume all pending SQEs; returns list of Sqe."""
+        sq_h, sq_t, _, _ = self._counters()
+        out = []
+        while sq_h < sq_t:
+            slot = sq_h % self.entries
+            off = self._sq_base + slot * _SQE.size
+            vals = _SQE.unpack(self.buf[off : off + _SQE.size])
+            out.append(Sqe(*vals[:7]))
+            sq_h += 1
+        self._set_counter(0, sq_h)  # sq_head
+        return out
+
+    def push_cqe(self, result: int, userdata: int) -> None:
+        _, _, cq_h, cq_t = self._counters()
+        slot = cq_t % self.entries
+        off = self._cq_base + slot * _CQE.size
+        self.buf[off : off + _CQE.size] = _CQE.pack(result, userdata, 0)
+        self._set_counter(3, cq_t + 1)  # cq_tail
+        self.complete_sem.post()
+
+    def close(self, unlink: bool = False) -> None:
+        self.buf.close()
+        self.submit_sem.close()
+        self.complete_sem.close()
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+            NamedSemaphore.unlink(f"{self.name}-sq")
+            NamedSemaphore.unlink(f"{self.name}-cq")
+
+
+class Sqe:
+    __slots__ = ("iov_offset", "length", "file_offset", "fd", "flags",
+                 "userdata", "iov_id")
+
+    def __init__(self, iov_offset, length, file_offset, fd, flags, userdata, iov_id):
+        self.iov_offset = iov_offset
+        self.length = length
+        self.file_offset = file_offset
+        self.fd = fd
+        self.flags = flags
+        self.userdata = userdata
+        self.iov_id = iov_id
+
+    @property
+    def is_read(self) -> bool:
+        return bool(self.flags & SQE_FLAG_READ)
+
+
+class Cqe:
+    __slots__ = ("result", "userdata")
+
+    def __init__(self, result, userdata):
+        self.result = result
+        self.userdata = userdata
