@@ -1,0 +1,13 @@
+from tpu3fs.app.application import (
+    AppInfo,
+    ApplicationBase,
+    OnePhaseApplication,
+    TwoPhaseApplication,
+)
+
+__all__ = [
+    "AppInfo",
+    "ApplicationBase",
+    "OnePhaseApplication",
+    "TwoPhaseApplication",
+]

@@ -1,0 +1,305 @@
+"""Application lifecycle: the service-binary skeleton.
+
+Re-expresses the reference's app framework (src/common/app/ApplicationBase,
+TwoPhaseApplication.h:36-103, OnePhaseApplication.h, src/core/app/
+ServerLauncher.h): parse flags -> (two-phase only: launcher registers at
+mgmtd and fetches the node-type config template) -> merge config template
+<- file <- ``--config.k=v`` flag overrides -> init common components
+(logging, monitor) -> build + start the RPC server -> run until stopped.
+
+Two-phase services also run the heartbeat loop: versioned heartbeats carry
+per-target local states up and bring config pushes down (hot-updated in
+place, ref CoreServiceDef.h hotUpdateConfig via heartbeat); a service that
+cannot reach mgmtd for half the failure-declaration timeout stops itself
+(design_notes "Failure detection": suicide at T/2).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu3fs.mgmtd.types import LocalTargetState, NodeType
+from tpu3fs.rpc.net import RpcServer
+from tpu3fs.rpc.services import bind_core_service
+from tpu3fs.utils.config import Config
+from tpu3fs.utils.logging import init_logging, xlog
+
+
+@dataclass
+class AppInfo:
+    """ref flat::AppInfo carried in heartbeats/registration."""
+
+    node_id: int = 0
+    node_type: NodeType = NodeType.CLIENT
+    hostname: str = "127.0.0.1"
+    port: int = 0
+    pid: int = field(default_factory=os.getpid)
+    start_time: float = field(default_factory=time.time)
+
+
+class ApplicationBase:
+    """Common skeleton; subclasses define node_type/default_config and wire
+    their services in build_services()."""
+
+    node_type: NodeType = NodeType.CLIENT
+
+    def __init__(self, argv: Optional[List[str]] = None):
+        self.argv = list(argv or [])
+        self.config = self.default_config()
+        self.info = AppInfo(node_type=self.node_type)
+        self.server: Optional[RpcServer] = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._flags: Dict[str, str] = {}
+        self._parse_argv()
+
+    # -- flags --------------------------------------------------------------
+    def _parse_argv(self) -> None:
+        """--key value pairs, plus --config.dotted=value overrides applied to
+        the config tree (ref TwoPhaseApplication.h:31-33 dynamic overrides)."""
+        rest = self.config.apply_flag_overrides(self.argv)
+        it = iter(rest)
+        for tok in it:
+            if tok.startswith("--"):
+                key = tok[2:]
+                if "=" in key:
+                    key, val = key.split("=", 1)
+                else:
+                    val = next(it, "")
+                self._flags[key.replace("-", "_")] = val
+        if "node_id" in self._flags:
+            self.info.node_id = int(self._flags["node_id"])
+        if "host" in self._flags:
+            self.info.hostname = self._flags["host"]
+        cfg_file = self._flags.get("cfg")
+        if cfg_file:
+            with open(cfg_file) as f:
+                self.config.load_toml(f.read())
+            # flag overrides win over the file (ref initConfig merge order)
+            self.config.apply_flag_overrides(self.argv)
+
+    def flag(self, name: str, default: str = "") -> str:
+        return self._flags.get(name, default)
+
+    # -- subclass hooks -----------------------------------------------------
+    def default_config(self) -> Config:
+        return Config()
+
+    def build_services(self, server: RpcServer) -> None:
+        raise NotImplementedError
+
+    def before_start(self) -> None:
+        """Runs after services are bound, before serving (ref beforeStart)."""
+
+    def after_stop(self) -> None:
+        """Teardown hook (flush engines, close files)."""
+
+    # -- lifecycle ----------------------------------------------------------
+    def init_common_components(self) -> None:
+        """ref initCommonComponents: logging + monitor (IBManager has no TPU
+        analogue; ICI links need no per-process bring-up)."""
+        init_logging(
+            path=self.flag("log_file") or None,
+            level=self.flag("log_level", "INFO"),
+        )
+        xlog("INFO", "%s node %d starting (pid %d)",
+             type(self).__name__, self.info.node_id, self.info.pid)
+
+    def init_server(self) -> None:
+        port = int(self.flag("port", "0"))
+        self.server = RpcServer(self.info.hostname, port)
+        self.info.port = self.server.port
+        bind_core_service(self.server, config=self.config,
+                          on_shutdown=self.stop)
+        self.build_services(self.server)
+
+    def start_server(self) -> None:
+        assert self.server is not None
+        self.before_start()
+        self.server.start()
+        xlog("INFO", "node %d serving on %s:%d",
+             self.info.node_id, self.info.hostname, self.info.port)
+
+    def run(self, *, block: bool = True) -> "ApplicationBase":
+        self.init_common_components()
+        self.init_server()
+        self.start_server()
+        if block:
+            self.wait()
+        return self
+
+    def wait(self) -> None:
+        try:
+            while not self._stop.wait(0.2):
+                pass
+        except KeyboardInterrupt:
+            pass
+        self._shutdown()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def _shutdown(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+        me = threading.current_thread()
+        for t in self._threads:
+            if t is not me:
+                t.join(timeout=2.0)
+        self.after_stop()
+        xlog("INFO", "node %d stopped", self.info.node_id)
+
+    def spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=name, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def run_background(self) -> "ApplicationBase":
+        """Start and return without blocking; caller stops via stop()+join()."""
+        self.run(block=False)
+        self.spawn(self.wait, "app-wait")
+        return self
+
+
+class OnePhaseApplication(ApplicationBase):
+    """Config comes only from the local file + flags (ref
+    OnePhaseApplication.h — mgmtd itself and monitor_collector boot this
+    way: they cannot fetch config from mgmtd)."""
+
+
+class TwoPhaseApplication(ApplicationBase):
+    """Phase 1 (launcher): connect to mgmtd, fetch the node-type config
+    template, register the node. Phase 2: serve + heartbeat loop.
+    ref TwoPhaseApplication.h:36-103 + ServerMgmtdClientFetcher."""
+
+    heartbeat_interval_s: float = 10.0
+    heartbeat_timeout_s: float = 60.0  # T; suicide at T/2 without contact
+
+    def __init__(self, argv: Optional[List[str]] = None):
+        super().__init__(argv)
+        self.mgmtd_client = None  # set in launcher_phase
+        self._hb_version = 0
+        self._config_version = 0
+        self._last_mgmtd_contact = time.time()
+        if self.flag("heartbeat_interval"):
+            self.heartbeat_interval_s = float(self.flag("heartbeat_interval"))
+        if self.flag("heartbeat_timeout"):
+            self.heartbeat_timeout_s = float(self.flag("heartbeat_timeout"))
+
+    def _mgmtd_addr(self):
+        spec = self.flag("mgmtd")
+        if not spec:
+            raise SystemExit("--mgmtd host:port is required")
+        host, port = spec.rsplit(":", 1)
+        return host, int(port)
+
+    def launcher_phase(self) -> None:
+        from tpu3fs.rpc.services import MgmtdAdminRpcClient
+        from tpu3fs.utils.result import FsError
+
+        self.mgmtd_client = MgmtdAdminRpcClient(self._mgmtd_addr())
+        # mgmtd may still be booting; the reference launcher retries its
+        # config fetch too (ServerMgmtdClientFetcher)
+        deadline = time.time() + float(self.flag("launcher_timeout", "30"))
+        while True:
+            try:
+                blob = self.mgmtd_client.get_config(self.node_type)
+                break
+            except FsError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.5)
+        if blob.content:
+            self.config.load_toml(blob.content)
+            self._config_version = blob.version
+            # file + flags still win over the remote template
+            cfg_file = self.flag("cfg")
+            if cfg_file:
+                with open(cfg_file) as f:
+                    self.config.load_toml(f.read())
+            self.config.apply_flag_overrides(self.argv)
+
+    def register(self) -> None:
+        self.mgmtd_client.register_node(
+            self.info.node_id, self.node_type,
+            self.info.hostname, self.info.port,
+        )
+        self._last_mgmtd_contact = time.time()
+
+    # -- heartbeat ----------------------------------------------------------
+    def local_target_states(self) -> Dict[int, LocalTargetState]:
+        """Storage services report per-target states; others report none."""
+        return {}
+
+    def _apply_config_push(self, version: int, content: str) -> None:
+        if version > self._config_version and content:
+            import tomllib
+
+            from tpu3fs.rpc.services import _flatten
+
+            try:
+                self.config.hot_update(_flatten(tomllib.loads(content)))
+                self._config_version = version
+                xlog("INFO", "node %d applied config v%d",
+                     self.info.node_id, version)
+            except Exception as e:
+                xlog("ERR", "node %d config push v%d rejected: %r",
+                     self.info.node_id, version, e)
+
+    def heartbeat_once(self) -> bool:
+        try:
+            self._hb_version += 1
+            reply = self.mgmtd_client.heartbeat(
+                self.info.node_id, self._hb_version,
+                self.local_target_states(),
+            )
+            self._last_mgmtd_contact = time.time()
+            self._apply_config_push(reply.config_version, reply.config_content)
+            return True
+        except Exception as e:
+            xlog("WARN", "node %d heartbeat failed: %r", self.info.node_id, e)
+            return False
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            self.heartbeat_once()
+            silence = time.time() - self._last_mgmtd_contact
+            if silence > self.heartbeat_timeout_s / 2:
+                xlog("ERR",
+                     "node %d lost mgmtd for %.0fs > T/2=%.0fs: exiting "
+                     "(design_notes failure detection)",
+                     self.info.node_id, silence, self.heartbeat_timeout_s / 2)
+                self.stop()
+                return
+
+    def routing(self):
+        return self.mgmtd_client.refresh_routing()
+
+    def _routing_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                self.mgmtd_client.refresh_routing()
+            except Exception:
+                pass
+
+    def run(self, *, block: bool = True) -> "TwoPhaseApplication":
+        self.init_common_components()
+        self.launcher_phase()
+        self.init_server()
+        self.register()
+        self.start_server()
+        self.heartbeat_once()
+        self.spawn(self._heartbeat_loop, "heartbeat")
+        self.spawn(self._routing_loop, "routing-poll")
+        if block:
+            self.wait()
+        return self

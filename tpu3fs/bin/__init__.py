@@ -1,0 +1,4 @@
+"""Service binaries (ref the four deployed mains: src/mgmtd/mgmtd.cpp,
+src/meta/meta.cpp, src/storage/storage.cpp, src/monitor_collector/
+monitor_collector.cpp). Each module exposes ``main(argv)`` and a
+``*App`` class usable in-process by tests and by the cluster runner."""

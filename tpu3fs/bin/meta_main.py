@@ -1,0 +1,102 @@
+"""meta service binary (ref src/meta/meta.cpp).
+
+Two-phase boot; serves the MetaSerde ops over a transactional KV engine.
+File-length-on-close and truncate go through a storage client over the RPC
+messenger (ref src/meta/components/FileHelper.cc queryLastChunk); a GC loop
+drains the deferred-removal queue against storage (ref GcManager background
+scans). The chain allocator follows the chain table published in routing.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from tpu3fs.app.application import TwoPhaseApplication
+from tpu3fs.client.file_io import FileIoClient
+from tpu3fs.client.storage_client import StorageClient
+from tpu3fs.kv.mem import MemKVEngine
+from tpu3fs.meta.store import ChainAllocator, MetaStore
+from tpu3fs.mgmtd.types import NodeType
+from tpu3fs.rpc.net import RpcServer
+from tpu3fs.rpc.services import RpcMessenger, bind_meta_service
+from tpu3fs.utils.config import Config, ConfigItem
+
+
+class MetaAppConfig(Config):
+    chunk_size = ConfigItem(1 << 20)
+    stripe = ConfigItem(1)
+    gc_interval_s = ConfigItem(10.0, hot=True)
+    chain_table_id = ConfigItem(1)
+
+
+class MetaApp(TwoPhaseApplication):
+    node_type = NodeType.META
+
+    def __init__(self, argv: Optional[List[str]] = None, *, engine=None):
+        super().__init__(argv)
+        # NOTE: a real deployment shares one transactional KV across meta
+        # servers (the reference uses FoundationDB); pass a shared engine for
+        # multi-meta setups, else this instance owns a private MemKV.
+        self.engine = engine or MemKVEngine()
+        self.meta: Optional[MetaStore] = None
+        self._fio: Optional[FileIoClient] = None
+
+    def default_config(self) -> Config:
+        return MetaAppConfig()
+
+    def _file_client(self) -> FileIoClient:
+        if self._fio is None:
+            messenger = RpcMessenger(lambda: self.mgmtd_client.routing())
+            sc = StorageClient(
+                f"meta-{self.info.node_id}",
+                lambda: self.mgmtd_client.routing(),
+                messenger,
+            )
+            self._fio = FileIoClient(sc)
+        return self._fio
+
+    def build_services(self, server: RpcServer) -> None:
+        routing = self.mgmtd_client.refresh_routing()
+        table_id = self.config.get("chain_table_id")
+        table = routing.chain_tables.get(table_id)
+        chains = table.chain_ids if table else [1]
+        self.meta = MetaStore(
+            self.engine,
+            ChainAllocator(table_id, chains),
+            file_length_hook=lambda ino: self._file_client().file_length(ino),
+            truncate_hook=lambda ino, ln: self._file_client().truncate_chunks(ino, ln),
+            default_chunk_size=self.config.get("chunk_size"),
+            default_stripe=self.config.get("stripe"),
+        )
+        bind_meta_service(server, self.meta)
+
+    def before_start(self) -> None:
+        self.spawn(self._gc_loop, "meta-gc")
+
+    def run_gc(self) -> int:
+        removed = 0
+        fio = self._file_client()
+        for inode in self.meta.gc_scan():
+            if self.meta.has_sessions(inode.id):
+                continue
+            fio.remove_chunks(inode)
+            self.meta.gc_finish(inode.id)
+            removed += 1
+        return removed
+
+    def _gc_loop(self) -> None:
+        while not self._stop.wait(self.config.get("gc_interval_s")):
+            try:
+                self.run_gc()
+            except Exception:
+                pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    MetaApp(argv if argv is not None else sys.argv[1:]).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""mgmtd service binary (ref src/mgmtd/mgmtd.cpp).
+
+One-phase boot (mgmtd cannot fetch config from itself); holds the cluster KV
+store, serves heartbeat/routing/admin RPCs and runs the background updaters:
+lease extension, heartbeat checking, chain updating (ref
+src/mgmtd/background/{MgmtdLeaseExtender,MgmtdHeartbeatChecker,
+MgmtdChainsUpdater}).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from tpu3fs.app.application import OnePhaseApplication
+from tpu3fs.kv.mem import MemKVEngine
+from tpu3fs.mgmtd.service import Mgmtd, MgmtdConfig
+from tpu3fs.mgmtd.types import NodeType
+from tpu3fs.rpc.net import RpcServer
+from tpu3fs.rpc.services import bind_mgmtd_admin, bind_mgmtd_service
+from tpu3fs.utils.config import Config, ConfigItem
+
+
+class MgmtdAppConfig(Config):
+    lease_length_s = ConfigItem(60.0, hot=True)
+    heartbeat_timeout_s = ConfigItem(60.0, hot=True)
+    tick_interval_s = ConfigItem(5.0, hot=True)
+
+
+class MgmtdApp(OnePhaseApplication):
+    node_type = NodeType.MGMTD
+
+    def __init__(self, argv: Optional[List[str]] = None, *, engine=None,
+                 clock=None):
+        super().__init__(argv)
+        self.engine = engine or MemKVEngine()
+        self._clock_override = clock
+        self.mgmtd: Optional[Mgmtd] = None
+
+    def default_config(self) -> Config:
+        return MgmtdAppConfig()
+
+    def build_services(self, server: RpcServer) -> None:
+        import time as _time
+
+        cfg = MgmtdConfig(
+            lease_length_s=self.config.get("lease_length_s"),
+            heartbeat_timeout_s=self.config.get("heartbeat_timeout_s"),
+        )
+        self.mgmtd = Mgmtd(self.info.node_id or 1, self.engine, cfg,
+                           clock=self._clock_override or _time.time)
+        svc = bind_mgmtd_service(server, self.mgmtd)
+        bind_mgmtd_admin(svc, self.mgmtd)
+
+    def before_start(self) -> None:
+        self.mgmtd.extend_lease()
+        self.spawn(self._tick_loop, "mgmtd-tick")
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self.config.get("tick_interval_s")):
+            try:
+                self.mgmtd.tick()
+            except Exception:
+                pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    MgmtdApp(argv if argv is not None else sys.argv[1:]).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""monitor_collector service binary (ref src/monitor_collector/
+monitor_collector.cpp): receives Sample batches from all services and
+batch-commits them to the analytics sink (JSONL here; the reference writes
+ClickHouse/TaosDB, MonitorCollectorService.h:24-31)."""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from tpu3fs.app.application import OnePhaseApplication
+from tpu3fs.mgmtd.types import NodeType
+from tpu3fs.monitor.collector import CollectorService, bind_collector_service
+from tpu3fs.monitor.recorder import JsonlSink
+from tpu3fs.rpc.net import RpcServer
+from tpu3fs.utils.config import Config, ConfigItem
+
+
+class MonitorAppConfig(Config):
+    out_path = ConfigItem("monitor_samples.jsonl")
+
+
+class MonitorApp(OnePhaseApplication):
+    node_type = NodeType.CLIENT  # monitor nodes are not in the data plane
+
+    def __init__(self, argv: Optional[List[str]] = None, *, sink=None):
+        super().__init__(argv)
+        self._sink = sink
+        self.collector: Optional[CollectorService] = None
+
+    def default_config(self) -> Config:
+        return MonitorAppConfig()
+
+    def build_services(self, server: RpcServer) -> None:
+        sink = self._sink or JsonlSink(self.config.get("out_path"))
+        self.collector = CollectorService(sink)
+        bind_collector_service(server, self.collector)
+
+    def after_stop(self) -> None:
+        if self.collector is not None:
+            self.collector.flush()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    MonitorApp(argv if argv is not None else sys.argv[1:]).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

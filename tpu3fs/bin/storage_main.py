@@ -1,0 +1,132 @@
+"""storage service binary (ref src/storage/storage.cpp:5-8 —
+TwoPhaseApplication<StorageServer>).
+
+Two-phase boot: launcher fetches the STORAGE config template from mgmtd and
+registers the node; beforeStart opens every target assigned to this node in
+routing (ref StorageTargets.create opening every target at
+StorageServer::beforeStart) and keeps discovering new assignments on routing
+refresh. Heartbeats carry per-target local states up; a resync loop pushes
+recovery transfers when this node heads a chain with a syncing successor
+(ref src/storage/sync/ResyncWorker).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional
+
+from tpu3fs.app.application import TwoPhaseApplication
+from tpu3fs.mgmtd.types import LocalTargetState, NodeType
+from tpu3fs.rpc.net import RpcServer
+from tpu3fs.rpc.services import RpcMessenger, bind_storage_service
+from tpu3fs.storage.craq import StorageService
+from tpu3fs.storage.resync import ResyncWorker
+from tpu3fs.storage.target import StorageTarget
+from tpu3fs.utils.config import Config, ConfigItem
+from tpu3fs.utils.logging import xlog
+
+
+class StorageAppConfig(Config):
+    engine = ConfigItem("mem")          # mem | native
+    data_dir = ConfigItem("")           # required for engine=native
+    chunk_size = ConfigItem(1 << 20)
+    resync_interval_s = ConfigItem(5.0, hot=True)
+    target_scan_interval_s = ConfigItem(5.0, hot=True)
+
+
+class StorageApp(TwoPhaseApplication):
+    node_type = NodeType.STORAGE
+
+    def __init__(self, argv: Optional[List[str]] = None):
+        super().__init__(argv)
+        self.service: Optional[StorageService] = None
+
+    def default_config(self) -> Config:
+        return StorageAppConfig()
+
+    def build_services(self, server: RpcServer) -> None:
+        messenger = RpcMessenger(lambda: self.mgmtd_client.routing())
+        self.service = StorageService(
+            self.info.node_id, lambda: self.mgmtd_client.routing(), messenger
+        )
+        bind_storage_service(server, self.service)
+
+    # -- target discovery ---------------------------------------------------
+    def _target_path(self, target_id: int, disk_index: int) -> Optional[str]:
+        base = self.config.get("data_dir")
+        if not base:
+            return None
+        path = os.path.join(base, f"disk{disk_index}", f"target{target_id}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def scan_targets(self) -> int:
+        """Open targets routing assigns to this node (ref StorageTargets
+        create/load at startup + admin create-target afterwards)."""
+        routing = self.mgmtd_client.refresh_routing()
+        added = 0
+        for info in routing.targets.values():
+            if info.node_id != self.info.node_id:
+                continue
+            if self.service.target(info.target_id) is not None:
+                continue
+            if not info.chain_id:
+                continue  # not part of a chain yet
+            target = StorageTarget(
+                info.target_id,
+                info.chain_id,
+                engine=self.config.get("engine"),
+                path=self._target_path(info.target_id, info.disk_index),
+                chunk_size=self.config.get("chunk_size"),
+            )
+            # a target opened on a fresh/possibly stale disk is not
+            # automatically up to date: if its chain already bumped past v1,
+            # report ONLINE and let the resync protocol promote it
+            chain = routing.chains.get(info.chain_id)
+            if chain is not None and chain.chain_version > 1:
+                target.local_state = LocalTargetState.ONLINE
+            self.service.add_target(target)
+            added += 1
+            xlog("INFO", "node %d opened target %d (chain %d, %s)",
+                 self.info.node_id, info.target_id, info.chain_id,
+                 self.config.get("engine"))
+        return added
+
+    def local_target_states(self) -> Dict[int, LocalTargetState]:
+        return {t.target_id: t.local_state for t in self.service.targets()}
+
+    def before_start(self) -> None:
+        self.scan_targets()
+        self.spawn(self._target_scan_loop, "target-scan")
+        self.spawn(self._resync_loop, "resync")
+
+    def _target_scan_loop(self) -> None:
+        while not self._stop.wait(self.config.get("target_scan_interval_s")):
+            try:
+                if self.scan_targets():
+                    self.heartbeat_once()
+            except Exception:
+                pass
+
+    def _resync_loop(self) -> None:
+        worker = None
+        while not self._stop.wait(self.config.get("resync_interval_s")):
+            try:
+                if worker is None:
+                    worker = ResyncWorker(
+                        self.service,
+                        RpcMessenger(lambda: self.mgmtd_client.routing()),
+                    )
+                worker.run_once()
+            except Exception:
+                pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    StorageApp(argv if argv is not None else sys.argv[1:]).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

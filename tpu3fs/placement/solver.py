@@ -92,18 +92,6 @@ def solve_placement(
         problem.targets_per_node,
     )
     M = _greedy_incidence(problem).astype(np.int8)
-    # column sums may be off after greedy fixup: repair by moving memberships
-    # from overloaded to underloaded nodes
-    for _ in range(v * b):
-        col = M.sum(axis=0)
-        hi, lo = int(np.argmax(col)), int(np.argmin(col))
-        if col[hi] <= r and col[lo] >= r:
-            break
-        # find a group containing hi but not lo
-        for g in range(b):
-            if M[g, hi] and not M[g, lo]:
-                M[g, hi], M[g, lo] = 0, 1
-                break
     tgt = target_lambda if target_lambda is not None else problem.lambda_lower_bound
     best_max, best_ssq = _score_np(M)
     if best_max <= tgt:

@@ -185,7 +185,7 @@ class RpcMessenger:
 
 # -- mgmtd ------------------------------------------------------------------
 
-def bind_mgmtd_service(server: RpcServer, mgmtd: Mgmtd) -> None:
+def bind_mgmtd_service(server: RpcServer, mgmtd: Mgmtd) -> ServiceDef:
     s = ServiceDef(MGMTD_SERVICE_ID, "Mgmtd")
 
     def heartbeat(req: HeartbeatReq) -> HeartbeatReply:
@@ -206,6 +206,7 @@ def bind_mgmtd_service(server: RpcServer, mgmtd: Mgmtd) -> None:
     s.method(2, "getRoutingInfo", RoutingReq, RoutingRsp, routing)
     s.method(3, "registerNode", RegisterNodeReq, Empty, register)
     server.add_service(s)
+    return s
 
 
 class MgmtdRpcClient:
@@ -559,3 +560,117 @@ def _flatten(d: dict, prefix: str = "") -> dict:
         else:
             out[f"{prefix}{k}"] = v
     return out
+
+
+# -- mgmtd admin ------------------------------------------------------------
+# Admin half of the Mgmtd service (ref MgmtdServiceDef.h setChainTable/
+# updateChain/setConfig/getConfig ops driven by admin_cli).
+
+@dataclass
+class CreateTargetReq:
+    target_id: int
+    node_id: int = 0
+    disk_index: int = 0
+
+
+@dataclass
+class UploadChainReq:
+    chain_id: int
+    target_ids: List[int] = field(default_factory=list)
+
+
+@dataclass
+class UploadChainTableReq:
+    table_id: int
+    chain_ids: List[int] = field(default_factory=list)
+
+
+@dataclass
+class SetConfigReq:
+    node_type: int
+    content: str = ""
+
+
+@dataclass
+class GetConfigReq:
+    node_type: int
+
+
+@dataclass
+class ConfigRsp:
+    content: str = ""
+    version: int = 0
+
+
+def bind_mgmtd_admin(service: "ServiceDef", mgmtd: Mgmtd) -> None:
+    """Extra admin methods registered on the Mgmtd service table."""
+
+    def create_target(req: CreateTargetReq) -> Empty:
+        mgmtd.create_target(req.target_id, node_id=req.node_id,
+                            disk_index=req.disk_index)
+        return Empty()
+
+    def upload_chain(req: UploadChainReq) -> Empty:
+        mgmtd.upload_chain(req.chain_id, req.target_ids)
+        return Empty()
+
+    def upload_chain_table(req: UploadChainTableReq) -> Empty:
+        mgmtd.upload_chain_table(req.table_id, req.chain_ids)
+        return Empty()
+
+    def set_config(req: SetConfigReq) -> IntReply:
+        return IntReply(mgmtd.set_config(NodeType(req.node_type), req.content))
+
+    def get_config(req: GetConfigReq) -> ConfigRsp:
+        blob = mgmtd.get_config(NodeType(req.node_type))
+        return ConfigRsp(blob.content, blob.version)
+
+    def tick(_r: Empty) -> IntReply:
+        mgmtd.tick()
+        return IntReply(mgmtd.get_routing_info().version)
+
+    service.method(4, "createTarget", CreateTargetReq, Empty, create_target)
+    service.method(5, "uploadChain", UploadChainReq, Empty, upload_chain)
+    service.method(6, "uploadChainTable", UploadChainTableReq, Empty,
+                   upload_chain_table)
+    service.method(7, "setConfig", SetConfigReq, IntReply, set_config)
+    service.method(8, "getConfig", GetConfigReq, ConfigRsp, get_config)
+    service.method(9, "tick", Empty, IntReply, tick)
+
+
+class MgmtdAdminRpcClient(MgmtdRpcClient):
+    """ForAdmin role: same method names as the in-process Mgmtd so AdminCli
+    and launchers work against a live cluster unchanged."""
+
+    def create_target(self, target_id: int, node_id: int = 0,
+                      disk_index: int = 0) -> None:
+        self._client.call(self._addr, MGMTD_SERVICE_ID, 4,
+                          CreateTargetReq(target_id, node_id, disk_index), Empty)
+
+    def upload_chain(self, chain_id: int, target_ids: List[int]) -> None:
+        self._client.call(self._addr, MGMTD_SERVICE_ID, 5,
+                          UploadChainReq(chain_id, list(target_ids)), Empty)
+
+    def upload_chain_table(self, table_id: int, chain_ids: List[int]) -> None:
+        self._client.call(self._addr, MGMTD_SERVICE_ID, 6,
+                          UploadChainTableReq(table_id, list(chain_ids)), Empty)
+
+    def set_config(self, node_type: NodeType, content: str) -> int:
+        return self._client.call(self._addr, MGMTD_SERVICE_ID, 7,
+                                 SetConfigReq(int(node_type), content),
+                                 IntReply).value
+
+    def get_config(self, node_type: NodeType):
+        return self._client.call(self._addr, MGMTD_SERVICE_ID, 8,
+                                 GetConfigReq(int(node_type)), ConfigRsp)
+
+    def tick(self) -> int:
+        return self._client.call(self._addr, MGMTD_SERVICE_ID, 9, Empty(),
+                                 IntReply).value
+
+    def get_routing_info(self, known_version: int = -1):
+        if known_version >= 0:
+            rsp = self._client.call(self._addr, MGMTD_SERVICE_ID, 2,
+                                    RoutingReq(known_version), RoutingRsp)
+            return rsp.routing if rsp.changed else None
+        return self.refresh_routing()

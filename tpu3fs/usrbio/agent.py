@@ -4,9 +4,12 @@ The FUSE-daemon half of the reference (src/fuse/IovTable.h:10-39 iov
 registration; src/fuse/FuseClients.cc:150,218 — watch threads poll submit
 semaphores, ioRingWorkers run IoRing::process; src/fuse/PioV.cc splits ring
 entries into chunk IOs). Here the agent owns Meta/Storage clients and worker
-threads: each submission wakes a priority lane, SQEs are translated to chunk
-reads/writes through FileIoClient, and data moves directly between the
-chunk store and the client's registered shm buffer.
+threads: each ring gets a dedicated worker (the reference multiplexes rings
+over 3 priority-lane semaphores, IoRing.h:259-264; with a worker per ring
+priorities never contend, so the ring's priority is recorded but does not
+schedule), SQEs are translated to chunk reads/writes through FileIoClient,
+and data moves directly between the chunk store and the client's registered
+shm buffer.
 """
 
 from __future__ import annotations
@@ -18,7 +21,7 @@ from tpu3fs.client.file_io import FileIoClient
 from tpu3fs.meta.store import MetaStore, OpenFlags
 from tpu3fs.meta.types import Inode
 from tpu3fs.usrbio.ring import Iov, IoRing
-from tpu3fs.utils.result import Code, FsError
+from tpu3fs.utils.result import Code, FsError, Status
 
 
 class _RingState:
@@ -27,6 +30,9 @@ class _RingState:
         self.iovs = iovs
         self.worker: Optional[threading.Thread] = None
         self.running = True
+        # set when deregister gives up joining a busy worker: the worker
+        # then owns the mapping and closes it on exit
+        self.close_on_exit = False
 
 
 class UsrbioAgent:
@@ -64,7 +70,10 @@ class UsrbioAgent:
 
     def close_fd(self, fd: int, length_hint: Optional[int] = None) -> None:
         with self._lock:
-            inode, session = self._fds.pop(fd)
+            entry = self._fds.pop(fd, None)
+        if entry is None:
+            raise FsError(Status(Code.INVALID_ARG, f"unknown fd {fd}"))
+        inode, session = entry
         if session:
             self._meta.close(inode.id, session, length_hint=length_hint)
 
@@ -95,19 +104,32 @@ class UsrbioAgent:
             state.ring.submit_sem.post()  # wake the worker so it exits
             if state.worker:
                 state.worker.join(timeout=5)
+                if state.worker.is_alive():
+                    # worker is mid-IO (slow storage op); closing the mmap
+                    # under it would crash the thread and drop the in-flight
+                    # completion — hand it the mapping to close on exit
+                    state.close_on_exit = True
+                    return
             state.ring.close()
 
     # -- data plane ----------------------------------------------------------
     def _ring_worker(self, state: _RingState) -> None:
         ring = state.ring
-        while state.running:
-            if not ring.submit_sem.wait(timeout=0.5):
-                continue
-            if not state.running:
-                return
-            for sqe in ring.drain_sqes():
-                result = self._process_sqe(state, sqe)
-                ring.push_cqe(result, sqe.userdata)
+        try:
+            while state.running:
+                if not ring.submit_sem.wait(timeout=0.5):
+                    continue
+                if not state.running:
+                    return
+                for sqe in ring.drain_sqes():
+                    result = self._process_sqe(state, sqe)
+                    ring.push_cqe(result, sqe.userdata)
+        except ValueError:
+            # ring mmap closed under us during deregistration: exit quietly
+            return
+        finally:
+            if state.close_on_exit:
+                state.ring.close()
 
     def _process_sqe(self, state: _RingState, sqe) -> int:
         """-> bytes moved, or negative Code on failure."""
@@ -134,6 +156,10 @@ class UsrbioAgent:
             return written
         except FsError as e:
             return -int(e.code)
+        except Exception:
+            # transport/storage faults must surface as a CQE error, never
+            # kill the ring worker (clients would block forever)
+            return -int(Code.INTERNAL)
 
     def stop(self) -> None:
         for name in list(self._rings):

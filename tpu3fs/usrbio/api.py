@@ -87,6 +87,9 @@ class UsrbioClient:
     def iordestroy(self, ior: IoRing) -> None:
         self._agent.deregister_ring(ior.name)
         self._ring_iovs.pop(ior.name, None)
+        # the client side owns the shm segment + named semaphores: unlink
+        # here or each create/destroy cycle leaks /dev/shm entries
+        ior.close(unlink=True)
 
     def iovdestroy(self, iov: Iov) -> None:
         iov.close(unlink=True)
