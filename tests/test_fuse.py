@@ -1,0 +1,196 @@
+"""FUSE layer tests: the ops table driven directly over the in-process
+fabric (tier-1/2 of SURVEY §4), and — where the environment allows a real
+kernel mount — an end-to-end mounted-filesystem test (the analogue of
+tests/fuse/fuse_test_ci.py)."""
+
+import errno
+import os
+import stat
+import subprocess
+import tempfile
+
+import pytest
+
+from tpu3fs.fabric.fabric import Fabric
+from tpu3fs.fuse.ops import VIRT_DIR, FuseOps, fs_errno
+from tpu3fs.usrbio.agent import UsrbioAgent
+from tpu3fs.usrbio.ring import Iov, IoRing
+from tpu3fs.utils.result import FsError
+
+
+@pytest.fixture
+def fuse_ops():
+    fab = Fabric()
+    fio = fab.file_client()
+    agent = UsrbioAgent(fab.meta, fio)
+    ops = FuseOps(fab.meta, fio, agent)
+    yield ops
+    ops.destroy()
+
+
+class TestFuseOps:
+    def test_create_write_read_release(self, fuse_ops):
+        o = fuse_ops
+        o.mkdir("/d", 0o750)
+        fh = o.create("/d/f", 0o640)
+        data = b"kernel-visible bytes " * 1000
+        assert o.write(fh, 0, data) == len(data)
+        assert o.read(fh, 0, len(data)) == data
+        o.release(fh)
+        attr = o.getattr("/d/f")
+        assert attr.size == len(data)
+        assert stat.S_ISREG(attr.mode)
+        assert attr.mode & 0o7777 == 0o640
+
+    def test_readdir_includes_virt_root(self, fuse_ops):
+        names = [n for n, _ in fuse_ops.readdir("/")]
+        assert VIRT_DIR in names
+        virt = [n for n, _ in fuse_ops.readdir("/" + VIRT_DIR)]
+        assert sorted(virt) == ["iors", "iovs"]
+
+    def test_namespace_ops(self, fuse_ops):
+        o = fuse_ops
+        o.mkdir("/a", 0o755)
+        fh = o.create("/a/x", 0o644)
+        o.write(fh, 0, b"payload")
+        o.release(fh)
+        o.link("/a/x", "/a/y")
+        assert o.getattr("/a/y").size == 7
+        o.rename("/a/y", "/a/z")
+        o.symlink("/a/z", "/a/sym")
+        assert o.readlink("/a/sym") == "/a/z"
+        o.unlink("/a/sym")
+        with pytest.raises(FsError) as ei:
+            o.getattr("/a/sym")
+        assert fs_errno(ei.value) == errno.ENOENT
+        o.unlink("/a/z")
+        o.unlink("/a/x")
+        o.rmdir("/a")
+
+    def test_open_trunc_and_setattr(self, fuse_ops):
+        o = fuse_ops
+        fh = o.create("/t", 0o644)
+        o.write(fh, 0, b"0123456789")
+        o.release(fh)
+        o.truncate("/t", 4)
+        assert o.getattr("/t").size == 4
+        fh2 = o.open("/t", os.O_RDWR | os.O_TRUNC)
+        o.release(fh2)
+        assert o.getattr("/t").size == 0
+        o.chmod("/t", 0o600)
+        assert o.getattr("/t").mode & 0o7777 == 0o600
+        o.chown("/t", 12, 34)
+        a = o.getattr("/t")
+        assert (a.uid, a.gid) == (12, 34)
+        o.utimens("/t", 100.0, 200.0)
+        a = o.getattr("/t")
+        assert (round(a.atime), round(a.mtime)) == (100, 200)
+
+    def test_truncate_not_resurrected_by_close(self, fuse_ops):
+        """Truncating below an open handle's high-water mark must stick:
+        release's length hint may not resurrect the pre-truncate length."""
+        o = fuse_ops
+        fh = o.create("/shrink", 0o644)
+        o.write(fh, 0, b"0123456789")
+        o.truncate("/shrink", 4)
+        o.release(fh)
+        assert o.getattr("/shrink").size == 4
+
+    def test_utimens_omit_leaves_field(self, fuse_ops):
+        o = fuse_ops
+        fh = o.create("/times", 0o644)
+        o.release(fh)
+        o.utimens("/times", 100.0, 200.0)
+        o.utimens("/times", None, 300.0)  # UTIME_OMIT on atime
+        a = o.getattr("/times")
+        assert (round(a.atime), round(a.mtime)) == (100, 300)
+
+    def test_write_on_readonly_fh_rejected(self, fuse_ops):
+        o = fuse_ops
+        fh = o.create("/ro", 0o644)
+        o.release(fh)
+        fh2 = o.open("/ro", os.O_RDONLY)
+        with pytest.raises(FsError) as ei:
+            o.write(fh2, 0, b"x")
+        assert fs_errno(ei.value) == errno.EACCES
+        o.release(fh2)
+
+    def test_virt_iov_ring_registration(self, fuse_ops):
+        o = fuse_ops
+        iov = Iov(1 << 16, create=True)
+        ring = IoRing(16, create=True, for_read=False)
+        try:
+            o.symlink(iov.name, f"/{VIRT_DIR}/iovs/v0")
+            target = (f"{ring.name}?entries=16&rw=w&prio=1&iov=v0")
+            o.symlink(target, f"/{VIRT_DIR}/iors/r0")
+            names = [n for n, _ in o.readdir(f"/{VIRT_DIR}/iovs")]
+            assert "v0" in names
+
+            # drive one write SQE through the registered ring
+            fh = o.create("/ub.dat", 0o644)
+            o.release(fh)
+            agent_fd = o._agent.open("/ub.dat", write=True)
+            iov.write(0, b"ring-write!")
+            ring.prep_io(0, 11, 0, agent_fd, read=False, userdata=5)
+            ring.submit()
+            res = ring.wait_for_ios(1, timeout=10)
+            assert res == [(11, 5)]
+            assert o.read(o.open("/ub.dat", os.O_RDONLY), 0, 11) == b"ring-write!"
+
+            o.unlink(f"/{VIRT_DIR}/iors/r0")
+            o.unlink(f"/{VIRT_DIR}/iovs/v0")
+        finally:
+            ring.close(unlink=True)
+            iov.close(unlink=True)
+
+    def test_statfs(self, fuse_ops):
+        info = fuse_ops.statfs()
+        assert info["f_bsize"] > 0
+
+
+def _can_mount() -> bool:
+    if not os.path.exists("/dev/fuse"):
+        return False
+    try:
+        import ctypes
+
+        ctypes.CDLL("libfuse.so.2")
+    except OSError:
+        return False
+    return True
+
+
+@pytest.mark.skipif(not _can_mount(), reason="no /dev/fuse or libfuse2")
+class TestKernelMount:
+    def test_mounted_filesystem_end_to_end(self):
+        from tpu3fs.fuse.mount import FuseMount
+
+        fab = Fabric()
+        ops = FuseOps(fab.meta, fab.file_client(),
+                      UsrbioAgent(fab.meta, fab.file_client()))
+        mnt = tempfile.mkdtemp(prefix="tpu3fs-mnt-")
+        m = FuseMount(ops, mnt)
+        m.mount()
+        if not m.wait_mounted(timeout=15):
+            pytest.skip(f"kernel mount failed (exit {m.exit_code}); "
+                        "environment forbids FUSE mounts")
+        try:
+            os.makedirs(f"{mnt}/dir/sub")
+            with open(f"{mnt}/dir/sub/file.bin", "wb") as f:
+                f.write(b"abc" * 100_000)
+            with open(f"{mnt}/dir/sub/file.bin", "rb") as f:
+                assert f.read() == b"abc" * 100_000
+            assert os.path.getsize(f"{mnt}/dir/sub/file.bin") == 300_000
+            os.rename(f"{mnt}/dir/sub/file.bin", f"{mnt}/dir/moved.bin")
+            assert sorted(os.listdir(f"{mnt}/dir")) == ["moved.bin", "sub"]
+            os.symlink("moved.bin", f"{mnt}/dir/ln")
+            assert os.readlink(f"{mnt}/dir/ln") == "moved.bin"
+            st = os.statvfs(mnt)
+            assert st.f_bsize > 0
+            assert os.path.isdir(f"{mnt}/{VIRT_DIR}/iovs")
+            os.remove(f"{mnt}/dir/ln")
+            os.remove(f"{mnt}/dir/moved.bin")
+        finally:
+            m.unmount()
+            subprocess.run(["fusermount", "-u", "-z", mnt],
+                           check=False, capture_output=True)

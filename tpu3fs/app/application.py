@@ -125,11 +125,22 @@ class ApplicationBase:
         xlog("INFO", "node %d serving on %s:%d",
              self.info.node_id, self.info.hostname, self.info.port)
 
+    def _install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful stop (unmount, close sessions). Only
+        possible from the main thread; in-process tests skip this."""
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: self.stop())
+
     def run(self, *, block: bool = True) -> "ApplicationBase":
         self.init_common_components()
         self.init_server()
         self.start_server()
         if block:
+            self._install_signal_handlers()
             self.wait()
         return self
 
@@ -301,5 +312,6 @@ class TwoPhaseApplication(ApplicationBase):
         self.spawn(self._heartbeat_loop, "heartbeat")
         self.spawn(self._routing_loop, "routing-poll")
         if block:
+            self._install_signal_handlers()
             self.wait()
         return self

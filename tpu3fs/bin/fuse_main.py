@@ -1,0 +1,83 @@
+"""FUSE daemon binary (ref src/fuse/hf3fs_fuse.cpp + FuseClients.h:179-239).
+
+Two-phase boot as a FUSE node: builds the mgmtd/meta/storage client stack
+(the reference's FuseClients singleton), a USRBIO agent for 3fs-virt ring
+registration, then mounts FuseOps at --mountpoint through libfuse.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from tpu3fs.app.application import TwoPhaseApplication
+from tpu3fs.client.file_io import FileIoClient
+from tpu3fs.client.storage_client import StorageClient
+from tpu3fs.fuse.mount import FuseMount
+from tpu3fs.fuse.ops import FuseOps
+from tpu3fs.mgmtd.types import NodeType
+from tpu3fs.rpc.net import RpcServer
+from tpu3fs.rpc.services import MetaRpcClient, RpcMessenger
+from tpu3fs.usrbio.agent import UsrbioAgent
+from tpu3fs.utils.config import Config, ConfigItem
+from tpu3fs.utils.logging import xlog
+
+
+class FuseAppConfig(Config):
+    mountpoint = ConfigItem("")
+    fsname = ConfigItem("tpu3fs")
+
+
+class FuseApp(TwoPhaseApplication):
+    node_type = NodeType.FUSE
+
+    def __init__(self, argv: Optional[List[str]] = None):
+        super().__init__(argv)
+        self.fuse: Optional[FuseMount] = None
+        self.ops: Optional[FuseOps] = None
+
+    def default_config(self) -> Config:
+        return FuseAppConfig()
+
+    def build_services(self, server: RpcServer) -> None:
+        routing = self.mgmtd_client.refresh_routing()
+        meta_addrs = [
+            (n.host, n.port) for n in routing.nodes.values()
+            if n.type == NodeType.META and n.port
+        ]
+        if not meta_addrs:
+            raise SystemExit("no meta servers in routing info")
+        meta = MetaRpcClient(meta_addrs,
+                             client_id=f"fuse-{self.info.node_id}")
+        fio = FileIoClient(StorageClient(
+            f"fuse-{self.info.node_id}",
+            lambda: self.mgmtd_client.routing(),
+            RpcMessenger(lambda: self.mgmtd_client.routing()),
+        ))
+        agent = UsrbioAgent(meta, fio, client_id=f"fuse-{self.info.node_id}")
+        self.ops = FuseOps(meta, fio, agent)
+
+    def before_start(self) -> None:
+        mountpoint = self.flag("mountpoint") or self.config.get("mountpoint")
+        if not mountpoint:
+            raise SystemExit("--mountpoint is required")
+        self.fuse = FuseMount(self.ops, mountpoint,
+                              fsname=self.config.get("fsname"))
+        self.fuse.mount()
+        if not self.fuse.wait_mounted():
+            raise SystemExit(f"mount at {mountpoint} failed "
+                             f"(exit {self.fuse.exit_code})")
+        xlog("INFO", "fuse mounted at %s", mountpoint)
+
+    def after_stop(self) -> None:
+        if self.fuse is not None:
+            self.fuse.unmount()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    FuseApp(argv if argv is not None else sys.argv[1:]).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

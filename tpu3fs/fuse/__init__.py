@@ -1,0 +1,3 @@
+from tpu3fs.fuse.ops import FuseOps, VIRT_DIR
+
+__all__ = ["FuseOps", "VIRT_DIR"]

@@ -447,9 +447,14 @@ class MetaStore:
         length_hint: Optional[int] = None,
         client_id: str = "",
         request_id: str = "",
+        wrote: Optional[bool] = None,
     ) -> Inode:
         """Close a write session; settle the precise file length
-        (ref src/meta/store/ops/Close; FileHelper queryLastChunk)."""
+        (ref src/meta/store/ops/Close; FileHelper queryLastChunk).
+
+        mtime only moves if the session wrote (wrote=True, or unspecified
+        with a length hint present) — a read-only open+close must not look
+        like a modification."""
 
         def op(txn: ITransaction) -> Inode:
             if request_id:
@@ -469,7 +474,8 @@ class MetaStore:
                     inode.length = self._file_length_hook(inode)
                 elif length_hint is not None:
                     inode.length = max(inode.length, length_hint)
-                inode.mtime = time.time()
+                if wrote or (wrote is None and length_hint is not None):
+                    inode.mtime = time.time()
                 self._store_inode(txn, inode)
             if request_id:
                 txn.set(idempotent_key(client_id, request_id), serialize(inode))

@@ -289,6 +289,7 @@ class CloseReq:
     length_hint: int = -1
     client_id: str = ""
     request_id: str = ""
+    wrote: int = -1  # -1 unknown, 0 read-only session, 1 wrote
 
 
 @dataclass
@@ -356,6 +357,11 @@ class SetAttrReq:
     perm: int = -1
     new_uid: int = -1
     new_gid: int = -1
+    # explicit has_* flags: negative times are legitimate (pre-epoch)
+    atime: float = 0.0
+    mtime: float = 0.0
+    has_atime: bool = False
+    has_mtime: bool = False
 
 
 @dataclass
@@ -432,7 +438,8 @@ def bind_meta_service(server: RpcServer, meta: MetaStore) -> None:
     s.method(10, "close", CloseReq, InodeRsp, lambda r: InodeRsp(meta.close(
         r.inode_id, r.session_id,
         length_hint=None if r.length_hint < 0 else r.length_hint,
-        client_id=r.client_id, request_id=r.request_id)))
+        client_id=r.client_id, request_id=r.request_id,
+        wrote=None if r.wrote < 0 else bool(r.wrote))))
     s.method(11, "rename", RenameReq, Empty,
              lambda r: (meta.rename(r.src, r.dst, u(r)), Empty())[1])
     s.method(12, "list", ListReq, ListRsp, lambda r: ListRsp(
@@ -445,7 +452,9 @@ def bind_meta_service(server: RpcServer, meta: MetaStore) -> None:
         meta.set_attr(r.path, u(r),
                       perm=None if r.perm < 0 else r.perm,
                       uid=None if r.new_uid < 0 else r.new_uid,
-                      gid=None if r.new_gid < 0 else r.new_gid)))
+                      gid=None if r.new_gid < 0 else r.new_gid,
+                      atime=r.atime if r.has_atime else None,
+                      mtime=r.mtime if r.has_mtime else None)))
     s.method(16, "pruneSession", PruneSessionReq, IntReply,
              lambda r: IntReply(meta.prune_session(r.client_id)))
     s.method(17, "batchStat", BatchStatReq, BatchStatRsp,
@@ -494,7 +503,8 @@ class MetaRpcClient:
         return self._call(2, PathReq(path, follow=follow), InodeRsp).inode
 
     def create(self, path: str, **kw) -> OpenRsp:
-        return self._call(3, CreateReq(path, client_id=self.client_id, **kw), OpenRsp)
+        kw.setdefault("client_id", self.client_id)
+        return self._call(3, CreateReq(path, **kw), OpenRsp)
 
     def mkdirs(self, path: str, recursive: bool = False) -> Inode:
         return self._call(4, MkdirsReq(path, recursive=recursive), InodeRsp).inode
@@ -503,13 +513,55 @@ class MetaRpcClient:
         self._call(7, RemoveReq(path, recursive=recursive,
                                 client_id=self.client_id, request_id=request_id), Empty)
 
-    def open(self, path: str, flags: int = 1) -> OpenRsp:
-        return self._call(8, OpenReq(path, flags=flags, client_id=self.client_id), OpenRsp)
+    def open(self, path: str, flags: int = 1,
+             client_id: Optional[str] = None) -> OpenRsp:
+        return self._call(8, OpenReq(path, flags=flags,
+                                     client_id=client_id or self.client_id),
+                          OpenRsp)
 
-    def close(self, inode_id: int, session_id: str, length_hint: int = -1,
-              request_id: str = "") -> Inode:
-        return self._call(10, CloseReq(inode_id, session_id, length_hint,
-                                       self.client_id, request_id), InodeRsp).inode
+    def close(self, inode_id: int, session_id: str,
+              length_hint: Optional[int] = None,
+              request_id: str = "", wrote: Optional[bool] = None) -> Inode:
+        hint = -1 if length_hint is None else length_hint
+        w = -1 if wrote is None else int(wrote)
+        return self._call(10, CloseReq(inode_id, session_id, hint,
+                                       self.client_id, request_id, w),
+                          InodeRsp).inode
+
+    def symlink(self, path: str, target: str) -> Inode:
+        return self._call(5, SymlinkReq(path, target), InodeRsp).inode
+
+    def hard_link(self, src: str, dst: str) -> Inode:
+        return self._call(6, HardLinkReq(src, dst), InodeRsp).inode
+
+    def sync(self, inode_id: int, length_hint: Optional[int] = None) -> Inode:
+        hint = -1 if length_hint is None else length_hint
+        return self._call(9, SyncReq(inode_id, hint), InodeRsp).inode
+
+    def truncate(self, path: str, length: int) -> Inode:
+        return self._call(13, TruncateReq(path, length), InodeRsp).inode
+
+    def set_attr(self, path: str, *, perm: Optional[int] = None,
+                 uid: Optional[int] = None, gid: Optional[int] = None,
+                 atime: Optional[float] = None,
+                 mtime: Optional[float] = None) -> Inode:
+        req = SetAttrReq(
+            path,
+            perm=-1 if perm is None else perm,
+            new_uid=-1 if uid is None else uid,
+            new_gid=-1 if gid is None else gid,
+            atime=atime or 0.0,
+            mtime=mtime or 0.0,
+            has_atime=atime is not None,
+            has_mtime=mtime is not None,
+        )
+        return self._call(15, req, InodeRsp).inode
+
+    def prune_session(self, client_id: str) -> int:
+        return self._call(16, PruneSessionReq(client_id), IntReply).value
+
+    def batch_stat(self, inode_ids: List[int]) -> List[Optional[Inode]]:
+        return self._call(17, BatchStatReq(list(inode_ids)), BatchStatRsp).inodes
 
     def rename(self, src: str, dst: str) -> None:
         self._call(11, RenameReq(src, dst), Empty)
