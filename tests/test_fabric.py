@@ -246,6 +246,18 @@ class TestFileEndToEnd:
         # sparse read past EOF returns short data
         assert fio.read(inode2, len(blob) - 100, 500)[:100] == blob[-100:]
 
+    def test_stat_fs_reports_cluster_space(self, fab):
+        fio = fab.file_client()
+        res = fab.meta.create("/sp", flags=OpenFlags.WRITE, client_id="c")
+        fio.write(res.inode, 0, b"q" * 9000)
+        fab.meta.close(res.inode.id, res.session_id)
+        sf = fab.meta.stat_fs()
+        assert sf.capacity > 0
+        # physical usage counts every replica of every chunk
+        assert sf.used >= 9000
+        assert sf.used < sf.capacity
+        assert sf.files == 1
+
     def test_length_settles_via_storage_query(self, fab):
         fio = fab.file_client()
         res = fab.meta.create("/f", flags=OpenFlags.WRITE, client_id="c")

@@ -42,6 +42,28 @@ class TestFuseOps:
         assert stat.S_ISREG(attr.mode)
         assert attr.mode & 0o7777 == 0o640
 
+    def test_read_after_write_same_handle_extends_past_meta_length(
+            self, fuse_ops):
+        # meta only settles length at sync/close; a read through the same
+        # handle must still see bytes written past the stale meta length
+        o = fuse_ops
+        fh = o.create("/raw", 0o644)
+        o.write(fh, 0, b"0123456789")
+        o.release(fh)
+        fh2 = o.open("/raw", os.O_RDWR)
+        o.write(fh2, 10, b"abcdefghij")
+        assert o.read(fh2, 0, 20) == b"0123456789abcdefghij"
+        o.release(fh2)
+
+    def test_statfs_reports_free_space_and_inodes(self, fuse_ops):
+        o = fuse_ops
+        fh = o.create("/sf", 0o644)
+        o.write(fh, 0, b"x" * 1024)
+        o.release(fh)
+        sf = o.statfs()
+        assert sf["f_bfree"] > 0
+        assert sf["f_files"] >= 1
+
     def test_readdir_includes_virt_root(self, fuse_ops):
         names = [n for n, _ in fuse_ops.readdir("/")]
         assert VIRT_DIR in names

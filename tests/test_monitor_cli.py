@@ -126,6 +126,16 @@ class TestAdminCli:
         assert "gc reclaimed 1" in c.run("gc-run")
         assert "files=0" in c.run("stat-fs")
 
+    def test_cli_write_moves_mtime(self, cli):
+        import time as _time
+
+        c, fab = cli
+        c.run('write /m.txt "one"')
+        m1 = fab.meta.stat("/m.txt").mtime
+        _time.sleep(0.02)
+        c.run('write /m.txt "two"')
+        assert fab.meta.stat("/m.txt").mtime > m1
+
     def test_topology_commands(self, cli):
         c, fab = cli
         assert "created" in c.run("create-target --target-id 5000 --node-id 10")

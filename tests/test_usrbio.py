@@ -116,6 +116,35 @@ class TestUsrbioEndToEnd:
         client.iordestroy(ring)
         client.iovdestroy(iov)
 
+    def test_close_fd_moves_mtime_only_after_writes(self, cluster):
+        import time as _time
+
+        fab, agent, client = cluster
+        iov = client.iovcreate(4096)
+        ring = client.iorcreate(8, [iov], for_read=False)
+        fd = client.reg_fd("/mt.bin", write=True)
+        iov.write(0, b"data")
+        client.prep_io(ring, iov, 0, 4, fd, 0, read=False)
+        client.submit_ios(ring)
+        client.wait_for_ios(ring, 1, timeout=5)
+        client.dereg_fd(fd, length_hint=4)
+        m1 = fab.meta.stat("/mt.bin").mtime
+        # read-only open+close must not look like a modification
+        _time.sleep(0.02)
+        fd = client.reg_fd("/mt.bin")
+        client.dereg_fd(fd)
+        assert fab.meta.stat("/mt.bin").mtime == m1
+        # another write session must move it
+        _time.sleep(0.02)
+        fd = client.reg_fd("/mt.bin", write=True)
+        client.prep_io(ring, iov, 0, 4, fd, 4, read=False)
+        client.submit_ios(ring)
+        client.wait_for_ios(ring, 1, timeout=5)
+        client.dereg_fd(fd, length_hint=8)
+        assert fab.meta.stat("/mt.bin").mtime > m1
+        client.iordestroy(ring)
+        client.iovdestroy(iov)
+
     def test_bad_fd_reports_error_cqe(self, cluster):
         fab, agent, client = cluster
         iov = client.iovcreate(4096)

@@ -26,6 +26,9 @@ from tpu3fs.utils.logging import xlog
 class FuseAppConfig(Config):
     mountpoint = ConfigItem("")
     fsname = ConfigItem("tpu3fs")
+    # shared mounts want allow_other, but non-root mounts need
+    # user_allow_other in /etc/fuse.conf — so it must be switchable
+    allow_other = ConfigItem(False)
 
 
 class FuseApp(TwoPhaseApplication):
@@ -62,7 +65,8 @@ class FuseApp(TwoPhaseApplication):
         if not mountpoint:
             raise SystemExit("--mountpoint is required")
         self.fuse = FuseMount(self.ops, mountpoint,
-                              fsname=self.config.get("fsname"))
+                              fsname=self.config.get("fsname"),
+                              allow_other=self.config.get("allow_other"))
         self.fuse.mount()
         if not self.fuse.wait_mounted():
             raise SystemExit(f"mount at {mountpoint} failed "

@@ -56,6 +56,10 @@ class MetaApp(TwoPhaseApplication):
             self._fio = FileIoClient(sc)
         return self._fio
 
+    def _cluster_space(self):
+        si = self._file_client().storage.space_info()
+        return si.capacity, si.used
+
     def build_services(self, server: RpcServer) -> None:
         routing = self.mgmtd_client.refresh_routing()
         table_id = self.config.get("chain_table_id")
@@ -66,6 +70,7 @@ class MetaApp(TwoPhaseApplication):
             ChainAllocator(table_id, chains),
             file_length_hook=lambda ino: self._file_client().file_length(ino),
             truncate_hook=lambda ino, ln: self._file_client().truncate_chunks(ino, ln),
+            space_hook=self._cluster_space,
             default_chunk_size=self.config.get("chunk_size"),
             default_stripe=self.config.get("stripe"),
         )

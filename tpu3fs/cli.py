@@ -212,7 +212,8 @@ class AdminCli:
                                    client_id="admin_cli")
         fio = self.fab.file_client()
         n = fio.write(res.inode, 0, text.encode())
-        self.fab.meta.close(res.inode.id, res.session_id)
+        self.fab.meta.close(res.inode.id, res.session_id,
+                            length_hint=n, wrote=True)
         return f"wrote {n} bytes"
 
     def cmd_read(self, args: List[str]) -> str:

@@ -21,6 +21,10 @@ class FileIoClient:
     def __init__(self, storage: StorageClient):
         self._storage = storage
 
+    @property
+    def storage(self) -> StorageClient:
+        return self._storage
+
     @staticmethod
     def _split(
         layout: Layout, offset: int, size: int

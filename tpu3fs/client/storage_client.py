@@ -19,9 +19,9 @@ from collections import defaultdict
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
-from tpu3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
+from tpu3fs.mgmtd.types import ChainInfo, NodeType, PublicTargetState, RoutingInfo
 from tpu3fs.storage.craq import Messenger, ReadReply, ReadReq, UpdateReply, WriteReq
-from tpu3fs.storage.types import ChunkId
+from tpu3fs.storage.types import ChunkId, SpaceInfo
 from tpu3fs.utils.result import Code, FsError, Status
 
 
@@ -272,6 +272,22 @@ class StorageClient:
             "truncate_file_chunks",
             (chain_id, file_id, last_index, last_length),
         )
+
+    def space_info(self) -> SpaceInfo:
+        """Cluster-wide space: spaceInfo from every live storage node
+        (ref admin_cli statFs path aggregating per-node spaceInfo)."""
+        total = SpaceInfo()
+        for node in self._routing().nodes.values():
+            if node.type != NodeType.STORAGE:
+                continue
+            try:
+                si = self._messenger(node.node_id, "space_info", None)
+            except FsError:
+                continue  # dead node: its space is unavailable, not free
+            total.capacity += si.capacity
+            total.used += si.used
+            total.chunk_count += si.chunk_count
+        return total
 
     def query_last_chunk(self, chain_id: int, file_id: int) -> Tuple[int, int]:
         chain = self._chain(chain_id)

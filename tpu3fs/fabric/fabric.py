@@ -83,6 +83,7 @@ class Fabric:
             ChainAllocator(1, self.chain_ids),
             file_length_hook=self._file_length,
             truncate_hook=self._truncate_chunks,
+            space_hook=self._cluster_space,
             default_chunk_size=self.cfg.chunk_size,
         )
         self._client_seq = itertools.count(1)
@@ -148,6 +149,8 @@ class Fabric:
             return svc.query_last_chunk(*payload)
         if method == "truncate_file_chunks":
             return svc.truncate_file_chunks(*payload)
+        if method == "space_info":
+            return svc.space_info()
         raise FsError(Status(Code.RPC_METHOD_NOT_FOUND, method))
 
     # -- clients ------------------------------------------------------------
@@ -164,6 +167,10 @@ class Fabric:
 
     def _truncate_chunks(self, inode, length: int) -> None:
         self.file_client().truncate_chunks(inode, length)
+
+    def _cluster_space(self):
+        si = self.storage_client().space_info()
+        return si.capacity, si.used
 
     # -- cluster life -------------------------------------------------------
     def heartbeat_all(self) -> None:

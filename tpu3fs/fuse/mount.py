@@ -183,12 +183,14 @@ class FuseMount:
     """Mount a FuseOps table; runs libfuse's loop on a thread."""
 
     def __init__(self, ops: FuseOps, mountpoint: str,
-                 *, fsname: str = "tpu3fs", debug: bool = False):
+                 *, fsname: str = "tpu3fs", debug: bool = False,
+                 allow_other: bool = False):
         self.ops = ops
         self.mountpoint = os.path.abspath(mountpoint)
         self._lib = ctypes.CDLL("libfuse.so.2", use_errno=True)
         self._fsname = fsname
         self._debug = debug
+        self._allow_other = allow_other
         self._thread: Optional[threading.Thread] = None
         self._keep = []  # keep callback closures alive
         self._struct = self._build_operations()
@@ -357,9 +359,12 @@ class FuseMount:
     # -- mount lifecycle -----------------------------------------------------
     def mount(self) -> None:
         os.makedirs(self.mountpoint, exist_ok=True)
+        opts = f"fsname={self._fsname}"
+        if self._allow_other:
+            # needs user_allow_other in /etc/fuse.conf for non-root mounts
+            opts += ",allow_other"
         args: List[bytes] = [b"tpu3fs", self.mountpoint.encode(), b"-f",
-                             b"-s", b"-o",
-                             f"fsname={self._fsname},allow_other".encode()]
+                             b"-s", b"-o", opts.encode()]
         if self._debug:
             args.append(b"-d")
         argv = (c_char_p * len(args))(*args)

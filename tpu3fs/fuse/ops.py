@@ -23,7 +23,7 @@ import os
 import stat as stat_mod
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
 
 from tpu3fs.meta.store import OpenFlags
@@ -236,9 +236,9 @@ class FuseOps:
         sf = self._meta.stat_fs()
         return {
             "f_bsize": 512 * 1024,
-            "f_blocks": max(1, getattr(sf, "capacity", 0) // (512 * 1024)),
-            "f_bfree": max(0, getattr(sf, "free", 0) // (512 * 1024)),
-            "f_files": getattr(sf, "inodes", 0),
+            "f_blocks": max(1, sf.capacity // (512 * 1024)),
+            "f_bfree": max(0, (sf.capacity - sf.used) // (512 * 1024)),
+            "f_files": sf.files,
         }
 
     # -- file ops ------------------------------------------------------------
@@ -287,6 +287,10 @@ class FuseOps:
             fresh = self._meta.batch_stat([inode.id])[0]
             if fresh is not None:
                 f.inode = inode = fresh
+        # meta's length only settles at sync/close; bytes written through
+        # this handle may extend past it, so clamp to what we know we wrote
+        if f.max_written > inode.length:
+            inode = replace(inode, length=f.max_written)
         return self._fio.read(inode, offset, size)
 
     def write(self, fh: int, offset: int, data: bytes) -> int:

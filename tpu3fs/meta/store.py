@@ -146,6 +146,7 @@ class MetaStore:
         *,
         file_length_hook: Optional[Callable[[Inode], int]] = None,
         truncate_hook: Optional[Callable[[Inode, int], None]] = None,
+        space_hook: Optional[Callable[[], Tuple[int, int]]] = None,
         default_chunk_size: int = 1 << 20,
         default_stripe: int = 1,
     ):
@@ -158,6 +159,10 @@ class MetaStore:
         # trims/removes storage chunks past the new EOF (ref: meta truncate
         # goes through the storage client in the reference too)
         self._truncate_hook = truncate_hook
+        # cluster (capacity, used) from storage spaceInfo; statFs then
+        # reports physical space, not summed logical lengths (ref statFs
+        # aggregating storage space)
+        self._space_hook = space_hook
         self._default_chunk_size = default_chunk_size
         self._default_stripe = default_stripe
         self._ensure_root()
@@ -789,7 +794,12 @@ class MetaStore:
                     used += inode.length
             return StatFs(capacity=0, used=used, files=files)
 
-        return with_transaction(self._engine, op, read_only=True)
+        sf = with_transaction(self._engine, op, read_only=True)
+        if self._space_hook is not None:
+            capacity, used = self._space_hook()
+            sf.capacity = capacity
+            sf.used = used
+        return sf
 
     # -- GC (ref src/meta/components/GcManager.cc) --------------------------
     def gc_scan(self, limit: int = 64) -> List[Inode]:

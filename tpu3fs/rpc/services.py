@@ -22,7 +22,7 @@ from tpu3fs.mgmtd.service import HeartbeatReply, Mgmtd
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType, RoutingInfo
 from tpu3fs.rpc.net import RpcClient, RpcServer, ServiceDef
 from tpu3fs.storage.craq import ReadReply, ReadReq, StorageService, UpdateReply, WriteReq
-from tpu3fs.storage.types import ChunkId, ChunkMeta
+from tpu3fs.storage.types import ChunkId, ChunkMeta, SpaceInfo
 from tpu3fs.utils.result import Code, FsError, Status
 
 STORAGE_SERVICE_ID = 3     # ref fbs/storage/Service.h
@@ -135,6 +135,7 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     s.method(9, "truncateChunks", TruncateChunksReq, IntReply,
              lambda r: IntReply(svc.truncate_file_chunks(
                  r.chain_id, r.file_id, r.last_index, r.last_length)))
+    s.method(10, "spaceInfo", Empty, SpaceInfo, lambda r: svc.space_info())
     server.add_service(s)
 
 
@@ -180,6 +181,8 @@ class RpcMessenger:
             return r.a, r.b
         if method == "truncate_file_chunks":
             return c.call(addr, sid, 9, TruncateChunksReq(*payload), IntReply).value
+        if method == "space_info":
+            return c.call(addr, sid, 10, Empty(), SpaceInfo)
         raise FsError(Status(Code.RPC_METHOD_NOT_FOUND, method))
 
 

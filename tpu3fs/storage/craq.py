@@ -25,13 +25,14 @@ calls, the RPC layer wires sockets — same operator either way.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
 from tpu3fs.storage.target import StorageTarget
-from tpu3fs.storage.types import Checksum, ChunkId, ChunkMeta
+from tpu3fs.storage.types import Checksum, ChunkId, ChunkMeta, SpaceInfo
 from tpu3fs.utils.fault_injection import inject
 from tpu3fs.utils.result import Code, FsError, Status
 from tpu3fs.utils.result import err as _err
@@ -487,6 +488,25 @@ class StorageService:
                     (chain_id, file_id, last_index, last_length),
                 )
         return touched
+
+    def space_info(self) -> SpaceInfo:
+        """Aggregate disk space over local targets (ref StorageSerde
+        spaceInfo, src/fbs/storage/Service.h:16). Path-backed targets on
+        the same device share one statvfs capacity, so count each device
+        once; mem targets each carry their own nominal capacity."""
+        total = SpaceInfo()
+        seen_devs = set()
+        for target in self.targets():
+            si = target.space_info()
+            if target.path:
+                dev = os.stat(target.path).st_dev
+                if dev in seen_devs:
+                    si.capacity = 0
+                seen_devs.add(dev)
+            total.capacity += si.capacity
+            total.used += si.used
+            total.chunk_count += si.chunk_count
+        return total
 
     # -- sync / recovery (receiver side; ref syncStart/syncDone) --------------
     def dump_chunkmeta(self, target_id: int) -> List[ChunkMeta]:

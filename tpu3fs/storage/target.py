@@ -8,11 +8,17 @@ local state through heartbeats.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from tpu3fs.mgmtd.types import LocalTargetState
 from tpu3fs.storage.engine import ChunkEngine, MemChunkEngine
 from tpu3fs.storage.types import DEFAULT_CHUNK_SIZE, SpaceInfo
+
+# mem targets have no disk behind them; advertise a finite dev-sized
+# capacity so statFs math stays meaningful (ref SpaceInfo from statvfs
+# in src/storage/worker/SpaceInfo)
+MEM_TARGET_CAPACITY = 16 << 30
 
 
 def make_engine(kind: str = "mem", path: Optional[str] = None) -> ChunkEngine:
@@ -38,13 +44,22 @@ class StorageTarget:
         self.target_id = target_id
         self.chain_id = chain_id
         self.engine = make_engine(engine, path)
+        self.path = path
         self.chunk_size = chunk_size
         self.local_state = LocalTargetState.UPTODATE
 
     def space_info(self) -> SpaceInfo:
-        metas = self.engine.all_metadata()
+        if self.path and not isinstance(self.engine, MemChunkEngine):
+            # disk-backed: both numbers from statvfs, so space consumed by
+            # anything else on the device counts as used, not free
+            st = os.statvfs(self.path)
+            capacity = st.f_frsize * st.f_blocks
+            used = capacity - st.f_frsize * st.f_bavail
+        else:
+            capacity = MEM_TARGET_CAPACITY
+            used = self.engine.used_size()
         return SpaceInfo(
-            capacity=0,
-            used=self.engine.used_size(),
-            chunk_count=len(metas),
+            capacity=capacity,
+            used=used,
+            chunk_count=len(self.engine.all_metadata()),
         )

@@ -15,7 +15,7 @@ shm buffer.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from tpu3fs.client.file_io import FileIoClient
 from tpu3fs.meta.store import MetaStore, OpenFlags
@@ -43,8 +43,8 @@ class UsrbioAgent:
         self._meta = meta
         self._fio = file_client
         self._client_id = client_id
-        # fd table (ref hf3fs_reg_fd): small int -> (inode, session)
-        self._fds: Dict[int, Tuple[Inode, str]] = {}
+        # fd table (ref hf3fs_reg_fd): small int -> [inode, session, wrote]
+        self._fds: Dict[int, List] = {}
         self._next_fd = 100
         self._rings: Dict[str, _RingState] = {}
         self._lock = threading.Lock()
@@ -65,7 +65,7 @@ class UsrbioAgent:
         with self._lock:
             fd = self._next_fd
             self._next_fd += 1
-            self._fds[fd] = (res.inode, res.session_id)
+            self._fds[fd] = [res.inode, res.session_id, False]
         return fd
 
     def close_fd(self, fd: int, length_hint: Optional[int] = None) -> None:
@@ -73,9 +73,10 @@ class UsrbioAgent:
             entry = self._fds.pop(fd, None)
         if entry is None:
             raise FsError(Status(Code.INVALID_ARG, f"unknown fd {fd}"))
-        inode, session = entry
+        inode, session, wrote = entry
         if session:
-            self._meta.close(inode.id, session, length_hint=length_hint)
+            self._meta.close(inode.id, session, length_hint=length_hint,
+                             wrote=wrote)
 
     def register_iov(self, name: str, size: int) -> Iov:
         """Map a client's shm buffer into the agent (ref IovTable.addIov —
@@ -136,7 +137,7 @@ class UsrbioAgent:
         entry = self._fds.get(sqe.fd)
         if entry is None:
             return -int(Code.META_NOT_FOUND)
-        inode, _session = entry
+        inode = entry[0]
         if sqe.iov_id >= len(state.iovs):
             return -int(Code.INVALID_ARG)
         iov = state.iovs[sqe.iov_id]
@@ -151,6 +152,9 @@ class UsrbioAgent:
                 iov.write(sqe.iov_offset, data)
                 return len(data)
             data = iov.read(sqe.iov_offset, sqe.length)
+            # flag before issuing so a close_fd racing this write still
+            # sees the session as written
+            entry[2] = True
             written = self._fio.write(inode, sqe.file_offset, data)
             self._meta.sync(inode.id, length_hint=sqe.file_offset + written)
             return written
