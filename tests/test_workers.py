@@ -1,0 +1,156 @@
+"""Storage maintenance workers (ref src/storage/worker/ — CheckWorker disk
+probes + low-space flags, DumpWorker chunkmeta dumps, PunchHoleWorker
+reclaim, AllocateWorker headroom)."""
+
+import json
+import os
+
+import pytest
+
+from tpu3fs.fabric import Fabric, SystemSetupConfig
+from tpu3fs.meta.store import OpenFlags
+from tpu3fs.mgmtd.types import LocalTargetState
+from tpu3fs.storage.craq import StorageService, WriteReq
+from tpu3fs.storage.target import StorageTarget
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.storage.workers import (
+    AllocateWorker,
+    CheckWorker,
+    DumpWorker,
+    PunchHoleWorker,
+)
+from tpu3fs.utils.result import Code
+
+
+def _single_native_service(tmp_path, monkeypatch=None):
+    from tpu3fs.mgmtd.types import (
+        ChainInfo,
+        NodeInfo,
+        NodeType,
+        PublicTargetState,
+        RoutingInfo,
+        TargetInfo,
+    )
+
+    routing = RoutingInfo(version=1)
+    routing.nodes[1] = NodeInfo(node_id=1, type=NodeType.STORAGE)
+    routing.chains[7] = ChainInfo(
+        chain_id=7, chain_version=1,
+        targets=[TargetInfo(target_id=70, node_id=1,
+                            public_state=PublicTargetState.SERVING)],
+    )
+    routing.targets[70] = routing.chains[7].targets[0]
+    svc = StorageService(1, lambda: routing, lambda *a: None)
+    target = StorageTarget(70, 7, engine="native",
+                           path=str(tmp_path / "t70"), chunk_size=4096)
+    os.makedirs(target.path, exist_ok=True)
+    svc.add_target(target)
+    return svc, target
+
+
+class TestCheckWorker:
+    def test_healthy_disk_keeps_target_serving(self, tmp_path):
+        svc, target = _single_native_service(tmp_path)
+        w = CheckWorker(svc)
+        assert w.run_once() == 0
+        assert target.local_state == LocalTargetState.UPTODATE
+        assert not target.reject_create
+
+    def test_vanished_path_offlines_target_and_fires_callback(self, tmp_path):
+        svc, target = _single_native_service(tmp_path)
+        fired = []
+        w = CheckWorker(svc, on_offline=lambda t: fired.append(t.target_id))
+        import shutil
+
+        shutil.rmtree(target.path)
+        assert w.run_once() == 1
+        assert target.local_state == LocalTargetState.OFFLINE
+        assert fired == [70]
+        # already-offline targets are skipped on the next pass
+        assert w.run_once() == 0
+
+    def test_low_space_flags_reject_create(self, tmp_path):
+        svc, target = _single_native_service(tmp_path)
+        w = CheckWorker(svc, reject_create_threshold=0.0,
+                        emergency_recycling_ratio=0.0)
+        w.run_once()  # any usage >= 0.0 threshold flips both flags
+        assert target.reject_create
+        assert target.emergency_recycling
+        # write path refuses NEW chunks but target stays online
+        rep = svc.write(WriteReq(
+            chain_id=7, chain_ver=1, chunk_id=ChunkId(5, 0), offset=0,
+            data=b"x", chunk_size=4096, client_id="c", channel_id=1, seqnum=1,
+        ))
+        assert rep.code == Code.NO_SPACE
+        assert target.local_state == LocalTargetState.UPTODATE
+
+    def test_reject_create_still_accepts_chain_and_resync_writes(
+            self, tmp_path):
+        svc, target = _single_native_service(tmp_path)
+        target.reject_create = True
+        # resync full-replace must land (a nearly-full replica has to be
+        # able to converge)
+        rep = svc.update(WriteReq(
+            chain_id=7, chain_ver=1, chunk_id=ChunkId(6, 0), offset=0,
+            data=b"r" * 4096, chunk_size=4096, full_replace=True,
+            update_ver=1, from_target=999,
+        ))
+        assert rep.ok, rep
+        # chain-internal forward of a new chunk must land too
+        rep = svc.update(WriteReq(
+            chain_id=7, chain_ver=1, chunk_id=ChunkId(6, 1), offset=0,
+            data=b"f" * 64, chunk_size=4096, update_ver=1, from_target=999,
+        ))
+        assert rep.ok, rep
+
+    def test_mem_targets_have_no_disk_to_fail(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=1, num_chains=1,
+                                       num_replicas=1))
+        svc = next(iter(fab.nodes.values())).service
+        assert CheckWorker(svc).run_once() == 0
+
+
+class TestDumpWorker:
+    def test_dump_writes_readable_chunkmeta(self, tmp_path):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=1, num_chains=1,
+                                       num_replicas=1, chunk_size=4096))
+        fio = fab.file_client()
+        res = fab.meta.create("/d", flags=OpenFlags.WRITE, client_id="c")
+        fio.write(res.inode, 0, b"z" * 10_000)
+        svc = next(iter(fab.nodes.values())).service
+        files = DumpWorker(svc, str(tmp_path / "dumps"), node_id=10).run_once()
+        assert files
+        rows = []
+        for path in files:
+            if path.endswith(".jsonl"):
+                with open(path) as f:
+                    rows += [json.loads(line) for line in f]
+            else:
+                from tpu3fs.analytics.trace import read_records
+
+                rows += read_records(path)
+        assert len(rows) == 3  # 10000 bytes / 4096 chunks
+        assert {r["file_id"] for r in rows} == {res.inode.id}
+        assert all(r["committed_ver"] >= 1 for r in rows)
+
+
+class TestReclaimWorkers:
+    def test_punch_hole_compacts_native_engine(self, tmp_path):
+        svc, target = _single_native_service(tmp_path)
+        rep = svc.write(WriteReq(
+            chain_id=7, chain_ver=1, chunk_id=ChunkId(9, 0), offset=0,
+            data=b"y" * 4096, chunk_size=4096, client_id="c", channel_id=1, seqnum=1,
+        ))
+        assert rep.ok
+        before = os.path.getsize(os.path.join(target.path, "data.bin")) \
+            if os.path.exists(os.path.join(target.path, "data.bin")) else None
+        assert target.engine.remove(ChunkId(9, 0))
+        assert PunchHoleWorker(svc).run_once() == 1
+        assert target.engine.used_size() == 0
+        del before  # layout is engine-private; used_size is the contract
+
+    def test_allocate_worker_counts_emergencies(self, tmp_path):
+        svc, target = _single_native_service(tmp_path)
+        assert AllocateWorker(svc).run_once() == 0
+        target.emergency_recycling = True
+        assert AllocateWorker(svc).run_once() == 1

@@ -1,0 +1,7 @@
+from tpu3fs.analytics.trace import (  # noqa: F401
+    SerdeObjectReader,
+    SerdeObjectWriter,
+    StructuredTraceLog,
+    read_records,
+    write_records,
+)

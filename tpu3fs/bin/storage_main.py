@@ -23,6 +23,12 @@ from tpu3fs.rpc.services import RpcMessenger, bind_storage_service
 from tpu3fs.storage.craq import StorageService
 from tpu3fs.storage.resync import ResyncWorker
 from tpu3fs.storage.target import StorageTarget
+from tpu3fs.storage.workers import (
+    AllocateWorker,
+    CheckWorker,
+    DumpWorker,
+    PunchHoleWorker,
+)
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.utils.logging import xlog
 
@@ -33,6 +39,14 @@ class StorageAppConfig(Config):
     chunk_size = ConfigItem(1 << 20)
     resync_interval_s = ConfigItem(5.0, hot=True)
     target_scan_interval_s = ConfigItem(5.0, hot=True)
+    # maintenance workers (ref src/storage/worker/)
+    check_interval_s = ConfigItem(3.0, hot=True)
+    punch_hole_interval_s = ConfigItem(10.0, hot=True)
+    dump_interval_s = ConfigItem(0.0, hot=True)   # 0 = disabled
+    dump_dir = ConfigItem("")                     # default <data_dir>/dumps
+    reject_create_threshold = ConfigItem(0.98, hot=True)
+    emergency_recycling_ratio = ConfigItem(0.95, hot=True)
+    trace_dir = ConfigItem("")  # write-path structured trace; "" = off
 
 
 class StorageApp(TwoPhaseApplication):
@@ -41,6 +55,7 @@ class StorageApp(TwoPhaseApplication):
     def __init__(self, argv: Optional[List[str]] = None):
         super().__init__(argv)
         self.service: Optional[StorageService] = None
+        self._trace = None
 
     def default_config(self) -> Config:
         return StorageAppConfig()
@@ -50,7 +65,19 @@ class StorageApp(TwoPhaseApplication):
         self.service = StorageService(
             self.info.node_id, lambda: self.mgmtd_client.routing(), messenger
         )
+        trace_dir = self.config.get("trace_dir")
+        if trace_dir:
+            from tpu3fs.analytics.trace import StructuredTraceLog
+
+            self._trace = StructuredTraceLog("storage-event", trace_dir)
+            self.service.set_trace_log(self._trace)
         bind_storage_service(server, self.service)
+
+    def after_stop(self) -> None:
+        if self._trace is not None:
+            # the writer buffers flush_rows rows; a restart must not lose
+            # the tail of the trace
+            self._trace.flush()
 
     # -- target discovery ---------------------------------------------------
     def _target_path(self, target_id: int, disk_index: int) -> Optional[str]:
@@ -100,6 +127,10 @@ class StorageApp(TwoPhaseApplication):
         self.scan_targets()
         self.spawn(self._target_scan_loop, "target-scan")
         self.spawn(self._resync_loop, "resync")
+        self.spawn(self._check_loop, "check-disk")
+        self.spawn(self._punch_hole_loop, "punch-hole")
+        # always spawned so dump_interval_s can be hot-enabled from 0
+        self.spawn(self._dump_loop, "dump-chunkmeta")
 
     def _target_scan_loop(self) -> None:
         while not self._stop.wait(self.config.get("target_scan_interval_s")):
@@ -118,6 +149,52 @@ class StorageApp(TwoPhaseApplication):
                         self.service,
                         RpcMessenger(lambda: self.mgmtd_client.routing()),
                     )
+                worker.run_once()
+            except Exception:
+                pass
+
+    def _check_loop(self) -> None:
+        worker = CheckWorker(
+            self.service,
+            reject_create_threshold=self.config.get("reject_create_threshold"),
+            emergency_recycling_ratio=self.config.get(
+                "emergency_recycling_ratio"),
+            # a freshly offlined disk must reach mgmtd now, not at the next
+            # periodic heartbeat (ref CheckWorker triggerHeartbeat)
+            on_offline=lambda t: self.heartbeat_once(),
+        )
+        allocator = AllocateWorker(self.service)
+        while not self._stop.wait(self.config.get("check_interval_s")):
+            try:
+                worker.reject_create_threshold = self.config.get(
+                    "reject_create_threshold")
+                worker.emergency_recycling_ratio = self.config.get(
+                    "emergency_recycling_ratio")
+                worker.run_once()
+                allocator.run_once()
+            except Exception:
+                pass
+
+    def _punch_hole_loop(self) -> None:
+        worker = PunchHoleWorker(self.service)
+        while not self._stop.wait(self.config.get("punch_hole_interval_s")):
+            try:
+                worker.run_once()
+            except Exception:
+                pass
+
+    def _dump_loop(self) -> None:
+        dump_dir = self.config.get("dump_dir") or os.path.join(
+            self.config.get("data_dir") or ".", "dumps")
+        worker = DumpWorker(self.service, dump_dir, self.info.node_id)
+        while True:
+            interval = self.config.get("dump_interval_s")
+            # 0 = disabled: poll for a hot re-enable without busy-looping
+            if self._stop.wait(interval if interval > 0 else 1.0):
+                return
+            if interval <= 0:
+                continue
+            try:
                 worker.run_once()
             except Exception:
                 pass

@@ -90,6 +90,53 @@ class FileIoClient:
             return b""
         return b"".join(parts)
 
+    def batch_read_files(
+        self, files: List[Tuple[Inode, int, int]]
+    ) -> List[bytes]:
+        """Read many (inode, offset, size) ranges as ONE node-grouped batch
+        through StorageClient.batch_read — the data-loader/KVCache path where
+        batching across files is what amortizes round trips."""
+        from tpu3fs.client.storage_client import ReadReq
+
+        reqs: List[ReadReq] = []
+        spans: List[List[Tuple[int, int]]] = []  # per file: (req idx, n)
+        sizes: List[int] = []
+        for inode, offset, size in files:
+            layout = inode.layout
+            assert layout is not None
+            if inode.length:
+                size = max(0, min(size, inode.length - offset))
+            sizes.append(size)
+            mine: List[Tuple[int, int]] = []
+            for idx, chain_id, in_off, n in self._split(layout, offset, size):
+                mine.append((len(reqs), n))
+                reqs.append(ReadReq(
+                    chain_id, ChunkId(inode.id, idx), in_off, n
+                ))
+            spans.append(mine)
+        replies = self._storage.batch_read(reqs)
+        out: List[bytes] = []
+        for (inode, _, _), mine, size in zip(files, spans, sizes):
+            if size == 0:
+                out.append(b"")
+                continue
+            parts: List[bytes] = []
+            any_data = False
+            for req_i, n in mine:
+                reply = replies[req_i]
+                if reply.code == Code.CHUNK_NOT_FOUND:
+                    parts.append(b"\x00" * n)
+                    continue
+                if not reply.ok:
+                    raise FsError(Status(reply.code))
+                any_data = True
+                parts.append(reply.data.ljust(n, b"\x00"))
+            if not any_data and inode.length == 0:
+                out.append(b"")
+            else:
+                out.append(b"".join(parts))
+        return out
+
     def file_length(self, inode: Inode) -> int:
         """Precise length: max over chains of last chunk end (FileHelper)."""
         layout = inode.layout

@@ -1,0 +1,1 @@
+from tpu3fs.kvcache.cache import KVCacheClient, KVCacheGC  # noqa: F401

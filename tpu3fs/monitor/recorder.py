@@ -67,6 +67,26 @@ class CounterRecorder(_Recorder):
         return [Sample(self.name, now, self.tags, value=float(v), count=int(v))]
 
 
+class ValueRecorder(_Recorder):
+    """Gauge: reports the last set() value each collection window
+    (ref monitor::ValueRecorder — disk capacity/free, queue depths)."""
+
+    def __init__(self, name, tags=None, monitor=None):
+        super().__init__(name, tags, monitor)
+        self._value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def collect(self, now: float) -> List[Sample]:
+        with self._lock:
+            if self._value is None:
+                return []
+            v = self._value
+        return [Sample(self.name, now, self.tags, value=v, count=1)]
+
+
 class DistributionRecorder(_Recorder):
     """Value distribution via reservoir sampling (the reference uses TDigest;
     a bounded reservoir gives the same quantile reporting contract)."""

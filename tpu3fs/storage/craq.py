@@ -57,6 +57,22 @@ class WriteReq:
 
 
 @dataclass
+class StorageEventTrace:
+    """One write-path trace row (ref fbs StorageEventTrace fed from
+    StorageOperator.cc:356-361); streamed via analytics.StructuredTraceLog."""
+
+    ts: float = 0.0
+    client_id: str = ""
+    chain_id: int = 0
+    file_id: int = 0
+    chunk_index: int = 0
+    update_ver: int = 0
+    code: int = 0
+    length: int = 0
+    latency_us: float = 0.0
+
+
+@dataclass
 class UpdateReply:
     code: Code
     update_ver: int = 0
@@ -149,6 +165,12 @@ class StorageService:
         tags = {"node": str(node_id)}
         self._write_rec = LatencyRecorder("storage.write", tags)
         self._read_rec = LatencyRecorder("storage.read", tags)
+        # structured write-path trace (ref StorageOperator.h:36 —
+        # analytics::StructuredTraceLog<StorageEventTrace>); None = off
+        self._trace = None
+
+    def set_trace_log(self, trace) -> None:
+        self._trace = trace
 
     # -- wiring -------------------------------------------------------------
     def add_target(self, target: StorageTarget) -> None:
@@ -189,11 +211,26 @@ class StorageService:
 
     # -- client write (HEAD only; ref StorageOperator.cc:233-282) ------------
     def write(self, req: WriteReq) -> UpdateReply:
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self._write_rec.record() as op:
             reply = self._write_impl(req)
             if not reply.ok:
                 op.fail()
-            return reply
+        if self._trace is not None:
+            self._trace.append(StorageEventTrace(
+                ts=_time.time(),
+                client_id=req.client_id,
+                chain_id=req.chain_id,
+                file_id=req.chunk_id.file_id,
+                chunk_index=req.chunk_id.index,
+                update_ver=reply.update_ver,
+                code=int(reply.code),
+                length=len(req.data),
+                latency_us=(_time.perf_counter() - t0) * 1e6,
+            ))
+        return reply
 
     def _write_impl(self, req: WriteReq) -> UpdateReply:
         if self.stopped:
@@ -253,6 +290,16 @@ class StorageService:
                 chain_ver = chain.chain_version
                 engine = target.engine
                 meta = engine.get_meta(req.chunk_id)
+                if (meta is None and target.reject_create
+                        and req.from_target == 0 and not req.full_replace):
+                    # disk nearly full: refuse NEW chunks from clients only —
+                    # chain forwards and resync full-replaces must land, or a
+                    # nearly-full replica could never converge (ref
+                    # CheckWorker reject-create flag)
+                    return UpdateReply(
+                        Code.NO_SPACE,
+                        message=f"target {target.target_id} rejects creates",
+                    )
                 update_ver = req.update_ver
                 if update_ver == 0:
                     update_ver = (meta.committed_ver if meta else 0) + 1

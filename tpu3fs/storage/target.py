@@ -47,6 +47,10 @@ class StorageTarget:
         self.path = path
         self.chunk_size = chunk_size
         self.local_state = LocalTargetState.UPTODATE
+        # flipped by CheckWorker on low disk space (ref CheckWorker.cc
+        # disk_reject_create_chunk_threshold / emergency_recycling_ratio)
+        self.reject_create = False
+        self.emergency_recycling = False
 
     def space_info(self) -> SpaceInfo:
         if self.path and not isinstance(self.engine, MemChunkEngine):
