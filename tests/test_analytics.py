@@ -71,7 +71,8 @@ class TestNpzFallback:
         assert path.endswith(".npz")
         back = read_records(path)
         assert back[0]["a"] == 3 and back[1]["d"] is True
-        assert back[0]["e"] == "01ff"  # bytes stored hex in the npz backend
+        assert back[0]["e"] == b"\x01\xff"  # bytes round-trip (stored hex)
+        assert back[1]["e"] == b""
 
 
 class TestSerdeObjects:

@@ -1,0 +1,272 @@
+"""Tests for Distributor, migration service, trash, and simple_example
+(SURVEY §2 inventory rows: src/meta/components/Distributor, src/migration,
+hf3fs_utils/trash.py + trash_cleaner, src/simple_example)."""
+
+import pytest
+
+from tpu3fs.fabric.fabric import Fabric, FabricClock, SystemSetupConfig
+from tpu3fs.kv import MemKVEngine
+from tpu3fs.meta.distributor import Distributor, rendezvous_owner
+from tpu3fs.meta.store import ChainAllocator, MetaStore, User
+from tpu3fs.migration import JobState, MigrationService
+from tpu3fs.simple_example import (
+    SimpleExampleService,
+    bind_simple_example_service,
+)
+from tpu3fs.simple_example.service import (
+    SimpleReadReq,
+    SimpleReadRsp,
+    SimpleWriteReq,
+    SimpleWriteRsp,
+)
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils import trash
+from tpu3fs.utils.result import Code, FsError
+
+
+# -- Distributor -------------------------------------------------------------
+
+class TestDistributor:
+    def test_rendezvous_stability(self):
+        # removing one server only moves inodes that were owned by it
+        servers = [1, 2, 3, 4]
+        owners_before = {i: rendezvous_owner(servers, i) for i in range(500)}
+        smaller = [1, 2, 4]
+        moved = 0
+        for i, before in owners_before.items():
+            after = rendezvous_owner(smaller, i)
+            if before == 3:
+                assert after != 3
+            elif after != before:
+                moved += 1
+        assert moved == 0  # only server-3 inodes were reassigned
+
+    def test_rendezvous_spread(self):
+        servers = [11, 22, 33]
+        counts = {s: 0 for s in servers}
+        for i in range(3000):
+            counts[rendezvous_owner(servers, i)] += 1
+        for s in servers:
+            assert counts[s] > 600  # roughly balanced
+
+    def test_membership_timeout(self):
+        clock = FabricClock(1000.0)
+        kv = MemKVEngine()
+        d1 = Distributor(kv, 1, timeout_s=30, clock=clock)
+        d2 = Distributor(kv, 2, timeout_s=30, clock=clock)
+        d1.heartbeat()
+        d2.heartbeat()
+        assert sorted(d1.active_servers()) == [1, 2]
+        clock.advance(20)
+        d1.heartbeat()  # server 2 goes silent
+        clock.advance(15)
+        assert d1.active_servers() == [1]
+        owner = d1.owner(42)
+        assert owner == 1 and d1.is_owner(42)
+        # server 2 comes back
+        d2.heartbeat()
+        assert sorted(d1.active_servers()) == [1, 2]
+        d2.leave()
+        assert d1.active_servers() == [1]
+
+    def test_no_servers(self):
+        d = Distributor(MemKVEngine(), 1)
+        assert d.owner(7) is None
+
+
+# -- migration ---------------------------------------------------------------
+
+class TestMigration:
+    def _write_chunks(self, fabric, chain_id, file_id, n=5):
+        client = fabric.storage_client()
+        for i in range(n):
+            data = bytes([i]) * 128
+            client.write_chunk(chain_id, ChunkId(file_id, i), 0, data)
+        return client
+
+    def test_migrate_chain(self):
+        fabric = Fabric(SystemSetupConfig(num_chains=2))
+        src, dst = fabric.chain_ids
+        client = self._write_chunks(fabric, src, file_id=7, n=5)
+        svc = MigrationService(fabric.routing, fabric.send)
+        job_id = svc.start_job(src, dst)
+        job = svc.run_job(job_id, batch=2)
+        assert job.state == JobState.DONE
+        assert job.copied == 5 and job.total == 5
+        # data readable from the destination chain, fully replicated
+        for i in range(5):
+            reply = client.read_chunk(dst, ChunkId(7, i))
+            assert reply.ok and reply.data == bytes([i]) * 128
+
+    def test_stop_and_list(self):
+        fabric = Fabric(SystemSetupConfig(num_chains=2))
+        src, dst = fabric.chain_ids
+        svc = MigrationService(fabric.routing, fabric.send)
+        job_id = svc.start_job(src, dst)
+        assert svc.stop_job(job_id)
+        assert not svc.stop_job(job_id)  # already stopped
+        jobs = svc.list_jobs()
+        assert len(jobs) == 1 and jobs[0].state == JobState.STOPPED
+        assert svc.step(job_id) == 0
+
+    def test_same_chain_rejected(self):
+        fabric = Fabric(SystemSetupConfig(num_chains=1))
+        svc = MigrationService(fabric.routing, fabric.send)
+        with pytest.raises(ValueError):
+            svc.start_job(fabric.chain_ids[0], fabric.chain_ids[0])
+
+    def test_failure_marks_job(self):
+        fabric = Fabric(SystemSetupConfig(num_chains=2))
+        src, dst = fabric.chain_ids
+        self._write_chunks(fabric, src, file_id=9, n=3)
+        svc = MigrationService(fabric.routing, fabric.send)
+        job_id = svc.start_job(src, 999999)  # nonexistent dst chain
+        svc.step(job_id)
+        job = svc.job(job_id)
+        assert job.state == JobState.FAILED and job.error
+
+
+# -- trash -------------------------------------------------------------------
+
+class TestTrash:
+    @pytest.fixture
+    def meta(self):
+        return MetaStore(MemKVEngine(), ChainAllocator(1, [101, 102]))
+
+    def test_roundtrip_name(self):
+        name = trash.trash_entry_name("data.bin", 1700000000, 86400)
+        orig, create, keep = trash.parse_trash_entry(name)
+        assert (orig, create, keep) == ("data.bin", 1700000000, 86400)
+        assert trash.parse_trash_entry("no-trash-format") is None
+
+    def test_move_list_restore(self, meta):
+        clock = FabricClock(2_000_000.0)
+        meta.create("/doomed")
+        tpath = trash.move_to_trash(meta, "/doomed", keep_s=100, clock=clock)
+        with pytest.raises(FsError):
+            meta.stat("/doomed")
+        entries = trash.list_trash(meta)
+        assert len(entries) == 1
+        assert entries[0].orig_name == "doomed"
+        assert entries[0].expire_ts == 2_000_100
+        trash.restore_from_trash(meta, tpath, "/back")
+        assert meta.stat("/back").is_file()
+        assert trash.list_trash(meta) == []
+
+    def test_cleaner_purges_only_expired(self, meta):
+        clock = FabricClock(3_000_000.0)
+        meta.create("/old")
+        meta.create("/fresh")
+        trash.move_to_trash(meta, "/old", keep_s=50, clock=clock)
+        clock.advance(60)
+        trash.move_to_trash(meta, "/fresh", keep_s=500, clock=clock)
+        cleaner = trash.TrashCleaner(meta, clock=clock)
+        assert cleaner.clean_once() == 1
+        left = trash.list_trash(meta)
+        assert len(left) == 1 and left[0].orig_name == "fresh"
+        clock.advance(1000)
+        assert cleaner.clean_once() == 1
+        assert trash.list_trash(meta) == []
+
+    def test_per_user_trash(self, meta):
+        alice = User(uid=1000, gid=100)
+        meta.mkdirs("/home", perm=0o777)
+        meta.create("/home/af", user=alice)
+        trash.move_to_trash(meta, "/home/af", user=alice, keep_s=10)
+        assert trash.list_trash(meta, user=alice)[0].orig_name == "af"
+        assert trash.list_trash(meta) == []  # root's trash is separate
+
+    def test_cleaner_empty_fs(self, meta):
+        assert trash.TrashCleaner(meta).clean_once() == 0
+
+
+# -- simple_example ----------------------------------------------------------
+
+class TestSimpleExample:
+    def test_direct(self):
+        svc = SimpleExampleService()
+        assert svc.write(SimpleWriteReq("k", "v")).stored == 1
+        assert svc.read(SimpleReadReq("k")) == SimpleReadRsp(True, "v")
+        assert svc.read(SimpleReadReq("nope")).found is False
+
+    def test_over_rpc(self):
+        from tpu3fs.rpc.net import RpcClient, RpcServer
+        from tpu3fs.simple_example import SIMPLE_EXAMPLE_SERVICE_ID
+
+        server = RpcServer()
+        sdef = bind_simple_example_service(server, SimpleExampleService())
+        server.start()
+        try:
+            client = RpcClient()
+            rsp = client.call(
+                server.address, SIMPLE_EXAMPLE_SERVICE_ID, 1,
+                SimpleWriteReq("a", "b"), SimpleWriteRsp,
+            )
+            assert rsp.stored == 1
+            rsp = client.call(
+                server.address, SIMPLE_EXAMPLE_SERVICE_ID, 2,
+                SimpleReadReq("a"), SimpleReadRsp,
+            )
+            assert rsp == SimpleReadRsp(True, "b")
+            client.close()
+        finally:
+            server.stop()
+        assert sdef.name == "SimpleExample"
+
+
+# -- core service config ops -------------------------------------------------
+
+class TestCoreServiceConfig:
+    def test_get_config_and_update_record(self):
+        import json
+
+        from tpu3fs.rpc.net import RpcClient, RpcServer
+        from tpu3fs.rpc.services import (
+            CORE_SERVICE_ID,
+            Empty,
+            StrReply,
+            bind_core_service,
+        )
+        from tpu3fs.utils.config import Config, ConfigItem
+
+        class Cfg(Config):
+            depth = ConfigItem(4, hot=True)
+
+        cfg = Cfg()
+        server = RpcServer()
+        bind_core_service(server, config=cfg)
+        server.start()
+        try:
+            client = RpcClient()
+
+            def call(mid, req, rsp_t):
+                return client.call(server.address, CORE_SERVICE_ID, mid, req, rsp_t)
+
+            assert "depth = 4" in call(5, Empty(), StrReply).value
+            rec = json.loads(call(6, Empty(), StrReply).value)
+            assert rec["seq"] == 0
+            call(3, StrReply("depth = 9"), Empty)
+            assert cfg.get("depth") == 9
+            rec = json.loads(call(6, Empty(), StrReply).value)
+            assert rec["seq"] == 1 and rec["ok"]
+            client.close()
+        finally:
+            server.stop()
+
+
+# -- CLI wiring --------------------------------------------------------------
+
+class TestCliWiring:
+    def test_trash_and_migrate_commands(self):
+        from tpu3fs.cli import AdminCli
+
+        fab = Fabric(SystemSetupConfig(num_chains=2))
+        cli = AdminCli(fab)
+        assert "created" in cli.run("touch /f")
+        assert "moved to /trash/0/" in cli.run("trash-put /f --keep 0")
+        assert "purged 1" in cli.run("trash-clean")
+        client = fab.storage_client()
+        client.write_chunk(fab.chain_ids[0], ChunkId(5, 0), 0, b"x" * 64)
+        out = cli.run(f"migrate-start {fab.chain_ids[0]} {fab.chain_ids[1]}")
+        assert "done copied=1/1" in out
+        assert "done 1/1" in cli.run("migrate-list")

@@ -19,7 +19,8 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Type
+import typing
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
 
 import numpy as np
 
@@ -57,12 +58,19 @@ def _rows_of(events: Sequence[Any]) -> List[Dict[str, Any]]:
 
 # -- columnar write/read -----------------------------------------------------
 
-def _columns(rows: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+def _ordered_keys(rows: List[Dict[str, Any]]) -> List[str]:
+    """First-seen column order, union over all rows."""
     keys: List[str] = []
     for row in rows:
         for k in row:
             if k not in keys:
                 keys.append(k)
+    return keys
+
+
+def _columns(rows: List[Dict[str, Any]]) -> "Tuple[Dict[str, np.ndarray], List[str]]":
+    keys = _ordered_keys(rows)
+    bytes_cols: List[str] = []
     cols: Dict[str, np.ndarray] = {}
     for k in keys:
         vals = [row.get(k) for row in rows]
@@ -77,30 +85,29 @@ def _columns(rows: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
                 dtype=np.float64,
             )
         elif isinstance(sample, bytes):
+            # hex-encoded; recorded in the schema so reads decode to bytes
             cols[k] = np.array([v.hex() if v else "" for v in vals])
+            bytes_cols.append(k)
         else:
             cols[k] = np.array(["" if v is None else str(v) for v in vals])
-    return cols
+    return cols, bytes_cols
 
 
 def write_records(path_base: str, rows: Sequence[Dict[str, Any]]) -> str:
     """Write rows columnar; returns the actual path (.parquet or .npz)."""
     rows = list(rows)
     if _pq is not None:
-        keys: List[str] = []
-        for row in rows:
-            for k in row:
-                if k not in keys:
-                    keys.append(k)
         # normalize: from_pylist takes its schema from the first row, so a
         # key appearing later would silently drop its whole column
+        keys = _ordered_keys(rows)
         norm = [{k: row.get(k) for k in keys} for row in rows]
         path = path_base + ".parquet"
         _pq.write_table(_pa.Table.from_pylist(norm), path)
         return path
     path = path_base + ".npz"
-    cols = _columns(rows)
-    meta = json.dumps({"n": len(rows), "columns": list(cols)})
+    cols, bytes_cols = _columns(rows)
+    meta = json.dumps({"n": len(rows), "columns": list(cols),
+                       "bytes_columns": bytes_cols})
     np.savez_compressed(path, __schema__=np.array(meta), **cols)
     # np.savez appends .npz only when missing; path already carries it
     return path
@@ -109,16 +116,22 @@ def write_records(path_base: str, rows: Sequence[Dict[str, Any]]) -> str:
 def read_records(path: str) -> List[Dict[str, Any]]:
     """Read rows back (either backend) as list-of-dicts."""
     if path.endswith(".parquet"):  # pragma: no cover - needs pyarrow
+        if _pq is None:
+            raise RuntimeError("pyarrow is required to read parquet traces")
         return _pq.read_table(path).to_pylist()
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["__schema__"]))
         cols = {k: z[k] for k in meta["columns"]}
+    bytes_cols = set(meta.get("bytes_columns", []))
     out = []
     for i in range(meta["n"]):
         row = {}
         for k, arr in cols.items():
             v = arr[i]
-            row[k] = str(v) if arr.dtype.kind == "U" else v.item()
+            if k in bytes_cols:
+                row[k] = bytes.fromhex(str(v))
+            else:
+                row[k] = str(v) if arr.dtype.kind == "U" else v.item()
         out.append(row)
     return out
 
@@ -169,15 +182,22 @@ class SerdeObjectReader:
         self._cls = cls
 
     def _build(self, cls: Type, row: Dict[str, Any], prefix: str) -> Any:
+        # field annotations may be strings under `from __future__ import
+        # annotations` — resolve them to real types before dispatching
+        try:
+            hints = typing.get_type_hints(cls)
+        except Exception:
+            hints = {}
         kwargs = {}
         for f in dataclasses.fields(cls):
             key = f"{prefix}{f.name}"
-            if dataclasses.is_dataclass(f.type) and isinstance(f.type, type):
-                kwargs[f.name] = self._build(f.type, row, key + ".")
+            ftype = hints.get(f.name, f.type)
+            if dataclasses.is_dataclass(ftype) and isinstance(ftype, type):
+                kwargs[f.name] = self._build(ftype, row, key + ".")
             elif key in row:
                 v = row[key]
-                if isinstance(f.type, type) and issubclass(f.type, enum.Enum):
-                    v = f.type(v)
+                if isinstance(ftype, type) and issubclass(ftype, enum.Enum):
+                    v = ftype(v)
                 kwargs[f.name] = v
         return cls(**kwargs)
 

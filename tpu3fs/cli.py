@@ -29,6 +29,7 @@ class AdminCli:
         """fabric: a Fabric (or compatible: .mgmtd, .meta, .file_client(),
         .storage_client(), .routing(), .run_gc(), .nodes)."""
         self.fab = fabric
+        self._migration_svc = None
         self._commands: Dict[str, Callable[[List[str]], str]] = {}
         for name in dir(self):
             if name.startswith("cmd_"):
@@ -238,6 +239,60 @@ class AdminCli:
 
     def cmd_gc_run(self, args: List[str]) -> str:
         return f"gc reclaimed {self.fab.run_gc()} files"
+
+    # -- trash (ref hf3fs_utils/trash.py + trash_cleaner) --------------------
+    def cmd_trash_put(self, args: List[str]) -> str:
+        from tpu3fs.utils import trash as _trash
+
+        keep = int(self._flag(args, "--keep", 3 * 86400))
+        dest = _trash.move_to_trash(self.fab.meta, args[0], keep_s=keep)
+        return f"moved to {dest}"
+
+    def cmd_trash_list(self, args: List[str]) -> str:
+        from tpu3fs.utils import trash as _trash
+
+        rows = [
+            f"{e.path} orig={e.orig_name} expires={e.expire_ts}"
+            for e in _trash.list_trash(self.fab.meta)
+        ]
+        return "\n".join(rows) if rows else "(trash empty)"
+
+    def cmd_trash_clean(self, args: List[str]) -> str:
+        from tpu3fs.utils import trash as _trash
+
+        n = _trash.TrashCleaner(self.fab.meta).clean_once()
+        self.fab.run_gc()
+        return f"purged {n} expired entries"
+
+    # -- migration (ref src/migration job control) ---------------------------
+    def _migration(self):
+        if self._migration_svc is None:
+            from tpu3fs.migration import MigrationService
+
+            self._migration_svc = MigrationService(
+                self.fab.routing, self.fab.send
+            )
+        return self._migration_svc
+
+    def cmd_migrate_start(self, args: List[str]) -> str:
+        svc = self._migration()
+        job_id = svc.start_job(int(args[0]), int(args[1]))
+        job = svc.run_job(job_id)
+        return (f"job {job_id}: {job.state.name.lower()} "
+                f"copied={job.copied}/{job.total}"
+                + (f" error={job.error}" if job.error else ""))
+
+    def cmd_migrate_list(self, args: List[str]) -> str:
+        rows = [
+            f"job {j.job_id}: {j.src_chain}->{j.dst_chain} "
+            f"{j.state.name.lower()} {j.copied}/{j.total}"
+            for j in self._migration().list_jobs()
+        ]
+        return "\n".join(rows) if rows else "(no jobs)"
+
+    def cmd_migrate_stop(self, args: List[str]) -> str:
+        ok = self._migration().stop_job(int(args[0]))
+        return "stopped" if ok else "not running"
 
     # -- bench (ref benchmarks/storage_bench) --------------------------------
     def cmd_bench(self, args: List[str]) -> str:

@@ -60,23 +60,21 @@ class FileIoClient:
             written += n
         return written
 
-    def read(self, inode: Inode, offset: int, size: int) -> bytes:
-        """POSIX-style read: holes and short chunks inside the file read as
-        zeros; the result is clamped to the inode's length (short read at EOF).
-        Each chunk part is padded to its slot so later chunks keep their file
-        offsets."""
-        layout = inode.layout
-        assert layout is not None
-        if inode.length:
-            size = max(0, min(size, inode.length - offset))
+    @staticmethod
+    def _assemble(inode: Inode, pairs: List[Tuple[object, int]],
+                  size: int) -> bytes:
+        """POSIX-style assembly of chunk read replies for one file range:
+        holes (CHUNK_NOT_FOUND) and short chunks read as zeros, each part
+        padded to its slot so later chunks keep their file offsets; an
+        untracked-length inode with no chunks at all is true EOF (empty
+        read), not a hole. `pairs` is [(reply, slot_length)] in file order.
+        Shared by read() and batch_read_files() so their semantics cannot
+        drift apart."""
         if size == 0:
             return b""
         parts: List[bytes] = []
         any_data = False
-        for idx, chain_id, in_off, n in self._split(layout, offset, size):
-            reply = self._storage.read_chunk(
-                chain_id, ChunkId(inode.id, idx), in_off, n
-            )
+        for reply, n in pairs:
             if reply.code == Code.CHUNK_NOT_FOUND:
                 parts.append(b"\x00" * n)  # hole
                 continue
@@ -85,10 +83,23 @@ class FileIoClient:
             any_data = True
             parts.append(reply.data.ljust(n, b"\x00"))  # pad short chunk
         if not any_data and inode.length == 0:
-            # untracked-length inode with no chunks at all: true EOF, not a
-            # hole — POSIX read of an empty file returns 0 bytes
             return b""
         return b"".join(parts)
+
+    def read(self, inode: Inode, offset: int, size: int) -> bytes:
+        """POSIX-style read: holes and short chunks inside the file read as
+        zeros; the result is clamped to the inode's length (short read at
+        EOF)."""
+        layout = inode.layout
+        assert layout is not None
+        if inode.length:
+            size = max(0, min(size, inode.length - offset))
+        pairs = [
+            (self._storage.read_chunk(
+                chain_id, ChunkId(inode.id, idx), in_off, n), n)
+            for idx, chain_id, in_off, n in self._split(layout, offset, size)
+        ]
+        return self._assemble(inode, pairs, size)
 
     def batch_read_files(
         self, files: List[Tuple[Inode, int, int]]
@@ -115,27 +126,12 @@ class FileIoClient:
                 ))
             spans.append(mine)
         replies = self._storage.batch_read(reqs)
-        out: List[bytes] = []
-        for (inode, _, _), mine, size in zip(files, spans, sizes):
-            if size == 0:
-                out.append(b"")
-                continue
-            parts: List[bytes] = []
-            any_data = False
-            for req_i, n in mine:
-                reply = replies[req_i]
-                if reply.code == Code.CHUNK_NOT_FOUND:
-                    parts.append(b"\x00" * n)
-                    continue
-                if not reply.ok:
-                    raise FsError(Status(reply.code))
-                any_data = True
-                parts.append(reply.data.ljust(n, b"\x00"))
-            if not any_data and inode.length == 0:
-                out.append(b"")
-            else:
-                out.append(b"".join(parts))
-        return out
+        return [
+            self._assemble(
+                inode, [(replies[req_i], n) for req_i, n in mine], size
+            )
+            for (inode, _, _), mine, size in zip(files, spans, sizes)
+        ]
 
     def file_length(self, inode: Inode) -> int:
         """Precise length: max over chains of last chunk end (FileHelper)."""

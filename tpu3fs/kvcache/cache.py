@@ -95,7 +95,15 @@ class KVCacheClient:
                 | OpenFlags.TRUNC,
                 client_id=self._client_id,
             )
-            n = self._fio.write(res.inode, 0, value)
+            try:
+                n = self._fio.write(res.inode, 0, value)
+            except BaseException:
+                # failed write must not leak the open write session
+                try:
+                    self._meta.close(res.inode.id, res.session_id)
+                except FsError:
+                    pass
+                raise
             self._meta.close(res.inode.id, res.session_id,
                              length_hint=n, wrote=True)
             self._write_bytes.add(n)
@@ -214,36 +222,44 @@ class KVCacheGC:
             return []
 
     def run_once(self, now: Optional[float] = None) -> int:
-        """Scan up to max_shards leaf dirs; returns entries removed."""
+        """Scan up to max_shards leaf dirs; returns entries removed.
+
+        Sub-shard lists are fetched lazily per top dir as the cursor reaches
+        it, so a pass costs 1 (root) + tops-touched + leafs-visited list_dir
+        calls — never a full enumeration of the whole shard tree up front."""
         now = time.time() if now is None else now
         removed = 0
         tops = sorted(self._list(self.root))
         if not tops:
             return 0
-        # flatten (top, sub) shard space and walk it from the cursor
-        shards: List[Tuple[str, str]] = []
-        for top in tops:
-            for sub in sorted(self._list(f"{self.root}/{top}")):
-                shards.append((top, sub))
-        if not shards:
-            return 0
-        start = self._cursor[0] % len(shards)
-        for i in range(min(self.max_shards, len(shards))):
-            top, sub = shards[(start + i) % len(shards)]
-            leaf = f"{self.root}/{top}/{sub}"
-            self._scans.add()
-            for name in self._list(leaf):
-                path = f"{leaf}/{name}"
-                try:
-                    inode = self._meta.stat(path)
-                except FsError:
-                    continue
-                if now - inode.mtime >= self.ttl_s:
+        ti = self._cursor[0] % len(tops)
+        si = self._cursor[1]
+        visited = 0
+        tops_touched = 0
+        while visited < self.max_shards and tops_touched <= len(tops):
+            top = tops[ti]
+            subs = sorted(self._list(f"{self.root}/{top}"))
+            while si < len(subs) and visited < self.max_shards:
+                leaf = f"{self.root}/{top}/{subs[si]}"
+                si += 1
+                visited += 1
+                self._scans.add()
+                for name in self._list(leaf):
+                    path = f"{leaf}/{name}"
                     try:
-                        self._meta.remove(path)
-                        removed += 1
-                        self._removes.add()
+                        inode = self._meta.stat(path)
                     except FsError:
-                        pass  # concurrent remove/touch: next pass decides
-        self._cursor = ((start + self.max_shards) % len(shards), 0)
+                        continue
+                    if now - inode.mtime >= self.ttl_s:
+                        try:
+                            self._meta.remove(path)
+                            removed += 1
+                            self._removes.add()
+                        except FsError:
+                            pass  # concurrent remove/touch: next pass decides
+            if si >= len(subs):
+                ti = (ti + 1) % len(tops)
+                si = 0
+                tops_touched += 1
+        self._cursor = (ti, si)
         return removed

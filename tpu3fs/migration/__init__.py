@@ -1,0 +1,3 @@
+from tpu3fs.migration.service import Job, JobState, MigrationService
+
+__all__ = ["Job", "JobState", "MigrationService"]

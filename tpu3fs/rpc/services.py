@@ -588,15 +588,36 @@ def bind_core_service(server: RpcServer, *, config=None, on_shutdown=None) -> No
     def render(_r: Empty) -> StrReply:
         return StrReply(config.render_toml() if config is not None else "")
 
+    # last hot-update record (ref CoreServiceDef.h getLastConfigUpdateRecord)
+    last_update = {"time": 0.0, "seq": 0, "ok": True, "detail": ""}
+
     def hot_update(req: StrReply) -> Empty:
+        import time as _time
+
         if config is not None:
             import tomllib
 
-            config.hot_update(_flatten(tomllib.loads(req.value)))
+            last_update["seq"] += 1
+            last_update["time"] = _time.time()
+            try:
+                config.hot_update(_flatten(tomllib.loads(req.value)))
+                last_update["ok"], last_update["detail"] = True, ""
+            except Exception as e:
+                last_update["ok"], last_update["detail"] = False, str(e)
+                raise
         return Empty()
+
+    def last_record(_r: Empty) -> StrReply:
+        import json
+
+        return StrReply(json.dumps(last_update))
 
     s.method(2, "renderConfig", Empty, StrReply, render)
     s.method(3, "hotUpdateConfig", StrReply, Empty, hot_update)
+    # getConfig: same rendered TOML; the ref splits getConfig/renderConfig by
+    # template-vs-effective view, both reduce to the live tree here
+    s.method(5, "getConfig", Empty, StrReply, render)
+    s.method(6, "getLastConfigUpdateRecord", Empty, StrReply, last_record)
 
     def shutdown(_r: Empty) -> Empty:
         if on_shutdown is not None:

@@ -219,17 +219,22 @@ class StorageService:
             if not reply.ok:
                 op.fail()
         if self._trace is not None:
-            self._trace.append(StorageEventTrace(
-                ts=_time.time(),
-                client_id=req.client_id,
-                chain_id=req.chain_id,
-                file_id=req.chunk_id.file_id,
-                chunk_index=req.chunk_id.index,
-                update_ver=reply.update_ver,
-                code=int(reply.code),
-                length=len(req.data),
-                latency_us=(_time.perf_counter() - t0) * 1e6,
-            ))
+            try:
+                self._trace.append(StorageEventTrace(
+                    ts=_time.time(),
+                    client_id=req.client_id,
+                    chain_id=req.chain_id,
+                    file_id=req.chunk_id.file_id,
+                    chunk_index=req.chunk_id.index,
+                    update_ver=reply.update_ver,
+                    code=int(reply.code),
+                    length=len(req.data),
+                    latency_us=(_time.perf_counter() - t0) * 1e6,
+                ))
+            except Exception:
+                # tracing is best-effort: a trace-flush I/O failure must not
+                # fail a client write that already committed + forwarded
+                pass
         return reply
 
     def _write_impl(self, req: WriteReq) -> UpdateReply:
