@@ -1,0 +1,341 @@
+"""Randomized-schedule model check of the CRAQ chain protocol.
+
+The reference model-checks CRAQ with P-language specs (specs/DataStorage/PSrc
+— StorageService/StorageClient/MgmtService machines; safety + liveness in
+PSpec/SystemSpec.p; 12 test schedules in PTst/TestScript.p, including
+multi-client writes with node failures). This is the same idea aimed at the
+REAL implementation: a seeded explorer drives the single-process fabric
+(real Mgmtd + StorageServices + StorageClients) through randomized
+interleavings of concurrent-client writes, reads, server-side fault
+injection, fail-stop node kills and recovery, checking CRAQ's safety
+invariants at every step and convergence (liveness) after healing:
+
+S1  Reads only return committed data: a successful read's payload is one of
+    the payloads ever submitted to that chunk — never torn/mixed bytes.
+S2  If the read's commit version matches an acknowledged write, the payload
+    is exactly that write's payload (version <-> value binding).
+S3  Committed data is never lost: per chunk, the commit version a client
+    observes never goes backwards, and an acknowledged write's version is
+    never regressed past by a later read returning older data.
+S4  Exactly-once: the final committed version of a chunk never exceeds the
+    number of logical writes issued to it (client retries of one logical
+    write consume at most one version).
+S5  Last-writer-wins (sequential oracle): because the explorer issues ops
+    strictly sequentially, a read must return the payload of the most
+    recent acknowledged write, unless later non-acknowledged writes
+    intervened (those may or may not have applied) — in which case the
+    payload must come from that ambiguous suffix.
+S6  Duplicate delivery (protocol level): re-delivering the exact same
+    (client, channel, seqnum) write to the head returns the cached reply
+    and does not advance the commit version (ReliableUpdate semantics).
+L1  After healing (restart all dead nodes + resync), every target of every
+    chain returns to SERVING and all replicas hold identical
+    (committed_ver, checksum) per chunk.
+
+A threaded stress schedule additionally runs concurrent clients against the
+same chunks (no total order, so only S1 + convergence are asserted there).
+"""
+
+import random
+
+import pytest
+
+from tpu3fs.client.storage_client import RetryOptions
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.mgmtd.types import PublicTargetState
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils.fault_injection import fault_injection
+from tpu3fs.utils.result import Code
+
+FILE_ID = 77
+PAYLOAD_LEN = 64
+NUM_CHUNKS = 3
+
+
+def _payload(tag: int) -> bytes:
+    return f"w{tag:06d}".encode().ljust(PAYLOAD_LEN, b".")
+
+
+class CraqExplorer:
+    """One randomized schedule against one fresh fabric."""
+
+    def __init__(self, seed: int, *, replicas: int = 3, nodes: int = 3):
+        self.rng = random.Random(seed)
+        self.fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=nodes,
+            num_chains=1,
+            num_replicas=replicas,
+            heartbeat_timeout_s=60.0,
+        ))
+        self.chain = self.fab.chain_ids[0]
+        fast = RetryOptions(max_retries=6, backoff_base_s=0.0,
+                            backoff_max_s=0.0)
+        self.clients = [self.fab.storage_client(retry=fast) for _ in range(3)]
+        self.tag = 0
+        # per chunk: payloads ever sent (S1), acked ver -> payload (S2),
+        # logical write count (S4), highest commit ver seen by a read (S3)
+        self.sent = {i: set() for i in range(NUM_CHUNKS)}
+        self.acked = {i: {} for i in range(NUM_CHUNKS)}
+        self.writes_issued = {i: 0 for i in range(NUM_CHUNKS)}
+        self.max_read_ver = {i: 0 for i in range(NUM_CHUNKS)}
+        # S5 oracle: payloads the committed value may legally be right now —
+        # collapses to {payload} on an acked write, grows on unacked ones
+        self.candidates = {i: set() for i in range(NUM_CHUNKS)}
+
+    # -- actions -------------------------------------------------------------
+    def act_write(self, faulty: bool = False) -> None:
+        idx = self.rng.randrange(NUM_CHUNKS)
+        client = self.rng.choice(self.clients)
+        self.tag += 1
+        data = _payload(self.tag)
+        self.sent[idx].add(data)
+        self.writes_issued[idx] += 1
+        if faulty:
+            with fault_injection(0.4, times=2):
+                reply = client.write_chunk(
+                    self.chain, ChunkId(FILE_ID, idx), 0, data,
+                    chunk_size=PAYLOAD_LEN)
+        else:
+            reply = client.write_chunk(
+                self.chain, ChunkId(FILE_ID, idx), 0, data,
+                chunk_size=PAYLOAD_LEN)
+        if reply.ok:
+            assert reply.commit_ver > 0
+            self.acked[idx][reply.commit_ver] = data
+            self.candidates[idx] = {data}
+        else:
+            # the write may or may not have applied somewhere down the chain
+            self.candidates[idx].add(data)
+
+    def act_read(self) -> None:
+        idx = self.rng.randrange(NUM_CHUNKS)
+        client = self.rng.choice(self.clients)
+        reply = client.read_chunk(self.chain, ChunkId(FILE_ID, idx))
+        if reply.code == Code.CHUNK_NOT_FOUND:
+            return
+        if not reply.ok:
+            return  # transient failure mid-schedule is legal
+        # S1: never torn — payload must be something a client submitted
+        assert reply.data in self.sent[idx], (
+            f"chunk {idx}: read returned bytes no client ever wrote")
+        # S2: version<->value binding for acknowledged writes
+        if reply.commit_ver in self.acked[idx]:
+            assert reply.data == self.acked[idx][reply.commit_ver]
+        # S5: last-writer-wins under the sequential schedule
+        if self.candidates[idx]:
+            assert reply.data in self.candidates[idx], (
+                f"chunk {idx}: read returned a stale/resurrected payload "
+                f"{reply.data[:10]!r}, legal set has "
+                f"{len(self.candidates[idx])} candidates")
+        # S3: commit version seen by readers never regresses
+        assert reply.commit_ver >= self.max_read_ver[idx], (
+            f"chunk {idx}: commit ver went backwards "
+            f"{self.max_read_ver[idx]} -> {reply.commit_ver}")
+        self.max_read_ver[idx] = reply.commit_ver
+
+    def _alive(self):
+        return [n for n in self.fab.nodes.values() if n.alive]
+
+    def act_kill(self) -> None:
+        alive = self._alive()
+        if len(alive) <= 1:
+            return  # keep the chain readable
+        node = self.rng.choice(alive)
+        self.fab.fail_node(node.node_id)
+
+    def act_recover(self) -> None:
+        dead = [n for n in self.fab.nodes.values() if not n.alive]
+        if not dead:
+            return
+        node = self.rng.choice(dead)
+        self.fab.restart_node(node.node_id)
+        self.fab.resync_all()
+
+    def act_tick(self) -> None:
+        self.fab.tick()
+
+    # -- schedule ------------------------------------------------------------
+    def run(self, steps: int = 50) -> None:
+        actions = [
+            (self.act_write, 30),
+            (lambda: self.act_write(faulty=True), 15),
+            (self.act_read, 30),
+            (self.act_kill, 8),
+            (self.act_recover, 10),
+            (self.act_tick, 7),
+        ]
+        fns = [fn for fn, w in actions for _ in range(w)]
+        for _ in range(steps):
+            self.rng.choice(fns)()
+        self.heal_and_check()
+
+    # -- liveness + convergence ----------------------------------------------
+    def heal_and_check(self) -> None:
+        for node in self.fab.nodes.values():
+            if not node.alive:
+                self.fab.restart_node(node.node_id)
+        self.fab.resync_all(rounds=8)
+        routing = self.fab.routing()
+        chain = routing.chains[self.chain]
+        # L1a: all targets back to SERVING
+        for t in chain.targets:
+            assert t.public_state == PublicTargetState.SERVING, (
+                f"target {t.target_id} stuck {t.public_state.name}")
+        # L1b: replicas bit-identical per chunk
+        metas = {}
+        for t in chain.targets:
+            node_id = routing.targets[t.target_id].node_id
+            dump = self.fab.send(node_id, "dump_chunkmeta", t.target_id)
+            # compare committed state only: a pending-only chunk
+            # (committed_ver == 0) is residue of an abandoned mid-chain
+            # write — not data; replicas may legally differ in it until the
+            # next write to that chunk supersedes the pending version
+            metas[t.target_id] = {
+                m.chunk_id.index: (m.committed_ver, m.checksum.value,
+                                   m.checksum.length)
+                for m in dump
+                if m.chunk_id.file_id == FILE_ID and m.committed_ver > 0
+            }
+        views = list(metas.values())
+        for other in views[1:]:
+            assert other == views[0], f"replica divergence: {metas}"
+        # S4: exactly-once accounting
+        for idx, (ver, _, _) in views[0].items():
+            assert ver <= self.writes_issued[idx], (
+                f"chunk {idx}: committed ver {ver} exceeds "
+                f"{self.writes_issued[idx]} logical writes — double apply")
+        # committed content is a real payload and matches acked binding
+        client = self.clients[0]
+        for idx in range(NUM_CHUNKS):
+            if idx not in views[0]:
+                continue
+            reply = client.read_chunk(self.chain, ChunkId(FILE_ID, idx))
+            assert reply.ok, f"chunk {idx} unreadable after heal: {reply.code}"
+            assert reply.data in self.sent[idx]
+            if reply.commit_ver in self.acked[idx]:
+                assert reply.data == self.acked[idx][reply.commit_ver]
+            if self.candidates[idx]:
+                assert reply.data in self.candidates[idx]
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_random_schedules_r3(seed):
+    CraqExplorer(seed, replicas=3, nodes=3).run(steps=50)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_random_schedules_r2_more_failures(seed):
+    """Two replicas + aggressive failure mix (the reference's harder
+    schedules: multiple failures with concurrent client writes)."""
+    ex = CraqExplorer(1000 + seed, replicas=2, nodes=4)
+    ex.run(steps=60)
+
+
+def test_acked_write_survives_head_failure():
+    """Directed schedule: ack a write, fail the head, heal — the acked
+    payload must still be readable (committed data never lost)."""
+    ex = CraqExplorer(42)
+    client = ex.clients[0]
+    data = _payload(999)
+    ex.sent[0].add(data)
+    ex.writes_issued[0] += 1
+    reply = client.write_chunk(ex.chain, ChunkId(FILE_ID, 0), 0, data,
+                               chunk_size=PAYLOAD_LEN)
+    assert reply.ok
+    ex.acked[0][reply.commit_ver] = data
+    routing = ex.fab.routing()
+    head = routing.chains[ex.chain].head()
+    head_node = routing.targets[head.target_id].node_id
+    ex.fab.fail_node(head_node)
+    got = client.read_chunk(ex.chain, ChunkId(FILE_ID, 0))
+    assert got.ok and got.data == data
+    ex.heal_and_check()
+
+
+def test_duplicate_retry_applies_once():
+    """Directed schedule: the same logical write retried across a chain
+    bump applies exactly once (ReliableUpdate semantics)."""
+    ex = CraqExplorer(43)
+    client = ex.clients[0]
+    for k in range(5):
+        data = _payload(k)
+        ex.sent[0].add(data)
+        ex.writes_issued[0] += 1
+        with fault_injection(0.5, times=1):
+            reply = client.write_chunk(ex.chain, ChunkId(FILE_ID, 0), 0,
+                                       data, chunk_size=PAYLOAD_LEN)
+        if reply.ok:
+            ex.acked[0][reply.commit_ver] = data
+    ex.heal_and_check()
+
+
+def test_duplicate_delivery_is_idempotent():
+    """S6 — protocol-level duplicate: re-delivering the exact same
+    (client, channel, seqnum) write request to the head must return the
+    cached reply and leave the committed version unchanged."""
+    from tpu3fs.storage.craq import WriteReq
+
+    ex = CraqExplorer(44)
+    routing = ex.fab.routing()
+    chain = routing.chains[ex.chain]
+    head = chain.head()
+    head_node = routing.targets[head.target_id].node_id
+    req = WriteReq(
+        chain_id=ex.chain, chain_ver=chain.chain_version,
+        chunk_id=ChunkId(FILE_ID, 0), offset=0, data=_payload(1),
+        chunk_size=PAYLOAD_LEN, client_id="dup-client", channel_id=9,
+        seqnum=1,
+    )
+    first = ex.fab.send(head_node, "write", req)
+    assert first.ok
+    second = ex.fab.send(head_node, "write", req)  # exact duplicate
+    assert second.ok
+    assert second.commit_ver == first.commit_ver, "duplicate re-applied"
+    dump = ex.fab.send(head_node, "dump_chunkmeta", head.target_id)
+    meta = [m for m in dump if m.chunk_id == ChunkId(FILE_ID, 0)]
+    assert meta and meta[0].committed_ver == first.commit_ver
+
+
+def test_threaded_concurrent_clients_converge():
+    """Concurrent clients hammer the same chunks from real threads (no
+    total order): every read must still satisfy S1 (no torn/unknown data),
+    and after the storm all replicas converge bit-identically."""
+    import threading
+
+    ex = CraqExplorer(45)
+    all_sent = [set() for _ in range(NUM_CHUNKS)]
+    lock = threading.Lock()
+    errors: list = []
+
+    def worker(wid: int) -> None:
+        rng = random.Random(wid)
+        client = ex.clients[wid % len(ex.clients)]
+        try:
+            for k in range(40):
+                idx = rng.randrange(NUM_CHUNKS)
+                data = _payload(wid * 1000 + k)
+                with lock:
+                    all_sent[idx].add(data)
+                client.write_chunk(ex.chain, ChunkId(FILE_ID, idx), 0,
+                                   data, chunk_size=PAYLOAD_LEN)
+                if rng.random() < 0.5:
+                    reply = client.read_chunk(ex.chain, ChunkId(FILE_ID, idx))
+                    if reply.ok:
+                        with lock:
+                            assert reply.data in all_sent[idx], (
+                                "torn or unknown payload")
+        except BaseException as e:  # surface thread failures to pytest
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # convergence: all replicas bit-identical after the storm
+    ex.sent = {i: all_sent[i] for i in range(NUM_CHUNKS)}
+    ex.acked = {i: {} for i in range(NUM_CHUNKS)}
+    ex.candidates = {i: set() for i in range(NUM_CHUNKS)}
+    ex.writes_issued = {i: 4 * 40 for i in range(NUM_CHUNKS)}
+    ex.heal_and_check()

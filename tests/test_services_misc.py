@@ -270,3 +270,44 @@ class TestCliWiring:
         out = cli.run(f"migrate-start {fab.chain_ids[0]} {fab.chain_ids[1]}")
         assert "done copied=1/1" in out
         assert "done 1/1" in cli.run("migrate-list")
+
+
+class TestReviewRegressions:
+    def test_migration_replaces_existing_dst_chunk(self):
+        """A migrated chunk must fully replace any pre-existing destination
+        chunk, not COW-merge with it."""
+        fab = Fabric(SystemSetupConfig(num_chains=2))
+        src, dst = fab.chain_ids
+        client = fab.storage_client()
+        client.write_chunk(dst, ChunkId(7, 0), 0, b"B" * 128)  # stale dst
+        client.write_chunk(src, ChunkId(7, 0), 0, b"A" * 32)
+        svc = MigrationService(fab.routing, fab.send)
+        job = svc.run_job(svc.start_job(src, dst))
+        assert job.state == JobState.DONE
+        reply = client.read_chunk(dst, ChunkId(7, 0))
+        assert reply.ok and reply.data == b"A" * 32, reply.data[:40]
+
+    def test_trash_second_user_can_trash(self):
+        """First user to trash must not lock others out of /trash."""
+        meta = MetaStore(MemKVEngine(), ChainAllocator(1, [101, 102]))
+        alice = User(uid=1000, gid=100)
+        bob = User(uid=2000, gid=200)
+        meta.mkdirs("/home", perm=0o777)
+        meta.create("/home/fa", user=alice)
+        meta.create("/home/fb", user=bob)
+        trash.move_to_trash(meta, "/home/fa", user=alice, keep_s=10)
+        trash.move_to_trash(meta, "/home/fb", user=bob, keep_s=10)
+        assert trash.list_trash(meta, user=alice)[0].orig_name == "fa"
+        assert trash.list_trash(meta, user=bob)[0].orig_name == "fb"
+
+    def test_same_second_trash_names_unique(self):
+        meta = MetaStore(MemKVEngine(), ChainAllocator(1, [101, 102]))
+        clock = FabricClock(5_000_000.0)
+        meta.mkdirs("/a", perm=0o777)
+        meta.mkdirs("/b", perm=0o777)
+        meta.create("/a/data.bin")
+        meta.create("/b/data.bin")
+        p1 = trash.move_to_trash(meta, "/a/data.bin", keep_s=60, clock=clock)
+        p2 = trash.move_to_trash(meta, "/b/data.bin", keep_s=60, clock=clock)
+        assert p1 != p2
+        assert len(trash.list_trash(meta)) == 2

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 import json
 import os
 import threading
@@ -173,6 +174,16 @@ class SerdeObjectWriter:
         self.flush()
 
 
+@functools.lru_cache(maxsize=None)
+def _resolved_hints(cls: Type) -> Dict[str, Any]:
+    """Field annotations may be strings under `from __future__ import
+    annotations` — resolve once per class, not per row."""
+    try:
+        return typing.get_type_hints(cls)
+    except Exception:
+        return {}
+
+
 class SerdeObjectReader:
     """Read a columnar stream back into dataclass instances
     (ref analytics::SerdeObjectReader). Nested dataclasses are rebuilt from
@@ -182,12 +193,7 @@ class SerdeObjectReader:
         self._cls = cls
 
     def _build(self, cls: Type, row: Dict[str, Any], prefix: str) -> Any:
-        # field annotations may be strings under `from __future__ import
-        # annotations` — resolve them to real types before dispatching
-        try:
-            hints = typing.get_type_hints(cls)
-        except Exception:
-            hints = {}
+        hints = _resolved_hints(cls)
         kwargs = {}
         for f in dataclasses.fields(cls):
             key = f"{prefix}{f.name}"

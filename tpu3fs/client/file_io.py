@@ -9,7 +9,7 @@ Also provides the precise-length callback used by meta close/fsync
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import Iterable, List, Tuple
 
 from tpu3fs.client.storage_client import StorageClient
 from tpu3fs.meta.types import Inode, Layout
@@ -61,7 +61,7 @@ class FileIoClient:
         return written
 
     @staticmethod
-    def _assemble(inode: Inode, pairs: List[Tuple[object, int]],
+    def _assemble(inode: Inode, pairs: Iterable[Tuple[object, int]],
                   size: int) -> bytes:
         """POSIX-style assembly of chunk read replies for one file range:
         holes (CHUNK_NOT_FOUND) and short chunks read as zeros, each part
@@ -94,11 +94,13 @@ class FileIoClient:
         assert layout is not None
         if inode.length:
             size = max(0, min(size, inode.length - offset))
-        pairs = [
+        # generator: a fatal error on an early chunk short-circuits inside
+        # _assemble before the remaining chunk RPCs are ever issued
+        pairs = (
             (self._storage.read_chunk(
                 chain_id, ChunkId(inode.id, idx), in_off, n), n)
             for idx, chain_id, in_off, n in self._split(layout, offset, size)
-        ]
+        )
         return self._assemble(inode, pairs, size)
 
     def batch_read_files(

@@ -236,10 +236,18 @@ class KVCacheGC:
         si = self._cursor[1]
         visited = 0
         tops_touched = 0
-        while visited < self.max_shards and tops_touched <= len(tops):
+        seen_leafs = set()  # each leaf scanned at most once per pass
+        wrapped = False
+        while (visited < self.max_shards and tops_touched <= len(tops)
+               and not wrapped):
             top = tops[ti]
             subs = sorted(self._list(f"{self.root}/{top}"))
             while si < len(subs) and visited < self.max_shards:
+                key = (top, subs[si])
+                if key in seen_leafs:
+                    wrapped = True  # full cycle: stop, cursor stays here
+                    break
+                seen_leafs.add(key)
                 leaf = f"{self.root}/{top}/{subs[si]}"
                 si += 1
                 visited += 1
@@ -257,7 +265,7 @@ class KVCacheGC:
                             self._removes.add()
                         except FsError:
                             pass  # concurrent remove/touch: next pass decides
-            if si >= len(subs):
+            if not wrapped and si >= len(subs):
                 ti = (ti + 1) % len(tops)
                 si = 0
                 tops_touched += 1

@@ -130,12 +130,16 @@ class MigrationService:
                     target_id=src_target))
                 if not rd.ok:
                     raise err(rd.code, f"read {chunk_id} failed")
+                # full_replace: install the copy as the chunk's entire
+                # committed content — a plain CRAQ write would merge with any
+                # pre-existing destination chunk (COW overlay) and corrupt it
                 wr = self._send(dst_node, "write", WriteReq(
                     chain_id=job.dst_chain,
                     chain_ver=dst_chain.chain_version,
                     chunk_id=chunk_id, offset=0, data=rd.data,
                     chunk_size=0,  # 0 = destination target's configured size
-                    client_id=f"migration-{job.job_id}"))
+                    client_id=f"migration-{job.job_id}",
+                    full_replace=True))
                 if not wr.ok:
                     raise err(wr.code, f"write {chunk_id} failed")
                 copied += 1

@@ -67,8 +67,16 @@ def move_to_trash(
     """Move `path` into the caller's trash dir; returns the trash path."""
     now = clock()
     tdir = user_trash_dir(user)
+    # the shared /trash root must be root-owned and world-writable, or the
+    # first user to trash something would own it 0o755 and lock everyone
+    # else out of creating their own per-user trash dir
     try:
-        meta.mkdirs(tdir, user=user, recursive=True)
+        meta.mkdirs(TRASH_ROOT, user=ROOT_USER, perm=0o777)
+    except FsError as e:
+        if e.code != Code.META_EXISTS:
+            raise
+    try:
+        meta.mkdirs(tdir, user=user)
     except FsError as e:
         if e.code != Code.META_EXISTS:
             raise
