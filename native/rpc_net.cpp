@@ -1,0 +1,654 @@
+// tpu3fs native RPC/net layer.
+//
+// C++ re-design of the reference's net core + serde RPC transport
+// (src/common/net/{EventLoop,Listener,IOWorker,Transport,Server}.cc and
+// src/common/serde/MessagePacket.h): an epoll event loop owns all
+// connections and does nonblocking length-prefixed framing; parsed request
+// packets are handed to a worker-thread pool which dispatches through a
+// registered handler and writes the reply back under a per-connection write
+// lock. The MessagePacket envelope (service id, method id, flags, status,
+// payload, message, 8-point latency timestamps — MessagePacket.h:11-52) is
+// bit-compatible with the Python serde codec (tpu3fs/rpc/serde.py), so
+// native servers interoperate with Python clients and vice versa.
+//
+// Exposed as a C ABI consumed through ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+// ---- status codes shared with tpu3fs.utils.result -------------------------
+enum Code : int64_t {
+  OK = 0,
+  INTERNAL = 104,
+  RPC_CONNECT_FAILED = 200,
+  RPC_TIMEOUT = 202,
+  RPC_BAD_REQUEST = 203,
+  RPC_METHOD_NOT_FOUND = 204,
+  RPC_SERVICE_NOT_FOUND = 205,
+  RPC_PEER_CLOSED = 206,
+};
+
+constexpr uint32_t kMaxPacket = 64u << 20;
+constexpr int64_t kFlagIsReq = 1;
+
+double mono_now() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---- varint / zigzag (wire-compatible with tpu3fs/rpc/serde.py) -----------
+void put_uvarint(std::string& buf, uint64_t v) {
+  while (true) {
+    uint8_t b = v & 0x7F;
+    v >>= 7;
+    if (v) {
+      buf.push_back(char(b | 0x80));
+    } else {
+      buf.push_back(char(b));
+      return;
+    }
+  }
+}
+
+bool get_uvarint(const uint8_t* data, size_t len, size_t& pos, uint64_t& out) {
+  int shift = 0;
+  out = 0;
+  while (pos < len && shift < 64) {
+    uint8_t b = data[pos++];
+    out |= uint64_t(b & 0x7F) << shift;
+    if (!(b & 0x80)) return true;
+    shift += 7;
+  }
+  return false;
+}
+
+uint64_t zigzag(int64_t v) { return (uint64_t(v) << 1) ^ uint64_t(v >> 63); }
+int64_t unzigzag(uint64_t v) { return int64_t(v >> 1) ^ -int64_t(v & 1); }
+
+void put_int(std::string& buf, int64_t v) { put_uvarint(buf, zigzag(v)); }
+
+void put_str(std::string& buf, const std::string& s) {
+  put_uvarint(buf, s.size());
+  buf += s;
+}
+
+void put_double(std::string& buf, double d) {  // little-endian IEEE double
+  uint64_t bits;
+  memcpy(&bits, &d, 8);
+  for (int i = 0; i < 8; i++) buf.push_back(char((bits >> (8 * i)) & 0xFF));
+}
+
+bool get_int(const uint8_t* d, size_t len, size_t& pos, int64_t& out) {
+  uint64_t u;
+  if (!get_uvarint(d, len, pos, u)) return false;
+  out = unzigzag(u);
+  return true;
+}
+
+bool get_str(const uint8_t* d, size_t len, size_t& pos, std::string& out) {
+  uint64_t n;
+  // bounds as `n > len - pos`: the `pos + n > len` form overflows for a
+  // crafted huge-length varint and would crash the event loop
+  if (!get_uvarint(d, len, pos, n) || pos > len || n > len - pos)
+    return false;
+  out.assign(reinterpret_cast<const char*>(d + pos), n);
+  pos += n;
+  return true;
+}
+
+bool get_double(const uint8_t* d, size_t len, size_t& pos, double& out) {
+  if (pos > len || len - pos < 8) return false;
+  uint64_t bits = 0;
+  for (int i = 0; i < 8; i++) bits |= uint64_t(d[pos + i]) << (8 * i);
+  memcpy(&out, &bits, 8);
+  pos += 8;
+  return true;
+}
+
+// ---- MessagePacket envelope ----------------------------------------------
+// Python: @dataclass MessagePacket{uuid:str, service_id:int, method_id:int,
+// flags:int, status:int, payload:bytes, message:str, timestamps:Timestamps}
+// Timestamps = 8 floats. Dataclasses encode as varint field count + fields.
+struct Packet {
+  std::string uuid;
+  int64_t service_id = 0;
+  int64_t method_id = 0;
+  int64_t flags = 0;
+  int64_t status = 0;
+  std::string payload;
+  std::string message;
+  double ts[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+};
+
+std::string encode_packet(const Packet& p) {
+  std::string buf;
+  put_uvarint(buf, 8);  // MessagePacket field count
+  put_str(buf, p.uuid);
+  put_int(buf, p.service_id);
+  put_int(buf, p.method_id);
+  put_int(buf, p.flags);
+  put_int(buf, p.status);
+  put_str(buf, p.payload);
+  put_str(buf, p.message);
+  put_uvarint(buf, 8);  // Timestamps field count
+  for (double t : p.ts) put_double(buf, t);
+  return buf;
+}
+
+bool decode_packet(const uint8_t* d, size_t len, Packet& p) {
+  size_t pos = 0;
+  uint64_t nfields;
+  if (!get_uvarint(d, len, pos, nfields) || nfields < 8) return false;
+  if (!get_str(d, len, pos, p.uuid)) return false;
+  if (!get_int(d, len, pos, p.service_id)) return false;
+  if (!get_int(d, len, pos, p.method_id)) return false;
+  if (!get_int(d, len, pos, p.flags)) return false;
+  if (!get_int(d, len, pos, p.status)) return false;
+  if (!get_str(d, len, pos, p.payload)) return false;
+  if (!get_str(d, len, pos, p.message)) return false;
+  uint64_t nts;
+  if (!get_uvarint(d, len, pos, nts)) return false;
+  for (uint64_t i = 0; i < nts && i < 8; i++)
+    if (!get_double(d, len, pos, p.ts[i])) return false;
+  return true;
+}
+
+// ---- socket helpers -------------------------------------------------------
+int set_nonblocking(int fd, bool nb) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  if (fl < 0) return -1;
+  return fcntl(fd, F_SETFL, nb ? (fl | O_NONBLOCK) : (fl & ~O_NONBLOCK));
+}
+
+// blocking send-all with EAGAIN poll (socket may be nonblocking)
+bool send_all(int fd, const char* data, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = ::send(fd, data + off, len - off, MSG_NOSIGNAL);
+    if (n > 0) {
+      off += size_t(n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      struct pollfd pfd = {fd, POLLOUT, 0};
+      if (poll(&pfd, 1, 30000) <= 0) return false;
+      continue;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    return false;
+  }
+  return true;
+}
+
+bool recv_exact(int fd, uint8_t* out, size_t len) {  // blocking socket
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = ::recv(fd, out + off, len - off, 0);
+    if (n > 0) {
+      off += size_t(n);
+      continue;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    return false;
+  }
+  return true;
+}
+
+// resolve host (name or dotted quad) to an IPv4 sockaddr; empty = loopback.
+// inet_addr alone cannot resolve names like "localhost", which the Python
+// transport handles — the two must accept the same addresses.
+bool resolve_ipv4(const char* host, uint16_t port, struct sockaddr_in* out) {
+  memset(out, 0, sizeof(*out));
+  out->sin_family = AF_INET;
+  out->sin_port = htons(port);
+  if (host == nullptr || *host == 0) {
+    out->sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    return true;
+  }
+  struct in_addr a;
+  if (inet_pton(AF_INET, host, &a) == 1) {
+    out->sin_addr = a;
+    return true;
+  }
+  struct addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  if (getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr)
+    return false;
+  out->sin_addr = reinterpret_cast<struct sockaddr_in*>(res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  return true;
+}
+
+std::string frame(const std::string& body) {
+  std::string out;
+  uint32_t n = uint32_t(body.size());
+  out.push_back(char((n >> 24) & 0xFF));
+  out.push_back(char((n >> 16) & 0xFF));
+  out.push_back(char((n >> 8) & 0xFF));
+  out.push_back(char(n & 0xFF));
+  out += body;
+  return out;
+}
+
+// ---- server ---------------------------------------------------------------
+// handler: returns status; on success fills *rsp (malloc'd) + *rsp_len; may
+// fill *msg (malloc'd) with an error message. Called from worker threads.
+typedef int64_t (*tpu3fs_handler_t)(int64_t service_id, int64_t method_id,
+                                    const uint8_t* req, size_t req_len,
+                                    uint8_t** rsp, size_t* rsp_len,
+                                    char** msg);
+
+struct Conn {
+  int fd = -1;
+  std::mutex write_mu;
+  // read framing state (owned by the event loop thread)
+  std::string inbuf;
+  std::atomic<bool> closed{false};
+};
+
+struct Job {
+  std::shared_ptr<Conn> conn;
+  Packet req;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_pipe[2] = {-1, -1};
+  int port = 0;
+  tpu3fs_handler_t handler = nullptr;
+  std::thread loop_thread;
+  std::vector<std::thread> workers;
+  std::atomic<bool> running{true};
+
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::deque<Job> queue;
+
+  std::mutex conns_mu;
+  std::map<int, std::shared_ptr<Conn>> conns;
+};
+
+void server_close_conn(Server* s, const std::shared_ptr<Conn>& c) {
+  bool was = c->closed.exchange(true);
+  if (!was) {
+    // erase from the map (and epoll) BEFORE close(): once the fd is closed
+    // the kernel may hand the same number to a new accept, and erasing
+    // afterwards would remove the live connection while its fd stays in
+    // epoll — a 100%-CPU level-triggered spin
+    {
+      std::lock_guard<std::mutex> g(s->conns_mu);
+      s->conns.erase(c->fd);
+    }
+    epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+    ::shutdown(c->fd, SHUT_RDWR);
+    ::close(c->fd);
+  }
+}
+
+void worker_main(Server* s) {
+  while (true) {
+    Job job;
+    {
+      std::unique_lock<std::mutex> lk(s->q_mu);
+      s->q_cv.wait(lk, [&] { return !s->running || !s->queue.empty(); });
+      if (!s->running && s->queue.empty()) return;
+      job = std::move(s->queue.front());
+      s->queue.pop_front();
+    }
+    Packet& req = job.req;
+    req.ts[3] = mono_now();  // server_dequeue
+    Packet rsp;
+    rsp.uuid = req.uuid;
+    rsp.service_id = req.service_id;
+    rsp.method_id = req.method_id;
+    rsp.flags = 0;
+    memcpy(rsp.ts, req.ts, sizeof(req.ts));
+    rsp.ts[4] = mono_now();  // server_run_start
+    uint8_t* out = nullptr;
+    size_t out_len = 0;
+    char* msg = nullptr;
+    int64_t status = INTERNAL;
+    if (s->handler) {
+      status = s->handler(req.service_id, req.method_id,
+                          reinterpret_cast<const uint8_t*>(req.payload.data()),
+                          req.payload.size(), &out, &out_len, &msg);
+    }
+    rsp.status = status;
+    if (out != nullptr) {
+      if (status == OK)
+        rsp.payload.assign(reinterpret_cast<char*>(out), out_len);
+      free(out);
+    }
+    if (msg != nullptr) {
+      rsp.message = msg;
+      free(msg);
+    }
+    rsp.ts[5] = mono_now();  // server_run_end
+    std::string wire = frame(encode_packet(rsp));
+    {
+      std::lock_guard<std::mutex> g(job.conn->write_mu);
+      if (!job.conn->closed.load() &&
+          !send_all(job.conn->fd, wire.data(), wire.size())) {
+        server_close_conn(s, job.conn);
+      }
+    }
+  }
+}
+
+void loop_main(Server* s) {
+  constexpr int kMaxEvents = 64;
+  struct epoll_event evs[kMaxEvents];
+  while (s->running.load()) {
+    int n = epoll_wait(s->epoll_fd, evs, kMaxEvents, 200);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < n; i++) {
+      if (evs[i].data.fd == s->listen_fd) {
+        while (true) {
+          int cfd = ::accept(s->listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          set_nonblocking(cfd, true);
+          auto conn = std::make_shared<Conn>();
+          conn->fd = cfd;
+          {
+            std::lock_guard<std::mutex> g(s->conns_mu);
+            s->conns[cfd] = conn;
+          }
+          struct epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.fd = cfd;
+          epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, cfd, &ev);
+        }
+        continue;
+      }
+      if (evs[i].data.fd == s->wake_pipe[0]) {
+        char buf[16];
+        while (read(s->wake_pipe[0], buf, sizeof(buf)) > 0) {
+        }
+        continue;
+      }
+      std::shared_ptr<Conn> conn;
+      {
+        std::lock_guard<std::mutex> g(s->conns_mu);
+        auto it = s->conns.find(evs[i].data.fd);
+        if (it == s->conns.end()) continue;
+        conn = it->second;
+      }
+      // drain the socket into the framing buffer
+      bool dead = false;
+      char tmp[64 * 1024];
+      while (true) {
+        ssize_t r = ::recv(conn->fd, tmp, sizeof(tmp), 0);
+        if (r > 0) {
+          conn->inbuf.append(tmp, size_t(r));
+          continue;
+        }
+        if (r == 0) {
+          dead = true;
+          break;
+        }
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        dead = true;
+        break;
+      }
+      // parse complete frames
+      double now = mono_now();
+      size_t off = 0;
+      while (conn->inbuf.size() - off >= 4) {
+        const uint8_t* b =
+            reinterpret_cast<const uint8_t*>(conn->inbuf.data()) + off;
+        uint32_t frame_len = (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) |
+                             (uint32_t(b[2]) << 8) | uint32_t(b[3]);
+        if (frame_len > kMaxPacket) {
+          dead = true;
+          break;
+        }
+        if (conn->inbuf.size() - off - 4 < frame_len) break;
+        Packet req;
+        if (decode_packet(b + 4, frame_len, req)) {
+          req.ts[2] = now;  // server_receive
+          {
+            std::lock_guard<std::mutex> lk(s->q_mu);
+            s->queue.push_back(Job{conn, std::move(req)});
+          }
+          s->q_cv.notify_one();
+        } else {
+          dead = true;
+        }
+        off += 4 + frame_len;
+      }
+      if (off) conn->inbuf.erase(0, off);
+      if (dead) server_close_conn(s, conn);
+    }
+  }
+}
+
+// ---- client ---------------------------------------------------------------
+struct Client {
+  int fd = -1;
+  std::mt19937_64 rng{std::random_device{}()};
+  std::mutex mu;  // one in-flight call per connection
+};
+
+std::string gen_uuid(std::mt19937_64& rng) {
+  static const char* hex = "0123456789abcdef";
+  std::string out(32, '0');
+  for (int i = 0; i < 32; i++) out[i] = hex[rng() & 0xF];
+  return out;
+}
+
+}  // namespace
+
+// ---- C ABI ----------------------------------------------------------------
+extern "C" {
+
+void* tpu3fs_rpc_alloc(size_t n) { return malloc(n); }
+void tpu3fs_rpc_free(void* p) { free(p); }
+
+void* tpu3fs_rpc_server_create(const char* host, int port,
+                               tpu3fs_handler_t handler, int num_workers) {
+  auto* s = new Server();
+  s->handler = handler;
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr{};
+  if (!resolve_ipv4(host, uint16_t(port), &addr)) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  if (bind(s->listen_fd, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) < 0 ||
+      listen(s->listen_fd, 128) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  set_nonblocking(s->listen_fd, true);
+  if (pipe(s->wake_pipe) == 0) {
+    set_nonblocking(s->wake_pipe[0], true);
+    set_nonblocking(s->wake_pipe[1], true);
+  }
+  s->epoll_fd = epoll_create1(0);
+  struct epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = s->listen_fd;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  ev.data.fd = s->wake_pipe[0];
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_pipe[0], &ev);
+  if (num_workers < 1) num_workers = 4;
+  for (int i = 0; i < num_workers; i++)
+    s->workers.emplace_back(worker_main, s);
+  s->loop_thread = std::thread(loop_main, s);
+  return s;
+}
+
+int tpu3fs_rpc_server_port(void* srv) {
+  return srv ? static_cast<Server*>(srv)->port : -1;
+}
+
+void tpu3fs_rpc_server_stop(void* srv) {
+  if (!srv) return;
+  auto* s = static_cast<Server*>(srv);
+  s->running.store(false);
+  if (s->wake_pipe[1] >= 0) {
+    char b = 1;
+    ssize_t ignored = write(s->wake_pipe[1], &b, 1);
+    (void)ignored;
+  }
+  s->q_cv.notify_all();
+  if (s->loop_thread.joinable()) s->loop_thread.join();
+  for (auto& w : s->workers)
+    if (w.joinable()) w.join();
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    for (auto& kv : s->conns) {
+      kv.second->closed.store(true);
+      ::shutdown(kv.second->fd, SHUT_RDWR);
+      ::close(kv.second->fd);
+    }
+    s->conns.clear();
+  }
+  ::close(s->listen_fd);
+  ::close(s->epoll_fd);
+  if (s->wake_pipe[0] >= 0) ::close(s->wake_pipe[0]);
+  if (s->wake_pipe[1] >= 0) ::close(s->wake_pipe[1]);
+  delete s;
+}
+
+void* tpu3fs_rpc_client_connect(const char* host, int port,
+                                int connect_timeout_ms, int call_timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  struct sockaddr_in addr{};
+  if (!resolve_ipv4(host, uint16_t(port), &addr)) {
+    ::close(fd);
+    return nullptr;
+  }
+  // nonblocking connect bounded by connect_timeout_ms, then blocking IO
+  // bounded by call_timeout_ms — same split as the Python RpcClient
+  set_nonblocking(fd, true);
+  int rc = connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+                   sizeof(addr));
+  if (rc < 0 && errno == EINPROGRESS) {
+    struct pollfd pfd = {fd, POLLOUT, 0};
+    if (poll(&pfd, 1, connect_timeout_ms) <= 0) {
+      ::close(fd);
+      return nullptr;
+    }
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) < 0 || err != 0) {
+      ::close(fd);
+      return nullptr;
+    }
+  } else if (rc < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  set_nonblocking(fd, false);
+  struct timeval tv{};
+  tv.tv_sec = call_timeout_ms / 1000;
+  tv.tv_usec = (call_timeout_ms % 1000) * 1000;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+// returns 0 on transport success (out_status carries the remote status code);
+// negative on transport failure: -1 send failed, -2 recv failed/timeout,
+// -3 decode failed, -4 uuid mismatch
+int tpu3fs_rpc_client_call(void* cli, int64_t service_id, int64_t method_id,
+                           const uint8_t* req, size_t req_len,
+                           int64_t* out_status, uint8_t** out_rsp,
+                           size_t* out_rsp_len, char** out_msg) {
+  auto* c = static_cast<Client*>(cli);
+  std::lock_guard<std::mutex> g(c->mu);
+  Packet pkt;
+  pkt.uuid = gen_uuid(c->rng);
+  pkt.service_id = service_id;
+  pkt.method_id = method_id;
+  pkt.flags = kFlagIsReq;
+  pkt.status = OK;
+  pkt.payload.assign(reinterpret_cast<const char*>(req), req_len);
+  pkt.ts[0] = mono_now();  // client_build
+  pkt.ts[1] = mono_now();  // client_send
+  std::string wire = frame(encode_packet(pkt));
+  if (!send_all(c->fd, wire.data(), wire.size())) return -1;
+  uint8_t hdr[4];
+  if (!recv_exact(c->fd, hdr, 4)) return -2;
+  uint32_t n = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |
+               (uint32_t(hdr[2]) << 8) | uint32_t(hdr[3]);
+  if (n > kMaxPacket) return -3;
+  std::vector<uint8_t> body(n);
+  if (!recv_exact(c->fd, body.data(), n)) return -2;
+  Packet rsp;
+  if (!decode_packet(body.data(), n, rsp)) return -3;
+  if (rsp.uuid != pkt.uuid) return -4;
+  *out_status = rsp.status;
+  *out_rsp_len = rsp.payload.size();
+  *out_rsp = static_cast<uint8_t*>(malloc(rsp.payload.size() + 1));
+  memcpy(*out_rsp, rsp.payload.data(), rsp.payload.size());
+  if (out_msg != nullptr) {
+    *out_msg = static_cast<char*>(malloc(rsp.message.size() + 1));
+    memcpy(*out_msg, rsp.message.data(), rsp.message.size());
+    (*out_msg)[rsp.message.size()] = 0;
+  }
+  return 0;
+}
+
+void tpu3fs_rpc_client_close(void* cli) {
+  if (!cli) return;
+  auto* c = static_cast<Client*>(cli);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

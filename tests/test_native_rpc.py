@@ -1,0 +1,196 @@
+"""Native C++ RPC/net layer tests (native/rpc_net.cpp + rpc/native_net.py).
+
+Mirrors the reference's net-layer suites (tests/common/net/TestEcho.cc:441,
+TestService.cc:425): echo + error paths + big payloads + concurrency across
+every combination of {python, native} client and server — the wire format is
+one MessagePacket codec, so all four interoperate."""
+
+import threading
+
+import pytest
+
+from tpu3fs.rpc.net import RpcClient, RpcServer
+from tpu3fs.rpc.native_net import NativeRpcClient, NativeRpcServer
+from tpu3fs.rpc.services import (
+    CORE_SERVICE_ID,
+    EchoReq,
+    EchoRsp,
+    bind_core_service,
+)
+from tpu3fs.utils.result import Code, FsError
+
+COMBOS = [
+    (RpcServer, RpcClient),
+    (RpcServer, NativeRpcClient),
+    (NativeRpcServer, RpcClient),
+    (NativeRpcServer, NativeRpcClient),
+]
+
+
+@pytest.fixture(params=COMBOS, ids=lambda c: f"{c[0].__name__}-{c[1].__name__}")
+def combo(request):
+    server_cls, client_cls = request.param
+    server = server_cls()
+    bind_core_service(server)
+    server.start()
+    client = client_cls()
+    yield server, client
+    client.close()
+    server.stop()
+
+
+class TestInterop:
+    def test_echo(self, combo):
+        server, client = combo
+        rsp = client.call(server.address, CORE_SERVICE_ID, 1,
+                          EchoReq("ping"), EchoRsp)
+        assert rsp.text == "ping"
+
+    def test_unknown_service_and_method(self, combo):
+        server, client = combo
+        with pytest.raises(FsError) as ei:
+            client.call(server.address, 999, 1, EchoReq("x"), EchoRsp)
+        assert ei.value.code == Code.RPC_SERVICE_NOT_FOUND
+        with pytest.raises(FsError) as ei:
+            client.call(server.address, CORE_SERVICE_ID, 99,
+                        EchoReq("x"), EchoRsp)
+        assert ei.value.code == Code.RPC_METHOD_NOT_FOUND
+
+    def test_big_payload(self, combo):
+        server, client = combo
+        big = "x" * (4 << 20)
+        rsp = client.call(server.address, CORE_SERVICE_ID, 1,
+                          EchoReq(big), EchoRsp)
+        assert rsp.text == big
+
+    def test_sequential_reuse(self, combo):
+        server, client = combo
+        for i in range(50):
+            rsp = client.call(server.address, CORE_SERVICE_ID, 1,
+                              EchoReq(f"m{i}"), EchoRsp)
+            assert rsp.text == f"m{i}"
+
+
+class TestNativeServerConcurrency:
+    def test_many_threads(self):
+        server = NativeRpcServer(num_workers=4)
+        bind_core_service(server)
+        server.start()
+        errors = []
+
+        def hammer(tid):
+            client = RpcClient()
+            try:
+                for i in range(30):
+                    text = f"t{tid}.{i}" * 100
+                    rsp = client.call(server.address, CORE_SERVICE_ID, 1,
+                                      EchoReq(text), EchoRsp)
+                    assert rsp.text == text
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(8)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+        finally:
+            server.stop()
+
+    def test_not_started_gated(self):
+        server = NativeRpcServer()
+        bind_core_service(server)
+        # event loop runs (port bound) but dispatch is gated until start()
+        client = RpcClient()
+        with pytest.raises(FsError) as ei:
+            client.call(server.address, CORE_SERVICE_ID, 1,
+                        EchoReq("x"), EchoRsp)
+        assert ei.value.code == Code.SHUTTING_DOWN
+        server.start()
+        rsp = client.call(server.address, CORE_SERVICE_ID, 1,
+                          EchoReq("now"), EchoRsp)
+        assert rsp.text == "now"
+        client.close()
+        server.stop()
+
+
+class TestFullServicesOverNative:
+    def test_meta_service_on_native_transport(self):
+        """The whole meta service binds onto the native server unchanged —
+        transport and service layers are decoupled as in the reference."""
+        from tpu3fs.kv import MemKVEngine
+        from tpu3fs.meta.store import ChainAllocator, MetaStore
+        from tpu3fs.rpc.services import MetaRpcClient, bind_meta_service
+
+        store = MetaStore(MemKVEngine(), ChainAllocator(1, [101, 102]))
+        server = NativeRpcServer()
+        bind_meta_service(server, store)
+        server.start()
+        try:
+            meta = MetaRpcClient([server.address], client=NativeRpcClient())
+            meta.mkdirs("/a", recursive=True)
+            res = meta.create("/a/f")
+            assert res.inode.is_file()
+            got = meta.stat("/a/f")
+            assert got.id == res.inode.id
+            assert [e.name for e in meta.list_dir("/a")] == ["f"]
+        finally:
+            server.stop()
+
+
+class TestNativeRobustness:
+    def test_malformed_packet_does_not_kill_server(self):
+        """A crafted frame whose string-length varint decodes huge must not
+        crash the event loop (overflow-safe bounds checks)."""
+        import socket
+        import struct
+
+        server = NativeRpcServer()
+        bind_core_service(server)
+        server.start()
+        try:
+            # varint field count 8, then a string length of 2^64-1
+            evil = bytes([8]) + b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01"
+            s = socket.create_connection(server.address, timeout=2)
+            s.sendall(struct.pack(">I", len(evil)) + evil)
+            s.close()
+            # server still alive and serving
+            client = RpcClient()
+            rsp = client.call(server.address, CORE_SERVICE_ID, 1,
+                              EchoReq("alive"), EchoRsp)
+            assert rsp.text == "alive"
+            client.close()
+        finally:
+            server.stop()
+
+    def test_hostname_resolution(self):
+        """'localhost' must work like it does on the Python transport."""
+        server = NativeRpcServer(host="localhost")
+        bind_core_service(server)
+        server.start()
+        try:
+            client = NativeRpcClient()
+            rsp = client.call(("localhost", server.port), CORE_SERVICE_ID, 1,
+                              EchoReq("dns"), EchoRsp)
+            assert rsp.text == "dns"
+            client.close()
+        finally:
+            server.stop()
+
+    def test_connect_timeout_honored(self):
+        """connect_timeout bounds connection attempts (not call_timeout)."""
+        import time
+
+        client = NativeRpcClient(connect_timeout=0.3, call_timeout=30.0)
+        t0 = time.monotonic()
+        with pytest.raises(FsError):
+            # RFC 5737 TEST-NET address: guaranteed unroutable
+            client.call(("192.0.2.1", 9), CORE_SERVICE_ID, 1,
+                        EchoReq("x"), EchoRsp)
+        assert time.monotonic() - t0 < 5.0
+        client.close()

@@ -112,7 +112,14 @@ class ApplicationBase:
 
     def init_server(self) -> None:
         port = int(self.flag("port", "0"))
-        self.server = RpcServer(self.info.hostname, port)
+        # --rpc=native runs the transport on the C++ epoll layer
+        # (native/rpc_net.cpp, wire-compatible); default stays python
+        if self.flag("rpc", "python") == "native":
+            from tpu3fs.rpc.native_net import NativeRpcServer
+
+            self.server = NativeRpcServer(self.info.hostname, port)
+        else:
+            self.server = RpcServer(self.info.hostname, port)
         self.info.port = self.server.port
         bind_core_service(self.server, config=self.config,
                           on_shutdown=self.stop)
