@@ -1,0 +1,179 @@
+"""storage_bench: chain-replicated chunk IO throughput harness.
+
+Port of the reference's benchmarks/storage_bench (StorageBench.h:28-50):
+configurable chunk count/size, batch size, worker concurrency, read/write
+phases, optional checksum verification of every read, and optional random
+error injection to exercise the retry ladders while measuring. Runs against
+the in-process fabric (the reference reuses its UnitTestFabric the same way),
+so the numbers measure the CRAQ write path + engine, not socket overhead —
+pair with benchmarks/usrbio_bench.py for the client-API path.
+
+Usage:
+  python -m benchmarks.storage_bench [--chunks 256] [--size 262144]
+      [--batch 16] [--threads 4] [--replicas 2] [--chains 4]
+      [--engine mem|native] [--verify] [--inject 0.05]
+
+Prints one JSON line per phase: write / read (+ IOPS, GiB/s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+from tpu3fs.client.storage_client import RetryOptions
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.ops.crc32c import crc32c
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils.fault_injection import fault_injection
+
+FILE_ID = 4242
+
+
+def run_bench(
+    *,
+    chunks: int = 256,
+    size: int = 256 << 10,
+    batch: int = 16,
+    threads: int = 4,
+    replicas: int = 2,
+    chains: int = 4,
+    engine: str = "mem",
+    verify: bool = False,
+    inject: float = 0.0,
+) -> list:
+    fab = Fabric(SystemSetupConfig(
+        num_storage_nodes=max(3, replicas),
+        num_chains=chains,
+        num_replicas=replicas,
+        chunk_size=size,
+        engine=engine,
+    ))
+    fast = RetryOptions(backoff_base_s=0.001, backoff_max_s=0.05)
+    payloads = [bytes([i & 0xFF]) * size for i in range(min(chunks, 64))]
+    crcs = [crc32c(p) for p in payloads]
+    results = []
+
+    def phase(name: str, fn) -> None:
+        errors = []
+        done = [0] * threads
+
+        def worker(wid: int) -> None:
+            client = fab.storage_client(retry=fast)
+            try:
+                for i in range(wid, chunks, threads):
+                    if inject > 0:
+                        # injected faults are non-retryable at the client
+                        # (deterministic in tests); the bench absorbs them
+                        # with one bare retry, like the reference's
+                        # error-injecting StorageBench counts-and-continues
+                        with fault_injection(inject, times=1):
+                            try:
+                                fn(client, i)
+                            except AssertionError:
+                                fn(client, i)
+                    else:
+                        fn(client, i)
+                    done[wid] += 1
+            except BaseException as e:
+                errors.append(e)
+
+        ts = [threading.Thread(target=worker, args=(w,))
+              for w in range(threads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        n = sum(done)
+        row = {
+            "metric": f"storage_bench_{name}",
+            "value": round(n * size / dt / (1 << 30), 3),
+            "unit": "GiB/s",
+            "iops": round(n / dt, 1),
+            "ops": n,
+            "chunk_size": size,
+            "replicas": replicas,
+            "threads": threads,
+            "engine": engine,
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    def do_write(client, i: int) -> None:
+        chain = fab.chain_ids[i % len(fab.chain_ids)]
+        reply = client.write_chunk(
+            chain, ChunkId(FILE_ID, i), 0, payloads[i % len(payloads)],
+            chunk_size=size)
+        assert reply.ok, reply
+
+    def do_read(client, i: int) -> None:
+        chain = fab.chain_ids[i % len(fab.chain_ids)]
+        reply = client.read_chunk(chain, ChunkId(FILE_ID, i))
+        assert reply.ok, reply
+        if verify:
+            assert crc32c(reply.data) == crcs[i % len(crcs)], (
+                f"checksum mismatch on chunk {i}")
+
+    phase("write", do_write)
+    phase("read", do_read)
+    # batched read phase: all chunks in node-grouped batches of `batch`
+    client = fab.storage_client(retry=fast)
+    from tpu3fs.client.storage_client import ReadReq
+
+    t0 = time.perf_counter()
+    got = 0
+    for base in range(0, chunks, batch):
+        idxs = list(range(base, min(base + batch, chunks)))
+        reqs = [
+            ReadReq(fab.chain_ids[i % len(fab.chain_ids)],
+                    ChunkId(FILE_ID, i), 0, -1)
+            for i in idxs
+        ]
+        if inject > 0:
+            with fault_injection(inject, times=1):
+                replies = client.batch_read(reqs)
+        else:
+            replies = client.batch_read(reqs)
+        assert all(r.ok for r in replies)
+        if verify:
+            for i, r in zip(idxs, replies):
+                assert crc32c(r.data) == crcs[i % len(crcs)], (
+                    f"batch-read checksum mismatch on chunk {i}")
+        got += len(replies)
+    dt = time.perf_counter() - t0
+    row = {
+        "metric": "storage_bench_batch_read",
+        "value": round(got * size / dt / (1 << 30), 3),
+        "unit": "GiB/s",
+        "iops": round(got / dt, 1),
+        "batch": batch,
+        "engine": engine,
+    }
+    results.append(row)
+    print(json.dumps(row), flush=True)
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunks", type=int, default=256)
+    ap.add_argument("--size", type=int, default=256 << 10)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--chains", type=int, default=4)
+    ap.add_argument("--engine", default="mem", choices=["mem", "native"])
+    ap.add_argument("--verify", action="store_true")
+    ap.add_argument("--inject", type=float, default=0.0)
+    args = ap.parse_args()
+    run_bench(**vars(args))
+
+
+if __name__ == "__main__":
+    main()

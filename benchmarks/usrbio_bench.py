@@ -1,0 +1,103 @@
+"""usrbio_bench: batched small-IO through the USRBIO shared-memory ring.
+
+Port of the reference's fio USRBIO recipe (benchmarks/fio_usrbio/README.md —
+batched small random reads at high iodepth through the zero-copy ring API):
+prewrite a file through the FS, then issue random fixed-size reads in ring
+batches and report IOPS + throughput. This exercises the full client path:
+shm ring SQE/CQE protocol -> agent workers -> chunk-split -> batched
+StorageClient reads -> data landing in the registered iov.
+
+Usage:
+  python -m benchmarks.usrbio_bench [--bs 131072] [--iodepth 64]
+      [--file-mb 64] [--batches 32] [--chunk-size 1048576]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.meta.store import OpenFlags
+from tpu3fs.usrbio.agent import UsrbioAgent
+from tpu3fs.usrbio.api import UsrbioClient
+
+PATH = "/bench.dat"
+
+
+def run_bench(
+    *,
+    bs: int = 128 << 10,
+    iodepth: int = 64,
+    file_mb: int = 64,
+    batches: int = 32,
+    chunk_size: int = 1 << 20,
+    seed: int = 0,
+) -> dict:
+    fab = Fabric(SystemSetupConfig(
+        num_chains=4, num_replicas=2, chunk_size=chunk_size))
+    file_size = file_mb << 20
+    # prewrite through the ordinary client path
+    res = fab.meta.create(PATH, flags=OpenFlags.WRITE, client_id="bench")
+    fio = fab.file_client()
+    block = bytes(range(256)) * (chunk_size // 256)
+    for off in range(0, file_size, chunk_size):
+        fio.write(res.inode, off, block)
+    fab.meta.close(res.inode.id, res.session_id, length_hint=file_size,
+                   wrote=True)
+
+    agent = UsrbioAgent(fab.meta, fab.file_client())
+    client = UsrbioClient(agent)
+    iov = client.iovcreate(iodepth * bs)
+    ring = client.iorcreate(iodepth, [iov], for_read=True)
+    fd = client.reg_fd(PATH)
+    rng = random.Random(seed)
+    total_ios = 0
+    t0 = time.perf_counter()
+    try:
+        for _ in range(batches):
+            for slot in range(iodepth):
+                off = rng.randrange(0, max(file_size // bs, 1)) * bs
+                client.prep_io(ring, iov, slot * bs, bs, fd, off,
+                               read=True, userdata=slot)
+            client.submit_ios(ring)
+            done = client.wait_for_ios(ring, iodepth, timeout=60.0)
+            assert len(done) == iodepth, f"short batch: {len(done)}"
+            for result, _ in done:
+                assert result == bs, f"short read: {result}"
+            total_ios += iodepth
+    finally:
+        dt = time.perf_counter() - t0
+        client.dereg_fd(fd)
+        client.iordestroy(ring)
+        client.iovdestroy(iov)
+        agent.stop()
+    row = {
+        "metric": "usrbio_rand_read",
+        "value": round(total_ios * bs / dt / (1 << 30), 3),
+        "unit": "GiB/s",
+        "iops": round(total_ios / dt, 1),
+        "bs": bs,
+        "iodepth": iodepth,
+        "ios": total_ios,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=128 << 10)
+    ap.add_argument("--iodepth", type=int, default=64)
+    ap.add_argument("--file-mb", type=int, default=64, dest="file_mb")
+    ap.add_argument("--batches", type=int, default=32)
+    ap.add_argument("--chunk-size", type=int, default=1 << 20,
+                    dest="chunk_size")
+    args = ap.parse_args()
+    run_bench(**vars(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -664,5 +664,8 @@ int ce_compact(void* h) {
 }
 
 uint32_t ce_crc32c(const uint8_t* data, uint64_t n) { return crc32c(data, n); }
+uint32_t ce_crc32c_seed(const uint8_t* data, uint64_t n, uint32_t crc) {
+  return crc32c(data, n, crc);
+}
 
 }  // extern "C"

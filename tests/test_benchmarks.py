@@ -1,0 +1,49 @@
+"""Smoke tests for the benchmark harnesses (tiny configurations).
+
+The reference treats its benches as part of the tree (benchmarks/
+storage_bench reuses UnitTestFabric; the fio plugin builds in CI) — these
+keep ours importable and correct without measuring anything."""
+
+from benchmarks.rebuild_bench import run_bench as rebuild_bench
+from benchmarks.storage_bench import run_bench as storage_bench
+from benchmarks.usrbio_bench import run_bench as usrbio_bench
+
+
+class TestStorageBench:
+    def test_small_run_with_verify(self):
+        rows = storage_bench(chunks=16, size=4096, batch=4, threads=2,
+                             replicas=2, chains=2, verify=True)
+        names = [r["metric"] for r in rows]
+        assert names == ["storage_bench_write", "storage_bench_read",
+                         "storage_bench_batch_read"]
+        assert all(r["value"] > 0 for r in rows)
+        assert rows[0]["ops"] == 16
+
+    def test_error_injection_still_completes(self):
+        rows = storage_bench(chunks=8, size=4096, batch=4, threads=2,
+                             replicas=2, chains=1, inject=0.3, verify=True)
+        assert rows[0]["ops"] == 8  # retries absorb the injected faults
+
+    def test_usrbio_file_equals_bs(self):
+        from benchmarks.usrbio_bench import run_bench as usrbio
+
+        row = usrbio(bs=65536, iodepth=2, file_mb=1, batches=1,
+                     chunk_size=65536)
+        assert row["ios"] == 2
+
+
+class TestUsrbioBench:
+    def test_small_run(self):
+        row = usrbio_bench(bs=8192, iodepth=8, file_mb=1, batches=2,
+                           chunk_size=65536)
+        assert row["ios"] == 16
+        assert row["value"] > 0
+
+
+class TestRebuildBench:
+    def test_small_run(self):
+        rows = rebuild_bench(k=4, m=2, shard_kb=16, batch=2, iters=2,
+                             pod_chips=8)
+        assert len(rows) == 2
+        assert rows[0]["metric"] == "rs_rebuild_4_2_lost1"
+        assert all(r["value"] > 0 for r in rows)
