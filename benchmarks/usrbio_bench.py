@@ -36,9 +36,13 @@ def run_bench(
     chunk_size: int = 1 << 20,
     seed: int = 0,
 ) -> dict:
+    file_size = file_mb << 20
+    if bs > file_size or file_size % bs:
+        raise ValueError(
+            f"--bs {bs} must divide the file size {file_size} "
+            f"(--file-mb {file_mb})")
     fab = Fabric(SystemSetupConfig(
         num_chains=4, num_replicas=2, chunk_size=chunk_size))
-    file_size = file_mb << 20
     # prewrite through the ordinary client path
     res = fab.meta.create(PATH, flags=OpenFlags.WRITE, client_id="bench")
     fio = fab.file_client()

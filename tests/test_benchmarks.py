@@ -24,13 +24,6 @@ class TestStorageBench:
                              replicas=2, chains=1, inject=0.3, verify=True)
         assert rows[0]["ops"] == 8  # retries absorb the injected faults
 
-    def test_usrbio_file_equals_bs(self):
-        from benchmarks.usrbio_bench import run_bench as usrbio
-
-        row = usrbio(bs=65536, iodepth=2, file_mb=1, batches=1,
-                     chunk_size=65536)
-        assert row["ios"] == 2
-
 
 class TestUsrbioBench:
     def test_small_run(self):
@@ -38,6 +31,19 @@ class TestUsrbioBench:
                            chunk_size=65536)
         assert row["ios"] == 16
         assert row["value"] > 0
+
+    def test_file_equals_bs(self):
+        row = usrbio_bench(bs=65536, iodepth=2, file_mb=1, batches=1,
+                           chunk_size=65536)
+        assert row["ios"] == 2
+
+    def test_bad_bs_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            usrbio_bench(bs=2 << 20, file_mb=1)
+        with pytest.raises(ValueError):
+            usrbio_bench(bs=196608, file_mb=1)
 
 
 class TestRebuildBench:

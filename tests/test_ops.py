@@ -4,6 +4,7 @@ Mirrors the reference's strategy of validating checksum paths against known
 implementations (folly::crc32c there; standard vectors here).
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -154,3 +155,88 @@ class TestCrc32c:
         got = np.asarray(bc(chunks))
         assert got[0] == crc32c(b"\x00" * size)
         assert got[1] == crc32c(b"\xff" * size)
+
+
+class TestRSXorFastPath:
+    """The normalized generator (parity row 0 all-ones) and its consequences."""
+
+    def test_parity_row0_is_xor(self):
+        import functools as ft
+
+        rs = RSCode(12, 4)
+        assert (rs.parity_matrix[0] == 1).all()
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, (2, 12, 256), dtype=np.uint8)
+        parity = rs.encode_np(data)
+        assert (parity[:, 0, :] ==
+                ft.reduce(np.bitwise_xor, [data[:, j] for j in range(12)])).all()
+
+    def test_mds_all_single_and_sampled_multi_losses(self):
+        """Column-normalizing the Cauchy matrix must keep the code MDS."""
+        import itertools
+
+        rs = RSCode(6, 3)
+        rng = np.random.default_rng(4)
+        data = rng.integers(0, 256, (6, 64), dtype=np.uint8)
+        shards = np.concatenate([data, rs.encode_np(data)], axis=0)
+        n = rs.k + rs.m
+        patterns = [c for r in range(1, rs.m + 1)
+                    for c in itertools.combinations(range(n), r)]
+        for lost in patterns:
+            present = tuple(i for i in range(n) if i not in lost)[: rs.k]
+            out = rs.reconstruct_np(present, lost, shards[list(present)])
+            assert (out == shards[list(lost)]).all(), f"lost={lost}"
+
+    def test_xor_path_matches_general_decode(self):
+        rs = RSCode(8, 2)
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 256, (8, 128), dtype=np.uint8)
+        shards = np.concatenate([data, rs.encode_np(data)], axis=0)
+        # lose data shard 3: survivors = other data + parity0
+        present = tuple(i for i in range(9) if i != 3)
+        fn = rs.reconstruct_fn(present, (3,))
+        assert rs._xor_rebuild_applies(present, (3,))
+        out = np.asarray(fn(jnp.asarray(shards[list(present)])))
+        assert (out[0] == data[3]).all()
+        # same answer as the numpy gold GF decode
+        gold = rs.reconstruct_np(present, (3,), shards[list(present)])
+        assert (out == gold).all()
+        # lose parity0: xor of all data
+        present = tuple(range(8))
+        fn = rs.reconstruct_fn(present, (8,))
+        assert rs._xor_rebuild_applies(present, (8,))
+        out = np.asarray(fn(jnp.asarray(shards[list(present)])))
+        assert (out[0] == shards[8]).all()
+
+    def test_xor_path_not_applied_when_pattern_disallows(self):
+        rs = RSCode(8, 2)
+        assert not rs._xor_rebuild_applies(tuple(range(1, 9)), (0, 9))
+        assert not rs._xor_rebuild_applies((0, 1, 2, 3, 4, 5, 6, 9), (7,))
+
+
+class TestPallasKernel:
+    """Fused GF(2) matmul kernel vs the einsum/gold paths (interpret mode
+    so the kernel logic runs in CPU CI; the real lowering is exercised on
+    TPU by bench.py)."""
+
+    def test_encode_bit_exact(self):
+        from tpu3fs.ops.pallas_rs import gf2_matmul, prepare_matrix
+
+        rs = RSCode(5, 3)
+        rng = np.random.default_rng(6)
+        data = rng.integers(0, 256, (2, 5, 640), dtype=np.uint8)
+        A = prepare_matrix(np.asarray(rs._parity_bits))
+        out = np.asarray(gf2_matmul(A, jnp.asarray(data), interpret=True,
+                                    block_s=256))
+        assert (out == rs.encode_np(data)).all()
+
+    def test_padding_and_2d_input(self):
+        from tpu3fs.ops.pallas_rs import gf2_matmul, prepare_matrix
+
+        rs = RSCode(4, 2)
+        rng = np.random.default_rng(7)
+        data = rng.integers(0, 256, (4, 300), dtype=np.uint8)  # S not /128
+        A = prepare_matrix(np.asarray(rs._parity_bits))
+        out = np.asarray(gf2_matmul(A, jnp.asarray(data), interpret=True,
+                                    block_s=256))
+        assert (out == rs.encode_np(data)).all()

@@ -76,16 +76,20 @@ def _native_crc():
         import ctypes
         import subprocess
 
-        from tpu3fs.storage.native_engine import _LIB_PATH, _NATIVE_DIR
+        from tpu3fs.storage import native_engine as ne
 
-        # make is a no-op when the .so is current, and rebuilds a stale lib
-        # that predates ce_crc32c_seed — a cached old .so must not silently
-        # degrade every chunk checksum to the ~1000x Python loop
-        subprocess.run(
-            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-            check=True, capture_output=True,
-        )
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ne._load_lib()  # build+dlopen serialized under its _lib_lock
+        if not hasattr(lib, "ce_crc32c_seed"):
+            # stale .so predating ce_crc32c_seed: rebuild (serialized under
+            # the same lock as _load_lib's build) and load a fresh handle —
+            # a cached old lib must not silently degrade every chunk
+            # checksum to the ~1000x Python loop
+            with ne._lib_lock:
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(ne._NATIVE_DIR)],
+                    check=True, capture_output=True,
+                )
+                lib = ctypes.CDLL(ne._LIB_PATH)
         fn = lib.ce_crc32c_seed
         fn.restype = ctypes.c_uint32
         fn.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
