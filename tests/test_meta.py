@@ -11,9 +11,26 @@ from tpu3fs.meta.types import InodeType
 from tpu3fs.utils.result import Code, FsError
 
 
-@pytest.fixture
-def store():
-    return MetaStore(MemKVEngine(), ChainAllocator(1, [101, 102, 103, 104]))
+@pytest.fixture(params=["mem", "remote"])
+def store(request):
+    """The whole per-op suite runs against BOTH the in-memory engine and the
+    network KV service — the reference runs its meta suite against MemKV and
+    real FDB the same way (tests/common/kv/mem vs tests/common/kv/fdb)."""
+    if request.param == "mem":
+        yield MetaStore(MemKVEngine(), ChainAllocator(1, [101, 102, 103, 104]))
+        return
+    from tpu3fs.kv.remote import RemoteKVEngine
+    from tpu3fs.kv.service import KvService, bind_kv_service
+    from tpu3fs.rpc.net import RpcServer
+
+    server = RpcServer()
+    bind_kv_service(server, KvService())
+    server.start()
+    try:
+        yield MetaStore(RemoteKVEngine(server.address),
+                        ChainAllocator(1, [101, 102, 103, 104]))
+    finally:
+        server.stop()
 
 
 ALICE = User(uid=1000, gid=100)

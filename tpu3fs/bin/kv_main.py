@@ -1,0 +1,59 @@
+"""kv service binary: the shared transactional KV store.
+
+Plays the role FoundationDB plays in the reference deployment (meta +
+mgmtd persist through one transactional KV; src/fdb/). Serves the Kv RPC
+service (snapshot/get/getRange/commit/release) over the MVCC engine with an
+optional write-ahead log for restart durability:
+
+  python -m tpu3fs.bin.kv_main --port 9500 [--wal /data/kv.wal] [--rpc native]
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from tpu3fs.app.application import OnePhaseApplication
+from tpu3fs.kv.service import KvService, bind_kv_service
+from tpu3fs.mgmtd.types import NodeType
+from tpu3fs.rpc.net import RpcServer
+from tpu3fs.utils.config import Config, ConfigItem
+
+
+class KvAppConfig(Config):
+    snapshot_ttl_s = ConfigItem(60.0, hot=True)
+
+
+class KvApp(OnePhaseApplication):
+    node_type = NodeType.CLIENT  # not part of the storage data plane
+
+    def __init__(self, argv: Optional[List[str]] = None):
+        super().__init__(argv)
+        self.service: Optional[KvService] = None
+
+    def default_config(self) -> Config:
+        return KvAppConfig()
+
+    def build_services(self, server: RpcServer) -> None:
+        wal = self.flag("wal", "") or None
+        self.service = KvService(
+            wal_path=wal,
+            snapshot_ttl_s=self.config.get("snapshot_ttl_s"),
+        )
+        bind_kv_service(server, self.service)
+        self.config.add_callback(
+            lambda cfg: self.service.set_snapshot_ttl(
+                cfg.get("snapshot_ttl_s")))
+
+    def after_stop(self) -> None:
+        if self.service is not None:
+            self.service.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    KvApp(argv if argv is not None else sys.argv[1:]).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,12 +35,18 @@ class MetaApp(TwoPhaseApplication):
 
     def __init__(self, argv: Optional[List[str]] = None, *, engine=None):
         super().__init__(argv)
-        # NOTE: a real deployment shares one transactional KV across meta
-        # servers (the reference uses FoundationDB); pass a shared engine for
-        # multi-meta setups, else this instance owns a private MemKV.
-        self.engine = engine or MemKVEngine()
+        # --kv host:port points at the shared network KV service (the
+        # FoundationDB role; tpu3fs/bin/kv_main.py) so multiple meta servers
+        # share one namespace; without it this instance owns a private MemKV
+        # (single-node/dev mode)
+        self.engine = engine or self._make_engine()
         self.meta: Optional[MetaStore] = None
         self._fio: Optional[FileIoClient] = None
+
+    def _make_engine(self):
+        from tpu3fs.kv.remote import engine_from_flag
+
+        return engine_from_flag(self.flag("kv", ""))
 
     def default_config(self) -> Config:
         return MetaAppConfig()

@@ -33,9 +33,15 @@ class MgmtdApp(OnePhaseApplication):
     def __init__(self, argv: Optional[List[str]] = None, *, engine=None,
                  clock=None):
         super().__init__(argv)
-        self.engine = engine or MemKVEngine()
+        # --kv host:port = shared network KV (lease CAS across mgmtds)
+        self.engine = engine or self._make_engine()
         self._clock_override = clock
         self.mgmtd: Optional[Mgmtd] = None
+
+    def _make_engine(self):
+        from tpu3fs.kv.remote import engine_from_flag
+
+        return engine_from_flag(self.flag("kv", ""))
 
     def default_config(self) -> Config:
         return MgmtdAppConfig()

@@ -75,6 +75,64 @@ class MemKVEngine(IKVEngine):
     def version(self) -> int:
         return self._version
 
+    # -- external transaction surface (shared by MemTransaction and the
+    # network KV service: one conflict-check + atomic-apply path) ----------
+    def pin_version(self, token, version: int) -> None:
+        """Hold MVCC history >= version alive (remote snapshot in use)."""
+        with self._lock:
+            self._active[token] = version
+
+    def unpin_version(self, token) -> None:
+        with self._lock:
+            self._active.pop(token, None)
+
+    def read_at(self, key: bytes, version: int) -> Optional[bytes]:
+        """Point read at an MVCC snapshot version."""
+        with self._lock:
+            return self._resolve(key, version)
+
+    def range_at(
+        self, begin: bytes, end: bytes, version: int
+    ) -> List[Tuple[bytes, bytes]]:
+        """[begin, end) live pairs at a snapshot version (unlimited)."""
+        with self._lock:
+            out = []
+            for key in self._range_keys(begin, end):
+                val = self._resolve(key, version)
+                if val is not None:
+                    out.append((key, val))
+            return out
+
+    def commit_external(
+        self,
+        read_version: int,
+        read_keys: List[bytes],
+        read_ranges: List[Tuple[bytes, bytes]],
+        writes: Dict[bytes, Optional[bytes]],
+        clear_ranges: List[Tuple[bytes, bytes]],
+        versionstamped: List[Tuple[bytes, bytes, bytes]],
+    ) -> int:
+        """Validate the read set against commits after read_version and, if
+        clean, apply the write set atomically. Returns the commit version;
+        raises FsError(KV_CONFLICT) otherwise."""
+        with self._lock:
+            if self._check_conflicts(read_version, read_keys, read_ranges):
+                raise FsError(Status(Code.KV_CONFLICT, "read-write conflict"))
+            if not writes and not clear_ranges and not versionstamped:
+                return self._version
+            self._version += 1
+            version = self._version
+            all_writes = dict(writes)
+            for order, (prefix, suffix, value) in enumerate(versionstamped):
+                stamp = struct.pack(">QH", version, order)
+                all_writes[prefix + stamp + suffix] = value
+            self._apply(version, all_writes, clear_ranges)
+            self._commits.append(
+                (version, list(all_writes.keys()), list(clear_ranges))
+            )
+            self._maybe_prune()
+            return version
+
     # -- internals used by MemTransaction ----------------------------------
     def _resolve(self, key: bytes, version: int) -> Optional[bytes]:
         history = self._data.get(key)
@@ -229,25 +287,14 @@ class MemTransaction(ITransaction):
         eng = self._engine
         with eng._lock:
             eng._active.pop(id(self), None)
-            if eng._check_conflicts(
-                self._read_version, self._read_keys, self._read_ranges
-            ):
-                raise FsError(Status(Code.KV_CONFLICT, "read-write conflict"))
-            if not self._writes and not self._clear_ranges and not self._versionstamped:
-                self._committed_version = eng._version
-                return
-            eng._version += 1
-            version = eng._version
-            writes = dict(self._writes)
-            for order, (prefix, suffix, value) in enumerate(self._versionstamped):
-                stamp = struct.pack(">QH", version, order)
-                writes[prefix + stamp + suffix] = value
-            eng._apply(version, writes, self._clear_ranges)
-            eng._commits.append(
-                (version, list(writes.keys()), list(self._clear_ranges))
+            self._committed_version = eng.commit_external(
+                self._read_version,
+                self._read_keys,
+                self._read_ranges,
+                self._writes,
+                self._clear_ranges,
+                self._versionstamped,
             )
-            self._committed_version = version
-            eng._maybe_prune()
 
     def cancel(self) -> None:
         self._done = True

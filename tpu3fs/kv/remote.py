@@ -1,0 +1,211 @@
+"""RemoteKVEngine: IKVEngine client for the network KV service.
+
+The FDB-client model (ref src/fdb/FDBTransaction.h semantics over our own
+service instead of the FDB C library): a transaction takes a server snapshot
+version, reads at that version over RPC, buffers writes/clears locally with
+read-your-writes overlay, and submits ONE atomic commit RPC carrying the
+read set — the server validates and applies. Conflicts surface as
+FsError(KV_CONFLICT) so the standard with_transaction retry loop drives
+retries identically to the in-memory engine; the meta/mgmtd suites run
+unchanged on top.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from tpu3fs.kv.kv import IKVEngine, ITransaction, KVPair
+from tpu3fs.kv.service import (
+    KV_SERVICE_ID,
+    CommitReq,
+    CommitRsp,
+    EmptyMsg,
+    GetReq,
+    GetRsp,
+    RangeEntry,
+    RangeReq,
+    RangeRsp,
+    ReleaseReq,
+    SnapshotReq,
+    SnapshotRsp,
+    StampEntry,
+    WriteEntry,
+)
+from tpu3fs.rpc.net import RpcClient
+from tpu3fs.utils.result import Code, FsError, Status
+
+
+def engine_from_flag(kv_flag: str):
+    """'host:port' -> RemoteKVEngine; empty -> local MemKVEngine (dev)."""
+    if kv_flag:
+        host, port = kv_flag.rsplit(":", 1)
+        return RemoteKVEngine((host, int(port)))
+    from tpu3fs.kv.mem import MemKVEngine
+
+    return MemKVEngine()
+
+
+class RemoteKVEngine(IKVEngine):
+    def __init__(self, addr: Tuple[str, int],
+                 client: Optional[RpcClient] = None,
+                 client_id: str = ""):
+        self._addr = (addr[0], int(addr[1]))
+        self._client = client or RpcClient()
+        self._client_id = client_id
+
+    def _call(self, method_id: int, req, rsp_type):
+        return self._client.call(
+            self._addr, KV_SERVICE_ID, method_id, req, rsp_type
+        )
+
+    def transaction(self) -> "RemoteTransaction":
+        rsp = self._call(1, SnapshotReq(self._client_id), SnapshotRsp)
+        return RemoteTransaction(self, rsp.version)
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class RemoteTransaction(ITransaction):
+    """Local write buffer + RPC snapshot reads + single commit RPC."""
+
+    def __init__(self, engine: RemoteKVEngine, read_version: int):
+        self._engine = engine
+        self._read_version = read_version
+        self._writes: Dict[bytes, Optional[bytes]] = {}
+        self._clear_ranges: List[Tuple[bytes, bytes]] = []
+        self._read_keys: List[bytes] = []
+        self._read_ranges: List[Tuple[bytes, bytes]] = []
+        self._versionstamped: List[Tuple[bytes, bytes, bytes]] = []
+        self._committed_version: Optional[int] = None
+        self._done = False
+
+    # -- reads (read-your-writes overlay, same rules as MemTransaction) -----
+    def _local_lookup(self, key: bytes):
+        if key in self._writes:
+            return True, self._writes[key]
+        for begin, end in self._clear_ranges:
+            if begin <= key < end:
+                return True, None
+        return False, None
+
+    def _remote_get(self, key: bytes) -> Optional[bytes]:
+        rsp = self._engine._call(
+            2, GetReq(bytes(key), self._read_version), GetRsp
+        )
+        return rsp.value if rsp.found else None
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        found, val = self._local_lookup(key)
+        if found:
+            return val
+        self._read_keys.append(bytes(key))
+        return self._remote_get(key)
+
+    def snapshot_get(self, key: bytes) -> Optional[bytes]:
+        found, val = self._local_lookup(key)
+        if found:
+            return val
+        return self._remote_get(key)
+
+    def get_range(
+        self,
+        begin: bytes,
+        end: bytes,
+        *,
+        limit: int = 0,
+        reverse: bool = False,
+        snapshot: bool = False,
+    ) -> List[KVPair]:
+        begin, end = bytes(begin), bytes(end)
+        if not snapshot:
+            self._read_ranges.append((begin, end))
+        # push limit/reverse to the server only when no buffered local edits
+        # could change which keys survive the overlay; otherwise fetch the
+        # full range and trim after merging
+        clean = not self._writes and not self._clear_ranges
+        rsp = self._engine._call(
+            3,
+            RangeReq(begin, end, self._read_version,
+                     limit if clean else 0, reverse if clean else False),
+            RangeRsp,
+        )
+        merged: Dict[bytes, Optional[bytes]] = {
+            p.key: p.value for p in rsp.pairs
+        }
+        for rb, re_ in self._clear_ranges:
+            for key in list(merged):
+                if rb <= key < re_:
+                    merged[key] = None
+        for key, val in self._writes.items():
+            if begin <= key < end:
+                merged[key] = val
+        items = sorted(
+            (k for k, v in merged.items() if v is not None), reverse=reverse
+        )
+        if limit:
+            items = items[:limit]
+        return [KVPair(k, merged[k]) for k in items]
+
+    def add_read_conflict(self, key: bytes) -> None:
+        self._read_keys.append(bytes(key))
+
+    # -- writes --------------------------------------------------------------
+    def set(self, key: bytes, value: bytes) -> None:
+        assert not self._done
+        self._writes[bytes(key)] = bytes(value)
+
+    def set_versionstamped_key(self, prefix: bytes, suffix: bytes,
+                               value: bytes) -> None:
+        assert not self._done
+        self._versionstamped.append(
+            (bytes(prefix), bytes(suffix), bytes(value)))
+
+    def clear(self, key: bytes) -> None:
+        assert not self._done
+        self._writes[bytes(key)] = None
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        assert not self._done
+        begin, end = bytes(begin), bytes(end)
+        for key in [k for k in self._writes if begin <= k < end]:
+            del self._writes[key]
+        self._clear_ranges.append((begin, end))
+
+    # -- commit ---------------------------------------------------------------
+    def commit(self) -> None:
+        assert not self._done
+        self._done = True
+        req = CommitReq(
+            read_version=self._read_version,
+            read_keys=list(self._read_keys),
+            read_ranges=[RangeEntry(b, e) for b, e in self._read_ranges],
+            writes=[
+                WriteEntry(k, v if v is not None else b"", v is None)
+                for k, v in self._writes.items()
+            ],
+            clear_ranges=[RangeEntry(b, e) for b, e in self._clear_ranges],
+            versionstamped=[
+                StampEntry(p, s, v) for p, s, v in self._versionstamped
+            ],
+        )
+        try:
+            rsp = self._engine._call(4, req, CommitRsp)
+            self._committed_version = rsp.version
+        finally:
+            self._release()  # on conflict too: free the snapshot pin now
+
+    def cancel(self) -> None:
+        if not self._done:
+            self._done = True
+            self._release()
+
+    def _release(self) -> None:
+        try:
+            self._engine._call(5, ReleaseReq(self._read_version), EmptyMsg)
+        except FsError:
+            pass  # pin expires by TTL server-side
+
+    @property
+    def committed_version(self) -> Optional[int]:
+        return self._committed_version
