@@ -80,7 +80,14 @@ class MetaApp(TwoPhaseApplication):
             default_chunk_size=self.config.get("chunk_size"),
             default_stripe=self.config.get("stripe"),
         )
-        bind_meta_service(server, self.meta)
+        # --auth 1: enforce bearer-token authentication via the UserStore
+        # in the shared KV (ref src/core/user; tokens resolved server-side)
+        user_store = None
+        if self.flag("auth", "") in ("1", "true", "yes"):
+            from tpu3fs.core.user import UserStore
+
+            user_store = UserStore(self.engine)
+        bind_meta_service(server, self.meta, user_store=user_store)
 
     def before_start(self) -> None:
         self.spawn(self._gc_loop, "meta-gc")

@@ -240,6 +240,37 @@ class AdminCli:
     def cmd_gc_run(self, args: List[str]) -> str:
         return f"gc reclaimed {self.fab.run_gc()} files"
 
+    # -- users (ref src/core/user UserStore; admin_cli user commands) --------
+    def _users(self):
+        from tpu3fs.core.user import UserStore
+
+        return UserStore(self.fab.kv)
+
+    def cmd_user_add(self, args: List[str]) -> str:
+        uid = int(args[0])
+        has_name = len(args) > 1 and not args[1].startswith("-")
+        name = args[1] if has_name else f"user{uid}"
+        rec = self._users().add_user(
+            uid, name,
+            gid=int(self._flag(args, "--gid", uid)),
+            admin="--admin" in args, root="--root" in args,
+        )
+        return f"user {rec.uid} ({rec.name}) token={rec.token}"
+
+    def cmd_user_list(self, args: List[str]) -> str:
+        rows = [
+            f"{r.uid:<6} {r.name:<16} gid={r.gid} admin={r.admin} root={r.root}"
+            for r in self._users().list_users()
+        ]
+        return "\n".join(rows) if rows else "(no users)"
+
+    def cmd_user_remove(self, args: List[str]) -> str:
+        ok = self._users().remove_user(int(args[0]))
+        return "removed" if ok else "no such user"
+
+    def cmd_user_rotate_token(self, args: List[str]) -> str:
+        return f"new token: {self._users().rotate_token(int(args[0]))}"
+
     # -- trash (ref hf3fs_utils/trash.py + trash_cleaner) --------------------
     def cmd_trash_put(self, args: List[str]) -> str:
         from tpu3fs.utils import trash as _trash

@@ -1,0 +1,3 @@
+from tpu3fs.core.user import AclCache, UserRecord, UserStore
+
+__all__ = ["AclCache", "UserRecord", "UserStore"]

@@ -60,6 +60,12 @@ _INODE_COUNTER_KEY = b"INOA" + b"counter"
 class User:
     uid: int = 0
     gid: int = 0
+    groups: tuple = ()
+    root: bool = False
+
+    @property
+    def is_root(self) -> bool:
+        return self.uid == 0 or self.root
 
 
 ROOT_USER = User(0, 0)
@@ -234,7 +240,7 @@ class MetaStore:
         for i, name in enumerate(parts):
             if not parent.is_dir():
                 raise _err(Code.META_NOT_DIRECTORY, "/" + "/".join(parts[:i]))
-            if not parent.acl.check(user.uid, user.gid, PERM_X):
+            if not parent.acl.check_user(user, PERM_X):
                 raise _err(Code.META_NO_PERMISSION, "/" + "/".join(parts[:i]))
             ent = self._load_dirent(txn, parent.id, name)
             if ent is None:
@@ -329,7 +335,7 @@ class MetaStore:
         return with_transaction(self._engine, op)
 
     def _check_dir_writable(self, d: Inode, user: User) -> None:
-        if not d.acl.check(user.uid, user.gid, PERM_W | PERM_X):
+        if not d.acl.check_user(user, PERM_W | PERM_X):
             raise _err(Code.META_NO_PERMISSION, f"dir {d.id}")
         if d.locked_by:
             raise _err(Code.META_NO_PERMISSION, f"dir {d.id} locked by {d.locked_by}")
@@ -417,7 +423,7 @@ class MetaStore:
             want |= PERM_R
         if flags & OpenFlags.WRITE:
             want |= PERM_W
-        if want and not inode.acl.check(user.uid, user.gid, want):
+        if want and not inode.acl.check_user(user, want):
             raise _err(Code.META_NO_PERMISSION, str(inode.id))
         session_id = ""
         if inode.is_file() and flags & OpenFlags.WRITE:
@@ -566,7 +572,7 @@ class MetaStore:
                 raise _err(Code.META_NOT_FOUND, path)
             if not inode.is_dir():
                 raise _err(Code.META_NOT_DIRECTORY, path)
-            if not inode.acl.check(user.uid, user.gid, PERM_R):
+            if not inode.acl.check_user(user, PERM_R):
                 raise _err(Code.META_NO_PERMISSION, path)
             begin, end = dirent_scan_range(inode.id)
             if prefix:
@@ -694,12 +700,12 @@ class MetaStore:
             _, _, inode = self._walk(txn, path, user)
             if inode is None:
                 raise _err(Code.META_NOT_FOUND, path)
-            if user.uid != 0 and user.uid != inode.acl.uid:
+            if not user.is_root and user.uid != inode.acl.uid:
                 raise _err(Code.META_NO_PERMISSION, path)
             if perm is not None:
                 inode.acl.perm = perm
             if uid is not None:
-                if user.uid != 0:
+                if not user.is_root:
                     raise _err(Code.META_NO_PERMISSION, "chown requires root")
                 inode.acl.uid = uid
             if gid is not None:
@@ -721,7 +727,7 @@ class MetaStore:
                 raise _err(Code.META_NOT_FOUND, path)
             if not inode.is_file():
                 raise _err(Code.META_NOT_FILE, path)
-            if not inode.acl.check(user.uid, user.gid, PERM_W):
+            if not inode.acl.check_user(user, PERM_W):
                 raise _err(Code.META_NO_PERMISSION, path)
             inode.length = length
             inode.mtime = time.time()
@@ -773,7 +779,7 @@ class MetaStore:
             if inode.locked_by and inode.locked_by != owner:
                 # changing or clearing someone else's lock needs privilege
                 # (root or the directory owner)
-                if user.uid != 0 and user.uid != inode.acl.uid:
+                if not user.is_root and user.uid != inode.acl.uid:
                     raise _err(
                         Code.META_NO_PERMISSION, f"locked by {inode.locked_by}"
                     )

@@ -41,17 +41,24 @@ class Acl:
     gid: int = 0
     perm: int = 0o755
 
-    def check(self, uid: int, gid: int, want: int) -> bool:
-        """want: bitmask of PERM_R/W/X. uid 0 bypasses like root."""
-        if uid == 0:
+    def check(self, uid: int, gid: int, want: int,
+              groups: tuple = (), root: bool = False) -> bool:
+        """want: bitmask of PERM_R/W/X. uid 0 (or root flag) bypasses."""
+        if uid == 0 or root:
             return True
         if uid == self.uid:
             bits = (self.perm >> 6) & 7
-        elif gid == self.gid:
+        elif gid == self.gid or self.gid in groups:
             bits = (self.perm >> 3) & 7
         else:
             bits = self.perm & 7
         return (bits & want) == want
+
+    def check_user(self, user, want: int) -> bool:
+        """Acl check for a User carrying supplementary groups/root flag."""
+        return self.check(user.uid, user.gid, want,
+                          getattr(user, "groups", ()),
+                          getattr(user, "root", False))
 
 
 @functools.lru_cache(maxsize=4096)

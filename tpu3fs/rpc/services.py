@@ -262,6 +262,7 @@ class PathReq:
     uid: int = 0
     gid: int = 0
     follow: bool = True
+    token: str = ""
 
 
 @dataclass
@@ -274,6 +275,7 @@ class CreateReq:
     chunk_size: int = 0
     stripe: int = 0
     client_id: str = ""
+    token: str = ""
 
 
 @dataclass
@@ -283,6 +285,7 @@ class OpenReq:
     gid: int = 0
     flags: int = 1
     client_id: str = ""
+    token: str = ""
 
 
 @dataclass
@@ -293,6 +296,7 @@ class CloseReq:
     client_id: str = ""
     request_id: str = ""
     wrote: int = -1  # -1 unknown, 0 read-only session, 1 wrote
+    token: str = ""
 
 
 @dataclass
@@ -302,6 +306,7 @@ class MkdirsReq:
     gid: int = 0
     perm: int = 0o755
     recursive: bool = False
+    token: str = ""
 
 
 @dataclass
@@ -312,6 +317,7 @@ class RemoveReq:
     recursive: bool = False
     client_id: str = ""
     request_id: str = ""
+    token: str = ""
 
 
 @dataclass
@@ -320,6 +326,7 @@ class RenameReq:
     dst: str
     uid: int = 0
     gid: int = 0
+    token: str = ""
 
 
 @dataclass
@@ -328,6 +335,7 @@ class SymlinkReq:
     target: str
     uid: int = 0
     gid: int = 0
+    token: str = ""
 
 
 @dataclass
@@ -336,6 +344,7 @@ class HardLinkReq:
     dst: str
     uid: int = 0
     gid: int = 0
+    token: str = ""
 
 
 @dataclass
@@ -345,6 +354,7 @@ class ListReq:
     gid: int = 0
     limit: int = 0
     prefix: str = ""
+    token: str = ""
 
 
 @dataclass
@@ -365,6 +375,7 @@ class SetAttrReq:
     mtime: float = 0.0
     has_atime: bool = False
     has_mtime: bool = False
+    token: str = ""
 
 
 @dataclass
@@ -373,22 +384,26 @@ class TruncateReq:
     length: int
     uid: int = 0
     gid: int = 0
+    token: str = ""
 
 
 @dataclass
 class SyncReq:
     inode_id: int
     length_hint: int = -1
+    token: str = ""
 
 
 @dataclass
 class PruneSessionReq:
     client_id: str
+    token: str = ""
 
 
 @dataclass
 class BatchStatReq:
     inode_ids: List[int] = field(default_factory=list)
+    token: str = ""
 
 
 @dataclass
@@ -412,13 +427,62 @@ class OpenRsp:
     session_id: str = ""
 
 
-def bind_meta_service(server: RpcServer, meta: MetaStore) -> None:
+@dataclass
+class StatFsReq:
+    token: str = ""
+
+
+@dataclass
+class AuthReq:
+    token: str = ""
+
+
+@dataclass
+class AuthRsp:
+    uid: int = 0
+    gid: int = 0
+    name: str = ""
+    admin: bool = False
+
+
+def bind_meta_service(server: RpcServer, meta: MetaStore, *,
+                      user_store=None, acl_ttl_s: float = 5.0) -> None:
+    """With a user_store, every op authenticates its bearer token through a
+    TTL AclCache and the SERVER derives identity from the user record —
+    claimed uid/gid in requests are ignored (ref UserStore + AclCache;
+    MetaSerde has an authenticate method the same way). Without one,
+    requests are trusted (single-tenant/dev mode, like the reference run
+    without token enforcement)."""
     s = ServiceDef(META_SERVICE_ID, "MetaSerde")
 
-    def u(req) -> User:
-        return User(req.uid, req.gid)
+    acl_cache = None
+    if user_store is not None:
+        from tpu3fs.core.user import AclCache
 
-    s.method(1, "statFs", Empty, StatFs, lambda r: meta.stat_fs())
+        acl_cache = AclCache(user_store, ttl_s=acl_ttl_s)
+
+    def u(req) -> User:
+        if acl_cache is None:
+            return User(req.uid, req.gid)
+        rec = acl_cache.authenticate(getattr(req, "token", ""))
+        return rec.as_user()
+
+    def gate(req) -> None:
+        """Session-scoped ops (statFs/sync/close/prune/batchStat) carry no
+        path identity but still require a valid bearer token in auth mode."""
+        if acl_cache is not None:
+            acl_cache.authenticate(getattr(req, "token", ""))
+
+    def authenticate(req: AuthReq) -> AuthRsp:
+        if acl_cache is None:
+            return AuthRsp(0, 0, "root", True)
+        rec = acl_cache.authenticate(req.token)
+        return AuthRsp(rec.uid, rec.gid, rec.name, rec.admin)
+
+    s.method(18, "authenticate", AuthReq, AuthRsp, authenticate)
+
+    s.method(1, "statFs", StatFsReq, StatFs,
+             lambda r: (gate(r), meta.stat_fs())[1])
     s.method(2, "stat", PathReq, InodeRsp,
              lambda r: InodeRsp(meta.stat(r.path, u(r), follow=r.follow)))
     s.method(3, "create", CreateReq, OpenRsp, lambda r: _open_rsp(
@@ -436,13 +500,15 @@ def bind_meta_service(server: RpcServer, meta: MetaStore) -> None:
                     client_id=r.client_id, request_id=r.request_id), Empty())[1])
     s.method(8, "open", OpenReq, OpenRsp, lambda r: _open_rsp(
         meta.open(r.path, u(r), flags=r.flags, client_id=r.client_id)))
-    s.method(9, "sync", SyncReq, InodeRsp, lambda r: InodeRsp(meta.sync(
-        r.inode_id, length_hint=None if r.length_hint < 0 else r.length_hint)))
-    s.method(10, "close", CloseReq, InodeRsp, lambda r: InodeRsp(meta.close(
-        r.inode_id, r.session_id,
-        length_hint=None if r.length_hint < 0 else r.length_hint,
-        client_id=r.client_id, request_id=r.request_id,
-        wrote=None if r.wrote < 0 else bool(r.wrote))))
+    s.method(9, "sync", SyncReq, InodeRsp, lambda r: (gate(r), InodeRsp(
+        meta.sync(r.inode_id,
+                  length_hint=None if r.length_hint < 0
+                  else r.length_hint)))[1])
+    s.method(10, "close", CloseReq, InodeRsp, lambda r: (gate(r), InodeRsp(
+        meta.close(r.inode_id, r.session_id,
+                   length_hint=None if r.length_hint < 0 else r.length_hint,
+                   client_id=r.client_id, request_id=r.request_id,
+                   wrote=None if r.wrote < 0 else bool(r.wrote))))[1])
     s.method(11, "rename", RenameReq, Empty,
              lambda r: (meta.rename(r.src, r.dst, u(r)), Empty())[1])
     s.method(12, "list", ListReq, ListRsp, lambda r: ListRsp(
@@ -459,9 +525,9 @@ def bind_meta_service(server: RpcServer, meta: MetaStore) -> None:
                       atime=r.atime if r.has_atime else None,
                       mtime=r.mtime if r.has_mtime else None)))
     s.method(16, "pruneSession", PruneSessionReq, IntReply,
-             lambda r: IntReply(meta.prune_session(r.client_id)))
+             lambda r: (gate(r), IntReply(meta.prune_session(r.client_id)))[1])
     s.method(17, "batchStat", BatchStatReq, BatchStatRsp,
-             lambda r: BatchStatRsp(meta.batch_stat(r.inode_ids)))
+             lambda r: (gate(r), BatchStatRsp(meta.batch_stat(r.inode_ids)))[1])
     server.add_service(s)
 
 
@@ -478,15 +544,23 @@ class MetaRpcClient:
         addrs: List[Tuple[str, int]],
         client: Optional[RpcClient] = None,
         client_id: str = "",
+        token: str = "",
     ):
         if not addrs:
             raise ValueError("need at least one meta server address")
         self._addrs = list(addrs)
         self._client = client or RpcClient()
         self.client_id = client_id
+        self.token = token
         self._cursor = 0
 
+    def authenticate(self, token: Optional[str] = None) -> "AuthRsp":
+        return self._call(18, AuthReq(self.token if token is None else token),
+                          AuthRsp)
+
     def _call(self, method_id: int, req, rsp_type):
+        if self.token and hasattr(req, "token") and not req.token:
+            req.token = self.token
         last: Optional[FsError] = None
         for i in range(len(self._addrs)):
             addr = self._addrs[(self._cursor + i) % len(self._addrs)]
@@ -573,7 +647,7 @@ class MetaRpcClient:
         return self._call(12, ListReq(path, limit=limit, prefix=prefix), ListRsp).entries
 
     def stat_fs(self) -> StatFs:
-        return self._call(1, Empty(), StatFs)
+        return self._call(1, StatFsReq(), StatFs)
 
     def get_real_path(self, path: str) -> str:
         return self._call(14, PathReq(path), StrReply).value
