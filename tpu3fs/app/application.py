@@ -146,10 +146,26 @@ class ApplicationBase:
         self.init_common_components()
         self.init_server()
         self.start_server()
+        self._start_memory_monitor()
         if block:
             self._install_signal_handlers()
             self.wait()
         return self
+
+    def _start_memory_monitor(self, interval_s: float = 30.0) -> None:
+        """Periodic process-memory gauges (ref src/memory counters)."""
+        from tpu3fs.monitor.memory import MemoryMonitor
+
+        self.memory_monitor = MemoryMonitor(
+            {"node": str(self.info.node_id),
+             "kind": type(self).__name__})
+
+        def loop() -> None:
+            while not self._stop.wait(interval_s):
+                self.memory_monitor.poll_once()
+
+        self.memory_monitor.poll_once()
+        self.spawn(loop, "memory-monitor")
 
     def wait(self) -> None:
         try:

@@ -240,6 +240,22 @@ class AdminCli:
     def cmd_gc_run(self, args: List[str]) -> str:
         return f"gc reclaimed {self.fab.run_gc()} files"
 
+    # -- namespace scans (ref src/meta/event/Scan.cc; DumpInodes admin cmds) -
+    def cmd_scan_stats(self, args: List[str]) -> str:
+        from tpu3fs.meta.scan import namespace_stats
+
+        st = namespace_stats(self.fab.kv)
+        return (f"files={st['files']} dirs={st['dirs']} "
+                f"symlinks={st['symlinks']} bytes={st['total_length']}")
+
+    def cmd_find_orphans(self, args: List[str]) -> str:
+        from tpu3fs.meta.scan import find_orphan_inodes
+
+        orphans = find_orphan_inodes(self.fab.kv)
+        if not orphans:
+            return "no orphan inodes"
+        return "\n".join(f"inode {o.id} nlink={o.nlink}" for o in orphans)
+
     # -- users (ref src/core/user UserStore; admin_cli user commands) --------
     def _users(self):
         from tpu3fs.core.user import UserStore

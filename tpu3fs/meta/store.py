@@ -155,9 +155,12 @@ class MetaStore:
         space_hook: Optional[Callable[[], Tuple[int, int]]] = None,
         default_chunk_size: int = 1 << 20,
         default_stripe: int = 1,
+        event_log=None,
     ):
         self._engine = engine
         self._ids = InodeIdAllocator(engine)
+        # optional structured meta event stream (ref src/meta/event/Event.cc)
+        self._events = event_log
         self._chains = chain_allocator or ChainAllocator(1, [1])
         # queries storage for the real last-chunk length on close/fsync
         # (ref FileHelper.cc queryLastChunk)
@@ -174,6 +177,15 @@ class MetaStore:
         self._ensure_root()
 
     # -- low-level codecs ---------------------------------------------------
+    def _emit(self, op: str, path: str, *, inode_id: int = 0,
+              uid: int = 0, detail: str = "") -> None:
+        if self._events is not None:
+            try:
+                self._events.append(op, path, inode_id=inode_id, uid=uid,
+                                    detail=detail)
+            except Exception:
+                pass  # event stream is best-effort observability
+
     @staticmethod
     def _load_inode(txn: ITransaction, inode_id: int) -> Optional[Inode]:
         raw = txn.get(inode_key(inode_id))
@@ -275,9 +287,21 @@ class MetaStore:
 
         return with_transaction(self._engine, op, read_only=True)
 
-    def batch_stat(self, inode_ids: List[int]) -> List[Optional[Inode]]:
+    def batch_stat(self, inode_ids: List[int],
+                   user: Optional[User] = None) -> List[Optional[Inode]]:
+        """With a user, inodes the user lacks read permission on come back
+        as None (auth mode: inode-id access skips the path walk, so the
+        per-inode read bit is the enforceable check)."""
+
         def op(txn: ITransaction):
-            return [self._load_inode(txn, i) for i in inode_ids]
+            out = []
+            for i in inode_ids:
+                ino = self._load_inode(txn, i)
+                if (ino is not None and user is not None
+                        and not ino.acl.check_user(user, PERM_R)):
+                    ino = None
+                out.append(ino)
+            return out
 
         return with_transaction(self._engine, op, read_only=True)
 
@@ -332,7 +356,9 @@ class MetaStore:
             assert created is not None
             return created
 
-        return with_transaction(self._engine, op)
+        result = with_transaction(self._engine, op)
+        self._emit("mkdir", path, inode_id=result.id, uid=user.uid)
+        return result
 
     def _check_dir_writable(self, d: Inode, user: User) -> None:
         if not d.acl.check_user(user, PERM_W | PERM_X):
@@ -383,6 +409,7 @@ class MetaStore:
 
         result = with_transaction(self._engine, op)
         self._maybe_truncate_chunks(result, flags)
+        self._emit("create", path, inode_id=result.inode.id, uid=user.uid)
         return result
 
     def open(
@@ -459,6 +486,7 @@ class MetaStore:
         client_id: str = "",
         request_id: str = "",
         wrote: Optional[bool] = None,
+        user: Optional[User] = None,
     ) -> Inode:
         """Close a write session; settle the precise file length
         (ref src/meta/store/ops/Close; FileHelper queryLastChunk).
@@ -475,6 +503,8 @@ class MetaStore:
             inode = self._load_inode(txn, inode_id)
             if inode is None:
                 raise _err(Code.META_NOT_FOUND, str(inode_id))
+            if user is not None and not inode.acl.check_user(user, PERM_W):
+                raise _err(Code.META_NO_PERMISSION, str(inode_id))
             skey = session_key(inode_id, session_id)
             if session_id:
                 if txn.get(skey) is None:
@@ -494,13 +524,17 @@ class MetaStore:
 
         return with_transaction(self._engine, op)
 
-    def sync(self, inode_id: int, *, length_hint: Optional[int] = None) -> Inode:
-        """fsync: refresh the length hint without closing the session."""
+    def sync(self, inode_id: int, *, length_hint: Optional[int] = None,
+             user: Optional[User] = None) -> Inode:
+        """fsync: refresh the length hint without closing the session.
+        With a user, requires write permission on the inode (auth mode)."""
 
         def op(txn: ITransaction) -> Inode:
             inode = self._load_inode(txn, inode_id)
             if inode is None:
                 raise _err(Code.META_NOT_FOUND, str(inode_id))
+            if user is not None and not inode.acl.check_user(user, PERM_W):
+                raise _err(Code.META_NO_PERMISSION, str(inode_id))
             if inode.is_file():
                 if self._file_length_hook is not None:
                     inode.length = self._file_length_hook(inode)
@@ -512,8 +546,15 @@ class MetaStore:
 
         return with_transaction(self._engine, op)
 
-    def prune_session(self, client_id: str) -> int:
-        """Drop all sessions of a dead client (ref SessionManager prune)."""
+    def prune_session(self, client_id: str,
+                      user: Optional[User] = None, *,
+                      admin: bool = False) -> int:
+        """Drop all sessions of a dead client (ref SessionManager prune).
+        With a user, pruning requires root or the admin flag — it destroys
+        other clients' live write sessions."""
+        if user is not None and not (user.is_root or admin):
+            raise _err(Code.META_NO_PERMISSION,
+                       "prune-session requires admin")
 
         def op(txn: ITransaction) -> int:
             begin, end = session_scan_range()
@@ -542,7 +583,10 @@ class MetaStore:
             )
             return inode
 
-        return with_transaction(self._engine, op)
+        result = with_transaction(self._engine, op)
+        self._emit("symlink", path, inode_id=result.id, uid=user.uid,
+                   detail=target)
+        return result
 
     def hard_link(self, src: str, dst: str, user: User = ROOT_USER) -> Inode:
         def op(txn: ITransaction) -> Inode:
@@ -613,7 +657,10 @@ class MetaStore:
             if request_id:
                 txn.set(idempotent_key(client_id, request_id), b"1")
 
-        return with_transaction(self._engine, op)
+        result = with_transaction(self._engine, op)
+        self._emit("remove", path, uid=user.uid,
+                   detail="recursive" if recursive else "")
+        return result
 
     def _remove_inode(
         self, txn: ITransaction, parent_id: int, name: str, inode: Inode,
@@ -683,7 +730,9 @@ class MetaStore:
                 sinode.parent = dparent.id
                 self._store_inode(txn, sinode)
 
-        return with_transaction(self._engine, op)
+        result = with_transaction(self._engine, op)
+        self._emit("rename", src, uid=user.uid, detail=dst)
+        return result
 
     def set_attr(
         self,
