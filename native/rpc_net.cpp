@@ -187,8 +187,16 @@ int set_nonblocking(int fd, bool nb) {
   return fcntl(fd, F_SETFL, nb ? (fl | O_NONBLOCK) : (fl & ~O_NONBLOCK));
 }
 
-// blocking send-all with EAGAIN poll (socket may be nonblocking)
-bool send_all(int fd, const char* data, size_t len) {
+// send-all with EAGAIN poll (socket may be nonblocking). drain_timeout_ms
+// bounds how long we wait for the peer to drain its receive window: a
+// stalled reader must not pin a server worker thread (and the connection's
+// write_mu) indefinitely — head-of-line blocking across the whole pool.
+bool send_all(int fd, const char* data, size_t len, int drain_timeout_ms) {
+  // drain_timeout_ms bounds the WHOLE send, not each EAGAIN: a slow-drip
+  // reader that accepts a few bytes every few seconds would reset a
+  // per-poll timeout forever and still pin the worker
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(drain_timeout_ms);
   size_t off = 0;
   while (off < len) {
     ssize_t n = ::send(fd, data + off, len - off, MSG_NOSIGNAL);
@@ -197,8 +205,12 @@ bool send_all(int fd, const char* data, size_t len) {
       continue;
     }
     if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      deadline - std::chrono::steady_clock::now())
+                      .count();
+      if (left <= 0) return false;
       struct pollfd pfd = {fd, POLLOUT, 0};
-      if (poll(&pfd, 1, 30000) <= 0) return false;
+      if (poll(&pfd, 1, int(left)) <= 0) return false;
       continue;
     }
     if (n < 0 && errno == EINTR) continue;
@@ -206,6 +218,10 @@ bool send_all(int fd, const char* data, size_t len) {
   }
   return true;
 }
+
+// a server reply may stall this long per EAGAIN before the connection is
+// declared dead and closed (workers return to the queue instead of blocking)
+constexpr int kServerDrainTimeoutMs = 5000;
 
 bool recv_exact(int fd, uint8_t* out, size_t len) {  // blocking socket
   size_t off = 0;
@@ -273,6 +289,15 @@ struct Conn {
   // read framing state (owned by the event loop thread)
   std::string inbuf;
   std::atomic<bool> closed{false};
+  // the fd is closed ONLY here, when the last reference dies: a worker may
+  // be inside send_all on this fd concurrently with the event loop closing
+  // the connection, and an early ::close() would let the kernel hand the
+  // same fd number to a new accept — the worker's reply bytes would then
+  // land in an unrelated client's connection. shutdown() (in
+  // server_close_conn) unblocks such senders; close() must wait for them.
+  ~Conn() {
+    if (fd >= 0) ::close(fd);
+  }
 };
 
 struct Job {
@@ -301,17 +326,15 @@ struct Server {
 void server_close_conn(Server* s, const std::shared_ptr<Conn>& c) {
   bool was = c->closed.exchange(true);
   if (!was) {
-    // erase from the map (and epoll) BEFORE close(): once the fd is closed
-    // the kernel may hand the same number to a new accept, and erasing
-    // afterwards would remove the live connection while its fd stays in
-    // epoll — a 100%-CPU level-triggered spin
     {
       std::lock_guard<std::mutex> g(s->conns_mu);
       s->conns.erase(c->fd);
     }
     epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+    // shutdown unblocks any worker currently in send_all on this fd; the
+    // actual ::close() is deferred to ~Conn so the fd number cannot be
+    // reused while a worker still holds a reference (see Conn)
     ::shutdown(c->fd, SHUT_RDWR);
-    ::close(c->fd);
   }
 }
 
@@ -358,7 +381,8 @@ void worker_main(Server* s) {
     {
       std::lock_guard<std::mutex> g(job.conn->write_mu);
       if (!job.conn->closed.load() &&
-          !send_all(job.conn->fd, wire.data(), wire.size())) {
+          !send_all(job.conn->fd, wire.data(), wire.size(),
+                    kServerDrainTimeoutMs)) {
         server_close_conn(s, job.conn);
       }
     }
@@ -461,6 +485,7 @@ void loop_main(Server* s) {
 // ---- client ---------------------------------------------------------------
 struct Client {
   int fd = -1;
+  int call_timeout_ms = 30000;
   std::mt19937_64 rng{std::random_device{}()};
   std::mutex mu;  // one in-flight call per connection
 };
@@ -547,8 +572,7 @@ void tpu3fs_rpc_server_stop(void* srv) {
     std::lock_guard<std::mutex> g(s->conns_mu);
     for (auto& kv : s->conns) {
       kv.second->closed.store(true);
-      ::shutdown(kv.second->fd, SHUT_RDWR);
-      ::close(kv.second->fd);
+      ::shutdown(kv.second->fd, SHUT_RDWR);  // ::close happens in ~Conn
     }
     s->conns.clear();
   }
@@ -599,6 +623,7 @@ void* tpu3fs_rpc_client_connect(const char* host, int port,
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   auto* c = new Client();
   c->fd = fd;
+  c->call_timeout_ms = call_timeout_ms;
   return c;
 }
 
@@ -621,7 +646,8 @@ int tpu3fs_rpc_client_call(void* cli, int64_t service_id, int64_t method_id,
   pkt.ts[0] = mono_now();  // client_build
   pkt.ts[1] = mono_now();  // client_send
   std::string wire = frame(encode_packet(pkt));
-  if (!send_all(c->fd, wire.data(), wire.size())) return -1;
+  if (!send_all(c->fd, wire.data(), wire.size(), c->call_timeout_ms))
+    return -1;
   uint8_t hdr[4];
   if (!recv_exact(c->fd, hdr, 4)) return -2;
   uint32_t n = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |

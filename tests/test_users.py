@@ -192,6 +192,91 @@ class TestAuthGateRegressions:
         assert mc.stat_fs() is not None
         assert mc.batch_stat([res.inode.id])[0].id == res.inode.id
 
+    def test_session_ops_authorize_not_just_authenticate(self, cluster):
+        """A VALID non-root token must still be denied on other users' state:
+        prune_session needs admin, close/sync need PERM_W on the inode,
+        batch_stat masks unreadable inodes (ADVICE r1 high finding)."""
+        server, users, meta = cluster
+        from tpu3fs.meta.store import OpenFlags, User
+
+        victim = users.add_user(1000, "victim")
+        res = meta.create("/secret", User(1000, 1000), perm=0o600,
+                          flags=OpenFlags.WRITE, client_id="victim-client")
+        mallory = users.add_user(2000, "mallory")
+        mc = MetaRpcClient([server.address], token=mallory.token)
+        # cannot prune another client's write sessions
+        with pytest.raises(FsError) as ei:
+            mc.prune_session("victim-client")
+        assert ei.value.code == Code.META_NO_PERMISSION
+        assert meta.list_sessions(res.inode.id)
+        # cannot settle length/mtime on a file it cannot write (even with
+        # the empty-session-id shortcut)
+        with pytest.raises(FsError) as ei:
+            mc.close(res.inode.id, "", length_hint=12345)
+        assert ei.value.code == Code.META_NO_PERMISSION
+        with pytest.raises(FsError) as ei:
+            mc.sync(res.inode.id, length_hint=12345)
+        assert ei.value.code == Code.META_NO_PERMISSION
+        assert meta.stat("/secret").length == 0
+        # batch_stat masks inodes without read permission
+        assert mc.batch_stat([res.inode.id]) == [None]
+        # an admin (non-root) token may prune; the owner may close
+        admin = users.add_user(3000, "ops", admin=True)
+        ma = MetaRpcClient([server.address], token=admin.token)
+        assert ma.prune_session("victim-client") == 1
+        mv = MetaRpcClient([server.address], token=victim.token)
+        assert mv.batch_stat([res.inode.id])[0].id == res.inode.id
+
+    def test_close_idempotency_cache_is_identity_scoped(self, cluster):
+        """Replaying another client's (client_id, request_id) with a
+        different token must NOT return the cached inode (code-review r2)."""
+        server, users, meta = cluster
+        from tpu3fs.meta.store import OpenFlags, User
+
+        victim = users.add_user(1000, "victim")
+        res = meta.create("/secret2", User(1000, 1000), perm=0o600,
+                          flags=OpenFlags.WRITE, client_id="vc")
+        mv = MetaRpcClient([server.address], token=victim.token,
+                           client_id="vc")
+        closed = mv.close(res.inode.id, res.session_id, request_id="rq-9",
+                          length_hint=77)
+        assert closed.length == 77
+        # victim's own retry hits the cache (idempotent)
+        again = mv.close(res.inode.id, res.session_id, request_id="rq-9")
+        assert again.length == 77
+        # mallory replays the exact same identifiers with her own token
+        mallory = users.add_user(2000, "mallory")
+        mm = MetaRpcClient([server.address], token=mallory.token,
+                           client_id="vc")
+        with pytest.raises(FsError) as ei:
+            mm.close(res.inode.id, "", request_id="rq-9", length_hint=1)
+        assert ei.value.code == Code.META_NO_PERMISSION
+
+    def test_chmod_between_open_and_close_does_not_wedge_session(self, cluster):
+        """close/sync authorize by session ownership, not the live ACL:
+        a chmod 0o400 after open must not leak the write session."""
+        server, users, meta = cluster
+        alice = users.add_user(1000, "alice")
+        meta.mkdirs("/w", perm=0o777)
+        mc = MetaRpcClient([server.address], token=alice.token, client_id="ac")
+        from tpu3fs.meta.store import OpenFlags
+
+        rsp = mc.create("/w/f", flags=OpenFlags.WRITE)
+        # root chmods the file read-only underneath the open session
+        meta.set_attr("/w/f", perm=0o400)
+        # alice's fsync and close still settle the length
+        assert mc.sync(rsp.inode.id, length_hint=5).length == 5
+        closed = mc.close(rsp.inode.id, rsp.session_id, length_hint=9)
+        assert closed.length == 9
+        assert not meta.list_sessions(rsp.inode.id)
+        # but another non-owner still cannot close someone else's session
+        bob = users.add_user(3000, "bob")
+        mb = MetaRpcClient([server.address], token=bob.token)
+        rsp2 = mc.create("/w/g", flags=OpenFlags.WRITE)
+        with pytest.raises(FsError) as ei:
+            mb.close(rsp2.inode.id, rsp2.session_id)
+        assert ei.value.code == Code.META_NO_PERMISSION
+
     def test_root_flag_grants_setattr_and_chown(self, cluster):
         server, users, meta = cluster
         meta.mkdirs("/private", perm=0o700)

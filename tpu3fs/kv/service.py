@@ -216,10 +216,14 @@ class KvService:
                           min(live) if live else self.engine.version)
 
     def _check_version(self, version: int) -> None:
-        if version < self._floor:
+        # _floor is only raised by _sweep_pins under _lock; read it under the
+        # same lock so a concurrent sweep orders strictly before or after
+        with self._lock:
+            floor = self._floor
+        if version < floor:
             raise FsError(Status(
                 Code.KV_TXN_TOO_OLD,
-                f"snapshot {version} expired (floor {self._floor})"))
+                f"snapshot {version} expired (floor {floor})"))
 
     # -- ops ------------------------------------------------------------------
     def snapshot(self, req: SnapshotReq) -> SnapshotRsp:
@@ -236,11 +240,18 @@ class KvService:
     def get(self, req: GetReq) -> GetRsp:
         self._check_version(req.version)
         val = self.engine.read_at(req.key, req.version)
+        # re-check AFTER the read: if a concurrent sweep raised the floor
+        # past our version, a commit may have pruned the MVCC history this
+        # read resolved against — fail loudly rather than return a silent
+        # misread (sweep raises the floor before any prune can run, so a
+        # read that passes the post-check saw intact history)
+        self._check_version(req.version)
         return GetRsp(found=val is not None, value=val or b"")
 
     def get_range(self, req: RangeReq) -> RangeRsp:
         self._check_version(req.version)
         pairs = self.engine.range_at(req.begin, req.end, req.version)
+        self._check_version(req.version)  # see get(): post-read floor check
         if req.reverse:
             pairs = list(reversed(pairs))
         if req.limit:
@@ -248,13 +259,19 @@ class KvService:
         return RangeRsp(pairs=[RangePair(k, v) for k, v in pairs])
 
     def commit(self, req: CommitReq) -> CommitRsp:
-        self._check_version(req.read_version)
         writes = {
             w.key: (None if w.tombstone else w.value) for w in req.writes
         }
         clears = [(r.begin, r.end) for r in req.clear_ranges]
         stamps = [(s.prefix, s.suffix, s.value) for s in req.versionstamped]
         with self._commit_lock:
+            # floor check must happen INSIDE _commit_lock: MVCC history is
+            # only pruned by commit_external (serialized on this lock), so a
+            # sweep that expires this txn's pin either raised the floor
+            # before this check (we reject) or the commit-log entries the
+            # conflict check needs are still intact (we commit safely) — no
+            # window where a stale txn commits against pruned history
+            self._check_version(req.read_version)
             version = self.engine.commit_external(
                 req.read_version,
                 list(req.read_keys),

@@ -404,7 +404,8 @@ class MetaStore:
             )
             session_id = ""
             if flags & OpenFlags.WRITE:
-                session_id = self._add_session(txn, inode.id, client_id)
+                session_id = self._add_session(txn, inode.id, client_id,
+                                               user.uid)
             return OpenResult(inode, session_id)
 
         result = with_transaction(self._engine, op)
@@ -458,12 +459,13 @@ class MetaStore:
                 inode.length = 0
                 inode.mtime = time.time()
                 self._store_inode(txn, inode)
-            session_id = self._add_session(txn, inode.id, client_id)
+            session_id = self._add_session(txn, inode.id, client_id, user.uid)
         return OpenResult(inode, session_id)
 
-    def _add_session(self, txn: ITransaction, inode_id: int, client_id: str) -> str:
+    def _add_session(self, txn: ITransaction, inode_id: int, client_id: str,
+                     uid: int = 0) -> str:
         session_id = uuid.uuid4().hex
-        sess = FileSession(inode_id, client_id, session_id, time.time())
+        sess = FileSession(inode_id, client_id, session_id, time.time(), uid)
         txn.set(session_key(inode_id, session_id), serialize(sess))
         return session_id
 
@@ -496,20 +498,34 @@ class MetaStore:
         like a modification."""
 
         def op(txn: ITransaction) -> Inode:
+            # the cache key is scoped to the caller's identity in auth mode:
+            # a replay of another client's (client_id, request_id) by a
+            # different user misses and must pass authorization below
+            ckey = idempotent_key(client_id, request_id,
+                                  None if user is None else user.uid)
             if request_id:
-                cached = txn.get(idempotent_key(client_id, request_id))
+                cached = txn.get(ckey)
                 if cached is not None:
                     return deserialize(cached, Inode)
             inode = self._load_inode(txn, inode_id)
             if inode is None:
                 raise _err(Code.META_NOT_FOUND, str(inode_id))
-            if user is not None and not inode.acl.check_user(user, PERM_W):
-                raise _err(Code.META_NO_PERMISSION, str(inode_id))
             skey = session_key(inode_id, session_id)
             if session_id:
-                if txn.get(skey) is None:
+                raw = txn.get(skey)
+                if raw is None:
                     raise _err(Code.META_NO_SESSION, session_id)
+                if user is not None:
+                    # the session is the capability granted at open: closing
+                    # authorizes against its owner, not the live ACL (a chmod
+                    # between open and close must not wedge the session)
+                    sess = deserialize(raw, FileSession)
+                    if not (user.is_root or sess.uid == user.uid):
+                        raise _err(Code.META_NO_PERMISSION, session_id)
                 txn.clear(skey)
+            elif user is not None and not inode.acl.check_user(user, PERM_W):
+                # sessionless length settle falls back to the ACL
+                raise _err(Code.META_NO_PERMISSION, str(inode_id))
             if inode.is_file():
                 if self._file_length_hook is not None:
                     inode.length = self._file_length_hook(inode)
@@ -519,7 +535,7 @@ class MetaStore:
                     inode.mtime = time.time()
                 self._store_inode(txn, inode)
             if request_id:
-                txn.set(idempotent_key(client_id, request_id), serialize(inode))
+                txn.set(ckey, serialize(inode))
             return inode
 
         return with_transaction(self._engine, op)
@@ -527,14 +543,22 @@ class MetaStore:
     def sync(self, inode_id: int, *, length_hint: Optional[int] = None,
              user: Optional[User] = None) -> Inode:
         """fsync: refresh the length hint without closing the session.
-        With a user, requires write permission on the inode (auth mode)."""
+        With a user, requires write permission on the inode OR a live write
+        session the user opened (so a chmod after open cannot wedge an
+        in-flight writer's fsync)."""
 
         def op(txn: ITransaction) -> Inode:
             inode = self._load_inode(txn, inode_id)
             if inode is None:
                 raise _err(Code.META_NOT_FOUND, str(inode_id))
             if user is not None and not inode.acl.check_user(user, PERM_W):
-                raise _err(Code.META_NO_PERMISSION, str(inode_id))
+                begin, end = session_scan_range(inode_id)
+                owns = any(
+                    deserialize(p.value, FileSession).uid == user.uid
+                    for p in txn.get_range(begin, end, snapshot=True)
+                )
+                if not owns:
+                    raise _err(Code.META_NO_PERMISSION, str(inode_id))
             if inode.is_file():
                 if self._file_length_hook is not None:
                     inode.length = self._file_length_hook(inode)

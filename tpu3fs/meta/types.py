@@ -160,6 +160,10 @@ class FileSession:
     client_id: str
     session_id: str
     opened_at: float = 0.0
+    # identity that opened the session: close is authorized against this
+    # (the session is the capability granted at open; POSIX checks
+    # permission at open, not close), 0 = dev mode / root
+    uid: int = 0
 
 
 # -- key codecs -------------------------------------------------------------
@@ -193,8 +197,15 @@ def session_scan_range(inode_id: Optional[int] = None) -> tuple:
     return base, base + b"\xff" * 8
 
 
-def idempotent_key(client_id: str, request_id: str) -> bytes:
-    return KeyPrefix.IDEMPOTENT.value + f"{client_id}/{request_id}".encode()
+def idempotent_key(client_id: str, request_id: str,
+                   uid: Optional[int] = None) -> bytes:
+    """With a uid, the cached result is scoped to that identity: a replay of
+    another client's (client_id, request_id) by a different authenticated
+    user misses the cache and goes through the normal authorization path
+    instead of reading the cached inode."""
+    scope = f"{client_id}/{request_id}" if uid is None else \
+        f"{client_id}/{request_id}@{uid}"
+    return KeyPrefix.IDEMPOTENT.value + scope.encode()
 
 
 GC_PREFIX = b"GCQU"  # GC queue records (analogue of the ref's GC directories)

@@ -468,10 +468,25 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
         return rec.as_user()
 
     def gate(req) -> None:
-        """Session-scoped ops (statFs/sync/close/prune/batchStat) carry no
-        path identity but still require a valid bearer token in auth mode."""
+        """Session-scoped ops (statFs) carry no path identity but still
+        require a valid bearer token in auth mode."""
         if acl_cache is not None:
             acl_cache.authenticate(getattr(req, "token", ""))
+
+    def su(req) -> Optional[User]:
+        """Resolved identity for session-scoped ops (sync/close/batchStat):
+        None in dev mode (store skips authorization), the token's user in
+        auth mode — so the store's PERM_W/PERM_R guards actually run."""
+        if acl_cache is None:
+            return None
+        return acl_cache.authenticate(getattr(req, "token", "")).as_user()
+
+    def prune_session(req: PruneSessionReq) -> IntReply:
+        if acl_cache is None:
+            return IntReply(meta.prune_session(req.client_id))
+        rec = acl_cache.authenticate(req.token)
+        return IntReply(meta.prune_session(
+            req.client_id, rec.as_user(), admin=rec.admin))
 
     def authenticate(req: AuthReq) -> AuthRsp:
         if acl_cache is None:
@@ -500,15 +515,16 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
                     client_id=r.client_id, request_id=r.request_id), Empty())[1])
     s.method(8, "open", OpenReq, OpenRsp, lambda r: _open_rsp(
         meta.open(r.path, u(r), flags=r.flags, client_id=r.client_id)))
-    s.method(9, "sync", SyncReq, InodeRsp, lambda r: (gate(r), InodeRsp(
+    s.method(9, "sync", SyncReq, InodeRsp, lambda r: InodeRsp(
         meta.sync(r.inode_id,
-                  length_hint=None if r.length_hint < 0
-                  else r.length_hint)))[1])
-    s.method(10, "close", CloseReq, InodeRsp, lambda r: (gate(r), InodeRsp(
+                  length_hint=None if r.length_hint < 0 else r.length_hint,
+                  user=su(r))))
+    s.method(10, "close", CloseReq, InodeRsp, lambda r: InodeRsp(
         meta.close(r.inode_id, r.session_id,
                    length_hint=None if r.length_hint < 0 else r.length_hint,
                    client_id=r.client_id, request_id=r.request_id,
-                   wrote=None if r.wrote < 0 else bool(r.wrote))))[1])
+                   wrote=None if r.wrote < 0 else bool(r.wrote),
+                   user=su(r))))
     s.method(11, "rename", RenameReq, Empty,
              lambda r: (meta.rename(r.src, r.dst, u(r)), Empty())[1])
     s.method(12, "list", ListReq, ListRsp, lambda r: ListRsp(
@@ -524,10 +540,9 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
                       gid=None if r.new_gid < 0 else r.new_gid,
                       atime=r.atime if r.has_atime else None,
                       mtime=r.mtime if r.has_mtime else None)))
-    s.method(16, "pruneSession", PruneSessionReq, IntReply,
-             lambda r: (gate(r), IntReply(meta.prune_session(r.client_id)))[1])
+    s.method(16, "pruneSession", PruneSessionReq, IntReply, prune_session)
     s.method(17, "batchStat", BatchStatReq, BatchStatRsp,
-             lambda r: (gate(r), BatchStatRsp(meta.batch_stat(r.inode_ids)))[1])
+             lambda r: BatchStatRsp(meta.batch_stat(r.inode_ids, user=su(r))))
     server.add_service(s)
 
 
