@@ -1,18 +1,28 @@
 """Headline benchmark: RS(12,4) erasure-encode throughput per chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 Baseline: the BASELINE.json north star is >= 40 GiB/s RS(12,4) encode on a
 v5e-8 (8 chips), i.e. 5 GiB/s per chip of *data* consumed. vs_baseline is
 measured single-chip GiB/s divided by that 5 GiB/s per-chip share.
+
+Robustness contract (the driver runs this unattended on real hardware):
+- backend init and the whole bench run are bounded by subprocess timeouts —
+  a hung TPU tunnel produces a self-describing failure record, never a hang;
+- if the TPU backend is unreachable the bench falls back to CPU and SAYS SO
+  in the record ("platform": "cpu", "error": ...) so a low number is never
+  mistaken for a TPU regression;
+- secondary metrics (worst-case decode, CRC, XOR rebuild, e2e fabric IO)
+  ride along in "extras" without changing the headline schema.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 K, M = 12, 4
 SHARD_BYTES = 1 << 20  # 1 MiB shards (the reference's default chunk size)
@@ -20,42 +30,181 @@ BATCH = 12             # 144 MiB of data per step
 WARMUP, ITERS = 2, 8
 BASELINE_PER_CHIP_GIBPS = 40.0 / 8
 
+PROBE_TIMEOUT_S = 120   # backend init (tunnel handshake) bound
+BENCH_TIMEOUT_S = 900   # full bench incl. first compiles (~20-40s each)
 
-def main() -> None:
+
+def _gibps(nbytes: int, iters: int, dt: float) -> float:
+    return nbytes * iters / dt / (1 << 30)
+
+
+def _bench_worker(platform: str) -> None:
+    """Child process: run every bench on the given platform, print JSON."""
     import jax
-    import jax.numpy as jnp
 
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu3fs.ops.crc32c import BatchCrc32c
     from tpu3fs.ops.rs import RSCode
 
     dev = jax.devices()[0]
     rs = RSCode(K, M)
-
     rng = np.random.default_rng(0)
     host = rng.integers(0, 256, (BATCH, K, SHARD_BYTES), dtype=np.uint8)
     data = jax.device_put(jnp.asarray(host), dev)
+    extras = {"platform": dev.platform, "device": str(dev)}
 
-    encode = rs.encode  # auto-selects the fused Pallas kernel on TPU
-    for _ in range(WARMUP):
-        jax.block_until_ready(encode(data))
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        out = encode(data)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+    def timeit(fn, arg, nbytes: int) -> float:
+        for _ in range(WARMUP):
+            jax.block_until_ready(fn(arg))
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(ITERS):
+            out = fn(arg)
+        jax.block_until_ready(out)
+        return _gibps(nbytes, ITERS, time.perf_counter() - t0)
 
     data_bytes = BATCH * K * SHARD_BYTES
-    gibps = data_bytes * ITERS / dt / (1 << 30)
-    print(
-        json.dumps(
-            {
-                "metric": "rs_encode_12_4_data_throughput_per_chip",
-                "value": round(gibps, 3),
-                "unit": "GiB/s",
-                "vs_baseline": round(gibps / BASELINE_PER_CHIP_GIBPS, 3),
-            }
+
+    # 1) headline: RS(12,4) encode (data consumed per second)
+    encode_gibps = timeit(rs.encode, data, data_bytes)
+
+    # 2) worst-case decode: all M parity-positions lost... the hard case is
+    # M *data* shards lost (needs the full GF(2) matmul with the inverted
+    # submatrix). Same data-consumed semantics as encode so the two compare.
+    lost = tuple(range(M))                      # first M data shards lost
+    present = tuple(range(M, K + M))            # K survivors
+    decode = rs.reconstruct_fn(present, lost)
+    extras["rs_decode_worstcase_gibps"] = round(
+        timeit(decode, data, data_bytes), 3)
+
+    # 3) RAID-style 1-loss XOR rebuild (the dominant recovery case)
+    xor_present = tuple(i for i in range(K + 1) if i != 1)
+    xor_fn = rs.reconstruct_fn(xor_present, (1,))
+    extras["xor_rebuild_1loss_gibps"] = round(
+        timeit(xor_fn, data, data_bytes), 3)
+
+    # 4) batched CRC32C over all shards
+    crc = BatchCrc32c(SHARD_BYTES, block=512)
+    flat = data.reshape(BATCH * K, SHARD_BYTES)
+    extras["crc32c_batch_gibps"] = round(timeit(crc.compute, flat, data_bytes), 3)
+
+    # 5) e2e single-process fabric write+read (CPU-side service path; small
+    # on purpose — it measures the CRAQ/ engine path, not the TPU)
+    try:
+        from benchmarks.storage_bench import run_bench as storage_bench
+
+        for row in storage_bench(chunks=64, size=256 << 10, batch=8,
+                                 threads=4, replicas=2, chains=4):
+            extras[f"e2e_{row['metric']}_gibps"] = row["value"]
+    except Exception as e:  # e2e is best-effort garnish on the kernel bench
+        extras["e2e_error"] = repr(e)[:200]
+
+    # 6) EC serving path: stripe write (device encode+CRC) / read via fabric
+    try:
+        from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+        from tpu3fs.storage.types import ChunkId
+
+        ec_chunk = 256 << 10
+        fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=4, num_chains=2, chunk_size=ec_chunk,
+            ec_k=3, ec_m=1))
+        cl = fab.storage_client()
+        stripes = 32
+        blobs = [bytes([i & 0xFF]) * ec_chunk for i in range(4)]
+        t0 = time.perf_counter()
+        for i in range(stripes):
+            r = cl.write_stripe(
+                fab.chain_ids[i % 2], ChunkId(5, i), blobs[i % 4],
+                chunk_size=ec_chunk)
+            assert r.ok, r
+        extras["e2e_ec_write_gibps"] = round(
+            _gibps(stripes * ec_chunk, 1, time.perf_counter() - t0), 3)
+        t0 = time.perf_counter()
+        for i in range(stripes):
+            r = cl.read_stripe(fab.chain_ids[i % 2], ChunkId(5, i), 0,
+                               ec_chunk, chunk_size=ec_chunk)
+            assert r.ok
+        extras["e2e_ec_read_gibps"] = round(
+            _gibps(stripes * ec_chunk, 1, time.perf_counter() - t0), 3)
+    except Exception as e:
+        extras["e2e_ec_error"] = repr(e)[:200]
+
+    print(json.dumps({
+        "metric": "rs_encode_12_4_data_throughput_per_chip",
+        "value": round(encode_gibps, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(encode_gibps / BASELINE_PER_CHIP_GIBPS, 3),
+        **extras,
+    }))
+
+
+def _probe_platform() -> tuple:
+    """-> (platform | None, error detail). Bounded: a dead TPU tunnel makes
+    jax.devices() hang forever, so the probe runs in a killable child."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
         )
-    )
+    except subprocess.TimeoutExpired:
+        return None, f"backend init exceeded {PROBE_TIMEOUT_S}s (tunnel down?)"
+    if out.returncode != 0:
+        return None, (out.stderr or out.stdout).strip()[-300:]
+    return out.stdout.strip().splitlines()[-1], ""
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    platform, probe_err = _probe_platform()
+    fallback_note = ""
+    if platform is None or platform not in ("tpu", "TPU"):
+        if platform is None:
+            fallback_note = f"tpu backend unavailable ({probe_err}); " \
+                            "cpu fallback numbers — NOT a TPU measurement"
+            platform = "cpu"
+        # probe returned e.g. "cpu" already: still a valid (non-TPU) run
+        elif platform != "cpu":
+            platform = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", platform],
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT_S, cwd=here,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "rs_encode_12_4_data_throughput_per_chip",
+            "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
+            "error": f"bench exceeded {BENCH_TIMEOUT_S}s on {platform}",
+        }))
+        return
+    line = ""
+    for cand in reversed(out.stdout.strip().splitlines()):
+        if cand.startswith("{"):
+            line = cand
+            break
+    if out.returncode != 0 or not line:
+        print(json.dumps({
+            "metric": "rs_encode_12_4_data_throughput_per_chip",
+            "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
+            "error": f"worker rc={out.returncode} on {platform}",
+            "detail": (out.stderr or out.stdout).strip()[-400:],
+        }))
+        return
+    if fallback_note:
+        rec = json.loads(line)
+        rec["error"] = fallback_note
+        line = json.dumps(rec)
+    print(line)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        _bench_worker(sys.argv[2] if len(sys.argv) > 2 else "cpu")
+    else:
+        main()

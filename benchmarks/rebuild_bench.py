@@ -59,7 +59,7 @@ def run_bench(*, k: int = 12, m: int = 4, shard_kb: int = 1024,
         eta_s = TARGET_TIB * 1024 / pod_gibps if pod_gibps else float("inf")
         row = {
             "metric": f"rs_rebuild_{k}_{m}_lost{lost_count}",
-            "value": round(gibps, 3),
+            "value": round(gibps, 6),  # 6 digits: tiny CPU-test runs must not round to 0
             "unit": "GiB/s rebuilt per chip",
             "pod_chips": pod_chips,
             "rebuild_14TiB_eta_s": round(eta_s, 1),

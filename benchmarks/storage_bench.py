@@ -157,6 +157,32 @@ def run_bench(
     }
     results.append(row)
     print(json.dumps(row), flush=True)
+
+    # batched write phase: node-grouped BatchWrite requests (a second file
+    # id so the write path runs fresh, not as overwrites)
+    t0 = time.perf_counter()
+    wrote = 0
+    for base in range(0, chunks, batch):
+        idxs = list(range(base, min(base + batch, chunks)))
+        ops = [
+            (fab.chain_ids[i % len(fab.chain_ids)],
+             ChunkId(FILE_ID + 1, i), 0, payloads[i % len(payloads)])
+            for i in idxs
+        ]
+        replies = client.batch_write(ops, chunk_size=size)
+        assert all(r.ok for r in replies)
+        wrote += len(replies)
+    dt = time.perf_counter() - t0
+    row = {
+        "metric": "storage_bench_batch_write",
+        "value": round(wrote * size / dt / (1 << 30), 3),
+        "unit": "GiB/s",
+        "iops": round(wrote / dt, 1),
+        "batch": batch,
+        "engine": engine,
+    }
+    results.append(row)
+    print(json.dumps(row), flush=True)
     return results
 
 

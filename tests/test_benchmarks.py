@@ -15,7 +15,8 @@ class TestStorageBench:
                              replicas=2, chains=2, verify=True)
         names = [r["metric"] for r in rows]
         assert names == ["storage_bench_write", "storage_bench_read",
-                         "storage_bench_batch_read"]
+                         "storage_bench_batch_read",
+                         "storage_bench_batch_write"]
         assert all(r["value"] > 0 for r in rows)
         assert rows[0]["ops"] == 16
 

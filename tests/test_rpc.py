@@ -202,3 +202,109 @@ class TestRpcCluster:
         client = rpc_cluster["client"]
         rsp = client.call(rpc_cluster["meta_addr"], 10001, 2, Empty(), StrReply)
         assert isinstance(rsp.value, str)
+
+    def test_batched_io_over_sockets(self, rpc_cluster):
+        """BatchRead/BatchWrite serde round-trips: many ops, one request."""
+        from tpu3fs.client.storage_client import ReadReq
+
+        mcli = MgmtdRpcClient(rpc_cluster["mgmtd_addr"], rpc_cluster["client"])
+        messenger = RpcMessenger(mcli.refresh_routing, rpc_cluster["client"])
+        sc = StorageClient("cb", mcli.refresh_routing, messenger)
+        chain = rpc_cluster["chain_id"]
+        writes = [
+            (chain, ChunkId(7, i), 0, bytes([i]) * 500) for i in range(6)
+        ]
+        replies = sc.batch_write(writes, chunk_size=4096)
+        assert all(r.ok for r in replies)
+        got = sc.batch_read([ReadReq(chain, ChunkId(7, i), 0, -1)
+                             for i in range(6)])
+        for i, r in enumerate(got):
+            assert r.ok and r.data == bytes([i]) * 500
+
+
+class TestEcOverSockets:
+    def test_stripe_write_read_rebuild_over_sockets(self):
+        """EC chains work across the real TCP transport: ShardWriteReq and
+        the batched shard install serde-roundtrip, and the rebuild worker
+        drives remote reads/writes through sockets."""
+        kv = MemKVEngine()
+        mgmtd = Mgmtd(1, kv)
+        mgmtd.extend_lease()
+        mgmtd_server = RpcServer()
+        bind_mgmtd_service(mgmtd_server, mgmtd)
+        mgmtd_server.start()
+        servers = [mgmtd_server]
+        services = {}
+        chain_id = 900_001
+        k, m = 3, 1
+        chunk = 1 << 14
+        from tpu3fs.ops.stripe import shard_size_of
+
+        S = shard_size_of(chunk, k)
+        shared = RpcClient()
+        try:
+            target_ids = [2000, 2001, 2002, 2003]
+            node_ids = [20, 21, 22, 23]
+            for node_id, target_id in zip(node_ids, target_ids):
+                mcli = MgmtdRpcClient(mgmtd_server.address, shared)
+                svc = StorageService(node_id, mcli.refresh_routing)
+                svc.set_messenger(RpcMessenger(mcli.refresh_routing, shared))
+                svc.add_target(StorageTarget(target_id, chain_id, chunk_size=S))
+                server = RpcServer()
+                bind_storage_service(server, svc)
+                server.start()
+                mgmtd.register_node(node_id, NodeType.STORAGE,
+                                    host=server.host, port=server.port)
+                mgmtd.create_target(target_id, node_id=node_id)
+                services[node_id] = svc
+                servers.append(server)
+            mgmtd.upload_chain(chain_id, target_ids, ec_k=k, ec_m=m)
+            for i, node_id in enumerate(node_ids):
+                mgmtd.heartbeat(node_id, 1,
+                                {target_ids[i]: LocalTargetState.UPTODATE})
+            mcli = MgmtdRpcClient(mgmtd_server.address, shared)
+            messenger = RpcMessenger(mcli.refresh_routing, shared)
+            sc = StorageClient("ec1", mcli.refresh_routing, messenger)
+            import numpy as np
+
+            rng = np.random.default_rng(0)
+            items = [(ChunkId(9, i),
+                      rng.integers(0, 256, chunk, dtype=np.uint8).tobytes())
+                     for i in range(3)]
+            replies = sc.write_stripes(chain_id, items, chunk_size=chunk)
+            assert all(r.ok for r in replies)
+            for cid, data in items:
+                got = sc.read_stripe(chain_id, cid, 0, chunk, chunk_size=chunk)
+                assert got.ok and got.data == data
+            # degraded read across sockets: wipe shard 2's engine
+            victim = services[22]
+            orig = victim.target(2002).engine.read(ChunkId(9, 0))
+            from tpu3fs.storage.engine import MemChunkEngine
+
+            victim.target(2002).engine = MemChunkEngine()
+            got = sc.read_stripe(chain_id, ChunkId(9, 0), 0, chunk,
+                                 chunk_size=chunk)
+            assert got.ok and got.data == items[0][1]
+            # rebuild the wiped target through the socket messenger
+            from tpu3fs.mgmtd.types import PublicTargetState as PS
+            from tpu3fs.storage.ec_resync import EcResyncWorker
+
+            mgmtd.heartbeat(21, 2, {2001: LocalTargetState.UPTODATE})
+            # force the wiped target into SYNCING via the real protocol
+            mgmtd.heartbeat(22, 2, {2002: LocalTargetState.OFFLINE})
+            mgmtd.tick()
+            mgmtd.heartbeat(22, 3, {2002: LocalTargetState.ONLINE})
+            mgmtd.tick()
+            chain_now = mcli.refresh_routing().chains[chain_id]
+            t_state = next(t.public_state for t in chain_now.targets
+                           if t.target_id == 2002)
+            assert t_state == PS.SYNCING
+            coordinator = services[20]
+            moved = EcResyncWorker(
+                coordinator, RpcMessenger(mcli.refresh_routing, shared)
+            ).run_once()
+            assert moved >= 3
+            assert victim.target(2002).engine.read(ChunkId(9, 0)) == orig
+        finally:
+            for s in servers:
+                s.stop()

@@ -43,22 +43,61 @@ class FileIoClient:
             pos += n
         return out
 
+    def _is_ec(self, chain_id: int) -> bool:
+        chain = self._storage._chain(chain_id)
+        return chain.is_ec
+
     def write(self, inode: Inode, offset: int, data: bytes) -> int:
         layout = inode.layout
         assert layout is not None, "write() needs a file inode with layout"
         written = 0
         for idx, chain_id, in_off, n in self._split(layout, offset, len(data)):
-            reply = self._storage.write_chunk(
-                chain_id,
-                ChunkId(inode.id, idx),
-                in_off,
-                data[written : written + n],
-                chunk_size=layout.chunk_size,
-            )
+            part = data[written : written + n]
+            if self._is_ec(chain_id):
+                reply = self._write_ec_chunk(
+                    inode, chain_id, idx, in_off, part, layout.chunk_size)
+            else:
+                reply = self._storage.write_chunk(
+                    chain_id,
+                    ChunkId(inode.id, idx),
+                    in_off,
+                    part,
+                    chunk_size=layout.chunk_size,
+                )
             if not reply.ok:
                 raise FsError(Status(reply.code, reply.message))
             written += n
         return written
+
+    def _write_ec_chunk(self, inode: Inode, chain_id: int, idx: int,
+                        in_off: int, part: bytes, chunk_size: int):
+        """EC chunks are whole stripes: a full-chunk write encodes directly;
+        a partial write is read-modify-write of the stripe (parity must be
+        re-encoded over the merged content). Concurrent partial writers of
+        the SAME stripe race on the stripe version (last write wins) — like
+        the reference, non-overlapping writers of a shared file should write
+        different chunks."""
+        cid = ChunkId(inode.id, idx)
+        if in_off == 0 and len(part) == chunk_size:
+            return self._storage.write_stripe(
+                chain_id, cid, part, chunk_size=chunk_size)
+        cur = self._storage.read_stripe(
+            chain_id, cid, 0, chunk_size, chunk_size=chunk_size)
+        if cur.ok:
+            base = bytearray(cur.data.ljust(chunk_size, b"\x00"))
+            next_ver = cur.commit_ver + 1
+        elif cur.code == Code.CHUNK_NOT_FOUND:
+            base = bytearray(chunk_size)
+            next_ver = 0
+        else:
+            return cur
+        base[in_off : in_off + len(part)] = part
+        # trim stripe padding back to the logical extent so shard lengths
+        # (and hence the file length from query_last_chunk) stay precise
+        logical = max(in_off + len(part), cur.logical_len if cur.ok else 0)
+        return self._storage.write_stripe(
+            chain_id, cid, bytes(base[:logical]), chunk_size=chunk_size,
+            update_ver=next_ver)
 
     @staticmethod
     def _assemble(inode: Inode, pairs: Iterable[Tuple[object, int]],
@@ -96,9 +135,16 @@ class FileIoClient:
             size = max(0, min(size, inode.length - offset))
         # generator: a fatal error on an early chunk short-circuits inside
         # _assemble before the remaining chunk RPCs are ever issued
+        def one(chain_id: int, idx: int, in_off: int, n: int):
+            if self._is_ec(chain_id):
+                return self._storage.read_stripe(
+                    chain_id, ChunkId(inode.id, idx), in_off, n,
+                    chunk_size=layout.chunk_size)
+            return self._storage.read_chunk(
+                chain_id, ChunkId(inode.id, idx), in_off, n)
+
         pairs = (
-            (self._storage.read_chunk(
-                chain_id, ChunkId(inode.id, idx), in_off, n), n)
+            (one(chain_id, idx, in_off, n), n)
             for idx, chain_id, in_off, n in self._split(layout, offset, size)
         )
         return self._assemble(inode, pairs, size)
@@ -124,7 +170,8 @@ class FileIoClient:
             for idx, chain_id, in_off, n in self._split(layout, offset, size):
                 mine.append((len(reqs), n))
                 reqs.append(ReadReq(
-                    chain_id, ChunkId(inode.id, idx), in_off, n
+                    chain_id, ChunkId(inode.id, idx), in_off, n,
+                    chunk_size=layout.chunk_size,
                 ))
             spans.append(mine)
         replies = self._storage.batch_read(reqs)
@@ -163,6 +210,18 @@ class FileIoClient:
         cs = layout.chunk_size
         last_idx = (length - 1) // cs if length > 0 else -1
         last_len = (length - last_idx * cs) if last_idx >= 0 else 0
+        if last_idx >= 0:
+            bchain = layout.chain_of_chunk(last_idx)
+            if self._is_ec(bchain) and last_len < cs:
+                # trimming one shard would invalidate the parity: re-encode
+                # and rewrite the boundary stripe at its shortened length
+                cid = ChunkId(inode.id, last_idx)
+                cur = self._storage.read_stripe(
+                    bchain, cid, 0, cs, chunk_size=cs)
+                if cur.ok:
+                    self._storage.write_stripe(
+                        bchain, cid, cur.data[:last_len], chunk_size=cs,
+                        update_ver=cur.commit_ver + 1)
         for chain_id in set(layout.chains):
             self._storage.truncate_file_chunks(
                 chain_id, inode.id, last_idx, last_len

@@ -20,7 +20,14 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu3fs.mgmtd.types import ChainInfo, NodeType, PublicTargetState, RoutingInfo
-from tpu3fs.storage.craq import Messenger, ReadReply, ReadReq, UpdateReply, WriteReq
+from tpu3fs.storage.craq import (
+    Messenger,
+    ReadReply,
+    ReadReq,
+    ShardWriteReq,
+    UpdateReply,
+    WriteReq,
+)
 from tpu3fs.storage.types import ChunkId, SpaceInfo
 from tpu3fs.utils.result import Code, FsError, Status
 
@@ -107,6 +114,17 @@ class StorageClient:
         chunk_size: int = 1 << 20,
     ) -> UpdateReply:
         """Write with the full retry ladder; exactly-once via channel identity."""
+        try:
+            if self._chain(chain_id).is_ec:
+                # a CRAQ write would install full-chunk bytes on shard-sized
+                # targets and silently corrupt the stripe format
+                raise FsError(Status(
+                    Code.INVALID_ARG,
+                    "CRAQ write on EC chain: use write_stripe"))
+        except FsError as e:
+            if e.code != Code.CHAIN_NOT_FOUND:
+                raise
+            return UpdateReply(e.code, message=e.status.message)
         channel, seq = self._channels.acquire()
         try:
             last: Optional[UpdateReply] = None
@@ -221,6 +239,18 @@ class StorageClient:
             if chain is None:
                 replies[i] = ReadReply(Code.CHAIN_NOT_FOUND)
                 continue
+            if chain.is_ec:
+                # EC reads are shard-addressed, not replica-selected; the
+                # shard size derives from the file's chunk_size, so a
+                # request without one cannot be served correctly — reject
+                # loudly instead of slicing at a guessed size
+                if not req.chunk_size:
+                    replies[i] = ReadReply(Code.INVALID_ARG)
+                    continue
+                replies[i] = self.read_stripe(
+                    req.chain_id, req.chunk_id, req.offset, req.length,
+                    chunk_size=req.chunk_size)
+                continue
             targets = self._pick_targets(chain)
             if not targets:
                 replies[i] = ReadReply(Code.TARGET_OFFLINE)
@@ -237,22 +267,423 @@ class StorageClient:
         for node_id, i, req in plan:
             by_node[node_id].append((i, req))
         for node_id, batch in by_node.items():
-            for i, req in batch:
-                try:
-                    replies[i] = self._messenger(node_id, "read", req)
-                except FsError as e:
+            # ONE BatchRead request per node (ref sendBatchRequest
+            # StorageClientImpl.cc:1303): the round trip is amortized over
+            # the whole group
+            idxs = [i for i, _ in batch]
+            try:
+                got = self._messenger(
+                    node_id, "batch_read", [req for _, req in batch])
+                for i, reply in zip(idxs, got):
+                    replies[i] = reply
+            except FsError as e:
+                for i in idxs:
                     replies[i] = ReadReply(e.code)
-        # fall back to the single-op retry ladder for failures
+        # fall back to the single-op retry ladder for failures (EC replies
+        # already went through read_stripe's own ladder)
         for i, r in enumerate(replies):
             if r is None or (not r.ok and r.code != Code.CHUNK_NOT_FOUND):
+                chain = routing.chains.get(reqs[i].chain_id)
+                if chain is not None and chain.is_ec:
+                    continue
                 replies[i] = self.read_chunk(
                     reqs[i].chain_id, reqs[i].chunk_id, reqs[i].offset, reqs[i].length
                 )
         return replies  # type: ignore[return-value]
 
+    def batch_write(
+        self,
+        writes: List[Tuple[int, ChunkId, int, bytes]],
+        *,
+        chunk_size: int = 1 << 20,
+    ) -> List[UpdateReply]:
+        """Batched CRAQ writes: (chain_id, chunk_id, offset, data) ops are
+        grouped by head node and issued as ONE BatchWrite per node (ref
+        batchWriteWithRetry StorageClientImpl.cc:1771). Failed ops fall back
+        to the single-op retry ladder."""
+        replies: List[Optional[UpdateReply]] = [None] * len(writes)
+        routing = self._routing()
+        by_node: Dict[int, List[int]] = defaultdict(list)
+        reqs: List[Optional[WriteReq]] = [None] * len(writes)
+        channels: List[Optional[Tuple[int, int]]] = [None] * len(writes)
+        try:
+            for i, (chain_id, chunk_id, offset, data) in enumerate(writes):
+                chain = routing.chains.get(chain_id)
+                if chain is not None and chain.is_ec:
+                    replies[i] = UpdateReply(
+                        Code.INVALID_ARG,
+                        message="CRAQ batch_write on EC chain: use write_stripes")
+                    continue
+                head = chain.head() if chain is not None else None
+                node = (routing.node_of_target(head.target_id)
+                        if head is not None else None)
+                if chain is None or head is None or node is None:
+                    replies[i] = UpdateReply(Code.TARGET_OFFLINE)
+                    continue
+                ch, seq = self._channels.acquire()
+                channels[i] = (ch, seq)
+                reqs[i] = WriteReq(
+                    chain_id=chain_id,
+                    chain_ver=chain.chain_version,
+                    chunk_id=chunk_id,
+                    offset=offset,
+                    data=data,
+                    chunk_size=chunk_size,
+                    client_id=self.client_id,
+                    channel_id=ch,
+                    seqnum=seq,
+                )
+                by_node[node.node_id].append(i)
+            for node_id, idxs in by_node.items():
+                try:
+                    got = self._messenger(
+                        node_id, "batch_write", [reqs[i] for i in idxs])
+                    for i, reply in zip(idxs, got):
+                        replies[i] = reply
+                except FsError as e:
+                    for i in idxs:
+                        replies[i] = UpdateReply(e.code)
+        finally:
+            for slot in channels:
+                if slot is not None:
+                    self._channels.release(slot[0])
+        # single-op ladder mops up failures (chain bumps, dead heads);
+        # hard rejections (EC misuse) are final
+        for i, r in enumerate(replies):
+            if r is None or (not r.ok and r.code != Code.INVALID_ARG):
+                chain_id, chunk_id, offset, data = writes[i]
+                replies[i] = self.write_chunk(
+                    chain_id, chunk_id, offset, data, chunk_size=chunk_size)
+        return replies  # type: ignore[return-value]
+
+    # -- EC stripes (TPU data plane; added capability, BASELINE.json) ---------
+    def write_stripe(
+        self,
+        chain_id: int,
+        chunk_id: ChunkId,
+        data: bytes,
+        *,
+        chunk_size: int = 1 << 20,
+        update_ver: int = 0,
+    ) -> UpdateReply:
+        """Erasure-code one chunk into k data + m parity shards on device
+        (RSCode encode + BatchCrc32c, Pallas on TPU) and install each shard
+        on its chain-position target. update_ver=0 probes: try 1, bump past
+        any newer committed stripe on conflict."""
+        from tpu3fs.ops.stripe import get_codec, shard_size_of
+
+        chain = self._chain(chain_id)
+        if not chain.is_ec:
+            raise FsError(Status(Code.INVALID_ARG, "write_stripe on CR chain"))
+        if len(data) > chunk_size:
+            raise FsError(Status(Code.INVALID_ARG, "stripe exceeds chunk size"))
+        k, m = chain.ec_k, chain.ec_m
+        S = shard_size_of(chunk_size, k)
+        codec = get_codec(k, m, S)
+        shards, crcs = codec.encode_stripe(data)
+        ver = update_ver or 1
+        last: Optional[UpdateReply] = None
+        done: set = set()  # shard indices already acked at `ver`
+        for attempt in range(self._retry.max_retries + 1):
+            chain = self._chain(chain_id)
+            routing = self._routing()
+            writable = 0
+            acked = 0
+            bump_to = 0
+            hard: Optional[UpdateReply] = None
+            for j in range(k + m):
+                t = chain.target_of_shard(j)
+                if t is None or not t.public_state.can_write:
+                    continue  # non-writable targets rebuild before SERVING
+                writable += 1
+                if j in done:
+                    acked += 1
+                    continue
+                node = routing.node_of_target(t.target_id)
+                if node is None:
+                    continue
+                # data shards ship the trimmed host bytes; parity ships the
+                # device-encoded rows (always full S)
+                if j < k:
+                    payload = data[j * S : (j + 1) * S]
+                else:
+                    payload = shards[j].tobytes()
+                req = ShardWriteReq(
+                    chain_id=chain_id,
+                    chain_ver=chain.chain_version,
+                    target_id=t.target_id,
+                    chunk_id=chunk_id,
+                    data=payload,
+                    crc=int(crcs[j]),
+                    update_ver=ver,
+                    chunk_size=S,
+                    logical_len=len(data),
+                )
+                try:
+                    reply = self._messenger(node.node_id, "write_shard", req)
+                except FsError as e:
+                    reply = UpdateReply(e.code, message=e.status.message)
+                if reply.ok:
+                    acked += 1
+                    done.add(j)
+                elif reply.code == Code.CHUNK_STALE_UPDATE:
+                    # a newer stripe version exists: re-write the whole
+                    # stripe above it (whole-stripe versioning)
+                    bump_to = max(bump_to, reply.commit_ver + 1, ver + 1)
+                elif Status(reply.code).retryable() or reply.code in (
+                    Code.RPC_PEER_CLOSED, Code.RPC_CONNECT_FAILED,
+                ):
+                    last = reply
+                else:
+                    hard = reply
+            if hard is not None:
+                return hard
+            if bump_to:
+                ver = bump_to
+                done.clear()  # everything must be re-written at the new ver
+                self._sleep(attempt)
+                continue
+            # STRICT success: every currently-writable shard acked (and at
+            # least k overall, or the stripe would be undecodable). A shard
+            # left behind on a live SERVING target would never be repaired
+            # — rebuild only runs for SYNCING targets — and a later
+            # sub-stripe read of just that shard would serve stale bytes.
+            if acked == writable and acked >= k:
+                return UpdateReply(Code.OK, update_ver=ver, commit_ver=ver)
+            last = last or UpdateReply(
+                Code.TARGET_OFFLINE,
+                message=f"{acked}/{writable} writable shards acked")
+            self._sleep(attempt)
+        return last or UpdateReply(Code.CLIENT_RETRIES_EXHAUSTED)
+
+    def write_stripes(
+        self,
+        chain_id: int,
+        items: List[Tuple[ChunkId, bytes]],
+        *,
+        chunk_size: int = 1 << 20,
+    ) -> List[UpdateReply]:
+        """Batched EC writes: encode MANY stripes with ONE device kernel
+        launch (amortizing the PCIe round trip — the whole point of the TPU
+        data plane) and install shards with one BatchShardWrite per node.
+        Stripes that hit version conflicts fall back to write_stripe."""
+        import numpy as np
+
+        from tpu3fs.ops.stripe import get_codec, shard_size_of
+
+        chain = self._chain(chain_id)
+        if not chain.is_ec:
+            raise FsError(Status(Code.INVALID_ARG, "write_stripes on CR chain"))
+        k, m = chain.ec_k, chain.ec_m
+        S = shard_size_of(chunk_size, k)
+        codec = get_codec(k, m, S)
+        B = len(items)
+        if B == 0:
+            return []
+        buf = np.zeros((B, k, S), dtype=np.uint8)
+        for b, (_, data) in enumerate(items):
+            flat = np.frombuffer(data, dtype=np.uint8)
+            buf[b].reshape(-1)[: flat.size] = flat
+        shards, crcs = codec.encode_batch(buf)
+
+        routing = self._routing()
+        by_node: Dict[int, List[Tuple[int, ShardWriteReq]]] = defaultdict(list)
+        acked = [0] * B
+        hard: List[Optional[UpdateReply]] = [None] * B
+        writable = 0
+        for j in range(k + m):
+            t = chain.target_of_shard(j)
+            if t is None or not t.public_state.can_write:
+                continue
+            writable += 1
+            node = routing.node_of_target(t.target_id)
+            if node is None:
+                continue
+            for b, (cid, data) in enumerate(items):
+                payload = (data[j * S : (j + 1) * S] if j < k
+                           else shards[b, j].tobytes())
+                by_node[node.node_id].append((b, ShardWriteReq(
+                    chain_id=chain_id,
+                    chain_ver=chain.chain_version,
+                    target_id=t.target_id,
+                    chunk_id=cid,
+                    data=payload,
+                    crc=int(crcs[b, j]),
+                    update_ver=1,
+                    chunk_size=S,
+                    logical_len=len(data),
+                )))
+        for node_id, group in by_node.items():
+            try:
+                got = self._messenger(
+                    node_id, "batch_write_shard", [r for _, r in group])
+            except FsError:
+                continue
+            for (b, _), reply in zip(group, got):
+                if reply.ok:
+                    acked[b] += 1
+                elif reply.code == Code.CHUNK_STALE_UPDATE:
+                    hard[b] = reply
+        out: List[UpdateReply] = []
+        for b, (cid, data) in enumerate(items):
+            # same strict rule as write_stripe: every writable shard acked
+            if acked[b] == writable and acked[b] >= k and hard[b] is None:
+                out.append(UpdateReply(Code.OK, update_ver=1, commit_ver=1))
+            else:
+                # conflict or partial: the single-stripe ladder re-probes
+                out.append(self.write_stripe(
+                    chain_id, cid, data, chunk_size=chunk_size))
+        return out
+
+    def read_stripe(
+        self,
+        chain_id: int,
+        chunk_id: ChunkId,
+        offset: int = 0,
+        length: int = -1,
+        *,
+        chunk_size: int = 1 << 20,
+    ) -> ReadReply:
+        """Read [offset, offset+length) of an EC-striped chunk: fetch the
+        covering data shards; on a missing/failed shard, gather any k
+        same-version survivors and reconstruct on device (degraded read)."""
+        from tpu3fs.ops.stripe import get_codec, shard_size_of
+
+        chain = self._chain(chain_id)
+        if not chain.is_ec:
+            raise FsError(Status(Code.INVALID_ARG, "read_stripe on CR chain"))
+        k, m = chain.ec_k, chain.ec_m
+        S = shard_size_of(chunk_size, k)
+        if length < 0:
+            length = chunk_size - offset
+        length = max(0, min(length, chunk_size - offset))
+        if length == 0:
+            return ReadReply(Code.OK, data=b"")
+        j0, j1 = offset // S, (offset + length - 1) // S + 1
+
+        last = ReadReply(Code.TARGET_NOT_FOUND)
+        for attempt in range(self._retry.max_retries + 1):
+            chain = self._chain(chain_id)
+            routing = self._routing()
+
+            def fetch(j: int) -> Optional[ReadReply]:
+                t = chain.target_of_shard(j)
+                if t is None or not t.public_state.can_read:
+                    return None
+                node = routing.node_of_target(t.target_id)
+                if node is None:
+                    return None
+                req = ReadReq(chain_id, chunk_id, 0, -1, t.target_id)
+                try:
+                    return self._messenger(node.node_id, "read", req)
+                except FsError as e:
+                    return ReadReply(e.code)
+
+            direct = {j: fetch(j) for j in range(j0, j1)}
+            vers = {
+                r.commit_ver for r in direct.values() if r is not None and r.ok
+            }
+            if (len(vers) == 1
+                    and all(r is not None and r.ok for r in direct.values())):
+                whole = b"".join(
+                    direct[j].data.ljust(S, b"\x00") for j in range(j0, j1)
+                )
+                lo = offset - j0 * S
+                logical = max(
+                    (j * S + len(direct[j].data) for j in range(j0, j1)
+                     if len(direct[j].data) > 0),
+                    default=0,
+                ) if (j0, j1) == (0, k) else 0
+                return ReadReply(
+                    Code.OK,
+                    data=whole[lo : lo + length],
+                    commit_ver=vers.pop(),
+                    logical_len=logical,
+                )
+            # degraded: gather every readable shard, group by version,
+            # reconstruct from the newest version with >= k members
+            replies = {j: (direct.get(j) or fetch(j)) for j in range(k + m)}
+            by_ver: Dict[int, Dict[int, bytes]] = defaultdict(dict)
+            all_missing = True
+            for j, r in replies.items():
+                if r is None:
+                    continue
+                if r.ok:
+                    by_ver[r.commit_ver][j] = r.data
+                    all_missing = False
+                elif r.code != Code.CHUNK_NOT_FOUND:
+                    all_missing = False
+            if all_missing:
+                return ReadReply(Code.CHUNK_NOT_FOUND)
+            usable = [v for v, g in by_ver.items() if len(g) >= k]
+            if usable:
+                ver = max(usable)
+                group = by_ver[ver]
+                present = sorted(group)[:k]
+                lost = [j for j in range(j0, j1) if j not in present]
+                import numpy as np
+
+                surv = np.stack([
+                    np.frombuffer(
+                        group[j].ljust(S, b"\x00"), dtype=np.uint8)
+                    for j in present
+                ])
+                codec = get_codec(k, m, S)
+                parts: Dict[int, bytes] = {
+                    j: group[j].ljust(S, b"\x00") for j in present
+                    if j0 <= j < j1
+                }
+                if lost:
+                    rebuilt = codec.reconstruct_batch(
+                        present, lost, surv[None])[0]
+                    for i, j in enumerate(lost):
+                        parts[j] = rebuilt[i].tobytes()
+                whole = b"".join(parts[j] for j in range(j0, j1))
+                lo = offset - j0 * S
+                logical = 0
+                if (j0, j1) == (0, k):
+                    from tpu3fs.ops.stripe import trim_rebuilt_shard
+
+                    lens = {j: len(group[j]) for j in present if j < k}
+                    logical = max(
+                        (j * S + len(group[j]) for j in present
+                         if j < k and len(group[j]) > 0), default=0)
+                    for j in lost:
+                        trimmed = trim_rebuilt_shard(
+                            parts[j], j, lens, k, S)
+                        if len(trimmed) > 0:
+                            logical = max(logical, j * S + len(trimmed))
+                return ReadReply(
+                    Code.OK, data=whole[lo : lo + length], commit_ver=ver,
+                    logical_len=logical)
+            # mixed versions / not enough shards yet: transient (a stripe
+            # write or rebuild is in flight) — retry
+            last = ReadReply(Code.CHUNK_NOT_COMMIT)
+            self._sleep(attempt)
+        return last
+
     # -- maintenance ----------------------------------------------------------
+    def _chain_nodes(self, chain: ChainInfo) -> List[int]:
+        """Distinct node ids hosting any target of the chain (EC fan-out)."""
+        routing = self._routing()
+        seen: List[int] = []
+        for t in chain.targets:
+            node = routing.node_of_target(t.target_id)
+            if node is not None and node.node_id not in seen:
+                seen.append(node.node_id)
+        return seen
+
     def remove_file_chunks(self, chain_id: int, file_id: int) -> None:
         chain = self._chain(chain_id)
+        if chain.is_ec:
+            # no propagation order on EC chains: address every node directly
+            for node_id in self._chain_nodes(chain):
+                try:
+                    self._messenger(
+                        node_id, "remove_file_chunks", (chain_id, file_id))
+                except FsError:
+                    continue  # dead node: resync reconciles its stale shards
+            return
         head = chain.head()
         if head is None:
             raise FsError(Status(Code.TARGET_OFFLINE, "no head"))
@@ -263,6 +694,15 @@ class StorageClient:
         self, chain_id: int, file_id: int, last_index: int, last_length: int
     ) -> None:
         chain = self._chain(chain_id)
+        if chain.is_ec:
+            for node_id in self._chain_nodes(chain):
+                try:
+                    self._messenger(
+                        node_id, "truncate_file_chunks",
+                        (chain_id, file_id, last_index, last_length))
+                except FsError:
+                    continue
+            return
         head = chain.head()
         if head is None:
             raise FsError(Status(Code.TARGET_OFFLINE, "no head"))
@@ -291,6 +731,24 @@ class StorageClient:
 
     def query_last_chunk(self, chain_id: int, file_id: int) -> Tuple[int, int]:
         chain = self._chain(chain_id)
+        if chain.is_ec:
+            # each target holds a different shard: the precise length is the
+            # max of all targets' (index, shard-position contribution) pairs
+            best = (-1, 0)
+            for t in chain.targets:
+                if t.public_state != PublicTargetState.SERVING:
+                    continue
+                node = self._routing().node_of_target(t.target_id)
+                if node is None:
+                    continue
+                try:
+                    got = self._messenger(
+                        node.node_id, "query_last_chunk", (chain_id, file_id))
+                except FsError:
+                    continue
+                if got[0] > best[0] or (got[0] == best[0] and got[1] > best[1]):
+                    best = tuple(got)
+            return best
         for t in chain.targets[::-1]:  # prefer tail: committed state
             if t.public_state != PublicTargetState.SERVING:
                 continue

@@ -48,6 +48,11 @@ class SystemSetupConfig:
     chunk_size: int = 1 << 16
     engine: str = "mem"
     heartbeat_timeout_s: float = 60.0
+    # EC(k, m) chain tables instead of CR replication: each chain gets
+    # k+m targets (on distinct nodes when possible) holding one stripe
+    # shard each; num_replicas is ignored for EC chains
+    ec_k: int = 0
+    ec_m: int = 0
 
 
 class _Node:
@@ -103,20 +108,32 @@ class Fabric:
         tid = self.FIRST_TARGET_ID
         node_ids = sorted(self.nodes)
         node_cursor = 0
+        is_ec = cfg.ec_k > 0
+        width = (cfg.ec_k + cfg.ec_m) if is_ec else cfg.num_replicas
+        # EC targets hold one shard of each stripe: engine chunk size is the
+        # shard size, not the stripe size
+        if is_ec:
+            from tpu3fs.ops.stripe import shard_size_of
+
+            target_chunk_size = shard_size_of(cfg.chunk_size, cfg.ec_k)
+        else:
+            target_chunk_size = cfg.chunk_size
         for c in range(cfg.num_chains):
             chain_id = self.FIRST_CHAIN_ID + c + 1
             target_ids = []
-            for _ in range(cfg.num_replicas):
+            for _ in range(width):
                 node_id = node_ids[node_cursor % len(node_ids)]
                 node_cursor += 1
                 self.mgmtd.create_target(tid, node_id=node_id)
                 target = StorageTarget(
-                    tid, chain_id, engine=cfg.engine, chunk_size=cfg.chunk_size
+                    tid, chain_id, engine=cfg.engine,
+                    chunk_size=target_chunk_size,
                 )
                 self.nodes[node_id].service.add_target(target)
                 target_ids.append(tid)
                 tid += 1
-            self.mgmtd.upload_chain(chain_id, target_ids)
+            self.mgmtd.upload_chain(
+                chain_id, target_ids, ec_k=cfg.ec_k, ec_m=cfg.ec_m)
             self.chain_ids.append(chain_id)
         self.mgmtd.upload_chain_table(1, self.chain_ids)
         self.heartbeat_all()
@@ -133,10 +150,18 @@ class Fabric:
         svc = node.service
         if method == "write":
             return svc.write(payload)
+        if method == "write_shard":
+            return svc.write_shard(payload)
         if method == "update":
             return svc.update(payload)
         if method == "read":
             return svc.read(payload)
+        if method == "batch_read":
+            return svc.batch_read(payload)
+        if method == "batch_write":
+            return svc.batch_write(payload)
+        if method == "batch_write_shard":
+            return svc.batch_write_shard(payload)
         if method == "dump_chunkmeta":
             return svc.dump_chunkmeta(payload)
         if method == "sync_done":
@@ -219,13 +244,19 @@ class Fabric:
         self.heartbeat_all()
         self.mgmtd.tick()
 
-    def resync_all(self, rounds: int = 4) -> int:
-        """Run resync workers on all live nodes until chains converge."""
+    def resync_all(self, rounds: int = 4, *, mesh=None) -> int:
+        """Run resync workers on all live nodes until chains converge.
+        CR chains use full-chunk-replace copying; EC chains rebuild the
+        recovering shard on device (optionally over a mesh collective)."""
+        from tpu3fs.storage.ec_resync import EcResyncWorker
+
         moved = 0
         for _ in range(rounds):
             for node in self.nodes.values():
                 if node.alive:
                     moved += ResyncWorker(node.service, self.send).run_once()
+                    moved += EcResyncWorker(
+                        node.service, self.send, mesh=mesh).run_once()
             self.tick()
             if all(
                 t.public_state == PublicTargetState.SERVING

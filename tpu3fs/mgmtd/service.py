@@ -216,13 +216,22 @@ class Mgmtd:
         self._routing.targets[target_id] = info
         self._routing.version = ver
 
-    def upload_chain(self, chain_id: int, target_ids: List[int]) -> None:
-        """Create a chain over existing targets, all SERVING/UPTODATE."""
+    def upload_chain(self, chain_id: int, target_ids: List[int],
+                     *, ec_k: int = 0, ec_m: int = 0) -> None:
+        """Create a chain over existing targets, all SERVING/UPTODATE.
+        With ec_k/ec_m the chain is an erasure-coded group (chain-table type
+        "EC", ref data_placement.py:30): target i holds shard i."""
+        if ec_k and len(target_ids) != ec_k + ec_m:
+            raise FsError(Status(
+                Code.INVALID_ARG,
+                f"EC({ec_k},{ec_m}) needs {ec_k + ec_m} targets, "
+                f"got {len(target_ids)}"))
         targets = [
             ChainTarget(t, PublicTargetState.SERVING, LocalTargetState.UPTODATE)
             for t in target_ids
         ]
-        chain = ChainInfo(chain_id, 1, targets, list(target_ids))
+        chain = ChainInfo(chain_id, 1, targets, list(target_ids),
+                          ec_k=ec_k, ec_m=ec_m)
         staged_infos = []
         for tid in target_ids:
             info = self._routing.targets.get(tid)

@@ -79,6 +79,27 @@ class ChainInfo:
     chain_version: int = 1
     targets: List[ChainTarget] = field(default_factory=list)
     preferred_order: List[int] = field(default_factory=list)
+    # EC chain-table type (ref deploy/data_placement data_placement.py:30
+    # chain_table_type Literal["EC","CR"]): ec_k/ec_m nonzero makes this an
+    # erasure-coded group — target at preferred_order position i holds shard
+    # i of every stripe (i < ec_k data, else parity); (0, 0) = CRAQ chain
+    ec_k: int = 0
+    ec_m: int = 0
+
+    @property
+    def is_ec(self) -> bool:
+        return self.ec_k > 0
+
+    def shard_index(self, target_id: int) -> int:
+        """Stable shard position of a target (chain_sm may reorder
+        `targets`; `preferred_order` preserves the layout positions)."""
+        return self.preferred_order.index(target_id)
+
+    def target_of_shard(self, shard: int) -> Optional[ChainTarget]:
+        if shard >= len(self.preferred_order):
+            return None
+        tid = self.preferred_order[shard]
+        return next((t for t in self.targets if t.target_id == tid), None)
 
     def serving_targets(self) -> List[ChainTarget]:
         return [t for t in self.targets if t.public_state == PublicTargetState.SERVING]

@@ -1,0 +1,154 @@
+"""Stripe codec: the device-resident EC data plane the serving path calls.
+
+One stripe = one file chunk split into k data shards of S bytes plus m
+parity shards. Encode (RS(k,m) GF(2) bit-matmul, Pallas on TPU) and batched
+CRC32C run on device; decode/reconstruct goes through the same
+RSCode.reconstruct_fn the rebuild benches and the multi-chip dryrun use, so
+a kernel improvement lands everywhere at once.
+
+The reference has no RS path (it replicates via CRAQ, docs/design_notes.md
+"Data replication"); "EC" exists there as a chain-table type in the
+placement solver (deploy/data_placement/src/model/data_placement.py:30).
+This module is the added TPU-native capability from BASELINE.json, gated by
+ChainInfo.ec_k/ec_m the way the reference gates engines per target
+(src/storage/store/StorageTarget.h:162).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpu3fs.ops.crc32c import BatchCrc32c, crc32c
+from tpu3fs.ops.rs import RSCode
+
+# codecs are heavyweight (device matrices + compiled fns): share per-process
+_cache_lock = threading.Lock()
+_codecs: Dict[Tuple[int, int, int], "StripeCodec"] = {}
+
+
+def get_codec(k: int, m: int, shard_size: int) -> "StripeCodec":
+    key = (k, m, shard_size)
+    with _cache_lock:
+        codec = _codecs.get(key)
+        if codec is None:
+            codec = StripeCodec(k, m, shard_size)
+            _codecs[key] = codec
+        return codec
+
+
+def aligned_shard_size(n: int) -> int:
+    """Round a working shard size up to the same 512B/64B grid
+    shard_size_of uses — zero padding is free for RS/CRC math, and the
+    alignment keeps the per-(k, m, S) codec cache from fragmenting into one
+    compiled kernel per distinct logical tail length."""
+    align = 512 if n >= 512 else 64
+    return -(-n // align) * align
+
+
+def shard_size_of(chunk_size: int, k: int) -> int:
+    """Shard size for a chunk striped over k data shards (last shard padded).
+
+    Rounded up to a CRC-block/TPU-lane-friendly boundary (512B, or 64B for
+    tiny shards) — client and server both derive S through here, so the
+    alignment is part of the stripe format."""
+    s0 = -(-chunk_size // k)
+    align = 512 if s0 >= 512 else 64
+    return -(-s0 // align) * align
+
+
+class StripeCodec:
+    """Encode/decode/checksum a batch of stripes on the device."""
+
+    def __init__(self, k: int, m: int, shard_size: int):
+        self.k = k
+        self.m = m
+        self.shard_size = shard_size
+        self.rs = RSCode(k, m)
+        block = 512 if shard_size % 512 == 0 else shard_size
+        self._crc = BatchCrc32c(shard_size, block=block)
+
+    # -- encode --------------------------------------------------------------
+    def encode_batch(self, data: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(B, k, S) uint8 -> (shards (B, k+m, S), crcs (B, k+m) uint32),
+        both materialized on host for the RPC layer."""
+        import jax
+        import jax.numpy as jnp
+
+        b, k, s = data.shape
+        assert k == self.k and s == self.shard_size, (data.shape, self.k)
+        dev_data = jnp.asarray(data)
+        parity = self.rs.encode(dev_data)
+        shards = jnp.concatenate([dev_data, parity], axis=1)
+        crcs = self._crc.compute(shards.reshape(b * (k + self.m), s))
+        shards, crcs = jax.device_get((shards, crcs))
+        return np.asarray(shards), np.asarray(crcs).reshape(b, k + self.m)
+
+    def encode_stripe(self, chunk: bytes) -> Tuple[np.ndarray, np.ndarray]:
+        """One chunk (<= k*S bytes, zero-padded) -> ((k+m, S), (k+m,))."""
+        buf = np.zeros((self.k, self.shard_size), dtype=np.uint8)
+        flat = np.frombuffer(chunk, dtype=np.uint8)
+        buf.reshape(-1)[: flat.size] = flat
+        shards, crcs = self.encode_batch(buf[None])
+        return shards[0], crcs[0]
+
+    # -- decode --------------------------------------------------------------
+    def reconstruct_batch(
+        self,
+        present_idx: Sequence[int],
+        lost_idx: Sequence[int],
+        present: np.ndarray,
+    ) -> np.ndarray:
+        """(B, k, S) survivors at present_idx -> (B, len(lost), S) rebuilt.
+        The single-chip serving path; the pod-scale variant is
+        tpu3fs.parallel.rebuild.rebuild_lost_shard over a mesh (same
+        reconstruct_fn underneath)."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = self.rs.reconstruct_fn(tuple(present_idx), tuple(lost_idx))
+        return np.asarray(jax.device_get(fn(jnp.asarray(present))))
+
+    def crc_batch(self, shards: np.ndarray) -> np.ndarray:
+        """(N, S) uint8 -> (N,) uint32 on device."""
+        import jax
+
+        return np.asarray(jax.device_get(self._crc.compute(shards)))
+
+    # -- host-side assembly helpers ------------------------------------------
+    def assemble(self, data_shards: List[Optional[bytes]], length: int) -> bytes:
+        """Concatenate k data shards (None = absent, an error upstream)
+        and trim the stripe padding to the chunk's logical length."""
+        assert all(s is not None for s in data_shards)
+        return b"".join(data_shards)[:length]
+
+    def crc_host(self, shard: bytes) -> int:
+        """Host-side single-shard CRC for validation off the batch path."""
+        return crc32c(shard.ljust(self.shard_size, b"\x00"))
+
+
+def trim_rebuilt_shard(
+    rebuilt: bytes, j: int, survivor_lens: Dict[int, int], k: int, S: int
+) -> bytes:
+    """Trim a rebuilt data shard back to its stored (logical) extent.
+
+    Shards are stored trimmed — shard j holds chunk bytes [j*S, (j+1)*S) up
+    to the stripe's logical length — so the rebuilt padded bytes must be
+    cut back or the re-installed shard would inflate the stripe's recorded
+    length. survivor_lens maps surviving DATA shard index -> stored length.
+
+    Exact cases: any nonempty survivor above j proves shard j was full; a
+    nonempty-to-empty boundary below j proves it was empty. The one
+    ambiguous case (j is the last nonempty shard, partially filled) falls
+    back to trailing-zero trimming: bytes stay exact either way, only the
+    recorded length can undershoot if the true content ends in zeros."""
+    if j >= k:
+        return rebuilt  # parity shards are always stored full
+    if any(lj > 0 for i, lj in survivor_lens.items() if i > j and i < k):
+        return rebuilt  # a later data shard has content: j was full
+    below = [lj for i, lj in survivor_lens.items() if i < j]
+    if below and min(below) < S:
+        return b""  # an earlier shard is short: logical length < j*S
+    return rebuilt.rstrip(b"\x00")

@@ -21,7 +21,14 @@ from tpu3fs.meta.types import DirEntry, Inode
 from tpu3fs.mgmtd.service import HeartbeatReply, Mgmtd
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType, RoutingInfo
 from tpu3fs.rpc.net import RpcClient, RpcServer, ServiceDef
-from tpu3fs.storage.craq import ReadReply, ReadReq, StorageService, UpdateReply, WriteReq
+from tpu3fs.storage.craq import (
+    ReadReply,
+    ReadReq,
+    ShardWriteReq,
+    StorageService,
+    UpdateReply,
+    WriteReq,
+)
 from tpu3fs.storage.types import ChunkId, ChunkMeta, SpaceInfo
 from tpu3fs.utils.result import Code, FsError, Status
 
@@ -61,6 +68,31 @@ class TruncateChunksReq:
     file_id: int
     last_index: int
     last_length: int
+
+
+@dataclass
+class BatchReadReq:
+    reqs: List[ReadReq] = field(default_factory=list)
+
+
+@dataclass
+class BatchReadRsp:
+    replies: List[ReadReply] = field(default_factory=list)
+
+
+@dataclass
+class BatchWriteReq:
+    reqs: List[WriteReq] = field(default_factory=list)
+
+
+@dataclass
+class BatchShardWriteReq:
+    reqs: List[ShardWriteReq] = field(default_factory=list)
+
+
+@dataclass
+class BatchWriteRsp:
+    replies: List[UpdateReply] = field(default_factory=list)
 
 
 @dataclass
@@ -136,6 +168,13 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
              lambda r: IntReply(svc.truncate_file_chunks(
                  r.chain_id, r.file_id, r.last_index, r.last_length)))
     s.method(10, "spaceInfo", Empty, SpaceInfo, lambda r: svc.space_info())
+    s.method(11, "batchRead", BatchReadReq, BatchReadRsp,
+             lambda r: BatchReadRsp(svc.batch_read(r.reqs)))
+    s.method(12, "batchWrite", BatchWriteReq, BatchWriteRsp,
+             lambda r: BatchWriteRsp(svc.batch_write(r.reqs)))
+    s.method(13, "writeShard", ShardWriteReq, UpdateReply, svc.write_shard)
+    s.method(14, "batchWriteShard", BatchShardWriteReq, BatchWriteRsp,
+             lambda r: BatchWriteRsp(svc.batch_write_shard(r.reqs)))
     server.add_service(s)
 
 
@@ -183,6 +222,16 @@ class RpcMessenger:
             return c.call(addr, sid, 9, TruncateChunksReq(*payload), IntReply).value
         if method == "space_info":
             return c.call(addr, sid, 10, Empty(), SpaceInfo)
+        if method == "batch_read":
+            return c.call(addr, sid, 11, BatchReadReq(payload), BatchReadRsp).replies
+        if method == "batch_write":
+            return c.call(addr, sid, 12, BatchWriteReq(payload), BatchWriteRsp).replies
+        if method == "write_shard":
+            return c.call(addr, sid, 13, payload, UpdateReply)
+        if method == "batch_write_shard":
+            return c.call(
+                addr, sid, 14, BatchShardWriteReq(payload), BatchWriteRsp
+            ).replies
         raise FsError(Status(Code.RPC_METHOD_NOT_FOUND, method))
 
 

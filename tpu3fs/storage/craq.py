@@ -86,12 +86,35 @@ class UpdateReply:
 
 
 @dataclass
+class ShardWriteReq:
+    """EC stripe-shard write: target-addressed, whole-shard, versioned.
+
+    Unlike CRAQ writes there is no chain forwarding — the client (or the
+    rebuild worker) addresses each shard's target directly; consistency
+    comes from the stripe version: readers only combine shards whose
+    committed version matches (tpu3fs EC design; the reference has no RS
+    path — "EC" is a chain-table type in its placement solver only,
+    deploy/data_placement/src/model/data_placement.py:30)."""
+
+    chain_id: int
+    chain_ver: int
+    target_id: int
+    chunk_id: ChunkId
+    data: bytes
+    crc: int                     # CRC32C of data (device-computed)
+    update_ver: int              # stripe version
+    chunk_size: int              # shard size (engine chunk size)
+    logical_len: int = 0         # pre-padding stripe payload length
+
+
+@dataclass
 class ReadReq:
     chain_id: int
     chunk_id: ChunkId
     offset: int = 0
     length: int = -1
     target_id: int = 0           # the selected serving target
+    chunk_size: int = 0          # EC chains: logical stripe size (for S)
 
 
 @dataclass
@@ -100,6 +123,9 @@ class ReadReply:
     data: bytes = b""
     commit_ver: int = 0
     checksum: Checksum = field(default_factory=Checksum)
+    # EC full-stripe reads: the stripe's logical (pre-padding) byte length,
+    # derived from trimmed shard lengths; 0 when unknown/not applicable
+    logical_len: int = 0
 
     @property
     def ok(self) -> bool:
@@ -428,6 +454,94 @@ class StorageService:
             Code.CLIENT_RETRIES_EXHAUSTED, message="forwarding retries exhausted"
         )
 
+    # -- EC shard writes (stripe data plane; no chain forwarding) -------------
+    def write_shard(self, req: ShardWriteReq) -> UpdateReply:
+        """Install one stripe shard on a local EC target: validate the
+        device-computed CRC, then full-replace at the stripe version.
+        Idempotent: a retry of the same (version, content) succeeds; a
+        stale version loses to a newer committed shard."""
+        if self.stopped:
+            return UpdateReply(Code.RPC_PEER_CLOSED, message="node stopped")
+        try:
+            chain = self._chain(req.chain_id)
+        except FsError as e:
+            return UpdateReply(e.code, message=e.status.message)
+        if not chain.is_ec:
+            return UpdateReply(Code.INVALID_ARG, message="not an EC chain")
+        target = self._targets.get(req.target_id)
+        if target is None:
+            return UpdateReply(Code.TARGET_NOT_FOUND, message=str(req.target_id))
+        # CRC covers the zero-padded shard (the device batch form); the
+        # engine stores the trimmed bytes
+        padded = req.data.ljust(req.chunk_size, b"\x00")
+        if Checksum.of(padded).value != req.crc:
+            return UpdateReply(
+                Code.CHUNK_CHECKSUM_MISMATCH,
+                message=f"shard crc mismatch on target {req.target_id}",
+            )
+        with self._chunk_lock(req.target_id, req.chunk_id):
+            try:
+                inject("storage.write_shard")
+                chain = self._chain(req.chain_id)  # re-check under the lock
+                engine = target.engine
+                meta = engine.get_meta(req.chunk_id)
+                if meta is not None and meta.committed_ver > req.update_ver:
+                    return UpdateReply(
+                        Code.CHUNK_STALE_UPDATE,
+                        commit_ver=meta.committed_ver,
+                        message=f"shard at {meta.committed_ver} > "
+                                f"{req.update_ver}",
+                    )
+                if meta is not None and meta.committed_ver == req.update_ver:
+                    if meta.checksum.value == Checksum.of(req.data).value:
+                        return UpdateReply(  # duplicate of the applied write
+                            Code.OK, update_ver=req.update_ver,
+                            commit_ver=meta.committed_ver,
+                            checksum=meta.checksum)
+                    # different content at the taken version: an overwrite
+                    # probing below the committed stripe, or a concurrent
+                    # writer that lost the race — either way the client must
+                    # re-encode above the committed version (stale, not a
+                    # corruption error)
+                    return UpdateReply(
+                        Code.CHUNK_STALE_UPDATE,
+                        commit_ver=meta.committed_ver,
+                        message="stripe version taken by different content",
+                    )
+                meta = engine.update(
+                    req.chunk_id,
+                    req.update_ver,
+                    chain.chain_version,
+                    req.data,
+                    0,
+                    full_replace=True,
+                    chunk_size=req.chunk_size,
+                )
+                return UpdateReply(
+                    Code.OK,
+                    update_ver=req.update_ver,
+                    commit_ver=meta.committed_ver,
+                    checksum=meta.checksum,
+                )
+            except FsError as e:
+                return UpdateReply(e.code, message=e.status.message)
+
+    # -- batched IO (one request carries many ops; ref BatchReadReq
+    # StorageOperator.cc:82-231, batchWrite StorageClientImpl.cc:1771) -------
+    def batch_read(self, reqs: List[ReadReq]) -> List[ReadReply]:
+        """Many reads in ONE request — the per-op RPC round trip is what the
+        batch eliminates; ops execute against local targets directly."""
+        return [self.read(r) for r in reqs]
+
+    def batch_write(self, reqs: List[WriteReq]) -> List[UpdateReply]:
+        """Many head-writes in one request; each op still runs the full
+        CRAQ update/forward/commit machinery."""
+        return [self.write(r) for r in reqs]
+
+    def batch_write_shard(self, reqs: List[ShardWriteReq]) -> List[UpdateReply]:
+        """Many EC shard installs in one request (the stripe-batch path)."""
+        return [self.write_shard(r) for r in reqs]
+
     # -- reads (apportioned; ref batchRead :82-231) ---------------------------
     def read(self, req: ReadReq) -> ReadReply:
         with self._read_rec.record() as op:
@@ -475,13 +589,37 @@ class StorageService:
     # -- file-level helpers (meta service hooks) ------------------------------
     def query_last_chunk(self, chain_id: int, file_id: int) -> Tuple[int, int]:
         """-> (max chunk index, its committed length) for a file on this node's
-        target of the chain; (-1, 0) if none (ref queryLastChunk)."""
+        target of the chain; (-1, 0) if none (ref queryLastChunk).
+
+        On an EC chain the local target holds shard j of each stripe, so the
+        in-chunk length contribution is j*S + shard_len (0 for parity shards
+        and empty data shards); the client maxes contributions over targets
+        to recover the precise logical length."""
         chain = self._chain(chain_id)
+        if chain.is_ec:
+            # a node may host SEVERAL shards of one EC chain: max the
+            # contribution over every local target, not just the first
+            best = (-1, 0)
+            for t in chain.targets:
+                if t.target_id not in self._targets:
+                    continue
+                target = self._targets[t.target_id]
+                metas = [m for m in target.engine.query(
+                    ChunkId.file_prefix(file_id)) if m.committed_ver > 0]
+                if not metas:
+                    continue
+                last = max(metas, key=lambda m: m.chunk_id.index)
+                shard = chain.shard_index(t.target_id)
+                contrib = (0 if shard >= chain.ec_k or last.length == 0
+                           else shard * target.chunk_size + last.length)
+                got = (last.chunk_id.index, contrib)
+                if got[0] > best[0] or (got[0] == best[0] and got[1] > best[1]):
+                    best = got
+            return best
         for t in chain.targets:
             if t.target_id in self._targets:
-                metas = self._targets[t.target_id].engine.query(
-                    ChunkId.file_prefix(file_id)
-                )
+                target = self._targets[t.target_id]
+                metas = target.engine.query(ChunkId.file_prefix(file_id))
                 metas = [m for m in metas if m.committed_ver > 0]
                 if not metas:
                     return -1, 0
@@ -491,9 +629,19 @@ class StorageService:
 
     def remove_file_chunks(self, chain_id: int, file_id: int) -> int:
         """Remove all chunks of a file on the local target and forward down
-        the chain (removes are idempotent; ref removeChunks)."""
+        the chain (removes are idempotent; ref removeChunks). EC chains have
+        no propagation order: each shard's node is addressed directly by the
+        caller, so remove from EVERY local target of the chain, no forward."""
         chain = self._chain(chain_id)
         removed = 0
+        if chain.is_ec:
+            for t in chain.targets:
+                if t.target_id in self._targets:
+                    engine = self._targets[t.target_id].engine
+                    for meta in engine.query(ChunkId.file_prefix(file_id)):
+                        engine.remove(meta.chunk_id)
+                        removed += 1
+            return removed
         mine, my_idx, writers = self._local_writer(chain)
         if mine is None:
             return 0
@@ -514,8 +662,24 @@ class StorageService:
     ) -> int:
         """Truncate a file's chunks on the local target: remove chunks past
         last_index, trim the boundary chunk, and forward down the chain
-        (idempotent, like removes; ref truncateChunks)."""
+        (idempotent, like removes; ref truncateChunks).
+
+        EC chains: drop whole stripes past last_index on every local target
+        of the chain and do not forward or trim the boundary — the client
+        re-encodes and rewrites the boundary stripe itself (trimming one
+        shard would invalidate the parity)."""
         chain = self._chain(chain_id)
+        if chain.is_ec:
+            touched = 0
+            for t in chain.targets:
+                if t.target_id in self._targets:
+                    engine = self._targets[t.target_id].engine
+                    for meta in engine.query(ChunkId.file_prefix(file_id)):
+                        if meta.chunk_id.index > last_index:
+                            with self._chunk_lock(t.target_id, meta.chunk_id):
+                                engine.remove(meta.chunk_id)
+                            touched += 1
+            return touched
         mine, my_idx, writers = self._local_writer(chain)
         if mine is None:
             return 0

@@ -1,0 +1,248 @@
+"""EC rebuild worker: reconstruct a recovering target's shards on device.
+
+The CR chains recover by full-chunk-replace copying from a chain peer
+(tpu3fs/storage/resync.py, ref src/storage/sync/ResyncWorker.cc). EC chains
+have no replica to copy from — the recovering target's shard of every stripe
+is REBUILT from any k surviving shards with one batched GF(2) bit-matmul
+(the BASELINE.json "rebuild 14 TiB < 5 min" path):
+
+  1. union the stripe lists of the serving peers (dump-chunkmeta),
+  2. for each batch of stripes, read k surviving shards per stripe,
+  3. one batched RSCode.reconstruct on device rebuilds the lost shard rows
+     — on a pod, the same decode runs inside the all-gather collective of
+     tpu3fs.parallel.rebuild.rebuild_lost_shard (pass a mesh),
+  4. install each rebuilt shard on the recovering target (write_shard,
+     trimmed back to its stored extent), then sync-done.
+
+Any SERVING node of the chain can run the rebuild for a SYNCING member;
+the worker is driven off routing exactly like the CR resync worker.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tpu3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
+from tpu3fs.storage.craq import Messenger, ReadReq, ShardWriteReq, StorageService
+from tpu3fs.storage.types import ChunkId, ChunkMeta
+from tpu3fs.utils.result import Code, FsError
+
+
+class EcResyncWorker:
+    def __init__(self, service: StorageService, messenger: Messenger, *,
+                 batch_stripes: int = 64, mesh=None):
+        self._service = service
+        self._messenger = messenger
+        self._batch = batch_stripes
+        # optional device mesh: rebuild through the ICI all-gather collective
+        # (tpu3fs.parallel.rebuild) instead of the single-chip decode
+        self._mesh = mesh
+
+    def run_once(self) -> int:
+        """One rebuild round over all local EC chains; returns shards moved."""
+        routing: RoutingInfo = self._service._routing()
+        local_ids = {t.target_id for t in self._service.targets()}
+        moved = 0
+        for chain in routing.chains.values():
+            if not chain.is_ec:
+                continue
+            syncing = [t for t in chain.targets
+                       if t.public_state == PublicTargetState.SYNCING]
+            if not syncing:
+                continue
+            # the first serving member acts as rebuild coordinator (one
+            # recovery driver per chain, mirroring the CR predecessor rule)
+            serving = chain.serving_targets()
+            if not serving or serving[0].target_id not in local_ids:
+                continue
+            for t in syncing:
+                moved += self._rebuild_target(routing, chain, t.target_id)
+        return moved
+
+    # -- one recovering target ------------------------------------------------
+    def _rebuild_target(self, routing: RoutingInfo, chain: ChainInfo,
+                        target_id: int) -> int:
+        k, m = chain.ec_k, chain.ec_m
+        lost_shard = chain.shard_index(target_id)
+        node = routing.node_of_target(target_id)
+        if node is None:
+            return 0
+        # stripe inventory = union over serving peers (any k shards name the
+        # stripe; one peer may have missed a write)
+        stripes: Dict[bytes, ChunkId] = {}
+        dumps_ok = 0
+        for t in chain.serving_targets():
+            pn = routing.node_of_target(t.target_id)
+            if pn is None:
+                continue
+            try:
+                metas: List[ChunkMeta] = self._messenger(
+                    pn.node_id, "dump_chunkmeta", t.target_id)
+            except FsError:
+                continue
+            dumps_ok += 1
+            for meta in metas:
+                if meta.committed_ver > 0:
+                    stripes[meta.chunk_id.to_bytes()] = meta.chunk_id
+        if dumps_ok == 0:
+            # can't see any peer inventory: declaring up-to-date now would
+            # promote a hollow target — leave SYNCING for the next round
+            return 0
+        if not stripes:
+            self._messenger(node.node_id, "sync_done", target_id)
+            return 0
+        moved = 0
+        failed = 0
+        todo = list(stripes.values())
+        for base in range(0, len(todo), self._batch):
+            ok, bad = self._rebuild_batch(
+                routing, chain, todo[base : base + self._batch],
+                lost_shard, node.node_id, target_id)
+            moved += ok
+            failed += bad
+        # stale-chunk cleanup: shards on the recovering target for stripes
+        # no peer knows anymore
+        try:
+            have: List[ChunkMeta] = self._messenger(
+                node.node_id, "dump_chunkmeta", target_id)
+            for meta in have:
+                if meta.chunk_id.to_bytes() not in stripes:
+                    self._messenger(
+                        node.node_id, "remove_chunk", (target_id, meta.chunk_id))
+        except FsError:
+            failed += 1
+        if failed == 0:
+            # only promote when EVERY stripe was rebuilt this round —
+            # skipped stripes (in-flight writes, failed installs) must get
+            # another pass before the target may serve reads
+            self._messenger(node.node_id, "sync_done", target_id)
+        return moved
+
+    def _read_shard(self, routing: RoutingInfo, chain: ChainInfo, j: int,
+                    chunk_id: ChunkId):
+        t = chain.target_of_shard(j)
+        if t is None or not t.public_state.can_read:
+            return None
+        pn = routing.node_of_target(t.target_id)
+        if pn is None:
+            return None
+        try:
+            r = self._messenger(
+                pn.node_id, "read",
+                ReadReq(chain.chain_id, chunk_id, 0, -1, t.target_id))
+        except FsError:
+            return None
+        return r if r.ok else None
+
+    def _rebuild_batch(self, routing: RoutingInfo, chain: ChainInfo,
+                       chunk_ids: List[ChunkId], lost_shard: int,
+                       node_id: int, target_id: int) -> tuple:
+        """-> (shards installed, stripes skipped/failed this round)."""
+        from tpu3fs.ops.stripe import (
+            aligned_shard_size,
+            get_codec,
+            trim_rebuilt_shard,
+        )
+
+        k, m = chain.ec_k, chain.ec_m
+        # gather survivors per stripe; stripes whose shard sets disagree on
+        # version are skipped this round (a write is in flight)
+        gathered = []  # (chunk_id, ver, {shard: bytes}, S)
+        skipped = 0
+        for cid in chunk_ids:
+            by_ver: Dict[int, Dict[int, bytes]] = {}
+            for j in range(k + m):
+                if j == lost_shard:
+                    continue
+                r = self._read_shard(routing, chain, j, cid)
+                if r is None:
+                    continue
+                by_ver.setdefault(r.commit_ver, {})[j] = r.data
+            usable = [v for v, g in by_ver.items() if len(g) >= k]
+            if not usable:
+                skipped += 1
+                continue
+            ver = max(usable)
+            shards = by_ver[ver]
+            # shard size is per-file (S = ceil(chunk_size/k)); the max stored
+            # survivor length is a safe working size: content beyond any
+            # shard's stored extent is zeros, and GF-multiplying zeros
+            # contributes zeros, so decoding at the shorter padded size is
+            # byte-exact over the true extents
+            S = max(len(b) for b in shards.values())
+            if S == 0:
+                continue  # all-empty stripe: nothing to rebuild
+            gathered.append((cid, ver, shards, aligned_shard_size(S)))
+        if not gathered:
+            return 0, skipped
+        # group stripes by (survivor index set, working size) so each group
+        # is ONE batched device decode
+        groups: Dict[tuple, List[int]] = {}
+        for i, (_, _, shards, S) in enumerate(gathered):
+            present = tuple(sorted(shards)[:k])
+            groups.setdefault((present, S), []).append(i)
+        moved = 0
+        for (present, S), idxs in groups.items():
+            codec = get_codec(k, m, S)
+            surv = np.stack([
+                np.stack([
+                    np.frombuffer(
+                        gathered[i][2][j].ljust(S, b"\x00"), dtype=np.uint8)
+                    for j in present
+                ])
+                for i in idxs
+            ])  # (B, k, S)
+            rebuilt = self._reconstruct(codec, present, (lost_shard,), surv)
+            for row, i in enumerate(idxs):
+                cid, ver, shards, _ = gathered[i]
+                lens = {j: len(b) for j, b in shards.items() if j < k}
+                payload = trim_rebuilt_shard(
+                    rebuilt[row, 0].tobytes(), lost_shard, lens, k, S)
+                crc = codec.crc_host(payload)
+                req = ShardWriteReq(
+                    chain_id=chain.chain_id,
+                    chain_ver=chain.chain_version,
+                    target_id=target_id,
+                    chunk_id=cid,
+                    data=payload,
+                    crc=crc,
+                    update_ver=ver,
+                    chunk_size=S,
+                )
+                try:
+                    reply = self._messenger(node_id, "write_shard", req)
+                except FsError:
+                    skipped += 1
+                    continue
+                if reply.ok:
+                    moved += 1
+                else:
+                    skipped += 1
+        return moved, skipped
+
+    def _reconstruct(self, codec, present, lost, surv: np.ndarray) -> np.ndarray:
+        """(B, k, S) -> (B, len(lost), S): mesh collective path when a mesh
+        was provided (the multi-chip dryrun drives this), single-chip
+        otherwise — both via RSCode.reconstruct_fn."""
+        if self._mesh is not None:
+            import jax.numpy as jnp
+
+            from tpu3fs.parallel.rebuild import rebuild_lost_shard
+
+            n = codec.k + codec.m
+            B, _, S = surv.shape
+            full = np.zeros((n, B, S), dtype=np.uint8)
+            for row, j in enumerate(present):
+                full[j] = surv[:, row, :]
+            # rebuild_lost_shard derives its survivor set as "everything not
+            # lost" — so every shard NOT in our present set must be declared
+            # lost, or its zero-filled row would be decoded as real data
+            mesh_lost = sorted(set(range(n)) - set(present))
+            out = rebuild_lost_shard(
+                self._mesh, jnp.asarray(full), codec.rs, mesh_lost)
+            out = np.moveaxis(np.asarray(out), 0, 1)  # (B, mesh_lost, S)
+            cols = [mesh_lost.index(j) for j in lost]
+            return out[:, cols, :]
+        return codec.reconstruct_batch(present, lost, surv)

@@ -29,6 +29,11 @@ class ResyncWorker:
         routing: RoutingInfo = self._service._routing()
         transferred = 0
         for chain in routing.chains.values():
+            if chain.is_ec:
+                # EC members hold DIFFERENT shards — copying a peer's shard
+                # would corrupt the recovering target; EC recovery is the
+                # decode rebuild in tpu3fs/storage/ec_resync.py
+                continue
             writers = chain.writer_chain()
             for i, t in enumerate(writers[:-1]):
                 if t.target_id not in {
