@@ -76,7 +76,7 @@ struct Crc32cTables {
 };
 const Crc32cTables kCrc;
 
-uint32_t crc32c(const uint8_t* data, size_t n, uint32_t crc = 0) {
+uint32_t crc32c_sw(const uint8_t* data, size_t n, uint32_t crc) {
   uint32_t c = ~crc;
   while (n >= 8) {
     uint64_t w;
@@ -92,6 +92,54 @@ uint32_t crc32c(const uint8_t* data, size_t n, uint32_t crc = 0) {
   while (n--) c = (c >> 8) ^ kCrc.t[0][(c ^ *data++) & 0xFF];
   return ~c;
 }
+
+#if defined(__x86_64__)
+// Hardware CRC32C (SSE4.2 crc32 instruction computes exactly the
+// Castagnoli polynomial). The crc32q chain has 3-cycle latency, so four
+// independent accumulators over interleaved lanes keep the unit saturated;
+// lanes are then stitched with the slice-by-8 combine (zero-shift trick:
+// feeding the next lane's bytes through the running crc is equivalent to
+// a serial pass because each lane is processed in order here — we simply
+// unroll 32 bytes per iteration on ONE stream, which already hides most
+// of the latency for cache-resident data).
+__attribute__((target("sse4.2")))
+uint32_t crc32c_hw(const uint8_t* data, size_t n, uint32_t crc) {
+  uint64_t c = static_cast<uint32_t>(~crc);
+  while (n >= 32) {
+    uint64_t w0, w1, w2, w3;
+    memcpy(&w0, data, 8);
+    memcpy(&w1, data + 8, 8);
+    memcpy(&w2, data + 16, 8);
+    memcpy(&w3, data + 24, 8);
+    c = __builtin_ia32_crc32di(c, w0);
+    c = __builtin_ia32_crc32di(c, w1);
+    c = __builtin_ia32_crc32di(c, w2);
+    c = __builtin_ia32_crc32di(c, w3);
+    data += 32;
+    n -= 32;
+  }
+  while (n >= 8) {
+    uint64_t w;
+    memcpy(&w, data, 8);
+    c = __builtin_ia32_crc32di(c, w);
+    data += 8;
+    n -= 8;
+  }
+  uint32_t c32 = static_cast<uint32_t>(c);
+  while (n--) c32 = __builtin_ia32_crc32qi(c32, *data++);
+  return ~c32;
+}
+
+const bool kHasSse42 = __builtin_cpu_supports("sse4.2");
+
+uint32_t crc32c(const uint8_t* data, size_t n, uint32_t crc = 0) {
+  return kHasSse42 ? crc32c_hw(data, n, crc) : crc32c_sw(data, n, crc);
+}
+#else
+uint32_t crc32c(const uint8_t* data, size_t n, uint32_t crc = 0) {
+  return crc32c_sw(data, n, crc);
+}
+#endif
 
 // ---- block reference ------------------------------------------------------
 struct BlockRef {
@@ -352,18 +400,39 @@ struct Engine {
   }
 
   // -- engine ops ----------------------------------------------------------
-  int update(const Key& k, uint64_t update_ver, uint64_t chain_ver,
+  // io_ver: in/out — 0 on input means "assign committed+1" (the head-write
+  // case); on return carries the staged version. out_len/out_crc (nullable)
+  // report the staged pending block so callers never have to materialize
+  // the chunk content to checksum it (the per-hop copy the Python path
+  // used to pay; ref StorageOperator.cc:464-482 cross-check).
+  int update(const Key& k, uint64_t* io_ver, uint64_t chain_ver,
              const uint8_t* data, uint32_t data_len, uint32_t offset,
-             int full_replace, uint32_t chunk_size) {
-    if (offset + data_len > chunk_size) return E_INVALID;
+             int full_replace, uint32_t chunk_size, uint32_t* out_len,
+             uint32_t* out_crc) {
+    // overflow-safe bound: offset + data_len can wrap uint32
+    if (offset > chunk_size || data_len > chunk_size - offset)
+      return E_INVALID;
+    uint64_t update_ver = *io_ver;
     // validate against the existing meta (or an empty one) BEFORE inserting,
     // so rejected updates leave no phantom committed_ver=0 chunk behind
     {
       auto it = metas.find(k);
       uint64_t cv = it != metas.end() ? it->second.committed_ver : 0;
       uint64_t pv = it != metas.end() ? it->second.pending_ver : 0;
+      if (update_ver == 0) {
+        update_ver = cv + 1;
+        *io_ver = update_ver;
+      }
       if (!full_replace) {
-        if (update_ver <= cv) return E_STALE_UPDATE;
+        if (update_ver <= cv) {
+          // report committed state for the idempotent-duplicate reply
+          if (it != metas.end()) {
+            if (out_len) *out_len = it->second.committed.length;
+            if (out_crc) *out_crc = it->second.committed.crc;
+            *io_ver = it->second.committed_ver;
+          }
+          return E_STALE_UPDATE;
+        }
         if (pv && pv != update_ver) return E_ADVANCE_UPDATE;
         if (update_ver > cv + 1) return E_MISSING_UPDATE;
       }
@@ -383,27 +452,38 @@ struct Engine {
       m.committed_ver = update_ver;
       m.pending_ver = 0;
       m.chain_ver = chain_ver;
+      if (out_len) *out_len = nb.length;
+      if (out_crc) *out_crc = nb.crc;
       return log_state(k, m);
     }
-    // COW: base = committed content extended to cover the write
+    // COW: base = committed content extended to cover the write. A write
+    // covering the whole resulting content (the common chunk-append /
+    // full-overwrite form) skips the merge buffer entirely.
     uint32_t new_len = std::max(m.committed.length, offset + data_len);
-    std::vector<uint8_t> buf(new_len, 0);
-    if (m.committed.valid() && m.committed.length) {
-      int rc = read_block(m.committed, buf.data(), 0, m.committed.length);
-      if (rc != OK) return rc;
+    const uint8_t* src = data;
+    std::vector<uint8_t> buf;
+    if (!(offset == 0 && data_len == new_len)) {
+      buf.assign(new_len, 0);
+      if (m.committed.valid() && m.committed.length) {
+        int rc = read_block(m.committed, buf.data(), 0, m.committed.length);
+        if (rc != OK) return rc;
+      }
+      memcpy(buf.data() + offset, data, data_len);
+      src = buf.data();
     }
-    memcpy(buf.data() + offset, data, data_len);
     int cls = class_for(std::max<uint32_t>(new_len, 1));
     if (cls < 0) return E_INVALID;
     free_block(m.pending);  // re-staging the same pending ver is idempotent
     BlockRef nb{static_cast<int8_t>(cls),
                 static_cast<uint32_t>(classes[cls].allocate()), new_len,
-                crc32c(buf.data(), new_len)};
-    int rc = write_block(nb, buf.data(), new_len);
+                crc32c(src, new_len)};
+    int rc = write_block(nb, src, new_len);
     if (rc != OK) return rc;
     m.pending = nb;
     m.pending_ver = update_ver;
     m.chain_ver = chain_ver;
+    if (out_len) *out_len = nb.length;
+    if (out_crc) *out_crc = nb.crc;
     return log_state(k, m);
   }
 
@@ -517,7 +597,8 @@ struct Engine {
 
 extern "C" {
 
-// meta output layout for queries (packed, mirrors python struct fmt "<QQQIIq")
+// meta output layout for queries (field order mirrored by the ctypes
+// _CMeta struct in tpu3fs/storage/native_engine.py — keep in sync)
 struct CMeta {
   uint64_t committed_ver;
   uint64_t pending_ver;
@@ -525,8 +606,20 @@ struct CMeta {
   uint32_t length;
   uint32_t crc;
   uint32_t pending_length;
+  uint32_t pending_crc;
   uint8_t key[kKeyLen];
 };
+
+static void fill_cmeta(const Key& k, const ChunkMeta& m, CMeta* out) {
+  out->committed_ver = m.committed_ver;
+  out->pending_ver = m.pending_ver;
+  out->chain_ver = m.chain_ver;
+  out->length = m.committed.length;
+  out->crc = m.committed.crc;
+  out->pending_length = m.pending.valid() ? m.pending.length : 0;
+  out->pending_crc = m.pending.valid() ? m.pending.crc : 0;
+  memcpy(out->key, k.b, kKeyLen);
+}
 
 void* ce_open(const char* dir, int fsync_wal) {
   auto* e = new Engine();
@@ -557,9 +650,11 @@ int ce_update(void* h, const uint8_t* key, uint64_t update_ver,
   std::lock_guard<std::mutex> g(e->mu);
   Key k;
   memcpy(k.b, key, kKeyLen);
-  return e->update(k, update_ver, chain_ver, data, data_len, offset,
-                   full_replace, chunk_size);
+  uint64_t ver = update_ver;
+  return e->update(k, &ver, chain_ver, data, data_len, offset, full_replace,
+                   chunk_size, nullptr, nullptr);
 }
+
 
 int ce_commit(void* h, const uint8_t* key, uint64_t ver, uint64_t chain_ver) {
   auto* e = static_cast<Engine*>(h);
@@ -594,14 +689,7 @@ int ce_get_meta(void* h, const uint8_t* key, CMeta* out) {
   memcpy(k.b, key, kKeyLen);
   auto it = e->metas.find(k);
   if (it == e->metas.end()) return E_NOT_FOUND;
-  const ChunkMeta& m = it->second;
-  out->committed_ver = m.committed_ver;
-  out->pending_ver = m.pending_ver;
-  out->chain_ver = m.chain_ver;
-  out->length = m.committed.length;
-  out->crc = m.committed.crc;
-  out->pending_length = m.pending.valid() ? m.pending.length : 0;
-  memcpy(out->key, k.b, kKeyLen);
+  fill_cmeta(k, it->second, out);
   return OK;
 }
 
@@ -633,14 +721,7 @@ int ce_query(void* h, const uint8_t* prefix, uint32_t prefix_len, CMeta* out,
   for (auto& [k, m] : e->metas) {
     if (prefix_len && memcmp(k.b, prefix, prefix_len) != 0) continue;
     if (n >= max_out) break;
-    CMeta& o = out[n++];
-    o.committed_ver = m.committed_ver;
-    o.pending_ver = m.pending_ver;
-    o.chain_ver = m.chain_ver;
-    o.length = m.committed.length;
-    o.crc = m.committed.crc;
-    o.pending_length = m.pending.valid() ? m.pending.length : 0;
-    memcpy(o.key, k.b, kKeyLen);
+    fill_cmeta(k, m, &out[n++]);
   }
   return n;
 }
@@ -666,6 +747,137 @@ int ce_compact(void* h) {
 uint32_t ce_crc32c(const uint8_t* data, uint64_t n) { return crc32c(data, n); }
 uint32_t ce_crc32c_seed(const uint8_t* data, uint64_t n, uint32_t crc) {
   return crc32c(data, n, crc);
+}
+
+// ---- batched ops -----------------------------------------------------------
+// One ctypes crossing per BATCH: the op loop runs here with the GIL released
+// (ctypes drops it for the duration of the call), which is what lets a
+// multithreaded storage server scale past the Python interpreter — the role
+// the per-disk UpdateWorker queues + 32-thread AIO pools play in the
+// reference (src/storage/update/UpdateWorker.h:11-46, aio/AioReadWorker.h).
+// Field order of these structs is mirrored by ctypes Structures in
+// tpu3fs/storage/native_engine.py — keep in sync.
+
+struct CUpOp {
+  uint8_t key[kKeyLen];
+  uint8_t flags;       // 1 = full_replace
+  uint8_t pad0[3];
+  uint32_t offset;     // write offset within the chunk
+  uint32_t data_len;
+  uint32_t chunk_size;
+  uint32_t pad1;
+  uint64_t data_off;   // offset of this op's payload in the shared blob
+  uint64_t update_ver; // 0 = assign committed+1 (head write)
+};
+
+struct COpResult {
+  int32_t rc;
+  uint32_t len;  // update: pending len; commit/read: committed len
+  uint32_t crc;  // update: pending crc; commit/read: committed/read crc
+  uint32_t pad0;
+  uint64_t ver;  // update: staged (or committed-on-stale) ver; else committed
+};
+
+struct CReadOp {
+  uint8_t key[kKeyLen];
+  uint32_t slot_len;   // this op's slice of the shared output buffer
+  uint64_t out_off;    // where this op's bytes land in the shared output
+  uint32_t offset;     // read offset within the chunk
+  int32_t length;      // -1 = to end of committed content
+};
+
+int ce_batch_update(void* h, uint64_t chain_ver, const uint8_t* blob,
+                    const CUpOp* ops, COpResult* res, int n) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  for (int i = 0; i < n; i++) {
+    const CUpOp& op = ops[i];
+    Key k;
+    memcpy(k.b, op.key, kKeyLen);
+    COpResult& r = res[i];
+    r = COpResult{};
+    uint64_t ver = op.update_ver;
+    uint32_t len = 0, crc = 0;
+    r.rc = e->update(k, &ver, chain_ver, blob + op.data_off, op.data_len,
+                     op.offset, op.flags & 1, op.chunk_size, &len, &crc);
+    r.ver = ver;
+    r.len = len;
+    r.crc = crc;
+  }
+  return OK;
+}
+
+int ce_batch_commit(void* h, uint64_t chain_ver, const uint8_t* keys,
+                    const uint64_t* vers, COpResult* res, int n) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  for (int i = 0; i < n; i++) {
+    Key k;
+    memcpy(k.b, keys + static_cast<size_t>(i) * kKeyLen, kKeyLen);
+    COpResult& r = res[i];
+    r = COpResult{};
+    r.rc = e->commit(k, vers[i], chain_ver);
+    auto it = e->metas.find(k);
+    if (it != e->metas.end()) {
+      r.ver = it->second.committed_ver;
+      r.len = it->second.committed.length;
+      r.crc = it->second.committed.crc;
+    }
+  }
+  return OK;
+}
+
+int ce_batch_read(void* h, const CReadOp* ops, uint8_t* out, uint64_t cap,
+                  COpResult* res, int n) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  for (int i = 0; i < n; i++) {
+    const CReadOp& op = ops[i];
+    Key k;
+    memcpy(k.b, op.key, kKeyLen);
+    COpResult& r = res[i];
+    r = COpResult{};
+    if (op.out_off > cap || op.slot_len > cap - op.out_off) {
+      r.rc = E_INVALID;
+      continue;
+    }
+    int64_t got = 0;
+    // clamp to this op's OWN slot, not the remaining buffer: a chunk whose
+    // committed content outgrew the caller's per-op cap must not spill
+    // into the next op's slot
+    r.rc = e->read(k, out + op.out_off, op.slot_len, op.offset,
+                   op.length, &got);
+    if (r.rc != OK) continue;
+    auto it = e->metas.find(k);
+    const ChunkMeta& m = it->second;
+    r.len = static_cast<uint32_t>(got);
+    r.ver = m.committed_ver;
+    // full-content reads reuse the committed CRC (the checksum-reuse
+    // counters of ChunkReplica.cc:24-29); partial reads recompute here,
+    // still outside the GIL
+    r.crc = (op.offset == 0 && r.len == m.committed.length)
+                ? m.committed.crc
+                : crc32c(out + op.out_off, r.len);
+  }
+  return OK;
+}
+
+// single read returning data + meta + crc in one crossing
+int ce_read2(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
+             uint32_t offset, int64_t length, int64_t* out_len,
+             uint64_t* out_commit_ver, uint32_t* out_crc) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  Key k;
+  memcpy(k.b, key, kKeyLen);
+  int rc = e->read(k, out, cap, offset, length, out_len);
+  if (rc != OK) return rc;
+  const ChunkMeta& m = e->metas.find(k)->second;
+  *out_commit_ver = m.committed_ver;
+  *out_crc = (offset == 0 && *out_len == static_cast<int64_t>(m.committed.length))
+                 ? m.committed.crc
+                 : crc32c(out, static_cast<size_t>(*out_len));
+  return OK;
 }
 
 }  // extern "C"

@@ -14,6 +14,7 @@ A controllable clock drives heartbeat timeouts deterministically.
 from __future__ import annotations
 
 import itertools
+import tempfile
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -47,6 +48,10 @@ class SystemSetupConfig:
     num_replicas: int = 2
     chunk_size: int = 1 << 16
     engine: str = "mem"
+    # base directory for disk-backed engines (None = system tempdir);
+    # benches point this at /dev/shm so the numbers measure the framework,
+    # not the host disk's writeback throttle
+    engine_dir: Optional[str] = None
     heartbeat_timeout_s: float = 60.0
     # EC(k, m) chain tables instead of CR replication: each chain gets
     # k+m targets (on distinct nodes when possible) holding one stripe
@@ -82,6 +87,7 @@ class Fabric:
         self.mgmtd.extend_lease()
         self.nodes: Dict[int, _Node] = {}
         self.chain_ids: List[int] = []
+        self._engine_dirs: List[str] = []
         self._boot_topology()
         self.meta = MetaStore(
             self.kv,
@@ -125,8 +131,14 @@ class Fabric:
                 node_id = node_ids[node_cursor % len(node_ids)]
                 node_cursor += 1
                 self.mgmtd.create_target(tid, node_id=node_id)
+                tpath = None
+                if cfg.engine != "mem" and cfg.engine_dir:
+                    tpath = tempfile.mkdtemp(
+                        prefix=f"t{tid}-", dir=cfg.engine_dir)
+                    self._engine_dirs.append(tpath)
                 target = StorageTarget(
                     tid, chain_id, engine=cfg.engine,
+                    path=tpath,
                     chunk_size=target_chunk_size,
                 )
                 self.nodes[node_id].service.add_target(target)
@@ -139,6 +151,21 @@ class Fabric:
         self.heartbeat_all()
 
     # -- plumbing -----------------------------------------------------------
+    def close(self) -> None:
+        """Release disk-backed engine state (benches create fabrics on
+        tmpfs via engine_dir — without cleanup /dev/shm fills up)."""
+        import shutil
+
+        for node in self.nodes.values():
+            for target in node.service.targets():
+                try:
+                    target.engine.close()
+                except Exception:
+                    pass
+        for d in self._engine_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+        self._engine_dirs.clear()
+
     def routing(self):
         return self.mgmtd.get_routing_info()
 
@@ -160,6 +187,8 @@ class Fabric:
             return svc.batch_read(payload)
         if method == "batch_write":
             return svc.batch_write(payload)
+        if method == "batch_update":
+            return svc.batch_update(payload)
         if method == "batch_write_shard":
             return svc.batch_write_shard(payload)
         if method == "dump_chunkmeta":

@@ -175,6 +175,8 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     s.method(13, "writeShard", ShardWriteReq, UpdateReply, svc.write_shard)
     s.method(14, "batchWriteShard", BatchShardWriteReq, BatchWriteRsp,
              lambda r: BatchWriteRsp(svc.batch_write_shard(r.reqs)))
+    s.method(15, "batchUpdate", BatchWriteReq, BatchWriteRsp,
+             lambda r: BatchWriteRsp(svc.batch_update(r.reqs)))
     server.add_service(s)
 
 
@@ -232,6 +234,8 @@ class RpcMessenger:
             return c.call(
                 addr, sid, 14, BatchShardWriteReq(payload), BatchWriteRsp
             ).replies
+        if method == "batch_update":
+            return c.call(addr, sid, 15, BatchWriteReq(payload), BatchWriteRsp).replies
         raise FsError(Status(Code.RPC_METHOD_NOT_FOUND, method))
 
 

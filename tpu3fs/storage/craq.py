@@ -135,6 +135,17 @@ class ReadReply:
 # messenger: (node_id, "update"|"sync_dump"|..., payload) -> reply
 Messenger = Callable[[int, str, object], object]
 
+# forwarding errors that mean "the chain may have moved under us: refresh
+# the routing snapshot and retry" (ReliableForwarding.h:15-40); shared by
+# the per-op and batched forwarders
+RETRIABLE_FORWARD_CODES = (
+    Code.CHAIN_VERSION_MISMATCH,
+    Code.TARGET_NOT_FOUND,
+    Code.RPC_PEER_CLOSED,
+    Code.RPC_CONNECT_FAILED,
+    Code.TIMEOUT,
+)
+
 
 class _ChannelTable:
     """(client, channel) -> (seqnum, cached reply): exactly-once per chain."""
@@ -336,7 +347,7 @@ class StorageService:
                     update_ver = (meta.committed_ver if meta else 0) + 1
                 # stage pending version (COW)
                 try:
-                    engine.update(
+                    staged = engine.update(
                         req.chunk_id,
                         update_ver,
                         chain_ver,
@@ -360,22 +371,20 @@ class StorageService:
                 if req.full_replace:
                     # recovery write: installed as committed already; still
                     # forward if a successor exists in the writer chain
-                    our_meta = engine.get_meta(req.chunk_id)
                     fwd = self._forward(target, req, update_ver, chain)
                     if fwd is not None and not fwd.ok:
                         return fwd
                     return UpdateReply(
                         Code.OK,
                         update_ver=update_ver,
-                        commit_ver=our_meta.committed_ver,
-                        checksum=our_meta.checksum,
+                        commit_ver=staged.committed_ver,
+                        checksum=staged.checksum,
                     )
-                # checksum of the full pending content for the cross-check
-                pending = self._pending_content(target, req.chunk_id)
-                our_sum = Checksum.of(pending)
-                fwd = self._forward(
-                    target, req, update_ver, chain, pending_content=pending
-                )
+                # checksum of the full pending content for the cross-check:
+                # the engine computed it while staging (native: inside the
+                # C++ COW write) — no chunk content crosses back into Python
+                our_sum = staged.pending_checksum
+                fwd = self._forward(target, req, update_ver, chain)
                 if fwd is not None:
                     if not fwd.ok:
                         return fwd
@@ -402,49 +411,65 @@ class StorageService:
         return target.engine.pending_content(chunk_id)
 
     # -- forwarding (ref ReliableForwarding.h:15-40) --------------------------
+    def _successor_of(self, target: StorageTarget, chain: ChainInfo):
+        """(successor target, its node) in the writer chain, or None when
+        this target is the tail; node is None when unroutable."""
+        writers = chain.writer_chain()
+        my_idx = next(
+            (i for i, t in enumerate(writers)
+             if t.target_id == target.target_id),
+            None,
+        )
+        if my_idx is None or my_idx + 1 >= len(writers):
+            return None
+        succ = writers[my_idx + 1]
+        return succ, self._routing().node_of_target(succ.target_id)
+
+    def _make_forward_req(
+        self,
+        target: StorageTarget,
+        req: WriteReq,
+        update_ver: int,
+        chain: ChainInfo,
+        succ,
+    ) -> WriteReq:
+        freq = replace(
+            req, from_target=target.target_id, update_ver=update_ver,
+            chain_ver=chain.chain_version)
+        if (succ.public_state == PublicTargetState.SYNCING
+                and not freq.full_replace):
+            # syncing successor gets the whole chunk (full-chunk-replace);
+            # materialize the staged content only on this rare path
+            freq = replace(
+                freq,
+                full_replace=True,
+                data=self._pending_content(target, req.chunk_id),
+                offset=0,
+            )
+        return freq
+
     def _forward(
         self,
         target: StorageTarget,
         req: WriteReq,
         update_ver: int,
         chain: ChainInfo,
-        pending_content: bytes = b"",
     ) -> Optional[UpdateReply]:
         """Forward to the successor; None when this target is the tail."""
         for attempt in range(self._max_forward_retries):
-            writers = chain.writer_chain()
-            my_idx = next(
-                (i for i, t in enumerate(writers) if t.target_id == target.target_id),
-                None,
-            )
-            if my_idx is None or my_idx + 1 >= len(writers):
+            hop = self._successor_of(target, chain)
+            if hop is None:
                 return None  # tail
-            succ = writers[my_idx + 1]
-            routing = self._routing()
-            node = routing.node_of_target(succ.target_id)
+            succ, node = hop
             if node is None or self._messenger is None:
                 return UpdateReply(Code.NO_SUCCESSOR, message="no route to successor")
-            freq = replace(req, from_target=target.target_id, update_ver=update_ver)
-            if succ.public_state == PublicTargetState.SYNCING and not req.full_replace:
-                # syncing successor gets the whole chunk (full-chunk-replace)
-                freq = replace(
-                    freq,
-                    full_replace=True,
-                    data=pending_content,
-                    offset=0,
-                )
-            freq = replace(freq, chain_ver=chain.chain_version)
+            freq = self._make_forward_req(target, req, update_ver, chain, succ)
             try:
                 reply = self._messenger(node.node_id, "update", freq)
             except FsError as e:
                 reply = UpdateReply(e.code, message=e.status.message)
-            if isinstance(reply, UpdateReply) and reply.code in (
-                Code.CHAIN_VERSION_MISMATCH,
-                Code.TARGET_NOT_FOUND,
-                Code.RPC_PEER_CLOSED,
-                Code.RPC_CONNECT_FAILED,
-                Code.TIMEOUT,
-            ):
+            if (isinstance(reply, UpdateReply)
+                    and reply.code in RETRIABLE_FORWARD_CODES):
                 # chain may have moved under us: refresh and retry (the
                 # successor may have been offlined, making us the tail)
                 chain = self._chain(req.chain_id)
@@ -529,14 +554,316 @@ class StorageService:
     # -- batched IO (one request carries many ops; ref BatchReadReq
     # StorageOperator.cc:82-231, batchWrite StorageClientImpl.cc:1771) -------
     def batch_read(self, reqs: List[ReadReq]) -> List[ReadReply]:
-        """Many reads in ONE request — the per-op RPC round trip is what the
-        batch eliminates; ops execute against local targets directly."""
-        return [self.read(r) for r in reqs]
+        """Many reads in ONE request. Ops are grouped per local target and
+        executed as ONE engine crossing per group — the loop runs in the
+        native engine with the GIL released (the reference's 32-thread AIO
+        pool analogue, AioReadWorker.h:27-29)."""
+        replies: List[Optional[ReadReply]] = [None] * len(reqs)
+        groups: Dict[int, List[int]] = {}
+        for i, req in enumerate(reqs):
+            try:
+                inject("storage.read")
+                target_id = self._resolve_read_target(req)
+            except FsError as e:
+                self._read_rec.failed.add()
+                replies[i] = ReadReply(e.code)
+                continue
+            groups.setdefault(target_id, []).append(i)
+        for target_id, idxs in groups.items():
+            target = self._targets[target_id]
+            items = [
+                (reqs[i].chunk_id, reqs[i].offset, reqs[i].length)
+                for i in idxs
+            ]
+            outs = target.engine.batch_read(items, target.chunk_size)
+            for i, (code, data, ver, crc) in zip(idxs, outs):
+                if code == Code.OK:
+                    self._read_rec.succeeded.add()
+                    replies[i] = ReadReply(
+                        Code.OK, data=data, commit_ver=ver,
+                        checksum=Checksum(crc, len(data)))
+                else:
+                    self._read_rec.failed.add()
+                    replies[i] = ReadReply(code)
+        return replies
 
     def batch_write(self, reqs: List[WriteReq]) -> List[UpdateReply]:
-        """Many head-writes in one request; each op still runs the full
-        CRAQ update/forward/commit machinery."""
-        return [self.write(r) for r in reqs]
+        """Many head-writes in one request. Same-chain runs execute as ONE
+        chain-batched operation: stage all in one native-engine crossing,
+        ONE batch-update RPC per chain hop, elementwise checksum
+        cross-check, one native batch commit — the server half of the
+        reference's per-node request batching (StorageClientImpl.cc:1030,
+        1303,1771; per-disk serialization as in UpdateWorker.h:11-46)."""
+        replies: List[Optional[UpdateReply]] = [None] * len(reqs)
+        groups: Dict[int, List[int]] = {}
+        for i, r in enumerate(reqs):
+            groups.setdefault(r.chain_id, []).append(i)
+        for chain_id, idxs in groups.items():
+            outs = self._batch_write_chain(chain_id, [reqs[i] for i in idxs])
+            for i, out in zip(idxs, outs):
+                replies[i] = out
+        return replies
+
+    def _batch_write_chain(
+        self, chain_id: int, reqs: List[WriteReq]
+    ) -> List[UpdateReply]:
+        """Head-side batched write for one chain (validation + dedupe gate,
+        then the shared batched hop)."""
+        n = len(reqs)
+        if self.stopped:
+            return [UpdateReply(Code.RPC_PEER_CLOSED, message="node stopped")
+                    for _ in range(n)]
+        try:
+            chain = self._chain(chain_id)
+        except FsError as e:
+            return [UpdateReply(e.code, message=e.status.message)
+                    for _ in range(n)]
+        head = chain.head()
+        if head is None:
+            return [UpdateReply(Code.TARGET_OFFLINE, message="no serving head")
+                    for _ in range(n)]
+        if head.target_id not in self._targets:
+            return [UpdateReply(
+                Code.NOT_HEAD,
+                message=f"head target {head.target_id} not local")
+                for _ in range(n)]
+        target = self._targets[head.target_id]
+        replies: List[Optional[UpdateReply]] = [None] * n
+        todo: List[int] = []
+        seen: set = set()
+        sequential: List[int] = []
+        for i, r in enumerate(reqs):
+            if r.chain_ver != chain.chain_version:
+                replies[i] = UpdateReply(
+                    Code.CHAIN_VERSION_MISMATCH,
+                    message=f"client {r.chain_ver} != {chain.chain_version}")
+                continue
+            cached = self._channels.check(r)
+            if cached is not None:
+                replies[i] = cached
+                continue
+            key = r.chunk_id.to_bytes()
+            if key in seen:
+                # two writes to one chunk in a batch: ordered per-op path
+                sequential.append(i)
+                continue
+            seen.add(key)
+            todo.append(i)
+        if todo:
+            with self._write_rec.record() as op:
+                outs = self._handle_batch_update(
+                    target, [reqs[i] for i in todo])
+                if not all(o.ok for o in outs):
+                    op.fail()
+            for i, out in zip(todo, outs):
+                replies[i] = out
+                if out.ok:
+                    self._channels.store(reqs[i], out)
+        for i in sequential:
+            replies[i] = self._write_impl(reqs[i])
+        return replies
+
+    def batch_update(self, reqs: List[WriteReq]) -> List[UpdateReply]:
+        """Chain-internal batched hop: the predecessor forwards the whole
+        batch in ONE RPC (vs one update() per op)."""
+        n = len(reqs)
+        if self.stopped:
+            return [UpdateReply(Code.RPC_PEER_CLOSED, message="node stopped")
+                    for _ in range(n)]
+        if n == 0:
+            return []
+        # our own _forward_batch always sends a same-chain batch, but the
+        # method is wire-exposed: mixed-chain batches from other senders
+        # must not land on the first op's chain
+        if any(r.chain_id != reqs[0].chain_id for r in reqs):
+            replies: List[Optional[UpdateReply]] = [None] * n
+            groups: Dict[int, List[int]] = {}
+            for i, r in enumerate(reqs):
+                groups.setdefault(r.chain_id, []).append(i)
+            for _, idxs in groups.items():
+                for i, out in zip(idxs, self.batch_update(
+                        [reqs[i] for i in idxs])):
+                    replies[i] = out
+            return replies
+        try:
+            chain = self._chain(reqs[0].chain_id)
+        except FsError as e:
+            return [UpdateReply(e.code, message=e.status.message)
+                    for _ in range(n)]
+        mine, _, _ = self._local_writer(chain)
+        if mine is None:
+            return [UpdateReply(
+                Code.TARGET_NOT_FOUND,
+                message="no local writer target in chain")
+                for _ in range(n)]
+        target = self._targets[mine.target_id]
+        replies: List[Optional[UpdateReply]] = [None] * n
+        todo: List[int] = []
+        seen: set = set()
+        dups: List[int] = []
+        for i, r in enumerate(reqs):
+            key = r.chunk_id.to_bytes()
+            if key in seen:
+                dups.append(i)
+            else:
+                seen.add(key)
+                todo.append(i)
+        outs = self._handle_batch_update(target, [reqs[i] for i in todo])
+        for i, out in zip(todo, outs):
+            replies[i] = out
+        for i in dups:
+            replies[i] = self._handle_update(target, reqs[i])
+        return replies
+
+    def _handle_batch_update(
+        self, target: StorageTarget, reqs: List[WriteReq]
+    ) -> List[UpdateReply]:
+        """The batched _handle_update: same-chain, unique chunks. Stages the
+        whole batch in one engine crossing, forwards it down the chain in
+        one RPC, cross-checks checksums elementwise, commits survivors in
+        one crossing. Locks are taken in sorted chunk order (consistent
+        global order -> no lock-order inversion between batches)."""
+        from tpu3fs.storage.engine import EngineUpdateOp
+
+        n = len(reqs)
+        replies: List[Optional[UpdateReply]] = [None] * n
+        order = sorted(range(n), key=lambda i: reqs[i].chunk_id.to_bytes())
+        locks = [self._chunk_lock(target.target_id, reqs[i].chunk_id)
+                 for i in order]
+        for lk in locks:
+            lk.acquire()
+        try:
+            inject("storage.update")
+            # re-check the chain AFTER taking the chunk locks (ref :377-382)
+            chain = self._chain(reqs[0].chain_id)
+            chain_ver = chain.chain_version
+            engine = target.engine
+            ops: List[EngineUpdateOp] = []
+            op_idx: List[int] = []
+            for i, r in enumerate(reqs):
+                if r.from_target == 0 and r.chain_ver != chain_ver:
+                    replies[i] = UpdateReply(
+                        Code.CHAIN_VERSION_MISMATCH,
+                        message=f"{r.chain_ver} != {chain_ver}")
+                    continue
+                if (target.reject_create and r.from_target == 0
+                        and not r.full_replace
+                        and engine.get_meta(r.chunk_id) is None):
+                    replies[i] = UpdateReply(
+                        Code.NO_SPACE,
+                        message=f"target {target.target_id} rejects creates")
+                    continue
+                ops.append(EngineUpdateOp(
+                    chunk_id=r.chunk_id,
+                    data=r.data,
+                    offset=r.offset,
+                    update_ver=r.update_ver,
+                    full_replace=r.full_replace,
+                    chunk_size=r.chunk_size or target.chunk_size,
+                ))
+                op_idx.append(i)
+            results = engine.batch_update(ops, chain_ver) if ops else []
+            # staged: (req index, staged ver, pending checksum, full_replace)
+            staged: List[Tuple[int, int, Checksum, bool]] = []
+            for i, res in zip(op_idx, results):
+                if res.code == Code.CHUNK_STALE_UPDATE:
+                    # duplicate of an already-committed update: idempotent OK
+                    replies[i] = UpdateReply(
+                        Code.OK,
+                        update_ver=reqs[i].update_ver or res.ver,
+                        commit_ver=res.ver,
+                        checksum=res.checksum)
+                elif not res.ok:
+                    replies[i] = UpdateReply(
+                        res.code, message="batch stage failed")
+                else:
+                    staged.append(
+                        (i, res.ver, res.checksum, reqs[i].full_replace))
+            if staged:
+                fwd = self._forward_batch(target, reqs, staged, chain)
+                commit_items: List[Tuple[ChunkId, int]] = []
+                commit_slots: List[Tuple[int, int, Checksum]] = []
+                for pos, (i, ver, cs, is_fr) in enumerate(staged):
+                    fr = fwd[pos] if fwd is not None else None
+                    if fr is not None and not fr.ok:
+                        replies[i] = fr
+                        continue
+                    if (fr is not None and not is_fr
+                            and fr.checksum.value != cs.value):
+                        replies[i] = UpdateReply(
+                            Code.CHUNK_CHECKSUM_MISMATCH,
+                            message=(f"successor {fr.checksum.value:#x} != "
+                                     f"ours {cs.value:#x}"))
+                        continue
+                    if is_fr:
+                        # full-replace staged as committed already
+                        replies[i] = UpdateReply(
+                            Code.OK, update_ver=ver, commit_ver=ver,
+                            checksum=cs)
+                    else:
+                        commit_items.append((reqs[i].chunk_id, ver))
+                        commit_slots.append((i, ver, cs))
+                if commit_items:
+                    commit_res = engine.batch_commit(commit_items, chain_ver)
+                    for (i, ver, cs), cr in zip(commit_slots, commit_res):
+                        if cr.ok:
+                            replies[i] = UpdateReply(
+                                Code.OK, update_ver=ver, commit_ver=cr.ver,
+                                checksum=cs)
+                        else:
+                            replies[i] = UpdateReply(
+                                cr.code, message="batch commit failed")
+        except FsError as e:
+            for i in range(n):
+                if replies[i] is None:
+                    replies[i] = UpdateReply(e.code, message=e.status.message)
+        finally:
+            for lk in reversed(locks):
+                lk.release()
+        return replies
+
+    def _forward_batch(
+        self,
+        target: StorageTarget,
+        reqs: List[WriteReq],
+        staged: List[Tuple[int, int, Checksum, bool]],
+        chain: ChainInfo,
+    ) -> Optional[List[UpdateReply]]:
+        """Forward the staged batch to the successor in ONE RPC; None when
+        this target is the tail. Retries across chain-version bumps like
+        the per-op _forward (ReliableForwarding.h:15-40)."""
+        for attempt in range(self._max_forward_retries):
+            hop = self._successor_of(target, chain)
+            if hop is None:
+                return None  # tail
+            succ, node = hop
+            if node is None or self._messenger is None:
+                return [UpdateReply(Code.NO_SUCCESSOR,
+                                    message="no route to successor")
+                        for _ in staged]
+            freqs = [
+                self._make_forward_req(target, reqs[i], ver, chain, succ)
+                for i, ver, cs, is_fr in staged
+            ]
+            try:
+                out = self._messenger(node.node_id, "batch_update", freqs)
+            except FsError as e:
+                out = [UpdateReply(e.code, message=e.status.message)
+                       for _ in freqs]
+            if not isinstance(out, list) or len(out) != len(staged):
+                return [UpdateReply(Code.ENGINE_ERROR,
+                                    message="malformed batch reply")
+                        for _ in staged]
+            if (out and all(r.code == out[0].code for r in out)
+                    and out[0].code in RETRIABLE_FORWARD_CODES):
+                # chain may have moved under us: refresh and retry (the
+                # successor may have been offlined, making us the tail)
+                chain = self._chain(reqs[staged[0][0]].chain_id)
+                continue
+            return out
+        return [UpdateReply(Code.CLIENT_RETRIES_EXHAUSTED,
+                            message="forwarding retries exhausted")
+                for _ in staged]
 
     def batch_write_shard(self, reqs: List[ShardWriteReq]) -> List[UpdateReply]:
         """Many EC shard installs in one request (the stripe-batch path)."""
@@ -550,38 +877,46 @@ class StorageService:
                 op.fail()
             return reply
 
-    def _read_impl(self, req: ReadReq) -> ReadReply:
+    def _resolve_read_target(self, req: ReadReq) -> int:
+        """Pick (or validate) the serving target answering this read; raises
+        FsError on the per-op failure modes."""
         if self.stopped:
-            return ReadReply(Code.RPC_PEER_CLOSED)
+            raise _err(Code.RPC_PEER_CLOSED, "node stopped")
+        chain = self._chain(req.chain_id)
+        target_id = req.target_id
+        if target_id == 0:
+            local_serving = [
+                t.target_id
+                for t in chain.targets
+                if t.public_state == PublicTargetState.SERVING
+                and t.target_id in self._targets
+            ]
+            if not local_serving:
+                raise _err(Code.TARGET_NOT_FOUND, str(req.chain_id))
+            target_id = local_serving[0]
+        chain_target = next(
+            (t for t in chain.targets if t.target_id == target_id), None
+        )
+        if chain_target is None or target_id not in self._targets:
+            raise _err(Code.TARGET_NOT_FOUND, str(target_id))
+        if not chain_target.public_state.can_read:
+            raise _err(Code.TARGET_OFFLINE, str(target_id))
+        return target_id
+
+    def _read_impl(self, req: ReadReq) -> ReadReply:
         try:
             inject("storage.read")
-            chain = self._chain(req.chain_id)
-            target_id = req.target_id
-            if target_id == 0:
-                local_serving = [
-                    t.target_id
-                    for t in chain.targets
-                    if t.public_state == PublicTargetState.SERVING
-                    and t.target_id in self._targets
-                ]
-                if not local_serving:
-                    return ReadReply(Code.TARGET_NOT_FOUND)
-                target_id = local_serving[0]
-            chain_target = next(
-                (t for t in chain.targets if t.target_id == target_id), None
-            )
-            if chain_target is None or target_id not in self._targets:
-                return ReadReply(Code.TARGET_NOT_FOUND)
-            if not chain_target.public_state.can_read:
-                return ReadReply(Code.TARGET_OFFLINE)
+            target_id = self._resolve_read_target(req)
             engine = self._targets[target_id].engine
-            data = engine.read(req.chunk_id, req.offset, req.length)
-            meta = engine.get_meta(req.chunk_id)
+            # one engine-lock hold for data+ver+crc (full-content reads
+            # reuse the committed CRC — ChunkReplica.cc:24-29 counters)
+            data, ver, crc = engine.read_verified(
+                req.chunk_id, req.offset, req.length)
             return ReadReply(
                 Code.OK,
                 data=data,
-                commit_ver=meta.committed_ver,
-                checksum=Checksum.of(data),
+                commit_ver=ver,
+                checksum=Checksum(crc, len(data)),
             )
         except FsError as e:
             return ReadReply(e.code)

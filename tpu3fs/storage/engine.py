@@ -19,11 +19,41 @@ from __future__ import annotations
 import abc
 import threading
 from dataclasses import dataclass, replace
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from tpu3fs.storage.types import Checksum, ChunkId, ChunkMeta
-from tpu3fs.utils.result import Code
+from tpu3fs.utils.result import Code, FsError
 from tpu3fs.utils.result import err as _err
+
+
+@dataclass
+class EngineUpdateOp:
+    """One op of a batched stage (the UpdateJob payload of UpdateWorker.h:44)."""
+
+    chunk_id: ChunkId
+    data: bytes
+    offset: int = 0
+    update_ver: int = 0          # 0 = assign committed+1
+    full_replace: bool = False
+    chunk_size: int = 0
+
+
+@dataclass
+class EngineOpResult:
+    """Outcome of one batched op: staged/committed version + block crc/len."""
+
+    code: Code
+    ver: int = 0
+    length: int = 0
+    crc: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.code == Code.OK
+
+    @property
+    def checksum(self) -> Checksum:
+        return Checksum(self.crc, self.length)
 
 
 class ChunkEngine(abc.ABC):
@@ -35,6 +65,14 @@ class ChunkEngine(abc.ABC):
     @abc.abstractmethod
     def read(self, chunk_id: ChunkId, offset: int = 0, length: int = -1) -> bytes:
         """Read committed content. Raises CHUNK_NOT_FOUND / CHUNK_NOT_COMMIT."""
+
+    @abc.abstractmethod
+    def read_verified(
+        self, chunk_id: ChunkId, offset: int = 0, length: int = -1
+    ) -> tuple:
+        """-> (data, commit_ver, crc), mutually consistent: all three are
+        taken under one engine lock hold, so a concurrent commit can never
+        pair one version's bytes with another version's checksum."""
 
     @abc.abstractmethod
     def update(
@@ -78,6 +116,71 @@ class ChunkEngine(abc.ABC):
     def close(self) -> None:  # pragma: no cover - engines may override
         pass
 
+    # -- batched ops (default: per-op loop; NativeChunkEngine overrides with
+    # one C-ABI crossing per batch, running the loop outside the GIL — the
+    # role of the reference's per-disk UpdateWorker queues) -------------------
+    def batch_update(
+        self, ops: List[EngineUpdateOp], chain_ver: int
+    ) -> List[EngineOpResult]:
+        out: List[EngineOpResult] = []
+        for op in ops:
+            try:
+                ver = op.update_ver
+                if ver == 0:
+                    m = self.get_meta(op.chunk_id)
+                    ver = (m.committed_ver if m else 0) + 1
+                meta = self.update(
+                    op.chunk_id, ver, chain_ver, op.data, op.offset,
+                    full_replace=op.full_replace, chunk_size=op.chunk_size,
+                )
+                if op.full_replace:
+                    out.append(EngineOpResult(
+                        Code.OK, ver, meta.length, meta.checksum.value))
+                else:
+                    out.append(EngineOpResult(
+                        Code.OK, ver, meta.pending_length,
+                        meta.pending_checksum.value))
+            except FsError as e:
+                if e.code == Code.CHUNK_STALE_UPDATE:
+                    cur = self.get_meta(op.chunk_id)
+                    out.append(EngineOpResult(
+                        Code.CHUNK_STALE_UPDATE,
+                        cur.committed_ver if cur else 0,
+                        cur.length if cur else 0,
+                        cur.checksum.value if cur else 0,
+                    ))
+                else:
+                    out.append(EngineOpResult(e.code))
+        return out
+
+    def batch_commit(
+        self, items: List[Tuple[ChunkId, int]], chain_ver: int
+    ) -> List[EngineOpResult]:
+        out: List[EngineOpResult] = []
+        for chunk_id, ver in items:
+            try:
+                meta = self.commit(chunk_id, ver, chain_ver)
+                out.append(EngineOpResult(
+                    Code.OK, meta.committed_ver, meta.length,
+                    meta.checksum.value))
+            except FsError as e:
+                out.append(EngineOpResult(e.code))
+        return out
+
+    def batch_read(
+        self, items: List[Tuple[ChunkId, int, int]], cap: int
+    ) -> List[Tuple[Code, bytes, int, int]]:
+        """items: (chunk_id, offset, length); cap: per-op buffer bound
+        (the target chunk size). -> (code, data, commit_ver, crc)."""
+        out: List[Tuple[Code, bytes, int, int]] = []
+        for chunk_id, offset, length in items:
+            try:
+                data, ver, crc = self.read_verified(chunk_id, offset, length)
+                out.append((Code.OK, data, ver, crc))
+            except FsError as e:
+                out.append((e.code, b"", 0, 0))
+        return out
+
 
 @dataclass
 class _Slot:
@@ -116,6 +219,18 @@ class MemChunkEngine(ChunkEngine):
             if length < 0:
                 return data[offset:]
             return data[offset : offset + length]
+
+    def read_verified(
+        self, chunk_id: ChunkId, offset: int = 0, length: int = -1
+    ) -> tuple:
+        with self._lock:
+            data = self.read(chunk_id, offset, length)
+            meta = self._slot(chunk_id).meta
+            if offset == 0 and len(data) == meta.length:
+                crc = meta.checksum.value       # checksum reuse
+            else:
+                crc = Checksum.of(data).value
+            return data, meta.committed_ver, crc
 
     # -- updates (COW + version algebra) -------------------------------------
     def update(
@@ -170,16 +285,25 @@ class MemChunkEngine(ChunkEngine):
                 meta.chain_ver = chain_ver
                 meta.length = len(data)
                 meta.checksum = Checksum.of(slot.committed)
+                meta.pending_length = 0
+                meta.pending_checksum = Checksum()
                 return replace(meta)
             # COW: base is committed content (re-applying the same pending
             # update is idempotent)
-            base = bytearray(slot.committed)
-            if offset + len(data) > len(base):
-                base.extend(b"\x00" * (offset + len(data) - len(base)))
-            base[offset : offset + len(data)] = data
-            slot.pending = bytes(base)
+            if offset == 0 and len(data) >= len(slot.committed):
+                # whole-content write (the common chunk-append/overwrite
+                # form): one copy, no bytearray round trip
+                slot.pending = bytes(data)
+            else:
+                base = bytearray(slot.committed)
+                if offset + len(data) > len(base):
+                    base.extend(b"\x00" * (offset + len(data) - len(base)))
+                base[offset : offset + len(data)] = data
+                slot.pending = bytes(base)
             meta.pending_ver = update_ver
             meta.chain_ver = chain_ver
+            meta.pending_length = len(slot.pending)
+            meta.pending_checksum = Checksum.of(slot.pending)
             return replace(meta)
 
     def commit(self, chunk_id: ChunkId, ver: int, chain_ver: int) -> ChunkMeta:
@@ -202,7 +326,10 @@ class MemChunkEngine(ChunkEngine):
             meta.pending_ver = 0
             meta.chain_ver = chain_ver
             meta.length = len(slot.committed)
-            meta.checksum = Checksum.of(slot.committed)
+            # the pending checksum covers exactly the content being promoted
+            meta.checksum = meta.pending_checksum
+            meta.pending_length = 0
+            meta.pending_checksum = Checksum()
             return replace(meta)
 
     # -- maintenance ---------------------------------------------------------
@@ -223,6 +350,8 @@ class MemChunkEngine(ChunkEngine):
             meta.pending_ver = 0
             slot.pending = None
             meta.checksum = Checksum.of(slot.committed)
+            meta.pending_length = 0
+            meta.pending_checksum = Checksum()
             return replace(meta)
 
     def query(self, prefix: bytes) -> List[ChunkMeta]:

@@ -46,7 +46,42 @@ class _CMeta(ctypes.Structure):
         ("length", ctypes.c_uint32),
         ("crc", ctypes.c_uint32),
         ("pending_length", ctypes.c_uint32),
+        ("pending_crc", ctypes.c_uint32),
         ("key", ctypes.c_uint8 * _KEYLEN),
+    ]
+
+
+class _CUpOp(ctypes.Structure):
+    _fields_ = [
+        ("key", ctypes.c_uint8 * _KEYLEN),
+        ("flags", ctypes.c_uint8),
+        ("pad0", ctypes.c_uint8 * 3),
+        ("offset", ctypes.c_uint32),
+        ("data_len", ctypes.c_uint32),
+        ("chunk_size", ctypes.c_uint32),
+        ("pad1", ctypes.c_uint32),
+        ("data_off", ctypes.c_uint64),
+        ("update_ver", ctypes.c_uint64),
+    ]
+
+
+class _COpResult(ctypes.Structure):
+    _fields_ = [
+        ("rc", ctypes.c_int32),
+        ("len", ctypes.c_uint32),
+        ("crc", ctypes.c_uint32),
+        ("pad0", ctypes.c_uint32),
+        ("ver", ctypes.c_uint64),
+    ]
+
+
+class _CReadOp(ctypes.Structure):
+    _fields_ = [
+        ("key", ctypes.c_uint8 * _KEYLEN),
+        ("slot_len", ctypes.c_uint32),
+        ("out_off", ctypes.c_uint64),
+        ("offset", ctypes.c_uint32),
+        ("length", ctypes.c_int32),
     ]
 
 
@@ -112,6 +147,29 @@ def _load_lib():
         lib.ce_compact.argtypes = [ctypes.c_void_p]
         lib.ce_crc32c.restype = ctypes.c_uint32
         lib.ce_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.ce_batch_update.restype = ctypes.c_int
+        lib.ce_batch_update.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.POINTER(_CUpOp), ctypes.POINTER(_COpResult), ctypes.c_int,
+        ]
+        lib.ce_batch_commit.restype = ctypes.c_int
+        lib.ce_batch_commit.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(_COpResult),
+            ctypes.c_int,
+        ]
+        lib.ce_batch_read.restype = ctypes.c_int
+        lib.ce_batch_read.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(_CReadOp), ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.POINTER(_COpResult), ctypes.c_int,
+        ]
+        lib.ce_read2.restype = ctypes.c_int
+        lib.ce_read2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
         _lib = lib
         return lib
 
@@ -131,6 +189,8 @@ def _meta_from_c(m: _CMeta) -> ChunkMeta:
         pending_ver=m.pending_ver,
         length=m.length,
         checksum=Checksum(m.crc, m.length),
+        pending_length=m.pending_length,
+        pending_checksum=Checksum(m.pending_crc, m.pending_length),
     )
 
 
@@ -141,6 +201,7 @@ class NativeChunkEngine(ChunkEngine):
         self._h = self._lib.ce_open(self._path.encode(), int(fsync_wal))
         if not self._h:
             raise _err(Code.ENGINE_ERROR, f"ce_open failed for {self._path}")
+        self._scratch_local = threading.local()
 
     @property
     def path(self) -> str:
@@ -162,14 +223,36 @@ class NativeChunkEngine(ChunkEngine):
         # capacity under its mutex, so a concurrent commit that grows the
         # chunk can shorten the read but never overrun the buffer
         cap = meta.length if length < 0 else min(length, 1 << 27)
-        buf = ctypes.create_string_buffer(max(cap, 1))
+        buf = self._scratch(max(cap, 1))
         out_len = ctypes.c_int64()
         rc = self._lib.ce_read(
-            self._h, chunk_id.to_bytes(), buf, len(buf.raw), offset, length,
+            self._h, chunk_id.to_bytes(), buf, len(buf), offset, length,
             ctypes.byref(out_len),
         )
         _check(rc, "read")
-        return buf.raw[: out_len.value]
+        return ctypes.string_at(ctypes.addressof(buf), out_len.value)
+
+    def read_verified(
+        self, chunk_id: ChunkId, offset: int = 0, length: int = -1
+    ) -> tuple:
+        meta = self.get_meta(chunk_id)
+        if meta is None:
+            raise _err(Code.CHUNK_NOT_FOUND, str(chunk_id))
+        cap = meta.length if length < 0 else min(length, 1 << 27)
+        buf = self._scratch(max(cap, 1))
+        out_len = ctypes.c_int64()
+        out_ver = ctypes.c_uint64()
+        out_crc = ctypes.c_uint32()
+        # data + commit_ver + crc read under ONE engine mutex hold: the
+        # reply can never pair one version's bytes with another's checksum
+        rc = self._lib.ce_read2(
+            self._h, chunk_id.to_bytes(), buf, max(cap, 1), offset, length,
+            ctypes.byref(out_len), ctypes.byref(out_ver),
+            ctypes.byref(out_crc),
+        )
+        _check(rc, "read_verified")
+        data = ctypes.string_at(ctypes.addressof(buf), out_len.value)
+        return data, out_ver.value, out_crc.value
 
     def pending_content(self, chunk_id: ChunkId) -> bytes:
         out = _CMeta()
@@ -178,13 +261,13 @@ class NativeChunkEngine(ChunkEngine):
             return b""
         _check(rc, "get_meta")
         cap = max(out.pending_length, out.length, 1)
-        buf = ctypes.create_string_buffer(cap)
+        buf = self._scratch(cap)
         out_len = ctypes.c_int64()
         rc = self._lib.ce_read_pending(
-            self._h, chunk_id.to_bytes(), buf, len(buf.raw), ctypes.byref(out_len)
+            self._h, chunk_id.to_bytes(), buf, len(buf), ctypes.byref(out_len)
         )
         _check(rc, "read_pending")
-        return buf.raw[: out_len.value]
+        return ctypes.string_at(ctypes.addressof(buf), out_len.value)
 
     def update(
         self,
@@ -238,6 +321,100 @@ class NativeChunkEngine(ChunkEngine):
 
     def compact(self) -> None:
         _check(int(self._lib.ce_compact(self._h)), "compact")
+
+    # -- batched ops: ONE ctypes crossing per batch; the loop runs in C++
+    # with the GIL released (ctypes drops it for the call duration) ----------
+    def batch_update(self, ops, chain_ver: int):
+        from tpu3fs.storage.engine import EngineOpResult
+
+        n = len(ops)
+        if n == 0:
+            return []
+        c_ops = (_CUpOp * n)()
+        parts = []
+        blob_off = 0
+        for i, op in enumerate(ops):
+            c = c_ops[i]
+            ctypes.memmove(c.key, op.chunk_id.to_bytes(), _KEYLEN)
+            c.flags = 1 if op.full_replace else 0
+            c.offset = op.offset
+            c.data_len = len(op.data)
+            c.chunk_size = op.chunk_size
+            c.data_off = blob_off
+            c.update_ver = op.update_ver
+            parts.append(op.data)
+            blob_off += len(op.data)
+        blob = b"".join(parts)
+        res = (_COpResult * n)()
+        _check(self._lib.ce_batch_update(
+            self._h, chain_ver, blob, c_ops, res, n), "batch_update")
+        out = []
+        for i in range(n):
+            r = res[i]
+            code = Code.OK if r.rc == 0 else _ERR_TO_CODE.get(
+                r.rc, Code.ENGINE_ERROR)
+            out.append(EngineOpResult(code, r.ver, r.len, r.crc))
+        return out
+
+    def batch_commit(self, items, chain_ver: int):
+        from tpu3fs.storage.engine import EngineOpResult
+
+        n = len(items)
+        if n == 0:
+            return []
+        keys = b"".join(cid.to_bytes() for cid, _ in items)
+        vers = (ctypes.c_uint64 * n)(*[v for _, v in items])
+        res = (_COpResult * n)()
+        _check(self._lib.ce_batch_commit(
+            self._h, chain_ver, keys, vers, res, n), "batch_commit")
+        out = []
+        for i in range(n):
+            r = res[i]
+            code = Code.OK if r.rc == 0 else _ERR_TO_CODE.get(
+                r.rc, Code.ENGINE_ERROR)
+            out.append(EngineOpResult(code, r.ver, r.len, r.crc))
+        return out
+
+    def _scratch(self, size: int) -> ctypes.Array:
+        """Grow-only per-thread scratch for batch reads: avoids the per-call
+        zeroing/page-fault cost of a fresh buffer (the BufferPool role,
+        ref src/storage/service/BufferPool.cc)."""
+        loc = self._scratch_local
+        buf = getattr(loc, "buf", None)
+        if buf is None or len(buf) < size:
+            buf = ctypes.create_string_buffer(max(size, 1 << 20))
+            loc.buf = buf
+        return buf
+
+    def batch_read(self, items, cap: int):
+        n = len(items)
+        if n == 0:
+            return []
+        c_ops = (_CReadOp * n)()
+        total = 0
+        for i, (chunk_id, offset, length) in enumerate(items):
+            c = c_ops[i]
+            ctypes.memmove(c.key, chunk_id.to_bytes(), _KEYLEN)
+            c.out_off = total
+            c.offset = offset
+            c.length = length
+            c.slot_len = cap if length < 0 else min(length, cap)
+            total += c.slot_len
+        buf = self._scratch(total)
+        res = (_COpResult * n)()
+        _check(self._lib.ce_batch_read(
+            self._h, c_ops, buf, len(buf), res, n), "batch_read")
+        base = ctypes.addressof(buf)
+        out = []
+        for i in range(n):
+            r = res[i]
+            if r.rc != 0:
+                out.append((_ERR_TO_CODE.get(r.rc, Code.ENGINE_ERROR),
+                            b"", 0, 0))
+                continue
+            data = ctypes.string_at(base + c_ops[i].out_off, r.len)
+            out.append((Code.OK, data, r.ver, r.crc))
+        return out
 
     def close(self) -> None:
         if self._h:

@@ -79,6 +79,11 @@ class ChunkMeta:
     pending_ver: int = 0          # 0 = no pending update
     length: int = 0               # committed content length
     checksum: Checksum = field(default_factory=Checksum)
+    # staged pending block (valid while pending_ver != 0): lets the chain
+    # checksum cross-check run without materializing chunk content back
+    # into Python (ref StorageOperator.cc:464-482)
+    pending_length: int = 0
+    pending_checksum: Checksum = field(default_factory=Checksum)
 
 
 @dataclass
