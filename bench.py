@@ -112,24 +112,33 @@ def _bench_worker(platform: str) -> None:
         fab = Fabric(SystemSetupConfig(
             num_storage_nodes=4, num_chains=2, chunk_size=ec_chunk,
             ec_k=3, ec_m=1))
-        cl = fab.storage_client()
+        from tpu3fs.meta.store import OpenFlags
+
         stripes = 32
         blobs = [bytes([i & 0xFF]) * ec_chunk for i in range(4)]
+        # the FILE write path (what FUSE/USRBIO ride): FileIoClient batches
+        # full stripes into write_stripes — one device encode for the whole
+        # span + one BatchShardWrite per node (round-2 weak #3 fix)
+        fio = fab.file_client()
+        res = fab.meta.create("/ecbench", flags=OpenFlags.WRITE,
+                              client_id="bench")
+        payload = b"".join(blobs[i % 4] for i in range(stripes))
         t0 = time.perf_counter()
-        for i in range(stripes):
-            r = cl.write_stripe(
-                fab.chain_ids[i % 2], ChunkId(5, i), blobs[i % 4],
-                chunk_size=ec_chunk)
-            assert r.ok, r
+        fio.write(res.inode, 0, payload)
         extras["e2e_ec_write_gibps"] = round(
             _gibps(stripes * ec_chunk, 1, time.perf_counter() - t0), 3)
+        # overwrite the same span: the batch path must survive existing
+        # stripe versions (probed, not collapsed to the per-stripe ladder)
         t0 = time.perf_counter()
-        for i in range(stripes):
-            r = cl.read_stripe(fab.chain_ids[i % 2], ChunkId(5, i), 0,
-                               ec_chunk, chunk_size=ec_chunk)
-            assert r.ok
-        extras["e2e_ec_read_gibps"] = round(
+        fio.write(res.inode, 0, payload)
+        extras["e2e_ec_overwrite_gibps"] = round(
             _gibps(stripes * ec_chunk, 1, time.perf_counter() - t0), 3)
+        t0 = time.perf_counter()
+        back = fio.read(res.inode, 0, stripes * ec_chunk)
+        dt = time.perf_counter() - t0
+        assert back == payload, "EC file read-back mismatch"
+        extras["e2e_ec_read_gibps"] = round(
+            _gibps(stripes * ec_chunk, 1, dt), 3)
     except Exception as e:
         extras["e2e_ec_error"] = repr(e)[:200]
 

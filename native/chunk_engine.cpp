@@ -46,6 +46,8 @@ enum ErrCode : int {
   E_IO = -6,
   E_INVALID = -7,
   E_NO_SPACE = -8,
+  E_CHECKSUM = -9,
+  E_RANGE = -10,  // batch-read op does not fit its output slot
 };
 
 constexpr int kMinClassShift = 12;           // 4 KiB
@@ -156,18 +158,43 @@ struct ChunkMeta {
   uint64_t chain_ver = 0;
   BlockRef committed;
   BlockRef pending;
+  // opaque per-chunk tag, promoted with the content at commit; the EC
+  // stripe path stores the stripe's logical (pre-padding) byte length so
+  // rebuilds and queryLastChunk never have to infer it from zero-trimming
+  uint32_t aux = 0;
+  uint32_t aux_pending = 0;
 };
 
 // ---- WAL record -----------------------------------------------------------
 // Fixed-size state record: last-wins per key on replay; remove = tombstone.
+
+// v1 layout (pre-aux builds): readable forever so upgrades never lose
+// acknowledged writes; replay migrates v1 logs to v2 via compact()
+struct WalRecordV1 {
+  static constexpr uint32_t kMagic = 0x33465354;  // "3FST"
+  uint32_t magic = kMagic;
+  uint8_t op = 0;
+  uint8_t key[kKeyLen] = {0};
+  uint64_t committed_ver = 0, pending_ver = 0, chain_ver = 0;
+  int8_t c_cls = -1, p_cls = -1;
+  uint32_t c_idx = 0, c_len = 0, c_crc = 0;
+  uint32_t p_idx = 0, p_len = 0, p_crc = 0;
+  uint32_t rec_crc = 0;
+
+  bool check() const;
+  uint32_t aux_of() const { return 0; }
+  uint32_t aux_pending_of() const { return 0; }
+};
+
 struct WalRecord {
-  uint32_t magic = 0x33465354;  // "3FST"
+  uint32_t magic = 0x33465355;  // "3FSU" (v2: aux fields)
   uint8_t op = 0;               // 1 = state, 2 = remove
   uint8_t key[kKeyLen] = {0};
   uint64_t committed_ver = 0, pending_ver = 0, chain_ver = 0;
   int8_t c_cls = -1, p_cls = -1;
   uint32_t c_idx = 0, c_len = 0, c_crc = 0;
   uint32_t p_idx = 0, p_len = 0, p_crc = 0;
+  uint32_t aux = 0, aux_pending = 0;
   uint32_t rec_crc = 0;         // crc of the record up to this field
 
   void seal() {
@@ -175,11 +202,19 @@ struct WalRecord {
                      offsetof(WalRecord, rec_crc));
   }
   bool check() const {
-    return magic == 0x33465354 &&
+    return magic == 0x33465355 &&
            rec_crc == crc32c(reinterpret_cast<const uint8_t*>(this),
                              offsetof(WalRecord, rec_crc));
   }
+  uint32_t aux_of() const { return aux; }
+  uint32_t aux_pending_of() const { return aux_pending; }
 };
+
+inline bool WalRecordV1::check() const {
+  return magic == kMagic &&
+         rec_crc == crc32c(reinterpret_cast<const uint8_t*>(this),
+                           offsetof(WalRecordV1, rec_crc));
+}
 
 // ---- per-class allocator + data file --------------------------------------
 struct SizeClass {
@@ -263,12 +298,14 @@ struct Engine {
     return wal_fd < 0 ? E_IO : OK;
   }
 
-  int replay() {
-    FILE* f = fopen(wal_path().c_str(), "rb");
-    if (!f) return OK;
-    WalRecord rec;
+  template <typename Rec>
+  size_t replay_records(FILE* f) {
+    // -> byte offset of the end of the last VALID record
+    Rec rec;
+    size_t valid = 0;
     while (fread(&rec, sizeof(rec), 1, f) == 1) {
       if (!rec.check()) break;  // torn tail: stop replay
+      valid += sizeof(rec);
       wal_records++;
       Key k;
       memcpy(k.b, rec.key, kKeyLen);
@@ -282,13 +319,39 @@ struct Engine {
       m.chain_ver = rec.chain_ver;
       m.committed = {rec.c_cls, rec.c_idx, rec.c_len, rec.c_crc};
       m.pending = {rec.p_cls, rec.p_idx, rec.p_len, rec.p_crc};
+      m.aux = rec.aux_of();
+      m.aux_pending = rec.aux_pending_of();
       metas[k] = m;
     }
+    return valid;
+  }
+
+  int replay() {
+    FILE* f = fopen(wal_path().c_str(), "rb");
+    if (!f) return OK;
+    // peek the first record's magic: a v1-format log (pre-aux build) is
+    // replayed with the v1 layout, then compacted to v2 below — acked
+    // writes from an older build must never be silently dropped
+    uint32_t first_magic = 0;
+    bool legacy = false;
+    if (fread(&first_magic, sizeof(first_magic), 1, f) == 1)
+      legacy = (first_magic == WalRecordV1::kMagic);
+    rewind(f);
+    size_t valid = legacy ? replay_records<WalRecordV1>(f)
+                          : replay_records<WalRecord>(f);
     fclose(f);
     // rebuild allocator occupancy from live references
     for (auto& [k, m] : metas) {
       if (m.committed.valid()) classes[m.committed.cls].mark(m.committed.idx);
       if (m.pending.valid()) classes[m.pending.cls].mark(m.pending.idx);
+    }
+    if (legacy) return compact();  // rewrite as v2 before any append
+    // drop any torn/garbage suffix NOW: O_APPEND writes after an unreadable
+    // record would otherwise be invisible to every future replay
+    struct stat st;
+    if (stat(wal_path().c_str(), &st) == 0 &&
+        static_cast<size_t>(st.st_size) != valid) {
+      if (::truncate(wal_path().c_str(), valid) != 0) return E_IO;
     }
     return OK;
   }
@@ -308,6 +371,8 @@ struct Engine {
     rec.p_idx = m.pending.idx;
     rec.p_len = m.pending.length;
     rec.p_crc = m.pending.crc;
+    rec.aux = m.aux;
+    rec.aux_pending = m.aux_pending;
     rec.seal();
     if (write(wal_fd, &rec, sizeof(rec)) != sizeof(rec)) return E_IO;
     if (fsync_wal) fsync(wal_fd);
@@ -348,6 +413,8 @@ struct Engine {
       rec.p_idx = m.pending.idx;
       rec.p_len = m.pending.length;
       rec.p_crc = m.pending.crc;
+      rec.aux = m.aux;
+      rec.aux_pending = m.aux_pending;
       rec.seal();
       if (write(fd, &rec, sizeof(rec)) != sizeof(rec)) {
         close(fd);
@@ -405,10 +472,14 @@ struct Engine {
   // report the staged pending block so callers never have to materialize
   // the chunk content to checksum it (the per-hop copy the Python path
   // used to pay; ref StorageOperator.cc:464-482 cross-check).
+  // check_crc: refuse the install (no mutation) unless the engine-computed
+  // content CRC equals expected_crc — the one-pass validated-install the EC
+  // shard path uses (the CRC is computed during staging anyway)
   int update(const Key& k, uint64_t* io_ver, uint64_t chain_ver,
              const uint8_t* data, uint32_t data_len, uint32_t offset,
-             int full_replace, uint32_t chunk_size, uint32_t* out_len,
-             uint32_t* out_crc) {
+             int full_replace, uint32_t chunk_size, uint32_t aux,
+             uint32_t* out_len, uint32_t* out_crc, int check_crc = 0,
+             uint32_t expected_crc = 0) {
     // overflow-safe bound: offset + data_len can wrap uint32
     if (offset > chunk_size || data_len > chunk_size - offset)
       return E_INVALID;
@@ -437,21 +508,30 @@ struct Engine {
         if (update_ver > cv + 1) return E_MISSING_UPDATE;
       }
     }
-    ChunkMeta& m = metas[k];
     if (full_replace) {
       int cls = class_for(std::max<uint32_t>(data_len, 1));
       if (cls < 0) return E_INVALID;
+      uint32_t crc = crc32c(data, data_len);
+      // refuse BEFORE metas[k] inserts: a failed validated install must
+      // leave no phantom committed_ver=0 meta behind
+      if (check_crc && crc != expected_crc) return E_CHECKSUM;
+      ChunkMeta& m = metas[k];
       BlockRef nb{static_cast<int8_t>(cls),
                   static_cast<uint32_t>(classes[cls].allocate()), data_len,
-                  crc32c(data, data_len)};
+                  crc};
       int rc = write_block(nb, data, data_len);
-      if (rc != OK) return rc;
+      if (rc != OK) {
+        classes[cls].release(nb.idx);
+        return rc;
+      }
       free_block(m.committed);
       free_block(m.pending);
       m.committed = nb;
       m.committed_ver = update_ver;
       m.pending_ver = 0;
       m.chain_ver = chain_ver;
+      m.aux = aux;
+      m.aux_pending = 0;
       if (out_len) *out_len = nb.length;
       if (out_crc) *out_crc = nb.crc;
       return log_state(k, m);
@@ -459,6 +539,7 @@ struct Engine {
     // COW: base = committed content extended to cover the write. A write
     // covering the whole resulting content (the common chunk-append /
     // full-overwrite form) skips the merge buffer entirely.
+    ChunkMeta& m = metas[k];
     uint32_t new_len = std::max(m.committed.length, offset + data_len);
     const uint8_t* src = data;
     std::vector<uint8_t> buf;
@@ -473,15 +554,25 @@ struct Engine {
     }
     int cls = class_for(std::max<uint32_t>(new_len, 1));
     if (cls < 0) return E_INVALID;
+    uint32_t crc = crc32c(src, new_len);
+    if (check_crc && crc != expected_crc) {
+      // drop the meta if this lookup created it (no phantom on refusal)
+      if (!m.committed.valid() && !m.pending.valid() && m.committed_ver == 0)
+        metas.erase(k);
+      return E_CHECKSUM;
+    }
     free_block(m.pending);  // re-staging the same pending ver is idempotent
     BlockRef nb{static_cast<int8_t>(cls),
-                static_cast<uint32_t>(classes[cls].allocate()), new_len,
-                crc32c(src, new_len)};
+                static_cast<uint32_t>(classes[cls].allocate()), new_len, crc};
     int rc = write_block(nb, src, new_len);
-    if (rc != OK) return rc;
+    if (rc != OK) {
+      classes[cls].release(nb.idx);
+      return rc;
+    }
     m.pending = nb;
     m.pending_ver = update_ver;
     m.chain_ver = chain_ver;
+    m.aux_pending = aux;
     if (out_len) *out_len = nb.length;
     if (out_crc) *out_crc = nb.crc;
     return log_state(k, m);
@@ -499,6 +590,8 @@ struct Engine {
     m.committed_ver = ver;
     m.pending_ver = 0;
     m.chain_ver = chain_ver;
+    m.aux = m.aux_pending;
+    m.aux_pending = 0;
     int rc = log_state(k, m);
     maybe_compact();
     return rc;
@@ -581,6 +674,8 @@ struct Engine {
     m.committed_ver += 1;
     m.pending_ver = 0;
     m.chain_ver = chain_ver;
+    m.aux = 0;
+    m.aux_pending = 0;
     return log_state(k, m);
   }
 
@@ -607,6 +702,7 @@ struct CMeta {
   uint32_t crc;
   uint32_t pending_length;
   uint32_t pending_crc;
+  uint32_t aux;
   uint8_t key[kKeyLen];
 };
 
@@ -618,6 +714,7 @@ static void fill_cmeta(const Key& k, const ChunkMeta& m, CMeta* out) {
   out->crc = m.committed.crc;
   out->pending_length = m.pending.valid() ? m.pending.length : 0;
   out->pending_crc = m.pending.valid() ? m.pending.crc : 0;
+  out->aux = m.aux;
   memcpy(out->key, k.b, kKeyLen);
 }
 
@@ -645,14 +742,16 @@ void ce_close(void* h) {
 
 int ce_update(void* h, const uint8_t* key, uint64_t update_ver,
               uint64_t chain_ver, const uint8_t* data, uint32_t data_len,
-              uint32_t offset, int full_replace, uint32_t chunk_size) {
+              uint32_t offset, int full_replace, uint32_t chunk_size,
+              uint32_t aux, int check_crc, uint32_t expected_crc) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
   Key k;
   memcpy(k.b, key, kKeyLen);
   uint64_t ver = update_ver;
   return e->update(k, &ver, chain_ver, data, data_len, offset, full_replace,
-                   chunk_size, nullptr, nullptr);
+                   chunk_size, aux, nullptr, nullptr, check_crc,
+                   expected_crc);
 }
 
 
@@ -765,7 +864,7 @@ struct CUpOp {
   uint32_t offset;     // write offset within the chunk
   uint32_t data_len;
   uint32_t chunk_size;
-  uint32_t pad1;
+  uint32_t aux;        // opaque tag stored with the staged content
   uint64_t data_off;   // offset of this op's payload in the shared blob
   uint64_t update_ver; // 0 = assign committed+1 (head write)
 };
@@ -774,7 +873,7 @@ struct COpResult {
   int32_t rc;
   uint32_t len;  // update: pending len; commit/read: committed len
   uint32_t crc;  // update: pending crc; commit/read: committed/read crc
-  uint32_t pad0;
+  uint32_t aux;  // read: the chunk's aux tag (EC stripe logical length)
   uint64_t ver;  // update: staged (or committed-on-stale) ver; else committed
 };
 
@@ -799,7 +898,8 @@ int ce_batch_update(void* h, uint64_t chain_ver, const uint8_t* blob,
     uint64_t ver = op.update_ver;
     uint32_t len = 0, crc = 0;
     r.rc = e->update(k, &ver, chain_ver, blob + op.data_off, op.data_len,
-                     op.offset, op.flags & 1, op.chunk_size, &len, &crc);
+                     op.offset, op.flags & 1, op.chunk_size, op.aux, &len,
+                     &crc);
     r.ver = ver;
     r.len = len;
     r.crc = crc;
@@ -841,10 +941,27 @@ int ce_batch_read(void* h, const CReadOp* ops, uint8_t* out, uint64_t cap,
       r.rc = E_INVALID;
       continue;
     }
+    // a chunk whose committed content outgrew the caller's per-op cap must
+    // neither spill into the next op's slot NOR return silently truncated
+    // bytes with a recomputed CRC — report E_RANGE so the caller re-reads
+    // that op with a big-enough buffer
+    {
+      auto pre = e->metas.find(k);
+      if (pre != e->metas.end()) {
+        uint32_t avail = pre->second.committed.length;
+        uint32_t want = op.offset >= avail ? 0
+                        : (op.length < 0
+                               ? avail - op.offset
+                               : std::min<uint32_t>(
+                                     static_cast<uint32_t>(op.length),
+                                     avail - op.offset));
+        if (want > op.slot_len) {
+          r.rc = E_RANGE;
+          continue;
+        }
+      }
+    }
     int64_t got = 0;
-    // clamp to this op's OWN slot, not the remaining buffer: a chunk whose
-    // committed content outgrew the caller's per-op cap must not spill
-    // into the next op's slot
     r.rc = e->read(k, out + op.out_off, op.slot_len, op.offset,
                    op.length, &got);
     if (r.rc != OK) continue;
@@ -852,6 +969,7 @@ int ce_batch_read(void* h, const CReadOp* ops, uint8_t* out, uint64_t cap,
     const ChunkMeta& m = it->second;
     r.len = static_cast<uint32_t>(got);
     r.ver = m.committed_ver;
+    r.aux = m.aux;
     // full-content reads reuse the committed CRC (the checksum-reuse
     // counters of ChunkReplica.cc:24-29); partial reads recompute here,
     // still outside the GIL
@@ -865,7 +983,8 @@ int ce_batch_read(void* h, const CReadOp* ops, uint8_t* out, uint64_t cap,
 // single read returning data + meta + crc in one crossing
 int ce_read2(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
              uint32_t offset, int64_t length, int64_t* out_len,
-             uint64_t* out_commit_ver, uint32_t* out_crc) {
+             uint64_t* out_commit_ver, uint32_t* out_crc,
+             uint32_t* out_aux) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
   Key k;
@@ -877,6 +996,7 @@ int ce_read2(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
   *out_crc = (offset == 0 && *out_len == static_cast<int64_t>(m.committed.length))
                  ? m.committed.crc
                  : crc32c(out, static_cast<size_t>(*out_len));
+  *out_aux = m.aux;
   return OK;
 }
 

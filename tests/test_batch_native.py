@@ -60,11 +60,12 @@ class TestEngineBatchOps:
         assert all(r.ok and r.ver == 1 for r in commits)
         reads = engine.batch_read(
             [(ChunkId(2, i), 0, -1) for i in range(8)], 4096)
-        for i, (code, data, ver, crc) in enumerate(reads):
+        for i, (code, data, ver, crc, aux) in enumerate(reads):
             assert code == Code.OK
             assert data == bytes([i + 1]) * 256
             assert ver == 1
             assert crc == crc32c(data)
+            assert aux == 0
 
     def test_batch_read_partial_and_missing(self, engine):
         engine.update(ChunkId(3, 0), 1, 1, b"abcdefgh", 0, chunk_size=4096)
@@ -198,3 +199,57 @@ class TestChainBatchedWrites:
         for r, (_, _, _, data) in zip(got, writes):
             assert r.ok and r.data == data
             assert r.checksum.value == crc32c(data)
+
+
+class TestEngineDurabilityEdges:
+    def test_wal_garbage_suffix_truncated_on_open(self, tmp_path):
+        """A torn/garbage WAL suffix is dropped at open; records appended
+        AFTER a recovery remain visible on the NEXT open (no O_APPEND
+        writes hiding behind an unreadable prefix)."""
+        import os
+
+        d = str(tmp_path / "eng")
+        e = NativeChunkEngine(d)
+        e.update(ChunkId(1, 0), 1, 1, b"alpha", 0, chunk_size=4096)
+        e.commit(ChunkId(1, 0), 1, 1)
+        e.close()
+        with open(os.path.join(d, "wal.log"), "ab") as f:
+            f.write(b"\xde\xad\xbe\xef" * 10)  # torn tail / garbage
+        e = NativeChunkEngine(d)
+        assert e.read(ChunkId(1, 0)) == b"alpha"
+        e.update(ChunkId(1, 1), 1, 1, b"beta", 0, chunk_size=4096)
+        e.commit(ChunkId(1, 1), 1, 1)
+        e.close()
+        e = NativeChunkEngine(d)   # the post-recovery write must survive
+        assert e.read(ChunkId(1, 0)) == b"alpha"
+        assert e.read(ChunkId(1, 1)) == b"beta"
+        e.close()
+
+    def test_batch_read_grown_chunk_not_truncated(self, tmp_path):
+        """A chunk whose committed content exceeds the per-op cap comes
+        back complete (native falls back to an exact-size re-read instead
+        of returning silently truncated bytes)."""
+        e = NativeChunkEngine(str(tmp_path / "eng2"))
+        big = bytes(range(256)) * 400           # 102400 B
+        e.update(ChunkId(2, 0), 1, 1, big, 0, chunk_size=1 << 20)
+        e.commit(ChunkId(2, 0), 1, 1)
+        out = e.batch_read([(ChunkId(2, 0), 0, -1)], cap=1 << 16)
+        code, data, ver, crc, aux = out[0]
+        assert code == Code.OK
+        assert data == big                       # full content, not 64 KiB
+        assert crc == crc32c(big)
+        e.close()
+
+    def test_validated_install_rejects_bad_crc(self, engine):
+        from tpu3fs.utils.result import FsError
+
+        with pytest.raises(FsError) as ei:
+            engine.update(ChunkId(3, 0), 1, 1, b"payload", 0,
+                          full_replace=True, chunk_size=4096,
+                          expected_crc=0xDEADBEEF)
+        assert ei.value.code == Code.CHUNK_CHECKSUM_MISMATCH
+        assert engine.get_meta(ChunkId(3, 0)) is None   # nothing installed
+        meta = engine.update(ChunkId(3, 0), 1, 1, b"payload", 0,
+                             full_replace=True, chunk_size=4096,
+                             expected_crc=crc32c(b"payload"))
+        assert meta.checksum.value == crc32c(b"payload")

@@ -227,11 +227,14 @@ class TestEcOverSockets:
         """EC chains work across the real TCP transport: ShardWriteReq and
         the batched shard install serde-roundtrip, and the rebuild worker
         drives remote reads/writes through sockets."""
+        from tpu3fs.rpc.services import MgmtdAdminRpcClient, bind_mgmtd_admin
+
         kv = MemKVEngine()
         mgmtd = Mgmtd(1, kv)
         mgmtd.extend_lease()
         mgmtd_server = RpcServer()
-        bind_mgmtd_service(mgmtd_server, mgmtd)
+        svc_def = bind_mgmtd_service(mgmtd_server, mgmtd)
+        bind_mgmtd_admin(svc_def, mgmtd)
         mgmtd_server.start()
         servers = [mgmtd_server]
         services = {}
@@ -245,6 +248,10 @@ class TestEcOverSockets:
         try:
             target_ids = [2000, 2001, 2002, 2003]
             node_ids = [20, 21, 22, 23]
+            # EC chain creation goes through the ADMIN RPC surface — the
+            # same path an operator's admin_cli takes against a live
+            # cluster, not the in-process mgmtd object
+            admin = MgmtdAdminRpcClient(mgmtd_server.address, shared)
             for node_id, target_id in zip(node_ids, target_ids):
                 mcli = MgmtdRpcClient(mgmtd_server.address, shared)
                 svc = StorageService(node_id, mcli.refresh_routing)
@@ -255,10 +262,10 @@ class TestEcOverSockets:
                 server.start()
                 mgmtd.register_node(node_id, NodeType.STORAGE,
                                     host=server.host, port=server.port)
-                mgmtd.create_target(target_id, node_id=node_id)
+                admin.create_target(target_id, node_id=node_id)
                 services[node_id] = svc
                 servers.append(server)
-            mgmtd.upload_chain(chain_id, target_ids, ec_k=k, ec_m=m)
+            admin.upload_chain(chain_id, target_ids, ec_k=k, ec_m=m)
             for i, node_id in enumerate(node_ids):
                 mgmtd.heartbeat(node_id, 1,
                                 {target_ids[i]: LocalTargetState.UPTODATE})

@@ -123,8 +123,11 @@ class AdminCli:
     def cmd_upload_chain(self, args: List[str]) -> str:
         cid = int(self._flag(args, "--chain-id"))
         targets = [int(x) for x in self._flag(args, "--targets").split(",")]
-        self.fab.mgmtd.upload_chain(cid, targets)
-        return f"chain {cid} uploaded with {len(targets)} targets"
+        ec_k = int(self._flag(args, "--ec-k", 0))
+        ec_m = int(self._flag(args, "--ec-m", 0))
+        self.fab.mgmtd.upload_chain(cid, targets, ec_k=ec_k, ec_m=ec_m)
+        kind = f"EC({ec_k},{ec_m})" if ec_k else "CR"
+        return f"chain {cid} uploaded with {len(targets)} targets ({kind})"
 
     def cmd_upload_chain_table(self, args: List[str]) -> str:
         tid = int(self._flag(args, "--table-id", 1))
@@ -154,13 +157,21 @@ class AdminCli:
             solve_placement,
         )
 
+        ec_k = int(self._flag(args, "--ec-k", 0))
+        ec_m = int(self._flag(args, "--ec-m", 0))
         p = PlacementProblem(
             num_nodes=int(self._flag(args, "--nodes")),
             group_size=int(self._flag(args, "--group-size")),
             targets_per_node=int(self._flag(args, "--targets-per-node")),
+            chain_table_type="EC" if ec_k else "CR",
         )
-        M = solve_placement(p, steps=int(self._flag(args, "--steps", 200)))
-        return "\n".join(gen_chain_table_commands(M))
+        traffic = self._flag(args, "--max-peer-traffic")
+        M = solve_placement(
+            p,
+            steps=int(self._flag(args, "--steps", 200)),
+            max_peer_traffic=float(traffic) if traffic else None,
+        )
+        return "\n".join(gen_chain_table_commands(M, ec_k=ec_k, ec_m=ec_m))
 
     # -- FS shell ------------------------------------------------------------
     def cmd_ls(self, args: List[str]) -> str:
@@ -367,12 +378,72 @@ class AdminCli:
         )
 
 
-def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
-    """One-shot or REPL against a fresh local fabric (dev mode)."""
-    from tpu3fs.fabric import Fabric
+class RpcFabricView:
+    """Live-cluster adapter for AdminCli: exposes the same .mgmtd / .meta /
+    .routing() / .file_client() / .storage_client() surfaces as the
+    in-process Fabric, backed by RPC clients — the admin_cli connects to a
+    running cluster exactly like the reference's (ForAdmin/ForClient mgmtd
+    role split, src/client/mgmtd/MgmtdClient.cc)."""
 
+    def __init__(self, mgmtd_addr, token: str = "", client_id: str = "admin"):
+        from tpu3fs.client.file_io import FileIoClient
+        from tpu3fs.client.storage_client import StorageClient
+        from tpu3fs.mgmtd.types import NodeType
+        from tpu3fs.rpc.net import RpcClient
+        from tpu3fs.rpc.services import (
+            MetaRpcClient,
+            MgmtdAdminRpcClient,
+            RpcMessenger,
+        )
+
+        self._rpc = RpcClient()
+        self._client_id = client_id
+        self.mgmtd = MgmtdAdminRpcClient(mgmtd_addr, self._rpc)
+        self._messenger = RpcMessenger(self.mgmtd.refresh_routing, self._rpc)
+        self._StorageClient = StorageClient
+        self._FileIoClient = FileIoClient
+        meta_addrs = [
+            (n.host, n.port)
+            for n in self.routing().nodes.values()
+            if n.type == NodeType.META and n.host
+        ]
+        self.meta = (
+            MetaRpcClient(meta_addrs, self._rpc,
+                          client_id=client_id, token=token)
+            if meta_addrs else None
+        )
+
+    def routing(self):
+        return self.mgmtd.refresh_routing()
+
+    def tick(self) -> None:
+        self.mgmtd.tick()
+
+    def storage_client(self, **kw):
+        return self._StorageClient(
+            self._client_id, self.mgmtd.refresh_routing, self._messenger,
+            **kw)
+
+    def file_client(self, **kw):
+        return self._FileIoClient(self.storage_client(**kw))
+
+
+def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
+    """One-shot or REPL — against a fresh local fabric (dev mode) or a live
+    cluster via --connect HOST:PORT (operator mode)."""
     argv = sys.argv[1:] if argv is None else argv
-    cli = AdminCli(Fabric())
+    if argv and argv[0] == "--connect":
+        host, port = argv[1].rsplit(":", 1)
+        token = ""
+        rest = argv[2:]
+        if rest[:1] == ["--token"]:
+            token, rest = rest[1], rest[2:]
+        cli = AdminCli(RpcFabricView((host, int(port)), token=token))
+        argv = rest
+    else:
+        from tpu3fs.fabric import Fabric
+
+        cli = AdminCli(Fabric())
     if argv:
         print(cli.run(" ".join(argv)))
         return 0

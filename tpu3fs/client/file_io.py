@@ -48,26 +48,47 @@ class FileIoClient:
         return chain.is_ec
 
     def write(self, inode: Inode, offset: int, data: bytes) -> int:
+        """Write a byte range. Chunk ops are BATCHED, not issued one at a
+        time: CR chunks go through StorageClient.batch_write (one request
+        per node, ref StorageClientImpl.cc:1030,1771) and full EC stripes
+        through write_stripes (ONE device encode for the whole span + one
+        BatchShardWrite per node). Only boundary partial-stripe EC writes
+        take the read-modify-write path. Chunks in one call are distinct,
+        so issue order does not affect the result."""
         layout = inode.layout
         assert layout is not None, "write() needs a file inode with layout"
-        written = 0
+        cs = layout.chunk_size
+        cr_writes: List[Tuple[int, ChunkId, int, bytes]] = []
+        ec_full: dict = {}   # chain_id -> [(ChunkId, bytes)]
+        ec_partial: List[Tuple[int, int, int, bytes]] = []
+        pos = 0
         for idx, chain_id, in_off, n in self._split(layout, offset, len(data)):
-            part = data[written : written + n]
+            part = data[pos : pos + n]
+            pos += n
             if self._is_ec(chain_id):
-                reply = self._write_ec_chunk(
-                    inode, chain_id, idx, in_off, part, layout.chunk_size)
+                if in_off == 0 and n == cs:
+                    ec_full.setdefault(chain_id, []).append(
+                        (ChunkId(inode.id, idx), part))
+                else:
+                    ec_partial.append((chain_id, idx, in_off, part))
             else:
-                reply = self._storage.write_chunk(
-                    chain_id,
-                    ChunkId(inode.id, idx),
-                    in_off,
-                    part,
-                    chunk_size=layout.chunk_size,
-                )
+                cr_writes.append((chain_id, ChunkId(inode.id, idx),
+                                  in_off, part))
+        if cr_writes:
+            for reply in self._storage.batch_write(cr_writes, chunk_size=cs):
+                if not reply.ok:
+                    raise FsError(Status(reply.code, reply.message))
+        for chain_id, items in ec_full.items():
+            for reply in self._storage.write_stripes(
+                    chain_id, items, chunk_size=cs):
+                if not reply.ok:
+                    raise FsError(Status(reply.code, reply.message))
+        for chain_id, idx, in_off, part in ec_partial:
+            reply = self._write_ec_chunk(
+                inode, chain_id, idx, in_off, part, cs)
             if not reply.ok:
                 raise FsError(Status(reply.code, reply.message))
-            written += n
-        return written
+        return len(data)
 
     def _write_ec_chunk(self, inode: Inode, chain_id: int, idx: int,
                         in_off: int, part: bytes, chunk_size: int):

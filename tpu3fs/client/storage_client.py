@@ -403,18 +403,22 @@ class StorageClient:
                 if node is None:
                     continue
                 # data shards ship the trimmed host bytes; parity ships the
-                # device-encoded rows (always full S)
+                # device-encoded rows (always full S). The wire CRC covers
+                # the STORED (trimmed) bytes, so the server validates with
+                # the one CRC pass its engine does during staging
                 if j < k:
                     payload = data[j * S : (j + 1) * S]
                 else:
                     payload = shards[j].tobytes()
+                crc = (int(crcs[j]) if len(payload) == S
+                       else codec.crc_host(payload))
                 req = ShardWriteReq(
                     chain_id=chain_id,
                     chain_ver=chain.chain_version,
                     target_id=t.target_id,
                     chunk_id=chunk_id,
                     data=payload,
-                    crc=int(crcs[j]),
+                    crc=crc,
                     update_ver=ver,
                     chunk_size=S,
                     logical_len=len(data),
@@ -466,7 +470,10 @@ class StorageClient:
         """Batched EC writes: encode MANY stripes with ONE device kernel
         launch (amortizing the PCIe round trip — the whole point of the TPU
         data plane) and install shards with one BatchShardWrite per node.
-        Stripes that hit version conflicts fall back to write_stripe."""
+        Overwrites are handled by probing the current stripe versions with
+        ONE statChunks RPC up front (shard 0's target holds every stripe of
+        the chain), so rewriting existing stripes stays on the batch path;
+        stripes that still conflict fall back to write_stripe."""
         import numpy as np
 
         from tpu3fs.ops.stripe import get_codec, shard_size_of
@@ -487,6 +494,22 @@ class StorageClient:
         shards, crcs = codec.encode_batch(buf)
 
         routing = self._routing()
+        # one-RPC version probe: max committed over probed shards is the
+        # floor for this batch's stripe versions (a later shard write may
+        # still be ahead — that stripe falls to the per-stripe ladder)
+        vers = [1] * B
+        t0 = chain.target_of_shard(0)
+        if t0 is not None:
+            node0 = routing.node_of_target(t0.target_id)
+            if node0 is not None:
+                try:
+                    stats = self._messenger(
+                        node0.node_id, "stat_chunks",
+                        (t0.target_id, [cid for cid, _ in items]))
+                    vers = [max(1, int(st[0]) + 1) if st[0] else 1
+                            for st in stats]
+                except FsError:
+                    pass  # probe is an optimization; conflicts still ladder
         by_node: Dict[int, List[Tuple[int, ShardWriteReq]]] = defaultdict(list)
         acked = [0] * B
         hard: List[Optional[UpdateReply]] = [None] * B
@@ -502,14 +525,16 @@ class StorageClient:
             for b, (cid, data) in enumerate(items):
                 payload = (data[j * S : (j + 1) * S] if j < k
                            else shards[b, j].tobytes())
+                crc = (int(crcs[b, j]) if len(payload) == S
+                       else codec.crc_host(payload))
                 by_node[node.node_id].append((b, ShardWriteReq(
                     chain_id=chain_id,
                     chain_ver=chain.chain_version,
                     target_id=t.target_id,
                     chunk_id=cid,
                     data=payload,
-                    crc=int(crcs[b, j]),
-                    update_ver=1,
+                    crc=crc,
+                    update_ver=vers[b],
                     chunk_size=S,
                     logical_len=len(data),
                 )))
@@ -528,11 +553,13 @@ class StorageClient:
         for b, (cid, data) in enumerate(items):
             # same strict rule as write_stripe: every writable shard acked
             if acked[b] == writable and acked[b] >= k and hard[b] is None:
-                out.append(UpdateReply(Code.OK, update_ver=1, commit_ver=1))
+                out.append(UpdateReply(
+                    Code.OK, update_ver=vers[b], commit_ver=vers[b]))
             else:
                 # conflict or partial: the single-stripe ladder re-probes
                 out.append(self.write_stripe(
-                    chain_id, cid, data, chunk_size=chunk_size))
+                    chain_id, cid, data, chunk_size=chunk_size,
+                    update_ver=vers[b]))
         return out
 
     def read_stripe(
@@ -589,11 +616,18 @@ class StorageClient:
                     direct[j].data.ljust(S, b"\x00") for j in range(j0, j1)
                 )
                 lo = offset - j0 * S
+                # exact logical length from the shard's stored aux tag
+                # (ShardWriteReq.logical_len persisted by the server);
+                # fall back to inferring from stored shard extents
                 logical = max(
-                    (j * S + len(direct[j].data) for j in range(j0, j1)
-                     if len(direct[j].data) > 0),
-                    default=0,
-                ) if (j0, j1) == (0, k) else 0
+                    (r.logical_len for r in direct.values() if r.logical_len),
+                    default=0)
+                if logical == 0 and (j0, j1) == (0, k):
+                    logical = max(
+                        (j * S + len(direct[j].data) for j in range(j0, j1)
+                         if len(direct[j].data) > 0),
+                        default=0,
+                    )
                 return ReadReply(
                     Code.OK,
                     data=whole[lo : lo + length],
@@ -640,8 +674,11 @@ class StorageClient:
                         parts[j] = rebuilt[i].tobytes()
                 whole = b"".join(parts[j] for j in range(j0, j1))
                 lo = offset - j0 * S
-                logical = 0
-                if (j0, j1) == (0, k):
+                # exact from any survivor's aux tag, else infer
+                logical = max(
+                    (r.logical_len for r in replies.values()
+                     if r is not None and r.ok and r.logical_len), default=0)
+                if logical == 0 and (j0, j1) == (0, k):
                     from tpu3fs.ops.stripe import trim_rebuilt_shard
 
                     lens = {j: len(group[j]) for j in present if j < k}

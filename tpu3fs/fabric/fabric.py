@@ -189,6 +189,8 @@ class Fabric:
             return svc.batch_write(payload)
         if method == "batch_update":
             return svc.batch_update(payload)
+        if method == "stat_chunks":
+            return svc.stat_chunks(*payload)
         if method == "batch_write_shard":
             return svc.batch_write_shard(payload)
         if method == "dump_chunkmeta":

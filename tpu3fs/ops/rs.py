@@ -113,7 +113,10 @@ class RSCode:
         return self._apply_bit_matrix(self._parity_bits, "encode", data)
 
     def encode_np(self, data: np.ndarray) -> np.ndarray:
-        """Gold-path numpy encode via GF tables (slow, exact)."""
+        """Numpy host encode: one pass per (i, j) coefficient. c==1 rows
+        (parity row 0 is all-ones by construction) reduce to plain XOR at
+        memory speed — the CPU-backend serving path; general coefficients
+        are one 256-entry LUT gather per pass."""
         data = np.asarray(data, dtype=np.uint8)
         *lead, k, s = data.shape
         assert k == self.k
@@ -121,7 +124,13 @@ class RSCode:
         out = np.zeros((flat.shape[0], self.m, s), dtype=np.uint8)
         for i in range(self.m):
             for j in range(k):
-                out[:, i, :] ^= GF.mul(self.parity_matrix[i, j], flat[:, j, :])
+                c = int(self.parity_matrix[i, j])
+                if c == 0:
+                    continue
+                if c == 1:
+                    out[:, i, :] ^= flat[:, j, :]
+                else:
+                    out[:, i, :] ^= GF.MUL_TABLE[c][flat[:, j, :]]
         return out.reshape(*lead, self.m, s)
 
     # -- decode ------------------------------------------------------------
@@ -213,7 +222,13 @@ class RSCode:
         out = np.zeros((flat.shape[0], R.shape[0], s), dtype=np.uint8)
         for i in range(R.shape[0]):
             for j in range(k):
-                out[:, i, :] ^= GF.mul(R[i, j], flat[:, j, :])
+                c = int(R[i, j])
+                if c == 0:
+                    continue
+                if c == 1:
+                    out[:, i, :] ^= flat[:, j, :]
+                else:
+                    out[:, i, :] ^= GF.MUL_TABLE[c][flat[:, j, :]]
         return out.reshape(*lead, R.shape[0], s)
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -34,8 +34,12 @@ import numpy as np
 @dataclasses.dataclass
 class PlacementProblem:
     num_nodes: int           # v
-    group_size: int          # k (= replication factor / EC group width)
+    group_size: int          # k (= replication factor / EC group width k+m)
     targets_per_node: int    # r
+    # "CR" chain replication vs "EC" erasure-coded chain tables: EC recovery
+    # reads from EVERY surviving group member (factor k-1), CR full-chunk-
+    # replace streams one copy (factor 1) — ref data_placement.py:91-92
+    chain_table_type: str = "CR"
 
     def __post_init__(self):
         v, k, r = self.num_nodes, self.group_size, self.targets_per_node
@@ -43,10 +47,27 @@ class PlacementProblem:
             raise ValueError(f"group size {k} > nodes {v}")
         if (v * r) % k != 0:
             raise ValueError(f"v*r={v*r} not divisible by group size {k}")
+        if self.chain_table_type not in ("CR", "EC"):
+            raise ValueError(f"chain_table_type {self.chain_table_type!r}")
 
     @property
     def num_groups(self) -> int:  # b
         return self.num_nodes * self.targets_per_node // self.group_size
+
+    @property
+    def recovery_traffic_factor(self) -> int:
+        """Traffic units a failed target's group emits during recovery
+        (ref data_placement.py:91-92)."""
+        return self.group_size - 1 if self.chain_table_type == "EC" else 1
+
+    @property
+    def max_recovery_traffic_on_peer(self) -> int:
+        """Ideal (balanced) per-peer recovery traffic ceiling
+        (ref data_placement.py:94-100)."""
+        import math
+
+        total = self.targets_per_node * self.recovery_traffic_factor
+        return math.ceil(total / (self.num_nodes - 1))
 
     @property
     def lambda_lower_bound(self) -> int:
@@ -83,8 +104,14 @@ def solve_placement(
     proposals_per_step: int = 128,
     seed: int = 0,
     target_lambda: Optional[int] = None,
+    max_peer_traffic: Optional[float] = None,
 ) -> np.ndarray:
-    """-> incidence matrix (b, v) with row sums k and column sums r."""
+    """-> incidence matrix (b, v) with row sums k and column sums r.
+
+    target_lambda bounds raw co-occurrence; max_peer_traffic bounds
+    recovery traffic in the chain-table type's units (EC-vs-CR weighted,
+    ref data_placement.py:91-100) — it is converted to the equivalent
+    co-occurrence bound, which the annealer minimizes."""
     v, k, b, r = (
         problem.num_nodes,
         problem.group_size,
@@ -92,6 +119,12 @@ def solve_placement(
         problem.targets_per_node,
     )
     M = _greedy_incidence(problem).astype(np.int8)
+    if max_peer_traffic is not None:
+        # traffic per co-occurrence = factor / (k-1)
+        per_cooc = problem.recovery_traffic_factor / (k - 1)
+        traffic_tgt = int(max_peer_traffic / per_cooc)
+        target_lambda = (min(target_lambda, traffic_tgt)
+                         if target_lambda is not None else traffic_tgt)
     tgt = target_lambda if target_lambda is not None else problem.lambda_lower_bound
     best_max, best_ssq = _score_np(M)
     if best_max <= tgt:
@@ -145,7 +178,10 @@ def solve_placement(
 
 
 def check_solution(
-    M: np.ndarray, problem: PlacementProblem, lambda_max: Optional[int] = None
+    M: np.ndarray,
+    problem: PlacementProblem,
+    lambda_max: Optional[int] = None,
+    max_peer_traffic: Optional[float] = None,
 ) -> bool:
     """Validate structure + balanced peer recovery traffic (ref
     check_solution in data_placement.py)."""
@@ -168,6 +204,15 @@ def check_solution(
         mx, _ = _score_np(M)
         if mx > lambda_max:
             return False
+    if max_peer_traffic is not None:
+        # worst per-peer traffic over every single-node failure, in the
+        # chain-table type's units (ref check_solution peer traffic)
+        worst = max(
+            float(peer_recovery_traffic(M, problem, n).max())
+            for n in range(v)
+        )
+        if worst > max_peer_traffic + 1e-9:
+            return False
     return True
 
 
@@ -181,6 +226,19 @@ def recovery_traffic_factor(M: np.ndarray, node: int) -> np.ndarray:
     return row
 
 
+def peer_recovery_traffic(
+    M: np.ndarray, problem: PlacementProblem, node: int
+) -> np.ndarray:
+    """Per-peer recovery traffic in TRAFFIC UNITS when `node` fails:
+    co-occurrence scaled by the chain-table type's recovery factor —
+    the quantity the reference's peer_traffic_map reports
+    (data_placement.py:296-300). For EC every surviving group member
+    streams its shard (factor (k-1)/(k-1) = 1 per co-occurrence); for CR
+    one full-chunk copy spreads over the k-1 peers (1/(k-1) each)."""
+    row = recovery_traffic_factor(M, node).astype(np.float64)
+    return row * problem.recovery_traffic_factor / (problem.group_size - 1)
+
+
 def gen_chain_table_commands(
     M: np.ndarray,
     *,
@@ -188,11 +246,20 @@ def gen_chain_table_commands(
     first_chain_id: int = 900_001,
     table_id: int = 1,
     node_ids: Optional[List[int]] = None,
+    ec_k: int = 0,
+    ec_m: int = 0,
 ) -> List[str]:
     """Admin command lines (create-target / upload-chains / upload-chain-table)
-    like the reference's generated command files."""
+    like the reference's generated command files. With ec_k/ec_m the chains
+    are emitted as EC(k, m) chain tables (group width must be k+m)."""
     M = np.asarray(M)
     b, v = M.shape
+    if ec_k:
+        width = int(M[0].sum())
+        if ec_k + ec_m != width:
+            raise ValueError(
+                f"EC({ec_k},{ec_m}) needs group width {ec_k + ec_m}, "
+                f"placement has {width}")
     node_ids = node_ids or [10 + i for i in range(v)]
     lines: List[str] = []
     chains: List[List[int]] = []
@@ -208,10 +275,11 @@ def gen_chain_table_commands(
             targets.append(tid)
             tid += 1
         chains.append(targets)
+    ec_suffix = f" --ec-k {ec_k} --ec-m {ec_m}" if ec_k else ""
     for g, targets in enumerate(chains):
         lines.append(
             f"upload-chain --chain-id {first_chain_id + g} --targets "
-            + ",".join(map(str, targets))
+            + ",".join(map(str, targets)) + ec_suffix
         )
     lines.append(
         f"upload-chain-table --table-id {table_id} --chains "

@@ -81,6 +81,17 @@ class BatchReadRsp:
 
 
 @dataclass
+class StatChunksReq:
+    target_id: int
+    chunk_ids: List[ChunkId] = field(default_factory=list)
+
+
+@dataclass
+class StatChunksRsp:
+    stats: List[List[int]] = field(default_factory=list)
+
+
+@dataclass
 class BatchWriteReq:
     reqs: List[WriteReq] = field(default_factory=list)
 
@@ -177,6 +188,9 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
              lambda r: BatchWriteRsp(svc.batch_write_shard(r.reqs)))
     s.method(15, "batchUpdate", BatchWriteReq, BatchWriteRsp,
              lambda r: BatchWriteRsp(svc.batch_update(r.reqs)))
+    s.method(16, "statChunks", StatChunksReq, StatChunksRsp,
+             lambda r: StatChunksRsp(
+                 [list(t) for t in svc.stat_chunks(r.target_id, r.chunk_ids)]))
     server.add_service(s)
 
 
@@ -236,6 +250,9 @@ class RpcMessenger:
             ).replies
         if method == "batch_update":
             return c.call(addr, sid, 15, BatchWriteReq(payload), BatchWriteRsp).replies
+        if method == "stat_chunks":
+            rsp = c.call(addr, sid, 16, StatChunksReq(*payload), StatChunksRsp)
+            return [tuple(t) for t in rsp.stats]
         raise FsError(Status(Code.RPC_METHOD_NOT_FOUND, method))
 
 
@@ -795,6 +812,11 @@ class CreateTargetReq:
 class UploadChainReq:
     chain_id: int
     target_ids: List[int] = field(default_factory=list)
+    # EC(k, m) chain tables (0,0 = CR replication chain); mirrors the
+    # chain_table_type axis of the reference's placement solver
+    # (deploy/data_placement/src/model/data_placement.py:30)
+    ec_k: int = 0
+    ec_m: int = 0
 
 
 @dataclass
@@ -829,7 +851,8 @@ def bind_mgmtd_admin(service: "ServiceDef", mgmtd: Mgmtd) -> None:
         return Empty()
 
     def upload_chain(req: UploadChainReq) -> Empty:
-        mgmtd.upload_chain(req.chain_id, req.target_ids)
+        mgmtd.upload_chain(req.chain_id, req.target_ids,
+                           ec_k=req.ec_k, ec_m=req.ec_m)
         return Empty()
 
     def upload_chain_table(req: UploadChainTableReq) -> Empty:
@@ -865,9 +888,12 @@ class MgmtdAdminRpcClient(MgmtdRpcClient):
         self._client.call(self._addr, MGMTD_SERVICE_ID, 4,
                           CreateTargetReq(target_id, node_id, disk_index), Empty)
 
-    def upload_chain(self, chain_id: int, target_ids: List[int]) -> None:
-        self._client.call(self._addr, MGMTD_SERVICE_ID, 5,
-                          UploadChainReq(chain_id, list(target_ids)), Empty)
+    def upload_chain(self, chain_id: int, target_ids: List[int],
+                     *, ec_k: int = 0, ec_m: int = 0) -> None:
+        self._client.call(
+            self._addr, MGMTD_SERVICE_ID, 5,
+            UploadChainReq(chain_id, list(target_ids), ec_k=ec_k, ec_m=ec_m),
+            Empty)
 
     def upload_chain_table(self, table_id: int, chain_ids: List[int]) -> None:
         self._client.call(self._addr, MGMTD_SERVICE_ID, 6,

@@ -255,24 +255,31 @@ class StorageService:
             reply = self._write_impl(req)
             if not reply.ok:
                 op.fail()
-        if self._trace is not None:
-            try:
-                self._trace.append(StorageEventTrace(
-                    ts=_time.time(),
-                    client_id=req.client_id,
-                    chain_id=req.chain_id,
-                    file_id=req.chunk_id.file_id,
-                    chunk_index=req.chunk_id.index,
-                    update_ver=reply.update_ver,
-                    code=int(reply.code),
-                    length=len(req.data),
-                    latency_us=(_time.perf_counter() - t0) * 1e6,
-                ))
-            except Exception:
-                # tracing is best-effort: a trace-flush I/O failure must not
-                # fail a client write that already committed + forwarded
-                pass
+        self._trace_write(req, reply, t0)
         return reply
+
+    def _trace_write(self, req: WriteReq, reply: UpdateReply,
+                     t0: float) -> None:
+        if self._trace is None:
+            return
+        import time as _time
+
+        try:
+            self._trace.append(StorageEventTrace(
+                ts=_time.time(),
+                client_id=req.client_id,
+                chain_id=req.chain_id,
+                file_id=req.chunk_id.file_id,
+                chunk_index=req.chunk_id.index,
+                update_ver=reply.update_ver,
+                code=int(reply.code),
+                length=len(req.data),
+                latency_us=(_time.perf_counter() - t0) * 1e6,
+            ))
+        except Exception:
+            # tracing is best-effort: a trace-flush I/O failure must not
+            # fail a client write that already committed + forwarded
+            pass
 
     def _write_impl(self, req: WriteReq) -> UpdateReply:
         if self.stopped:
@@ -496,14 +503,6 @@ class StorageService:
         target = self._targets.get(req.target_id)
         if target is None:
             return UpdateReply(Code.TARGET_NOT_FOUND, message=str(req.target_id))
-        # CRC covers the zero-padded shard (the device batch form); the
-        # engine stores the trimmed bytes
-        padded = req.data.ljust(req.chunk_size, b"\x00")
-        if Checksum.of(padded).value != req.crc:
-            return UpdateReply(
-                Code.CHUNK_CHECKSUM_MISMATCH,
-                message=f"shard crc mismatch on target {req.target_id}",
-            )
         with self._chunk_lock(req.target_id, req.chunk_id):
             try:
                 inject("storage.write_shard")
@@ -518,7 +517,7 @@ class StorageService:
                                 f"{req.update_ver}",
                     )
                 if meta is not None and meta.committed_ver == req.update_ver:
-                    if meta.checksum.value == Checksum.of(req.data).value:
+                    if meta.checksum.value == req.crc:
                         return UpdateReply(  # duplicate of the applied write
                             Code.OK, update_ver=req.update_ver,
                             commit_ver=meta.committed_ver,
@@ -533,6 +532,10 @@ class StorageService:
                         commit_ver=meta.committed_ver,
                         message="stripe version taken by different content",
                     )
+                # VALIDATED install: req.crc covers the stored (trimmed)
+                # shard bytes; the engine computes the content CRC during
+                # staging anyway and refuses on mismatch — one checksum
+                # pass server-side instead of a separate padded pre-check
                 meta = engine.update(
                     req.chunk_id,
                     req.update_ver,
@@ -541,6 +544,12 @@ class StorageService:
                     0,
                     full_replace=True,
                     chunk_size=req.chunk_size,
+                    # the stripe's logical (pre-padding) length rides the
+                    # engine's aux tag: durable across restarts, consulted
+                    # by queryLastChunk and rebuild-trim instead of
+                    # zero-stripping (round-2 weak #8)
+                    aux=req.logical_len,
+                    expected_crc=req.crc,
                 )
                 return UpdateReply(
                     Code.OK,
@@ -549,6 +558,11 @@ class StorageService:
                     checksum=meta.checksum,
                 )
             except FsError as e:
+                if e.code == Code.CHUNK_CHECKSUM_MISMATCH:
+                    return UpdateReply(
+                        e.code,
+                        message=f"shard crc mismatch on target "
+                                f"{req.target_id}")
                 return UpdateReply(e.code, message=e.status.message)
 
     # -- batched IO (one request carries many ops; ref BatchReadReq
@@ -576,12 +590,13 @@ class StorageService:
                 for i in idxs
             ]
             outs = target.engine.batch_read(items, target.chunk_size)
-            for i, (code, data, ver, crc) in zip(idxs, outs):
+            for i, (code, data, ver, crc, aux) in zip(idxs, outs):
                 if code == Code.OK:
                     self._read_rec.succeeded.add()
                     replies[i] = ReadReply(
                         Code.OK, data=data, commit_ver=ver,
-                        checksum=Checksum(crc, len(data)))
+                        checksum=Checksum(crc, len(data)),
+                        logical_len=aux)
                 else:
                     self._read_rec.failed.add()
                     replies[i] = ReadReply(code)
@@ -650,15 +665,24 @@ class StorageService:
             seen.add(key)
             todo.append(i)
         if todo:
+            import time as _time
+
+            t0 = _time.perf_counter()
             with self._write_rec.record() as op:
                 outs = self._handle_batch_update(
                     target, [reqs[i] for i in todo])
                 if not all(o.ok for o in outs):
                     op.fail()
+            # per-op latency is not individually measured inside a batch:
+            # amortize the batch duration evenly so trace-log sums stay
+            # meaningful (N ops of dt/N, not N ops of dt)
+            dt = _time.perf_counter() - t0
+            t0_amortized = _time.perf_counter() - dt / max(len(todo), 1)
             for i, out in zip(todo, outs):
                 replies[i] = out
                 if out.ok:
                     self._channels.store(reqs[i], out)
+                self._trace_write(reqs[i], out, t0_amortized)
         for i in sequential:
             replies[i] = self._write_impl(reqs[i])
         return replies
@@ -910,13 +934,14 @@ class StorageService:
             engine = self._targets[target_id].engine
             # one engine-lock hold for data+ver+crc (full-content reads
             # reuse the committed CRC — ChunkReplica.cc:24-29 counters)
-            data, ver, crc = engine.read_verified(
+            data, ver, crc, aux = engine.read_verified(
                 req.chunk_id, req.offset, req.length)
             return ReadReply(
                 Code.OK,
                 data=data,
                 commit_ver=ver,
                 checksum=Checksum(crc, len(data)),
+                logical_len=aux,
             )
         except FsError as e:
             return ReadReply(e.code)
@@ -945,8 +970,14 @@ class StorageService:
                     continue
                 last = max(metas, key=lambda m: m.chunk_id.index)
                 shard = chain.shard_index(t.target_id)
-                contrib = (0 if shard >= chain.ec_k or last.length == 0
-                           else shard * target.chunk_size + last.length)
+                if last.aux > 0:
+                    # exact: every shard stores the stripe's logical length
+                    # (ShardWriteReq.logical_len -> engine aux), so even a
+                    # parity-only node reports the precise contribution
+                    contrib = last.aux
+                else:
+                    contrib = (0 if shard >= chain.ec_k or last.length == 0
+                               else shard * target.chunk_size + last.length)
                 got = (last.chunk_id.index, contrib)
                 if got[0] > best[0] or (got[0] == best[0] and got[1] > best[1]):
                     best = got
@@ -1058,6 +1089,22 @@ class StorageService:
             total.used += si.used
             total.chunk_count += si.chunk_count
         return total
+
+    def stat_chunks(self, target_id: int, chunk_ids: List[ChunkId]):
+        """-> [(committed_ver, length, aux)] per chunk ((0,0,0) = absent):
+        the one-RPC version probe behind overwrite-capable batched stripe
+        writes (ref queryChunk, src/fbs/storage/Service.h:20)."""
+        target = self._targets.get(target_id)
+        if target is None:
+            raise _err(Code.TARGET_NOT_FOUND, str(target_id))
+        out = []
+        for cid in chunk_ids:
+            meta = target.engine.get_meta(cid)
+            if meta is None:
+                out.append((0, 0, 0))
+            else:
+                out.append((meta.committed_ver, meta.length, meta.aux))
+        return out
 
     # -- sync / recovery (receiver side; ref syncStart/syncDone) --------------
     def dump_chunkmeta(self, target_id: int) -> List[ChunkMeta]:

@@ -149,10 +149,11 @@ class EcResyncWorker:
         k, m = chain.ec_k, chain.ec_m
         # gather survivors per stripe; stripes whose shard sets disagree on
         # version are skipped this round (a write is in flight)
-        gathered = []  # (chunk_id, ver, {shard: bytes}, S)
+        gathered = []  # (chunk_id, ver, {shard: bytes}, S, logical)
         skipped = 0
         for cid in chunk_ids:
             by_ver: Dict[int, Dict[int, bytes]] = {}
+            aux_ver: Dict[int, int] = {}
             for j in range(k + m):
                 if j == lost_shard:
                     continue
@@ -160,12 +161,16 @@ class EcResyncWorker:
                 if r is None:
                     continue
                 by_ver.setdefault(r.commit_ver, {})[j] = r.data
+                if r.logical_len:
+                    aux_ver[r.commit_ver] = max(
+                        aux_ver.get(r.commit_ver, 0), r.logical_len)
             usable = [v for v, g in by_ver.items() if len(g) >= k]
             if not usable:
                 skipped += 1
                 continue
             ver = max(usable)
             shards = by_ver[ver]
+            logical = aux_ver.get(ver, 0)
             # shard size is per-file (S = ceil(chunk_size/k)); the max stored
             # survivor length is a safe working size: content beyond any
             # shard's stored extent is zeros, and GF-multiplying zeros
@@ -174,13 +179,13 @@ class EcResyncWorker:
             S = max(len(b) for b in shards.values())
             if S == 0:
                 continue  # all-empty stripe: nothing to rebuild
-            gathered.append((cid, ver, shards, aligned_shard_size(S)))
+            gathered.append((cid, ver, shards, aligned_shard_size(S), logical))
         if not gathered:
             return 0, skipped
         # group stripes by (survivor index set, working size) so each group
         # is ONE batched device decode
         groups: Dict[tuple, List[int]] = {}
-        for i, (_, _, shards, S) in enumerate(gathered):
+        for i, (_, _, shards, S, _logical) in enumerate(gathered):
             present = tuple(sorted(shards)[:k])
             groups.setdefault((present, S), []).append(i)
         moved = 0
@@ -196,10 +201,20 @@ class EcResyncWorker:
             ])  # (B, k, S)
             rebuilt = self._reconstruct(codec, present, (lost_shard,), surv)
             for row, i in enumerate(idxs):
-                cid, ver, shards, _ = gathered[i]
-                lens = {j: len(b) for j, b in shards.items() if j < k}
-                payload = trim_rebuilt_shard(
-                    rebuilt[row, 0].tobytes(), lost_shard, lens, k, S)
+                cid, ver, shards, _, logical = gathered[i]
+                raw = rebuilt[row, 0].tobytes()
+                if logical and lost_shard < k:
+                    # EXACT trim from the survivors' persisted stripe
+                    # logical length (engine aux tag) — no zero-stripping
+                    # ambiguity even when true content ends in zeros
+                    extent = min(max(logical - lost_shard * S, 0), S)
+                    payload = raw[:extent]
+                elif lost_shard >= k:
+                    payload = raw  # parity shards are stored full
+                else:
+                    lens = {j: len(b) for j, b in shards.items() if j < k}
+                    payload = trim_rebuilt_shard(
+                        raw, lost_shard, lens, k, S)
                 crc = codec.crc_host(payload)
                 req = ShardWriteReq(
                     chain_id=chain.chain_id,
@@ -210,6 +225,7 @@ class EcResyncWorker:
                     crc=crc,
                     update_ver=ver,
                     chunk_size=S,
+                    logical_len=logical,
                 )
                 try:
                     reply = self._messenger(node_id, "write_shard", req)

@@ -36,6 +36,7 @@ class EngineUpdateOp:
     update_ver: int = 0          # 0 = assign committed+1
     full_replace: bool = False
     chunk_size: int = 0
+    aux: int = 0                 # opaque tag stored with the staged content
 
 
 @dataclass
@@ -70,7 +71,7 @@ class ChunkEngine(abc.ABC):
     def read_verified(
         self, chunk_id: ChunkId, offset: int = 0, length: int = -1
     ) -> tuple:
-        """-> (data, commit_ver, crc), mutually consistent: all three are
+        """-> (data, commit_ver, crc, aux), mutually consistent: all are
         taken under one engine lock hold, so a concurrent commit can never
         pair one version's bytes with another version's checksum."""
 
@@ -85,8 +86,16 @@ class ChunkEngine(abc.ABC):
         *,
         full_replace: bool = False,
         chunk_size: int,
+        aux: int = 0,
+        expected_crc: Optional[int] = None,
     ) -> ChunkMeta:
-        """Stage pending version `update_ver` (COW write of [offset, offset+len))."""
+        """Stage pending version `update_ver` (COW write of [offset,
+        offset+len)); `aux` is an opaque tag promoted with the content at
+        commit (EC stripes store the logical pre-padding length there).
+        expected_crc (when given) makes the install VALIDATED: the engine
+        compares its own content CRC (computed during staging anyway) and
+        refuses with CHUNK_CHECKSUM_MISMATCH before mutating anything —
+        the one-pass verified write the EC shard path uses."""
 
     @abc.abstractmethod
     def commit(self, chunk_id: ChunkId, ver: int, chain_ver: int) -> ChunkMeta:
@@ -132,6 +141,7 @@ class ChunkEngine(abc.ABC):
                 meta = self.update(
                     op.chunk_id, ver, chain_ver, op.data, op.offset,
                     full_replace=op.full_replace, chunk_size=op.chunk_size,
+                    aux=op.aux,
                 )
                 if op.full_replace:
                     out.append(EngineOpResult(
@@ -171,14 +181,15 @@ class ChunkEngine(abc.ABC):
         self, items: List[Tuple[ChunkId, int, int]], cap: int
     ) -> List[Tuple[Code, bytes, int, int]]:
         """items: (chunk_id, offset, length); cap: per-op buffer bound
-        (the target chunk size). -> (code, data, commit_ver, crc)."""
-        out: List[Tuple[Code, bytes, int, int]] = []
+        (the target chunk size). -> (code, data, commit_ver, crc, aux)."""
+        out = []
         for chunk_id, offset, length in items:
             try:
-                data, ver, crc = self.read_verified(chunk_id, offset, length)
-                out.append((Code.OK, data, ver, crc))
+                data, ver, crc, aux = self.read_verified(
+                    chunk_id, offset, length)
+                out.append((Code.OK, data, ver, crc, aux))
             except FsError as e:
-                out.append((e.code, b"", 0, 0))
+                out.append((e.code, b"", 0, 0, 0))
         return out
 
 
@@ -187,6 +198,7 @@ class _Slot:
     meta: ChunkMeta
     committed: bytes = b""
     pending: Optional[bytes] = None
+    aux_pending: int = 0
 
 
 class MemChunkEngine(ChunkEngine):
@@ -230,7 +242,7 @@ class MemChunkEngine(ChunkEngine):
                 crc = meta.checksum.value       # checksum reuse
             else:
                 crc = Checksum.of(data).value
-            return data, meta.committed_ver, crc
+            return data, meta.committed_ver, crc, meta.aux
 
     # -- updates (COW + version algebra) -------------------------------------
     def update(
@@ -243,6 +255,8 @@ class MemChunkEngine(ChunkEngine):
         *,
         full_replace: bool = False,
         chunk_size: int,
+        aux: int = 0,
+        expected_crc: Optional[int] = None,
     ) -> ChunkMeta:
         if offset + len(data) > chunk_size:
             raise _err(Code.INVALID_ARG, "write exceeds chunk size")
@@ -271,6 +285,24 @@ class MemChunkEngine(ChunkEngine):
                         Code.CHUNK_MISSING_UPDATE,
                         f"update {update_ver} > committed {cv}+1",
                     )
+            checked: Optional[Checksum] = None
+            if expected_crc is not None:
+                if full_replace or slot is None or not slot.committed:
+                    content = data if (offset == 0 and isinstance(
+                        data, bytes)) else (
+                        b"\x00" * offset + bytes(data))
+                else:
+                    merged = bytearray(slot.committed)
+                    if offset + len(data) > len(merged):
+                        merged.extend(
+                            b"\x00" * (offset + len(data) - len(merged)))
+                    merged[offset:offset + len(data)] = data
+                    content = bytes(merged)
+                checked = Checksum.of(content)
+                if checked.value != (expected_crc & 0xFFFFFFFF):
+                    raise _err(
+                        Code.CHUNK_CHECKSUM_MISMATCH,
+                        "validated install: content crc mismatch")
             if slot is None:
                 slot = _Slot(ChunkMeta(chunk_id, chain_ver))
                 self._chunks[key] = slot
@@ -284,9 +316,13 @@ class MemChunkEngine(ChunkEngine):
                 meta.pending_ver = 0
                 meta.chain_ver = chain_ver
                 meta.length = len(data)
-                meta.checksum = Checksum.of(slot.committed)
+                # reuse the validation checksum when offset==0 covered it
+                meta.checksum = (checked if checked is not None and offset == 0
+                                 else Checksum.of(slot.committed))
                 meta.pending_length = 0
                 meta.pending_checksum = Checksum()
+                meta.aux = aux
+                slot.aux_pending = 0
                 return replace(meta)
             # COW: base is committed content (re-applying the same pending
             # update is idempotent)
@@ -304,6 +340,7 @@ class MemChunkEngine(ChunkEngine):
             meta.chain_ver = chain_ver
             meta.pending_length = len(slot.pending)
             meta.pending_checksum = Checksum.of(slot.pending)
+            slot.aux_pending = aux
             return replace(meta)
 
     def commit(self, chunk_id: ChunkId, ver: int, chain_ver: int) -> ChunkMeta:
@@ -330,6 +367,8 @@ class MemChunkEngine(ChunkEngine):
             meta.checksum = meta.pending_checksum
             meta.pending_length = 0
             meta.pending_checksum = Checksum()
+            meta.aux = slot.aux_pending
+            slot.aux_pending = 0
             return replace(meta)
 
     # -- maintenance ---------------------------------------------------------
@@ -352,6 +391,8 @@ class MemChunkEngine(ChunkEngine):
             meta.checksum = Checksum.of(slot.committed)
             meta.pending_length = 0
             meta.pending_checksum = Checksum()
+            meta.aux = 0
+            slot.aux_pending = 0
             return replace(meta)
 
     def query(self, prefix: bytes) -> List[ChunkMeta]:

@@ -33,6 +33,7 @@ _ERR_TO_CODE = {
     -6: Code.ENGINE_ERROR,
     -7: Code.INVALID_ARG,
     -8: Code.NO_SPACE,
+    -9: Code.CHUNK_CHECKSUM_MISMATCH,
 }
 
 _KEYLEN = 12
@@ -47,6 +48,7 @@ class _CMeta(ctypes.Structure):
         ("crc", ctypes.c_uint32),
         ("pending_length", ctypes.c_uint32),
         ("pending_crc", ctypes.c_uint32),
+        ("aux", ctypes.c_uint32),
         ("key", ctypes.c_uint8 * _KEYLEN),
     ]
 
@@ -59,7 +61,7 @@ class _CUpOp(ctypes.Structure):
         ("offset", ctypes.c_uint32),
         ("data_len", ctypes.c_uint32),
         ("chunk_size", ctypes.c_uint32),
-        ("pad1", ctypes.c_uint32),
+        ("aux", ctypes.c_uint32),
         ("data_off", ctypes.c_uint64),
         ("update_ver", ctypes.c_uint64),
     ]
@@ -70,7 +72,7 @@ class _COpResult(ctypes.Structure):
         ("rc", ctypes.c_int32),
         ("len", ctypes.c_uint32),
         ("crc", ctypes.c_uint32),
-        ("pad0", ctypes.c_uint32),
+        ("aux", ctypes.c_uint32),
         ("ver", ctypes.c_uint64),
     ]
 
@@ -108,7 +110,7 @@ def _load_lib():
         lib.ce_update.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
             ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int,
-            ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int, ctypes.c_uint32,
         ]
         lib.ce_commit.restype = ctypes.c_int
         lib.ce_commit.argtypes = [
@@ -168,7 +170,7 @@ def _load_lib():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
         ]
         _lib = lib
         return lib
@@ -191,6 +193,7 @@ def _meta_from_c(m: _CMeta) -> ChunkMeta:
         checksum=Checksum(m.crc, m.length),
         pending_length=m.pending_length,
         pending_checksum=Checksum(m.pending_crc, m.pending_length),
+        aux=m.aux,
     )
 
 
@@ -243,16 +246,17 @@ class NativeChunkEngine(ChunkEngine):
         out_len = ctypes.c_int64()
         out_ver = ctypes.c_uint64()
         out_crc = ctypes.c_uint32()
+        out_aux = ctypes.c_uint32()
         # data + commit_ver + crc read under ONE engine mutex hold: the
         # reply can never pair one version's bytes with another's checksum
         rc = self._lib.ce_read2(
             self._h, chunk_id.to_bytes(), buf, max(cap, 1), offset, length,
             ctypes.byref(out_len), ctypes.byref(out_ver),
-            ctypes.byref(out_crc),
+            ctypes.byref(out_crc), ctypes.byref(out_aux),
         )
         _check(rc, "read_verified")
         data = ctypes.string_at(ctypes.addressof(buf), out_len.value)
-        return data, out_ver.value, out_crc.value
+        return data, out_ver.value, out_crc.value, out_aux.value
 
     def pending_content(self, chunk_id: ChunkId) -> bytes:
         out = _CMeta()
@@ -279,10 +283,14 @@ class NativeChunkEngine(ChunkEngine):
         *,
         full_replace: bool = False,
         chunk_size: int,
+        aux: int = 0,
+        expected_crc: Optional[int] = None,
     ) -> ChunkMeta:
         rc = self._lib.ce_update(
             self._h, chunk_id.to_bytes(), update_ver, chain_ver,
             bytes(data), len(data), offset, int(full_replace), chunk_size,
+            aux, int(expected_crc is not None),
+            (expected_crc or 0) & 0xFFFFFFFF,
         )
         _check(rc, "update")
         return self.get_meta(chunk_id)
@@ -340,6 +348,7 @@ class NativeChunkEngine(ChunkEngine):
             c.offset = op.offset
             c.data_len = len(op.data)
             c.chunk_size = op.chunk_size
+            c.aux = op.aux
             c.data_off = blob_off
             c.update_ver = op.update_ver
             parts.append(op.data)
@@ -408,12 +417,23 @@ class NativeChunkEngine(ChunkEngine):
         out = []
         for i in range(n):
             r = res[i]
+            if r.rc == -10:
+                # committed content outgrew the per-op cap: re-read this op
+                # alone with an exact-size buffer (matches mem engine and
+                # the per-op path byte-for-byte)
+                try:
+                    chunk_id, offset, length = items[i]
+                    out.append((Code.OK,) + self.read_verified(
+                        chunk_id, offset, length))
+                except FsError as e:
+                    out.append((e.code, b"", 0, 0, 0))
+                continue
             if r.rc != 0:
                 out.append((_ERR_TO_CODE.get(r.rc, Code.ENGINE_ERROR),
-                            b"", 0, 0))
+                            b"", 0, 0, 0))
                 continue
             data = ctypes.string_at(base + c_ops[i].out_off, r.len)
-            out.append((Code.OK, data, r.ver, r.crc))
+            out.append((Code.OK, data, r.ver, r.crc, r.aux))
         return out
 
     def close(self) -> None:

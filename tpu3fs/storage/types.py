@@ -84,6 +84,9 @@ class ChunkMeta:
     # into Python (ref StorageOperator.cc:464-482)
     pending_length: int = 0
     pending_checksum: Checksum = field(default_factory=Checksum)
+    # opaque per-chunk tag promoted with the content at commit; the EC
+    # stripe path stores the stripe's logical (pre-padding) byte length
+    aux: int = 0
 
 
 @dataclass
