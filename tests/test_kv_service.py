@@ -15,7 +15,9 @@ import pytest
 
 from tpu3fs.kv.kv import with_transaction
 from tpu3fs.kv.remote import RemoteKVEngine
-from tpu3fs.kv.service import KvService, bind_kv_service
+from tpu3fs.kv.service import (CommitReq, KvService, SnapshotReq,
+                               StampEntry, WriteEntry,
+                               bind_kv_service)
 from tpu3fs.meta.store import ChainAllocator, MetaStore
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.utils.result import Code, FsError
@@ -296,3 +298,87 @@ class TestDurabilityRegressions:
         got = [p.key for p in txn.get_range(b"L", b"M", limit=3)]
         assert got == [b"L00", b"L00x", b"L01"]
         txn.cancel()
+
+
+class TestWalCompaction:
+    """Round-3: the kvd WAL is bounded (snapshot + tail replay) and a
+    kill -9 style abandon + restart resumes with full state (round-2
+    missing #4; the role FDB's own storage plays in the reference)."""
+
+    def test_wal_bounded_under_sustained_commits(self, tmp_path):
+        wal = str(tmp_path / "kv.wal")
+        svc = KvService(wal_path=wal, compact_min_bytes=16 << 10)
+        # sustained overwrite load on a SMALL key set: an append-only log
+        # would grow ~1000x the live-data size
+        for round_ in range(40):
+            for i in range(25):
+                svc.commit(CommitReq(
+                    read_version=svc.snapshot(SnapshotReq()).version,
+                    writes=[WriteEntry(b"key%d" % i, b"v" * 64, False)]))
+        live = 25 * (64 + 8)
+        size = os.path.getsize(wal)
+        # bounded: within compaction threshold territory, not O(commits)
+        assert size < 4 * (16 << 10) + 4 * live, size
+        svc.close()
+        # snapshot+tail replay restores exactly the live state
+        svc2 = KvService(wal_path=wal)
+        try:
+            for i in range(25):
+                assert svc2.engine.read_at(
+                    b"key%d" % i, svc2.engine.version) == b"v" * 64
+        finally:
+            svc2.close()
+
+    def test_kill9_midload_restart_resumes(self, tmp_path):
+        """Abandon the service WITHOUT close() (kill -9 analogue: the WAL
+        fd is never flushed/closed gracefully beyond per-commit flush),
+        then restart and keep committing."""
+        wal = str(tmp_path / "kv.wal")
+        svc = KvService(wal_path=wal, compact_min_bytes=8 << 10)
+        for i in range(200):
+            svc.commit(CommitReq(
+                read_version=svc.snapshot(SnapshotReq()).version,
+                writes=[WriteEntry(b"k%04d" % i, b"x" * 32, False)]))
+        # NO close(): the handle is simply dropped
+        del svc
+        svc2 = KvService(wal_path=wal, compact_min_bytes=8 << 10)
+        try:
+            for i in range(200):
+                assert svc2.engine.read_at(
+                    b"k%04d" % i, svc2.engine.version) == b"x" * 32
+            # the cluster keeps going: new commits apply and survive
+            svc2.commit(CommitReq(
+                read_version=svc2.snapshot(SnapshotReq()).version,
+                writes=[WriteEntry(b"after", b"restart", False)]))
+        finally:
+            svc2.close()
+        svc3 = KvService(wal_path=wal)
+        try:
+            assert svc3.engine.read_at(
+                b"after", svc3.engine.version) == b"restart"
+        finally:
+            svc3.close()
+
+    def test_versionstamp_monotonic_across_compaction_restart(self, tmp_path):
+        """Compaction collapses the log to one record; the engine version
+        must fast-forward on replay or new versionstamped keys would sort
+        BEFORE pre-restart ones."""
+        wal = str(tmp_path / "kv.wal")
+        svc = KvService(wal_path=wal, compact_min_bytes=1)  # compact always
+        for i in range(50):
+            svc.commit(CommitReq(
+                read_version=svc.snapshot(SnapshotReq()).version,
+                versionstamped=[StampEntry(b"VS/", b"", b"n%d" % i)]))
+        v_before = svc.engine.version
+        svc.close()
+        svc2 = KvService(wal_path=wal, compact_min_bytes=1)
+        try:
+            assert svc2.engine.version >= v_before
+            svc2.commit(CommitReq(
+                read_version=svc2.snapshot(SnapshotReq()).version,
+                versionstamped=[StampEntry(b"VS/", b"", b"post")]))
+            pairs = svc2.engine.range_at(b"VS/", b"VS0", svc2.engine.version)
+            assert pairs[-1][1] == b"post"   # newest stamp sorts LAST
+            assert len(pairs) == 51
+        finally:
+            svc2.close()

@@ -214,3 +214,36 @@ class TestRingBackpressure:
             assert ring.prep_io(0, 1, 0, 1, read=True, userdata=200) >= 0
         finally:
             ring.close(unlink=True)
+
+
+class TestReadInto:
+    """read_into: replies land directly in a caller buffer (the zero-copy
+    USRBIO read path) with read()-identical hole/EOF semantics."""
+
+    def test_read_into_matches_read_with_holes(self):
+        from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+        from tpu3fs.meta.store import OpenFlags
+
+        fab = Fabric(SystemSetupConfig(num_chains=2, chunk_size=4096))
+        fio = fab.file_client()
+        res = fab.meta.create("/ri", flags=OpenFlags.WRITE, client_id="c")
+        # chunk 0 written, chunk 1 is a hole, chunk 2 short
+        fio.write(res.inode, 0, b"A" * 4096)
+        fio.write(res.inode, 8192, b"B" * 100)
+        inode = fab.meta.stat("/ri")
+        want = fio.read(inode, 0, 3 * 4096)
+        buf = bytearray(3 * 4096)
+        n = fio.read_into(inode, 0, 3 * 4096, memoryview(buf))
+        assert bytes(buf[:n]) == want
+        # EC files take the same path
+        fab2 = Fabric(SystemSetupConfig(
+            num_storage_nodes=4, num_chains=1, chunk_size=12 << 10,
+            ec_k=3, ec_m=1))
+        fio2 = fab2.file_client()
+        res2 = fab2.meta.create("/ri2", flags=OpenFlags.WRITE, client_id="c")
+        payload = bytes(range(256)) * 96         # 2 stripes
+        fio2.write(res2.inode, 0, payload)
+        inode2 = fab2.meta.stat("/ri2")
+        buf2 = bytearray(len(payload))
+        n2 = fio2.read_into(inode2, 0, len(payload), memoryview(buf2))
+        assert n2 == len(payload) and bytes(buf2) == payload

@@ -39,6 +39,9 @@ class KvApp(OnePhaseApplication):
         self.service = KvService(
             wal_path=wal,
             snapshot_ttl_s=self.config.get("snapshot_ttl_s"),
+            compact_min_bytes=int(
+                self.flag("compact-min-bytes", 4 << 20) or (4 << 20)),
+            fsync=bool(int(self.flag("fsync", 0) or 0)),
         )
         bind_kv_service(server, self.service)
         self.config.add_callback(

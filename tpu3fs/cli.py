@@ -433,12 +433,18 @@ def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
     cluster via --connect HOST:PORT (operator mode)."""
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "--connect":
-        host, port = argv[1].rsplit(":", 1)
-        token = ""
-        rest = argv[2:]
-        if rest[:1] == ["--token"]:
-            token, rest = rest[1], rest[2:]
-        cli = AdminCli(RpcFabricView((host, int(port)), token=token))
+        usage = "usage: cli --connect HOST:PORT [--token TOKEN] [command...]"
+        try:
+            host, port_s = argv[1].rsplit(":", 1)
+            port = int(port_s)
+            token = ""
+            rest = argv[2:]
+            if rest[:1] == ["--token"]:
+                token, rest = rest[1], rest[2:]
+        except (IndexError, ValueError):
+            print(usage, file=sys.stderr)
+            return 2
+        cli = AdminCli(RpcFabricView((host, port), token=token))
         argv = rest
     else:
         from tpu3fs.fabric import Fabric

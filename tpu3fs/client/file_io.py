@@ -9,7 +9,7 @@ Also provides the precise-length callback used by meta close/fsync
 
 from __future__ import annotations
 
-from typing import Iterable, List, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 from tpu3fs.client.storage_client import StorageClient
 from tpu3fs.meta.types import Inode, Layout
@@ -49,45 +49,61 @@ class FileIoClient:
 
     def write(self, inode: Inode, offset: int, data: bytes) -> int:
         """Write a byte range. Chunk ops are BATCHED, not issued one at a
-        time: CR chunks go through StorageClient.batch_write (one request
-        per node, ref StorageClientImpl.cc:1030,1771) and full EC stripes
-        through write_stripes (ONE device encode for the whole span + one
-        BatchShardWrite per node). Only boundary partial-stripe EC writes
-        take the read-modify-write path. Chunks in one call are distinct,
-        so issue order does not affect the result."""
+        time: consecutive CR chunks go through StorageClient.batch_write
+        (one request per node, ref StorageClientImpl.cc:1030,1771) and
+        consecutive full EC stripes through write_stripes (ONE device
+        encode for the run + one BatchShardWrite per node); boundary
+        partial-stripe EC writes take the read-modify-write path. Runs
+        flush in FILE ORDER, so a failure always leaves a clean written
+        prefix of whole runs — never new data after a hole (within a run
+        the batch may land partially, as in the reference's batch APIs)."""
         layout = inode.layout
         assert layout is not None, "write() needs a file inode with layout"
         cs = layout.chunk_size
-        cr_writes: List[Tuple[int, ChunkId, int, bytes]] = []
-        ec_full: dict = {}   # chain_id -> [(ChunkId, bytes)]
-        ec_partial: List[Tuple[int, int, int, bytes]] = []
+
+        def flush(kind, run) -> None:
+            if not run:
+                return
+            if kind == "cr":
+                for reply in self._storage.batch_write(run, chunk_size=cs):
+                    if not reply.ok:
+                        raise FsError(Status(reply.code, reply.message))
+            elif kind == "ec_full":
+                chain_id = run[0][0]
+                items = [(cid, part) for _, cid, part in run]
+                for reply in self._storage.write_stripes(
+                        chain_id, items, chunk_size=cs):
+                    if not reply.ok:
+                        raise FsError(Status(reply.code, reply.message))
+            else:  # ec_partial
+                for chain_id, idx, in_off, part in run:
+                    reply = self._write_ec_chunk(
+                        inode, chain_id, idx, in_off, part, cs)
+                    if not reply.ok:
+                        raise FsError(Status(reply.code, reply.message))
+
         pos = 0
+        kind: Optional[str] = None
+        run: list = []
         for idx, chain_id, in_off, n in self._split(layout, offset, len(data)):
             part = data[pos : pos + n]
             pos += n
             if self._is_ec(chain_id):
                 if in_off == 0 and n == cs:
-                    ec_full.setdefault(chain_id, []).append(
-                        (ChunkId(inode.id, idx), part))
+                    seg_kind, seg = "ec_full", (chain_id,
+                                                ChunkId(inode.id, idx), part)
                 else:
-                    ec_partial.append((chain_id, idx, in_off, part))
+                    seg_kind, seg = "ec_partial", (chain_id, idx, in_off, part)
             else:
-                cr_writes.append((chain_id, ChunkId(inode.id, idx),
-                                  in_off, part))
-        if cr_writes:
-            for reply in self._storage.batch_write(cr_writes, chunk_size=cs):
-                if not reply.ok:
-                    raise FsError(Status(reply.code, reply.message))
-        for chain_id, items in ec_full.items():
-            for reply in self._storage.write_stripes(
-                    chain_id, items, chunk_size=cs):
-                if not reply.ok:
-                    raise FsError(Status(reply.code, reply.message))
-        for chain_id, idx, in_off, part in ec_partial:
-            reply = self._write_ec_chunk(
-                inode, chain_id, idx, in_off, part, cs)
-            if not reply.ok:
-                raise FsError(Status(reply.code, reply.message))
+                seg_kind, seg = "cr", (chain_id, ChunkId(inode.id, idx),
+                                       in_off, part)
+            breaks_run = seg_kind != kind or (
+                seg_kind == "ec_full" and run and run[0][0] != chain_id)
+            if breaks_run:
+                flush(kind, run)
+                kind, run = seg_kind, []
+            run.append(seg)
+        flush(kind, run)
         return len(data)
 
     def _write_ec_chunk(self, inode: Inode, chain_id: int, idx: int,
@@ -169,6 +185,49 @@ class FileIoClient:
             for idx, chain_id, in_off, n in self._split(layout, offset, size)
         )
         return self._assemble(inode, pairs, size)
+
+    def read_into(self, inode: Inode, offset: int, size: int,
+                  dest) -> int:
+        """Read a byte range DIRECTLY into a caller-owned buffer (memoryview
+        over registered shm): chunk replies are written at their slots with
+        no intermediate assembly, and the chunk ops ride ONE node-grouped
+        batch_read — the USRBIO zero-copy read path (the reference
+        RDMA-WRITEs results into the user's registered iov,
+        StorageOperator.cc:176-226). Returns bytes filled (short at EOF);
+        holes and short chunks zero-fill their slots."""
+        from tpu3fs.client.storage_client import ReadReq
+
+        layout = inode.layout
+        assert layout is not None
+        if inode.length:
+            size = max(0, min(size, inode.length - offset))
+        if size == 0:
+            return 0
+        segs = self._split(layout, offset, size)
+        reqs = [
+            ReadReq(chain_id, ChunkId(inode.id, idx), in_off, n,
+                    chunk_size=layout.chunk_size)
+            for idx, chain_id, in_off, n in segs
+        ]
+        replies = self._storage.batch_read(reqs)
+        pos = 0
+        any_data = False
+        for (idx, chain_id, in_off, n), reply in zip(segs, replies):
+            slot = dest[pos:pos + n]
+            if reply.code == Code.CHUNK_NOT_FOUND:
+                slot[:] = b"\x00" * n           # hole
+            elif not reply.ok:
+                raise FsError(Status(reply.code))
+            else:
+                any_data = True
+                got = reply.data[:n]
+                slot[:len(got)] = got
+                if len(got) < n:
+                    slot[len(got):] = b"\x00" * (n - len(got))
+            pos += n
+        if not any_data and inode.length == 0:
+            return 0
+        return size
 
     def batch_read_files(
         self, files: List[Tuple[Inode, int, int]]

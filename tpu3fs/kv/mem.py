@@ -75,6 +75,24 @@ class MemKVEngine(IKVEngine):
     def version(self) -> int:
         return self._version
 
+    def dump_at(self, version: int) -> List[Tuple[bytes, bytes]]:
+        """All live (key, value) pairs at a snapshot version — feeds the
+        network KV service's WAL compaction (replay = snapshot + tail)."""
+        with self._lock:
+            out = []
+            for key in list(self._sorted_keys):
+                val = self._resolve(key, version)
+                if val is not None:
+                    out.append((key, val))
+            return out
+
+    def restore_version_floor(self, version: int) -> None:
+        """Fast-forward the version counter (never backwards): a restarted
+        service replaying a compacted WAL must not reissue version numbers
+        (versionstamped keys depend on monotonicity across restarts)."""
+        with self._lock:
+            self._version = max(self._version, version)
+
     # -- external transaction surface (shared by MemTransaction and the
     # network KV service: one conflict-check + atomic-apply path) ----------
     def pin_version(self, token, version: int) -> None:

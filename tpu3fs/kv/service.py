@@ -11,7 +11,12 @@ concurrency, same retry loop as local transactions).
 
 Durability: an optional write-ahead log records every applied commit; on
 restart the service replays it into a fresh engine (the reference gets this
-from FDB itself).
+from FDB itself). The WAL is BOUNDED: when it outgrows
+max(compact_min_bytes, 4x the last snapshot), it is rewritten as one
+snapshot record (the full live dump at the current version) — replay is
+then snapshot + tail, and sustained commit load cannot grow the log or the
+restart time without bound. The snapshot record carries the commit version
+so versionstamp monotonicity survives restarts.
 """
 
 from __future__ import annotations
@@ -128,6 +133,9 @@ class WalRecord:
     version: int = 0
     writes: List[WriteEntry] = field(default_factory=list)
     clear_ranges: List[RangeEntry] = field(default_factory=list)
+    # true on the snapshot record a compaction writes: `writes` is the FULL
+    # live dump at `version`, and replay fast-forwards the engine version
+    snapshot: bool = False
 
 
 class KvService:
@@ -135,7 +143,9 @@ class KvService:
 
     def __init__(self, engine: Optional[MemKVEngine] = None, *,
                  wal_path: Optional[str] = None,
-                 snapshot_ttl_s: float = _SNAPSHOT_TTL_S):
+                 snapshot_ttl_s: float = _SNAPSHOT_TTL_S,
+                 compact_min_bytes: int = 4 << 20,
+                 fsync: bool = False):
         # NOTE: set_snapshot_ttl supports hot config updates
         self.engine = engine or MemKVEngine()
         self._ttl = snapshot_ttl_s
@@ -144,6 +154,10 @@ class KvService:
         self._next_token = 1
         self._wal_path = wal_path
         self._wal = None
+        self._fsync = fsync
+        self._compact_min_bytes = compact_min_bytes
+        self._wal_bytes = 0
+        self._snap_bytes = 0
         # serializes commit_external + WAL append so file order == version
         # order (RpcServer dispatches concurrently)
         self._commit_lock = threading.Lock()
@@ -157,6 +171,8 @@ class KvService:
                 with open(wal_path, "r+b") as f:
                     f.truncate(valid)
             self._wal = open(wal_path, "ab")
+            self._wal_bytes = os.path.getsize(wal_path)
+            self._snap_bytes = self._wal_bytes
         # snapshots below the floor may reference pruned MVCC history:
         # reject them with KV_TXN_TOO_OLD instead of silently misreading
         self._floor = self.engine.version
@@ -184,6 +200,9 @@ class KvService:
             clears = [(r.begin, r.end) for r in rec.clear_ranges]
             self.engine.commit_external(
                 self.engine.version, [], [], writes, clears, [])
+            if rec.snapshot:
+                # versionstamped keys must stay monotonic across restarts
+                self.engine.restore_version_floor(rec.version)
             pos += 4 + n
         return pos
 
@@ -201,6 +220,39 @@ class KvService:
         raw = serialize(rec)
         self._wal.write(len(raw).to_bytes(4, "big") + raw)
         self._wal.flush()
+        if self._fsync:
+            os.fsync(self._wal.fileno())
+        self._wal_bytes += 4 + len(raw)
+
+    def _maybe_compact(self) -> None:
+        """Caller holds _commit_lock. Rewrite the WAL as ONE snapshot
+        record when it outgrows max(compact_min_bytes, 4x last snapshot):
+        replay becomes snapshot + tail, and sustained commits cannot grow
+        the log without bound (the role RocksDB compaction / FDB's own
+        storage plays in the reference)."""
+        if self._wal is None:
+            return
+        if self._wal_bytes <= max(self._compact_min_bytes,
+                                  4 * self._snap_bytes):
+            return
+        version = self.engine.version
+        pairs = self.engine.dump_at(version)
+        rec = WalRecord(
+            version=version,
+            writes=[WriteEntry(k, v, False) for k, v in pairs],
+            snapshot=True,
+        )
+        raw = serialize(rec)
+        tmp = self._wal_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(len(raw).to_bytes(4, "big") + raw)
+            f.flush()
+            os.fsync(f.fileno())
+        self._wal.close()
+        os.replace(tmp, self._wal_path)   # atomic swap: old WAL or new, never half
+        self._wal = open(self._wal_path, "ab")
+        self._wal_bytes = os.path.getsize(self._wal_path)
+        self._snap_bytes = self._wal_bytes
 
     # -- snapshot pinning ----------------------------------------------------
     def _sweep_pins(self, now: float) -> None:
@@ -290,6 +342,7 @@ class KvService:
                         stamp = _struct.pack(">QH", version, order)
                         writes[prefix + stamp + suffix] = value
                 self._wal_append(version, writes, clears)
+                self._maybe_compact()
         return CommitRsp(version=version)
 
     def release(self, req: ReleaseReq) -> EmptyMsg:

@@ -67,7 +67,7 @@ class PlacementProblem:
         import math
 
         total = self.targets_per_node * self.recovery_traffic_factor
-        return math.ceil(total / (self.num_nodes - 1))
+        return math.ceil(total / max(self.num_nodes - 1, 1))
 
     @property
     def lambda_lower_bound(self) -> int:
@@ -119,8 +119,9 @@ def solve_placement(
         problem.targets_per_node,
     )
     M = _greedy_incidence(problem).astype(np.int8)
-    if max_peer_traffic is not None:
-        # traffic per co-occurrence = factor / (k-1)
+    if max_peer_traffic is not None and k > 1:
+        # traffic per co-occurrence = factor / (k-1); k=1 groups have no
+        # peer traffic at all, so any bound is trivially satisfied
         per_cooc = problem.recovery_traffic_factor / (k - 1)
         traffic_tgt = int(max_peer_traffic / per_cooc)
         target_lambda = (min(target_lambda, traffic_tgt)
@@ -236,7 +237,9 @@ def peer_recovery_traffic(
     streams its shard (factor (k-1)/(k-1) = 1 per co-occurrence); for CR
     one full-chunk copy spreads over the k-1 peers (1/(k-1) each)."""
     row = recovery_traffic_factor(M, node).astype(np.float64)
-    return row * problem.recovery_traffic_factor / (problem.group_size - 1)
+    # group_size=1 has no peers inside a group: factor is 0, traffic is 0
+    return (row * problem.recovery_traffic_factor
+            / max(problem.group_size - 1, 1))
 
 
 def gen_chain_table_commands(

@@ -148,9 +148,12 @@ class UsrbioAgent:
                 # refresh length so EOF clamping sees recent writes
                 fresh = self._meta.batch_stat([inode.id])[0]
                 src = fresh if fresh is not None else inode
-                data = self._fio.read(src, sqe.file_offset, sqe.length)
-                iov.write(sqe.iov_offset, data)
-                return len(data)
+                # replies land directly in the registered shm window — no
+                # assembly buffer, no iov copy (round-2 weak: zero-copy
+                # reads into usrbio iovs)
+                return self._fio.read_into(
+                    src, sqe.file_offset, sqe.length,
+                    iov.view(sqe.iov_offset, sqe.length))
             data = iov.read(sqe.iov_offset, sqe.length)
             # flag before issuing so a close_fd racing this write still
             # sees the session as written

@@ -59,6 +59,12 @@ class Iov:
     def read(self, offset: int, length: int) -> bytes:
         return bytes(self.buf[offset : offset + length])
 
+    def view(self, offset: int, length: int) -> memoryview:
+        """Writable window over the registered shm: storage read replies
+        land HERE directly (the RDMA-WRITE-into-user-memory analogue,
+        ref StorageOperator.cc:176-226), no intermediate assembly buffer."""
+        return memoryview(self.buf)[offset : offset + length]
+
     def close(self, unlink: bool = False) -> None:
         self.buf.close()
         if unlink:
