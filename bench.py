@@ -153,19 +153,27 @@ def _bench_worker(platform: str) -> None:
 
 def _probe_platform() -> tuple:
     """-> (platform | None, error detail). Bounded: a dead TPU tunnel makes
-    jax.devices() hang forever, so the probe runs in a killable child."""
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"backend init exceeded {PROBE_TIMEOUT_S}s (tunnel down?)"
-    if out.returncode != 0:
-        return None, (out.stderr or out.stdout).strip()[-300:]
-    return out.stdout.strip().splitlines()[-1], ""
+    jax.devices() hang forever, so the probe runs in a killable child.
+    RETRIED with a doubled budget — a slow-to-establish tunnel must not
+    cost the round its only TPU capture (round-2 verdict ask #9)."""
+    last_err = ""
+    for attempt, budget in enumerate((PROBE_TIMEOUT_S, 2 * PROBE_TIMEOUT_S)):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=budget,
+                cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            )
+        except subprocess.TimeoutExpired:
+            last_err = (f"backend init exceeded {budget}s "
+                        f"(attempt {attempt + 1}/2; tunnel down?)")
+            continue
+        if out.returncode != 0:
+            last_err = (out.stderr or out.stdout).strip()[-300:]
+            continue
+        return out.stdout.strip().splitlines()[-1], ""
+    return None, last_err
 
 
 def main() -> None:
@@ -205,11 +213,17 @@ def main() -> None:
             "detail": (out.stderr or out.stdout).strip()[-400:],
         }))
         return
-    if fallback_note:
-        rec = json.loads(line)
-        rec["error"] = fallback_note
-        line = json.dumps(rec)
-    print(line)
+    rec = json.loads(line)
+    # headline fields must be impossible to misread as a TPU capture:
+    # ok=false + null vs_baseline on any non-TPU run (advisor round-2),
+    # with the raw CPU number preserved under cpu_fallback_value
+    rec["ok"] = rec.get("platform") in ("tpu", "TPU")
+    if not rec["ok"]:
+        rec["cpu_fallback_value"] = rec.get("value")
+        rec["vs_baseline"] = None
+        if fallback_note:
+            rec["error"] = fallback_note
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

@@ -29,7 +29,10 @@
 #include <vector>
 
 #include <fcntl.h>
+#include <linux/io_uring.h>
+#include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <sys/types.h>
 #include <unistd.h>
 
@@ -259,6 +262,166 @@ struct SizeClass {
   }
 };
 
+// ---- io_uring batch reader -------------------------------------------------
+// Raw-syscall io_uring (no liburing in this image): the AioReadWorker role
+// (ref src/storage/aio/AioReadWorker.h:19-50 — libaio/io_uring, registered
+// FDs). Batched reads submit one SQE per op and reap completions in one
+// io_uring_enter; the engine's size-class FDs are registered once
+// (IORING_REGISTER_FILES) so the kernel skips the per-op fd lookup.
+// Unavailable (seccomp, old kernel) => callers fall back to sync pread.
+struct Uring {
+  int fd = -1;
+  unsigned sq_entries = 0;
+  unsigned *sq_head = nullptr, *sq_tail = nullptr, *sq_mask = nullptr;
+  unsigned *sq_array = nullptr;
+  unsigned *cq_head = nullptr, *cq_tail = nullptr, *cq_mask = nullptr;
+  io_uring_sqe* sqes = nullptr;
+  io_uring_cqe* cqes = nullptr;
+  void* sq_ptr = nullptr;
+  void* cq_ptr = nullptr;
+  size_t sq_len = 0, cq_len = 0, sqes_len = 0;
+  bool fixed_files = false;
+
+  bool init(unsigned entries, const int* files, unsigned nfiles) {
+    io_uring_params p{};
+    fd = static_cast<int>(syscall(__NR_io_uring_setup, entries, &p));
+    if (fd < 0) return false;
+    sq_len = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+    cq_len = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+    bool single = p.features & IORING_FEAT_SINGLE_MMAP;
+    if (single) sq_len = cq_len = std::max(sq_len, cq_len);
+    sq_ptr = mmap(nullptr, sq_len, PROT_READ | PROT_WRITE,
+                  MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
+    if (sq_ptr == MAP_FAILED) return fail();
+    cq_ptr = single ? sq_ptr
+                    : mmap(nullptr, cq_len, PROT_READ | PROT_WRITE,
+                           MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_CQ_RING);
+    if (cq_ptr == MAP_FAILED) return fail();
+    sqes_len = p.sq_entries * sizeof(io_uring_sqe);
+    sqes = static_cast<io_uring_sqe*>(
+        mmap(nullptr, sqes_len, PROT_READ | PROT_WRITE,
+             MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES));
+    if (sqes == MAP_FAILED) return fail();
+    auto at = [](void* base, unsigned off) {
+      return reinterpret_cast<unsigned*>(static_cast<char*>(base) + off);
+    };
+    sq_head = at(sq_ptr, p.sq_off.head);
+    sq_tail = at(sq_ptr, p.sq_off.tail);
+    sq_mask = at(sq_ptr, p.sq_off.ring_mask);
+    sq_array = at(sq_ptr, p.sq_off.array);
+    cq_head = at(cq_ptr, p.cq_off.head);
+    cq_tail = at(cq_ptr, p.cq_off.tail);
+    cq_mask = at(cq_ptr, p.cq_off.ring_mask);
+    cqes = reinterpret_cast<io_uring_cqe*>(
+        static_cast<char*>(cq_ptr) + p.cq_off.cqes);
+    sq_entries = p.sq_entries;
+    if (files && nfiles &&
+        syscall(__NR_io_uring_register, fd, IORING_REGISTER_FILES, files,
+                nfiles) == 0) {
+      fixed_files = true;
+    }
+    return true;
+  }
+
+  bool fail() {
+    shutdown();
+    return false;
+  }
+
+  void shutdown() {
+    if (sqes && sqes != MAP_FAILED) munmap(sqes, sqes_len);
+    if (cq_ptr && cq_ptr != sq_ptr && cq_ptr != MAP_FAILED)
+      munmap(cq_ptr, cq_len);
+    if (sq_ptr && sq_ptr != MAP_FAILED) munmap(sq_ptr, sq_len);
+    sqes = nullptr;
+    sq_ptr = cq_ptr = nullptr;
+    if (fd >= 0) close(fd);
+    fd = -1;
+  }
+
+  struct ReadOp {
+    int file;          // raw fd, or registered index when fixed_files
+    uint8_t* buf;
+    uint32_t len;
+    uint64_t off;
+    int64_t result;    // bytes read or -errno
+  };
+
+  unsigned reap(ReadOp* ops, unsigned n) {
+    unsigned reaped = 0;
+    unsigned chead = __atomic_load_n(cq_head, __ATOMIC_ACQUIRE);
+    unsigned ctail = __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE);
+    while (chead != ctail) {
+      const io_uring_cqe& c = cqes[chead & *cq_mask];
+      if (c.user_data < n) ops[c.user_data].result = c.res;
+      chead++;
+      reaped++;
+    }
+    __atomic_store_n(cq_head, chead, __ATOMIC_RELEASE);
+    return reaped;
+  }
+
+  // submit + reap all ops (waves of sq_entries); returns false on a ring
+  // failure (caller falls back to sync reads). INVARIANT on return: zero
+  // ops in flight — the kernel must never keep async-writing into the
+  // caller's buffers after this returns, so any failure path drains the
+  // submitted ops before reporting it.
+  bool read_batch(ReadOp* ops, unsigned n) {
+    unsigned done = 0;
+    while (done < n) {
+      unsigned wave = std::min(n - done, sq_entries);
+      unsigned tail = __atomic_load_n(sq_tail, __ATOMIC_ACQUIRE);
+      for (unsigned i = 0; i < wave; i++) {
+        unsigned idx = (tail + i) & *sq_mask;
+        io_uring_sqe& e = sqes[idx];
+        memset(&e, 0, sizeof(e));
+        e.opcode = IORING_OP_READ;
+        e.fd = ops[done + i].file;
+        e.addr = reinterpret_cast<uint64_t>(ops[done + i].buf);
+        e.len = ops[done + i].len;
+        e.off = ops[done + i].off;
+        e.user_data = done + i;
+        if (fixed_files) e.flags |= IOSQE_FIXED_FILE;
+        sq_array[idx] = idx;
+      }
+      __atomic_store_n(sq_tail, tail + wave, __ATOMIC_RELEASE);
+      // submit phase: io_uring_enter consumes SQEs; rc >= 0 is the count
+      // consumed (may be partial), rc < 0 consumes nothing
+      unsigned submitted = 0;
+      bool submit_failed = false;
+      while (submitted < wave) {
+        int rc = static_cast<int>(
+            syscall(__NR_io_uring_enter, fd, wave - submitted, 0, 0,
+                    nullptr, 0));
+        if (rc < 0) {
+          if (errno == EINTR) continue;
+          submit_failed = true;
+          break;
+        }
+        submitted += static_cast<unsigned>(rc);
+        if (rc == 0) {
+          submit_failed = true;  // no progress: treat as a ring failure
+          break;
+        }
+      }
+      // reap phase: everything submitted MUST complete before we return,
+      // success or not; GETEVENTS with min_complete blocks until then
+      // (EINTR retried; other errors retried too — abandoning in-flight
+      // reads would let the kernel scribble on freed buffers)
+      unsigned reaped = 0;
+      while (reaped < submitted) {
+        reaped += reap(ops, n);
+        if (reaped >= submitted) break;
+        syscall(__NR_io_uring_enter, fd, 0, submitted - reaped,
+                IORING_ENTER_GETEVENTS, nullptr, 0);
+      }
+      if (submit_failed) return false;  // drained; caller re-reads sync
+      done += wave;
+    }
+    return true;
+  }
+};
+
 int class_for(uint32_t chunk_bytes) {
   if (chunk_bytes == 0) return 0;
   uint32_t need = chunk_bytes;
@@ -282,6 +445,21 @@ struct Engine {
   // overwritten block
   std::vector<std::pair<int8_t, uint32_t>> quarantine;
   std::mutex mu;
+  Uring uring;
+  int uring_state = 0;  // 0 = not probed, 1 = ready, -1 = unavailable
+
+  Uring* get_uring() {
+    if (uring_state == 0) {
+      if (getenv("TPU3FS_NO_URING") != nullptr) {
+        uring_state = -1;
+      } else {
+        int files[kNumClasses];
+        for (int c = 0; c < kNumClasses; c++) files[c] = classes[c].fd;
+        uring_state = uring.init(256, files, kNumClasses) ? 1 : -1;
+      }
+    }
+    return uring_state == 1 ? &uring : nullptr;
+  }
 
   std::string class_path(int c) const {
     return dir + "/data_" + std::to_string(c) + ".bin";
@@ -733,6 +911,7 @@ void* ce_open(const char* dir, int fsync_wal) {
 void ce_close(void* h) {
   auto* e = static_cast<Engine*>(h);
   if (!e) return;
+  e->uring.shutdown();
   e->compact();
   for (int c = 0; c < kNumClasses; c++)
     if (e->classes[c].fd >= 0) close(e->classes[c].fd);
@@ -931,6 +1110,21 @@ int ce_batch_read(void* h, const CReadOp* ops, uint8_t* out, uint64_t cap,
                   COpResult* res, int n) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  // resolve phase: validate each op and turn it into a raw (fd, offset,
+  // len, dest) read under the mutex; the IO phase then runs every read
+  // through ONE io_uring submit/reap (the AioReadWorker analogue) — or a
+  // pread loop when the ring is unavailable
+  struct Pending {
+    int i;
+    uint32_t want;
+    bool full;           // full committed content: CRC reusable
+    uint32_t crc;        // committed crc (for reuse)
+  };
+  std::vector<Uring::ReadOp> rops;
+  std::vector<Pending> pend;
+  rops.reserve(n);
+  pend.reserve(n);
+  Uring* ring = e->get_uring();
   for (int i = 0; i < n; i++) {
     const CReadOp& op = ops[i];
     Key k;
@@ -941,41 +1135,79 @@ int ce_batch_read(void* h, const CReadOp* ops, uint8_t* out, uint64_t cap,
       r.rc = E_INVALID;
       continue;
     }
-    // a chunk whose committed content outgrew the caller's per-op cap must
-    // neither spill into the next op's slot NOR return silently truncated
-    // bytes with a recomputed CRC — report E_RANGE so the caller re-reads
-    // that op with a big-enough buffer
-    {
-      auto pre = e->metas.find(k);
-      if (pre != e->metas.end()) {
-        uint32_t avail = pre->second.committed.length;
-        uint32_t want = op.offset >= avail ? 0
+    auto it = e->metas.find(k);
+    if (it == e->metas.end()) {
+      r.rc = E_NOT_FOUND;
+      continue;
+    }
+    const ChunkMeta& m = it->second;
+    if (m.committed_ver == 0) {
+      r.rc = E_NOT_COMMIT;
+      continue;
+    }
+    uint32_t avail = m.committed.length;
+    uint32_t want = op.offset >= avail
+                        ? 0
                         : (op.length < 0
                                ? avail - op.offset
                                : std::min<uint32_t>(
                                      static_cast<uint32_t>(op.length),
                                      avail - op.offset));
-        if (want > op.slot_len) {
-          r.rc = E_RANGE;
-          continue;
-        }
-      }
+    // a chunk whose committed content outgrew the caller's per-op cap must
+    // neither spill into the next op's slot NOR return silently truncated
+    // bytes with a recomputed CRC — report E_RANGE so the caller re-reads
+    // that op with a big-enough buffer
+    if (want > op.slot_len) {
+      r.rc = E_RANGE;
+      continue;
     }
-    int64_t got = 0;
-    r.rc = e->read(k, out + op.out_off, op.slot_len, op.offset,
-                   op.length, &got);
-    if (r.rc != OK) continue;
-    auto it = e->metas.find(k);
-    const ChunkMeta& m = it->second;
-    r.len = static_cast<uint32_t>(got);
     r.ver = m.committed_ver;
     r.aux = m.aux;
+    if (want == 0) {
+      r.len = 0;
+      r.crc = (op.offset == 0 && avail == 0) ? m.committed.crc
+                                             : crc32c(out, 0);
+      continue;
+    }
+    const SizeClass& sc = e->classes[m.committed.cls];
+    Uring::ReadOp ro{};
+    ro.file = (ring && ring->fixed_files) ? m.committed.cls : sc.fd;
+    ro.buf = out + op.out_off;
+    ro.len = want;
+    ro.off = static_cast<uint64_t>(m.committed.idx) * sc.block_size +
+             op.offset;
+    rops.push_back(ro);
+    pend.push_back({i, want, op.offset == 0 && want == avail,
+                    m.committed.crc});
+  }
+  bool via_ring = ring != nullptr && rops.size() > 1;
+  if (via_ring &&
+      !ring->read_batch(rops.data(), static_cast<unsigned>(rops.size()))) {
+    // ring failure (already drained — no ops in flight): release it and
+    // fall back to sync preads for this and all future batches
+    via_ring = false;
+    e->uring.shutdown();
+    e->uring_state = -1;
+  }
+  for (size_t j = 0; j < rops.size(); j++) {
+    Uring::ReadOp& ro = rops[j];
+    const Pending& pd = pend[j];
+    COpResult& r = res[pd.i];
+    if (!via_ring) {
+      int fd = (ring && ring->fixed_files)
+                   ? e->classes[ro.file].fd   // un-map registered index
+                   : ro.file;
+      ro.result = pread(fd, ro.buf, ro.len, static_cast<off_t>(ro.off));
+    }
+    if (ro.result != static_cast<int64_t>(pd.want)) {
+      r.rc = E_IO;
+      continue;
+    }
+    r.len = pd.want;
     // full-content reads reuse the committed CRC (the checksum-reuse
     // counters of ChunkReplica.cc:24-29); partial reads recompute here,
     // still outside the GIL
-    r.crc = (op.offset == 0 && r.len == m.committed.length)
-                ? m.committed.crc
-                : crc32c(out + op.out_off, r.len);
+    r.crc = pd.full ? pd.crc : crc32c(ro.buf, pd.want);
   }
   return OK;
 }

@@ -1,0 +1,137 @@
+"""Round-3 admin parity: bench/read-bench, verify-checksums,
+find-orphaned-chunks, recursive chown, and the queryable monitor sink
+(ref src/client/cli/admin/{Bench,ReadBench,Checksum,FindOrphanedChunks,
+RecursiveChown}.cc; sink ref ClickHouseClient.cc + 3fs-monitor.sql)."""
+
+import time
+
+import pytest
+
+from tpu3fs.cli import AdminCli
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.meta.store import OpenFlags
+from tpu3fs.monitor.collector import (
+    CollectorService,
+    QueryReq,
+    SampleBatch,
+    bind_collector_service,
+)
+from tpu3fs.monitor.recorder import Sample, SqliteSink
+from tpu3fs.storage.types import ChunkId
+
+
+@pytest.fixture
+def cli():
+    fab = Fabric(SystemSetupConfig(num_chains=2, chunk_size=4096))
+    return AdminCli(fab), fab
+
+
+class TestBenchCommands:
+    def test_bench_then_read_bench(self, cli):
+        c, fab = cli
+        out = c.run("bench --chunks 8 --size 2048")
+        assert "wrote 8/8" in out and "0 failed" in out
+        out = c.run("read-bench --chunks 8")
+        assert "read " in out and "8/8" in out and "0 failed" in out
+
+
+class TestVerifyChecksums:
+    def test_clean_sweep_then_corruption_found(self, cli):
+        c, fab = cli
+        sc = fab.storage_client()
+        for i in range(6):
+            sc.write_chunk(fab.chain_ids[0], ChunkId(70, i), 0,
+                           bytes([i]) * 512, chunk_size=4096)
+        out = c.run("verify-checksums")
+        assert "6 chunks, 0 mismatches" in out
+        # corrupt ONE replica's committed content behind the protocol
+        chain = fab.routing().chains[fab.chain_ids[0]]
+        t = chain.targets[-1]
+        node = fab.routing().node_of_target(t.target_id)
+        eng = fab.nodes[node.node_id].service.target(t.target_id).engine
+        eng.update(ChunkId(70, 0), 99, 1, b"CORRUPT", 0,
+                   full_replace=True, chunk_size=4096)
+        out = c.run("verify-checksums")
+        assert "1 mismatches" in out
+
+
+class TestFindOrphanedChunks:
+    def test_orphans_found_and_removed(self, cli):
+        c, fab = cli
+        fio = fab.file_client()
+        res = fab.meta.create("/real", flags=OpenFlags.WRITE, client_id="c")
+        fio.write(res.inode, 0, b"live" * 100)
+        # orphan: chunks with a file id that has no inode
+        sc = fab.storage_client()
+        sc.write_chunk(fab.chain_ids[0], ChunkId(999_777, 0), 0, b"orphan",
+                       chunk_size=4096)
+        out = c.run("find-orphaned-chunks")
+        assert "999777" in out and res.inode.id not in [999_777]
+        out = c.run("find-orphaned-chunks --remove")
+        assert "removed" in out
+        assert "0 orphaned" in c.run("find-orphaned-chunks")
+        # the live file is untouched
+        assert fio.read(fab.meta.stat("/real"), 0, 400) == b"live" * 100
+
+
+class TestRecursiveChown:
+    def test_chown_recursive(self, cli):
+        c, fab = cli
+        fab.meta.mkdirs("/tree")
+        fab.meta.mkdirs("/tree/sub")
+        fab.meta.create("/tree/f1", flags=OpenFlags.WRITE, client_id="c")
+        fab.meta.create("/tree/sub/f2", flags=OpenFlags.WRITE, client_id="c")
+        out = c.run("chown -R 1234:55 /tree")
+        assert "chowned 4 inode(s)" in out
+        for p in ("/tree", "/tree/sub", "/tree/f1", "/tree/sub/f2"):
+            ino = fab.meta.stat(p)
+            assert (ino.acl.uid, ino.acl.gid) == (1234, 55), p
+
+
+class TestSqliteSink:
+    def _mk_samples(self, n):
+        return [
+            Sample(name="storage.write.latency_us", ts=1000.0 + i,
+                   tags={"node": "10"}, value=float(i), count=1, p99=9.9)
+            for i in range(n)
+        ]
+
+    def test_write_then_query(self, tmp_path):
+        sink = SqliteSink(str(tmp_path / "mon.db"))
+        sink.write(self._mk_samples(10))
+        got = sink.query("storage.write", limit=5)
+        assert len(got) == 5
+        assert got[0].ts == 1009.0            # newest first
+        assert got[0].tags == {"node": "10"}
+        assert sink.query("nomatch") == []
+        assert len(sink.query("", since=1008.0)) == 2
+
+    def test_collector_query_rpc(self, tmp_path):
+        from tpu3fs.rpc.net import RpcClient, RpcServer
+        from tpu3fs.monitor.collector import COLLECTOR_SERVICE_ID
+
+        sink = SqliteSink(str(tmp_path / "mon.db"))
+        svc = CollectorService(sink)
+        server = RpcServer()
+        bind_collector_service(server, svc)
+        server.start()
+        try:
+            client = RpcClient()
+            client.call(server.address, COLLECTOR_SERVICE_ID, 1,
+                        SampleBatch(self._mk_samples(4)), type(
+                            svc.write(SampleBatch([]))))
+            rsp = client.call(server.address, COLLECTOR_SERVICE_ID, 2,
+                              QueryReq(name_prefix="storage", limit=10),
+                              SampleBatch)
+            assert len(rsp.samples) == 4
+        finally:
+            server.stop()
+
+    def test_query_metrics_cli(self, tmp_path, cli):
+        c, _ = cli
+        sink = SqliteSink(str(tmp_path / "mon.db"))
+        sink.write(self._mk_samples(3))
+        out = c.run(f"query-metrics --db {tmp_path / 'mon.db'} "
+                    f"--name storage --limit 2")
+        assert "storage.write.latency_us" in out
+        assert out.count("\n") == 1

@@ -253,3 +253,31 @@ class TestEngineDurabilityEdges:
                              full_replace=True, chunk_size=4096,
                              expected_crc=crc32c(b"payload"))
         assert meta.checksum.value == crc32c(b"payload")
+
+    def test_batch_read_uring_and_sync_parity(self, tmp_path, monkeypatch):
+        """The io_uring batch path and the sync-pread fallback return
+        byte-identical results (same data/ver/crc/aux per op)."""
+        import os
+
+        blobs = {i: bytes([i + 1]) * (1000 + 313 * i) for i in range(24)}
+
+        def build(path):
+            e = NativeChunkEngine(str(path))
+            for i, b in blobs.items():
+                e.update(ChunkId(9, i), 1, 1, b, 0, chunk_size=1 << 16)
+                e.commit(ChunkId(9, i), 1, 1)
+            return e
+
+        items = ([(ChunkId(9, i), 0, -1) for i in range(24)]
+                 + [(ChunkId(9, i), 11, 222) for i in range(24)])
+        monkeypatch.setenv("TPU3FS_NO_URING", "1")
+        e_sync = build(tmp_path / "sync")
+        sync_out = e_sync.batch_read(items, 1 << 16)
+        e_sync.close()
+        monkeypatch.delenv("TPU3FS_NO_URING")
+        e_ring = build(tmp_path / "ring")
+        ring_out = e_ring.batch_read(items, 1 << 16)
+        e_ring.close()
+        assert sync_out == ring_out
+        for i in range(24):
+            assert sync_out[i][1] == blobs[i]

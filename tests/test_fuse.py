@@ -216,3 +216,81 @@ class TestKernelMount:
             m.unmount()
             subprocess.run(["fusermount", "-u", "-z", mnt],
                            check=False, capture_output=True)
+
+
+class TestXattrs:
+    """Extended attributes end-to-end (ref FuseOps.cc xattr lowlevel ops):
+    meta store, FuseOps surface, and the real kernel mount."""
+
+    def test_meta_xattr_roundtrip(self):
+        fab = Fabric()
+        fab.meta.create("/xf", client_id="c")
+        fab.meta.set_xattr("/xf", "user.color", b"blue")
+        fab.meta.set_xattr("/xf", "user.size", b"42")
+        assert fab.meta.get_xattr("/xf", "user.color") == b"blue"
+        assert fab.meta.list_xattrs("/xf") == ["user.color", "user.size"]
+        fab.meta.remove_xattr("/xf", "user.color")
+        assert fab.meta.list_xattrs("/xf") == ["user.size"]
+        from tpu3fs.utils.result import Code, FsError
+
+        with pytest.raises(FsError) as ei:
+            fab.meta.get_xattr("/xf", "user.color")
+        assert ei.value.code == Code.META_NO_XATTR
+
+    def test_fuse_ops_xattr_and_ioctl(self):
+        fab = Fabric()
+        ops = FuseOps(fab.meta, fab.file_client())
+        fab.meta.create("/g", client_id="c")
+        ops.setxattr("/g", "user.tag", b"v1")
+        assert ops.getxattr("/g", "user.tag") == b"v1"
+        assert ops.listxattr("/g") == ["user.tag"]
+        ops.removexattr("/g", "user.tag")
+        assert ops.listxattr("/g") == []
+        inode = fab.meta.stat("/g")
+        assert ops.ioctl("/g", FuseOps.IOC_GET_INODE_ID) == inode.id
+
+    def test_kernel_mount_xattrs(self):
+        from tpu3fs.fuse.mount import FuseMount
+
+        fab = Fabric()
+        ops = FuseOps(fab.meta, fab.file_client())
+        mnt = tempfile.mkdtemp(prefix="tpu3fs-xattr-")
+        m = FuseMount(ops, mnt)
+        m.mount()
+        if not m.wait_mounted(timeout=15):
+            pytest.skip(f"kernel mount failed (exit {m.exit_code})")
+        try:
+            path = f"{mnt}/xfile"
+            with open(path, "wb") as f:
+                f.write(b"x")
+            os.setxattr(path, "user.alpha", b"one")
+            os.setxattr(path, "user.beta", b"two" * 100)
+            assert os.getxattr(path, "user.alpha") == b"one"
+            assert sorted(os.listxattr(path)) == ["user.alpha", "user.beta"]
+            os.removexattr(path, "user.alpha")
+            assert os.listxattr(path) == ["user.beta"]
+            with pytest.raises(OSError) as ei:
+                os.getxattr(path, "user.alpha")
+            assert ei.value.errno == errno.ENODATA
+            # xattrs survive on the inode across a rename
+            os.rename(path, f"{mnt}/renamed")
+            assert os.getxattr(f"{mnt}/renamed", "user.beta") == b"two" * 100
+        finally:
+            m.unmount()
+
+    def test_xattr_create_replace_flags(self):
+        from tpu3fs.meta.store import MetaStore
+        from tpu3fs.utils.result import Code, FsError
+
+        fab = Fabric()
+        fab.meta.create("/fl", client_id="c")
+        ops = FuseOps(fab.meta, fab.file_client())
+        ops.setxattr("/fl", "user.k", b"v1", MetaStore.XATTR_CREATE)
+        with pytest.raises(FsError) as ei:
+            ops.setxattr("/fl", "user.k", b"v2", MetaStore.XATTR_CREATE)
+        assert ei.value.code == Code.META_EXISTS
+        ops.setxattr("/fl", "user.k", b"v2", MetaStore.XATTR_REPLACE)
+        assert ops.getxattr("/fl", "user.k") == b"v2"
+        with pytest.raises(FsError) as ei:
+            ops.setxattr("/fl", "user.nope", b"x", MetaStore.XATTR_REPLACE)
+        assert ei.value.code == Code.META_NO_XATTR

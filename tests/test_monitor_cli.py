@@ -100,7 +100,7 @@ class TestAdminCli:
     def test_help_lists_commands(self, cli):
         c, _ = cli
         out = c.run("help")
-        for cmd in ("list-nodes", "upload-chain", "offline-target", "bench"):
+        for cmd in ("list-nodes", "upload-chain", "offline-target", "fs-bench"):
             assert cmd in out
 
     def test_cluster_inspection(self, cli):
@@ -159,7 +159,7 @@ class TestAdminCli:
 
     def test_bench_runs(self, cli):
         c, _ = cli
-        out = c.run("bench --chunks 4 --size 4096")
+        out = c.run("fs-bench --chunks 4 --size 4096")
         assert "MB/s" in out
 
     def test_unknown_and_errors(self, cli):

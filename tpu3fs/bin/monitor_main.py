@@ -11,7 +11,7 @@ from typing import List, Optional
 from tpu3fs.app.application import OnePhaseApplication
 from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.monitor.collector import CollectorService, bind_collector_service
-from tpu3fs.monitor.recorder import JsonlSink
+from tpu3fs.monitor.recorder import JsonlSink, SqliteSink
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.utils.config import Config, ConfigItem
 
@@ -32,7 +32,16 @@ class MonitorApp(OnePhaseApplication):
         return MonitorAppConfig()
 
     def build_services(self, server: RpcServer) -> None:
-        sink = self._sink or JsonlSink(self.config.get("out_path"))
+        out = self.config.get("out_path")
+        if self._sink is not None:
+            sink = self._sink
+        elif self.flag("sink", "sqlite" if out.endswith(".db")
+                       else "jsonl") == "sqlite":
+            # queryable store (the ClickHouse stand-in): admin_cli
+            # query-metrics reads it over the collector RPC
+            sink = SqliteSink(out)
+        else:
+            sink = JsonlSink(out)
         self.collector = CollectorService(sink)
         bind_collector_service(server, self.collector)
 

@@ -173,6 +173,203 @@ class AdminCli:
         )
         return "\n".join(gen_chain_table_commands(M, ec_k=ec_k, ec_m=ec_m))
 
+    # -- maintenance / parity sweeps (ref src/client/cli/admin: Bench,
+    # ReadBench, Checksum, FindOrphanedChunks, RecursiveChown) --------------
+    def cmd_bench(self, args: List[str]) -> str:
+        """Raw storage write bench over the chain table (ref Bench.cc):
+        bench [--chunks N] [--size BYTES] [--file-id ID]."""
+        chunks = int(self._flag(args, "--chunks", 64))
+        size = int(self._flag(args, "--size", 65536))
+        file_id = int(self._flag(args, "--file-id", 909_090))
+        ri = self.fab.routing()
+        chains = [c.chain_id for c in ri.chains.values() if not c.is_ec]
+        if not chains:
+            return "no CR chains to bench"
+        client = self.fab.storage_client()
+        payload = b"\xab" * size
+        from tpu3fs.storage.types import ChunkId as _Cid
+
+        t0 = time.perf_counter()
+        writes = [(chains[i % len(chains)], _Cid(file_id, i), 0, payload)
+                  for i in range(chunks)]
+        replies = client.batch_write(writes, chunk_size=size)
+        dt = time.perf_counter() - t0
+        failed = sum(1 for r in replies if not r.ok)
+        return (f"wrote {chunks - failed}/{chunks} x {size}B in {dt:.3f}s "
+                f"({chunks * size / dt / 1e6:.1f} MB/s), {failed} failed")
+
+    def cmd_read_bench(self, args: List[str]) -> str:
+        """Raw storage read bench (ref ReadBench.cc): read the chunks
+        `bench` wrote: read-bench [--chunks N] [--file-id ID]."""
+        chunks = int(self._flag(args, "--chunks", 64))
+        file_id = int(self._flag(args, "--file-id", 909_090))
+        ri = self.fab.routing()
+        chains = [c.chain_id for c in ri.chains.values() if not c.is_ec]
+        if not chains:
+            return "no CR chains to bench"
+        client = self.fab.storage_client()
+        from tpu3fs.client.storage_client import ReadReq as _RR
+        from tpu3fs.storage.types import ChunkId as _Cid
+
+        t0 = time.perf_counter()
+        replies = client.batch_read([
+            _RR(chains[i % len(chains)], _Cid(file_id, i), 0, -1)
+            for i in range(chunks)
+        ])
+        dt = time.perf_counter() - t0
+        got = sum(len(r.data) for r in replies if r.ok)
+        failed = sum(1 for r in replies if not r.ok)
+        return (f"read {got} bytes from {chunks - failed}/{chunks} chunks "
+                f"in {dt:.3f}s ({got / dt / 1e6:.1f} MB/s), {failed} failed")
+
+    def cmd_verify_checksums(self, args: List[str]) -> str:
+        """Cross-replica checksum sweep (ref Checksum.cc): every committed
+        chunk's (version, crc) must agree across its chain's replicas.
+        verify-checksums [--chain ID]."""
+        only = self._flag(args, "--chain")
+        ri = self.fab.routing()
+        checked = mismatches = 0
+        lines: List[str] = []
+        for chain in ri.chains.values():
+            if only and chain.chain_id != int(only):
+                continue
+            if chain.is_ec:
+                continue  # EC shards differ by design; engine CRCs are
+                # validated at install time (expected_crc)
+            per_replica: Dict[int, Dict[bytes, tuple]] = {}
+            for t in chain.targets:
+                node = ri.node_of_target(t.target_id)
+                if node is None:
+                    continue
+                try:
+                    metas = self.fab.send(
+                        node.node_id, "dump_chunkmeta", t.target_id)
+                except FsError:
+                    continue
+                per_replica[t.target_id] = {
+                    m.chunk_id.to_bytes(): (m.committed_ver,
+                                            m.checksum.value)
+                    for m in metas if m.committed_ver > 0
+                }
+            all_keys = set().union(*per_replica.values()) \
+                if per_replica else set()
+            for key in all_keys:
+                states = {tid: rep.get(key) for tid, rep in
+                          per_replica.items()}
+                committed = {v for v in states.values() if v is not None}
+                checked += 1
+                if len(committed) > 1:
+                    mismatches += 1
+                    lines.append(
+                        f"chain {chain.chain_id} chunk {key.hex()}: "
+                        + ", ".join(f"t{tid}={v}" for tid, v in
+                                    states.items()))
+        head = f"checked {checked} chunks, {mismatches} mismatches"
+        return head if not lines else head + "\n" + "\n".join(lines[:50])
+
+    def cmd_find_orphaned_chunks(self, args: List[str]) -> str:
+        """Chunks whose file id has no inode (ref FindOrphanedChunks.cc):
+        find-orphaned-chunks [--remove]."""
+        remove = "--remove" in args
+        ri = self.fab.routing()
+        # file id -> set of chain ids holding its chunks
+        seen: Dict[int, set] = {}
+        for chain in ri.chains.values():
+            for t in chain.targets:
+                node = ri.node_of_target(t.target_id)
+                if node is None:
+                    continue
+                try:
+                    metas = self.fab.send(
+                        node.node_id, "dump_chunkmeta", t.target_id)
+                except FsError:
+                    continue
+                for m in metas:
+                    seen.setdefault(m.chunk_id.file_id,
+                                    set()).add(chain.chain_id)
+        file_ids = sorted(seen)
+        orphans: List[int] = []
+        for base in range(0, len(file_ids), 256):
+            batch = file_ids[base:base + 256]
+            inodes = self.fab.meta.batch_stat(batch)
+            orphans.extend(
+                fid for fid, ino in zip(batch, inodes) if ino is None)
+        removed = 0
+        if remove:
+            # StorageClient.remove_file_chunks knows the fan-out rules
+            # (CR: head + chain forward; EC: every node of the chain) —
+            # reuse it instead of hand-rolling target selection
+            client = self.fab.storage_client()
+            for fid in orphans:
+                for chain_id in seen[fid]:
+                    try:
+                        client.remove_file_chunks(chain_id, fid)
+                        removed += 1
+                    except FsError:
+                        continue
+        out = f"{len(orphans)} orphaned file ids: {orphans[:20]}"
+        if remove:
+            out += f"; removed chunks of {removed} (file, chain) pairs"
+        return out
+
+    def cmd_chown(self, args: List[str]) -> str:
+        """chown [-R] UID[:GID] PATH (ref RecursiveChown.cc)."""
+        recursive = "-R" in args
+        rest = [a for a in args if a != "-R"]
+        spec, path = rest[0], rest[1]
+        uid_s, _, gid_s = spec.partition(":")
+        uid = int(uid_s)
+        gid = int(gid_s) if gid_s else None
+        count = 0
+
+        def apply(p: str) -> None:
+            nonlocal count
+            self.fab.meta.set_attr(p, uid=uid, gid=gid)
+            count += 1
+            if recursive:
+                try:
+                    ents = self.fab.meta.list_dir(p)
+                except FsError:
+                    return
+                for e in ents:
+                    apply(p.rstrip("/") + "/" + e.name)
+
+        apply(path)
+        return f"chowned {count} inode(s) to {uid}" + \
+            (f":{gid}" if gid is not None else "")
+
+    def cmd_query_metrics(self, args: List[str]) -> str:
+        """Query the monitor sink (ref: operators query ClickHouse):
+        query-metrics --db PATH [--name PREFIX] [--limit N]
+        or --collector HOST:PORT to query a live monitor service."""
+        name = self._flag(args, "--name", "")
+        limit = int(self._flag(args, "--limit", 20))
+        coll = self._flag(args, "--collector")
+        if coll:
+            from tpu3fs.monitor.collector import (
+                COLLECTOR_SERVICE_ID,
+                QueryReq,
+                SampleBatch,
+            )
+            from tpu3fs.rpc.net import RpcClient
+
+            host, port = coll.rsplit(":", 1)
+            rsp = RpcClient().call(
+                (host, int(port)), COLLECTOR_SERVICE_ID, 2,
+                QueryReq(name_prefix=name, limit=limit), SampleBatch)
+            samples = rsp.samples
+        else:
+            from tpu3fs.monitor.recorder import SqliteSink
+
+            samples = SqliteSink(self._flag(args, "--db")).query(
+                name, limit=limit)
+        if not samples:
+            return "no samples"
+        return "\n".join(
+            f"{s.ts:.1f} {s.name} value={s.value} count={s.count} "
+            f"p99={s.p99:.1f} tags={s.tags}"
+            for s in samples)
+
     # -- FS shell ------------------------------------------------------------
     def cmd_ls(self, args: List[str]) -> str:
         path = args[0] if args else "/"
@@ -352,8 +549,8 @@ class AdminCli:
         ok = self._migration().stop_job(int(args[0]))
         return "stopped" if ok else "not running"
 
-    # -- bench (ref benchmarks/storage_bench) --------------------------------
-    def cmd_bench(self, args: List[str]) -> str:
+    # -- file-level bench (ref benchmarks/storage_bench) ---------------------
+    def cmd_fs_bench(self, args: List[str]) -> str:
         num = int(self._flag(args, "--chunks", 16))
         size = int(self._flag(args, "--size", 1 << 16))
         fio = self.fab.file_client()
@@ -418,6 +615,11 @@ class RpcFabricView:
 
     def tick(self) -> None:
         self.mgmtd.tick()
+
+    def send(self, node_id: int, method: str, payload):
+        """Storage-node RPC by node id (the Fabric.send signature), for
+        maintenance sweeps like verify-checksums / find-orphaned-chunks."""
+        return self._messenger(node_id, method, payload)
 
     def storage_client(self, **kw):
         return self._StorageClient(

@@ -69,12 +69,16 @@ class FileIoClient:
                     if not reply.ok:
                         raise FsError(Status(reply.code, reply.message))
             elif kind == "ec_full":
-                chain_id = run[0][0]
-                items = [(cid, part) for _, cid, part in run]
-                for reply in self._storage.write_stripes(
-                        chain_id, items, chunk_size=cs):
-                    if not reply.ok:
-                        raise FsError(Status(reply.code, reply.message))
+                # one run may span the layout's chains (chunks round-robin
+                # over them): one write_stripes per chain covers the run
+                by_chain: dict = {}
+                for chain_id, cid, part in run:
+                    by_chain.setdefault(chain_id, []).append((cid, part))
+                for chain_id, items in by_chain.items():
+                    for reply in self._storage.write_stripes(
+                            chain_id, items, chunk_size=cs):
+                        if not reply.ok:
+                            raise FsError(Status(reply.code, reply.message))
             else:  # ec_partial
                 for chain_id, idx, in_off, part in run:
                     reply = self._write_ec_chunk(
@@ -97,9 +101,7 @@ class FileIoClient:
             else:
                 seg_kind, seg = "cr", (chain_id, ChunkId(inode.id, idx),
                                        in_off, part)
-            breaks_run = seg_kind != kind or (
-                seg_kind == "ec_full" and run and run[0][0] != chain_id)
-            if breaks_run:
+            if seg_kind != kind:
                 flush(kind, run)
                 kind, run = seg_kind, []
             run.append(seg)

@@ -128,10 +128,12 @@ _OP = {
     "flush": CFUNCTYPE(c_int, c_char_p, POINTER(fuse_file_info)),
     "release": CFUNCTYPE(c_int, c_char_p, POINTER(fuse_file_info)),
     "fsync": CFUNCTYPE(c_int, c_char_p, c_int, POINTER(fuse_file_info)),
-    "setxattr": c_void_p,
-    "getxattr": c_void_p,
-    "listxattr": c_void_p,
-    "removexattr": c_void_p,
+    "setxattr": CFUNCTYPE(c_int, c_char_p, c_char_p, POINTER(c_byte),
+                          c_size_t, c_int),
+    "getxattr": CFUNCTYPE(c_int, c_char_p, c_char_p, POINTER(c_byte),
+                          c_size_t),
+    "listxattr": CFUNCTYPE(c_int, c_char_p, POINTER(c_byte), c_size_t),
+    "removexattr": CFUNCTYPE(c_int, c_char_p, c_char_p),
     "opendir": CFUNCTYPE(c_int, c_char_p, POINTER(fuse_file_info)),
     "readdir": CFUNCTYPE(c_int, c_char_p, c_void_p, fuse_fill_dir_t, c_off_t,
                          POINTER(fuse_file_info)),
@@ -152,6 +154,14 @@ _OP = {
     # time updates — it only calls utimens when both FATTR_ATIME|FATTR_MTIME
     # are present
     "flags_": c_uint,
+    # libfuse 2.9 tail (order matters: fuse_main copies min(op_size, ...)):
+    "ioctl": CFUNCTYPE(c_int, c_char_p, c_int, c_void_p,
+                       POINTER(fuse_file_info), c_uint, c_void_p),
+    "poll": c_void_p,
+    "write_buf": c_void_p,
+    "read_buf": c_void_p,
+    "flock": c_void_p,
+    "fallocate": c_void_p,
 }
 
 FLAG_UTIME_OMIT_OK = 1 << 2
@@ -337,6 +347,37 @@ class FuseMount:
         def destroy(_):
             o.destroy()
 
+        def setxattr(path, name, value, size, flags):
+            raw = ctypes.string_at(value, size) if size else b""
+            o.setxattr(p(path), name.decode(), raw, flags)
+
+        def getxattr(path, name, value, size):
+            raw = o.getxattr(p(path), name.decode())
+            if size == 0:
+                return len(raw)          # size probe
+            if size < len(raw):
+                return -errno.ERANGE
+            ctypes.memmove(value, raw, len(raw))
+            return len(raw)
+
+        def listxattr(path, buf, size):
+            blob = b"".join(n.encode() + b"\0" for n in o.listxattr(p(path)))
+            if size == 0:
+                return len(blob)
+            if size < len(blob):
+                return -errno.ERANGE
+            if blob:
+                ctypes.memmove(buf, blob, len(blob))
+            return len(blob)
+
+        def removexattr(path, name):
+            o.removexattr(p(path), name.decode())
+
+        def ioctl(path, cmd, arg, fi, flags, data):
+            out = o.ioctl(p(path), cmd & 0xFFFFFFFF)
+            if out is not None and data:
+                ctypes.memmove(data, int(out).to_bytes(8, "little"), 8)
+
         impls = dict(
             getattr=getattr_, fgetattr=fgetattr, readlink=readlink,
             mknod=mknod, mkdir=mkdir, unlink=unlink, rmdir=rmdir,
@@ -346,6 +387,8 @@ class FuseMount:
             statfs=statfs, flush=flush, release=release, fsync=fsync,
             opendir=opendir, readdir=readdir, releasedir=releasedir,
             access=access, utimens=utimens, destroy=destroy,
+            setxattr=setxattr, getxattr=getxattr, listxattr=listxattr,
+            removexattr=removexattr, ioctl=ioctl,
         )
         st = fuse_operations()
         for name, fn in impls.items():

@@ -48,6 +48,7 @@ _CODE_ERRNO = {
     Code.META_NOT_FILE: errno.EINVAL,
     Code.INVALID_ARG: errno.EINVAL,
     Code.META_BUSY: errno.EBUSY,
+    Code.META_NO_XATTR: errno.ENODATA,
 }
 
 
@@ -231,6 +232,28 @@ class FuseOps:
             if child is not None:
                 entries.append((ent.name, self._attr_of(child)))
         return entries
+
+    # -- extended attributes (ref FuseOps.cc xattr entries, :2580-2613) -----
+    def setxattr(self, path: str, name: str, value: bytes,
+                 flags: int = 0) -> None:
+        self._meta.set_xattr(path, name, value, flags=flags)
+
+    def getxattr(self, path: str, name: str) -> bytes:
+        return self._meta.get_xattr(path, name)
+
+    def listxattr(self, path: str) -> List[str]:
+        return self._meta.list_xattrs(path)
+
+    def removexattr(self, path: str, name: str) -> None:
+        self._meta.remove_xattr(path, name)
+
+    # -- ioctl (ref FuseOps.cc hf3fs ioctls: inode-id/layout queries) --------
+    IOC_GET_INODE_ID = 0x80087001   # _IOR('p', 1, u64)
+
+    def ioctl(self, path: str, cmd: int) -> Optional[int]:
+        if cmd == self.IOC_GET_INODE_ID:
+            return self._meta.stat(path).id
+        raise FsError(Status(Code.INVALID_ARG, f"ioctl {cmd:#x}"))
 
     def statfs(self) -> dict:
         sf = self._meta.stat_fs()

@@ -793,6 +793,63 @@ class MetaStore:
 
         return with_transaction(self._engine, op)
 
+    # -- extended attributes (ref fuse_lowlevel_ops setxattr/getxattr/
+    # listxattr/removexattr, FuseOps.cc:2580-2613) --------------------------
+    XATTR_CREATE = 1   # fail with META_EXISTS if the name exists
+    XATTR_REPLACE = 2  # fail with META_NO_XATTR if the name is absent
+
+    def set_xattr(self, path: str, name: str, value: bytes,
+                  user: User = ROOT_USER, *, flags: int = 0) -> Inode:
+        if not name or len(name) > 255 or len(value) > 64 << 10:
+            raise _err(Code.INVALID_ARG, f"xattr {name!r}")
+
+        def op(txn: ITransaction) -> Inode:
+            _, _, inode = self._walk(txn, path, user)
+            if inode is None:
+                raise _err(Code.META_NOT_FOUND, path)
+            if not inode.acl.check_user(user, PERM_W):
+                raise _err(Code.META_NO_PERMISSION, path)
+            # XATTR_CREATE/XATTR_REPLACE checked INSIDE the transaction:
+            # create-exclusive xattr protocols (lock/claim via xattrs)
+            # need the check and the write to be atomic
+            if (flags & self.XATTR_CREATE) and name in inode.xattrs:
+                raise _err(Code.META_EXISTS, f"xattr {name} on {path}")
+            if (flags & self.XATTR_REPLACE) and name not in inode.xattrs:
+                raise _err(Code.META_NO_XATTR, f"xattr {name} on {path}")
+            inode.xattrs[name] = bytes(value)
+            inode.ctime = time.time()
+            self._store_inode(txn, inode)
+            return inode
+
+        return with_transaction(self._engine, op)
+
+    def get_xattr(self, path: str, name: str,
+                  user: User = ROOT_USER) -> bytes:
+        inode = self.stat(path, user)
+        if name not in inode.xattrs:
+            raise _err(Code.META_NO_XATTR, f"xattr {name} on {path}")
+        return inode.xattrs[name]
+
+    def list_xattrs(self, path: str, user: User = ROOT_USER) -> List[str]:
+        return sorted(self.stat(path, user).xattrs)
+
+    def remove_xattr(self, path: str, name: str,
+                     user: User = ROOT_USER) -> Inode:
+        def op(txn: ITransaction) -> Inode:
+            _, _, inode = self._walk(txn, path, user)
+            if inode is None:
+                raise _err(Code.META_NOT_FOUND, path)
+            if not inode.acl.check_user(user, PERM_W):
+                raise _err(Code.META_NO_PERMISSION, path)
+            if name not in inode.xattrs:
+                raise _err(Code.META_NO_XATTR, f"xattr {name} on {path}")
+            del inode.xattrs[name]
+            inode.ctime = time.time()
+            self._store_inode(txn, inode)
+            return inode
+
+        return with_transaction(self._engine, op)
+
     def truncate(self, path: str, length: int, user: User = ROOT_USER) -> Inode:
         def op(txn: ITransaction) -> Inode:
             _, _, inode = self._walk(txn, path, user)

@@ -19,7 +19,7 @@ import random
 import struct
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from tpu3fs.kv.kv import KeyPrefix
 
@@ -112,6 +112,9 @@ class Inode:
     # DIRECTORY:
     parent: int = 0
     locked_by: str = ""  # lockDirectory owner; "" = unlocked
+    # extended attributes (ref FuseOps.cc setxattr/getxattr/listxattr/
+    # removexattr in the lowlevel ops table, :2580-2613)
+    xattrs: Dict[str, bytes] = field(default_factory=dict)
 
     @staticmethod
     def new_file(id: int, acl: Acl, layout: Layout) -> "Inode":

@@ -50,10 +50,28 @@ class CollectorService:
         with self._lock:
             self._flush_locked()
 
+    def query(self, req: "QueryReq") -> SampleBatch:
+        """Operator query over the sink (flushes first so recent samples
+        are visible); requires a queryable sink (SqliteSink)."""
+        self.flush()
+        if not hasattr(self._sink, "query"):
+            return SampleBatch([])
+        return SampleBatch(self._sink.query(
+            req.name_prefix, req.since, req.until, req.limit))
+
+
+@dataclass
+class QueryReq:
+    name_prefix: str = ""
+    since: float = 0.0
+    until: float = 0.0
+    limit: int = 1000
+
 
 def bind_collector_service(server: RpcServer, service: CollectorService) -> None:
     s = ServiceDef(COLLECTOR_SERVICE_ID, "MonitorCollector")
     s.method(1, "write", SampleBatch, Ack, service.write)
+    s.method(2, "query", QueryReq, SampleBatch, service.query)
     server.add_service(s)
 
 

@@ -267,3 +267,69 @@ class MemorySink:
 
     def write(self, samples: List[Sample]) -> None:
         self.samples.extend(samples)
+
+
+class SqliteSink:
+    """QUERYABLE sample store — the ClickHouse-writer stand-in with an
+    actual query path (ref src/common/monitor/ClickHouseClient.cc +
+    deploy/sql/3fs-monitor.sql; the reference's operators query the sink,
+    so a write-only file is not parity). One table, batch inserts, WAL
+    journaling; thread-safe via one connection per call."""
+
+    SCHEMA = (
+        "CREATE TABLE IF NOT EXISTS samples ("
+        " ts REAL, name TEXT, value REAL, count INTEGER,"
+        " min REAL, max REAL, mean REAL, p50 REAL, p90 REAL, p99 REAL,"
+        " tags TEXT)",
+        "CREATE INDEX IF NOT EXISTS idx_samples_name_ts"
+        " ON samples(name, ts)",
+    )
+
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+        with self._connect() as db:
+            for stmt in self.SCHEMA:
+                db.execute(stmt)
+
+    def _connect(self):
+        import sqlite3
+
+        db = sqlite3.connect(self._path, timeout=30.0)
+        db.execute("PRAGMA journal_mode=WAL")
+        return db
+
+    def write(self, samples: List[Sample]) -> None:
+        if not samples:
+            return
+        rows = [
+            (s.ts, s.name, s.value, s.count, s.min, s.max, s.mean,
+             s.p50, s.p90, s.p99, json.dumps(s.tags, sort_keys=True))
+            for s in samples
+        ]
+        with self._lock, self._connect() as db:
+            db.executemany(
+                "INSERT INTO samples VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows)
+
+    def query(self, name_prefix: str = "", since: float = 0.0,
+              until: float = 0.0, limit: int = 1000) -> List[Sample]:
+        """Newest-first samples filtered by name prefix + time window."""
+        q = ("SELECT ts, name, value, count, min, max, mean, p50, p90,"
+             " p99, tags FROM samples"
+             " WHERE name LIKE ? ESCAPE '\\' AND ts >= ?")
+        escaped = (name_prefix.replace("\\", "\\\\")
+                   .replace("%", "\\%").replace("_", "\\_"))
+        params: list = [escaped + "%", since]
+        if until:
+            q += " AND ts <= ?"
+            params.append(until)
+        q += " ORDER BY ts DESC LIMIT ?"
+        params.append(max(1, limit))
+        with self._lock, self._connect() as db:
+            rows = db.execute(q, params).fetchall()
+        return [
+            Sample(name=r[1], ts=r[0], tags=json.loads(r[10]), value=r[2],
+                   count=r[3], min=r[4], max=r[5], mean=r[6], p50=r[7],
+                   p90=r[8], p99=r[9])
+            for r in rows
+        ]

@@ -336,6 +336,23 @@ class PathReq:
 
 
 @dataclass
+class XattrReq:
+    path: str
+    name: str = ""
+    value: bytes = b""
+    uid: int = 0
+    gid: int = 0
+    token: str = ""
+    flags: int = 0   # XATTR_CREATE / XATTR_REPLACE
+
+
+@dataclass
+class XattrRsp:
+    value: bytes = b""
+    names: List[str] = field(default_factory=list)
+
+
+@dataclass
 class CreateReq:
     path: str
     uid: int = 0
@@ -613,6 +630,14 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
     s.method(16, "pruneSession", PruneSessionReq, IntReply, prune_session)
     s.method(17, "batchStat", BatchStatReq, BatchStatRsp,
              lambda r: BatchStatRsp(meta.batch_stat(r.inode_ids, user=su(r))))
+    s.method(19, "setXattr", XattrReq, InodeRsp, lambda r: InodeRsp(
+        meta.set_xattr(r.path, r.name, r.value, u(r), flags=r.flags)))
+    s.method(20, "getXattr", XattrReq, XattrRsp, lambda r: XattrRsp(
+        value=meta.get_xattr(r.path, r.name, u(r))))
+    s.method(21, "listXattrs", XattrReq, XattrRsp, lambda r: XattrRsp(
+        names=meta.list_xattrs(r.path, u(r))))
+    s.method(22, "removeXattr", XattrReq, InodeRsp, lambda r: InodeRsp(
+        meta.remove_xattr(r.path, r.name, u(r))))
     server.add_service(s)
 
 
@@ -733,6 +758,21 @@ class MetaRpcClient:
 
     def stat_fs(self) -> StatFs:
         return self._call(1, StatFsReq(), StatFs)
+
+    def set_xattr(self, path: str, name: str, value: bytes,
+                  *, flags: int = 0) -> Inode:
+        return self._call(
+            19, XattrReq(path, name=name, value=value, flags=flags),
+            InodeRsp).inode
+
+    def get_xattr(self, path: str, name: str) -> bytes:
+        return self._call(20, XattrReq(path, name=name), XattrRsp).value
+
+    def list_xattrs(self, path: str) -> List[str]:
+        return self._call(21, XattrReq(path), XattrRsp).names
+
+    def remove_xattr(self, path: str, name: str) -> Inode:
+        return self._call(22, XattrReq(path, name=name), InodeRsp).inode
 
     def get_real_path(self, path: str) -> str:
         return self._call(14, PathReq(path), StrReply).value

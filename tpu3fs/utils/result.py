@@ -66,6 +66,7 @@ class Code(enum.IntEnum):
     META_NAME_TOO_LONG = 411
     META_INVALID_PATH = 412
     META_NOT_FILE = 413
+    META_NO_XATTR = 414      # ENODATA, distinct from a missing path
 
     # storage 5xx (update-code taxonomy, ref StorageOperator.cc:401-434)
     CHUNK_NOT_FOUND = 500
