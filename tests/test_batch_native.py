@@ -83,6 +83,29 @@ class TestEngineBatchOps:
         assert out[1][1] == b"abcdefgh" and out[1][3] == crc32c(b"abcdefgh")
         assert out[2][0] == Code.CHUNK_NOT_FOUND
 
+    def test_oversized_op_fallback_does_not_corrupt_siblings(self, engine):
+        # Regression (round-3 advisor, high): the E_RANGE fallback re-read
+        # used the same per-thread scratch buffer that still held uncopied
+        # sibling replies, so a batch with one chunk larger than the per-op
+        # cap returned the oversized chunk's bytes for LATER ops. Layout:
+        # small, BIG (> cap -> E_RANGE re-read), small — the trailing small
+        # op is the one the old code corrupted.
+        cap = 1024
+        payloads = {
+            0: b"a" * 100,
+            1: b"B" * (cap * 3),    # committed content outgrows the cap
+            2: b"c" * 200,
+        }
+        for i, blob in payloads.items():
+            engine.update(ChunkId(9, i), 1, 1, blob, 0, chunk_size=8192)
+            engine.commit(ChunkId(9, i), 1, 1)
+        out = engine.batch_read(
+            [(ChunkId(9, i), 0, -1) for i in range(3)], cap)
+        for i, (code, data, ver, crc, aux) in enumerate(out):
+            assert code == Code.OK
+            assert data == payloads[i], f"op {i} corrupted"
+            assert crc == crc32c(payloads[i])
+
     def test_batch_update_stale_reports_committed_state(self, engine):
         engine.update(ChunkId(4, 0), 1, 1, b"committed", 0, chunk_size=4096)
         engine.commit(ChunkId(4, 0), 1, 1)

@@ -361,8 +361,12 @@ class AdminCli:
         else:
             from tpu3fs.monitor.recorder import SqliteSink
 
-            samples = SqliteSink(self._flag(args, "--db")).query(
-                name, limit=limit)
+            db = self._flag(args, "--db")
+            if not db:
+                return ("usage: query-metrics "
+                        "(--db <sqlite-file> | --collector <host:port>) "
+                        "[--name PREFIX] [--limit N]")
+            samples = SqliteSink(db).query(name, limit=limit)
         if not samples:
             return "no samples"
         return "\n".join(

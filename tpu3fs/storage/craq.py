@@ -878,12 +878,22 @@ class StorageService:
                 return [UpdateReply(Code.ENGINE_ERROR,
                                     message="malformed batch reply")
                         for _ in staged]
-            if (out and all(r.code == out[0].code for r in out)
-                    and out[0].code in RETRIABLE_FORWARD_CODES):
+            retriable = [pos for pos, r in enumerate(out)
+                         if r.code in RETRIABLE_FORWARD_CODES]
+            if retriable and len(retriable) == len(out):
                 # chain may have moved under us: refresh and retry (the
                 # successor may have been offlined, making us the tail)
                 chain = self._chain(reqs[staged[0][0]].chain_id)
                 continue
+            if retriable:
+                # mixed reply: some ops landed, some hit a transient
+                # forwarding error. Retry just those through the per-op
+                # ladder, which refreshes routing itself; an op may find
+                # we are now the tail (-> None, committed without a hop).
+                chain = self._chain(reqs[staged[0][0]].chain_id)
+                for pos in retriable:
+                    i, ver, cs, is_fr = staged[pos]
+                    out[pos] = self._forward(target, reqs[i], ver, chain)
             return out
         return [UpdateReply(Code.CLIENT_RETRIES_EXHAUSTED,
                             message="forwarding retries exhausted")

@@ -414,26 +414,33 @@ class NativeChunkEngine(ChunkEngine):
         _check(self._lib.ce_batch_read(
             self._h, c_ops, buf, len(buf), res, n), "batch_read")
         base = ctypes.addressof(buf)
+        # Pass 1: copy every rc==0 payload OUT of the shared scratch buffer
+        # before any fallback re-read runs — read_verified reuses the same
+        # per-thread scratch, so an interleaved E_RANGE re-read would
+        # overwrite sibling replies still sitting in `buf` in place.
         out = []
+        refetch = []
         for i in range(n):
             r = res[i]
             if r.rc == -10:
-                # committed content outgrew the per-op cap: re-read this op
-                # alone with an exact-size buffer (matches mem engine and
-                # the per-op path byte-for-byte)
-                try:
-                    chunk_id, offset, length = items[i]
-                    out.append((Code.OK,) + self.read_verified(
-                        chunk_id, offset, length))
-                except FsError as e:
-                    out.append((e.code, b"", 0, 0, 0))
-                continue
-            if r.rc != 0:
+                refetch.append(i)
+                out.append(None)
+            elif r.rc != 0:
                 out.append((_ERR_TO_CODE.get(r.rc, Code.ENGINE_ERROR),
                             b"", 0, 0, 0))
-                continue
-            data = ctypes.string_at(base + c_ops[i].out_off, r.len)
-            out.append((Code.OK, data, r.ver, r.crc, r.aux))
+            else:
+                data = ctypes.string_at(base + c_ops[i].out_off, r.len)
+                out.append((Code.OK, data, r.ver, r.crc, r.aux))
+        # Pass 2: committed content outgrew the per-op cap — re-read those
+        # ops alone with an exact-size buffer (matches mem engine and the
+        # per-op path byte-for-byte). Safe now: scratch holds no live data.
+        for i in refetch:
+            try:
+                chunk_id, offset, length = items[i]
+                out[i] = (Code.OK,) + self.read_verified(
+                    chunk_id, offset, length)
+            except FsError as e:
+                out[i] = (e.code, b"", 0, 0, 0)
         return out
 
     def close(self) -> None:
