@@ -6,14 +6,25 @@ Baseline: the BASELINE.json north star is >= 40 GiB/s RS(12,4) encode on a
 v5e-8 (8 chips), i.e. 5 GiB/s per chip of *data* consumed. vs_baseline is
 measured single-chip GiB/s divided by that 5 GiB/s per-chip share.
 
-Robustness contract (the driver runs this unattended on real hardware):
-- backend init and the whole bench run are bounded by subprocess timeouts —
-  a hung TPU tunnel produces a self-describing failure record, never a hang;
-- if the TPU backend is unreachable the bench falls back to CPU and SAYS SO
-  in the record ("platform": "cpu", "error": ...) so a low number is never
-  mistaken for a TPU regression;
-- secondary metrics (worst-case decode, CRC, XOR rebuild, e2e fabric IO)
-  ride along in "extras" without changing the headline schema.
+Robustness contract (the driver runs this unattended on flaky hardware —
+three rounds of TPU-tunnel outages shaped this design):
+- the bench is split into PHASES, each run in its own bounded subprocess in
+  priority order (headline RS encode FIRST, then kernel bit-exactness, then
+  secondary kernels, then e2e service paths). A mid-run tunnel drop or
+  phase crash costs only the remaining phases, never the captured ones;
+- after every phase the merged state is persisted to BENCH_partial.json, so
+  even a hard kill of this orchestrator leaves an inspectable record;
+- any phase that completes on a TPU backend is cached (with git commit +
+  timestamp) in BENCH_TPU_CAPTURE.json. If the tunnel is down at report
+  time but a capture from THIS round's code exists, the capture is the
+  headline (clearly labeled "source": "cached_capture" with captured_at /
+  capture_commit) — a real TPU measurement beats a live CPU fallback;
+- with no TPU measurement at all the record says so loudly: ok=false,
+  vs_baseline=null, value preserved under cpu_fallback_value.
+
+Run `python bench.py --capture-tpu` to probe and (if the tunnel is up)
+refresh the TPU capture without the e2e phases — cheap enough to run
+periodically through a round.
 """
 
 from __future__ import annotations
@@ -30,144 +41,233 @@ BATCH = 12             # 144 MiB of data per step
 WARMUP, ITERS = 2, 8
 BASELINE_PER_CHIP_GIBPS = 40.0 / 8
 
-PROBE_TIMEOUT_S = 120   # backend init (tunnel handshake) bound
-BENCH_TIMEOUT_S = 900   # full bench incl. first compiles (~20-40s each)
+HERE = os.path.dirname(os.path.abspath(__file__)) or "."
+PARTIAL_PATH = os.path.join(HERE, "BENCH_partial.json")
+CAPTURE_PATH = os.path.join(HERE, "BENCH_TPU_CAPTURE.json")
+
+PROBE_TIMEOUT_S = 120          # backend init (tunnel handshake) bound
+PHASE_TIMEOUT_S = {            # per-phase budget incl. first compiles
+    "headline": 420,
+    "exactness": 300,
+    "secondary": 420,
+    "e2e": 600,
+}
+TPU_PLATFORMS = ("tpu", "TPU", "axon")
+
+HEADLINE_METRIC = "rs_encode_12_4_data_throughput_per_chip"
 
 
 def _gibps(nbytes: int, iters: int, dt: float) -> float:
     return nbytes * iters / dt / (1 << 30)
 
 
-def _bench_worker(platform: str) -> None:
-    """Child process: run every bench on the given platform, print JSON."""
+# --------------------------------------------------------------------------
+# phase workers (run in child processes; print one JSON dict on stdout)
+# --------------------------------------------------------------------------
+
+def _init_jax(platform: str):
     import jax
 
     if platform == "cpu":
+        # the image's sitecustomize force-selects the axon backend via
+        # jax.config, so env vars alone don't stick
         jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def _timeit(jax, fn, arg, nbytes: int) -> float:
+    for _ in range(WARMUP):
+        jax.block_until_ready(fn(arg))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(ITERS):
+        out = fn(arg)
+    jax.block_until_ready(out)
+    return _gibps(nbytes, ITERS, time.perf_counter() - t0)
+
+
+def _make_data(jax, seed: int = 0):
     import jax.numpy as jnp
     import numpy as np
 
-    from tpu3fs.ops.crc32c import BatchCrc32c
+    rng = np.random.default_rng(seed)
+    host = rng.integers(0, 256, (BATCH, K, SHARD_BYTES), dtype=np.uint8)
+    return jax.device_put(jnp.asarray(host), jax.devices()[0]), host
+
+
+def _phase_headline(platform: str) -> dict:
+    """RS(12,4) encode throughput — the single number that matters."""
+    jax = _init_jax(platform)
     from tpu3fs.ops.rs import RSCode
 
     dev = jax.devices()[0]
     rs = RSCode(K, M)
-    rng = np.random.default_rng(0)
-    host = rng.integers(0, 256, (BATCH, K, SHARD_BYTES), dtype=np.uint8)
-    data = jax.device_put(jnp.asarray(host), dev)
-    extras = {"platform": dev.platform, "device": str(dev)}
-
-    def timeit(fn, arg, nbytes: int) -> float:
-        for _ in range(WARMUP):
-            jax.block_until_ready(fn(arg))
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(ITERS):
-            out = fn(arg)
-        jax.block_until_ready(out)
-        return _gibps(nbytes, ITERS, time.perf_counter() - t0)
-
+    data, _ = _make_data(jax)
     data_bytes = BATCH * K * SHARD_BYTES
+    gibps = _timeit(jax, rs.encode, data, data_bytes)
+    return {
+        "platform": dev.platform,
+        "device": str(dev),
+        "value": round(gibps, 3),
+    }
 
-    # 1) headline: RS(12,4) encode (data consumed per second)
-    encode_gibps = timeit(rs.encode, data, data_bytes)
 
-    # 2) worst-case decode: all M parity-positions lost... the hard case is
-    # M *data* shards lost (needs the full GF(2) matmul with the inverted
-    # submatrix). Same data-consumed semantics as encode so the two compare.
-    lost = tuple(range(M))                      # first M data shards lost
-    present = tuple(range(M, K + M))            # K survivors
+def _phase_exactness(platform: str) -> dict:
+    """Non-interpreted device kernels vs the numpy gold path, bit for bit.
+    Proves the Pallas lowering (not interpret mode) computes the same GF
+    math the CPU tests validate (round-3 verdict ask #1c)."""
+    jax = _init_jax(platform)
+    import numpy as np
+
+    from tpu3fs.ops import pallas_rs
+    from tpu3fs.ops.crc32c import BatchCrc32c, crc32c
+    from tpu3fs.ops.rs import RSCode
+
+    rs = RSCode(K, M)
+    size = 64 << 10  # 64 KiB shards: big enough to hit every grid path
+    rng = np.random.default_rng(7)
+    host = rng.integers(0, 256, (3, K, size), dtype=np.uint8)
+    import jax.numpy as jnp
+
+    data = jax.device_put(jnp.asarray(host), jax.devices()[0])
+
+    out = {"pallas_lowering": bool(pallas_rs.backend_supports_pallas())}
+    # encode
+    enc_dev = np.asarray(rs.encode(data))
+    enc_np = rs.encode_np(host)
+    out["encode_bit_exact"] = bool(np.array_equal(enc_dev, enc_np))
+    # worst-case decode (M data shards lost -> full GF matmul)
+    shards = np.concatenate([host, enc_np], axis=1)
+    present = tuple(range(M, K + M))
+    lost = tuple(range(M))
+    dec_dev = np.asarray(
+        rs.reconstruct_fn(present, lost)(jnp.asarray(shards[:, list(present)])))
+    out["decode_bit_exact"] = bool(np.array_equal(dec_dev, host[:, list(lost)]))
+    # CRC32C vs the scalar reference
+    crc = BatchCrc32c(size, block=512)
+    crcs_dev = np.asarray(crc(jnp.asarray(host.reshape(-1, size))))
+    crcs_ref = np.array(
+        [crc32c(row.tobytes()) for row in host.reshape(-1, size)],
+        dtype=np.uint32)
+    out["crc32c_bit_exact"] = bool(np.array_equal(crcs_dev, crcs_ref))
+    out["all_bit_exact"] = (out["encode_bit_exact"]
+                            and out["decode_bit_exact"]
+                            and out["crc32c_bit_exact"])
+    return out
+
+
+def _phase_secondary(platform: str) -> dict:
+    """Decode / rebuild / CRC throughput (same data-consumed semantics as
+    the headline so the numbers compare)."""
+    jax = _init_jax(platform)
+    from tpu3fs.ops.crc32c import BatchCrc32c
+    from tpu3fs.ops.rs import RSCode
+
+    rs = RSCode(K, M)
+    data, _ = _make_data(jax)
+    data_bytes = BATCH * K * SHARD_BYTES
+    out = {}
+    # worst-case decode: M *data* shards lost (full inverted-submatrix matmul)
+    lost = tuple(range(M))
+    present = tuple(range(M, K + M))
     decode = rs.reconstruct_fn(present, lost)
-    extras["rs_decode_worstcase_gibps"] = round(
-        timeit(decode, data, data_bytes), 3)
-
-    # 3) RAID-style 1-loss XOR rebuild (the dominant recovery case)
+    out["rs_decode_worstcase_gibps"] = round(
+        _timeit(jax, decode, data, data_bytes), 3)
+    # RAID-style 1-loss XOR rebuild (the dominant recovery case)
     xor_present = tuple(i for i in range(K + 1) if i != 1)
     xor_fn = rs.reconstruct_fn(xor_present, (1,))
-    extras["xor_rebuild_1loss_gibps"] = round(
-        timeit(xor_fn, data, data_bytes), 3)
-
-    # 4) batched CRC32C over all shards
+    out["xor_rebuild_1loss_gibps"] = round(
+        _timeit(jax, xor_fn, data, data_bytes), 3)
+    # batched CRC32C over all shards
     crc = BatchCrc32c(SHARD_BYTES, block=512)
     flat = data.reshape(BATCH * K, SHARD_BYTES)
-    extras["crc32c_batch_gibps"] = round(timeit(crc.compute, flat, data_bytes), 3)
+    out["crc32c_batch_gibps"] = round(
+        _timeit(jax, crc, flat, data_bytes), 3)
+    return out
 
-    # 5) e2e single-process fabric write+read (CPU-side service path; small
-    # on purpose — it measures the CRAQ/ engine path, not the TPU)
+
+def _phase_e2e(platform: str) -> dict:
+    """Single-process fabric service paths (CRAQ write/read, EC file IO).
+    These measure the engine + chain protocol, not the accelerator; they
+    ride along so regressions in the serving path are visible."""
+    _init_jax(platform)
+    out = {}
     try:
         from benchmarks.storage_bench import run_bench as storage_bench
 
-        for row in storage_bench(chunks=64, size=256 << 10, batch=8,
-                                 threads=4, replicas=2, chains=4):
-            extras[f"e2e_{row['metric']}_gibps"] = row["value"]
-    except Exception as e:  # e2e is best-effort garnish on the kernel bench
-        extras["e2e_error"] = repr(e)[:200]
+        for eng in ("mem", "native"):
+            try:
+                for row in storage_bench(chunks=64, size=256 << 10, batch=8,
+                                         threads=4, replicas=2, chains=4,
+                                         engine=eng):
+                    suffix = "" if eng == "mem" else "_native"
+                    out[f"e2e_{row['metric']}{suffix}_gibps"] = row["value"]
+            except Exception as e:
+                out[f"e2e_error_{eng}"] = repr(e)[:200]
+    except Exception as e:
+        out["e2e_error"] = repr(e)[:200]
 
-    # 6) EC serving path: stripe write (device encode+CRC) / read via fabric
     try:
         from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
-        from tpu3fs.storage.types import ChunkId
+        from tpu3fs.meta.store import OpenFlags
 
         ec_chunk = 256 << 10
         fab = Fabric(SystemSetupConfig(
             num_storage_nodes=4, num_chains=2, chunk_size=ec_chunk,
             ec_k=3, ec_m=1))
-        from tpu3fs.meta.store import OpenFlags
-
         stripes = 32
         blobs = [bytes([i & 0xFF]) * ec_chunk for i in range(4)]
-        # the FILE write path (what FUSE/USRBIO ride): FileIoClient batches
-        # full stripes into write_stripes — one device encode for the whole
-        # span + one BatchShardWrite per node (round-2 weak #3 fix)
         fio = fab.file_client()
         res = fab.meta.create("/ecbench", flags=OpenFlags.WRITE,
                               client_id="bench")
         payload = b"".join(blobs[i % 4] for i in range(stripes))
         t0 = time.perf_counter()
         fio.write(res.inode, 0, payload)
-        extras["e2e_ec_write_gibps"] = round(
+        out["e2e_ec_write_gibps"] = round(
             _gibps(stripes * ec_chunk, 1, time.perf_counter() - t0), 3)
-        # overwrite the same span: the batch path must survive existing
-        # stripe versions (probed, not collapsed to the per-stripe ladder)
         t0 = time.perf_counter()
         fio.write(res.inode, 0, payload)
-        extras["e2e_ec_overwrite_gibps"] = round(
+        out["e2e_ec_overwrite_gibps"] = round(
             _gibps(stripes * ec_chunk, 1, time.perf_counter() - t0), 3)
         t0 = time.perf_counter()
         back = fio.read(res.inode, 0, stripes * ec_chunk)
         dt = time.perf_counter() - t0
         assert back == payload, "EC file read-back mismatch"
-        extras["e2e_ec_read_gibps"] = round(
+        out["e2e_ec_read_gibps"] = round(
             _gibps(stripes * ec_chunk, 1, dt), 3)
     except Exception as e:
-        extras["e2e_ec_error"] = repr(e)[:200]
-
-    print(json.dumps({
-        "metric": "rs_encode_12_4_data_throughput_per_chip",
-        "value": round(encode_gibps, 3),
-        "unit": "GiB/s",
-        "vs_baseline": round(encode_gibps / BASELINE_PER_CHIP_GIBPS, 3),
-        **extras,
-    }))
+        out["e2e_ec_error"] = repr(e)[:200]
+    return out
 
 
-def _probe_platform() -> tuple:
+_PHASE_FNS = {
+    "headline": _phase_headline,
+    "exactness": _phase_exactness,
+    "secondary": _phase_secondary,
+    "e2e": _phase_e2e,
+}
+KERNEL_PHASES = ("headline", "exactness", "secondary")
+
+
+# --------------------------------------------------------------------------
+# orchestrator
+# --------------------------------------------------------------------------
+
+def _probe_platform(attempts=(PROBE_TIMEOUT_S, 2 * PROBE_TIMEOUT_S)) -> tuple:
     """-> (platform | None, error detail). Bounded: a dead TPU tunnel makes
-    jax.devices() hang forever, so the probe runs in a killable child.
-    RETRIED with a doubled budget — a slow-to-establish tunnel must not
-    cost the round its only TPU capture (round-2 verdict ask #9)."""
+    jax.devices() hang forever, so the probe runs in a killable child."""
     last_err = ""
-    for attempt, budget in enumerate((PROBE_TIMEOUT_S, 2 * PROBE_TIMEOUT_S)):
+    for attempt, budget in enumerate(attempts):
         try:
             out = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True, text=True, timeout=budget,
-                cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+                capture_output=True, text=True, timeout=budget, cwd=HERE,
             )
         except subprocess.TimeoutExpired:
             last_err = (f"backend init exceeded {budget}s "
-                        f"(attempt {attempt + 1}/2; tunnel down?)")
+                        f"(attempt {attempt + 1}/{len(attempts)}; "
+                        "tunnel down?)")
             continue
         if out.returncode != 0:
             last_err = (out.stderr or out.stdout).strip()[-300:]
@@ -176,58 +276,254 @@ def _probe_platform() -> tuple:
     return None, last_err
 
 
-def main() -> None:
-    here = os.path.dirname(os.path.abspath(__file__)) or "."
-    platform, probe_err = _probe_platform()
-    fallback_note = ""
-    if platform is None or platform not in ("tpu", "TPU"):
-        if platform is None:
-            fallback_note = f"tpu backend unavailable ({probe_err}); " \
-                            "cpu fallback numbers — NOT a TPU measurement"
-            platform = "cpu"
-        # probe returned e.g. "cpu" already: still a valid (non-TPU) run
-        elif platform != "cpu":
-            platform = "cpu"
+def _run_phase(phase: str, platform: str) -> dict:
+    """Run one phase in a bounded child; error dict on any failure."""
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--worker", platform],
-            capture_output=True, text=True, timeout=BENCH_TIMEOUT_S, cwd=here,
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", phase, platform],
+            capture_output=True, text=True,
+            timeout=PHASE_TIMEOUT_S[phase], cwd=HERE,
         )
     except subprocess.TimeoutExpired:
-        print(json.dumps({
-            "metric": "rs_encode_12_4_data_throughput_per_chip",
-            "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
-            "error": f"bench exceeded {BENCH_TIMEOUT_S}s on {platform}",
-        }))
-        return
+        return {"error": f"phase {phase} exceeded "
+                         f"{PHASE_TIMEOUT_S[phase]}s on {platform}"}
     line = ""
     for cand in reversed(out.stdout.strip().splitlines()):
         if cand.startswith("{"):
             line = cand
             break
     if out.returncode != 0 or not line:
-        print(json.dumps({
-            "metric": "rs_encode_12_4_data_throughput_per_chip",
-            "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
-            "error": f"worker rc={out.returncode} on {platform}",
-            "detail": (out.stderr or out.stdout).strip()[-400:],
-        }))
-        return
-    rec = json.loads(line)
-    # headline fields must be impossible to misread as a TPU capture:
-    # ok=false + null vs_baseline on any non-TPU run (advisor round-2),
-    # with the raw CPU number preserved under cpu_fallback_value
-    rec["ok"] = rec.get("platform") in ("tpu", "TPU")
-    if not rec["ok"]:
-        rec["cpu_fallback_value"] = rec.get("value")
-        rec["vs_baseline"] = None
-        if fallback_note:
-            rec["error"] = fallback_note
+        return {"error": f"phase {phase} rc={out.returncode} on {platform}",
+                "detail": (out.stderr or out.stdout).strip()[-400:]}
+    return json.loads(line)
+
+
+def _git_commit() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, cwd=HERE, timeout=10).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+# paths whose changes can alter kernel performance/correctness: a cached
+# capture is only trustworthy if none of these moved since it was taken
+KERNEL_PATHS = ("tpu3fs/ops", "native", "bench.py")
+
+
+def _kernels_changed_since(commit: str) -> bool:
+    """True when the kernel-relevant paths differ between `commit` and the
+    working tree (uncommitted changes included). Conservative: any doubt
+    (bad commit, git failure) counts as changed."""
+    if not commit or commit == "unknown":
+        return True
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", commit, "--"] + list(KERNEL_PATHS),
+            capture_output=True, text=True, cwd=HERE, timeout=10)
+        if out.returncode != 0 or out.stdout.strip():
+            return True
+        # `git diff` never lists UNTRACKED files — a brand-new kernel
+        # source would slip through and let a stale capture mask it
+        unt = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard", "--"]
+            + list(KERNEL_PATHS),
+            capture_output=True, text=True, cwd=HERE, timeout=10)
+        return unt.returncode != 0 or bool(unt.stdout.strip())
+    except Exception:
+        return True
+
+
+def _persist(path: str, obj: dict) -> None:
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _load(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _run_kernel_phases(platform: str, state: dict,
+                       partial_path: str = PARTIAL_PATH) -> dict:
+    """Headline + exactness + secondary, persisting after each phase.
+    Returns the kernel-results dict {phase: result}."""
+    for phase in KERNEL_PHASES:
+        res = _run_phase(phase, platform)
+        state.setdefault("phases", {})[phase] = res
+        state["platform_requested"] = platform
+        _persist(partial_path, state)
+        # a dead tunnel fails fast thanks to the probe, but if the tunnel
+        # dies MID-run the first phase error tells us; keep going — later
+        # phases are independently bounded and a partial capture is the
+        # whole point of the phase split.
+    return state["phases"]
+
+
+def _save_capture(phases: dict) -> None:
+    _persist(CAPTURE_PATH, {
+        "phases": {p: phases[p] for p in KERNEL_PHASES if p in phases},
+        "captured_at": time.time(),
+        "captured_at_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "capture_commit": _git_commit(),
+    })
+
+
+def _capture_is_tpu(phases: dict) -> bool:
+    head = phases.get("headline", {})
+    return head.get("platform") in TPU_PLATFORMS and "value" in head
+
+
+def capture_tpu(verbose: bool = True) -> bool:
+    """Probe; if a TPU backend is live, run the kernel phases on it and
+    refresh BENCH_TPU_CAPTURE.json. True when a capture was saved."""
+    platform, err = _probe_platform(attempts=(90,))
+    if platform not in TPU_PLATFORMS:
+        if verbose:
+            print(json.dumps({"captured": False,
+                              "platform": platform, "error": err}))
+        return False
+    state = {"mode": "capture", "started_at": time.time()}
+    # capture mode persists to its own partial file so a periodic capture
+    # never clobbers the inspectable record of a killed bench run
+    phases = _run_kernel_phases(platform, state,
+                                partial_path=CAPTURE_PATH + ".partial")
+    if not _capture_is_tpu(phases):
+        if verbose:
+            print(json.dumps({"captured": False,
+                              "detail": phases.get("headline")}))
+        return False
+    _save_capture(phases)
+    if verbose:
+        print(json.dumps({"captured": True,
+                          "value": phases["headline"]["value"],
+                          "commit": _git_commit()}))
+    return True
+
+
+def main() -> None:
+    state = {"mode": "bench", "started_at": time.time()}
+    platform, probe_err = _probe_platform()
+    on_tpu = platform in TPU_PLATFORMS
+    if platform is None:
+        platform = "cpu"
+    elif not on_tpu:
+        platform = "cpu"
+    state["probe"] = {"platform": platform, "error": probe_err}
+    _persist(PARTIAL_PATH, state)
+
+    phases = _run_kernel_phases(platform, state)
+    e2e = _run_phase("e2e", platform)
+    state["phases"]["e2e"] = e2e
+    _persist(PARTIAL_PATH, state)
+
+    live_tpu = _capture_is_tpu(phases)
+    if live_tpu:
+        _save_capture(phases)
+
+    extras: dict = {}
+    for phase in ("secondary", "exactness"):
+        src = phases.get(phase, {})
+        for k, v in src.items():
+            if not k.startswith("error"):
+                extras[k] = v
+    for k, v in e2e.items():
+        extras[k] = v
+
+    head = phases.get("headline", {})
+    if live_tpu:
+        rec = {
+            "metric": HEADLINE_METRIC,
+            "value": head["value"],
+            "unit": "GiB/s",
+            "vs_baseline": round(head["value"] / BASELINE_PER_CHIP_GIBPS, 3),
+            "platform": head.get("platform"),
+            "device": head.get("device"),
+            "source": "live",
+            "ok": True,
+            **extras,
+        }
+    else:
+        capture = _load(CAPTURE_PATH)
+        capture_ok = (capture and _capture_is_tpu(capture.get("phases", {}))
+                      and not _kernels_changed_since(
+                          capture.get("capture_commit", "")))
+        if capture_ok:
+            # a real TPU measurement from earlier in this round, with the
+            # kernel-relevant paths unchanged since: report it as the
+            # headline, clearly labeled, with the live CPU numbers
+            # alongside. A cached device capture of this exact kernel code
+            # beats a live number from the wrong hardware. (A capture whose
+            # kernels have since changed is NOT promoted — it could mask a
+            # regression — and rides along under stale_tpu_capture below.)
+            chead = capture["phases"]["headline"]
+            rec = {
+                "metric": HEADLINE_METRIC,
+                "value": chead["value"],
+                "unit": "GiB/s",
+                "vs_baseline": round(
+                    chead["value"] / BASELINE_PER_CHIP_GIBPS, 3),
+                "platform": chead.get("platform"),
+                "device": chead.get("device"),
+                "source": "cached_capture",
+                "captured_at": capture.get("captured_at_iso"),
+                "capture_commit": capture.get("capture_commit"),
+                "current_commit": _git_commit(),
+                "live_probe_error": probe_err or "backend not tpu",
+                "ok": True,
+            }
+            for phase in ("secondary", "exactness"):
+                for k, v in capture["phases"].get(phase, {}).items():
+                    if not k.startswith("error"):
+                        rec[k] = v
+            for k, v in e2e.items():
+                rec[k] = v
+            if "value" in head:
+                rec["cpu_live_value"] = head["value"]
+        else:
+            # no TPU measurement exists at all: loud, unambiguous fallback
+            rec = {
+                "metric": HEADLINE_METRIC,
+                "value": head.get("value", 0.0),
+                "unit": "GiB/s",
+                "vs_baseline": None,
+                "platform": head.get("platform", "cpu"),
+                "source": "cpu_fallback",
+                "ok": False,
+                "cpu_fallback_value": head.get("value", 0.0),
+                "error": (f"tpu backend unavailable ({probe_err}); cpu "
+                          "fallback numbers — NOT a TPU measurement"),
+                **extras,
+            }
+            if "error" in head:
+                rec["headline_phase_error"] = head["error"]
+            if capture and _capture_is_tpu(capture.get("phases", {})):
+                rec["stale_tpu_capture"] = {
+                    "value": capture["phases"]["headline"]["value"],
+                    "captured_at": capture.get("captured_at_iso"),
+                    "capture_commit": capture.get("capture_commit"),
+                    "note": "kernel paths changed since capture; "
+                            "not promoted to headline",
+                }
+    state["record"] = rec
+    _persist(PARTIAL_PATH, state)
     print(json.dumps(rec))
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
-        _bench_worker(sys.argv[2] if len(sys.argv) > 2 else "cpu")
+    if len(sys.argv) > 2 and sys.argv[1] == "--worker":
+        print(json.dumps(_PHASE_FNS[sys.argv[2]](sys.argv[3])))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--capture-tpu":
+        capture_tpu()
     else:
         main()

@@ -19,6 +19,7 @@
 // Exposed as a C ABI consumed through ctypes (no pybind11 in this image).
 
 #include <algorithm>
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -35,6 +36,13 @@
 #include <sys/syscall.h>
 #include <sys/types.h>
 #include <unistd.h>
+
+#include <condition_variable>
+#include <functional>
+#include <thread>
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
 
 namespace {
 
@@ -1025,6 +1033,275 @@ int ce_compact(void* h) {
 uint32_t ce_crc32c(const uint8_t* data, uint64_t n) { return crc32c(data, n); }
 uint32_t ce_crc32c_seed(const uint8_t* data, uint64_t n, uint32_t crc) {
   return crc32c(data, n, crc);
+}
+
+// ---- GF(2^8) erasure-code data plane (CPU fallback for the TPU kernels) ---
+//
+// ISA-L-style table-driven SIMD multiply-accumulate: each coefficient c is
+// handed in as two 16-entry PSHUFB tables (products of c with every low /
+// high nibble), so one shuffle multiplies 16 (SSSE3) or 32 (AVX2) bytes.
+// The nibble tables are built host-side from the SAME 0x11D field tables
+// the JAX/Pallas kernels use (tpu3fs/ops/gf256.py), keeping this code
+// field-agnostic; coefficients 0 and 1 take skip/XOR fast paths (parity
+// row 0 is all-ones by the RSCode construction, so the dominant single-
+// parity stripe never touches a shuffle). The reference has no RS path —
+// its CPU-side per-chunk math is folly CRC32C (src/fbs/storage/
+// Common.h:66-199); this is the added-capability analogue at the same
+// "CPU does GB/s" competence level.
+}  // extern "C" (the gfec helpers below need C++ linkage: templates)
+
+namespace gfec {
+
+void xor_acc_scalar(const uint8_t* src, uint8_t* dst, size_t n) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t a, b;
+    memcpy(&a, src + i, 8);
+    memcpy(&b, dst + i, 8);
+    b ^= a;
+    memcpy(dst + i, &b, 8);
+  }
+  for (; i < n; ++i) dst[i] ^= src[i];
+}
+
+void muladd_scalar(const uint8_t* lo, const uint8_t* hi, const uint8_t* src,
+                   uint8_t* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    uint8_t x = src[i];
+    dst[i] ^= lo[x & 15] ^ hi[x >> 4];
+  }
+}
+
+#if defined(__x86_64__)
+__attribute__((target("avx2"))) void xor_acc_avx2(const uint8_t* src,
+                                                  uint8_t* dst, size_t n) {
+  size_t i = 0;
+  for (; i + 32 <= n; i += 32) {
+    __m256i a = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src + i));
+    __m256i b = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(dst + i));
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + i),
+                        _mm256_xor_si256(a, b));
+  }
+  if (i < n) xor_acc_scalar(src + i, dst + i, n - i);
+}
+
+__attribute__((target("avx2"))) void muladd_avx2(const uint8_t* lo,
+                                                  const uint8_t* hi,
+                                                  const uint8_t* src,
+                                                  uint8_t* dst, size_t n) {
+  const __m256i vlo = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(lo)));
+  const __m256i vhi = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(hi)));
+  const __m256i mask = _mm256_set1_epi8(0x0F);
+  size_t i = 0;
+  for (; i + 32 <= n; i += 32) {
+    __m256i x = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src + i));
+    __m256i l = _mm256_and_si256(x, mask);
+    __m256i h = _mm256_and_si256(_mm256_srli_epi16(x, 4), mask);
+    __m256i p = _mm256_xor_si256(_mm256_shuffle_epi8(vlo, l),
+                                 _mm256_shuffle_epi8(vhi, h));
+    __m256i d = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(dst + i));
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + i),
+                        _mm256_xor_si256(d, p));
+  }
+  if (i < n) muladd_scalar(lo, hi, src + i, dst + i, n - i);
+}
+
+__attribute__((target("ssse3"))) void muladd_ssse3(const uint8_t* lo,
+                                                    const uint8_t* hi,
+                                                    const uint8_t* src,
+                                                    uint8_t* dst, size_t n) {
+  const __m128i vlo = _mm_loadu_si128(reinterpret_cast<const __m128i*>(lo));
+  const __m128i vhi = _mm_loadu_si128(reinterpret_cast<const __m128i*>(hi));
+  const __m128i mask = _mm_set1_epi8(0x0F);
+  size_t i = 0;
+  for (; i + 16 <= n; i += 16) {
+    __m128i x = _mm_loadu_si128(reinterpret_cast<const __m128i*>(src + i));
+    __m128i l = _mm_and_si128(x, mask);
+    __m128i h = _mm_and_si128(_mm_srli_epi16(x, 4), mask);
+    __m128i p = _mm_xor_si128(_mm_shuffle_epi8(vlo, l),
+                              _mm_shuffle_epi8(vhi, h));
+    __m128i d = _mm_loadu_si128(reinterpret_cast<const __m128i*>(dst + i));
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm_xor_si128(d, p));
+  }
+  if (i < n) muladd_scalar(lo, hi, src + i, dst + i, n - i);
+}
+
+const bool kHasAvx2 = __builtin_cpu_supports("avx2");
+const bool kHasSsse3 = __builtin_cpu_supports("ssse3");
+
+inline void xor_acc(const uint8_t* src, uint8_t* dst, size_t n) {
+  if (kHasAvx2) return xor_acc_avx2(src, dst, n);
+  xor_acc_scalar(src, dst, n);
+}
+
+inline void muladd(const uint8_t* lo, const uint8_t* hi, const uint8_t* src,
+                   uint8_t* dst, size_t n) {
+  if (kHasAvx2) return muladd_avx2(lo, hi, src, dst, n);
+  if (kHasSsse3) return muladd_ssse3(lo, hi, src, dst, n);
+  muladd_scalar(lo, hi, src, dst, n);
+}
+#else
+inline void xor_acc(const uint8_t* src, uint8_t* dst, size_t n) {
+  xor_acc_scalar(src, dst, n);
+}
+inline void muladd(const uint8_t* lo, const uint8_t* hi, const uint8_t* src,
+                   uint8_t* dst, size_t n) {
+  muladd_scalar(lo, hi, src, dst, n);
+}
+#endif
+
+// Apply the (r, k) matrix to one S-byte slice of one batch element.
+void apply_slice(const uint8_t* nib, const uint8_t* coeffs, int k, int r,
+                 const uint8_t* data_b, uint8_t* out_b, uint64_t s_off,
+                 uint64_t s_len, uint64_t S) {
+  for (int i = 0; i < r; ++i) {
+    memset(out_b + i * S + s_off, 0, s_len);
+  }
+  // src-row outer: each input shard slice is streamed once through all r
+  // output accumulators (the shuffles are compute-bound; the src slice
+  // stays hot in L1/L2 across the r passes)
+  for (int j = 0; j < k; ++j) {
+    const uint8_t* src = data_b + j * S + s_off;
+    for (int i = 0; i < r; ++i) {
+      uint8_t c = coeffs[i * k + j];
+      if (c == 0) continue;
+      uint8_t* dst = out_b + i * S + s_off;
+      if (c == 1) {
+        xor_acc(src, dst, s_len);
+      } else {
+        const uint8_t* t = nib + (static_cast<size_t>(i) * k + j) * 32;
+        muladd(t, t + 16, src, dst, s_len);
+      }
+    }
+  }
+}
+
+// Persistent worker pool: the serving hot path calls ce_gf_apply /
+// ce_crc32c_batch per stripe batch, so per-call thread spawn/join would be
+// pure overhead (the role of the reference's long-lived per-disk worker
+// threads, src/storage/update/UpdateWorker.h:30-33). Workers park on a
+// condition variable between jobs; the submitting thread participates.
+// Intentionally leaked (never destroyed): workers block in wait() at
+// process exit and tearing down the mutex under them would be UB.
+class Pool {
+ public:
+  static Pool& get() {
+    static Pool* p = new Pool();
+    return *p;
+  }
+
+  void run(uint64_t n_tasks, const std::function<void(uint64_t)>& f) {
+    std::lock_guard<std::mutex> job_guard(job_mu_);  // one job at a time
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      fn_ = &f;
+      next_.store(0, std::memory_order_relaxed);
+      total_ = n_tasks;
+      pending_workers_ = static_cast<unsigned>(threads_.size());
+      ++gen_;
+    }
+    cv_.notify_all();
+    work();
+    std::unique_lock<std::mutex> g(mu_);
+    done_cv_.wait(g, [&] { return pending_workers_ == 0; });
+    fn_ = nullptr;
+  }
+
+  unsigned width() const {
+    return static_cast<unsigned>(threads_.size()) + 1;
+  }
+
+ private:
+  Pool() {
+    unsigned hw = std::thread::hardware_concurrency();
+    unsigned nworkers = hw > 1 ? hw - 1 : 0;
+    for (unsigned i = 0; i < nworkers; ++i)
+      threads_.emplace_back([this] { worker_loop(); });
+  }
+
+  void work() {
+    for (;;) {
+      uint64_t t = next_.fetch_add(1, std::memory_order_relaxed);
+      if (t >= total_) return;
+      (*fn_)(t);
+    }
+  }
+
+  void worker_loop() {
+    uint64_t seen = 0;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [&] { return gen_ != seen; });
+        seen = gen_;
+      }
+      work();
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        if (--pending_workers_ == 0) done_cv_.notify_one();
+      }
+    }
+  }
+
+  std::mutex job_mu_;
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  std::vector<std::thread> threads_;
+  const std::function<void(uint64_t)>* fn_ = nullptr;
+  std::atomic<uint64_t> next_{0};
+  uint64_t total_ = 0;
+  uint64_t gen_ = 0;
+  unsigned pending_workers_ = 0;
+};
+
+// Run f(0..n_tasks) across the pool when the work justifies it; inline
+// otherwise (small per-write calls must not pay dispatch latency).
+template <typename F>
+void parallel_for(uint64_t n_tasks, uint64_t approx_bytes, F&& f) {
+  if (n_tasks <= 1 || approx_bytes < (1u << 20) || Pool::get().width() <= 1) {
+    for (uint64_t t = 0; t < n_tasks; ++t) f(t);
+    return;
+  }
+  std::function<void(uint64_t)> fw = std::forward<F>(f);
+  Pool::get().run(n_tasks, fw);
+}
+
+}  // namespace gfec
+
+extern "C" {
+
+// Apply an (r, k) GF(2^8) matrix to (batch, k, S) data -> (batch, r, S).
+// nib: (r*k, 32) nibble-product tables; coeffs: (r, k) raw coefficients.
+// Encode passes the parity matrix; decode passes the inverted-submatrix
+// reconstruction rows — one entry point, both directions.
+int ce_gf_apply(const uint8_t* nib, const uint8_t* coeffs, int k, int r,
+                const uint8_t* data, uint64_t batch, uint64_t S,
+                uint8_t* out) {
+  if (k <= 0 || r <= 0 || S == 0 || batch == 0) return E_INVALID;
+  // tile the (batch, S) plane so one big stripe still spreads over cores
+  const uint64_t kTile = 256 << 10;
+  uint64_t tiles_per_s = (S + kTile - 1) / kTile;
+  uint64_t n_tasks = batch * tiles_per_s;
+  gfec::parallel_for(n_tasks, batch * S * (uint64_t)k, [&](uint64_t t) {
+    uint64_t b = t / tiles_per_s;
+    uint64_t s_off = (t % tiles_per_s) * kTile;
+    uint64_t s_len = std::min(kTile, S - s_off);
+    gfec::apply_slice(nib, coeffs, k, r, data + b * (uint64_t)k * S,
+                      out + b * (uint64_t)r * S, s_off, s_len, S);
+  });
+  return OK;
+}
+
+// Batched CRC32C: n_rows rows of `len` bytes at `stride` apart -> out[n].
+int ce_crc32c_batch(const uint8_t* data, uint64_t n_rows, uint64_t stride,
+                    uint64_t len, uint32_t* out) {
+  gfec::parallel_for(n_rows, n_rows * len, [&](uint64_t i) {
+    out[i] = crc32c(data + i * stride, len);
+  });
+  return OK;
 }
 
 // ---- batched ops -----------------------------------------------------------

@@ -240,3 +240,71 @@ class TestPallasKernel:
         out = np.asarray(gf2_matmul(A, jnp.asarray(data), interpret=True,
                                     block_s=256))
         assert (out == rs.encode_np(data)).all()
+
+
+class TestNativeEc:
+    """Native SIMD GF/CRC (native/chunk_engine.cpp ce_gf_apply /
+    ce_crc32c_batch) vs the numpy gold path — the CPU-backend serving
+    kernels (round-3 verdict ask #2)."""
+
+    def test_available(self):
+        from tpu3fs.ops import native_ec
+
+        assert native_ec.available()
+
+    def test_encode_matches_gold_random_codes(self):
+        from tpu3fs.ops import native_ec
+
+        rng = np.random.default_rng(0)
+        for k, m in ((3, 1), (4, 2), (12, 4), (1, 1), (8, 3)):
+            rs = RSCode(k, m)
+            # sizes straddle the 16/32-byte SIMD strides and the scalar tail
+            for s in (17, 32, 100, 512, 4096):
+                data = rng.integers(0, 256, (2, k, s), dtype=np.uint8)
+                got = native_ec.gf_apply(rs.parity_matrix, data)
+                assert np.array_equal(got, rs.encode_np(data)), (k, m, s)
+
+    def test_decode_matches_gold(self):
+        from tpu3fs.ops import native_ec
+
+        rs = RSCode(6, 3)
+        rng = np.random.default_rng(1)
+        data = rng.integers(0, 256, (3, 6, 333), dtype=np.uint8)
+        shards = np.concatenate([data, rs.encode_np(data)], axis=1)
+        present = (0, 2, 4, 6, 7, 8)
+        lost = (1, 3, 5)
+        R = rs._reconstruct_matrix(present, lost)
+        got = native_ec.gf_apply(R, shards[:, list(present)])
+        assert np.array_equal(got, data[:, list(lost)])
+
+    def test_crc_batch_matches_scalar(self):
+        from tpu3fs.ops import native_ec
+        from tpu3fs.ops.crc32c import crc32c_py
+
+        rng = np.random.default_rng(2)
+        for s in (1, 7, 64, 1000):
+            rows = rng.integers(0, 256, (5, s), dtype=np.uint8)
+            got = native_ec.crc32c_batch(rows)
+            want = [crc32c_py(r.tobytes()) for r in rows]
+            assert list(got) == want, s
+
+    def test_cpu_backend_apis_route_native_and_stay_bit_exact(self):
+        # RSCode.encode / BatchCrc32c.__call__ / reconstruct_fn on the CPU
+        # backend must return the same bits as the gold path regardless of
+        # which kernel they picked
+        rs = RSCode(5, 2)
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, (2, 5, 512), dtype=np.uint8)
+        assert np.array_equal(np.asarray(rs.encode(jnp.asarray(data))),
+                              rs.encode_np(data))
+        shards = np.concatenate([data, rs.encode_np(data)], axis=1)
+        # xor fast path (lost data shard 1, survivors 0,2,3,4 + parity 0)
+        fn = rs.reconstruct_fn((0, 2, 3, 4, 5), (1,))
+        got = np.asarray(fn(jnp.asarray(shards[:, [0, 2, 3, 4, 5]])))
+        assert np.array_equal(got, data[:, [1]])
+        from tpu3fs.ops.crc32c import BatchCrc32c, crc32c
+
+        crc = BatchCrc32c(512, block=512)
+        got_crc = np.asarray(crc(jnp.asarray(data.reshape(-1, 512))))
+        want = [crc32c(r.tobytes()) for r in data.reshape(-1, 512)]
+        assert list(got_crc) == want

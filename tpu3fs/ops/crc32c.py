@@ -114,6 +114,20 @@ def crc32c_py(data: Union[bytes, bytearray, memoryview], crc: int = 0) -> int:
     return _raw_update((crc & 0xFFFFFFFF) ^ _XOROUT, bytes(data)) ^ _XOROUT
 
 
+def crc32c_batch_host(rows: np.ndarray) -> np.ndarray:
+    """Host-side (numpy in/out) per-row CRC32C — the CPU-backend serving
+    path. One native crossing with a thread-pooled HW CRC when the library
+    is loadable; the scalar loop otherwise. Host-side kernel selection for
+    batched CRC lives HERE (mirrors RSCode.encode_host)."""
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    from tpu3fs.ops import native_ec
+
+    if native_ec.available():
+        return native_ec.crc32c_batch(rows)
+    return np.fromiter((crc32c(row.tobytes()) for row in rows),
+                       dtype=np.uint32, count=rows.shape[0])
+
+
 @functools.lru_cache(maxsize=1)
 def _byte_shift_matrix() -> np.ndarray:
     """A: 32x32 GF(2) matrix advancing the register through one zero byte."""
@@ -214,4 +228,14 @@ class BatchCrc32c:
 
     def __call__(self, chunks: jnp.ndarray) -> jnp.ndarray:
         assert chunks.ndim == 2 and chunks.shape[1] == self.size, chunks.shape
+        from tpu3fs.ops import pallas_rs
+
+        if (not pallas_rs.backend_supports_pallas()
+                and not isinstance(chunks, jax.core.Tracer)):
+            # non-TPU backend with concrete data: the HW-CRC batch in
+            # native/chunk_engine.cpp is ~100x the jax-CPU matmul lowering
+            from tpu3fs.ops import native_ec
+
+            if native_ec.available():
+                return jnp.asarray(native_ec.crc32c_batch(np.asarray(chunks)))
         return self._jit(chunks)

@@ -86,9 +86,12 @@ class RSCode:
 
     # -- kernel selection ---------------------------------------------------
     def _apply_bit_matrix(self, A_bits: jnp.ndarray, key,
-                          data: jnp.ndarray) -> jnp.ndarray:
+                          data: jnp.ndarray,
+                          A_sym: np.ndarray = None) -> jnp.ndarray:
         """Apply a symbol-major (8o, 8k) bit matrix via the fastest backend:
-        the fused Pallas kernel on TPU, the jitted einsum form elsewhere."""
+        the fused Pallas kernel on TPU; on non-TPU backends the native SIMD
+        nibble-table path (when given the symbol matrix and concrete data);
+        the jitted einsum form as the last resort and under tracing."""
         from tpu3fs.ops import pallas_rs
 
         if pallas_rs.backend_supports_pallas():
@@ -97,6 +100,12 @@ class RSCode:
                 A_pm = pallas_rs.prepare_matrix(np.asarray(A_bits))
                 self._pallas_matrices[key] = A_pm
             return pallas_rs.gf2_matmul(A_pm, data)
+        if A_sym is not None and not isinstance(data, jax.core.Tracer):
+            from tpu3fs.ops import native_ec
+
+            if native_ec.available():
+                return jnp.asarray(native_ec.gf_apply(
+                    np.asarray(A_sym), np.asarray(data)))
         fn = self._einsum_fns.get(key)
         if fn is None:
             fn = jax.jit(functools.partial(_bit_matmul, A_bits))
@@ -110,7 +119,35 @@ class RSCode:
     def encode(self, data: jnp.ndarray) -> jnp.ndarray:
         """(..., k, S) uint8 data -> (..., m, S) parity."""
         assert data.shape[-2] == self.k, (data.shape, self.k)
-        return self._apply_bit_matrix(self._parity_bits, "encode", data)
+        return self._apply_bit_matrix(self._parity_bits, "encode", data,
+                                      A_sym=self.parity_matrix)
+
+    def encode_host(self, data: np.ndarray) -> np.ndarray:
+        """Host-side (numpy in, numpy out) encode — the CPU-backend serving
+        path. Picks the native SIMD kernel when the library is loadable,
+        the numpy LUT gold otherwise. All host-side kernel selection lives
+        HERE (stripe.py and callers stay dispatch-free)."""
+        from tpu3fs.ops import native_ec
+
+        if native_ec.available():
+            return native_ec.gf_apply(self.parity_matrix, data)
+        return self.encode_np(data)
+
+    def reconstruct_host(
+        self,
+        present_idx: Sequence[int],
+        lost_idx: Sequence[int],
+        present_shards: np.ndarray,
+    ) -> np.ndarray:
+        """Host-side reconstruction (native SIMD when available)."""
+        from tpu3fs.ops import native_ec
+
+        if native_ec.available():
+            R = self._reconstruct_matrix(
+                tuple(int(i) for i in present_idx),
+                tuple(int(i) for i in lost_idx))
+            return native_ec.gf_apply(R, np.asarray(present_shards))
+        return self.reconstruct_np(present_idx, lost_idx, present_shards)
 
     def encode_np(self, data: np.ndarray) -> np.ndarray:
         """Numpy host encode: one pass per (i, j) coefficient. c==1 rows
@@ -170,13 +207,27 @@ class RSCode:
             if self._xor_rebuild_applies(present, lost):
                 # single loss covered by the all-ones parity row: the lost
                 # shard is the plain XOR of the k survivors — byte XOR at
-                # VPU/HBM speed, no GF matmul (the RAID rebuild path)
-                fn = jax.jit(_xor_reduce_shards)
+                # VPU/HBM speed, no GF matmul (the RAID rebuild path).
+                # On CPU backends concrete data drops to the native SIMD
+                # XOR via the all-ones row of gf_apply.
+                jitted = jax.jit(_xor_reduce_shards)
+                ones = np.ones((1, self.k), dtype=np.uint8)
+
+                def fn(data, _jitted=jitted, _ones=ones):
+                    from tpu3fs.ops import native_ec, pallas_rs
+
+                    if (not pallas_rs.backend_supports_pallas()
+                            and not isinstance(data, jax.core.Tracer)
+                            and native_ec.available()):
+                        return jnp.asarray(
+                            native_ec.gf_apply(_ones, np.asarray(data)))
+                    return _jitted(data)
             else:
                 R = self._reconstruct_matrix(present, lost)
                 R_bits = GF.expand_to_bits(R).astype(np.int8)
                 fn = functools.partial(
-                    self._apply_bit_matrix, jnp.asarray(R_bits), key
+                    self._apply_bit_matrix, jnp.asarray(R_bits), key,
+                    A_sym=R,
                 )
             self._reconstruct_fns[key] = fn
         return fn

@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from tpu3fs.ops.crc32c import BatchCrc32c, crc32c
+from tpu3fs.ops.crc32c import BatchCrc32c, crc32c, crc32c_batch_host
 from tpu3fs.ops.rs import RSCode
 
 # codecs are heavyweight (device matrices + compiled fns): share per-process
@@ -92,12 +92,11 @@ class StripeCodec:
         b, k, s = data.shape
         assert k == self.k and s == self.shard_size, (data.shape, self.k)
         if self._use_host():
-            parity = self.rs.encode_np(data)
+            # host kernel selection (native SIMD vs numpy gold) lives in
+            # RSCode.encode_host / crc32c_batch_host — one dispatch layer
+            parity = self.rs.encode_host(data)
             shards_np = np.concatenate([data, parity], axis=1)
-            flat = shards_np.reshape(b * (k + self.m), s)
-            crcs_np = np.fromiter(
-                (crc32c(row.tobytes()) for row in flat),
-                dtype=np.uint32, count=flat.shape[0])
+            crcs_np = crc32c_batch_host(shards_np.reshape(b * (k + self.m), s))
             return shards_np, crcs_np.reshape(b, k + self.m)
         import jax
         import jax.numpy as jnp
@@ -129,7 +128,7 @@ class StripeCodec:
         tpu3fs.parallel.rebuild.rebuild_lost_shard over a mesh (same
         reconstruct_fn underneath)."""
         if self._use_host():
-            return self.rs.reconstruct_np(present_idx, lost_idx, present)
+            return self.rs.reconstruct_host(present_idx, lost_idx, present)
         import jax
         import jax.numpy as jnp
 
@@ -139,9 +138,7 @@ class StripeCodec:
     def crc_batch(self, shards: np.ndarray) -> np.ndarray:
         """(N, S) uint8 -> (N,) uint32 (device; host CRC on CPU backends)."""
         if self._use_host():
-            shards = np.ascontiguousarray(shards, dtype=np.uint8)
-            return np.fromiter((crc32c(row.tobytes()) for row in shards),
-                               dtype=np.uint32, count=shards.shape[0])
+            return crc32c_batch_host(shards)
         import jax
 
         return np.asarray(jax.device_get(self._crc.compute(shards)))
