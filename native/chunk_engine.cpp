@@ -1030,6 +1030,10 @@ int ce_compact(void* h) {
   return e->compact();
 }
 
+// ABI fingerprint scanned as raw bytes by the Python loader BEFORE dlopen;
+// bump in lockstep with native_engine._ABI_TAG on any layout change
+__attribute__((used)) const char kAbiTag[] = "TPU3FS_ENGINE_ABI_4";
+
 uint32_t ce_crc32c(const uint8_t* data, uint64_t n) { return crc32c(data, n); }
 uint32_t ce_crc32c_seed(const uint8_t* data, uint64_t n, uint32_t crc) {
   return crc32c(data, n, crc);
@@ -1315,7 +1319,7 @@ int ce_crc32c_batch(const uint8_t* data, uint64_t n_rows, uint64_t stride,
 
 struct CUpOp {
   uint8_t key[kKeyLen];
-  uint8_t flags;       // 1 = full_replace
+  uint8_t flags;       // 1 = full_replace; 2 = validate expected_crc
   uint8_t pad0[3];
   uint32_t offset;     // write offset within the chunk
   uint32_t data_len;
@@ -1323,6 +1327,8 @@ struct CUpOp {
   uint32_t aux;        // opaque tag stored with the staged content
   uint64_t data_off;   // offset of this op's payload in the shared blob
   uint64_t update_ver; // 0 = assign committed+1 (head write)
+  uint32_t expected_crc;  // content CRC to enforce when flags & 2
+  uint32_t pad1;
 };
 
 struct COpResult {
@@ -1355,7 +1361,7 @@ int ce_batch_update(void* h, uint64_t chain_ver, const uint8_t* blob,
     uint32_t len = 0, crc = 0;
     r.rc = e->update(k, &ver, chain_ver, blob + op.data_off, op.data_len,
                      op.offset, op.flags & 1, op.chunk_size, op.aux, &len,
-                     &crc);
+                     &crc, (op.flags >> 1) & 1, op.expected_crc);
     r.ver = ver;
     r.len = len;
     r.crc = crc;

@@ -336,3 +336,149 @@ class TestFileEndToEnd:
         inode = fab.meta.close(res.inode.id, res.session_id)
         fab.fail_node(Fabric.FIRST_STORAGE_NODE_ID)
         assert fio.read(inode, 0, len(blob)) == blob
+
+
+class TestBoundedServerState:
+    """Server-side tables must stay bounded under churn (round-3 verdict
+    ask #5; ref caps channels at 1024, UpdateChannelAllocator.h:11-34)."""
+
+    def test_chunk_lock_table_is_fixed_size(self):
+        fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=2, num_chains=2, num_replicas=2,
+            chunk_size=4096))
+        svc = fab.nodes[min(fab.nodes)].service
+        base = len(svc._locks)
+        client = fab.storage_client()
+        chain = fab.chain_ids[0]
+        for i in range(300):  # 300 distinct chunks ever touched
+            client.write_chunk(chain, ChunkId(7000, i), 0, b"x", chunk_size=4096)
+        assert len(svc._locks) == base  # striped table: no per-chunk growth
+
+    def test_channel_table_lru_cap_and_prune(self):
+        from tpu3fs.storage.craq import _ChannelTable
+        from tpu3fs.storage.craq import WriteReq as WR
+
+        t = _ChannelTable(capacity=64, grace_s=0.0)
+
+        def req(client, chan, seq):
+            return WR(chain_id=1, chunk_id=ChunkId(1, 1), offset=0,
+                      data=b"", chain_ver=1, chunk_size=4096,
+                      client_id=client, channel_id=chan, seqnum=seq)
+
+        from tpu3fs.storage.craq import UpdateReply
+        for c in range(100):
+            t.store(req("cli", c + 1, 1), UpdateReply(Code.OK))
+        assert len(t) == 64                      # LRU cap enforced
+        # most-recent channel still deduplicates
+        assert t.check(req("cli", 100, 1)) is not None
+        # evicted (oldest) channel forgot its slot -> falls back to the
+        # engine's version algebra (returns None = not a known duplicate)
+        assert t.check(req("cli", 1, 1)) is None
+        t.store(req("other", 1, 1), UpdateReply(Code.OK))
+        assert t.prune_client("cli") == 63
+        assert len(t) == 1
+        # grace window: a full table of RECENT slots must NOT evict — a
+        # ver-0 head-write retry depends on its slot surviving the ladder
+        g = _ChannelTable(capacity=8)  # default 60s grace
+        for c in range(20):
+            g.store(req("cli", c + 1, 1), UpdateReply(Code.OK))
+        assert len(g) == 20            # overshoot kept until slots age
+        assert g.check(req("cli", 1, 1)) is not None
+
+    def test_prune_rpc_reaps_channels(self):
+        fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=2, num_chains=1, num_replicas=2,
+            chunk_size=4096))
+        client = fab.storage_client()
+        chain = fab.chain_ids[0]
+        for i in range(4):
+            client.write_chunk(chain, ChunkId(7100, i), 0, b"y", chunk_size=4096)
+        svc = next(n.service for n in fab.nodes.values()
+                   if len(n.service._channels) > 0)
+        assert len(svc._channels) > 0
+        reaped = svc.prune_client_channels(client.client_id)
+        assert reaped > 0
+        assert len(svc._channels) == 0
+
+
+class TestUpdateWorkerPipeline:
+    """Per-target update queues (ref UpdateWorker.h:11-46): group commit,
+    per-chunk FIFO order, bounded-queue refusal (round-3 verdict ask #3)."""
+
+    def test_concurrent_batches_coalesce_and_apply(self):
+        import threading
+
+        fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=3, num_chains=1, num_replicas=2,
+            chunk_size=4096))
+        sc = fab.storage_client()
+        chain = fab.chain_ids[0]
+        errs = []
+
+        def writer(base):
+            try:
+                writes = [(chain, ChunkId(8000 + base, i), 0,
+                           bytes([base]) * 512) for i in range(8)]
+                outs = sc.batch_write(writes, chunk_size=4096)
+                assert all(o.ok for o in outs), [o.message for o in outs]
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=writer, args=(b,)) for b in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        # every write readable with its own content
+        for b in range(6):
+            r = sc.read_chunk(chain, ChunkId(8000 + b, 3))
+            assert r.ok and r.data == bytes([b]) * 512
+
+    def test_same_chunk_updates_keep_fifo_order(self):
+        fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=3, num_chains=1, num_replicas=2,
+            chunk_size=4096))
+        sc = fab.storage_client()
+        chain = fab.chain_ids[0]
+        cid = ChunkId(8100, 0)
+        for v in range(1, 9):
+            out = sc.write_chunk(chain, cid, 0, bytes([v]) * 64,
+                                 chunk_size=4096)
+            assert out.ok
+        r = sc.read_chunk(chain, cid)
+        assert r.ok and r.data == bytes([8]) * 64
+        assert r.commit_ver == 8
+
+    def test_bounded_queue_refuses_with_retriable_code(self):
+        from tpu3fs.storage.update_worker import UpdateWorker
+        import threading
+
+        gate = threading.Event()
+
+        def slow_runner(reqs):
+            gate.wait(5.0)
+            return ["ok"] * len(reqs)
+
+        w = UpdateWorker(slow_runner, queue_cap=2, name="t")
+        make = lambda code, msg: (code, msg)
+
+        class R:  # minimal req double
+            def __init__(self, i):
+                self.chain_id = 1
+                self.chunk_id = ChunkId(1, i)
+
+        results = []
+        ts = [threading.Thread(
+            target=lambda i=i: results.append(w.submit([R(i)], make)))
+            for i in range(6)]
+        for t in ts:
+            t.start()
+        import time
+        time.sleep(0.3)       # let the queue fill behind the stalled runner
+        overflow = w.submit([R(99)], make)
+        gate.set()
+        for t in ts:
+            t.join()
+        assert overflow == [(Code.TIMEOUT, "update queue full")]
+        w.stop()

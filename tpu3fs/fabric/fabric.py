@@ -248,6 +248,7 @@ class Fabric:
         node = self.nodes[node_id]
         node.alive = False
         node.service.stopped = True
+        node.service.stop_workers()
 
     def fail_node(self, node_id: int) -> None:
         """Kill + advance time past the heartbeat timeout + chain update."""

@@ -71,6 +71,11 @@ class TruncateChunksReq:
 
 
 @dataclass
+class PruneClientReq:
+    client_id: str
+
+
+@dataclass
 class BatchReadReq:
     reqs: List[ReadReq] = field(default_factory=list)
 
@@ -191,6 +196,10 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     s.method(16, "statChunks", StatChunksReq, StatChunksRsp,
              lambda r: StatChunksRsp(
                  [list(t) for t in svc.stat_chunks(r.target_id, r.chunk_ids)]))
+    # channel reaping for departed clients (the reference prunes update
+    # channels via client sessions, UpdateChannelAllocator.h:11-34)
+    s.method(17, "pruneClientChannels", PruneClientReq, IntReply,
+             lambda r: IntReply(svc.prune_client_channels(r.client_id)))
     server.add_service(s)
 
 

@@ -37,6 +37,7 @@ class EngineUpdateOp:
     full_replace: bool = False
     chunk_size: int = 0
     aux: int = 0                 # opaque tag stored with the staged content
+    expected_crc: Optional[int] = None  # validated install (EC shard path)
 
 
 @dataclass
@@ -141,7 +142,7 @@ class ChunkEngine(abc.ABC):
                 meta = self.update(
                     op.chunk_id, ver, chain_ver, op.data, op.offset,
                     full_replace=op.full_replace, chunk_size=op.chunk_size,
-                    aux=op.aux,
+                    aux=op.aux, expected_crc=op.expected_crc,
                 )
                 if op.full_replace:
                     out.append(EngineOpResult(

@@ -64,6 +64,8 @@ class _CUpOp(ctypes.Structure):
         ("aux", ctypes.c_uint32),
         ("data_off", ctypes.c_uint64),
         ("update_ver", ctypes.c_uint64),
+        ("expected_crc", ctypes.c_uint32),
+        ("pad1", ctypes.c_uint32),
     ]
 
 
@@ -91,14 +93,31 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+# bumped on any C struct layout / entry-point change; must match kAbiTag in
+# native/chunk_engine.cpp. Checked as raw bytes in the .so BEFORE dlopen —
+# once a stale library is dlopen'ed, no in-process rebuild can replace it
+# (dlopen dedups by pathname), so the check has to happen first.
+_ABI_TAG = b"TPU3FS_ENGINE_ABI_4"
+
+
+def _abi_matches(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return _ABI_TAG in f.read()
+    except OSError:
+        return False
+
+
 def _load_lib():
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        if not os.path.exists(_LIB_PATH) or not _abi_matches(_LIB_PATH):
+            # missing OR stale-layout .so: rebuild before the first dlopen
+            # (a layout mismatch would silently misparse every batch op)
             subprocess.run(
-                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                ["make", "-B", "-C", os.path.abspath(_NATIVE_DIR)],
                 check=True,
                 capture_output=True,
             )
@@ -344,13 +363,15 @@ class NativeChunkEngine(ChunkEngine):
         for i, op in enumerate(ops):
             c = c_ops[i]
             ctypes.memmove(c.key, op.chunk_id.to_bytes(), _KEYLEN)
-            c.flags = 1 if op.full_replace else 0
+            c.flags = ((1 if op.full_replace else 0)
+                       | (2 if op.expected_crc is not None else 0))
             c.offset = op.offset
             c.data_len = len(op.data)
             c.chunk_size = op.chunk_size
             c.aux = op.aux
             c.data_off = blob_off
             c.update_ver = op.update_ver
+            c.expected_crc = (op.expected_crc or 0) & 0xFFFFFFFF
             parts.append(op.data)
             blob_off += len(op.data)
         blob = b"".join(parts)
