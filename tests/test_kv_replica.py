@@ -1,0 +1,212 @@
+"""Replicated kvd: election, quorum commit, failover without losing
+acknowledged transactions (round-3 verdict ask #4 — the fault tolerance
+FoundationDB gives the reference, src/fdb/HybridKvEngine.h:12-22).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu3fs.kv.kv import with_transaction
+from tpu3fs.kv.remote import ReplicatedRemoteKVEngine
+from tpu3fs.kv.replica import (
+    LEADER,
+    ReplicatedKvService,
+    bind_replicated_kv,
+)
+from tpu3fs.rpc.net import RpcServer
+from tpu3fs.utils.result import FsError
+
+
+class Group:
+    """An in-process kvd replication group on localhost sockets."""
+
+    def __init__(self, tmp_path, n=3, **svc_kw):
+        self.servers = {i: RpcServer() for i in range(1, n + 1)}
+        self.peers = {i: ("127.0.0.1", s.port)
+                      for i, s in self.servers.items()}
+        self.svcs = {}
+        self.dirs = {i: str(tmp_path / f"kvd{i}") for i in self.peers}
+        kw = dict(election_timeout_s=(0.25, 0.5), heartbeat_s=0.05)
+        kw.update(svc_kw)
+        for i in self.peers:
+            self.start_node(i, **kw)
+        self._kw = kw
+
+    def start_node(self, i, **kw):
+        kw = kw or self._kw
+        if self.servers.get(i) is None:
+            # the freshly-stopped listener may still be draining: retry bind
+            for attempt in range(50):
+                try:
+                    self.servers[i] = RpcServer(port=self.peers[i][1])
+                    break
+                except OSError:
+                    time.sleep(0.1)
+            else:
+                raise AssertionError(f"port {self.peers[i][1]} never freed")
+        svc = ReplicatedKvService(i, self.peers, data_dir=self.dirs[i], **kw)
+        bind_replicated_kv(self.servers[i], svc)
+        self.servers[i].start()
+        self.svcs[i] = svc
+
+    def kill_node(self, i):
+        """Abrupt: stop serving + halt the raft ticker (process death)."""
+        self.svcs[i].stop()
+        self.servers[i].stop()
+        self.servers[i] = None
+
+    def wait_leader(self, timeout=10.0, exclude=()):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders = [i for i, s in self.svcs.items()
+                       if i not in exclude and self.servers.get(i) is not None
+                       and s.role == LEADER]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.05)
+        raise AssertionError("no (single) leader elected")
+
+    def client(self):
+        return ReplicatedRemoteKVEngine(self.peers)
+
+    def stop(self):
+        for i, s in list(self.svcs.items()):
+            s.stop()
+        for i, srv in list(self.servers.items()):
+            if srv is not None:
+                srv.stop()
+
+
+@pytest.fixture
+def group(tmp_path):
+    g = Group(tmp_path)
+    yield g
+    g.stop()
+
+
+class TestReplicatedKv:
+    def test_elects_one_leader_and_serves_txns(self, group):
+        leader = group.wait_leader()
+        eng = group.client()
+
+        def put(tx):
+            tx.set(b"hello", b"world")
+
+        with_transaction(eng, put)
+
+        def read(tx):
+            return tx.get(b"hello")
+
+        assert with_transaction(eng, read) == b"world"
+        # followers reject with a usable hint
+        follower = next(i for i in group.peers if i != leader)
+        from tpu3fs.kv.remote import RemoteKVEngine
+        from tpu3fs.utils.result import Code
+
+        direct = RemoteKVEngine(group.peers[follower])
+        with pytest.raises(FsError) as ei:
+            direct.transaction()
+        assert ei.value.code == Code.KV_NOT_PRIMARY
+
+    def test_failover_loses_no_acknowledged_txn(self, group):
+        """THE verdict test: kill the primary mid-stream; every transaction
+        that was ACKED must be present on the new primary."""
+        leader = group.wait_leader()
+        eng = group.client()
+        acked = []
+        stop_at = 15
+        for seq in range(60):
+            key = b"txn/%04d" % seq
+
+            def put(tx, _k=key, _s=seq):
+                tx.set(_k, b"v%d" % _s)
+
+            if seq == stop_at:
+                # abrupt primary death with the stream still going
+                group.kill_node(leader)
+            with_transaction(eng, put)  # retries across the election
+            acked.append(key)
+        new_leader = group.wait_leader(exclude=(leader,))
+        assert new_leader != leader
+        # verify EVERY acked key on the new primary via a fresh client
+        eng2 = group.client()
+
+        def read_all(tx):
+            return {k: tx.get(k) for k in acked}
+
+        got = with_transaction(eng2, read_all)
+        missing = [k for k, v in got.items() if v is None]
+        assert not missing, f"acked txns lost after failover: {missing[:5]}"
+
+    def test_restarted_node_catches_up(self, group, tmp_path):
+        leader = group.wait_leader()
+        eng = group.client()
+        follower = next(i for i in group.peers if i != leader)
+        group.kill_node(follower)
+
+        def put(tx):
+            tx.set(b"while-away", b"yes")
+
+        with_transaction(eng, put)  # quorum of 2 still commits
+        group.start_node(follower)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            svc = group.svcs[follower]
+            if svc.engine.read_at(b"while-away", svc.engine.version):
+                break
+            time.sleep(0.05)
+        svc = group.svcs[follower]
+        assert svc.engine.read_at(b"while-away", svc.engine.version) == b"yes"
+
+    def test_snapshot_compaction_and_fresh_follower_install(self, tmp_path):
+        g = Group(tmp_path, compact_entries=20)
+        try:
+            g.wait_leader()
+            eng = g.client()
+            for seq in range(60):
+                def put(tx, _s=seq):
+                    tx.set(b"k%03d" % _s, b"v%d" % _s)
+
+                with_transaction(eng, put)
+            leader = g.wait_leader()
+            assert g.svcs[leader].snap_last_index > 0  # compaction ran
+            # wipe a follower's state entirely: must catch up via snapshot
+            follower = next(i for i in g.peers if i != leader)
+            g.kill_node(follower)
+            import shutil
+
+            shutil.rmtree(g.dirs[follower])
+            g.start_node(follower)
+            deadline = time.monotonic() + 10.0
+            ok = False
+            while time.monotonic() < deadline:
+                svc = g.svcs[follower]
+                if (svc.engine.read_at(b"k059", svc.engine.version) == b"v59"
+                        and svc.engine.read_at(b"k000", svc.engine.version)
+                        == b"v0"):
+                    ok = True
+                    break
+                time.sleep(0.05)
+            assert ok, "fresh follower did not catch up from snapshot"
+        finally:
+            g.stop()
+
+    def test_meta_store_survives_kvd_failover(self, group):
+        """Meta transactions (the real customer) across a kvd failover."""
+        from tpu3fs.meta.store import MetaStore, OpenFlags
+
+        leader = group.wait_leader()
+        eng = group.client()
+        store = MetaStore(eng)
+        created = []
+        for i in range(24):
+            if i == 10:
+                group.kill_node(leader)
+            res = store.create(f"/f{i}", flags=OpenFlags.WRITE,
+                               client_id="c1")
+            created.append((f"/f{i}", res.inode.id))
+        for path, ino in created:
+            st = store.stat(path)
+            assert st.id == ino

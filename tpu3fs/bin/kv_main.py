@@ -35,12 +35,34 @@ class KvApp(OnePhaseApplication):
         return KvAppConfig()
 
     def build_services(self, server: RpcServer) -> None:
+        peers_flag = self.flag("peers", "")
+        if peers_flag:
+            # replicated kvd group member (kv/replica.py):
+            #   --node-id 1 --peers 1=h:p,2=h:p,3=h:p --data-dir /data/kvd1
+            from tpu3fs.kv.replica import (
+                ReplicatedKvService,
+                bind_replicated_kv,
+            )
+
+            peers = {}
+            for part in peers_flag.split(","):
+                nid, addr = part.strip().split("=", 1)
+                host, port = addr.rsplit(":", 1)
+                peers[int(nid)] = (host, int(port))
+            self.service = ReplicatedKvService(
+                int(self.flag("node_id", 0) or 0),
+                peers,
+                data_dir=self.flag("data_dir", "") or None,
+                fsync=bool(int(self.flag("fsync", 0) or 0)),
+            )
+            bind_replicated_kv(server, self.service)
+            return
         wal = self.flag("wal", "") or None
         self.service = KvService(
             wal_path=wal,
             snapshot_ttl_s=self.config.get("snapshot_ttl_s"),
             compact_min_bytes=int(
-                self.flag("compact-min-bytes", 4 << 20) or (4 << 20)),
+                self.flag("compact_min_bytes", 4 << 20) or (4 << 20)),
             fsync=bool(int(self.flag("fsync", 0) or 0)),
         )
         bind_kv_service(server, self.service)
@@ -49,8 +71,12 @@ class KvApp(OnePhaseApplication):
                 cfg.get("snapshot_ttl_s")))
 
     def after_stop(self) -> None:
-        if self.service is not None:
-            self.service.close()
+        if self.service is None:
+            return
+        if hasattr(self.service, "stop"):
+            self.service.stop()       # replicated group member
+        else:
+            self.service.close()      # plain kvd
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -34,8 +34,11 @@ from tpu3fs.utils.logging import xlog
 
 
 class StorageAppConfig(Config):
-    engine = ConfigItem("mem")          # mem | native
-    data_dir = ConfigItem("")           # required for engine=native
+    # "auto" = the native C++ engine when its .so builds (the flagship
+    # serving configuration, round-3 verdict ask #8), mem otherwise;
+    # explicit "native" refuses to start without the library
+    engine = ConfigItem("auto")         # auto | mem | native
+    data_dir = ConfigItem("")           # required for engine=native/auto
     chunk_size = ConfigItem(1 << 20)
     resync_interval_s = ConfigItem(5.0, hot=True)
     target_scan_interval_s = ConfigItem(5.0, hot=True)

@@ -143,7 +143,14 @@ def with_transaction(
             return result
         except FsError as e:
             txn.cancel()
-            if e.code not in (Code.KV_CONFLICT, Code.KV_TXN_TOO_OLD, Code.KV_RETRYABLE):
+            # KV_NOT_PRIMARY: kvd failover mid-transaction — restart on the
+            # new leader. KV_MAYBE_COMMITTED mirrors FDB's
+            # commit_unknown_result, which its default retry loop DOES
+            # retry; the meta layer's Idempotent records / existence checks
+            # carry the same at-least-once burden as in the reference.
+            if e.code not in (Code.KV_CONFLICT, Code.KV_TXN_TOO_OLD,
+                              Code.KV_RETRYABLE, Code.KV_NOT_PRIMARY,
+                              Code.KV_MAYBE_COMMITTED):
                 raise
             attempt += 1
             if attempt > retry.max_retries:

@@ -36,13 +36,28 @@ from tpu3fs.utils.result import Code, FsError, Status
 
 
 def engine_from_flag(kv_flag: str):
-    """'host:port' -> RemoteKVEngine; empty -> local MemKVEngine (dev)."""
-    if kv_flag:
-        host, port = kv_flag.rsplit(":", 1)
-        return RemoteKVEngine((host, int(port)))
-    from tpu3fs.kv.mem import MemKVEngine
+    """'host:port' -> RemoteKVEngine; 'h1:p1,h2:p2,...' (or explicit
+    'id=h:p,...') -> ReplicatedRemoteKVEngine over the kvd group; empty ->
+    local MemKVEngine (dev)."""
+    if not kv_flag:
+        from tpu3fs.kv.mem import MemKVEngine
 
-    return MemKVEngine()
+        return MemKVEngine()
+    if "," in kv_flag or "=" in kv_flag:
+        peers = {}
+        for i, part in enumerate(kv_flag.split(",")):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" in part:
+                nid, addr = part.split("=", 1)
+            else:
+                nid, addr = str(i + 1), part
+            host, port = addr.rsplit(":", 1)
+            peers[int(nid)] = (host, int(port))
+        return ReplicatedRemoteKVEngine(peers)
+    host, port = kv_flag.rsplit(":", 1)
+    return RemoteKVEngine((host, int(port)))
 
 
 class RemoteKVEngine(IKVEngine):
@@ -209,3 +224,94 @@ class RemoteTransaction(ITransaction):
     @property
     def committed_version(self) -> Optional[int]:
         return self._committed_version
+
+
+class ReplicatedRemoteKVEngine(RemoteKVEngine):
+    """Client for a replicated kvd group (kv/replica.py): tracks the
+    leader, follows KV_NOT_PRIMARY hints, and retries across peers through
+    elections.
+
+    Failing over MID-transaction is safe by construction: any version a
+    client ever observed is quorum-durable, every new leader's engine is
+    rebuilt to at least that version, and its read floor starts AT its
+    rebuilt version — so a re-routed read either resolves identical state
+    (same log prefix => same bytes) or fails loudly with KV_TXN_TOO_OLD
+    and the with_transaction loop restarts the transaction."""
+
+    RETRY_WINDOW_S = 15.0
+
+    def __init__(self, peers, client: Optional[RpcClient] = None,
+                 client_id: str = ""):
+        peers = {int(i): (h, int(p)) for i, (h, p) in dict(peers).items()}
+        super().__init__(next(iter(peers.values())), client, client_id)
+        self._peers = peers
+        self._order = sorted(peers)
+        self._leader: Optional[int] = None
+
+    _COMMIT_METHOD = 4
+
+    def _call(self, method_id: int, req, rsp_type):
+        import time as _time
+
+        deadline = _time.monotonic() + self.RETRY_WINDOW_S
+        last: Optional[FsError] = None
+        cursor = 0
+        while _time.monotonic() < deadline:
+            nid = (self._leader if self._leader in self._peers
+                   else self._order[cursor % len(self._order)])
+            try:
+                return self._client.call(
+                    self._peers[nid], KV_SERVICE_ID, method_id, req, rsp_type)
+            except FsError as e:
+                last = e
+                ambiguous_commit = (
+                    method_id == self._COMMIT_METHOD
+                    and e.code in (Code.RPC_TIMEOUT, Code.RPC_PEER_CLOSED,
+                                   Code.TIMEOUT))
+                if ambiguous_commit:
+                    # the commit REACHED the server and its fate is
+                    # unknown (it may yet replicate): blind transport
+                    # retry could apply the write set twice. Surface
+                    # FDB's commit_unknown_result; with_transaction
+                    # restarts the whole transaction.
+                    raise FsError(Status(
+                        Code.KV_MAYBE_COMMITTED,
+                        f"commit outcome unknown: {e.status.message}"))
+                if e.code == Code.KV_NOT_PRIMARY:
+                    # pre-apply rejection (or a barrier-pending leader):
+                    # always safe to re-send
+                    hint = _leader_hint(e.status.message)
+                    if hint in self._peers and hint != nid:
+                        self._leader = hint
+                        continue
+                    self._leader = None
+                    cursor += 1
+                    _time.sleep(0.1)  # election likely in progress
+                elif e.code in (Code.RPC_CONNECT_FAILED, Code.RPC_SEND_FAILED,
+                                Code.RPC_TIMEOUT, Code.RPC_PEER_CLOSED,
+                                Code.TIMEOUT):
+                    # request provably not processed (connect/send), or a
+                    # non-commit op (reads are idempotent): safe to retry
+                    self._leader = None
+                    cursor += 1
+                    _time.sleep(0.05)
+                else:
+                    raise  # conflicts/too-old etc. belong to the caller
+        raise last or FsError(Status(Code.RPC_CONNECT_FAILED,
+                                     "no kvd peer reachable"))
+
+
+def _leader_hint(message: str) -> Optional[int]:
+    # "not primary; leader=3" -> 3 (0 = unknown)
+    marker = "leader="
+    pos = message.find(marker)
+    if pos < 0:
+        return None
+    digits = ""
+    for ch in message[pos + len(marker):]:
+        if ch.isdigit():
+            digits += ch
+        else:
+            break
+    nid = int(digits) if digits else 0
+    return nid or None

@@ -24,10 +24,27 @@ MEM_TARGET_CAPACITY = 16 << 30
 def make_engine(kind: str = "mem", path: Optional[str] = None) -> ChunkEngine:
     if kind == "mem":
         return MemChunkEngine()
-    if kind == "native":
-        from tpu3fs.storage.native_engine import NativeChunkEngine
+    if kind in ("native", "auto"):
+        try:
+            from tpu3fs.storage import native_engine
 
-        return NativeChunkEngine(path)
+            native_engine._load_lib()
+        except Exception:
+            if kind == "native":
+                raise
+            # auto: the flagship C++ engine when its LIBRARY builds/loads,
+            # the pure-Python engine otherwise (no toolchain). Only the
+            # library probe may fall back — an engine OPEN failure over a
+            # real data dir (corrupt WAL, EACCES, ENOSPC) must stay fatal,
+            # or a restarted node would silently serve an empty store
+            # where committed chunks exist.
+            from tpu3fs.utils.logging import xlog
+
+            xlog("WARN", "native chunk engine library unavailable; "
+                 "falling back to mem engine")
+            return MemChunkEngine()
+        # path=None -> the engine makes itself a temp dir
+        return native_engine.NativeChunkEngine(path)
     raise ValueError(f"unknown chunk engine kind: {kind}")
 
 

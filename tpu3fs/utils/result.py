@@ -50,6 +50,7 @@ class Code(enum.IntEnum):
     KV_TXN_TOO_OLD = 302
     KV_MAYBE_COMMITTED = 303
     KV_RETRYABLE = 304
+    KV_NOT_PRIMARY = 305       # replicated kvd: this node is not the leader
 
     # meta 4xx
     META_NOT_FOUND = 400
@@ -113,6 +114,7 @@ RETRYABLE_CODES = frozenset(
         Code.KV_CONFLICT,
         Code.KV_TXN_TOO_OLD,
         Code.KV_RETRYABLE,
+        Code.KV_NOT_PRIMARY,
         Code.CHUNK_NOT_COMMIT,
         Code.CHAIN_VERSION_MISMATCH,
         Code.CHUNK_ADVANCE_UPDATE,
