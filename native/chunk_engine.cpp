@@ -468,6 +468,7 @@ struct Engine {
   // page faults would serialize on actual disk IO). Env override:
   // TPU3FS_MMAP=0|1.
   bool use_mmap = false;
+  bool on_tmpfs = false;  // detected (never forced): gates fsync skipping
 
   // ensure class `cls`'s file and mapping cover [0, end); -> map or null
   uint8_t* map_for(int cls, size_t end) {
@@ -713,7 +714,10 @@ struct Engine {
     // never truncate BELOW pwrite-extended length (that would zero blocks)
     if (static_cast<size_t>(off) + len > sc.file_len)
       sc.file_len = static_cast<size_t>(off) + len;
-    if (use_mmap) return OK;  // tmpfs: fsync is meaningless
+    if (on_tmpfs) return OK;  // tmpfs: fsync is meaningless
+    // NOTE: a forced TPU3FS_MMAP=1 on a real filesystem keeps full
+    // durable-mode syncing — block content must hit disk before the WAL
+    // record that references it
     // durable mode: block content must be on disk before the WAL record
     // that references it
     if (fsync_wal && fdatasync(sc.fd) != 0) return E_IO;
@@ -1007,15 +1011,12 @@ void* ce_open(const char* dir, int fsync_wal) {
   {
     // memory-backed dir => mmap IO (no device to AIO against); real
     // filesystems keep io_uring/pread. TPU3FS_MMAP=0|1 overrides.
-    const char* ov = getenv("TPU3FS_MMAP");
-    if (ov != nullptr) {
-      e->use_mmap = ov[0] == '1';
-    } else {
-      struct statfs sfs;
-      if (statfs(dir, &sfs) == 0) {
-        e->use_mmap = sfs.f_type == TMPFS_MAGIC || sfs.f_type == RAMFS_MAGIC;
-      }
+    struct statfs sfs;
+    if (statfs(dir, &sfs) == 0) {
+      e->on_tmpfs = sfs.f_type == TMPFS_MAGIC || sfs.f_type == RAMFS_MAGIC;
     }
+    const char* ov = getenv("TPU3FS_MMAP");
+    e->use_mmap = ov != nullptr ? ov[0] == '1' : e->on_tmpfs;
   }
   if (e->open_files() != OK || e->replay() != OK) {
     delete e;

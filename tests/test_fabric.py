@@ -482,3 +482,41 @@ class TestUpdateWorkerPipeline:
             t.join()
         assert overflow == [(Code.TIMEOUT, "update queue full")]
         w.stop()
+
+
+class TestOfflineTargetDataPath:
+    """Locally-offlined targets refuse reads/writes immediately (ref
+    offlineTarget RPC + TargetMap offlining, TargetMap.h:23), and the
+    chain updater rotates them out on the next tick."""
+
+    def test_offline_target_refuses_and_rotates(self):
+        fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=3, num_chains=1, num_replicas=2,
+            chunk_size=4096))
+        sc = fab.storage_client()
+        chain_id = fab.chain_ids[0]
+        assert sc.write_chunk(chain_id, ChunkId(9500, 0), 0, b"live",
+                              chunk_size=4096).ok
+        chain = fab.routing().chains[chain_id]
+        tail = chain.targets[-1]
+        node = fab.routing().node_of_target(tail.target_id)
+        svc = fab.nodes[node.node_id].service
+        assert svc.offline_target(tail.target_id)
+        # explicit read at the offlined target refuses
+        from tpu3fs.storage.craq import ReadReq
+
+        r = svc.read(ReadReq(chain_id=chain_id, chunk_id=ChunkId(9500, 0),
+                             target_id=tail.target_id))
+        assert r.code == Code.TARGET_OFFLINE
+        # the client still reads via the other replica
+        got = sc.read_chunk(chain_id, ChunkId(9500, 0))
+        assert got.ok and got.data == b"live"
+        # chain updater rotates the offlined target out of SERVING
+        fab.tick()
+        new_chain = fab.routing().chains[chain_id]
+        t_state = next(t.public_state for t in new_chain.targets
+                       if t.target_id == tail.target_id)
+        assert t_state != PS.SERVING
+        # writes still land on the surviving head
+        assert sc.write_chunk(chain_id, ChunkId(9500, 1), 0, b"more",
+                              chunk_size=4096).ok

@@ -357,3 +357,78 @@ class TestMisc:
         for t in threads:
             t.join()
         assert len(set(ids)) == 16
+
+
+class TestBatchClose:
+    """Batched length settles: one KV transaction per 64 closes instead of
+    one per file (round-3 verdict ask #10; ref BatchOperation.cc:750)."""
+
+    def _mk(self):
+        from tpu3fs.kv.mem import MemKVEngine
+        from tpu3fs.meta.store import BatchCloseItem, MetaStore, OpenFlags
+
+        eng = MemKVEngine()
+        store = MetaStore(eng)
+        return eng, store, BatchCloseItem, OpenFlags
+
+    def test_close_heavy_workload_txn_count(self):
+        eng, store, Item, OpenFlags = self._mk()
+        items = []
+        for i in range(256):
+            res = store.create(f"/bf{i}", flags=OpenFlags.WRITE,
+                               client_id="c1")
+            items.append(Item(inode_id=res.inode.id,
+                              session_id=res.session_id,
+                              length_hint=100 + i, wrote=1))
+        calls = {"n": 0}
+        orig = eng.transaction
+
+        def counting():
+            calls["n"] += 1
+            return orig()
+
+        eng.transaction = counting
+        results = store.batch_close(items)
+        assert calls["n"] <= 256 // 64 + 1   # O(n/64), not O(n)
+        assert all(not isinstance(r, Exception) for r in results)
+        for i in range(0, 256, 37):
+            assert store.stat(f"/bf{i}").length == 100 + i
+
+    def test_per_item_failures_dont_poison_batchmates(self):
+        eng, store, Item, OpenFlags = self._mk()
+        good = store.create("/ok", flags=OpenFlags.WRITE, client_id="c1")
+        items = [
+            Item(inode_id=good.inode.id, session_id=good.session_id,
+                 length_hint=7, wrote=1),
+            Item(inode_id=999999, session_id="nope", length_hint=1),
+        ]
+        res = store.batch_close(items)
+        from tpu3fs.utils.result import Code, FsError
+
+        assert not isinstance(res[0], FsError)
+        assert isinstance(res[1], FsError)
+        assert res[1].code in (Code.META_NOT_FOUND, Code.META_NO_SESSION)
+        assert store.stat("/ok").length == 7
+
+    def test_batch_close_over_rpc(self):
+        from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+        from tpu3fs.meta.store import BatchCloseItem, OpenFlags
+
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=2, num_chains=1,
+                                       chunk_size=4096))
+        items = []
+        for i in range(8):
+            res = fab.meta.create(f"/r{i}", flags=OpenFlags.WRITE,
+                                  client_id="rc")
+            items.append(BatchCloseItem(inode_id=res.inode.id,
+                                        session_id=res.session_id,
+                                        length_hint=10 * i, wrote=1))
+        outs = fab.meta.batch_close(items)
+        assert all(not isinstance(o, Exception) for o in outs)
+        # the fabric meta settles lengths from STORAGE (queryLastChunk
+        # hook), so the hint is rightly ignored; the sessions must be gone
+        from tpu3fs.utils.result import FsError
+
+        import pytest as _pytest
+        with _pytest.raises(FsError):
+            fab.meta.close(items[5].inode_id, items[5].session_id)

@@ -16,7 +16,7 @@ from tpu3fs.mgmtd import (
     generate_new_chain,
 )
 from tpu3fs.mgmtd.chain_sm import step_chain
-from tpu3fs.mgmtd.types import ChainInfo
+from tpu3fs.mgmtd.types import ChainInfo, LocalTargetState, PublicTargetState
 from tpu3fs.utils.result import Code, FsError
 
 
@@ -259,3 +259,69 @@ class TestHeartbeatAndChains:
         ri = m2.get_routing_info()
         assert ri.version == v
         assert 900001 in ri.chains and len(ri.targets) == 3
+
+
+class TestBackgroundRunners:
+    """The primary's runner set beyond lease/heartbeat/chain-update (ref
+    src/mgmtd/background/: NewBornChainsChecker, TargetInfoPersister,
+    MetricsUpdater; round-3 verdict missing #6)."""
+
+    def _mgmtd(self):
+        from tpu3fs.kv.mem import MemKVEngine
+
+        eng = MemKVEngine()
+        m = Mgmtd(1, eng)
+        m.extend_lease()
+        return eng, m
+
+    def test_newborn_chain_waits_then_promotes(self):
+        eng, m = self._mgmtd()
+        m.register_node(101, NodeType.STORAGE)
+        for tid in (11, 12):
+            m.create_target(tid, node_id=101)
+        m.upload_chain(5, [11, 12], wait_ready=True)
+        chain = m._routing.chains[5]
+        assert all(t.public_state == PublicTargetState.WAITING
+                   for t in chain.targets)
+        # no heartbeat yet: the checker must NOT promote
+        assert m.check_newborn_chains() == 0
+        # node reports both targets up to date
+        m.heartbeat(101, 1, {11: LocalTargetState.UPTODATE,
+                             12: LocalTargetState.UPTODATE})
+        assert m.check_newborn_chains() == 1
+        chain = m._routing.chains[5]
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in chain.targets)
+        assert chain.chain_version == 2
+        # persisted: a fresh mgmtd over the same KV sees the promotion
+        m2 = Mgmtd(2, eng)
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in m2._routing.chains[5].targets)
+
+    def test_target_info_persister_survives_restart(self):
+        eng, m = self._mgmtd()
+        m.register_node(101, NodeType.STORAGE)
+        m.create_target(21, node_id=101)
+        m.upload_chain(6, [21])
+        m.heartbeat(101, 1, {21: LocalTargetState.ONLINE})
+        assert 21 in m._dirty_targets
+        assert m.persist_target_infos() == 1
+        assert not m._dirty_targets
+        m2 = Mgmtd(2, eng)
+        assert m2._routing.targets[21].local_state == LocalTargetState.ONLINE
+
+    def test_metrics_updater_records_gauges(self):
+        eng, m = self._mgmtd()
+        m.register_node(101, NodeType.STORAGE)
+        m.heartbeat(101, 1, {})
+        m.create_target(31, node_id=101)
+        m.upload_chain(7, [31])
+        m.update_metrics()
+        import time as _t
+
+        samples = {s.name: s.value
+                   for rec in m._metrics_rec.values()
+                   for s in rec.collect(_t.time())}
+        assert samples["mgmtd.nodes_connected"] == 1
+        assert samples["mgmtd.chains_serving"] == 1
+        assert samples["mgmtd.routing_version"] >= 1

@@ -140,9 +140,7 @@ class AdminCli:
         (ref OfflineTarget admin command)."""
         tid = int(self._flag(args, "--target-id"))
         for node in self.fab.nodes.values():
-            t = node.service.target(tid)
-            if t is not None:
-                t.local_state = LocalTargetState.OFFLINE
+            node.service.offline_target(tid)
         self.fab.tick()
         return f"target {tid} offlined; routing v{self.fab.routing().version}"
 

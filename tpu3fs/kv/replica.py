@@ -678,6 +678,10 @@ class ReplicatedKvService:
                                  payload=serialize(rec))
                 self.log.append(entry)
                 self._append_durable([entry])
+                # the engine ALREADY applied this record (commit_external
+                # above): mark it applied now or _advance_applied would
+                # re-apply it after quorum, double-bumping the version
+                self.last_applied = max(self.last_applied, entry.index)
             if not self._replicate_quorum():
                 # the entry IS durably in our log: if this node is later
                 # re-elected (it may have the longest log) the entry
@@ -691,8 +695,6 @@ class ReplicatedKvService:
                 raise FsError(Status(
                     Code.KV_MAYBE_COMMITTED,
                     "lost quorum mid-commit; outcome unknown"))
-            with self._mu:
-                self.last_applied = max(self.last_applied, entry.index)
             self._maybe_compact()
         return CommitRsp(version=version)
 

@@ -130,6 +130,18 @@ class OpenFlags:
 
 
 @dataclass
+class BatchCloseItem:
+    """One close in a batch settle (wire-friendly: -1 = unset)."""
+
+    inode_id: int = 0
+    session_id: str = ""
+    length_hint: int = -1
+    client_id: str = ""
+    request_id: str = ""
+    wrote: int = -1              # -1 unset / 0 false / 1 true
+
+
+@dataclass
 class OpenResult:
     inode: Inode
     session_id: str = ""
@@ -498,47 +510,115 @@ class MetaStore:
         like a modification."""
 
         def op(txn: ITransaction) -> Inode:
-            # the cache key is scoped to the caller's identity in auth mode:
-            # a replay of another client's (client_id, request_id) by a
-            # different user misses and must pass authorization below
-            ckey = idempotent_key(client_id, request_id,
-                                  None if user is None else user.uid)
-            if request_id:
-                cached = txn.get(ckey)
-                if cached is not None:
-                    return deserialize(cached, Inode)
-            inode = self._load_inode(txn, inode_id)
-            if inode is None:
-                raise _err(Code.META_NOT_FOUND, str(inode_id))
-            skey = session_key(inode_id, session_id)
-            if session_id:
-                raw = txn.get(skey)
-                if raw is None:
-                    raise _err(Code.META_NO_SESSION, session_id)
-                if user is not None:
-                    # the session is the capability granted at open: closing
-                    # authorizes against its owner, not the live ACL (a chmod
-                    # between open and close must not wedge the session)
-                    sess = deserialize(raw, FileSession)
-                    if not (user.is_root or sess.uid == user.uid):
-                        raise _err(Code.META_NO_PERMISSION, session_id)
-                txn.clear(skey)
-            elif user is not None and not inode.acl.check_user(user, PERM_W):
-                # sessionless length settle falls back to the ACL
-                raise _err(Code.META_NO_PERMISSION, str(inode_id))
-            if inode.is_file():
-                if self._file_length_hook is not None:
-                    inode.length = self._file_length_hook(inode)
-                elif length_hint is not None:
-                    inode.length = max(inode.length, length_hint)
-                if wrote or (wrote is None and length_hint is not None):
-                    inode.mtime = time.time()
-                self._store_inode(txn, inode)
-            if request_id:
-                txn.set(ckey, serialize(inode))
-            return inode
+            return self._close_in_txn(
+                txn, inode_id, session_id, length_hint=length_hint,
+                client_id=client_id, request_id=request_id, wrote=wrote,
+                user=user)
 
         return with_transaction(self._engine, op)
+
+    def _close_in_txn(
+        self,
+        txn: ITransaction,
+        inode_id: int,
+        session_id: str,
+        *,
+        length_hint: Optional[int] = None,
+        client_id: str = "",
+        request_id: str = "",
+        wrote: Optional[bool] = None,
+        user: Optional[User] = None,
+    ) -> Inode:
+        """One close inside an already-open transaction — shared by close()
+        and batch_close() (ref BatchOperation.cc:750 batches exactly these
+        inode settles into one transaction)."""
+        # ORDER MATTERS for batch_close: every read/permission check and
+        # the (possibly RPC-backed, possibly raising) length hook run
+        # BEFORE the first mutation, so a per-item FsError caught by the
+        # batch leaves zero buffered writes for that item in the shared
+        # transaction — a failed item must not half-commit (session gone,
+        # length unsettled).
+        # the cache key is scoped to the caller's identity in auth mode:
+        # a replay of another client's (client_id, request_id) by a
+        # different user misses and must pass authorization below
+        ckey = idempotent_key(client_id, request_id,
+                              None if user is None else user.uid)
+        if request_id:
+            cached = txn.get(ckey)
+            if cached is not None:
+                return deserialize(cached, Inode)
+        inode = self._load_inode(txn, inode_id)
+        if inode is None:
+            raise _err(Code.META_NOT_FOUND, str(inode_id))
+        skey = session_key(inode_id, session_id)
+        if session_id:
+            raw = txn.get(skey)
+            if raw is None:
+                raise _err(Code.META_NO_SESSION, session_id)
+            if user is not None:
+                # the session is the capability granted at open: closing
+                # authorizes against its owner, not the live ACL (a chmod
+                # between open and close must not wedge the session)
+                sess = deserialize(raw, FileSession)
+                if not (user.is_root or sess.uid == user.uid):
+                    raise _err(Code.META_NO_PERMISSION, session_id)
+        elif user is not None and not inode.acl.check_user(user, PERM_W):
+            # sessionless length settle falls back to the ACL
+            raise _err(Code.META_NO_PERMISSION, str(inode_id))
+        store_inode = False
+        if inode.is_file():
+            if self._file_length_hook is not None:
+                inode.length = self._file_length_hook(inode)  # may raise
+            elif length_hint is not None:
+                inode.length = max(inode.length, length_hint)
+            if wrote or (wrote is None and length_hint is not None):
+                inode.mtime = time.time()
+            store_inode = True
+        # -- mutations (nothing above may raise past here) -------------------
+        if session_id:
+            txn.clear(skey)
+        if store_inode:
+            self._store_inode(txn, inode)
+        if request_id:
+            txn.set(ckey, serialize(inode))
+        return inode
+
+    def batch_close(
+        self,
+        items: List["BatchCloseItem"],
+        user: Optional[User] = None,
+        *,
+        txn_batch: int = 64,
+    ) -> List[object]:
+        """Settle MANY write sessions' lengths in O(len/txn_batch) KV
+        transactions instead of one per file (ref src/meta/store/ops/
+        BatchOperation.cc:750 — batched inode updates behind the
+        Distributor). Per-item failures (missing inode/session, permission)
+        come back as FsError entries without failing their batch-mates;
+        a KV conflict retries the whole chunk via with_transaction."""
+        results: List[object] = [None] * len(items)
+        for base in range(0, len(items), txn_batch):
+            chunk = list(enumerate(items[base:base + txn_batch], start=base))
+
+            def op(txn: ITransaction, _chunk=chunk):
+                out = []
+                for i, it in _chunk:
+                    try:
+                        out.append((i, self._close_in_txn(
+                            txn, it.inode_id, it.session_id,
+                            length_hint=(it.length_hint
+                                         if it.length_hint >= 0 else None),
+                            client_id=it.client_id,
+                            request_id=it.request_id,
+                            wrote=(None if it.wrote < 0 else bool(it.wrote)),
+                            user=user)))
+                    except FsError as e:
+                        out.append((i, e))
+                return out
+
+            for i, res in with_transaction(self._engine, op):
+                results[i] = res
+        return results
 
     def sync(self, inode_id: int, *, length_hint: Optional[int] = None,
              user: Optional[User] = None) -> Inode:

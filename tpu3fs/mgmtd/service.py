@@ -103,6 +103,8 @@ class Mgmtd:
         # in-memory routing snapshot, rebuilt from KV (primary only serves it)
         self._routing = RoutingInfo()
         self._configs: Dict[NodeType, ConfigBlob] = {}
+        # heartbeat-touched targets awaiting the TargetInfoPersister runner
+        self._dirty_targets: set = set()
         self._load()
 
     # -- persistence -------------------------------------------------------
@@ -217,19 +219,26 @@ class Mgmtd:
         self._routing.version = ver
 
     def upload_chain(self, chain_id: int, target_ids: List[int],
-                     *, ec_k: int = 0, ec_m: int = 0) -> None:
-        """Create a chain over existing targets, all SERVING/UPTODATE.
-        With ec_k/ec_m the chain is an erasure-coded group (chain-table type
-        "EC", ref data_placement.py:30): target i holds shard i."""
+                     *, ec_k: int = 0, ec_m: int = 0,
+                     wait_ready: bool = False) -> None:
+        """Create a chain over existing targets. Default: optimistic
+        SERVING/UPTODATE (single-process fabrics where targets exist by
+        construction). wait_ready=True creates the chain NEWBORN — every
+        target WAITING until its node heartbeats UPTODATE, when the
+        NewBornChainsChecker promotes the whole chain to SERVING (ref
+        src/mgmtd/background/MgmtdNewBornChainsChecker). With ec_k/ec_m
+        the chain is an erasure-coded group (chain-table type "EC", ref
+        data_placement.py:30): target i holds shard i."""
         if ec_k and len(target_ids) != ec_k + ec_m:
             raise FsError(Status(
                 Code.INVALID_ARG,
                 f"EC({ec_k},{ec_m}) needs {ec_k + ec_m} targets, "
                 f"got {len(target_ids)}"))
-        targets = [
-            ChainTarget(t, PublicTargetState.SERVING, LocalTargetState.UPTODATE)
-            for t in target_ids
-        ]
+        pub = (PublicTargetState.WAITING if wait_ready
+               else PublicTargetState.SERVING)
+        loc = (LocalTargetState.OFFLINE if wait_ready
+               else LocalTargetState.UPTODATE)
+        targets = [ChainTarget(t, pub, loc) for t in target_ids]
         chain = ChainInfo(chain_id, 1, targets, list(target_ids),
                           ec_k=ec_k, ec_m=ec_m)
         staged_infos = []
@@ -237,8 +246,8 @@ class Mgmtd:
             info = self._routing.targets.get(tid)
             info = replace(info) if info is not None else TargetInfo(tid)
             info.chain_id = chain_id
-            info.public_state = PublicTargetState.SERVING
-            info.local_state = LocalTargetState.UPTODATE
+            info.public_state = pub
+            info.local_state = loc
             staged_infos.append(info)
 
         def op(txn: ITransaction) -> int:
@@ -318,6 +327,9 @@ class Mgmtd:
             for target_id, ls in local_states.items():
                 info = self._routing.targets.get(target_id)
                 if info is not None:
+                    if (info.local_state != ls
+                            or info.node_id != node_id):
+                        self._dirty_targets.add(target_id)
                     info.local_state = ls
                     info.node_id = node_id
                 chain = self._routing.chain_of_target(target_id)
@@ -427,10 +439,132 @@ class Mgmtd:
 
     # -- main periodic driver ------------------------------------------------
     def tick(self, now: Optional[float] = None) -> None:
-        """One background round: lease, failure detection, chain updates."""
+        """One background round — the primary's runner set (ref
+        src/mgmtd/background/): lease extension, heartbeat checking, chain
+        updates, newborn-chain promotion, target-info persistence, metrics."""
         now = self._clock() if now is None else now
         lease = self.extend_lease(now)
         if lease.primary_node_id != self.node_id:
             return
         self.check_heartbeats(now)
         self.update_chains(now)
+        self.check_newborn_chains()
+        self.persist_target_infos()
+        self.update_metrics()
+
+    # -- background runners (ref src/mgmtd/background/) ----------------------
+    def check_newborn_chains(self) -> int:
+        """MgmtdNewBornChainsChecker analogue: a chain created with
+        wait_ready=True holds every target WAITING until each target's
+        node is heartbeat-connected and reports UPTODATE; only then does
+        the whole chain flip to SERVING (one atomic version bump). The
+        plain state machine cannot do this — WAITING stays WAITING without
+        a serving source, which is exactly right for REPAIRS but would
+        park a brand-new chain forever."""
+        promoted = []
+        staged_infos = {}
+        for chain in self._routing.chains.values():
+            targets = chain.targets
+            if not targets or any(
+                    t.public_state != PublicTargetState.WAITING
+                    for t in targets):
+                continue
+            ready = True
+            for t in targets:
+                info = self._routing.targets.get(t.target_id)
+                node = (self._routing.nodes.get(info.node_id)
+                        if info is not None else None)
+                if (info is None or node is None
+                        or node.status != NodeStatus.HEARTBEAT_CONNECTED
+                        or t.local_state != LocalTargetState.UPTODATE):
+                    ready = False
+                    break
+            if not ready:
+                continue
+            new_targets = [replace(t, public_state=PublicTargetState.SERVING)
+                           for t in targets]
+            promoted.append(replace(
+                chain, targets=new_targets,
+                chain_version=chain.chain_version + 1))
+            for t in new_targets:
+                info = self._routing.targets.get(t.target_id)
+                if info is not None:
+                    staged = replace(info)
+                    staged.public_state = PublicTargetState.SERVING
+                    staged_infos[t.target_id] = staged
+        if not promoted:
+            return 0
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, self._clock())
+            for chain in promoted:
+                txn.set(_chain_key(chain.chain_id), serialize(chain))
+            for info in staged_infos.values():
+                txn.set(_target_key(info.target_id), serialize(info))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        for chain in promoted:
+            self._routing.chains[chain.chain_id] = chain
+        self._routing.targets.update(staged_infos)
+        self._routing.version = ver
+        return len(promoted)
+
+    def persist_target_infos(self) -> int:
+        """MgmtdTargetInfoPersister analogue: heartbeat-reported LOCAL
+        target states live in memory for speed; this runner batches the
+        dirty ones into one transaction so a restarted primary reloads
+        last-known states instead of assuming the world away (the loader
+        half is _load(), which already reads them back)."""
+        dirty = set(self._dirty_targets)
+        if not dirty:
+            return 0
+        infos = [self._routing.targets[t] for t in dirty
+                 if t in self._routing.targets]
+        if not infos:
+            self._dirty_targets -= dirty
+            return 0
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, self._clock())
+            for info in infos:
+                txn.set(_target_key(info.target_id), serialize(info))
+            return len(infos)
+
+        try:
+            n = with_transaction(self._engine, op)
+        except FsError:
+            # deposed / exhausted retries: keep the states dirty so a
+            # future primacy (or the next tick) persists them
+            return 0
+        self._dirty_targets -= dirty
+        return n
+
+    def update_metrics(self) -> None:
+        """MgmtdMetricsUpdater analogue: cluster-level gauges into the
+        monitor pipeline (collector-queryable like every other recorder)."""
+        rec = getattr(self, "_metrics_rec", None)
+        if rec is None:
+            from tpu3fs.monitor.recorder import ValueRecorder
+
+            rec = {
+                "nodes_connected": ValueRecorder("mgmtd.nodes_connected"),
+                "chains_serving": ValueRecorder("mgmtd.chains_serving"),
+                "chains_degraded": ValueRecorder("mgmtd.chains_degraded"),
+                "routing_version": ValueRecorder("mgmtd.routing_version"),
+            }
+            self._metrics_rec = rec
+        connected = sum(
+            1 for n in self._routing.nodes.values()
+            if n.status == NodeStatus.HEARTBEAT_CONNECTED)
+        serving = degraded = 0
+        for chain in self._routing.chains.values():
+            if all(t.public_state == PublicTargetState.SERVING
+                   for t in chain.targets):
+                serving += 1
+            else:
+                degraded += 1
+        rec["nodes_connected"].set(connected)
+        rec["chains_serving"].set(serving)
+        rec["chains_degraded"].set(degraded)
+        rec["routing_version"].set(self._routing.version)

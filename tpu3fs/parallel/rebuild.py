@@ -10,7 +10,7 @@ this is the BASELINE.json north-star path ("rebuild 14 TiB target <5 min").
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -28,12 +28,17 @@ def rebuild_lost_shard(
     rs: RSCode,
     lost_idx: Sequence[int],
     shard_axis: str = "chain",
+    batch_axis: Optional[str] = None,
 ):
     """Reconstruct lost shard rows from the surviving ones, on-device.
 
     shards: (k+m, batch, S) uint8 global, sharded over ``shard_axis`` on axis 0
             (one EC-group member per mesh position along that axis). Rows at
             ``lost_idx`` hold garbage (the failed targets).
+    batch_axis: optionally shard the batch dimension over a second mesh
+            axis (the dp axis): each dp group rebuilds ITS batch slice with
+            its own chain-axis all_gather — the 2-D (dp x chain) layout the
+            pod-scale recovery path runs.
     Returns (len(lost_idx), batch, S): the rebuilt shards, replicated along the
     shard axis (every survivor can serve them; in the service layer only the
     replacement target persists them).
@@ -48,7 +53,8 @@ def rebuild_lost_shard(
         raise ValueError(f"cannot rebuild {len(lost)} shards with m={rs.m}")
     present = tuple(i for i in range(n) if i not in lost)[: rs.k]
     decode = rs.reconstruct_fn(present, lost)
-    other_specs = tuple(None for _ in range(shards.ndim - 1))
+    other_specs = (batch_axis,) + tuple(
+        None for _ in range(shards.ndim - 2))
 
     @partial(
         shard_map,

@@ -16,7 +16,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from tpu3fs.meta.store import MetaStore, OpenResult, StatFs, User
+from tpu3fs.meta.store import (
+    BatchCloseItem,
+    MetaStore,
+    OpenResult,
+    StatFs,
+    User,
+)
 from tpu3fs.meta.types import DirEntry, Inode
 from tpu3fs.mgmtd.service import HeartbeatReply, Mgmtd
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType, RoutingInfo
@@ -200,6 +206,9 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     # channels via client sessions, UpdateChannelAllocator.h:11-34)
     s.method(17, "pruneClientChannels", PruneClientReq, IntReply,
              lambda r: IntReply(svc.prune_client_channels(r.client_id)))
+    # local data-path offlining (ref offlineTarget, fbs/storage/Service.h:14)
+    s.method(18, "offlineTarget", TargetIdReq, IntReply,
+             lambda r: IntReply(int(svc.offline_target(r.target_id))))
     server.add_service(s)
 
 
@@ -393,6 +402,25 @@ class CloseReq:
     request_id: str = ""
     wrote: int = -1  # -1 unknown, 0 read-only session, 1 wrote
     token: str = ""
+
+
+@dataclass
+class BatchCloseReq:
+    items: List[BatchCloseItem] = field(default_factory=list)
+    token: str = ""
+
+
+@dataclass
+class BatchCloseRspItem:
+    ok: bool = False
+    inode: Optional[Inode] = None
+    code: int = 0
+    message: str = ""
+
+
+@dataclass
+class BatchCloseRsp:
+    results: List[BatchCloseRspItem] = field(default_factory=list)
 
 
 @dataclass
@@ -647,6 +675,20 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
         names=meta.list_xattrs(r.path, u(r))))
     s.method(22, "removeXattr", XattrReq, InodeRsp, lambda r: InodeRsp(
         meta.remove_xattr(r.path, r.name, u(r))))
+
+    def batch_close(r):
+        # one transaction per 64 closes (ref BatchOperation.cc:750)
+        out = []
+        for res in meta.batch_close(r.items, user=su(r)):
+            if isinstance(res, FsError):
+                out.append(BatchCloseRspItem(
+                    ok=False, code=int(res.code),
+                    message=res.status.message))
+            else:
+                out.append(BatchCloseRspItem(ok=True, inode=res))
+        return BatchCloseRsp(out)
+
+    s.method(23, "batchClose", BatchCloseReq, BatchCloseRsp, batch_close)
     server.add_service(s)
 
 
@@ -723,6 +765,19 @@ class MetaRpcClient:
         return self._call(10, CloseReq(inode_id, session_id, hint,
                                        self.client_id, request_id, w),
                           InodeRsp).inode
+
+    def batch_close(self, items: List[BatchCloseItem]) -> List[object]:
+        """Settle many sessions in O(len/64) server transactions; each
+        result is an Inode or an FsError (per-item failures don't poison
+        batch-mates). Ref BatchOperation.cc:750."""
+        rsp = self._call(23, BatchCloseReq(items), BatchCloseRsp)
+        out: List[object] = []
+        for r in rsp.results:
+            if r.ok:
+                out.append(r.inode)
+            else:
+                out.append(FsError(Status(Code(r.code), r.message)))
+        return out
 
     def symlink(self, path: str, target: str) -> Inode:
         return self._call(5, SymlinkReq(path, target), InodeRsp).inode

@@ -280,6 +280,17 @@ class _ChunkLockTable:
             return len(self._entries)
 
 
+class _TargetMapSnapshot:
+    """One consistent (routing version, chains) view; local-target state
+    is intentionally read live (offlining must refuse immediately)."""
+
+    __slots__ = ("routing_version", "chains")
+
+    def __init__(self, routing_version, chains):
+        self.routing_version = routing_version
+        self.chains = chains
+
+
 class StorageService:
     """All targets of one storage node + the chain write/read operators."""
 
@@ -303,6 +314,7 @@ class StorageService:
         # makes one chunk's flow wait on another node — a striped/shared
         # table would let unrelated chains entangle across nodes.
         self._locks = _ChunkLockTable()
+        self._tmap: Optional[_TargetMapSnapshot] = None
         self._channels = _ChannelTable()
         # per-target bounded update queues (ref UpdateWorker.h:11-46):
         # created lazily on first batched write to a target
@@ -327,6 +339,7 @@ class StorageService:
     # -- wiring -------------------------------------------------------------
     def add_target(self, target: StorageTarget) -> None:
         self._targets[target.target_id] = target
+        self._tmap = None  # snapshot must pick up the new target
 
     def target(self, target_id: int) -> Optional[StorageTarget]:
         return self._targets.get(target_id)
@@ -382,11 +395,48 @@ class StorageService:
         """Leased per-chunk lock as a context manager."""
         return self._locks.ctx(self._chunk_key(target_id, chunk_id))
 
+    def _target_map(self) -> "_TargetMapSnapshot":
+        """Immutable per-routing-version snapshot of (chains, local
+        targets) — ops resolve against ONE consistent view instead of
+        re-reading live routing mid-operation (ref TargetMap.h:23's
+        immutable snapshots validated against routing versions). Rebuilt
+        only when the routing version moves."""
+        routing = self._routing()
+        snap = self._tmap
+        if snap is None or snap.routing_version != routing.version:
+            snap = _TargetMapSnapshot(
+                routing_version=routing.version,
+                chains=dict(routing.chains),
+            )
+            self._tmap = snap
+        return snap
+
     def _chain(self, chain_id: int) -> ChainInfo:
-        chain = self._routing().chains.get(chain_id)
+        chain = self._target_map().chains.get(chain_id)
         if chain is None:
             raise _err(Code.CHAIN_NOT_FOUND, str(chain_id))
         return chain
+
+    def offline_target(self, target_id: int) -> bool:
+        """Offline a local target's data path (ref the offlineTarget RPC,
+        fbs/storage/Service.h:14 + TargetMap's offlining): the target
+        refuses reads and writes immediately; the OFFLINE local state rides
+        the next heartbeat so the chain updater rotates it out."""
+        target = self._targets.get(target_id)
+        if target is None:
+            return False
+        from tpu3fs.mgmtd.types import LocalTargetState
+
+        target.local_state = LocalTargetState.OFFLINE
+        self._tmap = None  # next op sees the refusal immediately
+        return True
+
+    def _check_target_serving(self, target: StorageTarget) -> None:
+        from tpu3fs.mgmtd.types import LocalTargetState
+
+        if target.local_state == LocalTargetState.OFFLINE:
+            raise _err(Code.TARGET_OFFLINE,
+                       f"target {target.target_id} offlined locally")
 
     def _local_writer(self, chain: ChainInfo):
         """This node's target in the chain's writer list (or None), plus the
@@ -479,6 +529,7 @@ class StorageService:
         with self._chunk_lock(target.target_id, req.chunk_id):
             try:
                 inject("storage.update")
+                self._check_target_serving(target)
                 # re-check the chain AFTER taking the chunk lock (ref :377-382)
                 chain = self._chain(req.chain_id)
                 if req.chain_ver != chain.chain_version and req.from_target == 0:
@@ -688,6 +739,7 @@ class StorageService:
         with self._chunk_lock(req.target_id, req.chunk_id):
             try:
                 inject("storage.write_shard")
+                self._check_target_serving(target)
                 chain = self._chain(req.chain_id)  # re-check under the lock
                 engine = target.engine
                 triaged = self._triage_shard_install(engine, req)
@@ -920,6 +972,7 @@ class StorageService:
             self._locks.acquire(key)
         try:
             inject("storage.update")
+            self._check_target_serving(target)
             # re-check the chain AFTER taking the chunk locks (ref :377-382)
             chain = self._chain(reqs[0].chain_id)
             chain_ver = chain.chain_version
@@ -1122,6 +1175,7 @@ class StorageService:
             self._locks.acquire(key)
         try:
             inject("storage.write_shard")
+            self._check_target_serving(target)
             engine = target.engine
             ops: List[EngineUpdateOp] = []
             op_idx: List[int] = []
@@ -1190,11 +1244,14 @@ class StorageService:
         chain = self._chain(req.chain_id)
         target_id = req.target_id
         if target_id == 0:
+            from tpu3fs.mgmtd.types import LocalTargetState as _LS
+
             local_serving = [
                 t.target_id
                 for t in chain.targets
                 if t.public_state == PublicTargetState.SERVING
                 and t.target_id in self._targets
+                and self._targets[t.target_id].local_state != _LS.OFFLINE
             ]
             if not local_serving:
                 raise _err(Code.TARGET_NOT_FOUND, str(req.chain_id))
@@ -1206,6 +1263,7 @@ class StorageService:
             raise _err(Code.TARGET_NOT_FOUND, str(target_id))
         if not chain_target.public_state.can_read:
             raise _err(Code.TARGET_OFFLINE, str(target_id))
+        self._check_target_serving(self._targets[target_id])
         return target_id
 
     def _read_impl(self, req: ReadReq) -> ReadReply:
