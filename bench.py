@@ -314,8 +314,12 @@ def _git_commit() -> str:
 
 
 # paths whose changes can alter kernel performance/correctness: a cached
-# capture is only trustworthy if none of these moved since it was taken
-KERNEL_PATHS = ("tpu3fs/ops", "native", "bench.py")
+# capture is only trustworthy if none of these moved since it was taken.
+# Precise file list, not all of native/: the RPC transport
+# (native/rpc_net.cpp) shares the directory but cannot change RS/CRC
+# kernel results, and flagging it would discard good captures for free.
+KERNEL_PATHS = ("tpu3fs/ops", "native/chunk_engine.cpp", "native/Makefile",
+                "bench.py")
 
 
 def _kernels_changed_since(commit: str) -> bool:

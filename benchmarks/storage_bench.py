@@ -12,8 +12,16 @@ Usage:
   python -m benchmarks.storage_bench [--chunks 256] [--size 262144]
       [--batch 16] [--threads 4] [--replicas 2] [--chains 4]
       [--engine mem|native] [--verify] [--inject 0.05]
+      [--rpc] [--transport python|native]
 
 Prints one JSON line per phase: write / read (+ IOPS, GiB/s).
+
+--rpc stands the cluster up over real TCP sockets (mgmtd + storage
+servers + RpcMessenger clients) instead of the in-process fabric, so the
+numbers include the transport: serde envelopes, bulk-section framing
+(FLAG_BULK scatter/gather — the RDMA-batch analogue), connection pooling.
+--transport picks the Python or the native (epoll/writev) transport for
+both servers and clients.
 """
 
 from __future__ import annotations
@@ -196,6 +204,204 @@ def run_bench(
     return results
 
 
+class _RpcCluster:
+    """mgmtd + N storage nodes over real sockets (the socket-mode twin of
+    the fabric; same shape as the reference running its UnitTestFabric
+    against live transports)."""
+
+    def __init__(self, *, replicas: int, chains: int, size: int,
+                 transport: str = "python"):
+        from tpu3fs.kv.mem import MemKVEngine
+        from tpu3fs.mgmtd.service import Mgmtd
+        from tpu3fs.mgmtd.types import LocalTargetState, NodeType
+        from tpu3fs.rpc.services import (
+            MgmtdRpcClient,
+            RpcMessenger,
+            bind_mgmtd_service,
+            bind_storage_service,
+        )
+        from tpu3fs.storage.craq import StorageService
+        from tpu3fs.storage.target import StorageTarget
+
+        if transport == "native":
+            from tpu3fs.rpc.native_net import (
+                NativeRpcClient as ClientCls,
+                NativeRpcServer as ServerCls,
+            )
+        else:
+            from tpu3fs.rpc.net import (
+                RpcClient as ClientCls,
+                RpcServer as ServerCls,
+            )
+
+        self.mgmtd = Mgmtd(1, MemKVEngine())
+        self.mgmtd.extend_lease()
+        self.servers = []
+        mgmtd_server = ServerCls()
+        bind_mgmtd_service(mgmtd_server, self.mgmtd)
+        mgmtd_server.start()
+        self.servers.append(mgmtd_server)
+        self.mgmtd_addr = mgmtd_server.address
+        self.shared_client = ClientCls()
+        self._client_cls = ClientCls
+        self._messenger_cls = RpcMessenger
+        self._mgmtd_cli_cls = MgmtdRpcClient
+
+        num_nodes = max(3, replicas)
+        node_ids = [10 + i for i in range(num_nodes)]
+        self.chain_ids = [900_001 + i for i in range(chains)]
+        node_states: dict = {n: {} for n in node_ids}
+        services = []
+        svc_by_node = {}
+        for node_id in node_ids:
+            mcli = MgmtdRpcClient(self.mgmtd_addr, self.shared_client)
+            svc = StorageService(node_id, mcli.refresh_routing)
+            svc.set_messenger(RpcMessenger(mcli.refresh_routing,
+                                           self.shared_client))
+            server = ServerCls()
+            bind_storage_service(server, svc)
+            server.start()
+            self.mgmtd.register_node(node_id, NodeType.STORAGE,
+                                     host=server.host, port=server.port)
+            self.servers.append(server)
+            services.append(svc)
+            svc_by_node[node_id] = svc
+        for ci, chain_id in enumerate(self.chain_ids):
+            targets = []
+            for r in range(replicas):
+                node_id = node_ids[(ci + r) % num_nodes]
+                target_id = 1000 + ci * 16 + r
+                svc_by_node[node_id].add_target(
+                    StorageTarget(target_id, chain_id, chunk_size=size))
+                self.mgmtd.create_target(target_id, node_id=node_id)
+                node_states[node_id][target_id] = LocalTargetState.UPTODATE
+                targets.append(target_id)
+            self.mgmtd.upload_chain(chain_id, targets)
+        self.mgmtd.upload_chain_table(1, self.chain_ids)
+        for node_id in node_ids:
+            self.mgmtd.heartbeat(node_id, 1, node_states[node_id])
+        self._client_seq = 0
+
+    def storage_client(self, **kw):
+        from tpu3fs.client.storage_client import StorageClient
+
+        self._client_seq += 1
+        mcli = self._mgmtd_cli_cls(self.mgmtd_addr, self.shared_client)
+        messenger = self._messenger_cls(mcli.refresh_routing,
+                                        self.shared_client)
+        return StorageClient(f"bench-rpc-{self._client_seq}",
+                             mcli.refresh_routing, messenger, **kw)
+
+    def close(self) -> None:
+        self.shared_client.close()
+        for s in self.servers:
+            s.stop()
+
+
+def run_rpc_bench(
+    *,
+    chunks: int = 256,
+    size: int = 256 << 10,
+    batch: int = 16,
+    threads: int = 4,
+    replicas: int = 2,
+    chains: int = 4,
+    transport: str = "python",
+    verify: bool = False,
+) -> list:
+    cluster = _RpcCluster(replicas=replicas, chains=chains, size=size,
+                          transport=transport)
+    fast = RetryOptions(backoff_base_s=0.001, backoff_max_s=0.05)
+    payloads = [bytes([i & 0xFF]) * size for i in range(min(chunks, 64))]
+    crcs = [crc32c(p) for p in payloads]
+    results = []
+    chain_ids = cluster.chain_ids
+
+    def emit(name: str, n: int, dt: float, **extra) -> None:
+        row = {
+            "metric": f"storage_bench_rpc_{name}",
+            "value": round(n * size / dt / (1 << 30), 3),
+            "unit": "GiB/s",
+            "iops": round(n / dt, 1),
+            "chunk_size": size,
+            "replicas": replicas,
+            "transport": transport,
+            **extra,
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    def threaded(fn) -> float:
+        errors: list = []
+        ts = []
+
+        def worker(wid: int) -> None:
+            client = cluster.storage_client(retry=fast)
+            try:
+                for i in range(wid, chunks, threads):
+                    fn(client, i)
+            except BaseException as e:
+                errors.append(e)
+
+        ts = [threading.Thread(target=worker, args=(w,))
+              for w in range(threads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        return dt
+
+    def do_write(client, i: int) -> None:
+        reply = client.write_chunk(
+            chain_ids[i % len(chain_ids)], ChunkId(FILE_ID, i), 0,
+            payloads[i % len(payloads)], chunk_size=size)
+        assert reply.ok, reply
+
+    def do_read(client, i: int) -> None:
+        reply = client.read_chunk(chain_ids[i % len(chain_ids)],
+                                  ChunkId(FILE_ID, i))
+        assert reply.ok, reply
+        if verify:
+            assert crc32c(reply.data) == crcs[i % len(crcs)]
+
+    emit("write", chunks, threaded(do_write), threads=threads)
+    emit("read", chunks, threaded(do_read), threads=threads)
+
+    client = cluster.storage_client(retry=fast)
+    from tpu3fs.client.storage_client import ReadReq
+
+    t0 = time.perf_counter()
+    got = 0
+    for base in range(0, chunks, batch):
+        idxs = list(range(base, min(base + batch, chunks)))
+        reqs = [ReadReq(chain_ids[i % len(chain_ids)], ChunkId(FILE_ID, i),
+                        0, -1) for i in idxs]
+        replies = client.batch_read(reqs)
+        assert all(r.ok for r in replies)
+        if verify:
+            for i, r in zip(idxs, replies):
+                assert crc32c(r.data) == crcs[i % len(crcs)]
+        got += len(replies)
+    emit("batch_read", got, time.perf_counter() - t0, batch=batch)
+
+    t0 = time.perf_counter()
+    wrote = 0
+    for base in range(0, chunks, batch):
+        idxs = list(range(base, min(base + batch, chunks)))
+        ops = [(chain_ids[i % len(chain_ids)], ChunkId(FILE_ID + 1, i), 0,
+                payloads[i % len(payloads)]) for i in idxs]
+        replies = client.batch_write(ops, chunk_size=size)
+        assert all(r.ok for r in replies)
+        wrote += len(replies)
+    emit("batch_write", wrote, time.perf_counter() - t0, batch=batch)
+    cluster.close()
+    return results
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--chunks", type=int, default=256)
@@ -207,8 +413,21 @@ def main() -> None:
     ap.add_argument("--engine", default="mem", choices=["mem", "native"])
     ap.add_argument("--verify", action="store_true")
     ap.add_argument("--inject", type=float, default=0.0)
+    ap.add_argument("--rpc", action="store_true",
+                    help="run over real sockets instead of the fabric")
+    ap.add_argument("--transport", default="python",
+                    choices=["python", "native"])
     args = ap.parse_args()
-    run_bench(**vars(args))
+    if args.rpc:
+        run_rpc_bench(chunks=args.chunks, size=args.size, batch=args.batch,
+                      threads=args.threads, replicas=args.replicas,
+                      chains=args.chains, transport=args.transport,
+                      verify=args.verify)
+    else:
+        run_bench(chunks=args.chunks, size=args.size, batch=args.batch,
+                  threads=args.threads, replicas=args.replicas,
+                  chains=args.chains, engine=args.engine,
+                  verify=args.verify, inject=args.inject)
 
 
 if __name__ == "__main__":

@@ -32,12 +32,14 @@
 
 #include <arpa/inet.h>
 #include <fcntl.h>
+#include <limits.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 namespace {
@@ -56,6 +58,13 @@ enum Code : int64_t {
 
 constexpr uint32_t kMaxPacket = 64u << 20;
 constexpr int64_t kFlagIsReq = 1;
+// bulk framing (tpu3fs/rpc/net.py FLAG_BULK): the frame body is
+// [MessagePacket serde][bulk section] — control fields in the envelope,
+// chunk payloads appended raw. Senders gather caller buffers with writev
+// (no concatenation of control + data); the analogue of the reference
+// splitting serde packets from RDMA READ/WRITE batches into registered
+// buffers (src/common/net/ib/IBSocket.h:155-229).
+constexpr int64_t kFlagBulk = 8;
 
 double mono_now() {
   return std::chrono::duration<double>(
@@ -145,6 +154,11 @@ struct Packet {
   std::string payload;
   std::string message;
   double ts[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  // bulk section (raw: varint count + varint lens + segments), present
+  // when flags carries kFlagBulk; an EMPTY section is meaningful ("I speak
+  // bulk; reply with data in bulk"), hence the separate presence bit
+  std::string bulk;
+  bool has_bulk = false;
 };
 
 std::string encode_packet(const Packet& p) {
@@ -177,7 +191,36 @@ bool decode_packet(const uint8_t* d, size_t len, Packet& p) {
   if (!get_uvarint(d, len, pos, nts)) return false;
   for (uint64_t i = 0; i < nts && i < 8; i++)
     if (!get_double(d, len, pos, p.ts[i])) return false;
+  // the rest of the frame is the bulk section when the flag says so; a
+  // frame with trailing bytes but NO flag is malformed (catches a legacy
+  // peer mis-framing rather than silently dropping data)
+  if (p.flags & kFlagBulk) {
+    p.has_bulk = true;
+    p.bulk.assign(reinterpret_cast<const char*>(d + pos), len - pos);
+  } else if (pos != len) {
+    return false;
+  }
   return true;
+}
+
+// minimal bulk-section sanity: varint count + per-segment varint lens must
+// cover the section exactly (the Python split_bulk enforces the same)
+bool bulk_section_valid(const std::string& bulk) {
+  const uint8_t* d = reinterpret_cast<const uint8_t*>(bulk.data());
+  size_t len = bulk.size(), pos = 0;
+  uint64_t count;
+  if (!get_uvarint(d, len, pos, count)) return false;
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < count; i++) {
+    uint64_t n;
+    if (!get_uvarint(d, len, pos, n)) return false;
+    // per-segment bound before accumulating: crafted 2^63-ish lengths
+    // could otherwise wrap `total` mod 2^64 and pass the final equality
+    if (n > len) return false;
+    total += n;
+    if (total > len) return false;
+  }
+  return pos <= len && total == len - pos;
 }
 
 // ---- socket helpers -------------------------------------------------------
@@ -187,21 +230,32 @@ int set_nonblocking(int fd, bool nb) {
   return fcntl(fd, F_SETFL, nb ? (fl | O_NONBLOCK) : (fl & ~O_NONBLOCK));
 }
 
-// send-all with EAGAIN poll (socket may be nonblocking). drain_timeout_ms
-// bounds how long we wait for the peer to drain its receive window: a
-// stalled reader must not pin a server worker thread (and the connection's
-// write_mu) indefinitely — head-of-line blocking across the whole pool.
-bool send_all(int fd, const char* data, size_t len, int drain_timeout_ms) {
-  // drain_timeout_ms bounds the WHOLE send, not each EAGAIN: a slow-drip
-  // reader that accepts a few bytes every few seconds would reset a
-  // per-poll timeout forever and still pin the worker
+// a server reply may stall this long per EAGAIN before the connection is
+// declared dead and closed (workers return to the queue instead of blocking)
+constexpr int kServerDrainTimeoutMs = 5000;
+
+// gather-write with EAGAIN poll (socket may be nonblocking): payload
+// buffers go to the kernel straight from their owners (no concatenation).
+// drain_timeout_ms bounds the WHOLE send, not each EAGAIN: a slow-drip
+// reader that accepts a few bytes every few seconds must not pin a server
+// worker thread (and the connection's write_mu) indefinitely —
+// head-of-line blocking across the whole pool.
+bool send_iovs(int fd, struct iovec* iov, int n_iov, int drain_timeout_ms) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(drain_timeout_ms);
-  size_t off = 0;
-  while (off < len) {
-    ssize_t n = ::send(fd, data + off, len - off, MSG_NOSIGNAL);
+  int first = 0;
+  while (first < n_iov) {
+    ssize_t n = ::writev(fd, iov + first, std::min(n_iov - first, IOV_MAX));
     if (n > 0) {
-      off += size_t(n);
+      size_t done = size_t(n);
+      while (first < n_iov && done >= iov[first].iov_len) {
+        done -= iov[first].iov_len;
+        first++;
+      }
+      if (first < n_iov && done > 0) {
+        iov[first].iov_base = static_cast<char*>(iov[first].iov_base) + done;
+        iov[first].iov_len -= done;
+      }
       continue;
     }
     if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
@@ -218,10 +272,6 @@ bool send_all(int fd, const char* data, size_t len, int drain_timeout_ms) {
   }
   return true;
 }
-
-// a server reply may stall this long per EAGAIN before the connection is
-// declared dead and closed (workers return to the queue instead of blocking)
-constexpr int kServerDrainTimeoutMs = 5000;
 
 bool recv_exact(int fd, uint8_t* out, size_t len) {  // blocking socket
   size_t off = 0;
@@ -264,23 +314,18 @@ bool resolve_ipv4(const char* host, uint16_t port, struct sockaddr_in* out) {
   return true;
 }
 
-std::string frame(const std::string& body) {
-  std::string out;
-  uint32_t n = uint32_t(body.size());
-  out.push_back(char((n >> 24) & 0xFF));
-  out.push_back(char((n >> 16) & 0xFF));
-  out.push_back(char((n >> 8) & 0xFF));
-  out.push_back(char(n & 0xFF));
-  out += body;
-  return out;
-}
-
 // ---- server ---------------------------------------------------------------
-// handler: returns status; on success fills *rsp (malloc'd) + *rsp_len; may
-// fill *msg (malloc'd) with an error message. Called from worker threads.
+// handler v2: returns status; on success fills *rsp (malloc'd) + *rsp_len;
+// may fill *msg (malloc'd) with an error message. `bulk`/`bulk_len` carry
+// the request's raw bulk section when has_bulk != 0; the handler may hand
+// back a malloc'd reply bulk section via *rsp_bulk — the transport then
+// writev's it after the envelope without copying. Called from workers.
 typedef int64_t (*tpu3fs_handler_t)(int64_t service_id, int64_t method_id,
                                     const uint8_t* req, size_t req_len,
+                                    const uint8_t* bulk, size_t bulk_len,
+                                    int has_bulk,
                                     uint8_t** rsp, size_t* rsp_len,
+                                    uint8_t** rsp_bulk, size_t* rsp_bulk_len,
                                     char** msg);
 
 struct Conn {
@@ -290,7 +335,7 @@ struct Conn {
   std::string inbuf;
   std::atomic<bool> closed{false};
   // the fd is closed ONLY here, when the last reference dies: a worker may
-  // be inside send_all on this fd concurrently with the event loop closing
+  // be inside send_iovs on this fd concurrently with the event loop closing
   // the connection, and an early ::close() would let the kernel hand the
   // same fd number to a new accept — the worker's reply bytes would then
   // land in an unrelated client's connection. shutdown() (in
@@ -331,7 +376,7 @@ void server_close_conn(Server* s, const std::shared_ptr<Conn>& c) {
       s->conns.erase(c->fd);
     }
     epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
-    // shutdown unblocks any worker currently in send_all on this fd; the
+    // shutdown unblocks any worker currently in send_iovs on this fd; the
     // actual ::close() is deferred to ~Conn so the fd number cannot be
     // reused while a worker still holds a reference (see Conn)
     ::shutdown(c->fd, SHUT_RDWR);
@@ -359,12 +404,17 @@ void worker_main(Server* s) {
     rsp.ts[4] = mono_now();  // server_run_start
     uint8_t* out = nullptr;
     size_t out_len = 0;
+    uint8_t* out_bulk = nullptr;
+    size_t out_bulk_len = 0;
     char* msg = nullptr;
     int64_t status = INTERNAL;
     if (s->handler) {
       status = s->handler(req.service_id, req.method_id,
                           reinterpret_cast<const uint8_t*>(req.payload.data()),
-                          req.payload.size(), &out, &out_len, &msg);
+                          req.payload.size(),
+                          reinterpret_cast<const uint8_t*>(req.bulk.data()),
+                          req.bulk.size(), req.has_bulk ? 1 : 0,
+                          &out, &out_len, &out_bulk, &out_bulk_len, &msg);
     }
     rsp.status = status;
     if (out != nullptr) {
@@ -376,16 +426,41 @@ void worker_main(Server* s) {
       rsp.message = msg;
       free(msg);
     }
+    bool reply_bulk = (status == OK && out_bulk != nullptr);
+    if (reply_bulk) rsp.flags |= kFlagBulk;
     rsp.ts[5] = mono_now();  // server_run_end
-    std::string wire = frame(encode_packet(rsp));
+    // envelope assembled once; the bulk section rides from the handler's
+    // buffer straight into writev — the reply data is never copied again
+    std::string env = encode_packet(rsp);
+    uint64_t total = env.size() + (reply_bulk ? out_bulk_len : 0);
+    if (total > kMaxPacket) {
+      // mirror the Python server's MAX_PACKET guard: an oversized reply
+      // must become an error envelope, never a mis-framed/truncated
+      // 4-byte length that desyncs the stream
+      rsp.flags &= ~kFlagBulk;
+      reply_bulk = false;
+      rsp.status = INTERNAL;
+      rsp.payload.clear();
+      rsp.message = "reply exceeds max packet";
+      env = encode_packet(rsp);
+      total = env.size();
+    }
+    uint8_t hdr[4] = {uint8_t(total >> 24), uint8_t(total >> 16),
+                      uint8_t(total >> 8), uint8_t(total)};
+    struct iovec iov[3] = {
+        {hdr, 4},
+        {const_cast<char*>(env.data()), env.size()},
+        {out_bulk, reply_bulk ? out_bulk_len : 0},
+    };
     {
       std::lock_guard<std::mutex> g(job.conn->write_mu);
       if (!job.conn->closed.load() &&
-          !send_all(job.conn->fd, wire.data(), wire.size(),
-                    kServerDrainTimeoutMs)) {
+          !send_iovs(job.conn->fd, iov, reply_bulk ? 3 : 2,
+                     kServerDrainTimeoutMs)) {
         server_close_conn(s, job.conn);
       }
     }
+    if (out_bulk != nullptr) free(out_bulk);
   }
 }
 
@@ -464,7 +539,8 @@ void loop_main(Server* s) {
         }
         if (conn->inbuf.size() - off - 4 < frame_len) break;
         Packet req;
-        if (decode_packet(b + 4, frame_len, req)) {
+        if (decode_packet(b + 4, frame_len, req) &&
+            (!req.has_bulk || bulk_section_valid(req.bulk))) {
           req.ts[2] = now;  // server_receive
           {
             std::lock_guard<std::mutex> lk(s->q_mu);
@@ -627,13 +703,29 @@ void* tpu3fs_rpc_client_connect(const char* host, int port,
   return c;
 }
 
+// ABI version marker: the Python loader rebuilds a stale .so whose symbols
+// predate the bulk-framing handler signature (a silent mismatch would
+// corrupt the callback stack instead of failing loud)
+int tpu3fs_rpc_abi_version() { return 2; }
+
 // returns 0 on transport success (out_status carries the remote status code);
 // negative on transport failure: -1 send failed, -2 recv failed/timeout,
-// -3 decode failed, -4 uuid mismatch
-int tpu3fs_rpc_client_call(void* cli, int64_t service_id, int64_t method_id,
-                           const uint8_t* req, size_t req_len,
-                           int64_t* out_status, uint8_t** out_rsp,
-                           size_t* out_rsp_len, char** out_msg) {
+// -3 decode failed, -4 uuid mismatch, -5 request exceeds kMaxPacket
+// (found before any bytes moved: the connection is still healthy).
+//
+// Bulk riders: n_iovs < 0 means "no bulk section" (a plain call);
+// n_iovs >= 0 sends kFlagBulk with the given segments gathered into
+// writev straight from the caller's buffers (n_iovs == 0 is the empty
+// section that asks the server to reply in bulk). On success with a
+// bulk reply, *out_bulk is the malloc'd raw section (*out_has_bulk = 1).
+int tpu3fs_rpc_client_call2(void* cli, int64_t service_id, int64_t method_id,
+                            const uint8_t* req, size_t req_len,
+                            const uint8_t* const* iov_ptrs,
+                            const size_t* iov_lens, int64_t n_iovs,
+                            int64_t* out_status, uint8_t** out_rsp,
+                            size_t* out_rsp_len, uint8_t** out_bulk,
+                            size_t* out_bulk_len, int* out_has_bulk,
+                            char** out_msg) {
   auto* c = static_cast<Client*>(cli);
   std::lock_guard<std::mutex> g(c->mu);
   Packet pkt;
@@ -643,10 +735,36 @@ int tpu3fs_rpc_client_call(void* cli, int64_t service_id, int64_t method_id,
   pkt.flags = kFlagIsReq;
   pkt.status = OK;
   pkt.payload.assign(reinterpret_cast<const char*>(req), req_len);
+  bool bulk = n_iovs >= 0;
+  if (bulk) pkt.flags |= kFlagBulk;
   pkt.ts[0] = mono_now();  // client_build
   pkt.ts[1] = mono_now();  // client_send
-  std::string wire = frame(encode_packet(pkt));
-  if (!send_all(c->fd, wire.data(), wire.size(), c->call_timeout_ms))
+  std::string env = encode_packet(pkt);
+  std::string bulk_hdr;
+  uint64_t bulk_data = 0;
+  if (bulk) {
+    put_uvarint(bulk_hdr, uint64_t(n_iovs));
+    for (int64_t i = 0; i < n_iovs; i++) {
+      put_uvarint(bulk_hdr, iov_lens[i]);
+      bulk_data += iov_lens[i];
+    }
+  }
+  uint64_t total = env.size() + bulk_hdr.size() + bulk_data;
+  if (total > kMaxPacket) return -5;
+  uint8_t hdr4[4] = {uint8_t(total >> 24), uint8_t(total >> 16),
+                     uint8_t(total >> 8), uint8_t(total)};
+  std::vector<struct iovec> iov;
+  iov.reserve(3 + size_t(bulk ? n_iovs : 0));
+  iov.push_back({hdr4, 4});
+  iov.push_back({const_cast<char*>(env.data()), env.size()});
+  if (bulk) {
+    if (!bulk_hdr.empty())
+      iov.push_back({const_cast<char*>(bulk_hdr.data()), bulk_hdr.size()});
+    for (int64_t i = 0; i < n_iovs; i++)
+      if (iov_lens[i] > 0)
+        iov.push_back({const_cast<uint8_t*>(iov_ptrs[i]), iov_lens[i]});
+  }
+  if (!send_iovs(c->fd, iov.data(), int(iov.size()), c->call_timeout_ms))
     return -1;
   uint8_t hdr[4];
   if (!recv_exact(c->fd, hdr, 4)) return -2;
@@ -657,17 +775,39 @@ int tpu3fs_rpc_client_call(void* cli, int64_t service_id, int64_t method_id,
   if (!recv_exact(c->fd, body.data(), n)) return -2;
   Packet rsp;
   if (!decode_packet(body.data(), n, rsp)) return -3;
+  if (rsp.has_bulk && !bulk_section_valid(rsp.bulk)) return -3;
   if (rsp.uuid != pkt.uuid) return -4;
   *out_status = rsp.status;
   *out_rsp_len = rsp.payload.size();
   *out_rsp = static_cast<uint8_t*>(malloc(rsp.payload.size() + 1));
   memcpy(*out_rsp, rsp.payload.data(), rsp.payload.size());
+  if (out_has_bulk != nullptr) *out_has_bulk = rsp.has_bulk ? 1 : 0;
+  if (out_bulk != nullptr && out_bulk_len != nullptr) {
+    if (rsp.has_bulk) {
+      *out_bulk = static_cast<uint8_t*>(malloc(rsp.bulk.size() + 1));
+      memcpy(*out_bulk, rsp.bulk.data(), rsp.bulk.size());
+      *out_bulk_len = rsp.bulk.size();
+    } else {
+      *out_bulk = nullptr;
+      *out_bulk_len = 0;
+    }
+  }
   if (out_msg != nullptr) {
     *out_msg = static_cast<char*>(malloc(rsp.message.size() + 1));
     memcpy(*out_msg, rsp.message.data(), rsp.message.size());
     (*out_msg)[rsp.message.size()] = 0;
   }
   return 0;
+}
+
+int tpu3fs_rpc_client_call(void* cli, int64_t service_id, int64_t method_id,
+                           const uint8_t* req, size_t req_len,
+                           int64_t* out_status, uint8_t** out_rsp,
+                           size_t* out_rsp_len, char** out_msg) {
+  return tpu3fs_rpc_client_call2(cli, service_id, method_id, req, req_len,
+                                 nullptr, nullptr, -1, out_status, out_rsp,
+                                 out_rsp_len, nullptr, nullptr, nullptr,
+                                 out_msg);
 }
 
 void tpu3fs_rpc_client_close(void* cli) {

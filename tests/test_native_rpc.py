@@ -194,3 +194,120 @@ class TestNativeRobustness:
                         EchoReq("x"), EchoRsp)
         assert time.monotonic() - t0 < 5.0
         client.close()
+
+
+# -- bulk framing (FLAG_BULK: payload sections outside the serde envelope,
+#    the RDMA-batch analogue — net.py bulk section, rpc_net.cpp kFlagBulk) --
+
+BULK_SERVICE_ID = 9999
+
+
+def _bind_bulk_service(server):
+    from tpu3fs.rpc.net import ServiceDef
+
+    s = ServiceDef(BULK_SERVICE_ID, "BulkEcho")
+
+    def bulk_echo(req, segs):
+        # prove the server saw real segments: reverse each one
+        if segs is None:
+            return EchoRsp("inline"), None
+        return EchoRsp(f"segs={len(segs)}"), [bytes(s)[::-1] for s in segs]
+
+    s.method(1, "bulkEcho", EchoReq, EchoRsp, bulk_echo, bulk=True)
+    s.method(2, "plain", EchoReq, EchoRsp, lambda r: EchoRsp(r.text))
+    server.add_service(s)
+
+
+@pytest.fixture(params=COMBOS, ids=lambda c: f"{c[0].__name__}-{c[1].__name__}")
+def bulk_combo(request):
+    server_cls, client_cls = request.param
+    server = server_cls()
+    _bind_bulk_service(server)
+    server.start()
+    client = client_cls()
+    yield server, client
+    client.close()
+    server.stop()
+
+
+class TestBulkFraming:
+    def test_roundtrip_segments(self, bulk_combo):
+        server, client = bulk_combo
+        segs = [b"alpha", b"", b"gamma" * 100]
+        rsp, out = client.call_bulk(server.address, BULK_SERVICE_ID, 1,
+                                    EchoReq("go"), EchoRsp, bulk_iovs=segs)
+        assert rsp.text == "segs=3"
+        assert [bytes(s) for s in out] == [s[::-1] for s in segs]
+
+    def test_empty_section_requests_bulk_reply(self, bulk_combo):
+        server, client = bulk_combo
+        rsp, out = client.call_bulk(server.address, BULK_SERVICE_ID, 1,
+                                    EchoReq("go"), EchoRsp, bulk_iovs=())
+        assert rsp.text == "segs=0"
+        assert out == []
+
+    def test_legacy_inline_call_still_served(self, bulk_combo):
+        server, client = bulk_combo
+        rsp = client.call(server.address, BULK_SERVICE_ID, 1,
+                          EchoReq("go"), EchoRsp)
+        assert rsp.text == "inline"
+
+    def test_bulk_to_plain_method_rejected(self, bulk_combo):
+        server, client = bulk_combo
+        with pytest.raises(FsError) as ei:
+            client.call_bulk(server.address, BULK_SERVICE_ID, 2,
+                             EchoReq("x"), EchoRsp, bulk_iovs=[b"data"])
+        assert ei.value.code == Code.RPC_BAD_REQUEST
+
+    def test_large_segments(self, bulk_combo):
+        server, client = bulk_combo
+        import os as _os
+
+        segs = [_os.urandom(2 << 20) for _ in range(3)]
+        rsp, out = client.call_bulk(server.address, BULK_SERVICE_ID, 1,
+                                    EchoReq("big"), EchoRsp, bulk_iovs=segs)
+        assert rsp.text == "segs=3"
+        assert [bytes(s) for s in out] == [s[::-1] for s in segs]
+
+    def test_memoryview_iovs_gather(self, bulk_combo):
+        """Senders may pass memoryviews (e.g. slices of a larger buffer)."""
+        server, client = bulk_combo
+        blob = bytes(range(256)) * 64
+        mv = memoryview(blob)
+        segs = [mv[0:1000], mv[1000:5000]]
+        rsp, out = client.call_bulk(server.address, BULK_SERVICE_ID, 1,
+                                    EchoReq("mv"), EchoRsp, bulk_iovs=segs)
+        assert rsp.text == "segs=2"
+        assert [bytes(s) for s in out] == [bytes(s)[::-1] for s in segs]
+
+    def test_malformed_bulk_section_is_survivable(self):
+        """A bulk flag whose section lies about segment lengths must not
+        kill either server flavor."""
+        import socket
+        import struct
+
+        from tpu3fs.rpc.net import FLAG_BULK, FLAG_IS_REQ, MessagePacket
+        from tpu3fs.rpc.serde import serialize
+
+        for server_cls in (RpcServer, NativeRpcServer):
+            server = server_cls()
+            _bind_bulk_service(server)
+            server.start()
+            try:
+                pkt = MessagePacket(
+                    uuid="x" * 32, service_id=BULK_SERVICE_ID, method_id=1,
+                    flags=FLAG_IS_REQ | FLAG_BULK, status=0, payload=b"")
+                raw = serialize(pkt)
+                # section claims one 100-byte segment but carries 3 bytes
+                evil = raw + bytes([1, 100]) + b"abc"
+                s = socket.create_connection(server.address, timeout=2)
+                s.sendall(struct.pack(">I", len(evil)) + evil)
+                s.close()
+                client = RpcClient()
+                rsp, out = client.call_bulk(
+                    server.address, BULK_SERVICE_ID, 1, EchoReq("alive"),
+                    EchoRsp, bulk_iovs=[b"ok"])
+                assert rsp.text == "segs=1"
+                client.close()
+            finally:
+                server.stop()

@@ -371,6 +371,11 @@ class Mgmtd:
                 if info is not None and info.node_id in dead_set:
                     t.local_state = LocalTargetState.OFFLINE
                     info.local_state = LocalTargetState.OFFLINE
+                    # every writer of local_state must mark the target
+                    # dirty, or persist_target_infos never writes the
+                    # OFFLINE state and a primary restart resurrects the
+                    # dead node's last heartbeat as UPTODATE
+                    self._dirty_targets.add(t.target_id)
         return dead
 
     # -- chain updater (ref MgmtdChainsUpdater) ------------------------------

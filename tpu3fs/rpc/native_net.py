@@ -4,11 +4,18 @@ Drop-in counterparts of RpcServer/RpcClient (tpu3fs/rpc/net.py) running the
 transport in native code: epoll event loop + worker pool on the server,
 blocking pooled connections on the client — the same split as the
 reference's native net core (src/common/net/{EventLoop,IOWorker,Server}.cc).
-The wire format (length-prefixed MessagePacket envelopes) is bit-compatible
-with the Python transport, so any mix of native/Python client and server
-interoperates; service dispatch (deserialize request, run handler, serialize
-reply) stays in Python, exactly as the reference keeps service logic above
-its native transport.
+The wire format (length-prefixed MessagePacket envelopes, optional bulk
+sections) is bit-compatible with the Python transport, so any mix of
+native/Python client and server interoperates; service dispatch
+(deserialize request, run handler, serialize reply) stays in Python,
+exactly as the reference keeps service logic above its native transport.
+
+Bulk framing (the RDMA-batch analogue, ref src/common/net/ib/
+IBSocket.h:155-229): chunk payloads ride a raw section after the envelope.
+On send the native side writev's the caller's buffers without
+concatenation; on receive the bridge takes ONE owned copy of the section
+(the handler may retain segments past the native frame's lifetime — e.g.
+per-target update queues) and hands out zero-copy memoryview slices of it.
 """
 
 from __future__ import annotations
@@ -19,19 +26,25 @@ import subprocess
 import threading
 from typing import Any, Dict, Optional, Tuple, Type
 
-from tpu3fs.rpc.net import ServiceDef
+from tpu3fs.rpc.net import ServiceDef, pack_bulk_header, split_bulk
 from tpu3fs.rpc.serde import deserialize, serialize
 from tpu3fs.utils.result import Code, FsError, Status
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libtpu3fs_rpc.so")
 
+_ABI_VERSION = 2  # must match tpu3fs_rpc_abi_version() in rpc_net.cpp
+
 _HANDLER_T = ctypes.CFUNCTYPE(
     ctypes.c_int64,                      # status
     ctypes.c_int64, ctypes.c_int64,      # service_id, method_id
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,   # req
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,   # bulk section
+    ctypes.c_int,                                      # has_bulk
     ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),    # out rsp
     ctypes.POINTER(ctypes.c_size_t),                   # out rsp_len
+    ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),    # out rsp_bulk
+    ctypes.POINTER(ctypes.c_size_t),                   # out rsp_bulk_len
     ctypes.POINTER(ctypes.c_char_p),                   # out msg
 )
 
@@ -39,18 +52,61 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+def _build(force: bool = False) -> None:
+    cmd = ["make", "-C", os.path.abspath(_NATIVE_DIR)]
+    if force:
+        cmd.append("-B")
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _probe_abi() -> int:
+    """ABI version of the .so on disk, read in a SUBPROCESS: dlopen caches
+    by inode, so probing in-process would pin a stale mapping that a
+    rebuild-then-reload could never replace."""
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import ctypes\n"
+             f"lib = ctypes.CDLL({os.path.abspath(_LIB_PATH)!r})\n"
+             "try:\n"
+             "    lib.tpu3fs_rpc_abi_version.restype = ctypes.c_int\n"
+             "    print(lib.tpu3fs_rpc_abi_version())\n"
+             "except AttributeError:\n"
+             "    print(-1)\n"],
+            capture_output=True, text=True, timeout=30)
+        return int(out.stdout.strip() or -1)
+    except Exception:
+        return -1
+
+
 def _load_lib():
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            subprocess.run(
-                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                check=True,
-                capture_output=True,
-            )
+        # always run make: incremental, so a fresh .so is a cheap no-op and
+        # a source edit never runs against a stale binary. A host with a
+        # prebuilt .so but no toolchain (make missing or failing) still
+        # loads what's on disk — subject to the ABI gate below.
+        try:
+            _build()
+        except (subprocess.CalledProcessError, OSError):
+            if not os.path.exists(_LIB_PATH):
+                raise
+        # the ABI gate runs BEFORE the first in-process dlopen (see
+        # _probe_abi): a stale .so predating the bulk-framing handler
+        # signature would otherwise corrupt the callback stack
+        if _probe_abi() != _ABI_VERSION:
+            _build(force=True)  # raises where no toolchain can fix it
+            abi = _probe_abi()
+            if abi != _ABI_VERSION:
+                raise RuntimeError(
+                    f"libtpu3fs_rpc ABI {abi} != expected {_ABI_VERSION} "
+                    "after rebuild")
         lib = ctypes.CDLL(_LIB_PATH)
+        lib.tpu3fs_rpc_abi_version.restype = ctypes.c_int
         lib.tpu3fs_rpc_alloc.restype = ctypes.c_void_p
         lib.tpu3fs_rpc_alloc.argtypes = [ctypes.c_size_t]
         lib.tpu3fs_rpc_free.argtypes = [ctypes.c_void_p]
@@ -65,13 +121,19 @@ def _load_lib():
         lib.tpu3fs_rpc_client_connect.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ]
-        lib.tpu3fs_rpc_client_call.restype = ctypes.c_int
-        lib.tpu3fs_rpc_client_call.argtypes = [
+        lib.tpu3fs_rpc_client_call2.restype = ctypes.c_int
+        lib.tpu3fs_rpc_client_call2.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_void_p),       # iov ptrs
+            ctypes.POINTER(ctypes.c_size_t),       # iov lens
+            ctypes.c_int64,                        # n_iovs (-1 = no bulk)
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),  # out bulk
+            ctypes.POINTER(ctypes.c_size_t),                 # out bulk len
+            ctypes.POINTER(ctypes.c_int),                    # out has_bulk
             ctypes.POINTER(ctypes.c_char_p),
         ]
         lib.tpu3fs_rpc_client_close.argtypes = [ctypes.c_void_p]
@@ -79,11 +141,27 @@ def _load_lib():
         return lib
 
 
-def _malloc_bytes(lib, data: bytes):
+def _malloc_bytes(lib, data) -> int:
     """Copy bytes into a malloc'd buffer the C side takes ownership of."""
     buf = lib.tpu3fs_rpc_alloc(len(data) or 1)
-    ctypes.memmove(buf, data, len(data))
+    ctypes.memmove(buf, bytes(data), len(data))
     return buf
+
+
+def _malloc_section(lib, iovs):
+    """Assemble a bulk section (header + segments) into one malloc'd
+    buffer for the C side to writev after the envelope. The single copy on
+    the native server's reply path."""
+    hdr = pack_bulk_header(iovs)
+    total = len(hdr) + sum(len(b) for b in iovs)
+    buf = lib.tpu3fs_rpc_alloc(total or 1)
+    ctypes.memmove(buf, hdr, len(hdr))
+    off = len(hdr)
+    for iov in iovs:
+        if len(iov):
+            ctypes.memmove(buf + off, bytes(iov), len(iov))
+            off += len(iov)
+    return buf, total
 
 
 class NativeRpcServer:
@@ -129,7 +207,9 @@ class NativeRpcServer:
 
     # -- dispatch (same semantics as RpcServer._dispatch) -------------------
     def _handle(self, service_id, method_id, req_ptr, req_len,
-                out_rsp, out_rsp_len, out_msg) -> int:
+                bulk_ptr, bulk_len, has_bulk,
+                out_rsp, out_rsp_len, out_bulk, out_bulk_len,
+                out_msg) -> int:
         try:
             if not self._started:
                 return self._err(out_msg, Code.SHUTTING_DOWN, "not started")
@@ -142,12 +222,28 @@ class NativeRpcServer:
             if mdef is None:
                 return self._err(out_msg, Code.RPC_METHOD_NOT_FOUND,
                                  f"{service.name}.{method_id}")
+            bulk = None
+            if has_bulk:
+                if not mdef.bulk:
+                    return self._err(
+                        out_msg, Code.RPC_BAD_REQUEST,
+                        f"{service.name}.{mdef.name} is not bulk-capable")
+                # ONE owned copy of the section — the native frame buffer
+                # dies when this callback returns, but handlers may retain
+                # segments (per-target update queues)
+                section = (ctypes.string_at(bulk_ptr, bulk_len)
+                           if bulk_len else b"")
+                bulk = split_bulk(section)
             try:
                 req = deserialize(payload, mdef.req_type)
             except Exception as e:
                 return self._err(out_msg, Code.RPC_BAD_REQUEST, repr(e))
             try:
-                rsp = mdef.handler(req)
+                if mdef.bulk:
+                    rsp, reply_iovs = mdef.handler(req, bulk)
+                else:
+                    rsp = mdef.handler(req)
+                    reply_iovs = None
                 raw = serialize(rsp, mdef.rsp_type)
             except FsError as e:
                 return self._err(out_msg, e.code, e.status.message)
@@ -157,6 +253,10 @@ class NativeRpcServer:
                 _malloc_bytes(self._lib, raw), ctypes.POINTER(ctypes.c_uint8)
             )
             out_rsp_len[0] = len(raw)
+            if reply_iovs is not None:
+                buf, total = _malloc_section(self._lib, reply_iovs)
+                out_bulk[0] = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+                out_bulk_len[0] = total
             return int(Code.OK)
         except Exception:  # never let an exception cross the FFI boundary
             return int(Code.INTERNAL)
@@ -220,39 +320,96 @@ class NativeRpcClient:
         *,
         req_type: Optional[Type] = None,
     ) -> Any:
+        rsp, _ = self.call_bulk(addr, service_id, method_id, req, rsp_type,
+                                req_type=req_type)
+        return rsp
+
+    def call_bulk(
+        self,
+        addr: Tuple[str, int],
+        service_id: int,
+        method_id: int,
+        req: Any,
+        rsp_type: Type,
+        *,
+        req_type: Optional[Type] = None,
+        bulk_iovs=None,
+    ):
+        """call() with bulk riders both ways -> (rsp, reply_segments|None).
+        Request buffers are handed to the native writev as raw pointers —
+        zero-copy for bytes; reply segments are memoryviews over one
+        python-owned copy of the reply section."""
         raw = serialize(req, req_type or type(req))
         buf = (ctypes.c_uint8 * max(len(raw), 1)).from_buffer_copy(
             raw or b"\x00")
         status = ctypes.c_int64(0)
         rsp_ptr = ctypes.POINTER(ctypes.c_uint8)()
         rsp_len = ctypes.c_size_t(0)
+        bulk_ptr = ctypes.POINTER(ctypes.c_uint8)()
+        bulk_len = ctypes.c_size_t(0)
+        has_bulk = ctypes.c_int(0)
         msg_ptr = ctypes.c_char_p()
+        n_iovs = -1
+        iov_ptrs = None
+        iov_lens = None
+        keepalive = []
+        if bulk_iovs is not None:
+            n_iovs = len(bulk_iovs)
+            arr_p = (ctypes.c_void_p * max(n_iovs, 1))()
+            arr_l = (ctypes.c_size_t * max(n_iovs, 1))()
+            for i, iov in enumerate(bulk_iovs):
+                # c_char_p on a bytes object points at its internal buffer
+                # (no copy); non-bytes buffers take one owned copy here
+                b = iov if isinstance(iov, bytes) else bytes(iov)
+                keepalive.append(b)
+                arr_p[i] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
+                arr_l[i] = len(b)
+            iov_ptrs = arr_p
+            iov_lens = arr_l
         conn = self._get_conn(addr)
         try:
-            rc = self._lib.tpu3fs_rpc_client_call(
+            rc = self._lib.tpu3fs_rpc_client_call2(
                 conn.handle, service_id, method_id,
                 buf, len(raw),
+                iov_ptrs, iov_lens, n_iovs,
                 ctypes.byref(status), ctypes.byref(rsp_ptr),
-                ctypes.byref(rsp_len), ctypes.byref(msg_ptr),
+                ctypes.byref(rsp_len),
+                ctypes.byref(bulk_ptr), ctypes.byref(bulk_len),
+                ctypes.byref(has_bulk),
+                ctypes.byref(msg_ptr),
             )
+            if rc == -5:
+                # the caller's sizing error, caught by the C side before
+                # any bytes moved: the pooled connection is healthy —
+                # don't drop or mislabel it as a peer failure
+                raise FsError(Status(
+                    Code.RPC_BAD_REQUEST,
+                    f"{addr}: request exceeds max packet"))
             if rc != 0:
                 self._drop_conn(addr, conn)
                 code = Code.RPC_TIMEOUT if rc == -2 else Code.RPC_PEER_CLOSED
                 raise FsError(Status(code, f"{addr}: transport rc={rc}"))
         finally:
+            del keepalive
             if conn.lock.locked():
                 conn.lock.release()
         try:
             payload = ctypes.string_at(rsp_ptr, rsp_len.value) \
                 if rsp_len.value else b""
             message = (msg_ptr.value or b"").decode("utf-8", "replace")
+            section = None
+            if has_bulk.value:
+                section = (ctypes.string_at(bulk_ptr, bulk_len.value)
+                           if bulk_len.value else b"")
         finally:
             self._lib.tpu3fs_rpc_free(rsp_ptr)
+            self._lib.tpu3fs_rpc_free(bulk_ptr)
             self._lib.tpu3fs_rpc_free(
                 ctypes.cast(msg_ptr, ctypes.c_void_p))
         if status.value != int(Code.OK):
             raise FsError(Status(Code(status.value), message))
-        return deserialize(payload, rsp_type)
+        segments = split_bulk(section) if section is not None else None
+        return deserialize(payload, rsp_type), segments
 
     def close(self) -> None:
         with self._lock:

@@ -21,7 +21,7 @@ import uuid as uuid_mod
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
-from tpu3fs.rpc.serde import deserialize, serialize
+from tpu3fs.rpc.serde import deserialize, deserialize_prefix, serialize
 from tpu3fs.utils.result import Code, FsError, Status
 
 
@@ -50,6 +50,14 @@ class Timestamps:
 FLAG_IS_REQ = 1
 FLAG_COMPRESS = 2     # reserved (ref UseCompress)
 FLAG_CONTROL_RDMA = 4  # reserved (ref ControlRDMA)
+# bulk framing: the frame body is [MessagePacket serde][bulk section]; the
+# envelope's payload carries only control fields while chunk data rides the
+# bulk section untouched by serde — the analogue of the reference splitting
+# control packets from RDMA READ/WRITE batches into registered buffers
+# (src/common/net/ib/IBSocket.h:155-229, RDMABuf.h:434). Senders gather
+# caller buffers straight into sendmsg (no concatenation); receivers hand
+# out memoryview slices of one recv buffer (no per-field copies).
+FLAG_BULK = 8
 
 
 @dataclass
@@ -68,10 +76,122 @@ _LEN = struct.Struct(">I")
 MAX_PACKET = 64 << 20
 
 
-def _send_packet(sock: socket.socket, pkt: MessagePacket, lock: threading.Lock) -> None:
-    raw = serialize(pkt)
-    with lock:
-        sock.sendall(_LEN.pack(len(raw)) + raw)
+# -- bulk section codec ------------------------------------------------------
+# self-describing so the control schemas never change shape:
+#   varint count, varint len per segment, then the segments back to back.
+
+def _write_uvarint(buf: bytearray, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            buf.append(b | 0x80)
+        else:
+            buf.append(b)
+            return
+
+
+def _read_uvarint(data, pos: int):
+    shift = 0
+    out = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def pack_bulk_header(iovs) -> bytes:
+    hdr = bytearray()
+    _write_uvarint(hdr, len(iovs))
+    for iov in iovs:
+        _write_uvarint(hdr, len(iov))
+    return bytes(hdr)
+
+
+def split_bulk(section) -> List[memoryview]:
+    """Bulk section (memoryview) -> per-segment memoryviews, zero-copy."""
+    mv = memoryview(section)
+    try:
+        count, pos = _read_uvarint(mv, 0)
+        lens = []
+        for _ in range(count):
+            n, pos = _read_uvarint(mv, pos)
+            lens.append(n)
+    except IndexError:
+        # truncated header (empty section / varint cut mid-byte) must fail
+        # as a transport error, not leak IndexError past the FsError
+        # contract / the server's connection-error handling
+        raise ConnectionError("bulk section truncated header")
+    out = []
+    for n in lens:
+        if pos + n > len(mv):
+            raise ConnectionError("bulk segment overruns section")
+        out.append(mv[pos:pos + n])
+        pos += n
+    if pos != len(mv):
+        raise ConnectionError(f"bulk section trailing bytes: {len(mv) - pos}")
+    return out
+
+
+def _send_packet(
+    sock: socket.socket, pkt: MessagePacket, lock: threading.Lock,
+    bulk_iovs=None,
+) -> None:
+    if bulk_iovs is not None:
+        pkt.flags |= FLAG_BULK
+        raw = serialize(pkt)
+        hdr = pack_bulk_header(bulk_iovs)
+        total = len(raw) + len(hdr) + sum(len(b) for b in bulk_iovs)
+        if total > MAX_PACKET:
+            # the caller's sizing error, found BEFORE any bytes hit the
+            # wire: the connection is still in sync, so this must not be
+            # reported (or handled) as a peer/transport failure
+            raise FsError(Status(
+                Code.RPC_BAD_REQUEST, f"oversized packet: {total}"))
+        # gather-write: caller buffers go straight to the kernel, no
+        # concatenation of control + data
+        iovs = [_LEN.pack(total) + raw + hdr] + list(bulk_iovs)
+        with lock:
+            _sendmsg_all(sock, iovs)
+    else:
+        raw = serialize(pkt)
+        with lock:
+            sock.sendall(_LEN.pack(len(raw)) + raw)
+
+
+# one sendmsg accepts at most IOV_MAX (1024) buffers; stay under it so a
+# wide batch (1000+ ops) doesn't fail with EMSGSIZE
+_IOV_CAP = 512
+
+
+def _sendmsg_all(sock: socket.socket, iovs) -> None:
+    """sendmsg until every iov is fully written (sendmsg may stop short,
+    and never takes more than _IOV_CAP buffers per call)."""
+    iovs = list(iovs)
+    while iovs:
+        window = iovs[:_IOV_CAP]
+        total = sum(len(b) for b in window)
+        sent = sock.sendmsg(window)
+        if sent >= total:
+            del iovs[:len(window)]
+            continue
+        # drop fully-sent iovs, trim the partial one, go again
+        remaining: List = []
+        acc = 0
+        for iov in window:
+            if acc + len(iov) <= sent:
+                acc += len(iov)
+                continue
+            # only the boundary iov is partially sent; later ones must go
+            # whole (a negative off would tail-slice and drop bytes)
+            off = max(0, sent - acc)
+            mv = memoryview(iov)
+            remaining.append(mv[off:] if off else mv)
+            acc += len(iov)
+        iovs = remaining + iovs[len(window):]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -84,11 +204,33 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_packet(sock: socket.socket) -> MessagePacket:
+def _recv_exact_into(sock: socket.socket, n: int) -> bytearray:
+    """One allocation, recv_into it (no chunk-list joins)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    off = 0
+    while off < n:
+        got = sock.recv_into(view[off:], n - off)
+        if not got:
+            raise ConnectionError("peer closed")
+        off += got
+    return buf
+
+
+def _recv_packet(sock: socket.socket):
+    """-> (MessagePacket, bulk_segments | None). Bulk segments are
+    memoryviews over the single receive buffer — the buffer stays alive as
+    long as any view does, so hand-offs are GC-safe."""
     (n,) = _LEN.unpack(_recv_exact(sock, 4))
     if n > MAX_PACKET:
         raise ConnectionError(f"oversized packet: {n}")
-    return deserialize(_recv_exact(sock, n), MessagePacket)
+    buf = _recv_exact_into(sock, n)
+    pkt, pos = deserialize_prefix(buf, MessagePacket)
+    if pkt.flags & FLAG_BULK:
+        return pkt, split_bulk(memoryview(buf)[pos:])
+    if pos != n:
+        raise ConnectionError(f"trailing bytes after packet: {n - pos}")
+    return pkt, None
 
 
 # -- service declaration ----------------------------------------------------
@@ -100,6 +242,9 @@ class MethodDef:
     req_type: Type
     rsp_type: Type
     handler: Callable[[Any], Any]
+    # bulk-capable methods take (req, bulk_segments|None) and return
+    # (rsp, reply_iovs|None); plain methods take req and return rsp
+    bulk: bool = False
 
 
 class ServiceDef:
@@ -112,11 +257,12 @@ class ServiceDef:
 
     def method(
         self, method_id: int, name: str, req_type: Type, rsp_type: Type,
-        handler: Callable[[Any], Any],
+        handler: Callable[[Any], Any], *, bulk: bool = False,
     ) -> None:
         if method_id in self.methods:
             raise ValueError(f"duplicate method id {method_id} in {self.name}")
-        self.methods[method_id] = MethodDef(method_id, name, req_type, rsp_type, handler)
+        self.methods[method_id] = MethodDef(
+            method_id, name, req_type, rsp_type, handler, bulk)
 
 
 class RpcServer:
@@ -167,10 +313,18 @@ class RpcServer:
         write_lock = threading.Lock()
         try:
             while self._running:
-                pkt = _recv_packet(conn)
+                pkt, bulk = _recv_packet(conn)
                 pkt.timestamps.server_receive = time.monotonic()
-                reply = self._dispatch(pkt)
-                _send_packet(conn, reply, write_lock)
+                reply, reply_iovs = self._dispatch(pkt, bulk)
+                try:
+                    _send_packet(conn, reply, write_lock, reply_iovs)
+                except FsError as e:
+                    # oversized reply (MAX_PACKET): the stream is still in
+                    # sync (nothing was written) — answer with an error
+                    # envelope like the native server does, don't kill the
+                    # connection thread
+                    err = self._error_reply(reply, e.code, e.status.message)
+                    _send_packet(conn, err, write_lock)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -179,30 +333,41 @@ class RpcServer:
             except OSError:
                 pass
 
-    def _dispatch(self, pkt: MessagePacket) -> MessagePacket:
+    def _dispatch(self, pkt: MessagePacket, bulk=None):
+        """-> (reply packet, reply bulk iovs | None)."""
         ts = pkt.timestamps
         ts.server_dequeue = time.monotonic()
         service = self._services.get(pkt.service_id)
         if service is None:
             return self._error_reply(pkt, Code.RPC_SERVICE_NOT_FOUND,
-                                     str(pkt.service_id))
+                                     str(pkt.service_id)), None
         mdef = service.methods.get(pkt.method_id)
         if mdef is None:
             return self._error_reply(pkt, Code.RPC_METHOD_NOT_FOUND,
-                                     f"{service.name}.{pkt.method_id}")
+                                     f"{service.name}.{pkt.method_id}"), None
+        if bulk is not None and not mdef.bulk:
+            return self._error_reply(
+                pkt, Code.RPC_BAD_REQUEST,
+                f"{service.name}.{mdef.name} is not bulk-capable"), None
         try:
             req = deserialize(pkt.payload, mdef.req_type)
         except Exception as e:  # malformed payload
-            return self._error_reply(pkt, Code.RPC_BAD_REQUEST, repr(e))
+            return self._error_reply(pkt, Code.RPC_BAD_REQUEST, repr(e)), None
         ts.server_run_start = time.monotonic()
+        reply_iovs = None
         try:
-            rsp = mdef.handler(req)
+            if mdef.bulk:
+                rsp, reply_iovs = mdef.handler(req, bulk)
+            else:
+                rsp = mdef.handler(req)
             payload = serialize(rsp, mdef.rsp_type)
             status, message = int(Code.OK), ""
         except FsError as e:
             payload, status, message = b"", int(e.code), e.status.message
+            reply_iovs = None
         except Exception as e:  # handler bug: surface as INTERNAL
             payload, status, message = b"", int(Code.INTERNAL), repr(e)
+            reply_iovs = None
         ts.server_run_end = time.monotonic()
         return MessagePacket(
             uuid=pkt.uuid,
@@ -213,7 +378,7 @@ class RpcServer:
             payload=payload,
             message=message,
             timestamps=ts,
-        )
+        ), reply_iovs
 
     @staticmethod
     def _error_reply(pkt: MessagePacket, code: Code, msg: str) -> MessagePacket:
@@ -298,6 +463,24 @@ class RpcClient:
         req_type: Optional[Type] = None,
     ) -> Any:
         """Raises FsError carrying the remote (or transport) status code."""
+        rsp, _ = self.call_bulk(addr, service_id, method_id, req, rsp_type,
+                                req_type=req_type)
+        return rsp
+
+    def call_bulk(
+        self,
+        addr: Tuple[str, int],
+        service_id: int,
+        method_id: int,
+        req: Any,
+        rsp_type: Type,
+        *,
+        req_type: Optional[Type] = None,
+        bulk_iovs=None,
+    ):
+        """call() with bulk riders both ways -> (rsp, reply_segments|None).
+        Request `bulk_iovs` buffers are gathered into the socket without
+        copies; reply segments are memoryviews over one receive buffer."""
         pkt = MessagePacket(
             uuid=uuid_mod.uuid4().hex,
             service_id=service_id,
@@ -314,8 +497,8 @@ class RpcClient:
             # let another thread claim a connection we may still drop/close
             try:
                 pkt.timestamps.client_send = time.monotonic()
-                _send_packet(conn.sock, pkt, conn.write_lock)
-                reply = _recv_packet(conn.sock)
+                _send_packet(conn.sock, pkt, conn.write_lock, bulk_iovs)
+                reply, reply_bulk = _recv_packet(conn.sock)
                 reply.timestamps.client_receive = time.monotonic()
             except (ConnectionError, OSError, socket.timeout) as e:
                 self._drop_conn(addr, conn)
@@ -335,7 +518,7 @@ class RpcClient:
             raise FsError(Status(Code(reply.status), reply.message))
         reply.timestamps.client_done = time.monotonic()
         rsp = deserialize(reply.payload, rsp_type)
-        return rsp
+        return rsp, reply_bulk
 
     def close(self) -> None:
         with self._lock:

@@ -205,6 +205,15 @@ def deserialize(data: bytes, hint: Type[T]) -> T:
     return value
 
 
+def deserialize_prefix(data, hint: Type[T]):
+    """Decode one value from the front of `data` (bytes or memoryview);
+    -> (value, bytes_consumed). Trailing bytes are the caller's business —
+    the bulk-framed RPC transport rides raw payload sections after the
+    envelope (the RDMA-batch analogue, ref IBSocket.h:155-229)."""
+    value, pos = _decode(memoryview(data), 0, hint)
+    return value, pos
+
+
 def serde_json(value: Any) -> Any:
     """Debug render: dataclass tree -> plain JSON-able structures."""
     if dataclasses.is_dataclass(value) and not isinstance(value, type):

@@ -13,7 +13,7 @@ ResyncWorker run unchanged over sockets.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
 
 from tpu3fs.meta.store import (
@@ -170,12 +170,80 @@ class RegisterNodeReq:
 
 
 # -- storage ----------------------------------------------------------------
+#
+# Data-path methods are bulk-capable: chunk payloads ride the frame's bulk
+# section (FLAG_BULK, net.py) instead of the serde envelope — the analogue
+# of the reference separating control packets from RDMA READ/WRITE batches
+# (src/common/net/ib/IBSocket.h:155-229). A bulk-mode client always sets
+# the flag (an empty section on pure reads signals "reply in bulk"); legacy
+# inline-payload requests are still served inline, so the two wire forms
+# interoperate.
+
+def _attach(op, seg):
+    """Re-attach a bulk segment as an op's data field. Segments arrive as
+    memoryviews over the transport's receive buffer; ops may outlive the
+    request (per-target update queues), so take an owned copy — the ONE
+    copy on the whole receive path."""
+    return replace(op, data=seg if isinstance(seg, bytes) else bytes(seg))
+
+
+def _detach(rsp):
+    """Split a reply's data field off into a bulk segment."""
+    return replace(rsp, data=b""), rsp.data
+
 
 def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     s = ServiceDef(STORAGE_SERVICE_ID, "StorageSerde")
-    s.method(1, "write", WriteReq, UpdateReply, svc.write)
-    s.method(2, "update", WriteReq, UpdateReply, svc.update)
-    s.method(3, "read", ReadReq, ReadReply, svc.read)
+
+    def _one_write(fn):
+        def h(r, bulk):
+            # `is not None`, not truthiness: a bulk-flagged request with a
+            # count=0 section must be rejected, not silently run with
+            # data=b'' (empty-section probes are a read-path convention)
+            if bulk is not None:
+                if len(bulk) != 1:
+                    raise FsError(Status(
+                        Code.RPC_BAD_REQUEST,
+                        f"bulk segments {len(bulk)} != 1"))
+                r = _attach(r, bulk[0])
+            return fn(r), None
+        return h
+
+    def _batch_write(fn):
+        def h(r, bulk):
+            reqs = r.reqs
+            if bulk is not None:
+                if len(bulk) != len(reqs):
+                    raise FsError(Status(
+                        Code.RPC_BAD_REQUEST,
+                        f"bulk segments {len(bulk)} != ops {len(reqs)}"))
+                reqs = [_attach(op, seg) for op, seg in zip(reqs, bulk)]
+            return BatchWriteRsp(fn(reqs)), None
+        return h
+
+    def _read_h(r, bulk):
+        rsp = svc.read(r)
+        if bulk is None:
+            return rsp, None
+        ctrl, data = _detach(rsp)
+        return ctrl, [data]
+
+    def _batch_read_h(r, bulk):
+        replies = svc.batch_read(r.reqs)
+        if bulk is None:
+            return BatchReadRsp(replies), None
+        ctrls, iovs = [], []
+        for rp in replies:
+            ctrl, data = _detach(rp)
+            ctrls.append(ctrl)
+            iovs.append(data)
+        return BatchReadRsp(ctrls), iovs
+
+    s.method(1, "write", WriteReq, UpdateReply, _one_write(svc.write),
+             bulk=True)
+    s.method(2, "update", WriteReq, UpdateReply, _one_write(svc.update),
+             bulk=True)
+    s.method(3, "read", ReadReq, ReadReply, _read_h, bulk=True)
     s.method(4, "dumpChunkMeta", TargetIdReq, ChunkMetaList,
              lambda r: ChunkMetaList(svc.dump_chunkmeta(r.target_id)))
     s.method(5, "syncDone", TargetIdReq, Empty,
@@ -190,15 +258,16 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
              lambda r: IntReply(svc.truncate_file_chunks(
                  r.chain_id, r.file_id, r.last_index, r.last_length)))
     s.method(10, "spaceInfo", Empty, SpaceInfo, lambda r: svc.space_info())
-    s.method(11, "batchRead", BatchReadReq, BatchReadRsp,
-             lambda r: BatchReadRsp(svc.batch_read(r.reqs)))
+    s.method(11, "batchRead", BatchReadReq, BatchReadRsp, _batch_read_h,
+             bulk=True)
     s.method(12, "batchWrite", BatchWriteReq, BatchWriteRsp,
-             lambda r: BatchWriteRsp(svc.batch_write(r.reqs)))
-    s.method(13, "writeShard", ShardWriteReq, UpdateReply, svc.write_shard)
+             _batch_write(svc.batch_write), bulk=True)
+    s.method(13, "writeShard", ShardWriteReq, UpdateReply,
+             _one_write(svc.write_shard), bulk=True)
     s.method(14, "batchWriteShard", BatchShardWriteReq, BatchWriteRsp,
-             lambda r: BatchWriteRsp(svc.batch_write_shard(r.reqs)))
+             _batch_write(svc.batch_write_shard), bulk=True)
     s.method(15, "batchUpdate", BatchWriteReq, BatchWriteRsp,
-             lambda r: BatchWriteRsp(svc.batch_update(r.reqs)))
+             _batch_write(svc.batch_update), bulk=True)
     s.method(16, "statChunks", StatChunksReq, StatChunksRsp,
              lambda r: StatChunksRsp(
                  [list(t) for t in svc.stat_chunks(r.target_id, r.chunk_ids)]))
@@ -221,8 +290,13 @@ class RpcMessenger:
     """
 
     def __init__(self, routing_provider, client: Optional[RpcClient] = None):
+        import os
+
         self._routing = routing_provider
         self._client = client or RpcClient()
+        # A/B lever: TPU3FS_RPC_INLINE=1 turns bulk framing off so the
+        # two wire forms can be benchmarked against each other
+        self._bulk = os.environ.get("TPU3FS_RPC_INLINE", "") != "1"
 
     def _addr(self, node_id: int) -> Tuple[str, int]:
         node = self._routing().nodes.get(node_id)
@@ -230,16 +304,49 @@ class RpcMessenger:
             raise FsError(Status(Code.RPC_CONNECT_FAILED, f"no address for node {node_id}"))
         return node.host, node.port
 
+    def _one_write(self, addr, method_id: int, op):
+        """Single write-ish op: the chunk payload rides the bulk section,
+        the control envelope carries everything else — no payload
+        concatenation anywhere on the send path."""
+        if not self._bulk:
+            return self._client.call(addr, STORAGE_SERVICE_ID, method_id,
+                                     op, UpdateReply)
+        ctrl = replace(op, data=b"")
+        rsp, _ = self._client.call_bulk(
+            addr, STORAGE_SERVICE_ID, method_id, ctrl, UpdateReply,
+            req_type=type(op), bulk_iovs=[op.data])
+        return rsp
+
+    def _batch_write(self, addr, method_id: int, ops, req_cls):
+        if not self._bulk:
+            return self._client.call(addr, STORAGE_SERVICE_ID, method_id,
+                                     req_cls(ops), BatchWriteRsp).replies
+        iovs = [op.data for op in ops]
+        ctrl = req_cls([replace(op, data=b"") for op in ops])
+        rsp, _ = self._client.call_bulk(
+            addr, STORAGE_SERVICE_ID, method_id, ctrl, BatchWriteRsp,
+            bulk_iovs=iovs)
+        return rsp.replies
+
     def __call__(self, node_id: int, method: str, payload):
         addr = self._addr(node_id)
         c = self._client
         sid = STORAGE_SERVICE_ID
         if method == "write":
-            return c.call(addr, sid, 1, payload, UpdateReply)
+            return self._one_write(addr, 1, payload)
         if method == "update":
-            return c.call(addr, sid, 2, payload, UpdateReply)
+            return self._one_write(addr, 2, payload)
         if method == "read":
-            return c.call(addr, sid, 3, payload, ReadReply)
+            if not self._bulk:
+                return c.call(addr, sid, 3, payload, ReadReply)
+            # empty bulk section = "I speak bulk; reply with data in bulk"
+            rsp, segs = c.call_bulk(addr, sid, 3, payload, ReadReply,
+                                    bulk_iovs=())
+            if segs:
+                # owned copy: .data must stay bytes for every consumer
+                # (slicing, ljust, joins) — the ONE copy on this path
+                rsp = replace(rsp, data=bytes(segs[0]))
+            return rsp
         if method == "dump_chunkmeta":
             return c.call(addr, sid, 4, TargetIdReq(payload), ChunkMetaList).metas
         if method == "sync_done":
@@ -257,17 +364,24 @@ class RpcMessenger:
         if method == "space_info":
             return c.call(addr, sid, 10, Empty(), SpaceInfo)
         if method == "batch_read":
-            return c.call(addr, sid, 11, BatchReadReq(payload), BatchReadRsp).replies
+            if not self._bulk:
+                return c.call(addr, sid, 11, BatchReadReq(payload),
+                              BatchReadRsp).replies
+            rsp, segs = c.call_bulk(addr, sid, 11, BatchReadReq(payload),
+                                    BatchReadRsp, bulk_iovs=())
+            replies = rsp.replies
+            if segs:
+                replies = [replace(rp, data=bytes(seg))
+                           for rp, seg in zip(replies, segs)]
+            return replies
         if method == "batch_write":
-            return c.call(addr, sid, 12, BatchWriteReq(payload), BatchWriteRsp).replies
+            return self._batch_write(addr, 12, payload, BatchWriteReq)
         if method == "write_shard":
-            return c.call(addr, sid, 13, payload, UpdateReply)
+            return self._one_write(addr, 13, payload)
         if method == "batch_write_shard":
-            return c.call(
-                addr, sid, 14, BatchShardWriteReq(payload), BatchWriteRsp
-            ).replies
+            return self._batch_write(addr, 14, payload, BatchShardWriteReq)
         if method == "batch_update":
-            return c.call(addr, sid, 15, BatchWriteReq(payload), BatchWriteRsp).replies
+            return self._batch_write(addr, 15, payload, BatchWriteReq)
         if method == "stat_chunks":
             rsp = c.call(addr, sid, 16, StatChunksReq(*payload), StatChunksRsp)
             return [tuple(t) for t in rsp.stats]

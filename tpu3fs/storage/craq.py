@@ -338,8 +338,10 @@ class StorageService:
 
     # -- wiring -------------------------------------------------------------
     def add_target(self, target: StorageTarget) -> None:
+        # no snapshot invalidation needed: _TargetMapSnapshot caches only
+        # (routing_version, chains); target objects and their local_state
+        # are always read live from _targets
         self._targets[target.target_id] = target
-        self._tmap = None  # snapshot must pick up the new target
 
     def target(self, target_id: int) -> Optional[StorageTarget]:
         return self._targets.get(target_id)
@@ -427,8 +429,10 @@ class StorageService:
             return False
         from tpu3fs.mgmtd.types import LocalTargetState
 
+        # local_state is read live by _check_target_serving (the snapshot
+        # caches only routing chains), so the next op sees the refusal
+        # without any invalidation
         target.local_state = LocalTargetState.OFFLINE
-        self._tmap = None  # next op sees the refusal immediately
         return True
 
     def _check_target_serving(self, target: StorageTarget) -> None:
