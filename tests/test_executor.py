@@ -1,0 +1,148 @@
+"""Executor toolkit + buffer pool (tpu3fs/utils/{executor,bufpool}.py —
+the reference's CoroutinesPool.h / BackgroundRunner.h / RDMABuf.h pool
+roles, thread-shaped)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu3fs.utils.bufpool import BufferPool, _class_of
+from tpu3fs.utils.executor import (
+    ConcurrencyLimiter,
+    PeriodicRunner,
+    WorkerPool,
+)
+from tpu3fs.utils.result import Code, FsError
+
+
+class TestWorkerPool:
+    def test_submit_and_results(self):
+        pool = WorkerPool("t", num_workers=3)
+        try:
+            futs = [pool.submit(lambda x=i: x * x) for i in range(20)]
+            assert [f.get(5) for f in futs] == [i * i for i in range(20)]
+        finally:
+            pool.shutdown()
+
+    def test_exceptions_delivered_via_future(self):
+        pool = WorkerPool("t", num_workers=1)
+        try:
+            fut = pool.submit(lambda: 1 / 0)
+            with pytest.raises(ZeroDivisionError):
+                fut.get(5)
+        finally:
+            pool.shutdown()
+
+    def test_map_runs_all_and_raises_first_error(self):
+        pool = WorkerPool("t", num_workers=2)
+        done = []
+        try:
+            def work(i):
+                if i == 3:
+                    raise ValueError("boom")
+                done.append(i)
+                return i
+
+            with pytest.raises(ValueError):
+                pool.map(work, range(8))
+            # every non-failing task still ran (no mid-flight abandonment)
+            assert sorted(done) == [0, 1, 2, 4, 5, 6, 7]
+        finally:
+            pool.shutdown()
+
+    def test_bounded_queue_backpressure(self):
+        pool = WorkerPool("t", num_workers=1, queue_cap=2)
+        gate = threading.Event()
+        try:
+            pool.submit(gate.wait)  # occupies the worker
+            pool.submit(lambda: None)
+            pool.submit(lambda: None)  # queue now full (cap 2)
+            with pytest.raises(FsError) as ei:
+                pool.submit(lambda: None, block=False)
+            assert ei.value.code == Code.CLIENT_BUSY
+            with pytest.raises(FsError):
+                pool.submit(lambda: None, timeout=0.05)
+        finally:
+            gate.set()
+            pool.shutdown()
+
+    def test_submit_after_shutdown_rejected(self):
+        pool = WorkerPool("t", num_workers=1)
+        pool.shutdown()
+        with pytest.raises(FsError) as ei:
+            pool.submit(lambda: None)
+        assert ei.value.code == Code.SHUTTING_DOWN
+
+
+class TestConcurrencyLimiter:
+    def test_limits_holders(self):
+        lim = ConcurrencyLimiter("t", 2)
+        peak = [0]
+        cur = [0]
+        mu = threading.Lock()
+
+        def work():
+            with lim:
+                with mu:
+                    cur[0] += 1
+                    peak[0] = max(peak[0], cur[0])
+                time.sleep(0.01)
+                with mu:
+                    cur[0] -= 1
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert peak[0] <= 2
+
+
+class TestPeriodicRunner:
+    def test_runs_and_survives_errors(self):
+        hits = []
+
+        def tick():
+            hits.append(1)
+            if len(hits) == 1:
+                raise RuntimeError("first tick fails")
+
+        r = PeriodicRunner("t", 0.02, tick, jitter=0.0)
+        r.start()
+        deadline = time.monotonic() + 5
+        while len(hits) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        r.stop()
+        assert len(hits) >= 3  # kept running after the failing tick
+
+
+class TestBufferPool:
+    def test_class_rounding(self):
+        assert _class_of(1) == 4096
+        assert _class_of(4096) == 4096
+        assert _class_of(4097) == 8192
+        assert _class_of(1 << 20) == 1 << 20
+
+    def test_reuse(self):
+        pool = BufferPool()
+        a = pool.acquire(5000)
+        assert len(a) == 8192
+        pool.release(a)
+        b = pool.acquire(6000)
+        assert b is a  # same class, reused
+        assert pool.stats()["hits"] == 1
+
+    def test_oversize_not_pooled(self):
+        pool = BufferPool(max_class_bytes=1 << 20)
+        big = pool.acquire(2 << 20)
+        assert len(big) == 2 << 20  # exact, not class-rounded
+        pool.release(big)
+        assert pool.stats()["pooled_bytes"] == 0
+
+    def test_per_class_bound(self):
+        pool = BufferPool(max_per_class=2)
+        bufs = [pool.acquire(4096) for _ in range(5)]
+        for b in bufs:
+            pool.release(b)
+        assert pool.stats()["pooled_bytes"] == 2 * 4096

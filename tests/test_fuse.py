@@ -68,7 +68,7 @@ class TestFuseOps:
         names = [n for n, _ in fuse_ops.readdir("/")]
         assert VIRT_DIR in names
         virt = [n for n, _ in fuse_ops.readdir("/" + VIRT_DIR)]
-        assert sorted(virt) == ["iors", "iovs"]
+        assert sorted(virt) == ["fds", "iors", "iovs"]
 
     def test_namespace_ops(self, fuse_ops):
         o = fuse_ops
@@ -294,3 +294,50 @@ class TestXattrs:
         with pytest.raises(FsError) as ei:
             ops.setxattr("/fl", "user.nope", b"x", MetaStore.XATTR_REPLACE)
         assert ei.value.code == Code.META_NO_XATTR
+
+
+@pytest.mark.skipif(not _can_mount(), reason="no /dev/fuse or libfuse2")
+class TestForeignProcessUsrbio:
+    """The external C++ load generator (native/usrbio_loadgen.cpp) drives
+    the USRBIO shm ABI from a FOREIGN process — raw struct layouts, POSIX
+    named semaphores, and the 3fs-virt magic-symlink registration through
+    a real kernel mount (the reference's fio-engine parity claim,
+    benchmarks/fio_usrbio/hf3fs_usrbio.cpp)."""
+
+    def test_loadgen_end_to_end(self):
+        import json as json_mod
+
+        from tpu3fs.fuse.mount import FuseMount
+
+        native_dir = os.path.join(os.path.dirname(__file__), "..", "native")
+        binary = os.path.join(native_dir, "usrbio_loadgen")
+        subprocess.run(["make", "-C", native_dir, "usrbio_loadgen"],
+                       check=True, capture_output=True)
+        fab = Fabric()
+        ops = FuseOps(fab.meta, fab.file_client(),
+                      UsrbioAgent(fab.meta, fab.file_client()))
+        mnt = tempfile.mkdtemp(prefix="tpu3fs-lg-")
+        m = FuseMount(ops, mnt)
+        m.mount()
+        if not m.wait_mounted(timeout=15):
+            pytest.skip(f"kernel mount failed (exit {m.exit_code})")
+        try:
+            # 4 MiB file, 128 KiB blocks, queue depth 8, 2 iterations
+            out = subprocess.run(
+                [binary, mnt, "4", "128", "8", "2"],
+                capture_output=True, text=True, timeout=120)
+            assert out.returncode == 0, (out.stdout, out.stderr)
+            rows = [json_mod.loads(line)
+                    for line in out.stdout.strip().splitlines()]
+            metrics = {r["metric"]: r for r in rows}
+            assert "usrbio_loadgen_write" in metrics
+            assert "usrbio_loadgen_read" in metrics
+            assert metrics["usrbio_loadgen_read"]["verified"] is True
+            assert metrics["usrbio_loadgen_write"]["value"] > 0
+            # teardown happened via unlink: registrations gone
+            assert os.listdir(f"{mnt}/{VIRT_DIR}/iors") == []
+            assert os.listdir(f"{mnt}/{VIRT_DIR}/fds") == []
+        finally:
+            m.unmount()
+            subprocess.run(["fusermount", "-u", "-z", mnt],
+                           check=False, capture_output=True)

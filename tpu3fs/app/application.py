@@ -55,6 +55,7 @@ class ApplicationBase:
         self.server: Optional[RpcServer] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._runners: List = []  # PeriodicRunner instances
         self._flags: Dict[str, str] = {}
         self._parse_argv()
 
@@ -160,12 +161,9 @@ class ApplicationBase:
             {"node": str(self.info.node_id),
              "kind": type(self).__name__})
 
-        def loop() -> None:
-            while not self._stop.wait(interval_s):
-                self.memory_monitor.poll_once()
-
         self.memory_monitor.poll_once()
-        self.spawn(loop, "memory-monitor")
+        self.spawn_periodic("memory-monitor", interval_s,
+                            self.memory_monitor.poll_once)
 
     def wait(self) -> None:
         try:
@@ -177,6 +175,8 @@ class ApplicationBase:
 
     def stop(self) -> None:
         self._stop.set()
+        for r in self._runners:
+            r.request_stop()
 
     @property
     def stopped(self) -> bool:
@@ -196,6 +196,19 @@ class ApplicationBase:
         t = threading.Thread(target=fn, name=name, daemon=True)
         t.start()
         self._threads.append(t)
+
+    def spawn_periodic(self, name: str, interval_s, fn):
+        """Named periodic background task (ref BackgroundRunner.h), tied
+        to the app's stop(): interval_s may be a zero-arg callable so
+        hot-updated config intervals take effect on the next tick."""
+        from tpu3fs.utils.executor import PeriodicRunner
+
+        r = PeriodicRunner(name, interval_s, fn)
+        r.start()
+        self._runners.append(r)
+        if r._thread is not None:
+            self._threads.append(r._thread)  # joined in _shutdown
+        return r
 
     def run_background(self) -> "ApplicationBase":
         """Start and return without blocking; caller stops via stop()+join()."""

@@ -60,14 +60,13 @@ class MgmtdApp(OnePhaseApplication):
 
     def before_start(self) -> None:
         self.mgmtd.extend_lease()
-        self.spawn(self._tick_loop, "mgmtd-tick")
-
-    def _tick_loop(self) -> None:
-        while not self._stop.wait(self.config.get("tick_interval_s")):
-            try:
-                self.mgmtd.tick()
-            except Exception:
-                pass
+        # hot-updatable cadence: the callable interval re-reads config
+        # every tick (utils.executor.PeriodicRunner)
+        self.spawn_periodic(
+            "mgmtd-tick",
+            lambda: self.config.get("tick_interval_s"),
+            self.mgmtd.tick,
+        )
 
 
 def main(argv: Optional[List[str]] = None) -> int:

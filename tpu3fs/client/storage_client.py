@@ -89,8 +89,38 @@ class StorageClient:
         self._channels = UpdateChannelAllocator()
         self._rr = itertools.count()
         self._rng = random.Random(seed)
+        self._pool = None  # lazy batch fan-out pool (multi-node batches)
+        self._pool_mu = threading.Lock()
+
+    def close(self) -> None:
+        """Release the fan-out pool's worker threads (clients are cheap to
+        create, but their pools are not GC'd — long-lived processes that
+        churn clients must close them)."""
+        with self._pool_mu:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # -- internals ----------------------------------------------------------
+    def _fan_out(self, fn: Callable, items: List) -> None:
+        """Issue per-node batch calls concurrently (ref StorageClientImpl
+        launching one coroutine per node group, StorageClientImpl.cc:1303);
+        a single-node batch runs inline — no pool, no handoff cost."""
+        import os
+
+        if (len(items) <= 1
+                or os.environ.get("TPU3FS_CLIENT_FANOUT", "1") == "0"):
+            for item in items:
+                fn(item)
+            return
+        with self._pool_mu:
+            if self._pool is None:
+                from tpu3fs.utils.executor import WorkerPool
+
+                self._pool = WorkerPool(f"client-{self.client_id}",
+                                        num_workers=4, queue_cap=64)
+            pool = self._pool
+        pool.map(fn, items)
     def _chain(self, chain_id: int) -> ChainInfo:
         chain = self._routing().chains.get(chain_id)
         if chain is None:
@@ -266,10 +296,12 @@ class StorageClient:
         by_node: Dict[int, List[Tuple[int, ReadReq]]] = defaultdict(list)
         for node_id, i, req in plan:
             by_node[node_id].append((i, req))
-        for node_id, batch in by_node.items():
+
+        def _issue_read(item) -> None:
             # ONE BatchRead request per node (ref sendBatchRequest
             # StorageClientImpl.cc:1303): the round trip is amortized over
             # the whole group
+            node_id, batch = item
             idxs = [i for i, _ in batch]
             try:
                 got = self._messenger(
@@ -279,6 +311,8 @@ class StorageClient:
             except FsError as e:
                 for i in idxs:
                     replies[i] = ReadReply(e.code)
+
+        self._fan_out(_issue_read, list(by_node.items()))
         # fall back to the single-op retry ladder for failures (EC replies
         # already went through read_stripe's own ladder)
         for i, r in enumerate(replies):
@@ -334,7 +368,8 @@ class StorageClient:
                     seqnum=seq,
                 )
                 by_node[node.node_id].append(i)
-            for node_id, idxs in by_node.items():
+            def _issue_write(item) -> None:
+                node_id, idxs = item
                 try:
                     got = self._messenger(
                         node_id, "batch_write", [reqs[i] for i in idxs])
@@ -343,6 +378,8 @@ class StorageClient:
                 except FsError as e:
                     for i in idxs:
                         replies[i] = UpdateReply(e.code)
+
+            self._fan_out(_issue_write, list(by_node.items()))
         finally:
             for slot in channels:
                 if slot is not None:

@@ -31,7 +31,7 @@ from tpu3fs.meta.types import Inode, InodeType
 from tpu3fs.utils.result import Code, FsError, Status
 
 VIRT_DIR = "3fs-virt"
-_VIRT_SUBDIRS = ("iovs", "iors")
+_VIRT_SUBDIRS = ("iovs", "iors", "fds")
 
 # FsError code -> errno (subset; everything else maps to EIO)
 _CODE_ERRNO = {
@@ -357,6 +357,23 @@ class FuseOps:
             size = os.stat(os.path.join("/dev/shm", target)).st_size
             iov = self._agent.register_iov(target, size)
             self._virt_iovs[name] = iov
+        elif kind == "fds":
+            # foreign-process fd registration (hf3fs_reg_fd): target =
+            # "<fs-path>?rw=r|w"; the agent assigns a virtual fd and the
+            # client reads it back via readlink, which returns the stored
+            # target with "&fd=N" appended — a pure symlink handshake, no
+            # shared address space needed
+            fs_path, _, qs = target.partition("?")
+            params = dict(
+                kv.split("=", 1) for kv in qs.split("&") if "=" in kv
+            )
+            rw = params.get("rw", "r")
+            fd = self._agent.open(fs_path, write=rw == "w")
+            # stored target is NORMALIZED to always carry the query string:
+            # a bare-path registration ("somefile", default rw) must still
+            # round-trip "?...&fd=N" so deregistration can find the fd
+            self._virt[kind][name] = f"{fs_path}?rw={rw}&fd={fd}"
+            return
         else:
             # target = "<ring-shm-name>?entries=N&rw=r|w&prio=P&iov=<names,>"
             ring_name, _, qs = target.partition("?")
@@ -383,6 +400,13 @@ class FuseOps:
         if kind == "iors":
             ring_name = target.partition("?")[0]
             self._agent.deregister_ring(ring_name)
+        elif kind == "fds":
+            params = dict(
+                kv.split("=", 1)
+                for kv in target.partition("?")[2].split("&") if "=" in kv
+            )
+            if "fd" in params:
+                self._agent.close_fd(int(params["fd"]))
         else:
             iov = self._virt_iovs.pop(name, None)
             if iov is not None:

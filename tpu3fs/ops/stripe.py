@@ -72,17 +72,22 @@ class StripeCodec:
         self._host_mode: Optional[bool] = None
 
     def _use_host(self) -> bool:
-        """True when the default jax backend is CPU: the LUT/XOR numpy
-        path beats jax-CPU's gathered GF matmul by ~50x there, while real
-        TPU backends keep the device kernels (MXU bit-matmul + fused
-        batched CRC)."""
+        """The serving path stays on host kernels even when a TPU is
+        attached: StripeCodec's contract is host bytes in / host bytes out
+        (the RPC layer), one stripe batch per request — a synchronous
+        device round-trip per call is transfer-bound and loses to the
+        native SIMD path by orders of magnitude (measured 0.001 vs ~1+
+        GiB/s through a remote-attached chip). The device kernels
+        (Pallas bit-matmul + fused CRC) remain the path for
+        device-RESIDENT data: RSCode.encode / reconstruct_fn as used by
+        tpu3fs.parallel.{rebuild,shuffle} and the benches.
+        TPU3FS_STRIPE_DEVICE=1 forces the device path for hosts whose
+        accelerator is local enough to win on big batches."""
         if self._host_mode is None:
-            import jax
+            import os
 
-            try:
-                self._host_mode = jax.default_backend() == "cpu"
-            except RuntimeError:
-                self._host_mode = True
+            self._host_mode = os.environ.get(
+                "TPU3FS_STRIPE_DEVICE", "") != "1"
         return self._host_mode
 
     # -- encode --------------------------------------------------------------

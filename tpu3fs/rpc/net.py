@@ -21,7 +21,13 @@ import uuid as uuid_mod
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
-from tpu3fs.rpc.serde import deserialize, deserialize_prefix, serialize
+from tpu3fs.rpc.serde import (
+    _read_uvarint,
+    _write_uvarint,
+    deserialize,
+    deserialize_prefix,
+    serialize,
+)
 from tpu3fs.utils.result import Code, FsError, Status
 
 
@@ -79,29 +85,7 @@ MAX_PACKET = 64 << 20
 # -- bulk section codec ------------------------------------------------------
 # self-describing so the control schemas never change shape:
 #   varint count, varint len per segment, then the segments back to back.
-
-def _write_uvarint(buf: bytearray, v: int) -> None:
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        if v:
-            buf.append(b | 0x80)
-        else:
-            buf.append(b)
-            return
-
-
-def _read_uvarint(data, pos: int):
-    shift = 0
-    out = 0
-    while True:
-        b = data[pos]
-        pos += 1
-        out |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return out, pos
-        shift += 7
-
+# One wire-level varint codec for the whole transport: serde.py owns it.
 
 def pack_bulk_header(iovs) -> bytes:
     hdr = bytearray()
@@ -204,30 +188,46 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_exact_into(sock: socket.socket, n: int) -> bytearray:
-    """One allocation, recv_into it (no chunk-list joins)."""
-    buf = bytearray(n)
+def _recv_exact_into(sock: socket.socket, buf: bytearray, n: int) -> None:
+    """recv_into the first n bytes of buf (no chunk-list joins)."""
     view = memoryview(buf)
     off = 0
     while off < n:
-        got = sock.recv_into(view[off:], n - off)
+        got = sock.recv_into(view[off:n], n - off)
         if not got:
             raise ConnectionError("peer closed")
         off += got
-    return buf
 
 
 def _recv_packet(sock: socket.socket):
     """-> (MessagePacket, bulk_segments | None). Bulk segments are
     memoryviews over the single receive buffer — the buffer stays alive as
-    long as any view does, so hand-offs are GC-safe."""
+    long as any view does, so hand-offs are GC-safe.
+
+    Receive buffers come from the shared BufferPool (the registered-
+    buffer-pool role, ref RDMABuf.h:434). Inline frames release their
+    buffer right after packet decode (serde copies every field out); bulk
+    frames detach theirs — the escaped memoryviews own it, GC reclaims.
+    """
+    from tpu3fs.utils.bufpool import GLOBAL_POOL
+
     (n,) = _LEN.unpack(_recv_exact(sock, 4))
     if n > MAX_PACKET:
         raise ConnectionError(f"oversized packet: {n}")
-    buf = _recv_exact_into(sock, n)
-    pkt, pos = deserialize_prefix(buf, MessagePacket)
+    buf = GLOBAL_POOL.acquire(n)
+    try:
+        _recv_exact_into(sock, buf, n)
+        # decode bounded to the frame: a pooled buffer is longer than n
+        # and its tail holds a PREVIOUS frame's bytes — an unbounded parse
+        # of a truncated packet could read stale cross-request data
+        pkt, pos = deserialize_prefix(memoryview(buf)[:n], MessagePacket)
+    except BaseException:
+        GLOBAL_POOL.release(buf)
+        raise
     if pkt.flags & FLAG_BULK:
-        return pkt, split_bulk(memoryview(buf)[pos:])
+        # buffer detached: the segments escape with views into it
+        return pkt, split_bulk(memoryview(buf)[pos:n])
+    GLOBAL_POOL.release(buf)
     if pos != n:
         raise ConnectionError(f"trailing bytes after packet: {n - pos}")
     return pkt, None

@@ -674,6 +674,15 @@ class StorageService:
                 return None  # tail
             succ, node = hop
             if node is None or self._messenger is None:
+                # the successor target exists but routing has no node for
+                # it yet (startup/registration skew). ONE immediate
+                # re-resolve against fresh routing, then NO_SUCCESSOR —
+                # which is client-retryable (RETRYABLE_CODES), so the
+                # WAITING happens in the client's backoff ladder, not in a
+                # server worker sleeping under the chunk lock
+                if self._messenger is not None and attempt == 0:
+                    chain = self._chain(req.chain_id)
+                    continue
                 return UpdateReply(Code.NO_SUCCESSOR, message="no route to successor")
             freq = self._make_forward_req(target, req, update_ver, chain, succ)
             try:
@@ -1081,6 +1090,13 @@ class StorageService:
                 return None  # tail
             succ, node = hop
             if node is None or self._messenger is None:
+                # routing hasn't learned the successor's node yet
+                # (startup/registration skew): one immediate re-resolve,
+                # then the client-retryable NO_SUCCESSOR — waiting belongs
+                # in the client ladder, not a server worker holding locks
+                if self._messenger is not None and attempt == 0:
+                    chain = self._chain(chain.chain_id)
+                    continue
                 return [UpdateReply(Code.NO_SUCCESSOR,
                                     message="no route to successor")
                         for _ in staged]

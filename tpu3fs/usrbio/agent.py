@@ -39,7 +39,8 @@ class UsrbioAgent:
     """One agent per host, shared by all local USRBIO clients."""
 
     def __init__(self, meta: MetaStore, file_client: FileIoClient,
-                 client_id: str = "usrbio-agent"):
+                 client_id: str = "usrbio-agent", *,
+                 max_concurrent_ios: int = 64):
         self._meta = meta
         self._fio = file_client
         self._client_id = client_id
@@ -48,6 +49,14 @@ class UsrbioAgent:
         self._next_fd = 100
         self._rings: Dict[str, _RingState] = {}
         self._lock = threading.Lock()
+        # host-wide IO throttle across ALL rings (the reference bounds
+        # in-flight usrbio IO with semaphores per priority lane,
+        # IoRing.h:259-264): one misbehaving client with a deep ring
+        # cannot monopolize the storage backend
+        from tpu3fs.utils.executor import ConcurrencyLimiter
+
+        self._io_limiter = ConcurrencyLimiter("usrbio-io",
+                                              max_concurrent_ios)
 
     # -- control plane (the reference's ClientAgent service, fbs/lib) --------
     def open(self, path: str, *, write: bool = False) -> int:
@@ -123,7 +132,8 @@ class UsrbioAgent:
                 if not state.running:
                     return
                 for sqe in ring.drain_sqes():
-                    result = self._process_sqe(state, sqe)
+                    with self._io_limiter:
+                        result = self._process_sqe(state, sqe)
                     ring.push_cqe(result, sqe.userdata)
         except ValueError:
             # ring mmap closed under us during deregistration: exit quietly

@@ -101,6 +101,7 @@ class Code(enum.IntEnum):
     CLIENT_RETRIES_EXHAUSTED = 700
     CLIENT_NO_CHANNEL = 701
     CLIENT_ROUTING_STALE = 702
+    CLIENT_BUSY = 703        # bounded queue/limiter full (backpressure)
 
 
 #: Codes on which a client-side retry ladder may re-issue the request.
@@ -122,6 +123,10 @@ RETRYABLE_CODES = frozenset(
         Code.SYNCING,
         Code.CLIENT_ROUTING_STALE,
         Code.QUEUE_FULL,
+        # forwarding found no route to the successor after server-side
+        # retries: routing is lagging (startup/failover) — clients should
+        # back off and ladder, not fail the write
+        Code.NO_SUCCESSOR,
     }
 )
 
