@@ -207,6 +207,23 @@ def _phase_e2e(platform: str) -> dict:
     except Exception as e:
         out["e2e_error"] = repr(e)[:200]
 
+    # socket-cluster numbers: the full transport (serde envelopes, bulk
+    # framing, connection pooling) on both transports
+    try:
+        from benchmarks.storage_bench import run_rpc_bench
+
+        for transport in ("python", "native"):
+            try:
+                for row in run_rpc_bench(chunks=64, size=256 << 10, batch=8,
+                                         threads=4, replicas=2, chains=4,
+                                         transport=transport):
+                    suffix = "" if transport == "python" else "_native"
+                    out[f"e2e_{row['metric']}{suffix}_gibps"] = row["value"]
+            except Exception as e:
+                out[f"e2e_rpc_error_{transport}"] = repr(e)[:200]
+    except Exception as e:
+        out["e2e_rpc_error"] = repr(e)[:200]
+
     try:
         from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
         from tpu3fs.meta.store import OpenFlags

@@ -311,8 +311,12 @@ class TestForeignProcessUsrbio:
 
         native_dir = os.path.join(os.path.dirname(__file__), "..", "native")
         binary = os.path.join(native_dir, "usrbio_loadgen")
-        subprocess.run(["make", "-C", native_dir, "usrbio_loadgen"],
-                       check=True, capture_output=True)
+        try:
+            subprocess.run(["make", "-C", native_dir, "usrbio_loadgen"],
+                           check=True, capture_output=True)
+        except (subprocess.CalledProcessError, OSError) as e:
+            if not os.path.exists(binary):
+                pytest.skip(f"no C++ toolchain to build loadgen: {e!r}")
         fab = Fabric()
         ops = FuseOps(fab.meta, fab.file_client(),
                       UsrbioAgent(fab.meta, fab.file_client()))

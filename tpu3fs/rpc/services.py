@@ -289,6 +289,10 @@ class RpcMessenger:
     agnostic.
     """
 
+    # real sockets: per-node batch RPCs are worth issuing concurrently
+    # (StorageClient._fan_out); in-process messengers leave this unset
+    parallel_fanout = True
+
     def __init__(self, routing_provider, client: Optional[RpcClient] = None):
         import os
 

@@ -47,14 +47,15 @@ class BufferPool:
         """A bytearray of len >= n (callers track their own exact length)."""
         cls = _class_of(n)
         if cls > self._max_class_bytes:
-            self.misses += 1
+            with self._mu:
+                self.misses += 1
             return bytearray(n)
         with self._mu:
             free = self._free.get(cls)
             if free:
                 self.hits += 1
                 return free.pop()
-        self.misses += 1
+            self.misses += 1
         return bytearray(cls)
 
     def release(self, buf: bytearray) -> None:

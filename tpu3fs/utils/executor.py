@@ -203,15 +203,21 @@ class PeriodicRunner:
         from tpu3fs.utils.logging import xlog
 
         while not self._stop.is_set():
-            base = (self.interval_s() if callable(self.interval_s)
-                    else self.interval_s)
-            delay = base * (1.0 + random.uniform(-self.jitter, self.jitter))
-            if self._stop.wait(max(0.0, delay)):
-                return
+            # the interval callable is inside the try too: a transient
+            # hot-config error must not silently kill the runner thread
+            # (a dead mgmtd-tick runner would stop lease extension)
             try:
+                base = (self.interval_s() if callable(self.interval_s)
+                        else self.interval_s)
+                delay = base * (
+                    1.0 + random.uniform(-self.jitter, self.jitter))
+                if self._stop.wait(max(0.0, delay)):
+                    return
                 self.fn()
             except Exception as e:  # noqa: BLE001 — retried next tick
                 xlog("WARNING", "periodic %s failed: %r", self.name, e)
+                if self._stop.wait(1.0):
+                    return
 
     def request_stop(self) -> None:
         """Signal without joining (app shutdown paths that must not block)."""
