@@ -212,11 +212,13 @@ def _phase_e2e(platform: str) -> dict:
     try:
         from benchmarks.storage_bench import run_rpc_bench
 
-        for transport in ("python", "native"):
+        # python transport on the mem engine; native transport in the
+        # flagship config (native engine + C++ read fast path)
+        for transport, eng in (("python", "mem"), ("native", "native")):
             try:
                 for row in run_rpc_bench(chunks=64, size=256 << 10, batch=8,
                                          threads=4, replicas=2, chains=4,
-                                         transport=transport):
+                                         transport=transport, engine=eng):
                     suffix = "" if transport == "python" else "_native"
                     out[f"e2e_{row['metric']}{suffix}_gibps"] = row["value"]
             except Exception as e:

@@ -210,7 +210,7 @@ class _RpcCluster:
     against live transports)."""
 
     def __init__(self, *, replicas: int, chains: int, size: int,
-                 transport: str = "python"):
+                 transport: str = "python", engine: str = "mem"):
         from tpu3fs.kv.mem import MemKVEngine
         from tpu3fs.mgmtd.service import Mgmtd
         from tpu3fs.mgmtd.types import LocalTargetState, NodeType
@@ -266,13 +266,24 @@ class _RpcCluster:
             self.servers.append(server)
             services.append(svc)
             svc_by_node[node_id] = svc
+        import os
+        import tempfile
+
+        self._tmp = None
+        if engine == "native":
+            base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+            self._tmp = tempfile.TemporaryDirectory(
+                prefix="tpu3fs-rpcbench-", dir=base)
         for ci, chain_id in enumerate(self.chain_ids):
             targets = []
             for r in range(replicas):
                 node_id = node_ids[(ci + r) % num_nodes]
                 target_id = 1000 + ci * 16 + r
+                path = (os.path.join(self._tmp.name, str(target_id))
+                        if self._tmp else None)
                 svc_by_node[node_id].add_target(
-                    StorageTarget(target_id, chain_id, chunk_size=size))
+                    StorageTarget(target_id, chain_id, chunk_size=size,
+                                  engine=engine, path=path))
                 self.mgmtd.create_target(target_id, node_id=node_id)
                 node_states[node_id][target_id] = LocalTargetState.UPTODATE
                 targets.append(target_id)
@@ -280,6 +291,13 @@ class _RpcCluster:
         self.mgmtd.upload_chain_table(1, self.chain_ids)
         for node_id in node_ids:
             self.mgmtd.heartbeat(node_id, 1, node_states[node_id])
+        # native transport + native engine: serve batchRead in C++
+        self.services = services
+        if transport == "native":
+            from tpu3fs.storage.native_fastpath import sync_read_fastpath
+
+            for server, svc in zip(self.servers[1:], services):
+                sync_read_fastpath(server, svc)
         self._client_seq = 0
 
     def storage_client(self, **kw):
@@ -296,6 +314,8 @@ class _RpcCluster:
         self.shared_client.close()
         for s in self.servers:
             s.stop()
+        if self._tmp is not None:
+            self._tmp.cleanup()
 
 
 def run_rpc_bench(
@@ -307,10 +327,11 @@ def run_rpc_bench(
     replicas: int = 2,
     chains: int = 4,
     transport: str = "python",
+    engine: str = "mem",
     verify: bool = False,
 ) -> list:
     cluster = _RpcCluster(replicas=replicas, chains=chains, size=size,
-                          transport=transport)
+                          transport=transport, engine=engine)
     fast = RetryOptions(backoff_base_s=0.001, backoff_max_s=0.05)
     payloads = [bytes([i & 0xFF]) * size for i in range(min(chunks, 64))]
     crcs = [crc32c(p) for p in payloads]
@@ -326,6 +347,7 @@ def run_rpc_bench(
             "chunk_size": size,
             "replicas": replicas,
             "transport": transport,
+            "engine": engine,
             **extra,
         }
         results.append(row)
@@ -422,7 +444,7 @@ def main() -> None:
         run_rpc_bench(chunks=args.chunks, size=args.size, batch=args.batch,
                       threads=args.threads, replicas=args.replicas,
                       chains=args.chains, transport=args.transport,
-                      verify=args.verify)
+                      engine=args.engine, verify=args.verify)
     else:
         run_bench(chunks=args.chunks, size=args.size, batch=args.batch,
                   threads=args.threads, replicas=args.replicas,

@@ -24,6 +24,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <string>
@@ -314,6 +315,278 @@ bool resolve_ipv4(const char* host, uint16_t port, struct sockaddr_in* out) {
   return true;
 }
 
+// ---- storage read fast path ------------------------------------------------
+// Serves StorageSerde.batchRead (service 3, method 11) fully in native
+// code: decode the request, read through the chunk engine's C ABI (both
+// .so's live in this process; the engine's ce_batch_read is handed over
+// as a raw function pointer), encode the reply, writev it — the Python
+// dispatch layer is never entered. This is the native end-to-end read
+// data plane the reference gets for free from being all-C++
+// (src/storage/service/StorageOperator.cc read path + AioReadWorker).
+//
+// SAFETY CONTRACT (enforced here, maintained by the Python side):
+// the registry only ever contains CR targets that are locally UPTODATE
+// and publicly readable, with their engine handle and chain id; entries
+// are rebuilt by the storage app on every routing/target change and
+// cleared on shutdown. Any op that does not match an entry exactly
+// (unknown target, chain mismatch, schema drift, engine E_RANGE) makes
+// the WHOLE request fall back to the Python path — the fast path serves
+// only the unambiguous hot case.
+
+// engine ABI mirror (native/chunk_engine.cpp — keep in sync)
+struct FpReadOp {
+  uint8_t key[12];
+  uint32_t slot_len;
+  uint64_t out_off;
+  uint32_t offset;
+  int32_t length;
+};
+struct FpOpResult {
+  int32_t rc;
+  uint32_t len;
+  uint32_t crc;
+  uint32_t aux;
+  uint64_t ver;
+};
+typedef int (*fp_batch_read_t)(void* h, const FpReadOp* ops, uint8_t* out,
+                               uint64_t cap, FpOpResult* res, int n);
+
+struct FpTarget {
+  void* engine = nullptr;
+  int64_t chain_id = 0;
+  uint64_t chunk_size = 0;
+};
+
+// status codes the fast path can emit (tpu3fs/utils/result.py)
+enum FpCode : int64_t {
+  FP_OK = 0,
+  FP_CHUNK_NOT_FOUND = 500,
+  FP_CHUNK_NOT_COMMIT = 501,
+  FP_CHECKSUM_MISMATCH = 506,
+  FP_ENGINE_ERROR = 515,
+  FP_INVALID = 100,
+};
+
+int64_t fp_rc_to_code(int32_t rc) {
+  switch (rc) {
+    case -1:
+      return FP_CHUNK_NOT_FOUND;
+    case -2:
+      return FP_CHUNK_NOT_COMMIT;
+    case -7:
+      return FP_INVALID;
+    case -9:
+      return FP_CHECKSUM_MISMATCH;
+    default:
+      return FP_ENGINE_ERROR;
+  }
+}
+
+struct FpState {
+  std::mutex mu;
+  fp_batch_read_t batch_read = nullptr;
+  std::map<int64_t, FpTarget> targets;
+  std::atomic<uint64_t> hits{0};
+  std::atomic<uint64_t> fallbacks{0};
+  // readers currently inside an engine call: deregistration spins until
+  // this drains so a caller may safely ce_close an engine after
+  // del_target/clear returns (no use-after-free on in-flight reads)
+  std::atomic<int64_t> inflight{0};
+};
+
+struct FpReq {
+  int64_t chain_id;
+  uint64_t file_id;
+  uint32_t index;
+  int64_t offset;
+  int64_t length;
+  int64_t target_id;
+};
+
+// decode BatchReadReq{reqs: List[ReadReq]}; false => fall back to Python
+bool fp_decode_req(const uint8_t* d, size_t len, std::vector<FpReq>& out) {
+  size_t pos = 0;
+  uint64_t nfields, count;
+  if (!get_uvarint(d, len, pos, nfields) || nfields != 1) return false;
+  if (!get_uvarint(d, len, pos, count) || count > 65536) return false;
+  out.reserve(count);
+  for (uint64_t i = 0; i < count; i++) {
+    uint64_t rf;
+    if (!get_uvarint(d, len, pos, rf) || rf != 6) return false;
+    FpReq r;
+    int64_t tmp;
+    if (!get_int(d, len, pos, r.chain_id)) return false;
+    uint64_t cidf;
+    if (!get_uvarint(d, len, pos, cidf) || cidf != 2) return false;
+    if (!get_int(d, len, pos, tmp)) return false;
+    r.file_id = uint64_t(tmp);
+    if (!get_int(d, len, pos, tmp)) return false;
+    r.index = uint32_t(tmp);
+    if (!get_int(d, len, pos, r.offset)) return false;
+    if (!get_int(d, len, pos, r.length)) return false;
+    if (!get_int(d, len, pos, r.target_id)) return false;
+    if (!get_int(d, len, pos, tmp)) return false;  // chunk_size (unused)
+    out.push_back(r);
+  }
+  return pos == len;
+}
+
+void fp_put_reply(std::string& buf, int64_t code, uint64_t data_len,
+                  const uint8_t* data, uint64_t ver, uint32_t crc,
+                  uint32_t aux, bool inline_data) {
+  // ReadReply{code, data, commit_ver, checksum{value,length}, logical_len}
+  put_uvarint(buf, 5);
+  put_int(buf, code);
+  if (inline_data && data != nullptr) {
+    put_uvarint(buf, data_len);
+    buf.append(reinterpret_cast<const char*>(data), data_len);
+  } else {
+    put_uvarint(buf, 0);  // bulk mode or error: empty inline data
+  }
+  put_int(buf, int64_t(ver));
+  put_uvarint(buf, 2);  // Checksum field count
+  put_int(buf, int64_t(crc));
+  put_int(buf, int64_t(data_len));
+  put_int(buf, int64_t(aux));
+}
+
+// true when handled (reply fields filled); false => fall back to Python
+bool fp_try_batch_read(FpState& fp, const Packet& req, std::string& payload,
+                       std::string& bulk_out, bool& reply_bulk) {
+  std::vector<FpReq> ops;
+  const uint8_t* d = reinterpret_cast<const uint8_t*>(req.payload.data());
+  if (!fp_decode_req(d, req.payload.size(), ops)) return false;
+  if (ops.empty()) return false;
+  // resolve every op against the registry under one lock snapshot; the
+  // inflight count is taken under the same lock so deregistration can
+  // drain us before an engine is closed
+  std::vector<FpTarget> tgts(ops.size());
+  fp_batch_read_t engine_read;
+  uint64_t total_slots = 0;
+  {
+    std::lock_guard<std::mutex> g(fp.mu);
+    engine_read = fp.batch_read;
+    if (engine_read == nullptr || fp.targets.empty()) return false;
+    for (size_t i = 0; i < ops.size(); i++) {
+      auto it = fp.targets.find(ops[i].target_id);
+      if (it == fp.targets.end() || it->second.chain_id != ops[i].chain_id)
+        return false;
+      tgts[i] = it->second;
+      total_slots += ops[i].length < 0
+                         ? it->second.chunk_size
+                         : std::min<uint64_t>(uint64_t(ops[i].length),
+                                              it->second.chunk_size);
+    }
+    // the reply must fit one frame (length header is 4 bytes and the
+    // Python peer rejects frames over kMaxPacket): oversized batches go
+    // to the Python path, which answers with a clean error envelope —
+    // this also bounds the buffer allocation below
+    if (total_slots > kMaxPacket - (1u << 20)) return false;
+    fp.inflight.fetch_add(1);
+  }
+  struct InflightGuard {
+    FpState& fp;
+    ~InflightGuard() { fp.inflight.fetch_sub(1); }
+  } guard{fp};
+  // group by engine handle: one ce_batch_read per engine
+  std::map<void*, std::vector<size_t>> by_engine;
+  for (size_t i = 0; i < ops.size(); i++)
+    by_engine[tgts[i].engine].push_back(i);
+  struct Out {
+    int32_t rc = 0;
+    uint64_t off = 0;  // offset into the group buffer
+    uint32_t len = 0;
+    uint32_t crc = 0;
+    uint32_t aux = 0;
+    uint64_t ver = 0;
+    const std::vector<uint8_t>* buf = nullptr;
+  };
+  std::vector<Out> outs(ops.size());
+  std::vector<std::unique_ptr<std::vector<uint8_t>>> bufs;
+  for (auto& kv : by_engine) {
+    auto& idxs = kv.second;
+    std::vector<FpReadOp> rops(idxs.size());
+    std::vector<FpOpResult> res(idxs.size());
+    uint64_t total = 0;
+    for (size_t j = 0; j < idxs.size(); j++) {
+      const FpReq& r = ops[idxs[j]];
+      const FpTarget& t = tgts[idxs[j]];
+      FpReadOp& o = rops[j];
+      // key layout: >QI big-endian (file_id u64, index u32)
+      for (int b = 0; b < 8; b++)
+        o.key[b] = uint8_t(r.file_id >> (8 * (7 - b)));
+      for (int b = 0; b < 4; b++)
+        o.key[8 + b] = uint8_t(r.index >> (8 * (3 - b)));
+      o.offset = uint32_t(r.offset);
+      o.length = int32_t(r.length);
+      uint64_t slot = r.length < 0
+                          ? t.chunk_size
+                          : std::min<uint64_t>(uint64_t(r.length),
+                                               t.chunk_size);
+      o.slot_len = uint32_t(slot);
+      o.out_off = total;
+      total += slot;
+    }
+    auto buf = std::make_unique<std::vector<uint8_t>>(total);
+    if (engine_read(kv.first, rops.data(), buf->data(), total, res.data(),
+                    int(idxs.size())) != 0)
+      return false;
+    for (size_t j = 0; j < idxs.size(); j++) {
+      if (res[j].rc == -10) return false;  // E_RANGE: Python re-reads
+      Out& o = outs[idxs[j]];
+      o.rc = res[j].rc;
+      o.off = rops[j].out_off;
+      o.len = res[j].len;
+      o.crc = res[j].crc;
+      o.aux = res[j].aux;
+      o.ver = res[j].ver;
+      o.buf = buf.get();
+    }
+    bufs.push_back(std::move(buf));
+  }
+  // encode BatchReadRsp{replies}; data inline or as a bulk section
+  reply_bulk = req.has_bulk;
+  payload.clear();
+  put_uvarint(payload, 1);
+  put_uvarint(payload, ops.size());
+  std::string bulk_hdr;
+  uint64_t bulk_data = 0;
+  if (reply_bulk) put_uvarint(bulk_hdr, ops.size());
+  for (size_t i = 0; i < ops.size(); i++) {
+    const Out& o = outs[i];
+    if (o.rc != 0) {
+      fp_put_reply(payload, fp_rc_to_code(o.rc), 0, nullptr, 0, 0, 0, true);
+      if (reply_bulk) put_uvarint(bulk_hdr, 0);
+      continue;
+    }
+    const uint8_t* data = o.buf->data() + o.off;
+    if (reply_bulk) {
+      fp_put_reply(payload, FP_OK, o.len, nullptr, o.ver, o.crc, o.aux,
+                   false);
+      put_uvarint(bulk_hdr, o.len);
+      bulk_data += o.len;
+    } else {
+      fp_put_reply(payload, FP_OK, o.len, data, o.ver, o.crc, o.aux, true);
+    }
+  }
+  if (reply_bulk) {
+    bulk_out.clear();
+    bulk_out.reserve(bulk_hdr.size() + bulk_data);
+    bulk_out += bulk_hdr;
+    for (size_t i = 0; i < ops.size(); i++) {
+      const Out& o = outs[i];
+      if (o.rc == 0 && o.len)
+        bulk_out.append(
+            reinterpret_cast<const char*>(o.buf->data() + o.off), o.len);
+    }
+  }
+  fp.hits.fetch_add(1);
+  return true;
+}
+
+constexpr int64_t kStorageServiceId = 3;
+constexpr int64_t kBatchReadMethodId = 11;
+
 // ---- server ---------------------------------------------------------------
 // handler v2: returns status; on success fills *rsp (malloc'd) + *rsp_len;
 // may fill *msg (malloc'd) with an error message. `bulk`/`bulk_len` carry
@@ -366,6 +639,8 @@ struct Server {
 
   std::mutex conns_mu;
   std::map<int, std::shared_ptr<Conn>> conns;
+
+  FpState fastpath;
 };
 
 void server_close_conn(Server* s, const std::shared_ptr<Conn>& c) {
@@ -402,6 +677,46 @@ void worker_main(Server* s) {
     rsp.flags = 0;
     memcpy(rsp.ts, req.ts, sizeof(req.ts));
     rsp.ts[4] = mono_now();  // server_run_start
+    // native read fast path: batchRead against registered native-engine
+    // targets never enters Python; anything ambiguous falls through
+    if (req.service_id == kStorageServiceId &&
+        req.method_id == kBatchReadMethodId) {
+      std::string fp_payload, fp_bulk;
+      bool fp_reply_bulk = false;
+      bool handled = false;
+      try {
+        handled = fp_try_batch_read(s->fastpath, req, fp_payload, fp_bulk,
+                                    fp_reply_bulk);
+      } catch (...) {
+        // allocation or engine failure must fall back, never kill the
+        // worker thread (InflightGuard unwinds the in-flight count)
+        handled = false;
+      }
+      if (handled) {
+        rsp.status = OK;
+        rsp.payload = std::move(fp_payload);
+        if (fp_reply_bulk) rsp.flags |= kFlagBulk;
+        rsp.ts[5] = mono_now();
+        std::string env2 = encode_packet(rsp);
+        uint64_t total2 = env2.size() + (fp_reply_bulk ? fp_bulk.size() : 0);
+        uint8_t hdr2[4] = {uint8_t(total2 >> 24), uint8_t(total2 >> 16),
+                           uint8_t(total2 >> 8), uint8_t(total2)};
+        struct iovec iov2[3] = {
+            {hdr2, 4},
+            {const_cast<char*>(env2.data()), env2.size()},
+            {const_cast<char*>(fp_bulk.data()),
+             fp_reply_bulk ? fp_bulk.size() : 0},
+        };
+        std::lock_guard<std::mutex> g(job.conn->write_mu);
+        if (!job.conn->closed.load() &&
+            !send_iovs(job.conn->fd, iov2, fp_reply_bulk ? 3 : 2,
+                       kServerDrainTimeoutMs)) {
+          server_close_conn(s, job.conn);
+        }
+        continue;
+      }
+      s->fastpath.fallbacks.fetch_add(1);
+    }
     uint8_t* out = nullptr;
     size_t out_len = 0;
     uint8_t* out_bulk = nullptr;
@@ -815,6 +1130,58 @@ void tpu3fs_rpc_client_close(void* cli) {
   auto* c = static_cast<Client*>(cli);
   ::close(c->fd);
   delete c;
+}
+
+// ---- storage read fast path control (see FpState) -------------------------
+
+// install the chunk engine's ce_batch_read (a raw fn pointer — the engine
+// .so lives in this same process; Python hands the address over via ctypes)
+void tpu3fs_rpc_fastpath_install(void* srv, void* batch_read_fn) {
+  auto* s = static_cast<Server*>(srv);
+  std::lock_guard<std::mutex> g(s->fastpath.mu);
+  s->fastpath.batch_read = reinterpret_cast<fp_batch_read_t>(batch_read_fn);
+}
+
+void tpu3fs_rpc_fastpath_set_target(void* srv, int64_t target_id,
+                                    void* engine, int64_t chain_id,
+                                    uint64_t chunk_size) {
+  auto* s = static_cast<Server*>(srv);
+  std::lock_guard<std::mutex> g(s->fastpath.mu);
+  s->fastpath.targets[target_id] = FpTarget{engine, chain_id, chunk_size};
+}
+
+// drain in-flight fast-path reads: after erasing entries, wait for every
+// reader that resolved BEFORE the erase to leave its engine call, so the
+// caller may ce_close the engine as soon as del/clear returns
+void fp_drain(FpState& fp) {
+  while (fp.inflight.load() > 0)
+    std::this_thread::sleep_for(std::chrono::microseconds(50));
+}
+
+void tpu3fs_rpc_fastpath_del_target(void* srv, int64_t target_id) {
+  auto* s = static_cast<Server*>(srv);
+  {
+    std::lock_guard<std::mutex> g(s->fastpath.mu);
+    s->fastpath.targets.erase(target_id);
+  }
+  fp_drain(s->fastpath);
+}
+
+void tpu3fs_rpc_fastpath_clear(void* srv) {
+  auto* s = static_cast<Server*>(srv);
+  {
+    std::lock_guard<std::mutex> g(s->fastpath.mu);
+    s->fastpath.targets.clear();
+  }
+  fp_drain(s->fastpath);
+}
+
+// hits and fallbacks, for tests and metrics
+void tpu3fs_rpc_fastpath_stats(void* srv, uint64_t* hits,
+                               uint64_t* fallbacks) {
+  auto* s = static_cast<Server*>(srv);
+  if (hits != nullptr) *hits = s->fastpath.hits.load();
+  if (fallbacks != nullptr) *fallbacks = s->fastpath.fallbacks.load();
 }
 
 }  // extern "C"

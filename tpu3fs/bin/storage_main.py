@@ -121,6 +121,15 @@ class StorageApp(TwoPhaseApplication):
             xlog("INFO", "node %d opened target %d (chain %d, %s)",
                  self.info.node_id, info.target_id, info.chain_id,
                  self.config.get("engine"))
+        # refresh the native read fast path every scan (no-op on the
+        # python transport): registry entries track target/routing state
+        # with at most one scan interval of lag
+        try:
+            from tpu3fs.storage.native_fastpath import sync_read_fastpath
+
+            sync_read_fastpath(self.server, self.service)
+        except Exception:
+            pass
         return added
 
     def local_target_states(self) -> Dict[int, LocalTargetState]:

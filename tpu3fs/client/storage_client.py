@@ -533,7 +533,10 @@ class StorageClient:
         for b, (_, data) in enumerate(items):
             flat = np.frombuffer(data, dtype=np.uint8)
             buf[b].reshape(-1)[: flat.size] = flat
-        shards, crcs = codec.encode_batch(buf)
+        # parity-only encode: data-shard payloads below are slices of the
+        # caller's bytes, so materializing a concatenated (B, k+m, S)
+        # array would be a multi-MiB copy per batch for nothing
+        parity, crcs = codec.encode_parity(buf)
 
         routing = self._routing()
         # one-RPC version probe: max committed over probed shards is the
@@ -566,7 +569,7 @@ class StorageClient:
                 continue
             for b, (cid, data) in enumerate(items):
                 payload = (data[j * S : (j + 1) * S] if j < k
-                           else shards[b, j].tobytes())
+                           else parity[b, j - k].tobytes())
                 crc = (int(crcs[b, j]) if len(payload) == S
                        else codec.crc_host(payload))
                 by_node[node.node_id].append((b, ShardWriteReq(

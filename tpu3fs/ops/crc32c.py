@@ -200,8 +200,11 @@ class BatchCrc32c:
         z = np_bits_to_u32(
             np_mat2_pow(_byte_shift_matrix(), size) @ np_u32_to_bits(_XOROUT).astype(np.int64) & 1
         )
-        self._b_t = jnp.asarray(B_T)
-        self._ks = jnp.asarray(Ks)
+        # host numpy: constructing BatchCrc32c must not initialize the
+        # jax backend (jit accepts numpy operands; device materialization
+        # is lazy, on the first device call)
+        self._b_t = B_T
+        self._ks = Ks
         self._const = np.uint32(z ^ _XOROUT)
         self._jit = jax.jit(self._compute)
 
@@ -237,5 +240,5 @@ class BatchCrc32c:
             from tpu3fs.ops import native_ec
 
             if native_ec.available():
-                return jnp.asarray(native_ec.crc32c_batch(np.asarray(chunks)))
+                return native_ec.crc32c_batch(np.asarray(chunks))
         return self._jit(chunks)

@@ -74,9 +74,14 @@ class RSCode:
         self.generator = np.concatenate(
             [np.eye(k, dtype=np.uint8), self.parity_matrix], axis=0
         )  # (k+m, k)
-        self._parity_bits = jnp.asarray(
-            GF.expand_to_bits(self.parity_matrix).astype(np.int8)
-        )
+        # HOST numpy, not a device array: constructing RSCode must never
+        # initialize the jax backend — EC-serving processes (storage
+        # servers, FUSE daemons) run the host SIMD path and may have no
+        # reachable accelerator at all. jax.jit/einsum accept numpy
+        # operands, so device materialization happens lazily on the first
+        # actual device-kernel call.
+        self._parity_bits = GF.expand_to_bits(self.parity_matrix).astype(
+            np.int8)
         # per-instance caches keyed on (present, lost) — instance-held so
         # the device matrices/compiled fns die with the RSCode object
         self._reconstruct_mats: dict = {}
@@ -104,8 +109,10 @@ class RSCode:
             from tpu3fs.ops import native_ec
 
             if native_ec.available():
-                return jnp.asarray(native_ec.gf_apply(
-                    np.asarray(A_sym), np.asarray(data)))
+                # plain numpy out: wrapping in a device array here
+                # would touch the backend for a pure host computation
+                return native_ec.gf_apply(
+                    np.asarray(A_sym), np.asarray(data))
         fn = self._einsum_fns.get(key)
         if fn is None:
             fn = jax.jit(functools.partial(_bit_matmul, A_bits))
@@ -219,14 +226,14 @@ class RSCode:
                     if (not pallas_rs.backend_supports_pallas()
                             and not isinstance(data, jax.core.Tracer)
                             and native_ec.available()):
-                        return jnp.asarray(
-                            native_ec.gf_apply(_ones, np.asarray(data)))
+                        return native_ec.gf_apply(
+                            _ones, np.asarray(data))
                     return _jitted(data)
             else:
                 R = self._reconstruct_matrix(present, lost)
                 R_bits = GF.expand_to_bits(R).astype(np.int8)
                 fn = functools.partial(
-                    self._apply_bit_matrix, jnp.asarray(R_bits), key,
+                    self._apply_bit_matrix, R_bits, key,
                     A_sym=R,
                 )
             self._reconstruct_fns[key] = fn

@@ -91,6 +91,29 @@ class StripeCodec:
         return self._host_mode
 
     # -- encode --------------------------------------------------------------
+    def encode_parity(self, data: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """(B, k, S) uint8 -> (parity (B, m, S), crcs (B, k+m) uint32) —
+        the serving-path shape: callers already hold the data-shard bytes,
+        so the (B, k+m, S) concatenation encode_batch builds would be a
+        multi-MiB copy just to throw away. Honors the same host/device
+        policy as encode_batch (TPU3FS_STRIPE_DEVICE=1 keeps the device
+        kernels for hosts whose accelerator is local enough to win)."""
+        b, k, s = data.shape
+        assert k == self.k and s == self.shard_size, (data.shape, self.k)
+        if not self._use_host():
+            shards, crcs = self.encode_batch(data)
+            return shards[:, k:], crcs
+        parity = self.rs.encode_host(data)
+        crcs = np.empty((b, k + self.m), dtype=np.uint32)
+        crcs[:, :k] = crc32c_batch_host(
+            np.ascontiguousarray(data).reshape(b * k, s)).reshape(b, k)
+        if self.m:
+            crcs[:, k:] = crc32c_batch_host(
+                np.ascontiguousarray(parity).reshape(b * self.m, s)
+            ).reshape(b, self.m)
+        return parity, crcs
+
     def encode_batch(self, data: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """(B, k, S) uint8 -> (shards (B, k+m, S), crcs (B, k+m) uint32),
         both materialized on host for the RPC layer."""
@@ -99,10 +122,9 @@ class StripeCodec:
         if self._use_host():
             # host kernel selection (native SIMD vs numpy gold) lives in
             # RSCode.encode_host / crc32c_batch_host — one dispatch layer
-            parity = self.rs.encode_host(data)
+            parity, crcs_np = self.encode_parity(data)
             shards_np = np.concatenate([data, parity], axis=1)
-            crcs_np = crc32c_batch_host(shards_np.reshape(b * (k + self.m), s))
-            return shards_np, crcs_np.reshape(b, k + self.m)
+            return shards_np, crcs_np
         import jax
         import jax.numpy as jnp
 

@@ -137,6 +137,17 @@ def _load_lib():
             ctypes.POINTER(ctypes.c_char_p),
         ]
         lib.tpu3fs_rpc_client_close.argtypes = [ctypes.c_void_p]
+        lib.tpu3fs_rpc_fastpath_install.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.tpu3fs_rpc_fastpath_set_target.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_uint64]
+        lib.tpu3fs_rpc_fastpath_del_target.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64]
+        lib.tpu3fs_rpc_fastpath_clear.argtypes = [ctypes.c_void_p]
+        lib.tpu3fs_rpc_fastpath_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
         return lib
 
@@ -204,6 +215,33 @@ class NativeRpcServer:
         if self._srv is not None:
             self._lib.tpu3fs_rpc_server_stop(self._srv)
             self._srv = None
+
+    # -- storage read fast path (native/rpc_net.cpp FpState) ----------------
+    def fastpath_install(self, batch_read_fn) -> None:
+        if self._srv is not None:
+            self._lib.tpu3fs_rpc_fastpath_install(self._srv, batch_read_fn)
+
+    def fastpath_sync(self, batch_read_fn, wanted: dict) -> None:
+        """Reconcile the registry to exactly `wanted`:
+        {target_id: (engine_handle, chain_id, chunk_size)}. The transient
+        empty registry during the rebuild only means a momentary fallback
+        to the Python dispatch — never a wrong answer."""
+        if self._srv is None:
+            return
+        if batch_read_fn is not None:
+            self._lib.tpu3fs_rpc_fastpath_install(self._srv, batch_read_fn)
+        self._lib.tpu3fs_rpc_fastpath_clear(self._srv)
+        for target_id, (h, chain_id, chunk_size) in wanted.items():
+            self._lib.tpu3fs_rpc_fastpath_set_target(
+                self._srv, target_id, h, chain_id, chunk_size)
+
+    def fastpath_stats(self):
+        hits = ctypes.c_uint64(0)
+        fallbacks = ctypes.c_uint64(0)
+        if self._srv is not None:
+            self._lib.tpu3fs_rpc_fastpath_stats(
+                self._srv, ctypes.byref(hits), ctypes.byref(fallbacks))
+        return hits.value, fallbacks.value
 
     # -- dispatch (same semantics as RpcServer._dispatch) -------------------
     def _handle(self, service_id, method_id, req_ptr, req_len,

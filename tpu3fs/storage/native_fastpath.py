@@ -1,0 +1,75 @@
+"""Wire the native transport's storage read fast path to a service.
+
+The C++ transport (native/rpc_net.cpp) can serve StorageSerde.batchRead
+end to end — decode, chunk-engine read, encode, writev — without ever
+entering Python, IF it knows which targets are native-engined and
+currently readable. This module maintains that registry from the Python
+side, where the authoritative state (routing snapshots, local target
+states) lives.
+
+The registry is a positive allowlist rebuilt on every call: a target is
+registered only while it (a) runs the native chunk engine, (b) is
+locally UPTODATE, and (c) is publicly readable in the current routing
+snapshot of its chain. Everything else is dropped, and any op the C++
+side cannot match exactly falls back to the Python dispatch — so a stale
+registry entry can at worst serve committed bytes from a replica that
+routing just demoted, the same window the Python path has between two
+routing polls. The storage app calls sync_read_fastpath() from its
+target-scan loop (tpu3fs/bin/storage_main.py), bounding that window to
+one scan interval.
+
+Ref: the reference's read path is native end to end by construction
+(src/storage/service/StorageOperator.cc + AioReadWorker.h); this is the
+same property, recovered via a fn-pointer bridge between the two .so's.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from tpu3fs.mgmtd.types import LocalTargetState
+
+
+def _native_engine_handle(target):
+    """The ce_open handle when this target runs the native engine."""
+    eng = getattr(target, "engine", None)
+    h = getattr(eng, "_h", None)
+    lib = getattr(eng, "_lib", None)
+    if h and lib is not None:
+        return h, lib
+    return None, None
+
+
+def sync_read_fastpath(server, svc) -> int:
+    """Rebuild `server`'s fast-path registry from `svc`'s current state;
+    -> number of registered targets (0 when the server has no fast path,
+    e.g. the Python transport)."""
+    install = getattr(server, "fastpath_install", None)
+    if install is None:
+        return 0
+    try:
+        routing = svc._routing()
+    except Exception:
+        routing = None
+    registered = 0
+    wanted = {}
+    batch_read_fn = None
+    for target in svc.targets():
+        h, lib = _native_engine_handle(target)
+        if h is None:
+            continue
+        if target.local_state != LocalTargetState.UPTODATE:
+            continue
+        chain = routing.chains.get(target.chain_id) if routing else None
+        if chain is None:
+            continue
+        ct = next((t for t in chain.targets
+                   if t.target_id == target.target_id), None)
+        if ct is None or not ct.public_state.can_read:
+            continue
+        wanted[target.target_id] = (h, target.chain_id, target.chunk_size)
+        if batch_read_fn is None:
+            batch_read_fn = ctypes.cast(lib.ce_batch_read, ctypes.c_void_p)
+    server.fastpath_sync(batch_read_fn, wanted)
+    registered = len(wanted)
+    return registered
