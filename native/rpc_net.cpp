@@ -480,8 +480,11 @@ bool fp_try_batch_read(FpState& fp, const Packet& req, std::string& payload,
     // the reply must fit one frame (length header is 4 bytes and the
     // Python peer rejects frames over kMaxPacket): oversized batches go
     // to the Python path, which answers with a clean error envelope —
-    // this also bounds the buffer allocation below
-    if (total_slots > kMaxPacket - (1u << 20)) return false;
+    // this also bounds the buffer allocation below. 64 bytes/op covers
+    // the per-reply envelope fields (code, lengths, ver, checksum, aux)
+    // with margin; 1 MiB covers the packet envelope itself.
+    if (total_slots + uint64_t(ops.size()) * 64 + (1u << 20) > kMaxPacket)
+      return false;
     fp.inflight.fetch_add(1);
   }
   struct InflightGuard {

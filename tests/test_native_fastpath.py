@@ -123,15 +123,17 @@ class TestNativeReadFastpath:
         assert sc.write_chunk(CHAIN, ChunkId(7, 0), 0, b"x" * 100,
                               chunk_size=CHUNK).ok
         assert sync_read_fastpath(env["server"], env["svc"]) == 1
-        # locally offlined target must leave the registry on the next sync
+        # local offlining drops the registry entry IMMEDIATELY (the
+        # offline_target contract) — no re-sync scan needed
         env["svc"].offline_target(1000)
-        assert sync_read_fastpath(env["server"], env["svc"]) == 0
         h_before, f_before = env["server"].fastpath_stats()
         # reads now fall back to python dispatch (which refuses: offline)
         got = sc.batch_read([ClientReadReq(CHAIN, ChunkId(7, 0), 0, -1)])
         assert not got[0].ok
         h_after, f_after = env["server"].fastpath_stats()
         assert h_after == h_before and f_after > f_before
+        # and a later sync keeps it out
+        assert sync_read_fastpath(env["server"], env["svc"]) == 0
 
     def test_mem_engine_targets_never_register(self, native_node, tmp_path):
         env = native_node

@@ -238,7 +238,13 @@ class ReplicatedRemoteKVEngine(RemoteKVEngine):
     (same log prefix => same bytes) or fails loudly with KV_TXN_TOO_OLD
     and the with_transaction loop restarts the transaction."""
 
-    RETRY_WINDOW_S = 15.0
+    # generous by design: a leader election under heavy host load can take
+    # well past 15s (observed in CI-like runs with parallel suites), and
+    # exhausting the window surfaces RPC_CONNECT_FAILED to callers whose
+    # transaction would have succeeded one election later. FDB clients
+    # effectively retry until the transaction timeout; 45s approximates
+    # that while still failing a genuinely dead cluster promptly.
+    RETRY_WINDOW_S = 45.0
 
     def __init__(self, peers, client: Optional[RpcClient] = None,
                  client_id: str = ""):

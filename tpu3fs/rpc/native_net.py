@@ -235,6 +235,11 @@ class NativeRpcServer:
             self._lib.tpu3fs_rpc_fastpath_set_target(
                 self._srv, target_id, h, chain_id, chunk_size)
 
+    def fastpath_del_target(self, target_id: int) -> None:
+        """Drop one target now; drains in-flight reads before returning."""
+        if self._srv is not None:
+            self._lib.tpu3fs_rpc_fastpath_del_target(self._srv, target_id)
+
     def fastpath_stats(self):
         hits = ctypes.c_uint64(0)
         fallbacks = ctypes.c_uint64(0)

@@ -332,6 +332,22 @@ class StorageService:
         # structured write-path trace (ref StorageOperator.h:36 —
         # analytics::StructuredTraceLog<StorageEventTrace>); None = off
         self._trace = None
+        # native read-fastpath invalidator (storage/native_fastpath.py):
+        # called with a target id on local offlining (None = drop all) so
+        # the C++ registry honors offline_target's immediate-refusal
+        # contract instead of waiting for the next target scan
+        self._fastpath_invalidate = None
+
+    def set_fastpath_invalidator(self, fn) -> None:
+        self._fastpath_invalidate = fn
+
+    def _invalidate_fastpath(self, target_id) -> None:
+        fn = self._fastpath_invalidate
+        if fn is not None:
+            try:
+                fn(target_id)
+            except Exception:
+                pass
 
     def set_trace_log(self, trace) -> None:
         self._trace = trace
@@ -430,9 +446,11 @@ class StorageService:
         from tpu3fs.mgmtd.types import LocalTargetState
 
         # local_state is read live by _check_target_serving (the snapshot
-        # caches only routing chains), so the next op sees the refusal
-        # without any invalidation
+        # caches only routing chains), so the next PYTHON op sees the
+        # refusal without any invalidation; the native fast path holds its
+        # own registry and must be told now
         target.local_state = LocalTargetState.OFFLINE
+        self._invalidate_fastpath(target_id)
         return True
 
     def _check_target_serving(self, target: StorageTarget) -> None:

@@ -44,14 +44,13 @@ def sync_read_fastpath(server, svc) -> int:
     """Rebuild `server`'s fast-path registry from `svc`'s current state;
     -> number of registered targets (0 when the server has no fast path,
     e.g. the Python transport)."""
-    install = getattr(server, "fastpath_install", None)
-    if install is None:
+    sync = getattr(server, "fastpath_sync", None)
+    if sync is None:
         return 0
     try:
         routing = svc._routing()
     except Exception:
         routing = None
-    registered = 0
     wanted = {}
     batch_read_fn = None
     for target in svc.targets():
@@ -70,6 +69,11 @@ def sync_read_fastpath(server, svc) -> int:
         wanted[target.target_id] = (h, target.chain_id, target.chunk_size)
         if batch_read_fn is None:
             batch_read_fn = ctypes.cast(lib.ce_batch_read, ctypes.c_void_p)
-    server.fastpath_sync(batch_read_fn, wanted)
-    registered = len(wanted)
-    return registered
+    sync(batch_read_fn, wanted)
+    # local offlining promises IMMEDIATE refusal (craq offline_target):
+    # hand the service an invalidator so the C++ registry drops the
+    # target in the same call, not at the next scan
+    svc.set_fastpath_invalidator(
+        lambda tid: (server.fastpath_del_target(tid)
+                     if tid is not None else server.fastpath_sync(None, {})))
+    return len(wanted)
