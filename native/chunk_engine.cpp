@@ -759,12 +759,19 @@ struct Engine {
   // used to pay; ref StorageOperator.cc:464-482 cross-check).
   // check_crc: refuse the install (no mutation) unless the engine-computed
   // content CRC equals expected_crc — the one-pass validated-install the EC
-  // shard path uses (the CRC is computed during staging anyway)
+  // shard path uses (the CRC is computed during staging anyway).
+  // `mode`: 0 = COW stage (chain version algebra), 1 = full replace
+  // committed in one step (recovery writes), 2 = STAGE-replace: stage the
+  // data as the whole pending content at update_ver, allowing version
+  // gaps and replacing an older pending — phase one of the EC two-phase
+  // stripe write (the committed version survives until commit()).
   int update(const Key& k, uint64_t* io_ver, uint64_t chain_ver,
              const uint8_t* data, uint32_t data_len, uint32_t offset,
-             int full_replace, uint32_t chunk_size, uint32_t aux,
+             int mode, uint32_t chunk_size, uint32_t aux,
              uint32_t* out_len, uint32_t* out_crc, int check_crc = 0,
              uint32_t expected_crc = 0) {
+    const int full_replace = (mode == 1);
+    const int stage_replace = (mode == 2);
     // overflow-safe bound: offset + data_len can wrap uint32
     if (offset > chunk_size || data_len > chunk_size - offset)
       return E_INVALID;
@@ -779,7 +786,19 @@ struct Engine {
         update_ver = cv + 1;
         *io_ver = update_ver;
       }
-      if (!full_replace) {
+      if (stage_replace) {
+        if (update_ver <= cv) {
+          if (it != metas.end()) {
+            if (out_len) *out_len = it->second.committed.length;
+            if (out_crc) *out_crc = it->second.committed.crc;
+            *io_ver = it->second.committed_ver;
+          }
+          return E_STALE_UPDATE;
+        }
+        // version gaps + replacing an OLDER pending are legal; clobbering
+        // a NEWER pending could strand its partial commit quorum
+        if (pv && update_ver < pv) return E_ADVANCE_UPDATE;
+      } else if (!full_replace) {
         if (update_ver <= cv) {
           // report committed state for the idempotent-duplicate reply
           if (it != metas.end()) {
@@ -823,12 +842,15 @@ struct Engine {
     }
     // COW: base = committed content extended to cover the write. A write
     // covering the whole resulting content (the common chunk-append /
-    // full-overwrite form) skips the merge buffer entirely.
+    // full-overwrite form) skips the merge buffer entirely. stage_replace
+    // NEVER merges: the data IS the whole pending content.
     ChunkMeta& m = metas[k];
-    uint32_t new_len = std::max(m.committed.length, offset + data_len);
+    uint32_t new_len = stage_replace
+                           ? data_len
+                           : std::max(m.committed.length, offset + data_len);
     const uint8_t* src = data;
     std::vector<uint8_t> buf;
-    if (!(offset == 0 && data_len == new_len)) {
+    if (!stage_replace && !(offset == 0 && data_len == new_len)) {
       buf.assign(new_len, 0);
       if (m.committed.valid() && m.committed.length) {
         int rc = read_block(m.committed, buf.data(), 0, m.committed.length);
@@ -1431,7 +1453,8 @@ int ce_crc32c_batch(const uint8_t* data, uint64_t n_rows, uint64_t stride,
 
 struct CUpOp {
   uint8_t key[kKeyLen];
-  uint8_t flags;       // 1 = full_replace; 2 = validate expected_crc
+  uint8_t flags;       // 1 = full_replace; 2 = validate expected_crc;
+                       // 4 = stage_replace (EC two-phase stage)
   uint8_t pad0[3];
   uint32_t offset;     // write offset within the chunk
   uint32_t data_len;
@@ -1473,7 +1496,9 @@ int ce_batch_update(void* h, uint64_t chain_ver, const uint8_t* blob,
     uint64_t ver = op.update_ver;
     uint32_t len = 0, crc = 0;
     r.rc = e->update(k, &ver, chain_ver, blob + op.data_off, op.data_len,
-                     op.offset, op.flags & 1, op.chunk_size, op.aux, &len,
+                     op.offset,
+                     (op.flags & 4) ? 2 : (op.flags & 1),
+                     op.chunk_size, op.aux, &len,
                      &crc, (op.flags >> 1) & 1, op.expected_crc);
     r.ver = ver;
     r.len = len;

@@ -16,7 +16,7 @@ import random
 import threading
 import time
 from collections import defaultdict
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu3fs.mgmtd.types import ChainInfo, NodeType, PublicTargetState, RoutingInfo
@@ -30,6 +30,23 @@ from tpu3fs.storage.craq import (
 )
 from tpu3fs.storage.types import ChunkId, SpaceInfo
 from tpu3fs.utils.result import Code, FsError, Status
+
+
+# -- EC stripe version encoding ---------------------------------------------
+# Stripe versions carry a WRITER NONCE in the low 32 bits and the logical
+# version in the high bits: two concurrent writers racing the same logical
+# version can otherwise stage DIFFERENT content under one version number
+# on different shards, and a later commit / roll-forward would assemble a
+# stripe of mixed payloads (found by tests/test_model_ec.py). With nonces,
+# equal version => same writer => consistent shards; ordering still works
+# (higher logical wins; ties break by nonce and the loser re-encodes).
+EC_VER_SHIFT = 32
+
+
+def ec_logical_ver(encoded: int) -> int:
+    """Logical stripe version of an encoded (or legacy small) version."""
+    return encoded >> EC_VER_SHIFT if encoded >= (1 << EC_VER_SHIFT) \
+        else encoded
 
 
 class TargetSelectionMode(enum.Enum):
@@ -131,6 +148,19 @@ class StorageClient:
         if chain is None:
             raise FsError(Status(Code.CHAIN_NOT_FOUND, str(chain_id)))
         return chain
+
+    def _ec_next_ver(self, prev_encoded: int) -> int:
+        """Next encoded stripe version above prev: logical+1 in the
+        high bits, a fresh writer nonce in the low 32 (see EC_VER_SHIFT).
+        """
+        import os
+
+        # REAL entropy, not the client's seeded RNG: clients constructed
+        # with the default seed would otherwise draw IDENTICAL nonces in
+        # lockstep, recreating the same-version mixed-stripe corruption
+        # the nonce exists to prevent
+        return ((ec_logical_ver(prev_encoded) + 1) << EC_VER_SHIFT) | \
+            int.from_bytes(os.urandom(4), "big")
 
     def _sleep(self, attempt: int) -> None:
         delay = min(
@@ -423,9 +453,10 @@ class StorageClient:
         S = shard_size_of(chunk_size, k)
         codec = get_codec(k, m, S)
         shards, crcs = codec.encode_stripe(data)
-        ver = update_ver or 1
+        ver = update_ver or self._ec_next_ver(0)
         last: Optional[UpdateReply] = None
-        done: set = set()  # shard indices already acked at `ver`
+        done: set = set()     # shard indices STAGED at `ver`
+        landed: set = set()   # shard indices COMMITTED at `ver`
         for attempt in range(self._retry.max_retries + 1):
             chain = self._chain(chain_id)
             routing = self._routing()
@@ -464,6 +495,7 @@ class StorageClient:
                     update_ver=ver,
                     chunk_size=S,
                     logical_len=len(data),
+                    phase=1,  # STAGE: the committed stripe survives failure
                 )
                 try:
                     reply = self._messenger(node.node_id, "write_shard", req)
@@ -474,8 +506,10 @@ class StorageClient:
                     done.add(j)
                 elif reply.code == Code.CHUNK_STALE_UPDATE:
                     # a newer stripe version exists: re-write the whole
-                    # stripe above it (whole-stripe versioning)
-                    bump_to = max(bump_to, reply.commit_ver + 1, ver + 1)
+                    # stripe above it (whole-stripe versioning, fresh nonce)
+                    bump_to = max(
+                        bump_to,
+                        self._ec_next_ver(max(reply.commit_ver, ver)))
                 elif Status(reply.code).retryable() or reply.code in (
                     Code.RPC_PEER_CLOSED, Code.RPC_CONNECT_FAILED,
                 ):
@@ -486,16 +520,55 @@ class StorageClient:
                 return hard
             if bump_to:
                 ver = bump_to
-                done.clear()  # everything must be re-written at the new ver
+                done.clear()  # everything must be re-staged at the new ver
+                landed.clear()
                 self._sleep(attempt)
                 continue
-            # STRICT success: every currently-writable shard acked (and at
-            # least k overall, or the stripe would be undecodable). A shard
-            # left behind on a live SERVING target would never be repaired
-            # — rebuild only runs for SYNCING targets — and a later
-            # sub-stripe read of just that shard would serve stale bytes.
+            # STRICT staging: every currently-writable shard staged (and at
+            # least k overall, or the stripe would be undecodable). Only
+            # then does phase 2 COMMIT — the first point where the old
+            # version is destroyed, and by then every writable shard holds
+            # the new content as pending. A partial commit (node dies
+            # mid-round) is finished by the rebuilder's roll-forward.
             if acked == writable and acked >= k:
-                return UpdateReply(Code.OK, update_ver=ver, commit_ver=ver)
+                for j in sorted(done - landed):
+                    t = chain.target_of_shard(j)
+                    node = (routing.node_of_target(t.target_id)
+                            if t is not None else None)
+                    if node is None:
+                        continue
+                    creq = ShardWriteReq(
+                        chain_id=chain_id,
+                        chain_ver=chain.chain_version,
+                        target_id=t.target_id,
+                        chunk_id=chunk_id,
+                        data=b"",
+                        crc=0,
+                        update_ver=ver,
+                        chunk_size=S,
+                        logical_len=len(data),
+                        phase=2,
+                    )
+                    try:
+                        r2 = self._messenger(node.node_id, "write_shard",
+                                             creq)
+                    except FsError as e:
+                        r2 = UpdateReply(e.code, message=e.status.message)
+                    if r2.ok:
+                        landed.add(j)
+                    elif r2.code == Code.CHUNK_MISSING_UPDATE:
+                        # our pending was displaced (e.g. by a concurrent
+                        # writer's stage): re-STAGE this shard next attempt
+                        # instead of re-sending a commit that cannot land
+                        done.discard(j)
+                if landed >= done:
+                    return UpdateReply(Code.OK, update_ver=ver,
+                                       commit_ver=ver)
+                last = UpdateReply(
+                    Code.TARGET_OFFLINE,
+                    message=f"{len(landed)}/{len(done)} commits acked")
+                self._sleep(attempt)
+                continue
             last = last or UpdateReply(
                 Code.TARGET_OFFLINE,
                 message=f"{acked}/{writable} writable shards acked")
@@ -542,7 +615,7 @@ class StorageClient:
         # one-RPC version probe: max committed over probed shards is the
         # floor for this batch's stripe versions (a later shard write may
         # still be ahead — that stripe falls to the per-stripe ladder)
-        vers = [1] * B
+        vers = [self._ec_next_ver(0)] * B
         t0 = chain.target_of_shard(0)
         if t0 is not None:
             node0 = routing.node_of_target(t0.target_id)
@@ -551,7 +624,7 @@ class StorageClient:
                     stats = self._messenger(
                         node0.node_id, "stat_chunks",
                         (t0.target_id, [cid for cid, _ in items]))
-                    vers = [max(1, int(st[0]) + 1) if st[0] else 1
+                    vers = [self._ec_next_ver(int(st[0]))
                             for st in stats]
                 except FsError:
                     pass  # probe is an optimization; conflicts still ladder
@@ -582,7 +655,9 @@ class StorageClient:
                     update_ver=vers[b],
                     chunk_size=S,
                     logical_len=len(data),
+                    phase=1,  # STAGE: committed stripe survives a failure
                 )))
+        # -- phase 1: stage every shard (pending only) -----------------------
         for node_id, group in by_node.items():
             try:
                 got = self._messenger(
@@ -594,10 +669,35 @@ class StorageClient:
                     acked[b] += 1
                 elif reply.code == Code.CHUNK_STALE_UPDATE:
                     hard[b] = reply
+        # -- phase 2: commit fully-staged stripes ----------------------------
+        # an overwrite only destroys the previous version HERE, and only
+        # for stripes whose every writable shard holds the staged content;
+        # a partial commit is completed by the rebuilder's roll-forward
+        # (committed+pending >= k at the staged version)
+        committed = [0] * B
+        commit_by_node: Dict[int, List[Tuple[int, ShardWriteReq]]] = (
+            defaultdict(list))
+        full_staged = {b for b in range(B)
+                       if acked[b] == writable and acked[b] >= k
+                       and hard[b] is None}
+        for node_id, group in by_node.items():
+            for b, r in group:
+                if b in full_staged:
+                    commit_by_node[node_id].append((b, replace(
+                        r, data=b"", crc=0, phase=2)))
+        for node_id, group in commit_by_node.items():
+            try:
+                got = self._messenger(
+                    node_id, "batch_write_shard", [r for _, r in group])
+            except FsError:
+                continue
+            for (b, _), reply in zip(group, got):
+                if reply.ok:
+                    committed[b] += 1
         out: List[UpdateReply] = []
         for b, (cid, data) in enumerate(items):
-            # same strict rule as write_stripe: every writable shard acked
-            if acked[b] == writable and acked[b] >= k and hard[b] is None:
+            # strict rule: every writable shard staged AND committed
+            if b in full_staged and committed[b] == acked[b]:
                 out.append(UpdateReply(
                     Code.OK, update_ver=vers[b], commit_ver=vers[b]))
             else:

@@ -181,6 +181,8 @@ class Fabric:
             return svc.write_shard(payload)
         if method == "update":
             return svc.update(payload)
+        if method == "read_rebuild":
+            return svc.read_rebuild(payload)
         if method == "read":
             return svc.read(payload)
         if method == "batch_read":

@@ -278,6 +278,9 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     # local data-path offlining (ref offlineTarget, fbs/storage/Service.h:14)
     s.method(18, "offlineTarget", TargetIdReq, IntReply,
              lambda r: IntReply(int(svc.offline_target(r.target_id))))
+    # rebuild-coordinator read: bypasses the public-state gate (EC
+    # opportunistic rebuild; ec_resync._read_shard)
+    s.method(19, "readRebuild", ReadReq, ReadReply, svc.read_rebuild)
     server.add_service(s)
 
 
@@ -389,6 +392,8 @@ class RpcMessenger:
         if method == "stat_chunks":
             rsp = c.call(addr, sid, 16, StatChunksReq(*payload), StatChunksRsp)
             return [tuple(t) for t in rsp.stats]
+        if method == "read_rebuild":
+            return c.call(addr, sid, 19, payload, ReadReply)
         raise FsError(Status(Code.RPC_METHOD_NOT_FOUND, method))
 
 

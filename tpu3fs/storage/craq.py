@@ -105,6 +105,15 @@ class ShardWriteReq:
     update_ver: int              # stripe version
     chunk_size: int              # shard size (engine chunk size)
     logical_len: int = 0         # pre-padding stripe payload length
+    # TWO-PHASE stripe writes (atomic overwrites): 1 = STAGE the shard as
+    # pending (committed version untouched), 2 = COMMIT a staged version
+    # (data/crc unused), 0 = legacy one-step install — still the right
+    # semantic for REBUILD writes, which install proven content.
+    # Rationale: a one-step overwrite that fails midway destroys the old
+    # version's shards on the targets it reached; with k-1 such losses the
+    # stripe has NO version with a k-quorum left (found by the EC model
+    # check, tests/test_model_ec.py).
+    phase: int = 0
 
 
 @dataclass
@@ -773,20 +782,35 @@ class StorageService:
                 self._check_target_serving(target)
                 chain = self._chain(req.chain_id)  # re-check under the lock
                 engine = target.engine
+                if req.phase == 2:
+                    # COMMIT a staged stripe version: idempotent for
+                    # duplicates (committed >= ver returns OK); missing
+                    # pending is the client's signal to re-stage
+                    meta = engine.commit(
+                        req.chunk_id, req.update_ver, chain.chain_version)
+                    return UpdateReply(
+                        Code.OK,
+                        update_ver=req.update_ver,
+                        commit_ver=meta.committed_ver,
+                        checksum=meta.checksum,
+                    )
                 triaged = self._triage_shard_install(engine, req)
                 if triaged is not None:
                     return triaged
                 # VALIDATED install: req.crc covers the stored (trimmed)
                 # shard bytes; the engine computes the content CRC during
                 # staging anyway and refuses on mismatch — one checksum
-                # pass server-side instead of a separate padded pre-check
+                # pass server-side instead of a separate padded pre-check.
+                # phase 1 STAGES only (pending); phase 0 installs committed
+                # in one step (rebuild writes of proven content).
                 meta = engine.update(
                     req.chunk_id,
                     req.update_ver,
                     chain.chain_version,
                     req.data,
                     0,
-                    full_replace=True,
+                    full_replace=req.phase == 0,
+                    stage_replace=req.phase == 1,
                     chunk_size=req.chunk_size,
                     # the stripe's logical (pre-padding) length rides the
                     # engine's aux tag: durable across restarts, consulted
@@ -799,7 +823,8 @@ class StorageService:
                     Code.OK,
                     update_ver=req.update_ver,
                     commit_ver=meta.committed_ver,
-                    checksum=meta.checksum,
+                    checksum=(meta.pending_checksum if req.phase == 1
+                              else meta.checksum),
                 )
             except FsError as e:
                 if e.code == Code.CHUNK_CHECKSUM_MISMATCH:
@@ -1217,6 +1242,8 @@ class StorageService:
             engine = target.engine
             ops: List[EngineUpdateOp] = []
             op_idx: List[int] = []
+            commits: List[Tuple] = []
+            commit_idx: List[int] = []
             chain_ver = 0  # all reqs of one target share its chain
             for i, r in enumerate(reqs):
                 try:
@@ -1229,6 +1256,10 @@ class StorageService:
                     replies[i] = UpdateReply(Code.INVALID_ARG,
                                              message="not an EC chain")
                     continue
+                if r.phase == 2:
+                    commits.append((r.chunk_id, r.update_ver))
+                    commit_idx.append(i)
+                    continue
                 triaged = self._triage_shard_install(engine, r)
                 if triaged is not None:
                     replies[i] = triaged
@@ -1238,12 +1269,23 @@ class StorageService:
                     data=r.data,
                     offset=0,
                     update_ver=r.update_ver,
-                    full_replace=True,
+                    full_replace=r.phase == 0,
+                    stage_replace=r.phase == 1,
                     chunk_size=r.chunk_size,
                     aux=r.logical_len,
                     expected_crc=r.crc,
                 ))
                 op_idx.append(i)
+            # commits of staged versions: one engine crossing too
+            if commits:
+                for i, res in zip(commit_idx,
+                                  engine.batch_commit(commits, chain_ver)):
+                    if res.ok:
+                        replies[i] = UpdateReply(
+                            Code.OK, update_ver=reqs[i].update_ver,
+                            commit_ver=res.ver, checksum=res.checksum)
+                    else:
+                        replies[i] = UpdateReply(res.code)
             results = engine.batch_update(ops, chain_ver) if ops else []
             for i, res in zip(op_idx, results):
                 if res.ok:
@@ -1303,6 +1345,30 @@ class StorageService:
             raise _err(Code.TARGET_OFFLINE, str(target_id))
         self._check_target_serving(self._targets[target_id])
         return target_id
+
+    def read_rebuild(self, req: ReadReq) -> ReadReply:
+        """Rebuild-coordinator read: serves committed data from a named
+        LOCAL target regardless of its PUBLIC state (the EC rebuilder
+        proves usability via stripe-version agreement + CRC — see
+        ec_resync._read_shard). Locally-offlined targets still refuse;
+        clients must keep using read(), whose public gate protects them
+        from stale replicas."""
+        with self._read_rec.record() as op:
+            try:
+                if self.stopped:
+                    raise _err(Code.RPC_PEER_CLOSED, "node stopped")
+                target = self._targets.get(req.target_id)
+                if target is None or target.chain_id != req.chain_id:
+                    raise _err(Code.TARGET_NOT_FOUND, str(req.target_id))
+                self._check_target_serving(target)
+                data, ver, crc, aux = target.engine.read_verified(
+                    req.chunk_id, req.offset, req.length)
+                return ReadReply(
+                    Code.OK, data=data, commit_ver=ver,
+                    checksum=Checksum(crc, len(data)), logical_len=aux)
+            except FsError as e:
+                op.fail()
+                return ReadReply(e.code)
 
     def _read_impl(self, req: ReadReq) -> ReadReply:
         try:

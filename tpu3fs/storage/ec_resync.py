@@ -53,9 +53,13 @@ class EcResyncWorker:
             if not syncing:
                 continue
             # the first serving member acts as rebuild coordinator (one
-            # recovery driver per chain, mirroring the CR predecessor rule)
+            # recovery driver per chain, mirroring the CR predecessor
+            # rule); a chain with NO serving members — every target
+            # degraded after cascading bounces — falls to the first chain
+            # member, or recovery could never start anywhere
             serving = chain.serving_targets()
-            if not serving or serving[0].target_id not in local_ids:
+            coordinator = (serving[0] if serving else chain.targets[0])
+            if coordinator.target_id not in local_ids:
                 continue
             for t in syncing:
                 moved += self._rebuild_target(routing, chain, t.target_id)
@@ -69,11 +73,21 @@ class EcResyncWorker:
         node = routing.node_of_target(target_id)
         if node is None:
             return 0
-        # stripe inventory = union over serving peers (any k shards name the
-        # stripe; one peer may have missed a write)
+        # stripe inventory: serving peers' stripes are REQUIRED (promotion
+        # blocks until each rebuilds); reachable degraded peers contribute
+        # best-effort entries — rebuilt when provable, never promotion-
+        # blocking (a single-shard residue of a failed write must not
+        # wedge sync_done)
         stripes: Dict[bytes, ChunkId] = {}
-        dumps_ok = 0
-        for t in chain.serving_targets():
+        required: set = set()
+        # per-stripe, per-shard (committed_ver, pending_ver) — feeds the
+        # roll-forward of partial two-phase commits
+        vers: Dict[bytes, Dict[int, tuple]] = {}
+        serving_dumps = 0
+        serving_ids = {t.target_id for t in chain.serving_targets()}
+        for t in chain.targets:
+            if t.target_id == target_id:
+                continue
             pn = routing.node_of_target(t.target_id)
             if pn is None:
                 continue
@@ -82,24 +96,53 @@ class EcResyncWorker:
                     pn.node_id, "dump_chunkmeta", t.target_id)
             except FsError:
                 continue
-            dumps_ok += 1
+            if t.target_id in serving_ids:
+                serving_dumps += 1
+            shard_j = chain.shard_index(t.target_id)
             for meta in metas:
+                key = meta.chunk_id.to_bytes()
+                if meta.committed_ver > 0 or meta.pending_ver > 0:
+                    vers.setdefault(key, {})[shard_j] = (
+                        meta.committed_ver, meta.pending_ver)
                 if meta.committed_ver > 0:
-                    stripes[meta.chunk_id.to_bytes()] = meta.chunk_id
-        if dumps_ok == 0:
-            # can't see any peer inventory: declaring up-to-date now would
-            # promote a hollow target — leave SYNCING for the next round
-            return 0
+                    stripes[key] = meta.chunk_id
+                    if t.target_id in serving_ids:
+                        required.add(key)
+        if serving_dumps == 0:
+            # no serving peer's inventory is visible. With enough degraded
+            # peers reachable, committed k-quorums still PROVE stripes
+            # (version agreement + CRC) — treat those as required and
+            # recover; with fewer than k reachable dumps nothing can be
+            # proven and promotion would be hollow: stay SYNCING.
+            reachable = len({j for sv in vers.values() for j in sv})
+            if reachable < k:
+                return 0
+            for key, shard_vers in vers.items():
+                counts: Dict[int, int] = {}
+                for cv, _pv in shard_vers.values():
+                    if cv > 0:
+                        counts[cv] = counts.get(cv, 0) + 1
+                if counts and max(counts.values()) >= k:
+                    required.add(key)
         if not stripes:
-            self._messenger(node.node_id, "sync_done", target_id)
+            try:
+                self._messenger(node.node_id, "sync_done", target_id)
+            except FsError:
+                pass  # recovering node died again; next round retries
             return 0
+        # roll FORWARD partial two-phase commits first: a stripe version v
+        # with committed(v) + pending(v) >= k was fully staged before its
+        # commit round died — committing the stragglers restores a
+        # committed k-quorum that the rebuild below can then use
+        self._roll_forward(routing, chain, stripes, vers)
         moved = 0
         failed = 0
         todo = list(stripes.values())
         for base in range(0, len(todo), self._batch):
+            batch = todo[base : base + self._batch]
             ok, bad = self._rebuild_batch(
-                routing, chain, todo[base : base + self._batch],
-                lost_shard, node.node_id, target_id)
+                routing, chain, batch, lost_shard, node.node_id, target_id,
+                required)
             moved += ok
             failed += bad
         # stale-chunk cleanup: shards on the recovering target for stripes
@@ -117,29 +160,105 @@ class EcResyncWorker:
             # only promote when EVERY stripe was rebuilt this round —
             # skipped stripes (in-flight writes, failed installs) must get
             # another pass before the target may serve reads
-            self._messenger(node.node_id, "sync_done", target_id)
+            try:
+                self._messenger(node.node_id, "sync_done", target_id)
+            except FsError:
+                pass  # recovering node died again; next round retries
         return moved
+
+    def _roll_forward(self, routing: RoutingInfo, chain: ChainInfo,
+                      stripes: Dict[bytes, ChunkId],
+                      vers: Dict[bytes, Dict[int, tuple]]) -> int:
+        """Finish partially-committed two-phase stripe writes: for each
+        stripe, the highest version v with committed(v) + pending(v) >= k
+        gets its pending shards committed (idempotent phase-2 writes).
+        Safe because a version fully staged across >= k shards was one
+        commit round away from durable — completing it can only move the
+        stripe FORWARD to content every staged shard already holds."""
+        k = chain.ec_k
+        committed = 0
+        serving_shards = {chain.shard_index(t.target_id)
+                          for t in chain.serving_targets()}
+        for key, shard_vers in vers.items():
+            cid = stripes.get(key)
+            if cid is None:
+                continue
+            best = 0
+            for j, (cv, pv) in shard_vers.items():
+                for v in (cv, pv):
+                    if v <= best:
+                        continue
+                    holders = {j2 for j2, (cv2, pv2) in shard_vers.items()
+                               if cv2 == v or pv2 == v}
+                    # quorum AND coverage of every serving shard: rolling
+                    # forward past a serving target that never staged v
+                    # would leave it serving stale sub-stripe reads
+                    if len(holders) >= k and serving_shards <= holders:
+                        best = v
+            if best == 0:
+                continue
+            # commit the stragglers still pending at `best`
+            for j, (cv, pv) in shard_vers.items():
+                if pv != best or cv >= best:
+                    continue
+                t = chain.target_of_shard(j)
+                pn = (routing.node_of_target(t.target_id)
+                      if t is not None else None)
+                if pn is None:
+                    continue
+                try:
+                    r = self._messenger(pn.node_id, "write_shard",
+                                        ShardWriteReq(
+                                            chain_id=chain.chain_id,
+                                            chain_ver=chain.chain_version,
+                                            target_id=t.target_id,
+                                            chunk_id=cid,
+                                            data=b"",
+                                            crc=0,
+                                            update_ver=best,
+                                            chunk_size=0,
+                                            phase=2,
+                                        ))
+                    if r.ok:
+                        committed += 1
+                except FsError:
+                    continue
+        return committed
 
     def _read_shard(self, routing: RoutingInfo, chain: ChainInfo, j: int,
                     chunk_id: ChunkId):
+        """-> (reply, safe) or None. `safe` = the source is publicly
+        readable. UNSAFE sources (WAITING/SYNCING publics whose node still
+        answers) are read OPPORTUNISTICALLY: after multiple bounces more
+        than m targets can be publicly degraded at once while every byte
+        still exists on disk — committed shard versions + CRCs let the
+        rebuilder prove which of that data is usable (the version guard in
+        _rebuild_batch), instead of wedging the chain forever."""
         t = chain.target_of_shard(j)
-        if t is None or not t.public_state.can_read:
+        if t is None:
             return None
+        safe = t.public_state.can_read
         pn = routing.node_of_target(t.target_id)
         if pn is None:
             return None
         try:
+            # read_rebuild bypasses the public-state gate (locally-offlined
+            # targets still refuse); the caller's version guard decides
+            # what is usable
             r = self._messenger(
-                pn.node_id, "read",
+                pn.node_id, "read_rebuild",
                 ReadReq(chain.chain_id, chunk_id, 0, -1, t.target_id))
         except FsError:
             return None
-        return r if r.ok else None
+        return (r, safe) if r.ok else None
 
     def _rebuild_batch(self, routing: RoutingInfo, chain: ChainInfo,
                        chunk_ids: List[ChunkId], lost_shard: int,
-                       node_id: int, target_id: int) -> tuple:
-        """-> (shards installed, stripes skipped/failed this round)."""
+                       node_id: int, target_id: int,
+                       required: Optional[set] = None) -> tuple:
+        """-> (shards installed, REQUIRED stripes skipped/failed this
+        round). Best-effort stripes (known only to degraded peers) never
+        block promotion."""
         from tpu3fs.ops.stripe import (
             aligned_shard_size,
             get_codec,
@@ -151,25 +270,59 @@ class EcResyncWorker:
         # version are skipped this round (a write is in flight)
         gathered = []  # (chunk_id, ver, {shard: bytes}, S, logical)
         skipped = 0
+
+        def _skip(cid) -> int:
+            return 1 if (required is None
+                         or cid.to_bytes() in required) else 0
+
         for cid in chunk_ids:
             by_ver: Dict[int, Dict[int, bytes]] = {}
             aux_ver: Dict[int, int] = {}
+            max_safe_ver = 0
+            # the recovering target's OWN committed shard participates in
+            # the version quorum: after several bounces it often already
+            # holds the newest shard (disk intact), and without its vote a
+            # one-at-a-time promotion queue can deadlock — every SYNCING
+            # rebuild waiting on stale WAITING peers that are queued
+            # behind it
+            own_ver = -1
             for j in range(k + m):
-                if j == lost_shard:
+                rs = self._read_shard(routing, chain, j, cid)
+                if rs is None:
                     continue
-                r = self._read_shard(routing, chain, j, cid)
-                if r is None:
-                    continue
+                r, safe = rs
                 by_ver.setdefault(r.commit_ver, {})[j] = r.data
+                if j == lost_shard:
+                    own_ver = r.commit_ver
+                if safe:
+                    max_safe_ver = max(max_safe_ver, r.commit_ver)
                 if r.logical_len:
                     aux_ver[r.commit_ver] = max(
                         aux_ver.get(r.commit_ver, 0), r.logical_len)
             usable = [v for v, g in by_ver.items() if len(g) >= k]
             if not usable:
-                skipped += 1
+                skipped += _skip(cid)
                 continue
             ver = max(usable)
-            shards = by_ver[ver]
+            if ver < max_safe_ver:
+                # a publicly-readable source has a NEWER committed stripe
+                # than anything k shards can prove: rebuilding at the old
+                # version would roll the stripe back — wait for the newer
+                # version's shard set to become reachable
+                skipped += _skip(cid)
+                continue
+            if own_ver == ver:
+                # already holding the proven version (engine-validated
+                # CRC): nothing to install for this stripe
+                continue
+            shards = {j: b for j, b in by_ver[ver].items()
+                      if j != lost_shard}
+            if len(shards) < k:
+                # quorum only reached WITH our own stale... no: own_ver !=
+                # ver here, so own shard is not in by_ver[ver]; fewer than
+                # k true survivors cannot decode — wait for peers
+                skipped += _skip(cid)
+                continue
             logical = aux_ver.get(ver, 0)
             # shard size is per-file (S = ceil(chunk_size/k)); the max stored
             # survivor length is a safe working size: content beyond any
@@ -230,12 +383,12 @@ class EcResyncWorker:
                 try:
                     reply = self._messenger(node_id, "write_shard", req)
                 except FsError:
-                    skipped += 1
+                    skipped += _skip(cid)
                     continue
                 if reply.ok:
                     moved += 1
                 else:
-                    skipped += 1
+                    skipped += _skip(cid)
         return moved, skipped
 
     def _reconstruct(self, codec, present, lost, surv: np.ndarray) -> np.ndarray:

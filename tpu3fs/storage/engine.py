@@ -35,6 +35,7 @@ class EngineUpdateOp:
     offset: int = 0
     update_ver: int = 0          # 0 = assign committed+1
     full_replace: bool = False
+    stage_replace: bool = False  # EC two-phase stage (pending only)
     chunk_size: int = 0
     aux: int = 0                 # opaque tag stored with the staged content
     expected_crc: Optional[int] = None  # validated install (EC shard path)
@@ -86,6 +87,7 @@ class ChunkEngine(abc.ABC):
         offset: int,
         *,
         full_replace: bool = False,
+        stage_replace: bool = False,
         chunk_size: int,
         aux: int = 0,
         expected_crc: Optional[int] = None,
@@ -96,7 +98,15 @@ class ChunkEngine(abc.ABC):
         expected_crc (when given) makes the install VALIDATED: the engine
         compares its own content CRC (computed during staging anyway) and
         refuses with CHUNK_CHECKSUM_MISMATCH before mutating anything —
-        the one-pass verified write the EC shard path uses."""
+        the one-pass verified write the EC shard path uses.
+
+        Modes: full_replace installs data as COMMITTED at update_ver in
+        one step (recovery writes — design_notes "Data recovery" step 2).
+        stage_replace stages data as the full PENDING content at
+        update_ver, allowing version gaps and replacing any older pending
+        — phase one of the EC two-phase stripe write; the committed
+        version is untouched until commit() promotes it, so a failed
+        overwrite can never destroy the last readable stripe version."""
 
     @abc.abstractmethod
     def commit(self, chunk_id: ChunkId, ver: int, chain_ver: int) -> ChunkMeta:
@@ -141,7 +151,9 @@ class ChunkEngine(abc.ABC):
                     ver = (m.committed_ver if m else 0) + 1
                 meta = self.update(
                     op.chunk_id, ver, chain_ver, op.data, op.offset,
-                    full_replace=op.full_replace, chunk_size=op.chunk_size,
+                    full_replace=op.full_replace,
+                    stage_replace=op.stage_replace,
+                    chunk_size=op.chunk_size,
                     aux=op.aux, expected_crc=op.expected_crc,
                 )
                 if op.full_replace:
@@ -255,19 +267,39 @@ class MemChunkEngine(ChunkEngine):
         offset: int,
         *,
         full_replace: bool = False,
+        stage_replace: bool = False,
         chunk_size: int,
         aux: int = 0,
         expected_crc: Optional[int] = None,
     ) -> ChunkMeta:
         if offset + len(data) > chunk_size:
             raise _err(Code.INVALID_ARG, "write exceeds chunk size")
+        assert not (full_replace and stage_replace)
         with self._lock:
             key = chunk_id.to_bytes()
             slot = self._chunks.get(key)
             # validate BEFORE inserting, so a rejected update leaves no
             # phantom committed_ver=0 chunk behind (which would turn
             # CHUNK_NOT_FOUND holes into spurious CHUNK_NOT_COMMIT retries)
-            if not full_replace:
+            if stage_replace:
+                # EC stage: any version newer than committed may stage,
+                # replacing an OLDER pending (stripe versions can jump) —
+                # but never a NEWER one: clobbering a fully-staged newer
+                # version could strand its partial commit with no
+                # completable quorum
+                cv = slot.meta.committed_ver if slot else 0
+                pv = slot.meta.pending_ver if slot else 0
+                if update_ver <= cv:
+                    raise _err(
+                        Code.CHUNK_STALE_UPDATE,
+                        f"stage {update_ver} <= committed {cv}",
+                    )
+                if pv and update_ver < pv:
+                    raise _err(
+                        Code.CHUNK_ADVANCE_UPDATE,
+                        f"stage {update_ver} < pending {pv}",
+                    )
+            if not full_replace and not stage_replace:
                 cv = slot.meta.committed_ver if slot else 0
                 pv = slot.meta.pending_ver if slot else 0
                 if update_ver <= cv:
@@ -288,7 +320,8 @@ class MemChunkEngine(ChunkEngine):
                     )
             checked: Optional[Checksum] = None
             if expected_crc is not None:
-                if full_replace or slot is None or not slot.committed:
+                if (full_replace or stage_replace or slot is None
+                        or not slot.committed):
                     content = data if (offset == 0 and isinstance(
                         data, bytes)) else (
                         b"\x00" * offset + bytes(data))
@@ -324,6 +357,16 @@ class MemChunkEngine(ChunkEngine):
                 meta.pending_checksum = Checksum()
                 meta.aux = aux
                 slot.aux_pending = 0
+                return replace(meta)
+            if stage_replace:
+                slot.pending = bytes(data)
+                meta.pending_ver = update_ver
+                meta.chain_ver = chain_ver
+                meta.pending_length = len(slot.pending)
+                meta.pending_checksum = (
+                    checked if checked is not None
+                    else Checksum.of(slot.pending))
+                slot.aux_pending = aux
                 return replace(meta)
             # COW: base is committed content (re-applying the same pending
             # update is idempotent)

@@ -301,13 +301,15 @@ class NativeChunkEngine(ChunkEngine):
         offset: int,
         *,
         full_replace: bool = False,
+        stage_replace: bool = False,
         chunk_size: int,
         aux: int = 0,
         expected_crc: Optional[int] = None,
     ) -> ChunkMeta:
+        mode = 2 if stage_replace else (1 if full_replace else 0)
         rc = self._lib.ce_update(
             self._h, chunk_id.to_bytes(), update_ver, chain_ver,
-            bytes(data), len(data), offset, int(full_replace), chunk_size,
+            bytes(data), len(data), offset, mode, chunk_size,
             aux, int(expected_crc is not None),
             (expected_crc or 0) & 0xFFFFFFFF,
         )
@@ -364,7 +366,8 @@ class NativeChunkEngine(ChunkEngine):
             c = c_ops[i]
             ctypes.memmove(c.key, op.chunk_id.to_bytes(), _KEYLEN)
             c.flags = ((1 if op.full_replace else 0)
-                       | (2 if op.expected_crc is not None else 0))
+                       | (2 if op.expected_crc is not None else 0)
+                       | (4 if op.stage_replace else 0))
             c.offset = op.offset
             c.data_len = len(op.data)
             c.chunk_size = op.chunk_size
