@@ -36,12 +36,14 @@ FILE_ID = 31
 
 
 class EcExplorer:
-    def __init__(self, seed: int, *, nodes: int = 4):
+    def __init__(self, seed: int, *, nodes: int = 4, k: int = K, m: int = M):
         self.rng = random.Random(seed)
         self.np_rng = np.random.default_rng(seed)
+        self.k = k
+        self.m = m
         self.fab = Fabric(SystemSetupConfig(
             num_storage_nodes=nodes, num_chains=2, chunk_size=CHUNK,
-            ec_k=K, ec_m=M))
+            ec_k=k, ec_m=m))
         fast = RetryOptions(max_retries=3, backoff_base_s=0.0005,
                             backoff_max_s=0.01)
         self.client = self.fab.storage_client(retry=fast)
@@ -95,7 +97,7 @@ class EcExplorer:
 
     def act_kill(self) -> None:
         live = [n for n in self.fab.nodes.values() if n.alive]
-        if len(live) <= K:  # keep at least k nodes up
+        if len(live) <= self.k:  # keep at least k nodes up
             return
         victim = self.rng.choice(live)
         if self.rng.random() < 0.4:
@@ -187,3 +189,11 @@ def test_random_ec_schedules(seed):
 @pytest.mark.parametrize("seed", range(6))
 def test_random_ec_schedules_more_nodes(seed):
     EcExplorer(500 + seed, nodes=5).run(steps=80)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_ec_schedules_double_parity(seed):
+    """RS(4,2): multi-loss rebuilds, two erasures tolerated — the
+    degraded-serving check kills one node on top of whatever the schedule
+    already degraded."""
+    EcExplorer(900 + seed, nodes=6, k=4, m=2).run(steps=80)

@@ -124,7 +124,10 @@ class FileIoClient:
             chain_id, cid, 0, chunk_size, chunk_size=chunk_size)
         if cur.ok:
             base = bytearray(cur.data.ljust(chunk_size, b"\x00"))
-            next_ver = cur.commit_ver + 1
+            # fresh-nonce encoded version: hand-computing commit_ver + 1
+            # would put concurrent RMW writers on the IDENTICAL encoded
+            # version and mix their shards (see EC_VER_SHIFT)
+            next_ver = self._storage.next_stripe_ver(cur.commit_ver)
         elif cur.code == Code.CHUNK_NOT_FOUND:
             base = bytearray(chunk_size)
             next_ver = 0
@@ -303,7 +306,7 @@ class FileIoClient:
                 if cur.ok:
                     self._storage.write_stripe(
                         bchain, cid, cur.data[:last_len], chunk_size=cs,
-                        update_ver=cur.commit_ver + 1)
+                        update_ver=self._storage.next_stripe_ver(cur.commit_ver))
         for chain_id in set(layout.chains):
             self._storage.truncate_file_chunks(
                 chain_id, inode.id, last_idx, last_len

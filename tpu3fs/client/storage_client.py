@@ -149,6 +149,13 @@ class StorageClient:
             raise FsError(Status(Code.CHAIN_NOT_FOUND, str(chain_id)))
         return chain
 
+    def next_stripe_ver(self, prev_encoded: int) -> int:
+        """Public face of the encoded-version generator for callers doing
+        read-modify-write (file_io): supersede what was read WITH a fresh
+        writer nonce — hand-computing prev+1 would put concurrent RMWs on
+        the identical encoded version and mix their shards."""
+        return self._ec_next_ver(prev_encoded)
+
     def _ec_next_ver(self, prev_encoded: int) -> int:
         """Next encoded stripe version above prev: logical+1 in the
         high bits, a fresh writer nonce in the low 32 (see EC_VER_SHIFT).
@@ -531,6 +538,13 @@ class StorageClient:
             # the new content as pending. A partial commit (node dies
             # mid-round) is finished by the rebuilder's roll-forward.
             if acked == writable and acked >= k:
+                # snapshot of the fully-staged shard set: commits must land
+                # on EVERY one of these. A CHUNK_MISSING_UPDATE discard
+                # shrinks `done` for re-staging — the ack below compares
+                # against this snapshot so a shrunken set can never ack
+                # with fewer than the full writable coverage (review: ack
+                # with < k commits after displaced pendings).
+                full = set(done)
                 for j in sorted(done - landed):
                     t = chain.target_of_shard(j)
                     node = (routing.node_of_target(t.target_id)
@@ -561,12 +575,12 @@ class StorageClient:
                         # writer's stage): re-STAGE this shard next attempt
                         # instead of re-sending a commit that cannot land
                         done.discard(j)
-                if landed >= done:
+                if landed >= full:
                     return UpdateReply(Code.OK, update_ver=ver,
                                        commit_ver=ver)
                 last = UpdateReply(
                     Code.TARGET_OFFLINE,
-                    message=f"{len(landed)}/{len(done)} commits acked")
+                    message=f"{len(landed)}/{len(full)} commits acked")
                 self._sleep(attempt)
                 continue
             last = last or UpdateReply(

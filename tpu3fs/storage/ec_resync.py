@@ -84,6 +84,7 @@ class EcResyncWorker:
         # roll-forward of partial two-phase commits
         vers: Dict[bytes, Dict[int, tuple]] = {}
         serving_dumps = 0
+        total_dumps = 0
         serving_ids = {t.target_id for t in chain.serving_targets()}
         for t in chain.targets:
             if t.target_id == target_id:
@@ -96,6 +97,7 @@ class EcResyncWorker:
                     pn.node_id, "dump_chunkmeta", t.target_id)
             except FsError:
                 continue
+            total_dumps += 1
             if t.target_id in serving_ids:
                 serving_dumps += 1
             shard_j = chain.shard_index(t.target_id)
@@ -110,12 +112,14 @@ class EcResyncWorker:
                         required.add(key)
         if serving_dumps == 0:
             # no serving peer's inventory is visible. With enough degraded
-            # peers reachable, committed k-quorums still PROVE stripes
-            # (version agreement + CRC) — treat those as required and
-            # recover; with fewer than k reachable dumps nothing can be
-            # proven and promotion would be hollow: stay SYNCING.
-            reachable = len({j for sv in vers.values() for j in sv})
-            if reachable < k:
+            # peers REACHABLE (answering dumps), committed k-quorums still
+            # PROVE stripes — treat those as required and recover; with
+            # fewer than k reachable peers nothing can be proven and
+            # promotion would be hollow: stay SYNCING. The bar counts
+            # RESPONDING PEERS, not shards seen in stripes: an empty
+            # all-degraded chain (zero stripes anywhere) must fall through
+            # to the empty-promotion below, or it wedges forever.
+            if total_dumps < k:
                 return 0
             for key, shard_vers in vers.items():
                 counts: Dict[int, int] = {}
