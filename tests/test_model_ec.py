@@ -141,12 +141,18 @@ class EcExplorer:
             assert t.public_state == PublicTargetState.SERVING, (
                 f"shard target {t.target_id} stuck {t.public_state.name}")
         self._check_reads("healed")
-        # E3: single-node-down degraded serving for every acked stripe
-        victim = self.rng.choice(
-            [n for n in self.fab.nodes.values() if n.alive])
-        self.fab.kill_node(victim.node_id)
-        self._check_reads(f"degraded(node {victim.node_id} down)")
-        self.fab.restart_node(victim.node_id)
+        # E3: m-node-down degraded serving for every acked stripe — the
+        # full erasure budget, not just one loss (RS(4,2) must survive
+        # TWO simultaneous erasures)
+        victims = self.rng.sample(
+            [n for n in self.fab.nodes.values() if n.alive],
+            k=min(self.m, len(self.fab.nodes) - self.k))
+        for v in victims:
+            self.fab.kill_node(v.node_id)
+        names = ",".join(str(v.node_id) for v in victims)
+        self._check_reads(f"degraded(nodes {names} down)")
+        for v in victims:
+            self.fab.restart_node(v.node_id)
         self.fab.resync_all(rounds=4)
 
     @staticmethod
@@ -193,7 +199,6 @@ def test_random_ec_schedules_more_nodes(seed):
 
 @pytest.mark.parametrize("seed", range(6))
 def test_random_ec_schedules_double_parity(seed):
-    """RS(4,2): multi-loss rebuilds, two erasures tolerated — the
-    degraded-serving check kills one node on top of whatever the schedule
-    already degraded."""
+    """RS(4,2): multi-loss rebuilds — the degraded-serving check (E3)
+    kills m=2 nodes simultaneously after healing."""
     EcExplorer(900 + seed, nodes=6, k=4, m=2).run(steps=80)

@@ -589,6 +589,26 @@ class StorageClient:
             self._sleep(attempt)
         return last or UpdateReply(Code.CLIENT_RETRIES_EXHAUSTED)
 
+    def _send_shard_batches(self, by_node) -> List[Tuple[int, object]]:
+        """One batch_write_shard per node, fanned out in parallel;
+        -> merged [(stripe index, reply)] collected after the barrier
+        (list.append is atomic; the CALLER merges counters single-threaded
+        to avoid lost-update races on shared indices)."""
+        events: List[Tuple[int, object]] = []
+
+        def _send(item) -> None:
+            node_id, group = item
+            try:
+                got = self._messenger(
+                    node_id, "batch_write_shard", [r for _, r in group])
+            except FsError:
+                return
+            for (b, _), reply in zip(group, got):
+                events.append((b, reply))
+
+        self._fan_out(_send, list(by_node.items()))
+        return events
+
     def write_stripes(
         self,
         chain_id: int,
@@ -672,17 +692,13 @@ class StorageClient:
                     phase=1,  # STAGE: committed stripe survives a failure
                 )))
         # -- phase 1: stage every shard (pending only) -----------------------
-        for node_id, group in by_node.items():
-            try:
-                got = self._messenger(
-                    node_id, "batch_write_shard", [r for _, r in group])
-            except FsError:
-                continue
-            for (b, _), reply in zip(group, got):
-                if reply.ok:
-                    acked[b] += 1
-                elif reply.code == Code.CHUNK_STALE_UPDATE:
-                    hard[b] = reply
+        # merge AFTER the _send_shard_batches barrier: `acked[b] += 1`
+        # from concurrent node threads would be a lost-update race
+        for b, reply in self._send_shard_batches(by_node):
+            if reply.ok:
+                acked[b] += 1
+            elif reply.code == Code.CHUNK_STALE_UPDATE:
+                hard[b] = reply
         # -- phase 2: commit fully-staged stripes ----------------------------
         # an overwrite only destroys the previous version HERE, and only
         # for stripes whose every writable shard holds the staged content;
@@ -699,15 +715,9 @@ class StorageClient:
                 if b in full_staged:
                     commit_by_node[node_id].append((b, replace(
                         r, data=b"", crc=0, phase=2)))
-        for node_id, group in commit_by_node.items():
-            try:
-                got = self._messenger(
-                    node_id, "batch_write_shard", [r for _, r in group])
-            except FsError:
-                continue
-            for (b, _), reply in zip(group, got):
-                if reply.ok:
-                    committed[b] += 1
+        for b, reply in self._send_shard_batches(commit_by_node):
+            if reply.ok:
+                committed[b] += 1
         out: List[UpdateReply] = []
         for b, (cid, data) in enumerate(items):
             # strict rule: every writable shard staged AND committed
