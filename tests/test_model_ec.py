@@ -12,8 +12,8 @@ Invariants:
   E2 (acked durability): after healing + rebuild, every acknowledged
      stripe is readable and equals an acknowledged payload for that chunk
      at least as new as the oldest surviving ack.
-  E3 (degraded serving): with any ONE node down, every acked stripe still
-     reads back correctly (the m=1 erasure-tolerance promise).
+  E3 (degraded serving): with the FULL erasure budget of m nodes down
+     simultaneously, every acked stripe still reads back correctly.
   E4 (length precision): short stripes read back at their exact logical
      length, through rebuilds.
 """

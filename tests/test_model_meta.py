@@ -1,0 +1,262 @@
+"""Randomized model check of the metadata namespace — the meta twin of
+the CRAQ/EC explorers. A seeded schedule of namespace mutations
+(create/mkdirs/remove/rename/symlink/hard-link/truncate/sessions) runs
+against a REAL MetaStore on the conflict-faithful MemKV engine, mirrored
+into a shadow tree; afterwards the store must agree with the shadow
+exactly and satisfy the structural invariants:
+
+  M1 (shadow agreement): walking the store from the root yields exactly
+     the shadow's paths with the right types; stat agrees on type and,
+     for files with settled sessions, length.
+  M2 (no orphans): every inode reachable from the root; the scan-based
+     orphan finder reports nothing except GC-queued removals.
+  M3 (link accounting): hard-linked files report nlink equal to the
+     shadow's link count; removing one name keeps the others readable.
+  M4 (rename safety): directory renames never create cycles (a rename
+     into the subject's own subtree fails atomically).
+  M5 (GC drains): after removals, gc_scan eventually returns every
+     removed file once and gc_finish empties the queue.
+
+The reference covers meta with per-op suites (tests/meta/store/ops/*);
+cross-op randomized scheduling is this framework's addition.
+"""
+
+import random
+
+import pytest
+
+from tpu3fs.kv.mem import MemKVEngine
+from tpu3fs.meta.scan import find_orphan_inodes
+from tpu3fs.meta.store import ChainAllocator, MetaStore, OpenFlags
+from tpu3fs.meta.types import InodeType
+from tpu3fs.utils.result import FsError
+
+
+class MetaExplorer:
+    def __init__(self, seed: int):
+        self.rng = random.Random(seed)
+        self.engine = MemKVEngine()
+        self.store = MetaStore(self.engine, ChainAllocator(1, [1, 2]),
+                               default_chunk_size=4096)
+        # shadow: path -> ("dir" | "file" | "symlink", payload)
+        # files: payload = settled length; symlink: payload = target
+        self.shadow = {"/": ("dir", None)}
+        # file identity for hard links: path -> link-group id
+        self.groups = {}
+        self._next_group = 0
+        self.removed_files = 0  # expected GC entries (files only)
+
+    # -- helpers -------------------------------------------------------------
+    def _dirs(self):
+        return [p for p, (k, _) in self.shadow.items() if k == "dir"]
+
+    def _files(self):
+        return [p for p, (k, _) in self.shadow.items() if k == "file"]
+
+    def _any_path(self):
+        return self.rng.choice(list(self.shadow))
+
+    def _fresh_name(self, parent: str) -> str:
+        base = "" if parent == "/" else parent
+        return f"{base}/n{self.rng.randrange(10_000)}"
+
+    def _in_shadow_subtree(self, p: str, root: str) -> bool:
+        return p == root or p.startswith(root.rstrip("/") + "/")
+
+    # -- actions -------------------------------------------------------------
+    def act_create(self) -> None:
+        parent = self.rng.choice(self._dirs())
+        path = self._fresh_name(parent)
+        if path in self.shadow:
+            return
+        length = self.rng.randrange(0, 10_000)
+        try:
+            res = self.store.create(path, flags=OpenFlags.WRITE,
+                                    client_id="fuzz")
+            self.store.close(res.inode.id, res.session_id,
+                             length_hint=length, wrote=True)
+        except FsError:
+            return
+        self.shadow[path] = ("file", length)
+        self.groups[path] = self._next_group
+        self._next_group += 1
+
+    def act_mkdirs(self) -> None:
+        parent = self.rng.choice(self._dirs())
+        path = self._fresh_name(parent) + f"/d{self.rng.randrange(100)}"
+        if any(self._in_shadow_subtree(p, path) for p in self.shadow):
+            return
+        try:
+            self.store.mkdirs(path, recursive=True)
+        except FsError:
+            return
+        # mkdirs creates intermediate components too
+        parts = path.strip("/").split("/")
+        cur = ""
+        for part in parts:
+            cur += "/" + part
+            if cur not in self.shadow:
+                self.shadow[cur] = ("dir", None)
+
+    def act_symlink(self) -> None:
+        parent = self.rng.choice(self._dirs())
+        path = self._fresh_name(parent)
+        if path in self.shadow:
+            return
+        target = self._any_path()
+        try:
+            self.store.symlink(path, target)
+        except FsError:
+            return
+        self.shadow[path] = ("symlink", target)
+
+    def act_hard_link(self) -> None:
+        files = self._files()
+        if not files:
+            return
+        src = self.rng.choice(files)
+        parent = self.rng.choice(self._dirs())
+        dst = self._fresh_name(parent)
+        if dst in self.shadow:
+            return
+        try:
+            self.store.hard_link(src, dst)
+        except FsError:
+            return
+        self.shadow[dst] = self.shadow[src]
+        self.groups[dst] = self.groups[src]
+
+    def act_remove(self) -> None:
+        candidates = [p for p in self.shadow if p != "/"]
+        if not candidates:
+            return
+        path = self.rng.choice(candidates)
+        kind = self.shadow[path][0]
+        recursive = self.rng.random() < 0.5
+        children = [p for p in self.shadow
+                    if p != path and self._in_shadow_subtree(p, path)]
+        try:
+            self.store.remove(path, recursive=recursive)
+        except FsError:
+            return  # e.g. non-empty dir without recursive — shadow intact
+        doomed = [path] + children
+        for p in doomed:
+            k, _ = self.shadow.pop(p)
+            g = self.groups.pop(p, None)
+            if k == "file" and g is not None:
+                # GC fires only when the LAST name of the group goes
+                if g not in self.groups.values():
+                    self.removed_files += 1
+
+    def act_rename(self) -> None:
+        candidates = [p for p in self.shadow if p != "/"]
+        if not candidates:
+            return
+        src = self.rng.choice(candidates)
+        parent = self.rng.choice(self._dirs())
+        dst = self._fresh_name(parent)
+        if dst in self.shadow:
+            return
+        src_kind = self.shadow[src][0]
+        into_own_subtree = (src_kind == "dir"
+                            and self._in_shadow_subtree(dst, src))
+        try:
+            self.store.rename(src, dst)
+        except FsError:
+            # M4: renames into the subject's own subtree MUST fail
+            return
+        assert not into_own_subtree, (
+            f"M4: rename {src} -> {dst} created a cycle")
+        moved = [(p, self.shadow[p], self.groups.get(p))
+                 for p in list(self.shadow)
+                 if self._in_shadow_subtree(p, src)]
+        for p, _, _ in moved:
+            self.shadow.pop(p)
+            self.groups.pop(p, None)
+        for p, entry, g in moved:
+            newp = dst + p[len(src):]
+            self.shadow[newp] = entry
+            if g is not None:
+                self.groups[newp] = g
+
+    def act_truncate(self) -> None:
+        files = self._files()
+        if not files:
+            return
+        path = self.rng.choice(files)
+        n = self.rng.randrange(0, 8_000)
+        try:
+            self.store.truncate(path, n)
+        except FsError:
+            return
+        g = self.groups[path]
+        for p, grp in self.groups.items():
+            if grp == g:
+                self.shadow[p] = ("file", n)
+
+    # -- schedule + invariants ----------------------------------------------
+    def run(self, steps: int = 120) -> None:
+        actions = [
+            (self.act_create, 26),
+            (self.act_mkdirs, 14),
+            (self.act_symlink, 8),
+            (self.act_hard_link, 8),
+            (self.act_remove, 16),
+            (self.act_rename, 18),
+            (self.act_truncate, 10),
+        ]
+        fns = [fn for fn, w in actions for _ in range(w)]
+        for _ in range(steps):
+            self.rng.choice(fns)()
+        self.check_invariants()
+
+    def check_invariants(self) -> None:
+        # M1: walk the store; compare against the shadow exactly
+        seen = {}
+        stack = ["/"]
+        while stack:
+            d = stack.pop()
+            for ent in self.store.list_dir(d):
+                p = ("" if d == "/" else d) + "/" + ent.name
+                inode = self.store.stat(p, follow=False)
+                kind = {InodeType.DIRECTORY: "dir", InodeType.FILE: "file",
+                        InodeType.SYMLINK: "symlink"}[inode.type]
+                seen[p] = kind
+                if kind == "dir":
+                    stack.append(p)
+        shadow_kinds = {p: k for p, (k, _) in self.shadow.items()
+                        if p != "/"}
+        assert seen == shadow_kinds, (
+            f"M1 divergence:\n extra={set(seen) - set(shadow_kinds)}\n"
+            f" missing={set(shadow_kinds) - set(seen)}\n"
+            f" mismatched={[p for p in seen if p in shadow_kinds and seen[p] != shadow_kinds[p]]}")
+        # M1b: settled lengths agree; M3: nlink equals link-group size
+        from collections import Counter
+
+        group_sizes = Counter(self.groups.values())
+        for p, (k, payload) in self.shadow.items():
+            if k != "file":
+                continue
+            inode = self.store.stat(p)
+            assert inode.length == payload, (
+                f"M1b: {p} length {inode.length} != {payload}")
+            assert inode.nlink == group_sizes[self.groups[p]], (
+                f"M3: {p} nlink {inode.nlink} != "
+                f"{group_sizes[self.groups[p]]}")
+        # M2: no unreachable inodes beyond the GC queue
+        orphans = find_orphan_inodes(self.engine)
+        gc_ids = {i.id for i in self.store.gc_scan(limit=10_000)}
+        bad = [i for i in orphans if i.id not in gc_ids]
+        assert not bad, f"M2: orphaned inodes outside GC: {bad}"
+        # M5: GC returns every fully-removed file then drains
+        assert len(gc_ids) == self.removed_files, (
+            f"M5: gc queue {len(gc_ids)} != removed {self.removed_files}")
+        for iid in gc_ids:
+            self.store.gc_finish(iid)
+        assert not self.store.gc_scan(limit=10)
+        assert not find_orphan_inodes(self.engine)
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_random_meta_schedules(seed):
+    MetaExplorer(seed).run(steps=120)
