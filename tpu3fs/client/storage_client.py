@@ -936,30 +936,65 @@ class StorageClient:
         return total
 
     def query_last_chunk(self, chain_id: int, file_id: int) -> Tuple[int, int]:
-        chain = self._chain(chain_id)
-        if chain.is_ec:
-            # each target holds a different shard: the precise length is the
-            # max of all targets' (index, shard-position contribution) pairs
-            best = (-1, 0)
-            for t in chain.targets:
-                if t.public_state != PublicTargetState.SERVING:
-                    continue
-                node = self._routing().node_of_target(t.target_id)
-                if node is None:
-                    continue
-                try:
-                    got = self._messenger(
-                        node.node_id, "query_last_chunk", (chain_id, file_id))
-                except FsError:
-                    continue
-                if got[0] > best[0] or (got[0] == best[0] and got[1] > best[1]):
-                    best = tuple(got)
-            return best
-        for t in chain.targets[::-1]:  # prefer tail: committed state
-            if t.public_state != PublicTargetState.SERVING:
-                continue
-            node = self._routing().node_of_target(t.target_id)
-            if node is None:
-                continue
-            return self._messenger(node.node_id, "query_last_chunk", (chain_id, file_id))
-        return -1, 0
+        """Last (chunk index, byte length) of a file on one chain — the
+        length-settlement primitive. The POLICY throughout: unavailability
+        must surface as an ERROR, never as (-1, 0) — a caller settling a
+        close would write a silently-truncated length into the inode. An
+        EMPTY chain is only ever reported as (-1, 0) by a replica that
+        actually answered. Retry ladder with per-replica failover covers
+        the just-killed-but-still-SERVING heartbeat window and transient
+        no-serving windows during failover."""
+        last_err: Optional[FsError] = None
+        for attempt in range(self._retry.max_retries + 1):
+            chain = self._chain(chain_id)
+            if chain.is_ec:
+                # each target holds a different shard: the precise length
+                # is the max over ALL serving targets' contributions — a
+                # partial sweep could under-report the tail shard, so any
+                # per-target failure fails the whole attempt
+                best = (-1, 0)
+                failed: Optional[FsError] = None
+                for t in chain.targets:
+                    if t.public_state != PublicTargetState.SERVING:
+                        continue
+                    node = self._routing().node_of_target(t.target_id)
+                    if node is None:
+                        continue
+                    try:
+                        got = self._messenger(
+                            node.node_id, "query_last_chunk",
+                            (chain_id, file_id))
+                    except FsError as e:
+                        failed = e
+                        continue
+                    if got[0] > best[0] or (
+                            got[0] == best[0] and got[1] > best[1]):
+                        best = tuple(got)
+                if failed is None:
+                    return best
+                last_err = failed
+            else:
+                answered = False
+                for t in chain.targets[::-1]:  # prefer tail: committed
+                    if t.public_state != PublicTargetState.SERVING:
+                        continue
+                    node = self._routing().node_of_target(t.target_id)
+                    if node is None:
+                        continue
+                    try:
+                        return self._messenger(
+                            node.node_id, "query_last_chunk",
+                            (chain_id, file_id))
+                    except FsError as e:
+                        last_err = e
+                        answered = True
+                        continue
+                if not answered and last_err is None:
+                    # zero serving replicas right now (failover window):
+                    # that means UNAVAILABLE, not empty — retry then raise
+                    last_err = FsError(Status(
+                        Code.TARGET_OFFLINE,
+                        f"no serving replica on chain {chain_id}"))
+            if attempt < self._retry.max_retries:
+                self._sleep(attempt)
+        raise last_err
