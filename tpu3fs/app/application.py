@@ -243,11 +243,18 @@ class TwoPhaseApplication(ApplicationBase):
             self.heartbeat_timeout_s = float(self.flag("heartbeat_timeout"))
 
     def _mgmtd_addr(self):
+        """--mgmtd host:port[,host:port...] — multiple addresses form the
+        client-side failover list (ref MgmtdClient's server list): a dead
+        primary's lease expires and a standby takes over, so servers keep
+        heartbeating/routing through whichever mgmtd answers."""
         spec = self.flag("mgmtd")
         if not spec:
-            raise SystemExit("--mgmtd host:port is required")
-        host, port = spec.rsplit(":", 1)
-        return host, int(port)
+            raise SystemExit("--mgmtd host:port[,host:port...] is required")
+        addrs = []
+        for part in spec.split(","):
+            host, port = part.strip().rsplit(":", 1)
+            addrs.append((host, int(port)))
+        return addrs  # always a list; MgmtdRpcClient takes either shape
 
     def launcher_phase(self) -> None:
         from tpu3fs.rpc.services import MgmtdAdminRpcClient

@@ -954,11 +954,17 @@ class StorageClient:
                 # per-target failure fails the whole attempt
                 best = (-1, 0)
                 failed: Optional[FsError] = None
+                queried = 0
                 for t in chain.targets:
                     if t.public_state != PublicTargetState.SERVING:
                         continue
                     node = self._routing().node_of_target(t.target_id)
                     if node is None:
+                        # SERVING but unroutable counts as a failure: a
+                        # partial sweep could under-report the tail shard
+                        failed = failed or FsError(Status(
+                            Code.TARGET_OFFLINE,
+                            f"no route to target {t.target_id}"))
                         continue
                     try:
                         got = self._messenger(
@@ -967,12 +973,16 @@ class StorageClient:
                     except FsError as e:
                         failed = e
                         continue
+                    queried += 1
                     if got[0] > best[0] or (
                             got[0] == best[0] and got[1] > best[1]):
                         best = tuple(got)
-                if failed is None:
+                if failed is None and queried > 0:
                     return best
-                last_err = failed
+                # zero targets answered, or a partial sweep: UNAVAILABLE
+                last_err = failed or FsError(Status(
+                    Code.TARGET_OFFLINE,
+                    f"no serving shard target on chain {chain_id}"))
             else:
                 answered = False
                 for t in chain.targets[::-1]:  # prefer tail: committed

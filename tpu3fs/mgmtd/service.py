@@ -105,6 +105,9 @@ class Mgmtd:
         self._configs: Dict[NodeType, ConfigBlob] = {}
         # heartbeat-touched targets awaiting the TargetInfoPersister runner
         self._dirty_targets: set = set()
+        # primacy edge detection for tick(): a standby reloads from KV on
+        # promotion before running any background mutator
+        self._was_primary = False
         self._load()
 
     # -- persistence -------------------------------------------------------
@@ -179,7 +182,28 @@ class Mgmtd:
             txn.set(_LEASE_KEY, serialize(lease))
             return lease
 
-        return with_transaction(self._engine, op)
+        lease = with_transaction(self._engine, op)
+        # primacy is CONFIRMED here (tests and apps may call extend_lease
+        # outside tick); tick() reads the previous value before calling us
+        # to detect the standby->primary edge
+        self._was_primary = lease.primary_node_id == self.node_id
+        return lease
+
+    def _ensure_holder_in_txn(self, txn: ITransaction) -> None:
+        """Reject when ANOTHER node holds the lease (expiry ignored):
+        the guard for heartbeat/registration traffic. Accepting these on a
+        node whose own lease merely expired is harmless — no other primary
+        exists to diverge from, and the strict mutators still re-validate
+        expiry — while rejecting them would break quiet clusters between
+        lease extensions. The case that matters (a client pinned to a
+        STANDBY while a live primary declares its nodes dead) is exactly
+        `primary_node_id != self.node_id`, which this refuses."""
+        raw = txn.get(_LEASE_KEY)
+        lease = deserialize(raw, LeaseInfo) if raw else LeaseInfo()
+        if lease.primary_node_id not in (0, self.node_id):
+            raise FsError(Status(
+                Code.MGMTD_NOT_PRIMARY,
+                f"primary={lease.primary_node_id}"))
 
     def current_lease(self) -> LeaseInfo:
         def op(txn: ITransaction) -> LeaseInfo:
@@ -281,6 +305,7 @@ class Mgmtd:
         self, node_id: int, node_type: NodeType, host: str = "", port: int = 0
     ) -> None:
         def op(txn: ITransaction):
+            self._ensure_holder_in_txn(txn)
             info = NodeInfo(
                 node_id, node_type, NodeStatus.HEARTBEAT_CONNECTING, host, port
             )
@@ -317,6 +342,12 @@ class Mgmtd:
             )
 
         def op(txn: ITransaction) -> None:
+            # a STANDBY must refuse heartbeats with MGMTD_NOT_PRIMARY so
+            # the multi-address client rotates to the primary — otherwise
+            # a client pinned to the standby looks alive HERE while the
+            # primary (which never sees the heartbeats) declares the node
+            # dead and rotates its targets out
+            self._ensure_holder_in_txn(txn)
             node.heartbeat_version = hb_version
             node.last_heartbeat = now
             node.status = NodeStatus.HEARTBEAT_CONNECTED
@@ -448,9 +479,28 @@ class Mgmtd:
         src/mgmtd/background/): lease extension, heartbeat checking, chain
         updates, newborn-chain promotion, target-info persistence, metrics."""
         now = self._clock() if now is None else now
-        lease = self.extend_lease(now)
+        was_primary = self._was_primary
+        lease = self.extend_lease(now)  # updates _was_primary
         if lease.primary_node_id != self.node_id:
+            # STANDBY: reload cluster state from the shared KV every tick.
+            # Serving routing from (or, worse, later acting on) the
+            # boot-time snapshot would hand out an empty/stale cluster —
+            # and a freshly-promoted primary running check_heartbeats/
+            # update_chains on stale state could clobber the real one.
+            try:
+                self._load()
+            except FsError:
+                pass  # KV hiccup: keep the last snapshot, retry next tick
             return
+        if not was_primary:
+            # primacy TRANSITION: act only on freshly-loaded state; a
+            # failed load must NOT leave _was_primary set or the next
+            # tick would mutate cluster state from the stale snapshot
+            try:
+                self._load()
+            except FsError:
+                self._was_primary = False
+                return
         self.check_heartbeats(now)
         self.update_chains(now)
         self.check_newborn_chains()

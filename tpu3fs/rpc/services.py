@@ -425,19 +425,57 @@ def bind_mgmtd_service(server: RpcServer, mgmtd: Mgmtd) -> ServiceDef:
 
 class MgmtdRpcClient:
     """Routing-info poller + heartbeat sender over RPC (ref MgmtdClient's
-    ForClient/ForServer split: this class serves both roles)."""
+    ForClient/ForServer split: this class serves both roles).
 
-    def __init__(self, addr: Tuple[str, int], client: Optional[RpcClient] = None):
-        self._addr = addr
+    Accepts ONE address or a LIST of mgmtd addresses (ref MgmtdClient's
+    server list): calls stick to the last-good server and fail over on
+    transport errors or MGMTD_NOT_PRIMARY — a dead primary's lease
+    expires and a standby's tick acquires it, so rotating through the
+    list finds the new primary."""
+
+    # codes that mean "try the next mgmtd in the list"
+    _FAILOVER_CODES = (
+        Code.RPC_CONNECT_FAILED, Code.RPC_PEER_CLOSED, Code.RPC_TIMEOUT,
+        Code.RPC_SEND_FAILED, Code.MGMTD_NOT_PRIMARY,
+    )
+
+    def __init__(self, addr, client: Optional[RpcClient] = None):
+        if isinstance(addr, tuple) and len(addr) == 2 \
+                and not isinstance(addr[0], tuple):
+            addrs = [addr]
+        else:
+            addrs = [tuple(a) for a in addr]
+        if not addrs:
+            raise ValueError("need at least one mgmtd address")
+        self._addrs = addrs
+        self._cursor = 0
         self._client = client or RpcClient()
         self._routing: Optional[RoutingInfo] = None
 
+    @property
+    def _addr(self):  # sticky current server (back-compat accessor)
+        return self._addrs[self._cursor % len(self._addrs)]
+
+    def _call(self, method_id: int, req, rsp_type):
+        last: Optional[FsError] = None
+        for i in range(len(self._addrs)):
+            addr = self._addrs[(self._cursor + i) % len(self._addrs)]
+            try:
+                out = self._client.call(addr, MGMTD_SERVICE_ID, method_id,
+                                        req, rsp_type)
+            except FsError as e:
+                if e.code in self._FAILOVER_CODES:
+                    last = e
+                    continue
+                raise
+            self._cursor = (self._cursor + i) % len(self._addrs)
+            return out
+        raise last  # every server refused/unreachable
+
     def register_node(self, node_id: int, node_type: NodeType,
                       host: str = "", port: int = 0) -> None:
-        self._client.call(
-            self._addr, MGMTD_SERVICE_ID, 3,
-            RegisterNodeReq(node_id, int(node_type), host, port), Empty,
-        )
+        self._call(3, RegisterNodeReq(node_id, int(node_type), host, port),
+                   Empty)
 
     def heartbeat(
         self, node_id: int, hb_version: int,
@@ -447,13 +485,11 @@ class MgmtdRpcClient:
             node_id, hb_version,
             {t: int(v) for t, v in (local_states or {}).items()},
         )
-        return self._client.call(self._addr, MGMTD_SERVICE_ID, 1, req, HeartbeatReply)
+        return self._call(1, req, HeartbeatReply)
 
     def refresh_routing(self) -> RoutingInfo:
         known = self._routing.version if self._routing else -1
-        rsp = self._client.call(
-            self._addr, MGMTD_SERVICE_ID, 2, RoutingReq(known), RoutingRsp
-        )
+        rsp = self._call(2, RoutingReq(known), RoutingRsp)
         if rsp.changed and rsp.routing is not None:
             self._routing = rsp.routing
         assert self._routing is not None
@@ -1112,36 +1148,32 @@ class MgmtdAdminRpcClient(MgmtdRpcClient):
 
     def create_target(self, target_id: int, node_id: int = 0,
                       disk_index: int = 0) -> None:
-        self._client.call(self._addr, MGMTD_SERVICE_ID, 4,
-                          CreateTargetReq(target_id, node_id, disk_index), Empty)
+        self._call(4, CreateTargetReq(target_id, node_id, disk_index),
+                   Empty)
 
     def upload_chain(self, chain_id: int, target_ids: List[int],
                      *, ec_k: int = 0, ec_m: int = 0) -> None:
-        self._client.call(
-            self._addr, MGMTD_SERVICE_ID, 5,
+        self._call(
+            5,
             UploadChainReq(chain_id, list(target_ids), ec_k=ec_k, ec_m=ec_m),
             Empty)
 
     def upload_chain_table(self, table_id: int, chain_ids: List[int]) -> None:
-        self._client.call(self._addr, MGMTD_SERVICE_ID, 6,
-                          UploadChainTableReq(table_id, list(chain_ids)), Empty)
+        self._call(6, UploadChainTableReq(table_id, list(chain_ids)),
+                   Empty)
 
     def set_config(self, node_type: NodeType, content: str) -> int:
-        return self._client.call(self._addr, MGMTD_SERVICE_ID, 7,
-                                 SetConfigReq(int(node_type), content),
-                                 IntReply).value
+        return self._call(7, SetConfigReq(int(node_type), content),
+                          IntReply).value
 
     def get_config(self, node_type: NodeType):
-        return self._client.call(self._addr, MGMTD_SERVICE_ID, 8,
-                                 GetConfigReq(int(node_type)), ConfigRsp)
+        return self._call(8, GetConfigReq(int(node_type)), ConfigRsp)
 
     def tick(self) -> int:
-        return self._client.call(self._addr, MGMTD_SERVICE_ID, 9, Empty(),
-                                 IntReply).value
+        return self._call(9, Empty(), IntReply).value
 
     def get_routing_info(self, known_version: int = -1):
         if known_version >= 0:
-            rsp = self._client.call(self._addr, MGMTD_SERVICE_ID, 2,
-                                    RoutingReq(known_version), RoutingRsp)
+            rsp = self._call(2, RoutingReq(known_version), RoutingRsp)
             return rsp.routing if rsp.changed else None
         return self.refresh_routing()
