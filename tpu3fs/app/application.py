@@ -252,8 +252,17 @@ class TwoPhaseApplication(ApplicationBase):
             raise SystemExit("--mgmtd host:port[,host:port...] is required")
         addrs = []
         for part in spec.split(","):
-            host, port = part.strip().rsplit(":", 1)
-            addrs.append((host, int(port)))
+            part = part.strip()
+            if not part:
+                continue  # tolerate trailing/duplicate commas
+            try:
+                host, port = part.rsplit(":", 1)
+                addrs.append((host, int(port)))
+            except ValueError:
+                raise SystemExit(
+                    f"bad --mgmtd entry {part!r}: want host:port")
+        if not addrs:
+            raise SystemExit("--mgmtd host:port[,host:port...] is required")
         return addrs  # always a list; MgmtdRpcClient takes either shape
 
     def launcher_phase(self) -> None:
@@ -321,6 +330,15 @@ class TwoPhaseApplication(ApplicationBase):
             return True
         except Exception as e:
             xlog("WARN", "node %d heartbeat failed: %r", self.info.node_id, e)
+            # a reachable mgmtd that refuses (e.g. standby during the dead
+            # primary's residual lease) still proves the cluster is there:
+            # count a successful routing read as contact so T/2 suicide
+            # only fires when the mgmtd FLEET is gone, not mid-failover
+            try:
+                self.mgmtd_client.refresh_routing()
+                self._last_mgmtd_contact = time.time()
+            except Exception:
+                pass
             return False
 
     def _heartbeat_loop(self) -> None:

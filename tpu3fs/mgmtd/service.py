@@ -330,24 +330,26 @@ class Mgmtd:
         """Versioned heartbeat; stale versions rejected
         (ref HeartbeatOperation.cc:36-134)."""
         now = self._clock() if now is None else now
-        node = self._routing.nodes.get(node_id)
-        if node is None:
-            raise FsError(Status(Code.MGMTD_NODE_NOT_FOUND, str(node_id)))
-        if hb_version < node.heartbeat_version:
-            raise FsError(
-                Status(
-                    Code.MGMTD_STALE_HEARTBEAT,
-                    f"{hb_version} < {node.heartbeat_version}",
-                )
-            )
 
         def op(txn: ITransaction) -> None:
-            # a STANDBY must refuse heartbeats with MGMTD_NOT_PRIMARY so
-            # the multi-address client rotates to the primary — otherwise
-            # a client pinned to the standby looks alive HERE while the
-            # primary (which never sees the heartbeats) declares the node
-            # dead and rotates its targets out
+            # the holder guard runs FIRST: a standby's stale snapshot must
+            # answer MGMTD_NOT_PRIMARY (which the multi-address client
+            # fails over on), never MGMTD_NODE_NOT_FOUND judged from a
+            # lagging view — otherwise a client pinned to the standby
+            # looks alive HERE while the primary (which never sees the
+            # heartbeats) declares the node dead and rotates its targets
             self._ensure_holder_in_txn(txn)
+            node = self._routing.nodes.get(node_id)
+            if node is None:
+                raise FsError(
+                    Status(Code.MGMTD_NODE_NOT_FOUND, str(node_id)))
+            if hb_version < node.heartbeat_version:
+                raise FsError(
+                    Status(
+                        Code.MGMTD_STALE_HEARTBEAT,
+                        f"{hb_version} < {node.heartbeat_version}",
+                    )
+                )
             node.heartbeat_version = hb_version
             node.last_heartbeat = now
             node.status = NodeStatus.HEARTBEAT_CONNECTED
@@ -368,6 +370,7 @@ class Mgmtd:
                     for t in chain.targets:
                         if t.target_id == target_id:
                             t.local_state = ls
+        node = self._routing.nodes[node_id]  # present: op validated it
         blob = self._configs.get(node.type, ConfigBlob())
         return HeartbeatReply(
             routing_version=self._routing.version,
@@ -501,6 +504,13 @@ class Mgmtd:
             except FsError:
                 self._was_primary = False
                 return
+            # HEARTBEAT GRACE: the loaded last_heartbeat stamps are from
+            # the old primary's reign — up to a full residual lease old.
+            # Judging them now would declare every surviving node dead in
+            # one sweep. Promotion starts a fresh heartbeat epoch; nodes
+            # get a full timeout to re-report before being judged.
+            for node in self._routing.nodes.values():
+                node.last_heartbeat = max(node.last_heartbeat, now)
         self.check_heartbeats(now)
         self.update_chains(now)
         self.check_newborn_chains()

@@ -440,13 +440,15 @@ class MgmtdRpcClient:
     )
 
     def __init__(self, addr, client: Optional[RpcClient] = None):
-        if isinstance(addr, tuple) and len(addr) == 2 \
-                and not isinstance(addr[0], tuple):
-            addrs = [addr]
+        if (isinstance(addr, (tuple, list)) and len(addr) == 2
+                and isinstance(addr[0], str)):
+            addrs = [(addr[0], int(addr[1]))]
         else:
-            addrs = [tuple(a) for a in addr]
-        if not addrs:
-            raise ValueError("need at least one mgmtd address")
+            addrs = [(a[0], int(a[1])) for a in addr]
+        if not addrs or not all(
+                isinstance(h, str) and isinstance(p, int)
+                for h, p in addrs):
+            raise ValueError(f"bad mgmtd address list: {addr!r}")
         self._addrs = addrs
         self._cursor = 0
         self._client = client or RpcClient()
@@ -491,7 +493,12 @@ class MgmtdRpcClient:
         known = self._routing.version if self._routing else -1
         rsp = self._call(2, RoutingReq(known), RoutingRsp)
         if rsp.changed and rsp.routing is not None:
-            self._routing = rsp.routing
+            # MONOTONIC install only: after a failover rotation a lagging
+            # standby may answer with an OLDER snapshot — installing it
+            # would resurrect targets the primary already rotated out
+            if self._routing is None or \
+                    rsp.routing.version > self._routing.version:
+                self._routing = rsp.routing
         assert self._routing is not None
         return self._routing
 
