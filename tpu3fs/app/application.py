@@ -237,6 +237,7 @@ class TwoPhaseApplication(ApplicationBase):
         self._hb_version = 0
         self._config_version = 0
         self._last_mgmtd_contact = time.time()
+        self._hb_fail_start = None
         if self.flag("heartbeat_interval"):
             self.heartbeat_interval_s = float(self.flag("heartbeat_interval"))
         if self.flag("heartbeat_timeout"):
@@ -326,19 +327,34 @@ class TwoPhaseApplication(ApplicationBase):
                 self.local_target_states(),
             )
             self._last_mgmtd_contact = time.time()
+            self._hb_fail_start = None
             self._apply_config_push(reply.config_version, reply.config_content)
             return True
         except Exception as e:
             xlog("WARN", "node %d heartbeat failed: %r", self.info.node_id, e)
             # a reachable mgmtd that refuses (e.g. standby during the dead
-            # primary's residual lease) still proves the cluster is there:
+            # primary's residual lease) still proves the FLEET is there:
             # count a successful routing read as contact so T/2 suicide
-            # only fires when the mgmtd FLEET is gone, not mid-failover
-            try:
-                self.mgmtd_client.refresh_routing()
-                self._last_mgmtd_contact = time.time()
-            except Exception:
-                pass
+            # doesn't kill a healthy cluster mid-failover. BOUNDED: a
+            # routing read cannot tell 'no primary exists yet' (safe)
+            # from 'a live primary I cannot reach' (asymmetric partition
+            # — unsafe to keep serving), so the credit only extends the
+            # silence budget to ~T total. Past that, a node that cannot
+            # HEARTBEAT anywhere exits even though routing reads work —
+            # closing the split-brain window roughly when the primary
+            # declares it dead. Co-tune lease_length_s <= T/2 so real
+            # failovers finish inside the credit.
+            now = time.time()
+            if self._hb_fail_start is None:
+                self._hb_fail_start = now
+            within_credit = (now - self._hb_fail_start
+                            < self.heartbeat_timeout_s / 2)
+            if within_credit:
+                try:
+                    self.mgmtd_client.refresh_routing()
+                    self._last_mgmtd_contact = now
+                except Exception:
+                    pass
             return False
 
     def _heartbeat_loop(self) -> None:

@@ -331,7 +331,7 @@ class Mgmtd:
         (ref HeartbeatOperation.cc:36-134)."""
         now = self._clock() if now is None else now
 
-        def op(txn: ITransaction) -> None:
+        def op(txn: ITransaction) -> NodeInfo:
             # the holder guard runs FIRST: a standby's stale snapshot must
             # answer MGMTD_NOT_PRIMARY (which the multi-address client
             # fails over on), never MGMTD_NODE_NOT_FOUND judged from a
@@ -354,8 +354,11 @@ class Mgmtd:
             node.last_heartbeat = now
             node.status = NodeStatus.HEARTBEAT_CONNECTED
             txn.set(_node_key(node_id), serialize(node))
+            return node
 
-        with_transaction(self._engine, op)
+        # the node the TRANSACTION validated, not a re-lookup: a racing
+        # standby-tick _load() may swap self._routing in between
+        node = with_transaction(self._engine, op)
         if local_states:
             for target_id, ls in local_states.items():
                 info = self._routing.targets.get(target_id)
@@ -370,7 +373,6 @@ class Mgmtd:
                     for t in chain.targets:
                         if t.target_id == target_id:
                             t.local_state = ls
-        node = self._routing.nodes[node_id]  # present: op validated it
         blob = self._configs.get(node.type, ConfigBlob())
         return HeartbeatReply(
             routing_version=self._routing.version,

@@ -440,14 +440,17 @@ class MgmtdRpcClient:
     )
 
     def __init__(self, addr, client: Optional[RpcClient] = None):
-        if (isinstance(addr, (tuple, list)) and len(addr) == 2
-                and isinstance(addr[0], str)):
-            addrs = [(addr[0], int(addr[1]))]
-        else:
-            addrs = [(a[0], int(a[1])) for a in addr]
-        if not addrs or not all(
-                isinstance(h, str) and isinstance(p, int)
-                for h, p in addrs):
+        try:
+            if (isinstance(addr, (tuple, list)) and len(addr) == 2
+                    and isinstance(addr[0], str)):
+                addrs = [(addr[0], int(addr[1]))]
+            else:
+                addrs = [(a[0], int(a[1])) for a in addr]
+            ok = bool(addrs) and all(isinstance(h, str) for h, _ in addrs)
+        except (TypeError, ValueError, IndexError):
+            ok = False
+            addrs = []
+        if not ok:
             raise ValueError(f"bad mgmtd address list: {addr!r}")
         self._addrs = addrs
         self._cursor = 0

@@ -425,6 +425,7 @@ class NativeChunkEngine(ChunkEngine):
             return []
         c_ops = (_CReadOp * n)()
         total = 0
+        offs = []
         for i, (chunk_id, offset, length) in enumerate(items):
             c = c_ops[i]
             ctypes.memmove(c.key, chunk_id.to_bytes(), _KEYLEN)
@@ -432,6 +433,7 @@ class NativeChunkEngine(ChunkEngine):
             c.offset = offset
             c.length = length
             c.slot_len = cap if length < 0 else min(length, cap)
+            offs.append(total)
             total += c.slot_len
         buf = self._scratch(total)
         res = (_COpResult * n)()
@@ -451,7 +453,6 @@ class NativeChunkEngine(ChunkEngine):
         # would alias the next batch (the E_RANGE corruption class) —
         # rejected deliberately; real deployments are NVMe-bound anyway.
         mv = memoryview(buf)
-        offs = [c_ops[i].out_off for i in range(n)]
         out = []
         refetch = []
         for i in range(n):
