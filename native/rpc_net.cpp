@@ -403,6 +403,26 @@ struct FpReq {
   int64_t target_id;
 };
 
+// decode ONE ReadReq at pos (shared by the batch and single forms: a
+// wire-format change lands in exactly one place)
+bool fp_decode_one(const uint8_t* d, size_t len, size_t& pos, FpReq& r) {
+  uint64_t rf;
+  if (!get_uvarint(d, len, pos, rf) || rf != 6) return false;
+  int64_t tmp;
+  if (!get_int(d, len, pos, r.chain_id)) return false;
+  uint64_t cidf;
+  if (!get_uvarint(d, len, pos, cidf) || cidf != 2) return false;
+  if (!get_int(d, len, pos, tmp)) return false;
+  r.file_id = uint64_t(tmp);
+  if (!get_int(d, len, pos, tmp)) return false;
+  r.index = uint32_t(tmp);
+  if (!get_int(d, len, pos, r.offset)) return false;
+  if (!get_int(d, len, pos, r.length)) return false;
+  if (!get_int(d, len, pos, r.target_id)) return false;
+  if (!get_int(d, len, pos, tmp)) return false;  // chunk_size (unused)
+  return true;
+}
+
 // decode BatchReadReq{reqs: List[ReadReq]}; false => fall back to Python
 bool fp_decode_req(const uint8_t* d, size_t len, std::vector<FpReq>& out) {
   size_t pos = 0;
@@ -411,23 +431,17 @@ bool fp_decode_req(const uint8_t* d, size_t len, std::vector<FpReq>& out) {
   if (!get_uvarint(d, len, pos, count) || count > 65536) return false;
   out.reserve(count);
   for (uint64_t i = 0; i < count; i++) {
-    uint64_t rf;
-    if (!get_uvarint(d, len, pos, rf) || rf != 6) return false;
     FpReq r;
-    int64_t tmp;
-    if (!get_int(d, len, pos, r.chain_id)) return false;
-    uint64_t cidf;
-    if (!get_uvarint(d, len, pos, cidf) || cidf != 2) return false;
-    if (!get_int(d, len, pos, tmp)) return false;
-    r.file_id = uint64_t(tmp);
-    if (!get_int(d, len, pos, tmp)) return false;
-    r.index = uint32_t(tmp);
-    if (!get_int(d, len, pos, r.offset)) return false;
-    if (!get_int(d, len, pos, r.length)) return false;
-    if (!get_int(d, len, pos, r.target_id)) return false;
-    if (!get_int(d, len, pos, tmp)) return false;  // chunk_size (unused)
+    if (!fp_decode_one(d, len, pos, r)) return false;
     out.push_back(r);
   }
+  return pos == len;
+}
+
+// decode one bare ReadReq (method 3); false => fall back to Python
+bool fp_decode_single(const uint8_t* d, size_t len, FpReq& r) {
+  size_t pos = 0;
+  if (!fp_decode_one(d, len, pos, r)) return false;
   return pos == len;
 }
 
@@ -450,12 +464,22 @@ void fp_put_reply(std::string& buf, int64_t code, uint64_t data_len,
   put_int(buf, int64_t(aux));
 }
 
-// true when handled (reply fields filled); false => fall back to Python
+// true when handled (reply fields filled); false => fall back to Python.
+// `single` = method 3 (one bare ReadReq in, one bare ReadReply out);
+// otherwise method 11 (BatchReadReq/BatchReadRsp).
 bool fp_try_batch_read(FpState& fp, const Packet& req, std::string& payload,
-                       std::string& bulk_out, bool& reply_bulk) {
+                       std::string& bulk_out, bool& reply_bulk,
+                       bool single = false) {
   std::vector<FpReq> ops;
   const uint8_t* d = reinterpret_cast<const uint8_t*>(req.payload.data());
-  if (!fp_decode_req(d, req.payload.size(), ops)) return false;
+  if (single) {
+    FpReq r;
+    if (!fp_decode_single(d, req.payload.size(), r)) return false;
+    if (r.target_id == 0) return false;  // selection belongs to Python
+    ops.push_back(r);
+  } else if (!fp_decode_req(d, req.payload.size(), ops)) {
+    return false;
+  }
   if (ops.empty()) return false;
   // resolve every op against the registry under one lock snapshot; the
   // inflight count is taken under the same lock so deregistration can
@@ -547,11 +571,14 @@ bool fp_try_batch_read(FpState& fp, const Packet& req, std::string& payload,
     }
     bufs.push_back(std::move(buf));
   }
-  // encode BatchReadRsp{replies}; data inline or as a bulk section
+  // encode BatchReadRsp{replies} (or one bare ReadReply when single);
+  // data inline or as a bulk section
   reply_bulk = req.has_bulk;
   payload.clear();
-  put_uvarint(payload, 1);
-  put_uvarint(payload, ops.size());
+  if (!single) {
+    put_uvarint(payload, 1);
+    put_uvarint(payload, ops.size());
+  }
   std::string bulk_hdr;
   uint64_t bulk_data = 0;
   if (reply_bulk) put_uvarint(bulk_hdr, ops.size());
@@ -589,6 +616,7 @@ bool fp_try_batch_read(FpState& fp, const Packet& req, std::string& payload,
 
 constexpr int64_t kStorageServiceId = 3;
 constexpr int64_t kBatchReadMethodId = 11;
+constexpr int64_t kReadMethodId = 3;
 
 // ---- server ---------------------------------------------------------------
 // handler v2: returns status; on success fills *rsp (malloc'd) + *rsp_len;
@@ -680,16 +708,20 @@ void worker_main(Server* s) {
     rsp.flags = 0;
     memcpy(rsp.ts, req.ts, sizeof(req.ts));
     rsp.ts[4] = mono_now();  // server_run_start
-    // native read fast path: batchRead against registered native-engine
-    // targets never enters Python; anything ambiguous falls through
+    // native read fast path: batchRead AND single read against
+    // registered native-engine targets never enter Python (so neither do
+    // Python-side read metrics / fault-injection points for those ops);
+    // anything ambiguous falls through
     if (req.service_id == kStorageServiceId &&
-        req.method_id == kBatchReadMethodId) {
+        (req.method_id == kBatchReadMethodId ||
+         req.method_id == kReadMethodId)) {
       std::string fp_payload, fp_bulk;
       bool fp_reply_bulk = false;
       bool handled = false;
       try {
         handled = fp_try_batch_read(s->fastpath, req, fp_payload, fp_bulk,
-                                    fp_reply_bulk);
+                                    fp_reply_bulk,
+                                    req.method_id == kReadMethodId);
       } catch (...) {
         // allocation or engine failure must fall back, never kill the
         // worker thread (InflightGuard unwinds the in-flight count)

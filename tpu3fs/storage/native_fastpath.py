@@ -1,8 +1,8 @@
 """Wire the native transport's storage read fast path to a service.
 
 The C++ transport (native/rpc_net.cpp) can serve StorageSerde.batchRead
-end to end — decode, chunk-engine read, encode, writev — without ever
-entering Python, IF it knows which targets are native-engined and
+and single target-addressed reads end to end — decode, chunk-engine
+read, encode, writev — without ever entering Python, IF it knows which targets are native-engined and
 currently readable. This module maintains that registry from the Python
 side, where the authoritative state (routing snapshots, local target
 states) lives.
