@@ -16,6 +16,14 @@ Invariants:
      simultaneously, every acked stripe still reads back correctly.
   E4 (length precision): short stripes read back at their exact logical
      length, through rebuilds.
+
+Mutation-tested: re-introducing single-phase installs is caught at seed
+0 (wedged chain), and constant writer nonces at seed 9 (mixed-stripe
+fabrication). Disabling the rebuilder's max_safe_ver rollback guard is
+NOT caught by these schedules — by design it protects a beyond-budget
+corner (an acked version losing its entire k-quorum to >m concurrent
+losses) that the explorer's kill policy deliberately excludes; the guard
+is defense-in-depth past the modeled envelope.
 """
 
 import random
