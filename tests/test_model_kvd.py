@@ -32,9 +32,11 @@ class KvdExplorer:
         self.rng = random.Random(seed)
         self.group = Group(tmp_path)
         self.eng = self.group.client()
-        # oracle: key -> set of POSSIBLE current values (singleton when
-        # the ack was unambiguous; two entries when a commit's outcome was
-        # unknown — KV_MAYBE_COMMITTED)
+        # oracle: key -> set of POSSIBLE current values. Singleton after
+        # an unambiguous ack or an observing read; a FAILED mutation adds
+        # its candidate outcomes (any raise may follow a landed commit —
+        # with_transaction retries maybe-committed — so swaps contribute
+        # up to retry-budget stacked applications)
         self.model = {}
         self.keys = [f"k{i}".encode() for i in range(8)]
 
@@ -52,11 +54,15 @@ class KvdExplorer:
         prev = self.model.get(key, {None})
         try:
             self._txn(put)
-        except FsError as e:
-            if e.code == Code.KV_MAYBE_COMMITTED:
-                self.model[key] = prev | {val}
-            return
         except Exception:
+            # ANY failure of a mutating transaction is ambiguous, not just
+            # an explicit KV_MAYBE_COMMITTED: with_transaction retries
+            # maybe-committed outcomes (FDB's commit_unknown_result
+            # semantics), so a commit can LAND on attempt 1 and the call
+            # still raise when the retry hits a clean transport error —
+            # the soak caught exactly this (value present that the oracle
+            # had recorded as failed)
+            self.model[key] = prev | {val}
             return
         self.model[key] = {val}
 
@@ -91,12 +97,21 @@ class KvdExplorer:
         prev = self.model.get(key, {None})
         try:
             nxt = self._txn(swap)
-        except FsError as e:
-            if e.code == Code.KV_MAYBE_COMMITTED:
-                pv = next(iter(prev))
-                self.model[key] = prev | {((pv or b"") + suffix)[-64:]}
-            return
         except Exception:
+            # ambiguous (see act_put) — and the retry-after-maybe-
+            # committed can even APPLY TWICE for a read-modify-write
+            # (FDB's documented hazard for non-idempotent transactions),
+            # so both one and two suffix applications are possible
+            # with_transaction retries maybe-committed up to its retry
+            # budget, and EVERY retried attempt may have landed: model up
+            # to max_retries+1 stacked applications, not just two
+            cands = set(prev)
+            frontier = set(prev)
+            for _ in range(12):  # > kv retry budget
+                frontier = {((pv or b"") + suffix)[-64:]
+                            for pv in frontier}
+                cands |= frontier
+            self.model[key] = cands
             return
         self.model[key] = {nxt}
 
