@@ -432,6 +432,8 @@ class ReplicatedKvService:
 
     def _run_election(self) -> None:
         with self._mu:
+            if self._stopped:
+                return  # a zombie candidate must not bump/persist terms
             self.role = CANDIDATE
             self.term += 1
             self.voted_for = self.node_id
@@ -468,6 +470,8 @@ class ReplicatedKvService:
             self._broadcast_heartbeat()
 
     def _broadcast_heartbeat(self) -> None:
+        if self._stopped:
+            return
         for peer in self._others:
             self._replicate_to(peer)
         self._advance_commit_from_matches()
@@ -584,6 +588,8 @@ class ReplicatedKvService:
         """Caller holds _mu. Snapshot applied state + truncate the log
         prefix; runs on leaders AND followers (a follower that never lags
         would otherwise grow its log forever)."""
+        if self._stopped:
+            return  # never rewrite files a successor may own
         if len(self.log) <= self._compact_entries:
             return
         keep_from = self.last_applied  # snapshot covers exactly this state
@@ -701,6 +707,9 @@ class ReplicatedKvService:
     # -- replication RPC handlers (peer-facing) ------------------------------
     def append_entries(self, req: AppendReq) -> AppendRsp:
         with self._mu:
+            if self._stopped:
+                return AppendRsp(term=self.term, ok=False,
+                                 match_index=self._last_index())
             if req.term < self.term:
                 return AppendRsp(term=self.term, ok=False,
                                  match_index=self._last_index())
@@ -747,6 +756,8 @@ class ReplicatedKvService:
 
     def request_vote(self, req: VoteReq) -> VoteRsp:
         with self._mu:
+            if self._stopped:
+                return VoteRsp(term=self.term, granted=False)
             if req.term < self.term:
                 return VoteRsp(term=self.term, granted=False)
             if req.term > self.term:
@@ -764,6 +775,8 @@ class ReplicatedKvService:
 
     def install_snapshot(self, req: SnapInstallReq) -> SnapInstallRsp:
         with self._mu:
+            if self._stopped:
+                return SnapInstallRsp(term=self.term, ok=False)
             if req.term < self.term:
                 return SnapInstallRsp(term=self.term, ok=False)
             self._become_follower(req.term, req.leader_id)
@@ -797,9 +810,31 @@ class ReplicatedKvService:
         with self._mu:
             self._stopped = True
             self.role = FOLLOWER
-        if self._log_f is not None:
-            self._log_f.close()
-            self._log_f = None
+        # QUIESCE before releasing the data dir: join the ticker and take
+        # the lock once more so any in-flight RPC handler finishes. An
+        # in-process restart (tests) constructs a NEW service over the
+        # SAME files with a different lock — a zombie writer thread from
+        # this instance racing the successor's reads/writes corrupts the
+        # log (impossible with real process kills, very possible with
+        # thread-level ones).
+        if self._ticker is not None \
+                and self._ticker is not threading.current_thread():
+            deadline = time.monotonic() + 30
+            while self._ticker.is_alive() and time.monotonic() < deadline:
+                self._ticker.join(timeout=1)
+            if self._ticker.is_alive():
+                # loud, not silent: the quiesce invariant is broken and a
+                # successor over this data dir would race a zombie writer
+                print(f"kvd {self.node_id}: ticker still alive after "
+                      "stop() quiesce window", flush=True)
+        # drain any in-flight client commit (it holds _commit_lock across
+        # replication): its post-quorum compact is also _stopped-guarded
+        with self._commit_lock:
+            pass
+        with self._mu:
+            if self._log_f is not None:
+                self._log_f.close()
+                self._log_f = None
 
 
 def bind_repl_service(server: RpcServer, svc: ReplicatedKvService) -> None:
