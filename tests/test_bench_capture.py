@@ -1,0 +1,81 @@
+"""Capture keying for bench.py's TPU-measurement cache.
+
+Round-4 verdict weak #4: the old all-of-`tpu3fs/ops` git-diff invalidation
+discarded a valid 13.7 GiB/s headline capture because an unrelated
+dispatcher (stripe.py) changed. The contract under test: each phase's
+capture is keyed to the files that determine THAT phase's computation, so a
+stripe.py-only edit keeps the headline capture promoted while an edit to
+the actual kernel files (pallas_rs.py / gf256.py / bitops.py / rs.py)
+invalidates it.
+"""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+import bench  # noqa: E402
+
+
+def test_headline_deps_exclude_dispatchers():
+    deps = bench.PHASE_DEP_FILES["headline"]
+    assert "tpu3fs/ops/stripe.py" not in deps
+    assert "tpu3fs/ops/native_ec.py" not in deps
+    # the files that DO determine the headline computation
+    for f in ("tpu3fs/ops/rs.py", "tpu3fs/ops/pallas_rs.py",
+              "tpu3fs/ops/gf256.py", "tpu3fs/ops/bitops.py"):
+        assert f in deps
+
+
+def test_digest_is_deterministic_and_per_phase():
+    d1 = bench._phase_dep_digest("headline")
+    assert d1 == bench._phase_dep_digest("headline")
+    assert d1 != bench._phase_dep_digest("exactness")  # crc32c.py added
+
+
+def _capture(digest, platform="tpu", error=None):
+    res = {"platform": platform, "value": 13.739}
+    if error:
+        res["error"] = error
+    return {"phases": {"headline": res}, "dep_digests": {"headline": digest}}
+
+
+def test_capture_valid_iff_digest_matches():
+    good = bench._phase_dep_digest("headline")
+    assert bench._capture_phase_valid(_capture(good), "headline")
+    assert not bench._capture_phase_valid(_capture("stale"), "headline")
+    assert not bench._capture_phase_valid(
+        _capture(good, platform="cpu"), "headline")
+    assert not bench._capture_phase_valid(
+        _capture(good, error="boom"), "headline")
+    assert not bench._capture_phase_valid({}, "headline")
+    assert not bench._capture_phase_valid(_capture(good), "secondary")
+
+
+def test_save_capture_merges_not_replaces(tmp_path, monkeypatch):
+    """A later partial capture (e.g. the tunnel died after the headline)
+    must not discard earlier valid phases."""
+    monkeypatch.setattr(bench, "CAPTURE_PATH", str(tmp_path / "cap.json"))
+    bench._save_capture({
+        "headline": {"platform": "tpu", "value": 10.0},
+        "secondary": {"platform": "tpu", "rs_decode_worstcase_gibps": 9.0},
+    })
+    bench._save_capture({"headline": {"platform": "tpu", "value": 11.0}})
+    cap = bench._load(bench.CAPTURE_PATH)
+    assert cap["phases"]["headline"]["value"] == 11.0
+    assert cap["phases"]["secondary"]["rs_decode_worstcase_gibps"] == 9.0
+    assert bench._capture_phase_valid(cap, "headline")
+    assert bench._capture_phase_valid(cap, "secondary")
+
+
+def test_save_capture_skips_errored_and_cpu_phases(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "CAPTURE_PATH", str(tmp_path / "cap.json"))
+    bench._save_capture({
+        "headline": {"platform": "tpu", "value": 10.0},
+        "secondary": {"error": "phase timed out"},
+        "e2e_tpu": {"platform": "cpu", "e2e_tpu_ec_write_gibps": 0.1},
+    })
+    cap = bench._load(bench.CAPTURE_PATH)
+    assert "secondary" not in cap["phases"]
+    assert "e2e_tpu" not in cap["phases"]
+    assert "headline" in cap["phases"]

@@ -39,6 +39,15 @@ def get_codec(k: int, m: int, shard_size: int) -> "StripeCodec":
         return codec
 
 
+def _bucket(b: int) -> int:
+    """Round a batch size up to the next power of two (shape bucketing for
+    the device paths: bounds XLA recompiles at O(log B) per codec)."""
+    p = 1
+    while p < b:
+        p <<= 1
+    return p
+
+
 def aligned_shard_size(n: int) -> int:
     """Round a working shard size up to the same 512B/64B grid
     shard_size_of uses — zero padding is free for RS/CRC math, and the
@@ -128,12 +137,22 @@ class StripeCodec:
         import jax
         import jax.numpy as jnp
 
-        dev_data = jnp.asarray(data)
+        # pad the batch to a power-of-two bucket: XLA compiles one program
+        # per input SHAPE, so free-running batch sizes (every distinct run
+        # length the file client flushes) would each pay a fresh multi-second
+        # compile — with bucketing there are O(log B) programs per codec,
+        # reused forever. Zero stripes encode to zero parity, so the pad
+        # rows are discarded by the slice below without affecting results.
+        bp = _bucket(b)
+        pad = np.zeros((bp - b, k, s), dtype=np.uint8) if bp != b else None
+        dev_data = jnp.asarray(
+            data if pad is None else np.concatenate([data, pad], axis=0))
         parity = self.rs.encode(dev_data)
         shards = jnp.concatenate([dev_data, parity], axis=1)
-        crcs = self._crc.compute(shards.reshape(b * (k + self.m), s))
+        crcs = self._crc(shards.reshape(bp * (k + self.m), s))
         shards, crcs = jax.device_get((shards, crcs))
-        return np.asarray(shards), np.asarray(crcs).reshape(b, k + self.m)
+        return (np.asarray(shards)[:b],
+                np.asarray(crcs).reshape(bp, k + self.m)[:b])
 
     def encode_stripe(self, chunk: bytes) -> Tuple[np.ndarray, np.ndarray]:
         """One chunk (<= k*S bytes, zero-padded) -> ((k+m, S), (k+m,))."""
@@ -159,8 +178,15 @@ class StripeCodec:
         import jax
         import jax.numpy as jnp
 
+        b = present.shape[0]
+        bp = _bucket(b)
+        if bp != b:  # shape bucketing, see encode_batch
+            present = np.concatenate(
+                [present,
+                 np.zeros((bp - b,) + present.shape[1:], dtype=np.uint8)],
+                axis=0)
         fn = self.rs.reconstruct_fn(tuple(present_idx), tuple(lost_idx))
-        return np.asarray(jax.device_get(fn(jnp.asarray(present))))
+        return np.asarray(jax.device_get(fn(jnp.asarray(present))))[:b]
 
     def crc_batch(self, shards: np.ndarray) -> np.ndarray:
         """(N, S) uint8 -> (N,) uint32 (device; host CRC on CPU backends)."""
@@ -168,7 +194,13 @@ class StripeCodec:
             return crc32c_batch_host(shards)
         import jax
 
-        return np.asarray(jax.device_get(self._crc.compute(shards)))
+        n = shards.shape[0]
+        npad = _bucket(n)
+        if npad != n:  # shape bucketing, see encode_batch
+            shards = np.concatenate(
+                [shards, np.zeros((npad - n, shards.shape[1]),
+                                  dtype=np.uint8)], axis=0)
+        return np.asarray(jax.device_get(self._crc(shards)))[:n]
 
     # -- host-side assembly helpers ------------------------------------------
     def assemble(self, data_shards: List[Optional[bytes]], length: int) -> bytes:
