@@ -412,13 +412,18 @@ PHASE_DEP_FILES = {
     "headline": _KERNEL_DEP_FILES,
     "exactness": _KERNEL_DEP_FILES + ("tpu3fs/ops/crc32c.py",),
     "secondary": _KERNEL_DEP_FILES + ("tpu3fs/ops/crc32c.py",),
-    # the e2e serving path depends on half the framework; its capture is
-    # keyed to the whole tpu3fs tree so promotion is never silently stale
-    # (the record still carries capture_commit either way)
-    "e2e_tpu": ("tpu3fs",),
+    # the e2e serving path depends on half the framework (including the
+    # native .so the host-side CRC and engine dispatch can call into); its
+    # capture is keyed to the whole tpu3fs tree + native sources so
+    # promotion is never silently stale (the record still carries
+    # capture_commit either way)
+    "e2e_tpu": ("tpu3fs", "native"),
 }
 _SHARED_HELPER_FNS = ("_gibps", "_init_jax", "_timeit", "_make_data")
 _MEASUREMENT_SIG = repr((K, M, SHARD_BYTES, BATCH, WARMUP, ITERS))
+
+
+_SOURCE_EXTS = (".py", ".cpp", ".cc", ".c", ".h", ".hpp")
 
 
 def _hash_path(h, path: str) -> None:
@@ -427,14 +432,15 @@ def _hash_path(h, path: str) -> None:
             dirs.sort()
             dirs[:] = [d for d in dirs if d != "__pycache__"]
             for name in sorted(files):
-                if name.endswith(".py"):
+                if (name.endswith(_SOURCE_EXTS) or name == "Makefile"):
                     _hash_path(h, os.path.join(root, name))
         return
-    try:
+    rel = os.path.relpath(path, HERE)  # digest keys must not bake in the
+    try:                               # checkout location
         with open(path, "rb") as f:
-            h.update(path.encode() + b"\0" + f.read() + b"\0")
+            h.update(rel.encode() + b"\0" + f.read() + b"\0")
     except OSError:
-        h.update(path.encode() + b"\0<missing>\0")
+        h.update(rel.encode() + b"\0<missing>\0")
 
 
 def _phase_dep_digest(phase: str) -> str:
@@ -472,8 +478,13 @@ def _load(path: str):
 def _run_kernel_phases(platform: str, state: dict,
                        partial_path: str = PARTIAL_PATH) -> dict:
     """Headline + exactness + secondary, persisting after each phase.
-    Returns the kernel-results dict {phase: result}."""
+    Returns the kernel-results dict {phase: result}. Each phase's dep
+    digest is taken BEFORE the phase runs (conservative: an edit landing
+    mid-phase makes the capture invalid, never silently valid — digests
+    computed at save time would validate a measurement against code it
+    never ran)."""
     for phase in KERNEL_PHASES:
+        state.setdefault("dep_digests", {})[phase] = _phase_dep_digest(phase)
         res = _run_phase(phase, platform)
         state.setdefault("phases", {})[phase] = res
         state["platform_requested"] = platform
@@ -485,11 +496,13 @@ def _run_kernel_phases(platform: str, state: dict,
     return state["phases"]
 
 
-def _save_capture(phases: dict) -> None:
+def _save_capture(phases: dict, run_digests: dict = None) -> None:
     """Merge TPU-measured phases into the capture file. Merge, not replace:
     a later partial capture (tunnel died after the headline) must not
     discard earlier valid phases — each phase carries its own dep digest
-    and timestamp so promotion judges them independently."""
+    and timestamp so promotion judges them independently. run_digests are
+    the digests taken when each phase RAN (falling back to save-time only
+    for phases without one)."""
     prior = _load(CAPTURE_PATH) or {}
     saved_phases = dict(prior.get("phases", {}))
     digests = dict(prior.get("dep_digests", {}))
@@ -504,7 +517,7 @@ def _save_capture(phases: dict) -> None:
         if plat is not None and plat not in TPU_PLATFORMS:
             continue
         saved_phases[p] = res
-        digests[p] = _phase_dep_digest(p)
+        digests[p] = (run_digests or {}).get(p) or _phase_dep_digest(p)
         stamps[p] = {"commit": commit, "at": now_iso}
     _persist(CAPTURE_PATH, {
         "phases": saved_phases,
@@ -556,10 +569,12 @@ def capture_tpu(verbose: bool = True) -> bool:
                               "detail": phases.get("headline")}))
         return False
     # the tunnel is demonstrably up: grab the e2e-on-TPU serving numbers too
+    state.setdefault("dep_digests", {})["e2e_tpu"] = _phase_dep_digest(
+        "e2e_tpu")
     phases["e2e_tpu"] = _run_phase("e2e_tpu", platform)
     state["phases"]["e2e_tpu"] = phases["e2e_tpu"]
     _persist(CAPTURE_PATH + ".partial", state)
-    _save_capture(phases)
+    _save_capture(phases, state.get("dep_digests"))
     if verbose:
         print(json.dumps({"captured": True,
                           "value": phases["headline"]["value"],
@@ -586,12 +601,14 @@ def main() -> None:
 
     live_tpu = _capture_is_tpu(phases)
     if live_tpu:
+        state.setdefault("dep_digests", {})["e2e_tpu"] = _phase_dep_digest(
+            "e2e_tpu")
         phases["e2e_tpu"] = _run_phase("e2e_tpu", platform)
         state["phases"]["e2e_tpu"] = phases["e2e_tpu"]
         _persist(PARTIAL_PATH, state)
-        _save_capture(phases)
+        _save_capture(phases, state.get("dep_digests"))
 
-    _RESERVED = ("platform", "device")
+    _RESERVED = ("platform", "device", "detail")
     extras: dict = {}
     for phase in ("secondary", "exactness", "e2e_tpu"):
         src = phases.get(phase, {})

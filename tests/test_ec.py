@@ -541,3 +541,98 @@ class TestBatchShardWrite:
         outs = fab.send(node_of(r1[0]), "batch_write_shard", pair)
         assert outs[0].ok and outs[1].ok
         assert outs[1].commit_ver == 2
+
+
+class TestHealthyChainRepair:
+    """Round-4 advisor (medium): a client crash between phase-2 commit RPCs
+    on a FULLY-HEALTHY chain leaves committed(v_new) on c shards, m < c < k
+    — no version holds a committed k-quorum, so the stripe is undecodable,
+    and the roll-forward inside _rebuild_target never runs because nothing
+    is SYNCING. EcResyncWorker._repair_healthy closes this: the chain's
+    first serving target sweeps split stripes and commits the stragglers."""
+
+    def _crash_mid_commit(self, fab, chain_id, cid, data, commits_allowed):
+        """Drive write_stripe through a messenger that dies (non-FsError,
+        like a process crash) after `commits_allowed` phase-2 commits."""
+        client = fab.storage_client()
+        committed = []
+
+        real_send = fab.send
+
+        def send(node_id, method, payload):
+            if method == "write_shard" and getattr(payload, "phase", 1) == 2:
+                if len(committed) >= commits_allowed:
+                    raise RuntimeError("client process died mid-commit")
+                committed.append(payload.target_id)
+            return real_send(node_id, method, payload)
+
+        client._messenger = send
+        with pytest.raises(RuntimeError):
+            client.write_stripe(chain_id, cid, data, chunk_size=CHUNK)
+        return len(committed)
+
+    def test_split_stripe_unreadable_then_repaired(self):
+        from tpu3fs.storage.ec_resync import EcResyncWorker
+
+        fab = ec_fabric()
+        client = fab.storage_client()
+        chain_id = fab.chain_ids[0]
+        cid = ChunkId(777, 0)
+        v1 = b"\x0a" * CHUNK
+        assert client.write_stripe(chain_id, cid, v1, chunk_size=CHUNK).ok
+        v2 = b"\x0b" * CHUNK
+        # crash after 2 of 4 commits: committed(v2)=2 in (m=1, k=3)
+        n = self._crash_mid_commit(fab, chain_id, cid, v2, commits_allowed=2)
+        assert n == 2
+        got = client.read_stripe(chain_id, cid, 0, CHUNK, chunk_size=CHUNK)
+        assert not got.ok, "no version has a committed k-quorum"
+        # every target is SERVING: the healthy-chain sweep must repair it
+        moved = 0
+        for node in fab.nodes.values():
+            moved += EcResyncWorker(node.service, fab.send).run_once()
+        got = client.read_stripe(chain_id, cid, 0, CHUNK, chunk_size=CHUNK)
+        assert got.ok and got.data == v2
+
+    def test_fully_staged_uncommitted_rolls_forward(self):
+        """Crash BEFORE any phase-2 commit: every shard staged v_new as
+        pending. committed(v_old) still has its k-quorum (reads keep
+        working at v_old); the sweep completes the write to v_new."""
+        from tpu3fs.storage.ec_resync import EcResyncWorker
+
+        fab = ec_fabric()
+        client = fab.storage_client()
+        chain_id = fab.chain_ids[0]
+        cid = ChunkId(778, 0)
+        v1 = b"\x01" * CHUNK
+        assert client.write_stripe(chain_id, cid, v1, chunk_size=CHUNK).ok
+        v2 = b"\x02" * CHUNK
+        assert self._crash_mid_commit(
+            fab, chain_id, cid, v2, commits_allowed=0) == 0
+        got = client.read_stripe(chain_id, cid, 0, CHUNK, chunk_size=CHUNK)
+        assert got.ok and got.data == v1  # old version intact pre-repair
+        for node in fab.nodes.values():
+            EcResyncWorker(node.service, fab.send).run_once()
+        got = client.read_stripe(chain_id, cid, 0, CHUNK, chunk_size=CHUNK)
+        assert got.ok and got.data == v2
+
+    def test_healthy_sweep_idle_on_clean_chain(self):
+        """No pending / no version split: the sweep must be a no-op (no
+        spurious write_shard traffic on clean chains)."""
+        from tpu3fs.storage.ec_resync import EcResyncWorker
+
+        fab = ec_fabric()
+        client = fab.storage_client()
+        chain_id = fab.chain_ids[0]
+        assert client.write_stripe(
+            chain_id, ChunkId(779, 0), b"x" * CHUNK, chunk_size=CHUNK).ok
+        writes = []
+        real_send = fab.send
+
+        def spy(node_id, method, payload):
+            if method == "write_shard":
+                writes.append(payload)
+            return real_send(node_id, method, payload)
+
+        for node in fab.nodes.values():
+            EcResyncWorker(node.service, spy).run_once()
+        assert writes == []

@@ -21,6 +21,7 @@ from tpu3fs.mgmtd.types import LocalTargetState, NodeType
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import RpcMessenger, bind_storage_service
 from tpu3fs.storage.craq import StorageService
+from tpu3fs.storage.ec_resync import EcResyncWorker
 from tpu3fs.storage.resync import ResyncWorker
 from tpu3fs.storage.target import StorageTarget
 from tpu3fs.storage.workers import (
@@ -154,14 +155,18 @@ class StorageApp(TwoPhaseApplication):
 
     def _resync_loop(self) -> None:
         worker = None
+        ec_worker = None
         while not self._stop.wait(self.config.get("resync_interval_s")):
             try:
                 if worker is None:
-                    worker = ResyncWorker(
-                        self.service,
-                        RpcMessenger(lambda: self.mgmtd_client.routing()),
-                    )
+                    messenger = RpcMessenger(
+                        lambda: self.mgmtd_client.routing())
+                    worker = ResyncWorker(self.service, messenger)
+                    # EC chains rebuild + heal (healthy-chain roll-forward
+                    # of interrupted two-phase commits) on the same cadence
+                    ec_worker = EcResyncWorker(self.service, messenger)
                 worker.run_once()
+                ec_worker.run_once()
             except Exception:
                 pass
 

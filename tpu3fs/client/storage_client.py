@@ -108,13 +108,18 @@ class StorageClient:
         self._rng = random.Random(seed)
         self._pool = None  # lazy batch fan-out pool (multi-node batches)
         self._pool_mu = threading.Lock()
+        self._pool_finalizer = None
 
     def close(self) -> None:
-        """Release the fan-out pool's worker threads (clients are cheap to
-        create, but their pools are not GC'd — long-lived processes that
-        churn clients must close them)."""
+        """Release the fan-out pool's worker threads. Explicit close is
+        best; a weakref finalizer backstops callers that churn clients
+        without closing (fuse, usrbio agent, benches — round-4 advisor:
+        per-client threads accumulated in long-lived processes)."""
         with self._pool_mu:
             pool, self._pool = self._pool, None
+            fin, self._pool_finalizer = self._pool_finalizer, None
+        if fin is not None:
+            fin.detach()
         if pool is not None:
             pool.shutdown(wait=False)
 
@@ -137,10 +142,17 @@ class StorageClient:
             return
         with self._pool_mu:
             if self._pool is None:
+                import weakref
+
                 from tpu3fs.utils.executor import WorkerPool
 
                 self._pool = WorkerPool(f"client-{self.client_id}",
                                         num_workers=4, queue_cap=64)
+                # reclaim worker threads when the client is GC'd without
+                # close(); args hold the POOL (not self), so the finalizer
+                # never keeps the client alive
+                self._pool_finalizer = weakref.finalize(
+                    self, WorkerPool.shutdown, self._pool, False)
             pool = self._pool
         pool.map(fn, items)
     def _chain(self, chain_id: int) -> ChainInfo:

@@ -197,6 +197,8 @@ class Fabric:
             return svc.batch_write_shard(payload)
         if method == "dump_chunkmeta":
             return svc.dump_chunkmeta(payload)
+        if method == "dump_pending_chunkmeta":
+            return svc.dump_pending_chunkmeta(payload)
         if method == "sync_done":
             return svc.sync_done(payload)
         if method == "remove_chunk":

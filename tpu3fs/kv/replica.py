@@ -49,6 +49,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu3fs.kv.mem import MemKVEngine
+from tpu3fs.utils.logging import xlog
 from tpu3fs.kv.service import (
     CommitReq,
     CommitRsp,
@@ -825,8 +826,9 @@ class ReplicatedKvService:
             if self._ticker.is_alive():
                 # loud, not silent: the quiesce invariant is broken and a
                 # successor over this data dir would race a zombie writer
-                print(f"kvd {self.node_id}: ticker still alive after "
-                      "stop() quiesce window", flush=True)
+                xlog("WARN",
+                     f"kvd {self.node_id}: ticker still alive after "
+                     "stop() quiesce window")
         # drain any in-flight client commit (it holds _commit_lock across
         # replication): its post-quorum compact is also _stopped-guarded
         with self._commit_lock:

@@ -281,6 +281,8 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     # rebuild-coordinator read: bypasses the public-state gate (EC
     # opportunistic rebuild; ec_resync._read_shard)
     s.method(19, "readRebuild", ReadReq, ReadReply, svc.read_rebuild)
+    s.method(20, "dumpPendingChunkMeta", TargetIdReq, ChunkMetaList,
+             lambda r: ChunkMetaList(svc.dump_pending_chunkmeta(r.target_id)))
     server.add_service(s)
 
 
@@ -356,6 +358,9 @@ class RpcMessenger:
             return rsp
         if method == "dump_chunkmeta":
             return c.call(addr, sid, 4, TargetIdReq(payload), ChunkMetaList).metas
+        if method == "dump_pending_chunkmeta":
+            return c.call(addr, sid, 20, TargetIdReq(payload),
+                          ChunkMetaList).metas
         if method == "sync_done":
             c.call(addr, sid, 5, TargetIdReq(payload), Empty)
             return None

@@ -330,7 +330,7 @@ class StorageService:
         self._update_workers: Dict[int, object] = {}
         self._update_workers_guard = threading.Lock()
         self._max_forward_retries = max_forward_retries
-        self.stopped = False
+        self._stopped = False
         # per-op latency/success metrics (ref monitor::OperationRecorder
         # usage throughout StorageOperator.cc:87,89,139)
         from tpu3fs.monitor.recorder import LatencyRecorder
@@ -349,6 +349,21 @@ class StorageService:
 
     def set_fastpath_invalidator(self, fn) -> None:
         self._fastpath_invalidate = fn
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    @stopped.setter
+    def stopped(self, value: bool) -> None:
+        """Stopping the service drops the C++ read-fastpath registry in the
+        SAME step: Python read/batch_read refuse with RPC_PEER_CLOSED once
+        stopped, and without this an in-process 'killed' node (tests,
+        chaos drives, thread-level failover) kept answering reads through
+        the native path until the next target scan (round-4 advisor)."""
+        self._stopped = value
+        if value:
+            self._invalidate_fastpath(None)
 
     def _invalidate_fastpath(self, target_id) -> None:
         fn = self._fastpath_invalidate
@@ -1555,6 +1570,17 @@ class StorageService:
         if target is None:
             raise _err(Code.TARGET_NOT_FOUND, str(target_id))
         return target.engine.all_metadata()
+
+    def dump_pending_chunkmeta(self, target_id: int) -> List[ChunkMeta]:
+        """Metas whose pending (staged, uncommitted) version is nonzero —
+        the cheap probe behind the healthy-chain EC repair sweep: an
+        interrupted two-phase stripe write always leaves pendings on its
+        straggler shards, so an all-empty reply means no repair work and
+        the full per-stripe version gather is skipped."""
+        target = self._targets.get(target_id)
+        if target is None:
+            raise _err(Code.TARGET_NOT_FOUND, str(target_id))
+        return target.engine.pending_metas()
 
     def remove_chunk(self, target_id: int, chunk_id: ChunkId) -> bool:
         """Remove a single chunk (resync cleanup of stale successor chunks)."""

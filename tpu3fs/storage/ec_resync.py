@@ -39,6 +39,13 @@ class EcResyncWorker:
         # optional device mesh: rebuild through the ICI all-gather collective
         # (tpu3fs.parallel.rebuild) instead of the single-chip decode
         self._mesh = mesh
+        # healthy-repair memo: per chain, the pending signature of the last
+        # sweep that committed nothing. A pending set that can never reach
+        # the roll-forward quorum (e.g. a phase-1 crash that staged < k
+        # shards) would otherwise re-trigger the full version gather every
+        # round forever; such orphans are reclaimed when their stripe is
+        # next overwritten (staging displaces older pendings).
+        self._repair_memo: Dict[int, frozenset] = {}
 
     def run_once(self) -> int:
         """One rebuild round over all local EC chains; returns shards moved."""
@@ -51,6 +58,10 @@ class EcResyncWorker:
             syncing = [t for t in chain.targets
                        if t.public_state == PublicTargetState.SYNCING]
             if not syncing:
+                serving = chain.serving_targets()
+                if (serving and serving[0].target_id in local_ids
+                        and len(serving) == len(chain.targets)):
+                    moved += self._repair_healthy(routing, chain)
                 continue
             # the first serving member acts as rebuild coordinator (one
             # recovery driver per chain, mirroring the CR predecessor
@@ -169,6 +180,77 @@ class EcResyncWorker:
             except FsError:
                 pass  # recovering node died again; next round retries
         return moved
+
+    def _repair_healthy(self, routing: RoutingInfo, chain: ChainInfo) -> int:
+        """Roll forward partially-committed two-phase stripe writes on a
+        HEALTHY chain. A client that crashes between its phase-2 commit
+        RPCs can leave committed(v_new) on only c shards, c in (m, k): no
+        version then holds a committed k-quorum, every byte is intact on
+        disk, and - because _rebuild_target's roll-forward only runs for
+        chains with a SYNCING member - the stripe stayed undecodable until
+        an overwrite or a target bounce happened to trigger resync
+        (round-4 advisor finding, medium). Two phases so healthy chains
+        cost almost nothing at steady state: (A) a cheap pending-only
+        probe per target (an interrupted write ALWAYS leaves pendings on
+        its straggler shards - phase 2 is what clears them); only if some
+        target reports pendings does (B) gather the per-shard committed
+        versions of JUST those stripes (stat_chunks) and roll forward
+        (idempotent phase-2 writes; safety argument in _roll_forward's
+        docstring). An ACTIVE write looks identical in (A) - the quorum +
+        serving-coverage guard makes committing alongside it idempotent.
+        Returns shards committed."""
+        pend: Dict[int, Dict[bytes, int]] = {}  # shard j -> key -> pv
+        cids: Dict[bytes, ChunkId] = {}
+        for t in chain.targets:
+            pn = routing.node_of_target(t.target_id)
+            if pn is None:
+                return 0  # can't see the whole chain: don't judge quorums
+            try:
+                metas: List[ChunkMeta] = self._messenger(
+                    pn.node_id, "dump_pending_chunkmeta", t.target_id)
+            except FsError:
+                return 0
+            j = chain.shard_index(t.target_id)
+            for meta in metas:
+                key = meta.chunk_id.to_bytes()
+                pend.setdefault(j, {})[key] = meta.pending_ver
+                cids.setdefault(key, meta.chunk_id)
+        if not cids:
+            self._repair_memo.pop(chain.chain_id, None)
+            return 0  # steady state: no pendings anywhere, no repair work
+        sig = frozenset((j, key, pv)
+                        for j, by_key in pend.items()
+                        for key, pv in by_key.items())
+        if self._repair_memo.get(chain.chain_id) == sig:
+            return 0  # same unresolvable pendings as last round: skip
+        order = sorted(cids)
+        id_list = [cids[key] for key in order]
+        vers: Dict[bytes, Dict[int, tuple]] = {}
+        for t in chain.targets:
+            pn = routing.node_of_target(t.target_id)
+            if pn is None:
+                return 0
+            j = chain.shard_index(t.target_id)
+            try:
+                stats = self._messenger(
+                    pn.node_id, "stat_chunks", (t.target_id, id_list))
+            except FsError:
+                return 0
+            for key, (cv, _length, _aux) in zip(order, stats):
+                pv = pend.get(j, {}).get(key, 0)
+                if cv > 0 or pv > 0:
+                    vers.setdefault(key, {})[j] = (cv, pv)
+        if not vers:
+            return 0
+        committed = self._roll_forward(
+            routing, chain, {key: cids[key] for key in vers}, vers)
+        # memoize ONLY a fruitless sweep: progress means the pending set is
+        # changing and the next round should look again
+        if committed == 0:
+            self._repair_memo[chain.chain_id] = sig
+        else:
+            self._repair_memo.pop(chain.chain_id, None)
+        return committed
 
     def _roll_forward(self, routing: RoutingInfo, chain: ChainInfo,
                       stripes: Dict[bytes, ChunkId],

@@ -125,6 +125,13 @@ class ChunkEngine(abc.ABC):
     @abc.abstractmethod
     def all_metadata(self) -> List[ChunkMeta]: ...
 
+    def pending_metas(self) -> List[ChunkMeta]:
+        """Metas with a staged (uncommitted) pending version. Engines that
+        can afford it keep an index so this is O(pendings), not O(chunks)
+        — it is the steady-state probe of the healthy-chain EC repair
+        sweep, called once per resync interval per target."""
+        return [m for m in self.all_metadata() if m.pending_ver > 0]
+
     @abc.abstractmethod
     def used_size(self) -> int: ...
 
@@ -220,6 +227,10 @@ class MemChunkEngine(ChunkEngine):
     def __init__(self):
         self._chunks: Dict[bytes, _Slot] = {}
         self._lock = threading.RLock()
+        # chunk keys with a staged pending version: keeps pending_metas()
+        # O(pendings) — the healthy-chain repair probe must not scan the
+        # whole index at steady state
+        self._pending_keys: set = set()
 
     # -- helpers -----------------------------------------------------------
     def _slot(self, chunk_id: ChunkId) -> Optional[_Slot]:
@@ -346,6 +357,7 @@ class MemChunkEngine(ChunkEngine):
                 # directly (design_notes "Data recovery" step 2)
                 slot.committed = bytes(data)
                 slot.pending = None
+                self._pending_keys.discard(key)
                 meta.committed_ver = update_ver
                 meta.pending_ver = 0
                 meta.chain_ver = chain_ver
@@ -360,6 +372,7 @@ class MemChunkEngine(ChunkEngine):
                 return replace(meta)
             if stage_replace:
                 slot.pending = bytes(data)
+                self._pending_keys.add(key)
                 meta.pending_ver = update_ver
                 meta.chain_ver = chain_ver
                 meta.pending_length = len(slot.pending)
@@ -380,6 +393,7 @@ class MemChunkEngine(ChunkEngine):
                     base.extend(b"\x00" * (offset + len(data) - len(base)))
                 base[offset : offset + len(data)] = data
                 slot.pending = bytes(base)
+            self._pending_keys.add(key)
             meta.pending_ver = update_ver
             meta.chain_ver = chain_ver
             meta.pending_length = len(slot.pending)
@@ -403,6 +417,7 @@ class MemChunkEngine(ChunkEngine):
                 )
             slot.committed = slot.pending
             slot.pending = None
+            self._pending_keys.discard(chunk_id.to_bytes())
             meta.committed_ver = ver
             meta.pending_ver = 0
             meta.chain_ver = chain_ver
@@ -418,6 +433,7 @@ class MemChunkEngine(ChunkEngine):
     # -- maintenance ---------------------------------------------------------
     def remove(self, chunk_id: ChunkId) -> bool:
         with self._lock:
+            self._pending_keys.discard(chunk_id.to_bytes())
             return self._chunks.pop(chunk_id.to_bytes(), None) is not None
 
     def truncate(self, chunk_id: ChunkId, length: int, chain_ver: int) -> ChunkMeta:
@@ -432,6 +448,7 @@ class MemChunkEngine(ChunkEngine):
             meta.committed_ver += 1
             meta.pending_ver = 0
             slot.pending = None
+            self._pending_keys.discard(chunk_id.to_bytes())
             meta.checksum = Checksum.of(slot.committed)
             meta.pending_length = 0
             meta.pending_checksum = Checksum()
@@ -446,6 +463,12 @@ class MemChunkEngine(ChunkEngine):
 
     def all_metadata(self) -> List[ChunkMeta]:
         return self.query(b"")
+
+    def pending_metas(self) -> List[ChunkMeta]:
+        with self._lock:
+            return [replace(self._chunks[k].meta)
+                    for k in sorted(self._pending_keys)
+                    if k in self._chunks]
 
     def used_size(self) -> int:
         with self._lock:
