@@ -177,6 +177,8 @@ def run_bench(
 
     # batched write phase: node-grouped BatchWrite requests (a second file
     # id so the write path runs fresh, not as overwrites)
+    for node in fab.nodes.values():
+        node.service.write_path_stats(reset=True)
     t0 = time.perf_counter()
     wrote = 0
     for base in range(0, chunks, batch):
@@ -196,6 +198,54 @@ def run_bench(
         "unit": "GiB/s",
         "iops": round(wrote / dt, 1),
         "batch": batch,
+        "engine": engine,
+    }
+    results.append(row)
+    print(json.dumps(row), flush=True)
+
+    # write-path decomposition: where the batched-write seconds went,
+    # split by chain role — "head" (entered from a client), "mid"
+    # (entered from a predecessor, forwarded on; replicas >= 3), "tail"
+    # (ended the chain). A forwarder's forward_s CONTAINS its successor's
+    # whole pipeline, so at ANY chain depth the pure messaging/serde cost
+    # of all hops together is
+    #   forward_msg = (head.forward + mid.forward) - (mid.wall + tail.wall)
+    # and head.wall decomposes as
+    #   head_stage + head_commit + head_other + forward_msg
+    #     + downstream stage/commit/other.
+    agg = {}
+    for role in ("head", "mid", "tail"):
+        agg[role] = {"stage_s": 0.0, "forward_s": 0.0, "commit_s": 0.0,
+                     "wall_s": 0.0, "ops": 0, "bytes": 0}
+    for node in fab.nodes.values():
+        st = node.service.write_path_stats()
+        for role, vals in agg.items():
+            for k in vals:
+                vals[k] += st[role][k]
+    head, mid, tail = agg["head"], agg["mid"], agg["tail"]
+    row = {
+        "metric": "storage_bench_write_decomp",
+        "unit": "s",
+        "head_stage_s": round(head["stage_s"], 4),
+        "mid_stage_s": round(mid["stage_s"], 4),
+        "tail_stage_s": round(tail["stage_s"], 4),
+        "forward_msg_s": round(
+            max(head["forward_s"] + mid["forward_s"]
+                - mid["wall_s"] - tail["wall_s"], 0.0), 4),
+        "head_commit_s": round(head["commit_s"], 4),
+        "mid_commit_s": round(mid["commit_s"], 4),
+        "tail_commit_s": round(tail["commit_s"], 4),
+        "head_other_s": round(
+            max(head["wall_s"] - head["stage_s"] - head["forward_s"]
+                - head["commit_s"], 0.0), 4),
+        "downstream_other_s": round(
+            max(mid["wall_s"] - mid["stage_s"] - mid["forward_s"]
+                - mid["commit_s"], 0.0)
+            + max(tail["wall_s"] - tail["stage_s"] - tail["commit_s"],
+                  0.0), 4),
+        "head_wall_s": round(head["wall_s"], 4),
+        "ops": head["ops"],
+        "bytes": head["bytes"],
         "engine": engine,
     }
     results.append(row)

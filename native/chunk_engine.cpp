@@ -26,6 +26,7 @@
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -450,6 +451,7 @@ int class_for(uint32_t chunk_bytes) {
 struct Engine {
   std::string dir;
   std::map<Key, ChunkMeta> metas;
+  std::set<Key> pending_keys;  // keys with pending_ver != 0 (see note_pending)
   SizeClass classes[kNumClasses];
   int wal_fd = -1;
   uint64_t wal_records = 0;
@@ -547,6 +549,7 @@ struct Engine {
       memcpy(k.b, rec.key, kKeyLen);
       if (rec.op == 2) {
         metas.erase(k);
+        pending_keys.erase(k);
         continue;
       }
       ChunkMeta m;
@@ -558,6 +561,7 @@ struct Engine {
       m.aux = rec.aux_of();
       m.aux_pending = rec.aux_pending_of();
       metas[k] = m;
+      note_pending(k, m);
     }
     return valid;
   }
@@ -610,7 +614,19 @@ struct Engine {
     return OK;
   }
 
+  // pending-key index: every meta state change funnels through log_state /
+  // log_remove / replay, so the set stays exact. Keeps ce_query_pending
+  // O(pendings), not O(chunks) — it is the steady-state probe of the
+  // healthy-chain EC repair sweep (once per resync interval per target).
+  void note_pending(const Key& k, const ChunkMeta& m) {
+    if (m.pending_ver)
+      pending_keys.insert(k);
+    else
+      pending_keys.erase(k);
+  }
+
   int log_state(const Key& k, const ChunkMeta& m) {
+    note_pending(k, m);
     WalRecord rec;
     rec.op = 1;
     memcpy(rec.key, k.b, kKeyLen);
@@ -953,6 +969,7 @@ struct Engine {
     free_block(it->second.committed);
     free_block(it->second.pending);
     metas.erase(it);
+    pending_keys.erase(k);
     return log_remove(k);
   }
 
@@ -1144,6 +1161,28 @@ int ce_query(void* h, const uint8_t* prefix, uint32_t prefix_len, CMeta* out,
     fill_cmeta(k, m, &out[n++]);
   }
   return n;
+}
+
+// query_pending: metas with a staged (uncommitted) pending version, via the
+// engine's pending-key index — O(pendings), the healthy-chain EC repair
+// probe's cost contract. Returns count (>=0) or error (<0).
+int ce_query_pending(void* h, CMeta* out, int max_out) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  int n = 0;
+  for (const auto& k : e->pending_keys) {
+    auto it = e->metas.find(k);
+    if (it == e->metas.end()) continue;
+    if (n >= max_out) break;
+    fill_cmeta(k, it->second, &out[n++]);
+  }
+  return n;
+}
+
+int64_t ce_pending_count(void* h) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  return static_cast<int64_t>(e->pending_keys.size());
 }
 
 int64_t ce_used_size(void* h) {
@@ -1438,6 +1477,21 @@ int ce_crc32c_batch(const uint8_t* data, uint64_t n_rows, uint64_t stride,
                     uint64_t len, uint32_t* out) {
   gfec::parallel_for(n_rows, n_rows * len, [&](uint64_t i) {
     out[i] = crc32c(data + i * stride, len);
+  });
+  return OK;
+}
+
+// Batched CRC32C over NON-CONTIGUOUS buffers (pointer + length per row):
+// the mem-engine staging path checksums a batch of independently-owned
+// payloads in one GIL-released crossing, spread over the pool — per-op
+// scalar CRC was the dominant term of the CPU batched-write pipeline.
+int ce_crc32c_multi(const uint8_t* const* bufs, const uint64_t* lens,
+                    uint64_t n, uint32_t* out) {
+  if (n == 0) return OK;
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; ++i) total += lens[i];
+  gfec::parallel_for(n, total, [&](uint64_t i) {
+    out[i] = crc32c(bufs[i], lens[i]);
   });
   return OK;
 }

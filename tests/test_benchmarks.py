@@ -16,9 +16,17 @@ class TestStorageBench:
         names = [r["metric"] for r in rows]
         assert names == ["storage_bench_write", "storage_bench_read",
                          "storage_bench_batch_read",
-                         "storage_bench_batch_write"]
-        assert all(r["value"] > 0 for r in rows)
+                         "storage_bench_batch_write",
+                         "storage_bench_write_decomp"]
+        assert all(r["value"] > 0 for r in rows if "value" in r)
         assert rows[0]["ops"] == 16
+        # the decomposition must account for the batched writes it saw
+        decomp = rows[-1]
+        assert decomp["ops"] == 16
+        assert decomp["head_wall_s"] > 0
+        # components never exceed the wall they decompose
+        assert (decomp["head_stage_s"] + decomp["forward_msg_s"]
+                + decomp["head_commit_s"]) <= decomp["head_wall_s"] + 0.01
 
     def test_error_injection_still_completes(self):
         rows = storage_bench(chunks=8, size=4096, batch=4, threads=2,

@@ -636,3 +636,39 @@ class TestHealthyChainRepair:
         for node in fab.nodes.values():
             EcResyncWorker(node.service, spy).run_once()
         assert writes == []
+
+    def test_transient_commit_failure_does_not_freeze_memo(self):
+        """A sweep whose phase-2 commit fails transiently must NOT be
+        memoized as fruitless — the pending signature is unchanged, so a
+        frozen memo would leave the stripe unreadable forever."""
+        from tpu3fs.storage.ec_resync import EcResyncWorker
+
+        fab = ec_fabric()
+        client = fab.storage_client()
+        chain_id = fab.chain_ids[0]
+        cid = ChunkId(781, 0)
+        assert client.write_stripe(
+            chain_id, cid, b"\x01" * CHUNK, chunk_size=CHUNK).ok
+        v2 = b"\x02" * CHUNK
+        assert self._crash_mid_commit(
+            fab, chain_id, cid, v2, commits_allowed=2) == 2
+
+        real_send = fab.send
+        drop = [True]
+
+        def flaky(node_id, method, payload):
+            if (method == "write_shard" and drop
+                    and getattr(payload, "phase", 1) == 2):
+                drop.pop()
+                from tpu3fs.utils.result import FsError, Status
+                raise FsError(Status(Code.RPC_CONNECT_FAILED, "blip"))
+            return real_send(node_id, method, payload)
+
+        workers = [EcResyncWorker(node.service, flaky)
+                   for node in fab.nodes.values()]
+        for w in workers:
+            w.run_once()  # first sweep: commit attempt hits the blip
+        for w in workers:
+            w.run_once()  # second sweep MUST retry (no frozen memo)
+        got = client.read_stripe(chain_id, cid, 0, CHUNK, chunk_size=CHUNK)
+        assert got.ok and got.data == v2

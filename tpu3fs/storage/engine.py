@@ -91,6 +91,7 @@ class ChunkEngine(abc.ABC):
         chunk_size: int,
         aux: int = 0,
         expected_crc: Optional[int] = None,
+        content_crc: Optional[Checksum] = None,
     ) -> ChunkMeta:
         """Stage pending version `update_ver` (COW write of [offset,
         offset+len)); `aux` is an opaque tag promoted with the content at
@@ -106,7 +107,13 @@ class ChunkEngine(abc.ABC):
         update_ver, allowing version gaps and replacing any older pending
         — phase one of the EC two-phase stripe write; the committed
         version is untouched until commit() promotes it, so a failed
-        overwrite can never destroy the last readable stripe version."""
+        overwrite can never destroy the last readable stripe version.
+
+        content_crc (when given) is the caller-precomputed Checksum OF
+        `data` (the batched staging path computes them all in one pooled
+        native crossing); engines may use it wherever the staged content
+        is exactly `data`, and must ignore it otherwise (merged COW
+        content)."""
 
     @abc.abstractmethod
     def commit(self, chunk_id: ChunkId, ver: int, chain_ver: int) -> ChunkMeta:
@@ -149,8 +156,20 @@ class ChunkEngine(abc.ABC):
     def batch_update(
         self, ops: List[EngineUpdateOp], chain_ver: int
     ) -> List[EngineOpResult]:
+        # one pooled native crossing checksums every whole-content payload
+        # up front (per-op scalar CRC was the dominant term of the batched
+        # write pipeline); ops that merge into existing content checksum
+        # inline as before. expected_crc ops skip precompute: validation
+        # recomputes (and reuses) the checksum anyway.
+        pre: List[Optional[Checksum]] = [None] * len(ops)
+        whole = [i for i, op in enumerate(ops)
+                 if op.offset == 0 and op.expected_crc is None and op.data]
+        if len(whole) > 1:
+            for i, cs in zip(whole,
+                             Checksum.of_many([ops[i].data for i in whole])):
+                pre[i] = cs
         out: List[EngineOpResult] = []
-        for op in ops:
+        for op, content_crc in zip(ops, pre):
             try:
                 ver = op.update_ver
                 if ver == 0:
@@ -162,6 +181,7 @@ class ChunkEngine(abc.ABC):
                     stage_replace=op.stage_replace,
                     chunk_size=op.chunk_size,
                     aux=op.aux, expected_crc=op.expected_crc,
+                    content_crc=content_crc,
                 )
                 if op.full_replace:
                     out.append(EngineOpResult(
@@ -282,9 +302,12 @@ class MemChunkEngine(ChunkEngine):
         chunk_size: int,
         aux: int = 0,
         expected_crc: Optional[int] = None,
+        content_crc: Optional[Checksum] = None,
     ) -> ChunkMeta:
         if offset + len(data) > chunk_size:
             raise _err(Code.INVALID_ARG, "write exceeds chunk size")
+        if offset != 0:
+            content_crc = None  # staged content can never be exactly data
         assert not (full_replace and stage_replace)
         with self._lock:
             key = chunk_id.to_bytes()
@@ -363,8 +386,11 @@ class MemChunkEngine(ChunkEngine):
                 meta.chain_ver = chain_ver
                 meta.length = len(data)
                 # reuse the validation checksum when offset==0 covered it
-                meta.checksum = (checked if checked is not None and offset == 0
-                                 else Checksum.of(slot.committed))
+                # (or the caller's precomputed content CRC)
+                meta.checksum = (
+                    checked if checked is not None and offset == 0
+                    else content_crc if content_crc is not None
+                    else Checksum.of(slot.committed))
                 meta.pending_length = 0
                 meta.pending_checksum = Checksum()
                 meta.aux = aux
@@ -378,6 +404,7 @@ class MemChunkEngine(ChunkEngine):
                 meta.pending_length = len(slot.pending)
                 meta.pending_checksum = (
                     checked if checked is not None
+                    else content_crc if content_crc is not None
                     else Checksum.of(slot.pending))
                 slot.aux_pending = aux
                 return replace(meta)
@@ -393,11 +420,14 @@ class MemChunkEngine(ChunkEngine):
                     base.extend(b"\x00" * (offset + len(data) - len(base)))
                 base[offset : offset + len(data)] = data
                 slot.pending = bytes(base)
+                content_crc = None  # merged content != data
             self._pending_keys.add(key)
             meta.pending_ver = update_ver
             meta.chain_ver = chain_ver
             meta.pending_length = len(slot.pending)
-            meta.pending_checksum = Checksum.of(slot.pending)
+            meta.pending_checksum = (
+                content_crc if content_crc is not None
+                else Checksum.of(slot.pending))
             slot.aux_pending = aux
             return replace(meta)
 

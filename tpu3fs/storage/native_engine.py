@@ -164,6 +164,13 @@ def _load_lib():
         lib.ce_used_size.argtypes = [ctypes.c_void_p]
         lib.ce_chunk_count.restype = ctypes.c_int64
         lib.ce_chunk_count.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "ce_query_pending"):  # stale .so: base fallback
+            lib.ce_query_pending.restype = ctypes.c_int
+            lib.ce_query_pending.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(_CMeta), ctypes.c_int,
+            ]
+            lib.ce_pending_count.restype = ctypes.c_int64
+            lib.ce_pending_count.argtypes = [ctypes.c_void_p]
         lib.ce_compact.restype = ctypes.c_int
         lib.ce_compact.argtypes = [ctypes.c_void_p]
         lib.ce_crc32c.restype = ctypes.c_uint32
@@ -305,6 +312,7 @@ class NativeChunkEngine(ChunkEngine):
         chunk_size: int,
         aux: int = 0,
         expected_crc: Optional[int] = None,
+        content_crc=None,  # computed natively during staging; unused here
     ) -> ChunkMeta:
         mode = 2 if stage_replace else (1 if full_replace else 0)
         rc = self._lib.ce_update(
@@ -344,6 +352,17 @@ class NativeChunkEngine(ChunkEngine):
 
     def all_metadata(self) -> List[ChunkMeta]:
         return self.query(b"")
+
+    def pending_metas(self) -> List[ChunkMeta]:
+        if not hasattr(self._lib, "ce_query_pending"):
+            return super().pending_metas()  # stale .so: O(chunks) fallback
+        count = int(self._lib.ce_pending_count(self._h))
+        if count == 0:
+            return []
+        arr = (_CMeta * count)()
+        rc = self._lib.ce_query_pending(self._h, arr, count)
+        _check(rc, "query_pending")
+        return [_meta_from_c(arr[i]) for i in range(rc)]
 
     def used_size(self) -> int:
         return int(self._lib.ce_used_size(self._h))

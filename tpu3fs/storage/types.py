@@ -55,6 +55,21 @@ class Checksum:
     def of(data: bytes) -> "Checksum":
         return Checksum(crc32c(data), len(data))
 
+    @staticmethod
+    def of_many(bufs) -> "list":
+        """Checksums of a sequence of buffers in ONE pooled native
+        crossing when the library is loadable (the batched staging path's
+        per-op scalar CRC was the dominant write-pipeline term); falls
+        back to the per-buffer path otherwise."""
+        if len(bufs) > 1:
+            from tpu3fs.ops import native_ec
+
+            if native_ec.available():
+                crcs = native_ec.crc32c_multi(bufs)
+                return [Checksum(int(c), len(b))
+                        for c, b in zip(crcs, bufs)]
+        return [Checksum.of(b) for b in bufs]
+
     def combine(self, other: "Checksum") -> "Checksum":
         return Checksum(
             crc32c_combine(self.value, other.value, other.length),
