@@ -1562,6 +1562,39 @@ int ce_batch_update(void* h, uint64_t chain_ver, const uint8_t* blob,
   return e->flush_log();
 }
 
+// Tail-of-chain batched write: stage + immediate commit per op under ONE
+// mutex hold (the native transport's write fast path; the Python tail does
+// the same two steps under its per-chunk locks, so a concurrent Python
+// writer can never interleave between our stage and commit).
+// E_STALE_UPDATE fills committed state (the idempotent-duplicate reply);
+// any other failure leaves that op uncommitted.
+int ce_batch_write(void* h, uint64_t chain_ver, const uint8_t* blob,
+                   const CUpOp* ops, COpResult* res, int n) {
+  auto* e = static_cast<Engine*>(h);
+  std::lock_guard<std::mutex> g(e->mu);
+  e->log_buffering = true;  // ONE WAL append for the whole batch
+  for (int i = 0; i < n; i++) {
+    const CUpOp& op = ops[i];
+    Key k;
+    memcpy(k.b, op.key, kKeyLen);
+    COpResult& r = res[i];
+    r = COpResult{};
+    uint64_t ver = op.update_ver;
+    uint32_t len = 0, crc = 0;
+    r.rc = e->update(k, &ver, chain_ver, blob + op.data_off, op.data_len,
+                     op.offset, (op.flags & 4) ? 2 : (op.flags & 1),
+                     op.chunk_size, op.aux, &len, &crc,
+                     (op.flags >> 1) & 1, op.expected_crc);
+    if (r.rc == OK && !(op.flags & 1))  // full_replace commits in update
+      r.rc = e->commit(k, ver, chain_ver);
+    r.ver = ver;
+    r.len = len;
+    r.crc = crc;
+  }
+  e->log_buffering = false;
+  return e->flush_log();
+}
+
 int ce_batch_commit(void* h, uint64_t chain_ver, const uint8_t* keys,
                     const uint64_t* vers, COpResult* res, int n) {
   auto* e = static_cast<Engine*>(h);

@@ -14,6 +14,7 @@
 // Exposed as a C ABI consumed through ctypes (no pybind11 in this image).
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <cerrno>
 #include <chrono>
@@ -27,6 +28,7 @@
 #include <memory>
 #include <mutex>
 #include <random>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -357,6 +359,43 @@ struct FpTarget {
   uint64_t chunk_size = 0;
 };
 
+// ---- write fast path (chain-internal batchUpdate, method 15) --------------
+// Serves the TAIL hop of batched CRAQ writes natively: the head (Python)
+// forwards a fully-staged batch in one RPC; when the receiving target is
+// the registered tail of its chain, decode + engine stage/commit + encode
+// all happen here (ce_batch_write holds the engine mutex across both
+// steps, closing the stage/commit interleave the Python path closes with
+// per-chunk locks). Anything ambiguous — unknown chain, chain-version
+// skew, duplicate chunks in one batch, inline (non-bulk) payloads, any
+// engine code other than OK/stale — falls back to the Python dispatch;
+// engine ops are idempotent (re-stage same ver, duplicate commit), so a
+// post-partial fallback re-run is safe.
+
+// engine ABI mirrors (native/chunk_engine.cpp CUpOp/COpResult — keep in sync)
+struct FpUpOp {
+  uint8_t key[12];
+  uint8_t flags;
+  uint8_t pad0[3];
+  uint32_t offset;
+  uint32_t data_len;
+  uint32_t chunk_size;
+  uint32_t aux;
+  uint64_t data_off;
+  uint64_t update_ver;
+  uint32_t expected_crc;
+  uint32_t pad1;
+};
+typedef int (*fp_batch_write_t)(void* h, uint64_t chain_ver,
+                                const uint8_t* blob, const FpUpOp* ops,
+                                FpOpResult* res, int n);
+
+struct FpWriteChain {
+  void* engine = nullptr;
+  int64_t target_id = 0;   // the registered tail target (for invalidation)
+  int64_t chain_ver = 0;
+  uint64_t chunk_size = 0;
+};
+
 // status codes the fast path can emit (tpu3fs/utils/result.py)
 enum FpCode : int64_t {
   FP_OK = 0,
@@ -386,6 +425,8 @@ struct FpState {
   std::mutex mu;
   fp_batch_read_t batch_read = nullptr;
   std::map<int64_t, FpTarget> targets;
+  fp_batch_write_t batch_write = nullptr;
+  std::map<int64_t, FpWriteChain> write_chains;  // chain_id -> local tail
   std::atomic<uint64_t> hits{0};
   std::atomic<uint64_t> fallbacks{0};
   // readers currently inside an engine call: deregistration spins until
@@ -614,9 +655,200 @@ bool fp_try_batch_read(FpState& fp, const Packet& req, std::string& payload,
   return true;
 }
 
+// ---- write fast path: decode / execute / encode ---------------------------
+
+struct FpWReq {
+  int64_t chain_id = 0;
+  int64_t chain_ver = 0;
+  uint64_t file_id = 0;
+  uint32_t index = 0;
+  int64_t offset = 0;
+  int64_t chunk_size = 0;
+  int64_t update_ver = 0;
+  bool full_replace = false;
+  int64_t from_target = 0;
+};
+
+// decode ONE WriteReq (12 fields; serde reflection order of
+// storage/craq.py WriteReq). Returns false on any shape mismatch OR a
+// non-empty inline data field (bulk mode keeps payloads out of the
+// envelope; inline payloads take the Python path).
+bool fp_decode_write_one(const uint8_t* d, size_t len, size_t& pos,
+                         FpWReq& r) {
+  uint64_t nf;
+  if (!get_uvarint(d, len, pos, nf) || nf != 12) return false;
+  int64_t tmp;
+  if (!get_int(d, len, pos, r.chain_id)) return false;
+  if (!get_int(d, len, pos, r.chain_ver)) return false;
+  uint64_t cidf;
+  if (!get_uvarint(d, len, pos, cidf) || cidf != 2) return false;
+  if (!get_int(d, len, pos, tmp)) return false;
+  r.file_id = uint64_t(tmp);
+  if (!get_int(d, len, pos, tmp)) return false;
+  r.index = uint32_t(tmp);
+  if (!get_int(d, len, pos, r.offset)) return false;
+  uint64_t data_len;
+  if (!get_uvarint(d, len, pos, data_len) || data_len != 0) return false;
+  if (!get_int(d, len, pos, r.chunk_size)) return false;
+  uint64_t sl;  // client_id (skipped)
+  if (!get_uvarint(d, len, pos, sl) || pos + sl > len) return false;
+  pos += sl;
+  if (!get_int(d, len, pos, tmp)) return false;  // channel_id
+  if (!get_int(d, len, pos, tmp)) return false;  // seqnum
+  if (!get_int(d, len, pos, r.update_ver)) return false;
+  if (pos >= len) return false;
+  r.full_replace = d[pos++] != 0;  // bool = one raw byte
+  if (!get_int(d, len, pos, r.from_target)) return false;
+  return true;
+}
+
+// decode BatchWriteReq{reqs: List[WriteReq]}
+bool fp_decode_write_reqs(const uint8_t* d, size_t len,
+                          std::vector<FpWReq>& out) {
+  size_t pos = 0;
+  uint64_t nfields, count;
+  if (!get_uvarint(d, len, pos, nfields) || nfields != 1) return false;
+  if (!get_uvarint(d, len, pos, count) || count == 0 || count > 65536)
+    return false;
+  out.reserve(count);
+  for (uint64_t i = 0; i < count; i++) {
+    FpWReq r;
+    if (!fp_decode_write_one(d, len, pos, r)) return false;
+    out.push_back(r);
+  }
+  return pos == len;
+}
+
+// bulk section -> per-segment (offset, length) into the section buffer
+bool fp_split_bulk(const std::string& bulk,
+                   std::vector<std::pair<uint64_t, uint64_t>>& segs) {
+  const uint8_t* d = reinterpret_cast<const uint8_t*>(bulk.data());
+  size_t len = bulk.size(), pos = 0;
+  uint64_t count;
+  if (!get_uvarint(d, len, pos, count) || count > 65536) return false;
+  std::vector<uint64_t> lens(count);
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < count; i++) {
+    if (!get_uvarint(d, len, pos, lens[i])) return false;
+    total += lens[i];
+  }
+  if (pos + total != len) return false;
+  segs.reserve(count);
+  for (uint64_t i = 0; i < count; i++) {
+    segs.emplace_back(pos, lens[i]);
+    pos += lens[i];
+  }
+  return true;
+}
+
+void fp_put_update_reply(std::string& buf, int64_t code, int64_t update_ver,
+                         int64_t commit_ver, uint32_t crc, uint32_t len) {
+  // UpdateReply{code, update_ver, commit_ver, checksum{value,length}, msg}
+  put_uvarint(buf, 5);
+  put_int(buf, code);
+  put_int(buf, update_ver);
+  put_int(buf, commit_ver);
+  put_uvarint(buf, 2);
+  put_int(buf, int64_t(crc));
+  put_int(buf, int64_t(len));
+  put_uvarint(buf, 0);  // empty message
+}
+
+constexpr int32_t kEngineStale = -3;  // chunk_engine E_STALE_UPDATE
+
+// true when handled (payload filled); false => fall back to Python
+bool fp_try_batch_write(FpState& fp, const Packet& req, std::string& payload) {
+  if (!req.has_bulk) return false;
+  std::vector<FpWReq> ops;
+  const uint8_t* d = reinterpret_cast<const uint8_t*>(req.payload.data());
+  if (!fp_decode_write_reqs(d, req.payload.size(), ops)) return false;
+  std::vector<std::pair<uint64_t, uint64_t>> segs;
+  if (!fp_split_bulk(req.bulk, segs) || segs.size() != ops.size())
+    return false;
+  std::vector<FpWriteChain> tgts(ops.size());
+  std::vector<std::array<uint8_t, 12>> keys(ops.size());
+  fp_batch_write_t engine_write;
+  {
+    std::lock_guard<std::mutex> g(fp.mu);
+    engine_write = fp.batch_write;
+    if (engine_write == nullptr || fp.write_chains.empty()) return false;
+    std::set<std::array<uint8_t, 12>> seen;
+    for (size_t i = 0; i < ops.size(); i++) {
+      const FpWReq& r = ops[i];
+      auto it = fp.write_chains.find(r.chain_id);
+      // every guard mirrors a Python-path precondition: registered tail,
+      // same chain version, chain-internal (head already staged/deduped),
+      // an assigned version, and in-bounds extent
+      if (it == fp.write_chains.end()) return false;
+      if (r.chain_ver != it->second.chain_ver) return false;
+      if (r.from_target == 0 || r.update_ver <= 0) return false;
+      if (r.offset < 0 ||
+          uint64_t(r.offset) + segs[i].second > it->second.chunk_size)
+        return false;
+      std::array<uint8_t, 12>& key = keys[i];  // >QI big-endian, once
+      for (int b = 0; b < 8; b++)
+        key[b] = uint8_t(r.file_id >> (8 * (7 - b)));
+      for (int b = 0; b < 4; b++)
+        key[8 + b] = uint8_t(r.index >> (8 * (3 - b)));
+      if (!seen.insert(key).second)
+        return false;  // same-chunk dups keep Python's ordered path
+      tgts[i] = it->second;
+    }
+    fp.inflight.fetch_add(1);
+  }
+  struct InflightGuard {
+    FpState& fp;
+    ~InflightGuard() { fp.inflight.fetch_sub(1); }
+  } guard{fp};
+  // group by (engine, chain_ver): one ce_batch_write per engine
+  std::map<void*, std::vector<size_t>> by_engine;
+  for (size_t i = 0; i < ops.size(); i++)
+    by_engine[tgts[i].engine].push_back(i);
+  const uint8_t* blob = reinterpret_cast<const uint8_t*>(req.bulk.data());
+  std::vector<FpOpResult> outs(ops.size());
+  for (auto& kv : by_engine) {
+    auto& idxs = kv.second;
+    std::vector<FpUpOp> wops(idxs.size());
+    std::vector<FpOpResult> res(idxs.size());
+    for (size_t j = 0; j < idxs.size(); j++) {
+      const FpWReq& r = ops[idxs[j]];
+      FpUpOp& o = wops[j];
+      memset(&o, 0, sizeof(o));
+      memcpy(o.key, keys[idxs[j]].data(), 12);
+      o.flags = r.full_replace ? 1 : 0;
+      o.offset = uint32_t(r.offset);
+      o.data_len = uint32_t(segs[idxs[j]].second);
+      o.chunk_size = uint32_t(tgts[idxs[j]].chunk_size);
+      o.data_off = segs[idxs[j]].first;
+      o.update_ver = uint64_t(r.update_ver);
+    }
+    if (engine_write(kv.first, uint64_t(ops[idxs[0]].chain_ver), blob,
+                     wops.data(), res.data(), int(idxs.size())) != 0)
+      return false;
+    for (size_t j = 0; j < idxs.size(); j++) {
+      if (res[j].rc != 0 && res[j].rc != kEngineStale)
+        return false;  // Python re-runs the batch; engine ops idempotent
+      outs[idxs[j]] = res[j];
+    }
+  }
+  payload.clear();
+  put_uvarint(payload, 1);  // BatchWriteRsp field count
+  put_uvarint(payload, ops.size());
+  for (size_t i = 0; i < ops.size(); i++) {
+    const FpOpResult& o = outs[i];
+    // OK: committed at the staged version. Stale: idempotent duplicate —
+    // report the committed state (mirrors the Python tail's replies)
+    fp_put_update_reply(payload, 0, ops[i].update_ver, int64_t(o.ver),
+                        o.crc, o.len);
+  }
+  fp.hits.fetch_add(1);
+  return true;
+}
+
 constexpr int64_t kStorageServiceId = 3;
 constexpr int64_t kBatchReadMethodId = 11;
 constexpr int64_t kReadMethodId = 3;
+constexpr int64_t kBatchUpdateMethodId = 15;
 
 // ---- server ---------------------------------------------------------------
 // handler v2: returns status; on success fills *rsp (malloc'd) + *rsp_len;
@@ -746,6 +978,38 @@ void worker_main(Server* s) {
         if (!job.conn->closed.load() &&
             !send_iovs(job.conn->fd, iov2, fp_reply_bulk ? 3 : 2,
                        kServerDrainTimeoutMs)) {
+          server_close_conn(s, job.conn);
+        }
+        continue;
+      }
+      s->fastpath.fallbacks.fetch_add(1);
+    }
+    // native write fast path: the chain-internal batchUpdate hop against
+    // a registered tail target never enters Python either
+    if (req.service_id == kStorageServiceId &&
+        req.method_id == kBatchUpdateMethodId) {
+      std::string fp_payload;
+      bool handled = false;
+      try {
+        handled = fp_try_batch_write(s->fastpath, req, fp_payload);
+      } catch (...) {
+        handled = false;  // fall back; InflightGuard unwinds the count
+      }
+      if (handled) {
+        rsp.status = OK;
+        rsp.payload = std::move(fp_payload);
+        rsp.ts[5] = mono_now();
+        std::string env2 = encode_packet(rsp);
+        uint64_t total2 = env2.size();
+        uint8_t hdr2[4] = {uint8_t(total2 >> 24), uint8_t(total2 >> 16),
+                           uint8_t(total2 >> 8), uint8_t(total2)};
+        struct iovec iov2[2] = {
+            {hdr2, 4},
+            {const_cast<char*>(env2.data()), env2.size()},
+        };
+        std::lock_guard<std::mutex> g(job.conn->write_mu);
+        if (!job.conn->closed.load() &&
+            !send_iovs(job.conn->fd, iov2, 2, kServerDrainTimeoutMs)) {
           server_close_conn(s, job.conn);
         }
         continue;
@@ -1198,6 +1462,15 @@ void tpu3fs_rpc_fastpath_del_target(void* srv, int64_t target_id) {
   {
     std::lock_guard<std::mutex> g(s->fastpath.mu);
     s->fastpath.targets.erase(target_id);
+    // write registry is keyed by chain; drop any entry whose tail is this
+    // target (offline_target's immediate-refusal contract covers writes)
+    for (auto it = s->fastpath.write_chains.begin();
+         it != s->fastpath.write_chains.end();) {
+      if (it->second.target_id == target_id)
+        it = s->fastpath.write_chains.erase(it);
+      else
+        ++it;
+    }
   }
   fp_drain(s->fastpath);
 }
@@ -1207,8 +1480,28 @@ void tpu3fs_rpc_fastpath_clear(void* srv) {
   {
     std::lock_guard<std::mutex> g(s->fastpath.mu);
     s->fastpath.targets.clear();
+    s->fastpath.write_chains.clear();
   }
   fp_drain(s->fastpath);
+}
+
+// ---- write fast path control ----------------------------------------------
+
+void tpu3fs_rpc_fastpath_install_write(void* srv, void* batch_write_fn) {
+  auto* s = static_cast<Server*>(srv);
+  std::lock_guard<std::mutex> g(s->fastpath.mu);
+  s->fastpath.batch_write =
+      reinterpret_cast<fp_batch_write_t>(batch_write_fn);
+}
+
+void tpu3fs_rpc_fastpath_set_write_chain(void* srv, int64_t chain_id,
+                                         void* engine, int64_t target_id,
+                                         int64_t chain_ver,
+                                         uint64_t chunk_size) {
+  auto* s = static_cast<Server*>(srv);
+  std::lock_guard<std::mutex> g(s->fastpath.mu);
+  s->fastpath.write_chains[chain_id] =
+      FpWriteChain{engine, target_id, chain_ver, chunk_size};
 }
 
 // hits and fallbacks, for tests and metrics

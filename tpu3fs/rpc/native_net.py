@@ -145,6 +145,12 @@ def _load_lib():
         lib.tpu3fs_rpc_fastpath_del_target.argtypes = [
             ctypes.c_void_p, ctypes.c_int64]
         lib.tpu3fs_rpc_fastpath_clear.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "tpu3fs_rpc_fastpath_install_write"):  # stale .so
+            lib.tpu3fs_rpc_fastpath_install_write.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p]
+            lib.tpu3fs_rpc_fastpath_set_write_chain.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64]
         lib.tpu3fs_rpc_fastpath_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64)]
@@ -236,9 +242,25 @@ class NativeRpcServer:
                 self._srv, target_id, h, chain_id, chunk_size)
 
     def fastpath_del_target(self, target_id: int) -> None:
-        """Drop one target now; drains in-flight reads before returning."""
+        """Drop one target now (read registry AND any write-chain entry
+        whose tail it is); drains in-flight ops before returning."""
         if self._srv is not None:
             self._lib.tpu3fs_rpc_fastpath_del_target(self._srv, target_id)
+
+    def fastpath_sync_write(self, batch_write_fn, wanted: dict) -> None:
+        """Install the write-chain registry:
+        {chain_id: (engine_handle, target_id, chain_ver, chunk_size)} —
+        chains whose LOCAL target is the serving tail. Call AFTER
+        fastpath_sync (whose clear() drops both registries)."""
+        if self._srv is None or not hasattr(
+                self._lib, "tpu3fs_rpc_fastpath_install_write"):
+            return
+        if batch_write_fn is not None:
+            self._lib.tpu3fs_rpc_fastpath_install_write(
+                self._srv, batch_write_fn)
+        for chain_id, (h, target_id, chain_ver, chunk_size) in wanted.items():
+            self._lib.tpu3fs_rpc_fastpath_set_write_chain(
+                self._srv, chain_id, h, target_id, chain_ver, chunk_size)
 
     def fastpath_stats(self):
         hits = ctypes.c_uint64(0)

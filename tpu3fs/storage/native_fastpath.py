@@ -52,7 +52,10 @@ def sync_read_fastpath(server, svc) -> int:
     except Exception:
         routing = None
     wanted = {}
+    wanted_write = {}
     batch_read_fn = None
+    batch_write_fn = None
+    local_ids = {t.target_id for t in svc.targets()}
     for target in svc.targets():
         h, lib = _native_engine_handle(target)
         if h is None:
@@ -69,7 +72,27 @@ def sync_read_fastpath(server, svc) -> int:
         wanted[target.target_id] = (h, target.chain_id, target.chunk_size)
         if batch_read_fn is None:
             batch_read_fn = ctypes.cast(lib.ce_batch_read, ctypes.c_void_p)
+            batch_write_fn = (
+                ctypes.cast(lib.ce_batch_write, ctypes.c_void_p)
+                if hasattr(lib, "ce_batch_write") else None)
+        # write-chain registration (the chain-internal batchUpdate hop):
+        # this target must be the TAIL of a fully-SERVING CR chain, and no
+        # earlier writer-chain member may be local (the Python dispatch
+        # picks the FIRST local writer — the fast path must answer for
+        # exactly the target Python would have picked). Any SYNCING member
+        # changes forward semantics (full-replace installs), so those
+        # chains stay on the Python path entirely.
+        if (not chain.is_ec
+                and all(t.public_state.can_write for t in chain.targets)
+                and chain.targets[-1].target_id == target.target_id
+                and not any(t.target_id in local_ids
+                            for t in chain.targets[:-1])):
+            wanted_write[target.chain_id] = (
+                h, target.target_id, chain.chain_version, target.chunk_size)
     sync(batch_read_fn, wanted)
+    sync_write = getattr(server, "fastpath_sync_write", None)
+    if sync_write is not None and batch_write_fn is not None:
+        sync_write(batch_write_fn, wanted_write)
     # local offlining promises IMMEDIATE refusal (craq offline_target):
     # hand the service an invalidator so the C++ registry drops the
     # target in the same call, not at the next scan
