@@ -266,6 +266,18 @@ def _phase_e2e(platform: str) -> dict:
     return out
 
 
+def _phase_northstar(platform: str) -> dict:
+    """BASELINE.md's headline workloads, scaled to the bench budget:
+    GraySort-style shuffle (solver-validated placement + device-sorted
+    range partitioning + batched write-back), KVCache 128 KiB random
+    reads racing a TTL GC on RS(12,4), and a sized failed-node EC
+    rebuild. Sizes via TPU3FS_NS_* env knobs (northstar_bench)."""
+    _init_jax(platform)
+    from benchmarks.northstar_bench import run_all
+
+    return run_all()
+
+
 def _phase_e2e_tpu(platform: str) -> dict:
     """EC serving path with the DEVICE data plane: fabric write/read and a
     failed-node rebuild where stripe encode + CRC32C run on the accelerator
@@ -333,10 +345,12 @@ _PHASE_FNS = {
     "secondary": _phase_secondary,
     "e2e": _phase_e2e,
     "e2e_tpu": _phase_e2e_tpu,
+    "northstar": _phase_northstar,
 }
 KERNEL_PHASES = ("headline", "exactness", "secondary")
 CAPTURE_PHASES = KERNEL_PHASES + ("e2e_tpu",)
 PHASE_TIMEOUT_S["e2e_tpu"] = 600
+PHASE_TIMEOUT_S["northstar"] = 900
 
 
 # --------------------------------------------------------------------------
@@ -597,6 +611,16 @@ def main() -> None:
     phases = _run_kernel_phases(platform, state)
     e2e = _run_phase("e2e", platform)
     state["phases"]["e2e"] = e2e
+    _persist(PARTIAL_PATH, state)
+    ns = _run_phase("northstar", platform)
+    state["phases"]["northstar"] = ns
+    for k, v in ns.items():
+        if k in ("platform", "device", "detail"):
+            continue  # phase plumbing, not metrics
+        if not k.startswith("error"):
+            e2e[k] = v  # north-star fields ride the e2e merge below
+        else:
+            e2e["northstar_phase_error"] = v
     _persist(PARTIAL_PATH, state)
 
     live_tpu = _capture_is_tpu(phases)

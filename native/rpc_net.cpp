@@ -690,8 +690,10 @@ bool fp_decode_write_one(const uint8_t* d, size_t len, size_t& pos,
   uint64_t data_len;
   if (!get_uvarint(d, len, pos, data_len) || data_len != 0) return false;
   if (!get_int(d, len, pos, r.chunk_size)) return false;
-  uint64_t sl;  // client_id (skipped)
-  if (!get_uvarint(d, len, pos, sl) || pos + sl > len) return false;
+  uint64_t sl;  // client_id (skipped); `sl > len - pos`, NOT `pos + sl >
+                // len` — the latter wraps for crafted huge varints (same
+                // guard as get_str above)
+  if (!get_uvarint(d, len, pos, sl) || sl > len - pos) return false;
   pos += sl;
   if (!get_int(d, len, pos, tmp)) return false;  // channel_id
   if (!get_int(d, len, pos, tmp)) return false;  // seqnum
@@ -782,6 +784,12 @@ bool fp_try_batch_write(FpState& fp, const Packet& req, std::string& payload) {
       if (it == fp.write_chains.end()) return false;
       if (r.chain_ver != it->second.chain_ver) return false;
       if (r.from_target == 0 || r.update_ver <= 0) return false;
+      // a request-carried chunk_size that disagrees with the registered
+      // target would make our accept/reject behavior diverge from the
+      // Python tail (which honors `r.chunk_size or target.chunk_size`)
+      if (r.chunk_size != 0 &&
+          uint64_t(r.chunk_size) != it->second.chunk_size)
+        return false;
       if (r.offset < 0 ||
           uint64_t(r.offset) + segs[i].second > it->second.chunk_size)
         return false;

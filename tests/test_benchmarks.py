@@ -62,3 +62,33 @@ class TestRebuildBench:
         assert len(rows) == 2
         assert rows[0]["metric"] == "rs_rebuild_4_2_lost1"
         assert all(r["value"] > 0 for r in rows)
+
+
+class TestNorthstarBench:
+    """BASELINE.md headline workloads at test sizes: each phase must
+    produce its e2e_* field and verify its own data integrity."""
+
+    def test_graysort_shuffle(self):
+        from benchmarks.northstar_bench import graysort_shuffle
+
+        out = graysort_shuffle(total_mb=8, partitions=8, nodes=4, chains=8)
+        assert out["e2e_graysort_shuffle_gibps"] > 0
+        assert out["e2e_graysort_readback_gibps"] > 0
+        assert out["graysort_bytes"] == 8 << 20
+        assert out["graysort_placement_checked"]
+
+    def test_kvcache_random_read_with_gc(self):
+        from benchmarks.northstar_bench import kvcache_random_read
+
+        out = kvcache_random_read(hot_entries=8, expired_entries=16,
+                                  value_kb=16, reads=32, batch=8)
+        assert out["e2e_kvcache_read_gibps"] > 0
+        assert out["kvcache_gc_removed"] == 16  # exactly the expired pool
+        assert out["e2e_kvcache_gc_remove_iops"] > 0
+
+    def test_failed_target_rebuild(self):
+        from benchmarks.northstar_bench import failed_target_rebuild
+
+        out = failed_target_rebuild(file_mb=8, chunk_mb=1)
+        assert out["e2e_rebuild_gibps"] > 0
+        assert out["e2e_rebuild_bytes"] > 0

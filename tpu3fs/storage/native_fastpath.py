@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import ctypes
 
-from tpu3fs.mgmtd.types import LocalTargetState
+from tpu3fs.mgmtd.types import LocalTargetState, PublicTargetState
 
 
 def _native_engine_handle(target):
@@ -83,7 +83,8 @@ def sync_read_fastpath(server, svc) -> int:
         # changes forward semantics (full-replace installs), so those
         # chains stay on the Python path entirely.
         if (not chain.is_ec
-                and all(t.public_state.can_write for t in chain.targets)
+                and all(t.public_state == PublicTargetState.SERVING
+                        for t in chain.targets)
                 and chain.targets[-1].target_id == target.target_id
                 and not any(t.target_id in local_ids
                             for t in chain.targets[:-1])):
