@@ -345,3 +345,77 @@ class TestForeignProcessUsrbio:
             m.unmount()
             subprocess.run(["fusermount", "-u", "-z", mnt],
                            check=False, capture_output=True)
+
+
+class TestReaddirplus:
+    """readdirplus returns full attrs with entries and primes the attr
+    cache so the `ls -l` getattr storm never re-hits meta; any mutation
+    drops the cache (ref fuse_lowlevel readdirplus, FuseOps.cc:2580-2613)."""
+
+    def test_entries_carry_full_attrs(self, fuse_ops):
+        o = fuse_ops
+        o.mkdir("/plus", 0o755)
+        fh = o.create("/plus/a", 0o644)
+        o.write(fh, 0, b"x" * 1234)
+        o.release(fh)
+        o.mkdir("/plus/sub", 0o700)
+        entries = dict(o.readdirplus("/plus"))
+        assert entries["a"].size == 1234
+        assert entries["a"].nlink >= 1 and entries["a"].mode
+        assert entries["sub"].mode & 0o170000  # type bits present
+
+    def test_getattr_storm_served_from_cache(self, fuse_ops):
+        o = fuse_ops
+        o.mkdir("/storm", 0o755)
+        for i in range(5):
+            o.release(o.create(f"/storm/f{i}", 0o644))
+        calls = []
+        real_stat = o._meta.stat
+
+        def counting_stat(path, **kw):
+            calls.append(path)
+            return real_stat(path, **kw)
+
+        o._meta.stat = counting_stat
+        try:
+            listed = dict(o.readdirplus("/storm"))
+            for name in listed:
+                got = o.getattr(f"/storm/{name}")
+                assert got.ino == listed[name].ino
+            assert calls == [], f"getattr after readdirplus hit meta: {calls}"
+        finally:
+            o._meta.stat = real_stat
+
+    def test_mutation_drops_cache(self, fuse_ops):
+        o = fuse_ops
+        o.mkdir("/mut", 0o755)
+        fh = o.create("/mut/f", 0o644)
+        o.release(fh)
+        o.readdirplus("/mut")
+        assert o._attr_cache  # primed
+        o.unlink("/mut/f")
+        assert not o._attr_cache  # mutator cleared it
+        # and a stale entry can no longer be served
+        import pytest as _pytest
+
+        from tpu3fs.utils.result import FsError
+
+        with _pytest.raises(FsError):
+            o.getattr("/mut/f")
+
+    def test_length_settle_and_trunc_not_served_stale(self, fuse_ops):
+        """open(O_TRUNC)/release change attrs: the cache must not serve
+        the pre-mutation size within its TTL."""
+        o = fuse_ops
+        o.mkdir("/settle", 0o755)
+        fh = o.create("/settle/f", 0o644)
+        o.write(fh, 0, b"y" * 2048)
+        o.release(fh)
+        o.readdirplus("/settle")  # primes cache with size=2048
+        o.truncate("/settle/f", 0)
+        assert o.getattr("/settle/f").size == 0
+        fh2 = o.create("/settle/g", 0o644)
+        o.readdirplus("/settle")
+        o.write(fh2, 0, b"z" * 999)
+        o.release(fh2)  # settles length; must clear the cache
+        assert o.getattr("/settle/g").size == 999

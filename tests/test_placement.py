@@ -87,3 +87,66 @@ class TestRegressions:
         M = solve_placement(p, steps=10)
         assert check_solution(M, p)
         assert (M == 1).all()
+
+
+class TestAnnealerOptimality:
+    """Round-4 verdict #9: the annealer's max pairwise co-occurrence
+    (lambda) must match the exact optimum on instances small enough to
+    brute force — 'falls back to greedy' must not hide systematically
+    mediocre tables. Mirrors the reference validating its solver against
+    check_solution (deploy/data_placement/src/model/data_placement.py)."""
+
+    @staticmethod
+    def _brute_force_opt_lambda(v: int, k: int, r: int) -> int:
+        """Exact minimal max-lambda over ALL incidence matrices with row
+        sums k and column sums r (DFS over non-decreasing row combos with
+        column-budget + best-bound pruning)."""
+        import itertools
+
+        b = v * r // k
+        combos = [np.array(c) for c in itertools.combinations(range(v), k)]
+        best = [k * b + 1]
+        col = np.zeros(v, dtype=int)
+        lam = np.zeros((v, v), dtype=int)
+
+        def dfs(row: int, start: int, cur_max: int) -> None:
+            if cur_max >= best[0]:
+                return
+            if row == b:
+                best[0] = cur_max
+                return
+            for ci in range(start, len(combos)):
+                c = combos[ci]
+                if (col[c] + 1 > r).any():
+                    continue
+                col[c] += 1
+                pairs = [(c[i], c[j]) for i in range(k)
+                         for j in range(i + 1, k)]
+                for a, d in pairs:
+                    lam[a, d] += 1
+                new_max = max(cur_max, max(lam[a, d] for a, d in pairs))
+                dfs(row + 1, ci, new_max)
+                for a, d in pairs:
+                    lam[a, d] -= 1
+                col[c] -= 1
+
+        dfs(0, 0, 0)
+        return best[0]
+
+    @pytest.mark.parametrize("v,k,r", [
+        (4, 2, 2), (5, 2, 2), (4, 2, 3), (6, 2, 2), (6, 3, 2), (5, 5, 2),
+    ])
+    def test_annealer_matches_brute_force(self, v, k, r):
+        opt = self._brute_force_opt_lambda(v, k, r)
+        prob = PlacementProblem(num_nodes=v, group_size=k,
+                                targets_per_node=r)
+        M = solve_placement(prob, steps=400, proposals_per_step=64, seed=1)
+        assert check_solution(M, prob)
+        cooc = M.T.astype(int) @ M.astype(int)
+        np.fill_diagonal(cooc, 0)
+        got = int(cooc.max())
+        assert got <= opt + 0, (
+            f"annealer lambda {got} worse than brute-force optimum {opt} "
+            f"on (v={v}, k={k}, r={r})")
+        # and the optimum is actually achievable (sanity on the oracle)
+        assert got >= opt or k == 1

@@ -576,6 +576,171 @@ class AdminCli:
             f"({num} x {size}B chunks)"
         )
 
+    # -- forensic dumps (ref DumpInodes/DumpDirEntries/DumpChunkMeta/
+    # DumpChains/DumpChainTable/DumpSession in src/client/cli/admin/) ------
+    def cmd_dump_inodes(self, args: List[str]) -> str:
+        """dump-inodes FILE: JSONL of EVERY inode record, straight off the
+        KV scan (ref DumpInodes.cc) — includes unlinked-but-open and
+        orphaned inodes a path walk would miss, which is the point of a
+        forensic dump."""
+        import json as _json
+
+        from tpu3fs.meta.scan import scan_inodes
+
+        n = 0
+        with open(args[0], "w") as f:
+            for ino in scan_inodes(self.fab.kv):
+                f.write(_json.dumps({
+                    "id": ino.id, "type": ino.type.name,
+                    "parent": ino.parent,
+                    "length": getattr(ino, "length", 0),
+                    "nlink": ino.nlink, "uid": ino.acl.uid,
+                    "gid": ino.acl.gid, "perm": ino.acl.perm,
+                    "mtime": ino.mtime, "ctime": ino.ctime,
+                }) + "\n")
+                n += 1
+        return f"dumped {n} inodes to {args[0]}"
+
+    def cmd_dump_dentries(self, args: List[str]) -> str:
+        """dump-dentries FILE: JSONL of every directory-entry record,
+        straight off the KV scan (ref DumpDirEntries.cc)."""
+        import json as _json
+
+        from tpu3fs.meta.scan import scan_dirents
+
+        n = 0
+        with open(args[0], "w") as f:
+            for ent in scan_dirents(self.fab.kv):
+                f.write(_json.dumps({
+                    "parent_id": ent.parent, "name": ent.name,
+                    "inode_id": ent.inode_id, "type": ent.type.name,
+                }) + "\n")
+                n += 1
+        return f"dumped {n} dentries to {args[0]}"
+
+    def cmd_dump_chunkmeta(self, args: List[str]) -> str:
+        """dump-chunkmeta TARGET_ID FILE: JSONL chunk metadata of one
+        storage target (ref DumpChunkMeta.cc)."""
+        import json as _json
+
+        target_id, out_path = int(args[0]), args[1]
+        routing = self.fab.routing()
+        node = routing.node_of_target(target_id)
+        if node is None:
+            return f"target {target_id} not in routing"
+        metas = self.fab.send(node.node_id, "dump_chunkmeta", target_id)
+        with open(out_path, "w") as f:
+            for m in metas:
+                f.write(_json.dumps({
+                    "chunk": [m.chunk_id.file_id, m.chunk_id.index],
+                    "committed_ver": m.committed_ver,
+                    "pending_ver": m.pending_ver,
+                    "chain_ver": m.chain_ver, "length": m.length,
+                    "crc": m.checksum.value,
+                }) + "\n")
+        return f"dumped {len(metas)} chunk metas to {out_path}"
+
+    def cmd_dump_chains(self, args: List[str]) -> str:
+        """dump-chains FILE: routing chain snapshot (ref DumpChains.cc)."""
+        import json as _json
+
+        routing = self.fab.routing()
+        blob = {
+            str(cid): {
+                "version": c.chain_version,
+                "ec": [c.ec_k, c.ec_m] if c.is_ec else None,
+                "targets": [[t.target_id, t.public_state.name]
+                            for t in c.targets],
+            } for cid, c in sorted(routing.chains.items())
+        }
+        with open(args[0], "w") as f:
+            _json.dump(blob, f, indent=1)
+        return f"dumped {len(blob)} chains to {args[0]}"
+
+    def cmd_dump_chain_table(self, args: List[str]) -> str:
+        """dump-chain-table FILE [TABLE_ID] (ref DumpChainTable.cc)."""
+        import json as _json
+
+        routing = self.fab.routing()
+        tables = routing.chain_tables
+        want = int(args[1]) if len(args) > 1 else None
+        blob = {str(tid): {"version": t.version, "chains": list(t.chain_ids)}
+                for tid, t in tables.items()
+                if want is None or tid == want}
+        with open(args[0], "w") as f:
+            _json.dump(blob, f, indent=1)
+        return f"dumped {len(blob)} chain tables to {args[0]}"
+
+    def cmd_dump_sessions(self, args: List[str]) -> str:
+        """dump-sessions [FILE]: live file write sessions
+        (ref DumpSession.cc)."""
+        import json as _json
+
+        rows = [{"inode": s.inode_id, "client": s.client_id,
+                 "session": s.session_id}
+                for s in self.fab.meta.list_sessions()]
+        if args:
+            with open(args[0], "w") as f:
+                for r in rows:
+                    f.write(_json.dumps(r) + "\n")
+            return f"dumped {len(rows)} sessions to {args[0]}"
+        return "\n".join(
+            f"inode={r['inode']} client={r['client']} "
+            f"session={r['session']}" for r in rows) or "(none)"
+
+    def cmd_list_clients(self, args: List[str]) -> str:
+        """Distinct client ids holding write sessions
+        (ref ListClients.cc)."""
+        clients = sorted({s.client_id
+                          for s in self.fab.meta.list_sessions()})
+        return "\n".join(clients) or "(none)"
+
+    def cmd_list_gc(self, args: List[str]) -> str:
+        """Pending GC queue entries (ref ListGc.cc)."""
+        limit = int(args[0]) if args else 64
+        inodes = self.fab.meta.gc_scan(limit=limit)
+        return "\n".join(
+            f"inode={i.id} length={getattr(i, 'length', 0)}"
+            for i in inodes) or "(empty)"
+
+    def cmd_get_real_path(self, args: List[str]) -> str:
+        """Resolve symlinks to the canonical path
+        (ref GetRealPath.cc)."""
+        return self.fab.meta.get_real_path(args[0])
+
+    def cmd_decode_user_token(self, args: List[str]) -> str:
+        """Resolve a bearer token to its user record
+        (ref DecodeUserToken.cc)."""
+        rec = self._users().authenticate(args[0])
+        if rec is None:
+            return "invalid token"
+        return (f"uid={rec.uid} name={rec.name} gid={rec.gid} "
+                f"groups={rec.groups} admin={rec.admin} root={rec.root}")
+
+    def cmd_fill_zero(self, args: List[str]) -> str:
+        """fill-zero PATH BYTES: materialize zeros (ref FillZero.cc)."""
+        path, nbytes = args[0], int(args[1])
+        res = self.fab.meta.create(path, flags=OpenFlags.WRITE,
+                                   client_id="cli")
+        fio = self.fab.file_client()
+        step = 1 << 20
+        for off in range(0, nbytes, step):
+            fio.write(res.inode, off, b"\x00" * min(step, nbytes - off))
+        self.fab.meta.close(res.inode.id, client_id="cli",
+                            session_id=res.session_id)
+        return f"filled {nbytes} zero bytes into {path}"
+
+    def cmd_create_range(self, args: List[str]) -> str:
+        """create-range PREFIX N: create N empty files
+        (ref CreateRange.cc)."""
+        prefix, n = args[0], int(args[1])
+        for i in range(n):
+            res = self.fab.meta.create(f"{prefix}{i}", client_id="cli")
+            self.fab.meta.close(res.inode.id, client_id="cli",
+                                session_id=res.session_id)
+        return f"created {n} files at {prefix}0..{prefix}{n - 1}"
+
+
 
 class RpcFabricView:
     """Live-cluster adapter for AdminCli: exposes the same .mgmtd / .meta /

@@ -314,10 +314,10 @@ class FuseMount:
             st = c_stat()
             for name in (".", ".."):
                 filler(buf, name.encode(), None, 0)
-            for name, attr in o.readdir(p(path)):
-                memset(pointer(st), 0, sizeof(c_stat))
-                st.st_ino = attr.ino
-                st.st_mode = attr.mode
+            # readdirplus form: full attrs come back with the entries (and
+            # prime FuseOps' attr cache for the getattr storm that follows)
+            for name, attr in o.readdirplus(p(path)):
+                _fill_stat(pointer(st), attr)
                 filler(buf, name.encode(), pointer(st), 0)
 
         def releasedir(path, fi):

@@ -99,6 +99,33 @@ class FuseOps:
         # 3fs-virt registrations: name -> symlink target
         self._virt: Dict[str, Dict[str, str]] = {d: {} for d in _VIRT_SUBDIRS}
         self._virt_iovs: Dict[str, object] = {}
+        # readdirplus attr cache: the `ls -l` pattern is one readdir
+        # followed by a getattr per entry — readdirplus (ref FuseOps.cc's
+        # fuse_lowlevel readdirplus, :2580-2613) returns attrs WITH the
+        # entries; this cache lets the follow-up getattr storm hit memory
+        # instead of one meta batch_stat turning into N meta stats. Any
+        # mutating op clears it wholesale (cheap, and exactly matches the
+        # pattern's interleaving-free window); entries also expire by TTL.
+        self._attr_cache: Dict[str, Tuple[float, Attr]] = {}
+        self._attr_cache_ttl = 1.0
+        # every mutating entry point drops the cache wholesale BEFORE
+        # running (instance-level wrap: one list to keep current, and a
+        # forgotten future mutator fails loudly in tests rather than
+        # serving stale attrs from a path we forgot to hand-invalidate)
+        # open/release/fsync/flush belong here too: open(O_TRUNC) cuts the
+        # file and release/fsync/flush settle its length at meta — all
+        # change the attrs a cached entry would go on serving
+        for _name in ("chmod", "chown", "utimens", "truncate", "mkdir",
+                      "rmdir", "unlink", "rename", "symlink", "link",
+                      "create", "write", "setxattr", "removexattr",
+                      "open", "release", "fsync", "flush"):
+            _orig = getattr(self, _name)
+
+            def _wrapped(*a, __orig=_orig, **kw):
+                self._attr_cache_clear()
+                return __orig(*a, **kw)
+
+            setattr(self, _name, _wrapped)
 
     # -- helpers -------------------------------------------------------------
     @staticmethod
@@ -142,11 +169,21 @@ class FuseOps:
                     uid=self._uid, gid=self._gid, size=len(target),
                     atime=now, mtime=now, ctime=now)
 
+    def _attr_cache_clear(self) -> None:
+        if self._attr_cache:
+            self._attr_cache.clear()
+
     # -- attr ops (ref fuse lookup/getattr/setattr) --------------------------
     def getattr(self, path: str) -> Attr:
         v = self._virt_parts(path)
         if v is not None:
             return self._virt_attr(*v)
+        hit = self._attr_cache.get(path)
+        if hit is not None:
+            ts, attr = hit
+            if time.time() - ts <= self._attr_cache_ttl:
+                return attr
+            self._attr_cache.pop(path, None)
         return self._attr_of(self._meta.stat(path, follow=False))
 
     def readlink(self, path: str) -> str:
@@ -215,6 +252,14 @@ class FuseOps:
         self._meta.hard_link(src, dst)
 
     def readdir(self, path: str) -> List[Tuple[str, Attr]]:
+        return self.readdirplus(path)
+
+    def readdirplus(self, path: str) -> List[Tuple[str, Attr]]:
+        """List entries WITH full attributes in one pass (one list_dir +
+        one batch_stat), priming the attr cache so the per-entry getattr
+        storm that follows (ls -l) is served from memory — the property
+        the reference gets from fuse_lowlevel readdirplus
+        (src/fuse/FuseOps.cc:2580-2613)."""
         v = self._virt_parts(path)
         if v is not None:
             kind, name = v
@@ -228,9 +273,13 @@ class FuseOps:
             entries.append((VIRT_DIR, self._virt_attr("", "")))
         ents = self._meta.list_dir(path)
         children = self._meta.batch_stat([e.inode_id for e in ents])
+        now = time.time()
+        base = path.rstrip("/")
         for ent, child in zip(ents, children):
             if child is not None:
-                entries.append((ent.name, self._attr_of(child)))
+                attr = self._attr_of(child)
+                entries.append((ent.name, attr))
+                self._attr_cache[f"{base}/{ent.name}"] = (now, attr)
         return entries
 
     # -- extended attributes (ref FuseOps.cc xattr entries, :2580-2613) -----
