@@ -22,16 +22,21 @@ import time
 import pytest
 
 from tpu3fs.kv.kv import with_transaction
+from tpu3fs.kv.replica import ReplicatedKvService, bind_replicated_kv
 from tpu3fs.utils.result import Code, FsError
 
 from tests.test_kv_replica import Group
 
 
 class KvdExplorer:
-    def __init__(self, seed: int, tmp_path):
+    def __init__(self, seed: int, tmp_path, *, reconfig: bool = False):
         self.rng = random.Random(seed)
         self.group = Group(tmp_path)
         self.eng = self.group.client()
+        # reconfig schedules add membership churn (slower: member
+        # catch-up, extra elections); they run as their own shorter
+        # parametrization so the base schedules stay CI-fast
+        self.reconfig = reconfig
         # oracle: key -> set of POSSIBLE current values. Singleton after
         # an unambiguous ack or an observing read; a FAILED mutation adds
         # its candidate outcomes (any raise may follow a landed commit —
@@ -39,6 +44,7 @@ class KvdExplorer:
         # up to retry-budget stacked applications)
         self.model = {}
         self.keys = [f"k{i}".encode() for i in range(8)]
+        self.next_node_id = 100  # ids for members added by act_reconfig
 
     def _txn(self, fn):
         return with_transaction(self.eng, fn)
@@ -117,7 +123,12 @@ class KvdExplorer:
 
     def act_kill(self) -> None:
         live = [i for i, srv in self.group.servers.items() if srv is not None]
-        if len(live) <= 2:  # keep a quorum possible
+        # never kill below the STRICTEST quorum any live member believes
+        # in (configs differ transiently during reconfig): an unavailable
+        # group is not an interesting schedule — it just burns minutes of
+        # client retry windows
+        qmax = max((self.group.svcs[i]._quorum for i in live), default=2)
+        if len(live) - 1 < qmax:
             return
         victim = self.rng.choice(live)
         self.group.kill_node(victim)
@@ -126,6 +137,74 @@ class KvdExplorer:
         dead = [i for i, srv in self.group.servers.items() if srv is None]
         if dead:
             self.group.start_node(self.rng.choice(dead))
+
+    def act_reconfig(self) -> None:
+        """Online membership change at a RANDOM moment — including mid-
+        election (the target node may be follower/candidate: the call must
+        refuse harmlessly) and racing kills. One node added or removed per
+        attempt; membership truth stays in the logs, and heal_and_check
+        derives the final config from the healed leader."""
+        from tpu3fs.kv.replica import ReconfigReq
+        from tpu3fs.rpc.net import RpcServer
+
+        live = [i for i, srv in self.group.servers.items()
+                if srv is not None]
+        if not live:
+            return
+        target = self.rng.choice(live)  # deliberately ANY node, not leader
+        svc = self.group.svcs[target]
+        peers = dict(svc.peers)
+        grow = self.rng.random() < 0.5 or len(peers) <= 2
+        if grow and len(peers) < 4:
+            nid = self.next_node_id
+            self.next_node_id += 1
+            # fixed low-range port (see reserve_group_port), excluding
+            # every existing member's port — a DEAD member's port probes
+            # as bindable but must stay reserved for its restart
+            from tests.test_kv_replica import reserve_group_port
+
+            srv = RpcServer(port=reserve_group_port(
+                exclude={a[1] for a in self.group.peers.values()}))
+            peers[nid] = ("127.0.0.1", srv.port)
+            # start the candidate member BEFORE proposing it, so an
+            # accepted config always has a live process behind it; a
+            # plainly-REFUSED proposal (no entry appended) tears it back
+            # down below — a ghost replica in group.peers would pollute
+            # every later restart's bootstrap map
+            new_svc = ReplicatedKvService(
+                nid, peers, data_dir=self.group.dirs[1] + f"-m{nid}",
+                **self.group._kw)
+            bind_replicated_kv(srv, new_svc)
+            srv.start()
+            from tpu3fs.kv.replica import ReconfigReq as _RR
+
+            target_svc = self.group.svcs[target]
+            try:
+                rsp = target_svc.reconfig(_RR(
+                    peers_json=target_svc._peers_to_json(peers)))
+                appended = rsp.ok or rsp.index > 0
+            except FsError:
+                appended = False  # not leader: nothing appended anywhere
+            if appended:
+                self.group.servers[nid] = srv
+                self.group.peers[nid] = peers[nid]
+                self.group.dirs[nid] = self.group.dirs[1] + f"-m{nid}"
+                self.group.svcs[nid] = new_svc
+            else:
+                new_svc.stop()
+                srv.stop()
+            return
+        else:
+            removable = [i for i in peers
+                         if i != target and i != svc.leader_id]
+            if not removable:
+                return
+            peers.pop(self.rng.choice(removable))
+            try:
+                svc.reconfig(ReconfigReq(
+                    peers_json=svc._peers_to_json(peers)))
+            except FsError:
+                pass  # not leader / mid-election: refused, nothing changes
 
     # -- schedule ------------------------------------------------------------
     def run(self, steps: int = 40) -> None:
@@ -136,6 +215,8 @@ class KvdExplorer:
             (self.act_kill, 8),
             (self.act_restart, 12),
         ]
+        if self.reconfig:
+            actions.append((self.act_reconfig, 6))
         fns = [fn for fn, w in actions for _ in range(w)]
         for _ in range(steps):
             self.rng.choice(fns)()
@@ -145,7 +226,15 @@ class KvdExplorer:
         for i, srv in list(self.group.servers.items()):
             if srv is None:
                 self.group.start_node(i)
-        self.group.wait_leader(timeout=20)
+        leader = self.group.wait_leader(timeout=20)
+        # final membership is whatever the healed leader's config says
+        # (reconfig entries may have committed, been truncated, or be
+        # ambiguous — the leader's log is the truth); the client follows
+        # the final address map so K1 reads can reach a new-node leader
+        members = dict(self.group.svcs[leader].peers)
+        from tpu3fs.kv.remote import ReplicatedRemoteKVEngine
+
+        self.eng = ReplicatedRemoteKVEngine(members)
         # K1/K2: every key settles to a possible acknowledged value
         for key in self.keys:
             possible = self.model.get(key, {None})
@@ -177,7 +266,7 @@ class KvdExplorer:
             views = {
                 i: applied_view(svc)
                 for i, svc in self.group.svcs.items()
-                if self.group.servers.get(i) is not None
+                if self.group.servers.get(i) is not None and i in members
             }
             vals = list(views.values())
             if vals and all(v == vals[0] for v in vals) and \
@@ -192,3 +281,11 @@ class KvdExplorer:
 @pytest.mark.parametrize("seed", range(8))
 def test_random_kvd_schedules(seed, tmp_path):
     KvdExplorer(seed, tmp_path).run(steps=40)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_kvd_reconfig_schedules(seed, tmp_path):
+    """Membership churn interleaved with kills/elections/txns — incl.
+    reconfig attempts against followers/candidates mid-election, which
+    must refuse harmlessly (round-4 verdict #8)."""
+    KvdExplorer(seed, tmp_path, reconfig=True).run(steps=28)

@@ -274,6 +274,10 @@ class FuseOps:
         ents = self._meta.list_dir(path)
         children = self._meta.batch_stat([e.inode_id for e in ents])
         now = time.time()
+        if len(self._attr_cache) > 65536:
+            # bound memory under read-only crawls (find/backup scans):
+            # TTL alone never evicts, and no mutation may ever run
+            self._attr_cache.clear()
         base = path.rstrip("/")
         for ent, child in zip(ents, children):
             if child is not None:

@@ -81,6 +81,13 @@ class LogEntry:
     term: int = 0
     index: int = 0
     payload: bytes = b""     # serialized WalRecord; b"" = no-op barrier
+    # non-empty = membership entry: JSON {node_id: [host, port], ...}.
+    # Activated at APPEND time on every replica (Raft's single-server
+    # change, dissertation §4.2.2): because each reconfig adds OR removes
+    # at most one node, any majority of the old config overlaps any
+    # majority of the new one, so two leaders can never be elected for
+    # the same term across the boundary — no joint consensus needed.
+    config: str = ""
 
 
 @dataclass
@@ -122,12 +129,28 @@ class SnapInstallReq:
     last_term: int = 0
     engine_version: int = 0
     pairs: List[RangePair] = field(default_factory=list)
+    # membership active at the snapshot point (config entries may have
+    # been compacted out of the log)
+    peers_json: str = ""
 
 
 @dataclass
 class SnapInstallRsp:
     term: int = 0
     ok: bool = False
+
+
+@dataclass
+class ReconfigReq:
+    peers_json: str = ""     # the COMPLETE new map {node_id: [host, port]}
+
+
+@dataclass
+class ReconfigRsp:
+    ok: bool = False
+    term: int = 0
+    index: int = 0
+    message: str = ""
 
 
 @dataclass
@@ -144,6 +167,7 @@ class StatusRsp:
     last_index: int = 0
     commit_index: int = 0
     engine_version: int = 0
+    peers_json: str = ""
 
 
 class ReplicatedKvService:
@@ -194,6 +218,7 @@ class ReplicatedKvService:
         self.snap_last_term = 0
         self._snap_pairs: List[Tuple[bytes, bytes]] = []
         self._snap_engine_version = 0
+        self._snap_peers_json = ""   # membership at the snapshot point
         self._log_f = None
 
         # serializes the full commit round (apply -> replicate -> ack) AND
@@ -209,6 +234,10 @@ class ReplicatedKvService:
             os.makedirs(data_dir, exist_ok=True)
             self._load_durable()
             self._log_f = open(self._log_path(), "ab")
+        with self._mu:
+            # a recovered log/snapshot may carry a NEWER membership than
+            # the bootstrap map this process was started with
+            self._active_config_rescan()
         self._rebuild_engine(upto=self.snap_last_index)
         self.last_applied = self.snap_last_index
 
@@ -216,6 +245,48 @@ class ReplicatedKvService:
             target=self._tick_loop, daemon=True,
             name=f"kvd-repl-{node_id}")
         self._ticker.start()
+
+    # -- membership ----------------------------------------------------------
+    @staticmethod
+    def _peers_to_json(peers: Dict[int, Tuple[str, int]]) -> str:
+        return json.dumps({str(n): list(a) for n, a in sorted(peers.items())})
+
+    @staticmethod
+    def _peers_from_json(blob: str) -> Dict[int, Tuple[str, int]]:
+        return {int(n): (a[0], int(a[1]))
+                for n, a in json.loads(blob).items()}
+
+    def _adopt_config(self, peers: Dict[int, Tuple[str, int]]) -> None:
+        """Caller holds _mu. Switch to `peers` (append-time activation):
+        quorum and replication targets change NOW; removed peers drop out
+        of _match/_next, added ones start from scratch (snapshot/backoff
+        brings them up)."""
+        if peers == self.peers:
+            return
+        self.peers = dict(peers)
+        self._others = [p for p in peers if p != self.node_id]
+        self._quorum = len(peers) // 2 + 1
+        for gone in [p for p in self._match if p not in peers]:
+            self._match.pop(gone, None)
+            self._next.pop(gone, None)
+        if self.role == LEADER:
+            for p in self._others:
+                self._match.setdefault(p, 0)
+                self._next.setdefault(p, self._last_index() + 1)
+
+    def _active_config_rescan(self) -> None:
+        """Caller holds _mu. Recompute the active config after a log
+        truncation or durable load: the LAST surviving config entry wins;
+        with none, the snapshot's; with neither, the bootstrap map."""
+        chosen: Optional[Dict[int, Tuple[str, int]]] = None
+        for e in reversed(self.log):
+            if e.config:
+                chosen = self._peers_from_json(e.config)
+                break
+        if chosen is None and self._snap_peers_json:
+            chosen = self._peers_from_json(self._snap_peers_json)
+        if chosen is not None:
+            self._adopt_config(chosen)
 
     # -- durable state -------------------------------------------------------
     def _state_path(self) -> str:
@@ -276,6 +347,7 @@ class ReplicatedKvService:
                 "last_index": self.snap_last_index,
                 "last_term": self.snap_last_term,
                 "engine_version": self._snap_engine_version,
+                "peers": self._snap_peers_json,
             }).encode()
             f.write(len(head).to_bytes(4, "big") + head)
             for k, v in self._snap_pairs:
@@ -301,6 +373,7 @@ class ReplicatedKvService:
             self.snap_last_index = int(head["last_index"])
             self.snap_last_term = int(head["last_term"])
             self._snap_engine_version = int(head["engine_version"])
+            self._snap_peers_json = str(head.get("peers", ""))
             pos = 4 + n
             pairs = []
             while pos + 4 <= len(raw):
@@ -543,6 +616,8 @@ class ReplicatedKvService:
             last_term=self.snap_last_term,
             engine_version=self._snap_engine_version,
             pairs=[RangePair(k, v) for k, v in self._snap_pairs],
+            peers_json=(self._snap_peers_json
+                        or self._peers_to_json(self.peers)),
         )
         addr = self.peers[peer]
         self._mu.release()
@@ -596,6 +671,13 @@ class ReplicatedKvService:
         keep_from = self.last_applied  # snapshot covers exactly this state
         if keep_from <= self.snap_last_index or keep_from > self.commit_index:
             return
+        # membership at the snapshot point: the last config entry at or
+        # below keep_from (those entries are about to be truncated away)
+        for e in self.log:
+            if e.index > keep_from:
+                break
+            if e.config:
+                self._snap_peers_json = e.config
         self._snap_pairs = self.engine.dump_at(self.engine.version)
         self._snap_engine_version = self.engine.version
         self.snap_last_term = self._term_at(keep_from)
@@ -711,6 +793,12 @@ class ReplicatedKvService:
             if self._stopped:
                 return AppendRsp(term=self.term, ok=False,
                                  match_index=self._last_index())
+            # note: appends from leaders OUTSIDE our (possibly stale)
+            # config are ACCEPTED — a lagging member must be able to learn
+            # the very config entries that make the sender legitimate, and
+            # the log-consistency check below protects correctness either
+            # way. Removed-node containment lives in request_vote's leader
+            # stickiness, not here.
             if req.term < self.term:
                 return AppendRsp(term=self.term, ok=False,
                                  match_index=self._last_index())
@@ -748,6 +836,10 @@ class ReplicatedKvService:
                         upto=min(self.commit_index, self._last_index()))
             elif new_durable:
                 self._append_durable(new_durable)
+            if truncated or any(e.config for e in new_durable):
+                # membership activates at APPEND time (and a truncation
+                # may have rolled a config entry back out)
+                self._active_config_rescan()
             if req.commit_index > self.commit_index:
                 self.commit_index = min(req.commit_index, self._last_index())
                 self._advance_applied()
@@ -758,6 +850,15 @@ class ReplicatedKvService:
     def request_vote(self, req: VoteReq) -> VoteRsp:
         with self._mu:
             if self._stopped:
+                return VoteRsp(term=self.term, granted=False)
+            if (time.monotonic() - self._last_leader_contact
+                    < self._election_window[0]):
+                # leader stickiness (Raft dissertation §4.2.3): while we
+                # hear a current leader, campaigns are refused WITHOUT
+                # adopting the candidate's term — this is what contains a
+                # REMOVED node (its config no longer includes it, but it
+                # keeps timing out and campaigning at ever-higher terms)
+                # without blocking a lagging member's catch-up
                 return VoteRsp(term=self.term, granted=False)
             if req.term < self.term:
                 return VoteRsp(term=self.term, granted=False)
@@ -785,6 +886,7 @@ class ReplicatedKvService:
             self._snap_engine_version = req.engine_version
             self.snap_last_index = req.last_index
             self.snap_last_term = req.last_term
+            self._snap_peers_json = req.peers_json
             self.log = [e for e in self.log if e.index > req.last_index]
             # a snapshot replaces everything up to last_index
             if self.log and self.log[0].index != req.last_index + 1:
@@ -793,7 +895,66 @@ class ReplicatedKvService:
             self._rewrite_log()
             self.commit_index = max(self.commit_index, req.last_index)
             self._rebuild_engine(upto=self.commit_index)
+            self._active_config_rescan()
             return SnapInstallRsp(term=self.term, ok=True)
+
+    def reconfig(self, req: ReconfigReq) -> ReconfigRsp:
+        """Online membership change (the role FDB's reconfigurable cluster
+        plays for the reference, src/fdb/HybridKvEngine.h:12-22): append a
+        config entry carrying the COMPLETE new peer map and replicate it
+        under the NEW quorum. One node added or removed per call (the
+        single-server rule that makes append-time activation safe); the
+        current leader cannot remove itself. A freshly added node is
+        started empty with the new map as its bootstrap config and catches
+        up via snapshot/log backoff."""
+        self._require_leader()
+        try:
+            new_peers = self._peers_from_json(req.peers_json)
+        except (ValueError, KeyError, TypeError) as e:
+            return ReconfigRsp(ok=False, message=f"bad peer map: {e!r}")
+        with self._commit_lock:
+            with self._mu:
+                if self.role != LEADER:
+                    return ReconfigRsp(
+                        ok=False, term=self.term,
+                        message=f"not leader; leader={self.leader_id}")
+                if not new_peers:
+                    return ReconfigRsp(ok=False, message="empty peer map")
+                if self.node_id not in new_peers:
+                    return ReconfigRsp(
+                        ok=False,
+                        message="leader cannot remove itself; move "
+                                "leadership first")
+                # ONE changed node per entry — added, removed, OR an
+                # existing member's address rewrite all count (the
+                # quorum-overlap argument needs every other member's
+                # identity AND address unchanged)
+                delta = set(new_peers) ^ set(self.peers)
+                delta |= {n for n in set(new_peers) & set(self.peers)
+                          if new_peers[n] != self.peers[n]}
+                if len(delta) > 1:
+                    return ReconfigRsp(
+                        ok=False,
+                        message=f"one node per change (delta={sorted(delta)}"
+                                "); reconfig repeatedly for more")
+                entry = LogEntry(term=self.term,
+                                 index=self._last_index() + 1,
+                                 config=self._peers_to_json(new_peers))
+                self.log.append(entry)
+                self._append_durable([entry])
+                self._adopt_config(new_peers)  # append-time activation
+                self.last_applied = max(self.last_applied, entry.index)
+                term, index = self.term, entry.index
+            if not self._replicate_quorum():
+                # the entry is durably in our log; like a client commit
+                # that lost quorum mid-round the outcome is ambiguous —
+                # step down and report it
+                with self._mu:
+                    self.role = FOLLOWER
+                return ReconfigRsp(
+                    ok=False, term=term, index=index,
+                    message="lost quorum mid-reconfig; outcome unknown")
+        return ReconfigRsp(ok=True, term=term, index=index)
 
     def status(self, req: StatusReq) -> StatusRsp:
         with self._mu:
@@ -805,6 +966,7 @@ class ReplicatedKvService:
                 last_index=self._last_index(),
                 commit_index=self.commit_index,
                 engine_version=self.engine.version,
+                peers_json=self._peers_to_json(self.peers),
             )
 
     def stop(self) -> None:
@@ -846,6 +1008,7 @@ def bind_repl_service(server: RpcServer, svc: ReplicatedKvService) -> None:
     s.method(3, "installSnapshot", SnapInstallReq, SnapInstallRsp,
              svc.install_snapshot)
     s.method(4, "status", StatusReq, StatusRsp, svc.status)
+    s.method(5, "reconfig", ReconfigReq, ReconfigRsp, svc.reconfig)
     server.add_service(s)
 
 

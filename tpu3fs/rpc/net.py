@@ -391,6 +391,15 @@ class RpcServer:
     def stop(self) -> None:
         self._running = False
         try:
+            # shutdown BEFORE close: close() does not interrupt a thread
+            # blocked in accept(2), and the in-kernel syscall then pins
+            # the socket — the port stays LISTENing (unbindable) until a
+            # connection happens to arrive. shutdown() wakes the accept
+            # immediately, so stop() actually releases the port.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
