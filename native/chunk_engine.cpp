@@ -447,10 +447,89 @@ int class_for(uint32_t chunk_bytes) {
   return shift - kMinClassShift;
 }
 
+// ---- paged metadata base run ----------------------------------------------
+// One mmap'd SORTED array of sealed WalRecords: the at-rest form of the
+// chunk index (the MetaStore role RocksDB plays in the reference,
+// src/storage/chunk_engine/src/meta/rocksdb.rs). RAM holds only the DELTA
+// since the last rewrite, so resident metadata stays flat as chunk count
+// grows; lookups binary-search the mapping (page cache, evictable).
+struct MetaBase {
+  int fd = -1;
+  const WalRecord* recs = nullptr;
+  size_t n = 0;
+  size_t map_len = 0;
+
+  const WalRecord* find(const Key& k) const {
+    size_t lo = 0, hi = n;
+    while (lo < hi) {
+      size_t mid = (lo + hi) / 2;
+      int c = memcmp(recs[mid].key, k.b, kKeyLen);
+      if (c < 0)
+        lo = mid + 1;
+      else if (c > 0)
+        hi = mid;
+      else
+        return &recs[mid];
+    }
+    return nullptr;
+  }
+
+  void reset() {
+    if (recs != nullptr) munmap(const_cast<WalRecord*>(recs), map_len);
+    if (fd >= 0) close(fd);
+    fd = -1;
+    recs = nullptr;
+    n = 0;
+    map_len = 0;
+  }
+};
+
+ChunkMeta meta_from_rec(const WalRecord& rec) {
+  ChunkMeta m;
+  m.committed_ver = rec.committed_ver;
+  m.pending_ver = rec.pending_ver;
+  m.chain_ver = rec.chain_ver;
+  m.committed = {rec.c_cls, rec.c_idx, rec.c_len, rec.c_crc};
+  m.pending = {rec.p_cls, rec.p_idx, rec.p_len, rec.p_crc};
+  m.aux = rec.aux;
+  m.aux_pending = rec.aux_pending;
+  return m;
+}
+
+void rec_from_meta(const Key& k, const ChunkMeta& m, WalRecord* rec) {
+  *rec = WalRecord{};
+  rec->op = 1;
+  memcpy(rec->key, k.b, kKeyLen);
+  rec->committed_ver = m.committed_ver;
+  rec->pending_ver = m.pending_ver;
+  rec->chain_ver = m.chain_ver;
+  rec->c_cls = m.committed.cls;
+  rec->c_idx = m.committed.idx;
+  rec->c_len = m.committed.length;
+  rec->c_crc = m.committed.crc;
+  rec->p_cls = m.pending.cls;
+  rec->p_idx = m.pending.idx;
+  rec->p_len = m.pending.length;
+  rec->p_crc = m.pending.crc;
+  rec->aux = m.aux;
+  rec->aux_pending = m.aux_pending;
+  rec->seal();
+}
+
 // ---- engine ---------------------------------------------------------------
 struct Engine {
   std::string dir;
+  // `metas` is the in-RAM DELTA over base_ (plus a read-materialization
+  // cache); dead_ masks base-resident keys erased since the last rewrite;
+  // base_overlap_ tracks delta keys that shadow a base record (for O(1)
+  // chunk counting); logged_len_ carries each delta key's last accounted
+  // committed length (for O(1) used_size)
+  MetaBase base_;
   std::map<Key, ChunkMeta> metas;
+  std::set<Key> dead_;
+  std::set<Key> base_overlap_;
+  std::map<Key, uint32_t> logged_len_;
+  uint64_t used_ = 0;
   std::set<Key> pending_keys;  // keys with pending_ver != 0 (see note_pending)
   SizeClass classes[kNumClasses];
   int wal_fd = -1;
@@ -538,7 +617,10 @@ struct Engine {
 
   template <typename Rec>
   size_t replay_records(FILE* f) {
-    // -> byte offset of the end of the last VALID record
+    // -> byte offset of the end of the last VALID record. Applies each
+    // record as a DELTA over the (already-scanned) base: allocator marks
+    // follow the visible state exactly — a record superseding an earlier
+    // visible version releases that version's blocks and marks its own.
     Rec rec;
     size_t valid = 0;
     while (fread(&rec, sizeof(rec), 1, f) == 1) {
@@ -547,9 +629,15 @@ struct Engine {
       wal_records++;
       Key k;
       memcpy(k.b, rec.key, kKeyLen);
+      ChunkMeta* prior = lookup(k);
+      if (prior != nullptr) {
+        if (prior->committed.valid())
+          classes[prior->committed.cls].release(prior->committed.idx);
+        if (prior->pending.valid())
+          classes[prior->pending.cls].release(prior->pending.idx);
+      }
       if (rec.op == 2) {
-        metas.erase(k);
-        pending_keys.erase(k);
+        if (prior != nullptr) erase_meta_nolog(k);
         continue;
       }
       ChunkMeta m;
@@ -560,13 +648,48 @@ struct Engine {
       m.pending = {rec.p_cls, rec.p_idx, rec.p_len, rec.p_crc};
       m.aux = rec.aux_of();
       m.aux_pending = rec.aux_pending_of();
-      metas[k] = m;
+      if (m.committed.valid()) classes[m.committed.cls].mark(m.committed.idx);
+      if (m.pending.valid()) classes[m.pending.cls].mark(m.pending.idx);
+      ChunkMeta& slot = pin(k);
+      slot = m;
+      uint32_t& ll = logged_len_[k];
+      used_ += m.committed.length;
+      used_ -= ll;
+      ll = m.committed.length;
       note_pending(k, m);
     }
     return valid;
   }
 
+  int load_base() {
+    // mmap the base run and take ONE sequential pass: allocator marks,
+    // live-byte total, pending-key index, and per-record CRC validation.
+    // This pass is the whole "open replay" for base-resident state —
+    // O(chunk count) of sequential page-cache reads, instead of replaying
+    // an unbounded mutation history.
+    int rc = remap_base();
+    if (rc != OK) return rc;
+    for (size_t i = 0; i < base_.n; i++) {
+      const WalRecord& rec = base_.recs[i];
+      if (!rec.check() || rec.op != 1) return E_IO;  // base never tears
+      if (i > 0 &&
+          memcmp(base_.recs[i - 1].key, rec.key, kKeyLen) >= 0)
+        return E_IO;  // must be strictly sorted
+      if (rec.c_cls >= 0) classes[rec.c_cls].mark(rec.c_idx);
+      if (rec.p_cls >= 0) classes[rec.p_cls].mark(rec.p_idx);
+      used_ += rec.c_len;
+      if (rec.pending_ver != 0) {
+        Key k;
+        memcpy(k.b, rec.key, kKeyLen);
+        pending_keys.insert(k);
+      }
+    }
+    return OK;
+  }
+
   int replay() {
+    int rc = load_base();
+    if (rc != OK) return rc;
     FILE* f = fopen(wal_path().c_str(), "rb");
     if (!f) return OK;
     // peek the first record's magic: a v1-format log (pre-aux build) is
@@ -580,12 +703,7 @@ struct Engine {
     size_t valid = legacy ? replay_records<WalRecordV1>(f)
                           : replay_records<WalRecord>(f);
     fclose(f);
-    // rebuild allocator occupancy from live references
-    for (auto& [k, m] : metas) {
-      if (m.committed.valid()) classes[m.committed.cls].mark(m.committed.idx);
-      if (m.pending.valid()) classes[m.pending.cls].mark(m.pending.idx);
-    }
-    if (legacy) return compact();  // rewrite as v2 before any append
+    if (legacy) return compact();  // rewrite as v2 base before any append
     // drop any torn/garbage suffix NOW: O_APPEND writes after an unreadable
     // record would otherwise be invisible to every future replay
     struct stat st;
@@ -614,6 +732,61 @@ struct Engine {
     return OK;
   }
 
+  // -- paged index primitives ----------------------------------------------
+  std::string base_path() const { return dir + "/meta_base.bin"; }
+
+  // visible meta for k, or null. Base hits MATERIALIZE into the delta so
+  // callers get a stable mutable slot (the mutators all work through
+  // in-place references); materialized entries simply ride into the next
+  // rewrite unchanged.
+  ChunkMeta* lookup(const Key& k) {
+    auto it = metas.find(k);
+    if (it != metas.end()) return &it->second;
+    if (dead_.count(k)) return nullptr;
+    const WalRecord* r = base_.find(k);
+    if (r == nullptr) return nullptr;
+    ChunkMeta m = meta_from_rec(*r);
+    base_overlap_.insert(k);
+    logged_len_[k] = m.committed.length;
+    return &(metas[k] = m);
+  }
+
+  // the `metas[k]` (create-if-absent) form
+  ChunkMeta& pin(const Key& k) {
+    ChunkMeta* p = lookup(k);
+    if (p != nullptr) return *p;
+    dead_.erase(k);
+    if (base_.find(k) != nullptr) base_overlap_.insert(k);
+    logged_len_[k] = 0;
+    return metas[k];
+  }
+
+  // a failed validated install drops the slot it just created (no
+  // phantom); a true phantom is never base-resident, so the overlap
+  // erase below is a no-op for real data
+  void drop_phantom(const Key& k) {
+    metas.erase(k);
+    logged_len_.erase(k);
+    base_overlap_.erase(k);
+  }
+
+  // erase bookkeeping shared by remove() and WAL replay
+  void erase_meta_nolog(const Key& k) {
+    metas.erase(k);
+    base_overlap_.erase(k);
+    if (base_.find(k) != nullptr) dead_.insert(k);
+    auto ll = logged_len_.find(k);
+    if (ll != logged_len_.end()) {
+      used_ -= ll->second;
+      logged_len_.erase(ll);
+    }
+    pending_keys.erase(k);
+  }
+
+  uint64_t meta_count() const {
+    return base_.n - dead_.size() - base_overlap_.size() + metas.size();
+  }
+
   // pending-key index: every meta state change funnels through log_state /
   // log_remove / replay, so the set stays exact. Keeps ce_query_pending
   // O(pendings), not O(chunks) — it is the steady-state probe of the
@@ -627,23 +800,12 @@ struct Engine {
 
   int log_state(const Key& k, const ChunkMeta& m) {
     note_pending(k, m);
+    uint32_t& ll = logged_len_[k];
+    used_ += m.committed.length;
+    used_ -= ll;
+    ll = m.committed.length;
     WalRecord rec;
-    rec.op = 1;
-    memcpy(rec.key, k.b, kKeyLen);
-    rec.committed_ver = m.committed_ver;
-    rec.pending_ver = m.pending_ver;
-    rec.chain_ver = m.chain_ver;
-    rec.c_cls = m.committed.cls;
-    rec.c_idx = m.committed.idx;
-    rec.c_len = m.committed.length;
-    rec.c_crc = m.committed.crc;
-    rec.p_cls = m.pending.cls;
-    rec.p_idx = m.pending.idx;
-    rec.p_len = m.pending.length;
-    rec.p_crc = m.pending.crc;
-    rec.aux = m.aux;
-    rec.aux_pending = m.aux_pending;
-    rec.seal();
+    rec_from_meta(k, m, &rec);
     wal_records++;
     if (log_buffering) {
       log_buf.push_back(rec);
@@ -672,48 +834,110 @@ struct Engine {
   }
 
   int compact() {
-    // rewrite the WAL as one state record per live chunk
-    std::string tmp = wal_path() + ".tmp";
+    // rewrite the BASE RUN: stream-merge (base - dead) with the delta into
+    // a fresh sorted record array, swap it in atomically, then truncate
+    // the WAL — RAM drops back to an empty delta. The rewrite trigger is
+    // the delta footprint (adaptive: ~1/8 of the live count), so total
+    // rewrite traffic amortizes to O(N log N) over N creates.
+    std::string tmp = base_path() + ".tmp";
     int fd = ::open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
     if (fd < 0) return E_IO;
-    for (auto& [k, m] : metas) {
-      WalRecord rec;
-      rec.op = 1;
-      memcpy(rec.key, k.b, kKeyLen);
-      rec.committed_ver = m.committed_ver;
-      rec.pending_ver = m.pending_ver;
-      rec.chain_ver = m.chain_ver;
-      rec.c_cls = m.committed.cls;
-      rec.c_idx = m.committed.idx;
-      rec.c_len = m.committed.length;
-      rec.c_crc = m.committed.crc;
-      rec.p_cls = m.pending.cls;
-      rec.p_idx = m.pending.idx;
-      rec.p_len = m.pending.length;
-      rec.p_crc = m.pending.crc;
-      rec.aux = m.aux;
-      rec.aux_pending = m.aux_pending;
-      rec.seal();
-      if (write(fd, &rec, sizeof(rec)) != sizeof(rec)) {
-        close(fd);
-        return E_IO;
+    std::vector<WalRecord> buf;
+    buf.reserve(4096);
+    auto emit = [&](const Key& k, const ChunkMeta& m) -> int {
+      buf.emplace_back();
+      rec_from_meta(k, m, &buf.back());
+      if (buf.size() == 4096) {
+        ssize_t want = static_cast<ssize_t>(buf.size() * sizeof(WalRecord));
+        if (write(fd, buf.data(), want) != want) return E_IO;
+        buf.clear();
       }
+      return OK;
+    };
+    auto dit = metas.begin();
+    size_t bi = 0;
+    int rc = OK;
+    while (rc == OK && (dit != metas.end() || bi < base_.n)) {
+      if (bi < base_.n) {
+        Key bk;
+        memcpy(bk.b, base_.recs[bi].key, kKeyLen);
+        if (dit == metas.end() || bk < dit->first) {
+          if (!dead_.count(bk)) rc = emit(bk, meta_from_rec(base_.recs[bi]));
+          bi++;
+          continue;
+        }
+        if (bk == dit->first) bi++;  // shadowed by the delta
+      }
+      rc = emit(dit->first, dit->second);
+      ++dit;
+    }
+    if (rc == OK && !buf.empty()) {
+      ssize_t want = static_cast<ssize_t>(buf.size() * sizeof(WalRecord));
+      if (write(fd, buf.data(), want) != want) rc = E_IO;
+    }
+    if (rc != OK) {
+      close(fd);
+      ::unlink(tmp.c_str());
+      return rc;
     }
     fsync(fd);
     close(fd);
-    if (rename(tmp.c_str(), wal_path().c_str()) != 0) return E_IO;
+    if (rename(tmp.c_str(), base_path().c_str()) != 0) return E_IO;
+    if (remap_base() != OK) return E_IO;
+    metas.clear();
+    dead_.clear();
+    base_overlap_.clear();
+    logged_len_.clear();
+    // WAL restarts empty: the base now carries full state
     close(wal_fd);
-    wal_fd = ::open(wal_path().c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
-    wal_records = metas.size();
-    // the snapshot wrote (and fsynced) full current state: any buffered
+    wal_fd = ::open(wal_path().c_str(),
+                    O_RDWR | O_CREAT | O_APPEND | O_TRUNC, 0644);
+    wal_records = 0;
+    // the base wrote (and fsynced) full current state: any buffered
     // records are redundant and every superseded block is now safe
     log_buf.clear();
     drain_quarantine();
     return wal_fd < 0 ? E_IO : OK;
   }
 
+  int remap_base() {
+    base_.reset();
+    base_.fd = ::open(base_path().c_str(), O_RDONLY);
+    if (base_.fd < 0) return OK;  // no base yet (fresh/legacy dir)
+    struct stat st;
+    if (fstat(base_.fd, &st) != 0) return E_IO;
+    size_t sz = static_cast<size_t>(st.st_size);
+    base_.n = sz / sizeof(WalRecord);
+    if (base_.n == 0) return OK;
+    base_.map_len = sz;
+    void* m = mmap(nullptr, sz, PROT_READ, MAP_SHARED, base_.fd, 0);
+    if (m == MAP_FAILED) {
+      base_.n = 0;
+      return E_IO;
+    }
+    base_.recs = static_cast<const WalRecord*>(m);
+    return OK;
+  }
+
+  uint64_t hot_cap() const {
+    // delta-size rewrite trigger. Default is adaptive (live/8): total
+    // rewrite traffic stays O(N log N) over N creates while the resident
+    // delta is bounded by live/8. TPU3FS_META_HOT_CAP pins it (a FLAT
+    // RSS envelope at the cost of more rewrite traffic — the tradeoff
+    // knob RocksDB's memtable size plays in the reference's engine).
+    static const uint64_t fixed = [] {
+      const char* v = getenv("TPU3FS_META_HOT_CAP");
+      return v != nullptr ? strtoull(v, nullptr, 10) : 0ull;
+    }();
+    if (fixed) return fixed;
+    uint64_t cap = meta_count() / 8;
+    return cap < 65536 ? 65536 : cap;
+  }
+
   void maybe_compact() {
-    if (wal_records > 4 * metas.size() + 4096) compact();
+    if (metas.size() + dead_.size() >= hot_cap() ||
+        wal_records > 4 * (meta_count() + 1) + 4096)
+      compact();
   }
 
   // -- block IO ------------------------------------------------------------
@@ -795,19 +1019,19 @@ struct Engine {
     // validate against the existing meta (or an empty one) BEFORE inserting,
     // so rejected updates leave no phantom committed_ver=0 chunk behind
     {
-      auto it = metas.find(k);
-      uint64_t cv = it != metas.end() ? it->second.committed_ver : 0;
-      uint64_t pv = it != metas.end() ? it->second.pending_ver : 0;
+      const ChunkMeta* it = lookup(k);
+      uint64_t cv = it != nullptr ? it->committed_ver : 0;
+      uint64_t pv = it != nullptr ? it->pending_ver : 0;
       if (update_ver == 0) {
         update_ver = cv + 1;
         *io_ver = update_ver;
       }
       if (stage_replace) {
         if (update_ver <= cv) {
-          if (it != metas.end()) {
-            if (out_len) *out_len = it->second.committed.length;
-            if (out_crc) *out_crc = it->second.committed.crc;
-            *io_ver = it->second.committed_ver;
+          if (it != nullptr) {
+            if (out_len) *out_len = it->committed.length;
+            if (out_crc) *out_crc = it->committed.crc;
+            *io_ver = it->committed_ver;
           }
           return E_STALE_UPDATE;
         }
@@ -817,10 +1041,10 @@ struct Engine {
       } else if (!full_replace) {
         if (update_ver <= cv) {
           // report committed state for the idempotent-duplicate reply
-          if (it != metas.end()) {
-            if (out_len) *out_len = it->second.committed.length;
-            if (out_crc) *out_crc = it->second.committed.crc;
-            *io_ver = it->second.committed_ver;
+          if (it != nullptr) {
+            if (out_len) *out_len = it->committed.length;
+            if (out_crc) *out_crc = it->committed.crc;
+            *io_ver = it->committed_ver;
           }
           return E_STALE_UPDATE;
         }
@@ -835,7 +1059,7 @@ struct Engine {
       // refuse BEFORE metas[k] inserts: a failed validated install must
       // leave no phantom committed_ver=0 meta behind
       if (check_crc && crc != expected_crc) return E_CHECKSUM;
-      ChunkMeta& m = metas[k];
+      ChunkMeta& m = pin(k);
       BlockRef nb{static_cast<int8_t>(cls),
                   static_cast<uint32_t>(classes[cls].allocate()), data_len,
                   crc};
@@ -860,7 +1084,7 @@ struct Engine {
     // covering the whole resulting content (the common chunk-append /
     // full-overwrite form) skips the merge buffer entirely. stage_replace
     // NEVER merges: the data IS the whole pending content.
-    ChunkMeta& m = metas[k];
+    ChunkMeta& m = pin(k);
     uint32_t new_len = stage_replace
                            ? data_len
                            : std::max(m.committed.length, offset + data_len);
@@ -881,7 +1105,7 @@ struct Engine {
     if (check_crc && crc != expected_crc) {
       // drop the meta if this lookup created it (no phantom on refusal)
       if (!m.committed.valid() && !m.pending.valid() && m.committed_ver == 0)
-        metas.erase(k);
+        drop_phantom(k);
       return E_CHECKSUM;
     }
     free_block(m.pending);  // re-staging the same pending ver is idempotent
@@ -902,9 +1126,9 @@ struct Engine {
   }
 
   int commit(const Key& k, uint64_t ver, uint64_t chain_ver) {
-    auto it = metas.find(k);
-    if (it == metas.end()) return E_NOT_FOUND;
-    ChunkMeta& m = it->second;
+    ChunkMeta* mp = lookup(k);
+    if (mp == nullptr) return E_NOT_FOUND;
+    ChunkMeta& m = *mp;
     if (m.committed_ver >= ver) return OK;  // duplicate commit
     if (m.pending_ver != ver || !m.pending.valid()) return E_MISSING_UPDATE;
     free_block(m.committed);
@@ -922,9 +1146,9 @@ struct Engine {
 
   int read(const Key& k, uint8_t* out, uint64_t cap, uint32_t offset,
            int64_t length, int64_t* out_len) {
-    auto it = metas.find(k);
-    if (it == metas.end()) return E_NOT_FOUND;
-    const ChunkMeta& m = it->second;
+    const ChunkMeta* mp = lookup(k);
+    if (mp == nullptr) return E_NOT_FOUND;
+    const ChunkMeta& m = *mp;
     if (m.committed_ver == 0) return E_NOT_COMMIT;
     if (offset >= m.committed.length) {
       *out_len = 0;
@@ -948,9 +1172,9 @@ struct Engine {
                    int64_t* out_len) {
     // full content of the staged pending version (committed if none):
     // feeds the chain checksum cross-check
-    auto it = metas.find(k);
-    if (it == metas.end()) return E_NOT_FOUND;
-    const ChunkMeta& m = it->second;
+    const ChunkMeta* mp = lookup(k);
+    if (mp == nullptr) return E_NOT_FOUND;
+    const ChunkMeta& m = *mp;
     const BlockRef& ref = m.pending.valid() ? m.pending : m.committed;
     if (!ref.valid()) {
       *out_len = 0;
@@ -964,19 +1188,18 @@ struct Engine {
   }
 
   int remove(const Key& k) {
-    auto it = metas.find(k);
-    if (it == metas.end()) return E_NOT_FOUND;
-    free_block(it->second.committed);
-    free_block(it->second.pending);
-    metas.erase(it);
-    pending_keys.erase(k);
+    ChunkMeta* mp = lookup(k);
+    if (mp == nullptr) return E_NOT_FOUND;
+    free_block(mp->committed);
+    free_block(mp->pending);
+    erase_meta_nolog(k);
     return log_remove(k);
   }
 
   int truncate(const Key& k, uint32_t new_len, uint64_t chain_ver) {
-    auto it = metas.find(k);
-    if (it == metas.end()) return E_NOT_FOUND;
-    ChunkMeta& m = it->second;
+    ChunkMeta* mp = lookup(k);
+    if (mp == nullptr) return E_NOT_FOUND;
+    ChunkMeta& m = *mp;
     std::vector<uint8_t> buf(new_len, 0);
     if (m.committed.valid() && m.committed.length) {
       uint32_t copy = std::min(new_len, m.committed.length);
@@ -1003,11 +1226,7 @@ struct Engine {
     return log_state(k, m);
   }
 
-  uint64_t used_size() const {
-    uint64_t total = 0;
-    for (auto& [k, m] : metas) total += m.committed.length;
-    return total;
-  }
+  uint64_t used_size() const { return used_; }
 };
 
 }  // namespace
@@ -1069,6 +1288,7 @@ void ce_close(void* h) {
   if (!e) return;
   e->uring.shutdown();
   e->compact();
+  e->base_.reset();
   for (int c = 0; c < kNumClasses; c++) {
     if (e->classes[c].map != nullptr)
       munmap(e->classes[c].map, e->classes[c].map_len);
@@ -1124,9 +1344,9 @@ int ce_get_meta(void* h, const uint8_t* key, CMeta* out) {
   std::lock_guard<std::mutex> g(e->mu);
   Key k;
   memcpy(k.b, key, kKeyLen);
-  auto it = e->metas.find(k);
-  if (it == e->metas.end()) return E_NOT_FOUND;
-  fill_cmeta(k, it->second, out);
+  const ChunkMeta* m = e->lookup(k);
+  if (m == nullptr) return E_NOT_FOUND;
+  fill_cmeta(k, *m, out);
   return OK;
 }
 
@@ -1154,11 +1374,28 @@ int ce_query(void* h, const uint8_t* prefix, uint32_t prefix_len, CMeta* out,
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
   if (prefix_len > kKeyLen) return E_INVALID;
+  // ordered 2-way merge of the base run and the delta (delta wins on
+  // ties; dead_ masks erased base keys) — same key order as before
   int n = 0;
-  for (auto& [k, m] : e->metas) {
-    if (prefix_len && memcmp(k.b, prefix, prefix_len) != 0) continue;
-    if (n >= max_out) break;
-    fill_cmeta(k, m, &out[n++]);
+  auto dit = e->metas.begin();
+  size_t bi = 0;
+  auto emit = [&](const Key& k, const ChunkMeta& m) {
+    if (prefix_len == 0 || memcmp(k.b, prefix, prefix_len) == 0)
+      fill_cmeta(k, m, &out[n++]);
+  };
+  while (n < max_out && (dit != e->metas.end() || bi < e->base_.n)) {
+    if (bi < e->base_.n) {
+      Key bk;
+      memcpy(bk.b, e->base_.recs[bi].key, kKeyLen);
+      if (dit == e->metas.end() || bk < dit->first) {
+        if (!e->dead_.count(bk)) emit(bk, meta_from_rec(e->base_.recs[bi]));
+        bi++;
+        continue;
+      }
+      if (bk == dit->first) bi++;  // shadowed by the delta
+    }
+    emit(dit->first, dit->second);
+    ++dit;
   }
   return n;
 }
@@ -1171,10 +1408,10 @@ int ce_query_pending(void* h, CMeta* out, int max_out) {
   std::lock_guard<std::mutex> g(e->mu);
   int n = 0;
   for (const auto& k : e->pending_keys) {
-    auto it = e->metas.find(k);
-    if (it == e->metas.end()) continue;
+    const ChunkMeta* m = e->lookup(k);
+    if (m == nullptr) continue;
     if (n >= max_out) break;
-    fill_cmeta(k, it->second, &out[n++]);
+    fill_cmeta(k, *m, &out[n++]);
   }
   return n;
 }
@@ -1194,7 +1431,7 @@ int64_t ce_used_size(void* h) {
 int64_t ce_chunk_count(void* h) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
-  return static_cast<int64_t>(e->metas.size());
+  return static_cast<int64_t>(e->meta_count());
 }
 
 int ce_compact(void* h) {
@@ -1606,11 +1843,11 @@ int ce_batch_commit(void* h, uint64_t chain_ver, const uint8_t* keys,
     COpResult& r = res[i];
     r = COpResult{};
     r.rc = e->commit(k, vers[i], chain_ver);
-    auto it = e->metas.find(k);
-    if (it != e->metas.end()) {
-      r.ver = it->second.committed_ver;
-      r.len = it->second.committed.length;
-      r.crc = it->second.committed.crc;
+    const ChunkMeta* m = e->lookup(k);
+    if (m != nullptr) {
+      r.ver = m->committed_ver;
+      r.len = m->committed.length;
+      r.crc = m->committed.crc;
     }
   }
   e->log_buffering = false;
@@ -1646,12 +1883,12 @@ int ce_batch_read(void* h, const CReadOp* ops, uint8_t* out, uint64_t cap,
       r.rc = E_INVALID;
       continue;
     }
-    auto it = e->metas.find(k);
-    if (it == e->metas.end()) {
+    const ChunkMeta* mp = e->lookup(k);
+    if (mp == nullptr) {
       r.rc = E_NOT_FOUND;
       continue;
     }
-    const ChunkMeta& m = it->second;
+    const ChunkMeta& m = *mp;
     if (m.committed_ver == 0) {
       r.rc = E_NOT_COMMIT;
       continue;
@@ -1746,7 +1983,7 @@ int ce_read2(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
   memcpy(k.b, key, kKeyLen);
   int rc = e->read(k, out, cap, offset, length, out_len);
   if (rc != OK) return rc;
-  const ChunkMeta& m = e->metas.find(k)->second;
+  const ChunkMeta& m = *e->lookup(k);
   *out_commit_ver = m.committed_ver;
   *out_crc = (offset == 0 && *out_len == static_cast<int64_t>(m.committed.length))
                  ? m.committed.crc

@@ -283,3 +283,67 @@ class TestPendingIndex:
         pm = eng2.pending_metas()
         assert len(pm) == 1 and pm[0].pending_ver == 1
         eng2.close()
+
+
+class TestPagedMetaIndex:
+    """The mmap'd base-run + delta metadata design (round-4 verdict #5):
+    state survives rewrites and reopens exactly, counters stay O(1)-exact,
+    and the CI-sized soak keeps RSS growth and reopen time bounded.
+    benchmarks/engine_soak.py is the 10M-chunk version of the same check."""
+
+    def test_rewrite_reopen_exactness(self, tmp_path):
+        from tpu3fs.storage.native_engine import NativeChunkEngine
+        from tpu3fs.storage.types import ChunkId
+
+        try:
+            eng = NativeChunkEngine(str(tmp_path))
+        except Exception:
+            import pytest
+
+            pytest.skip("native engine unavailable")
+        N = 500
+        for i in range(N):
+            eng.update(ChunkId(3, i), 1, 1, bytes([i & 0xFF]) * (50 + i),
+                       0, chunk_size=4096)
+            eng.commit(ChunkId(3, i), 1, 1)
+        for i in range(0, N, 5):
+            eng.remove(ChunkId(3, i))
+        eng.update(ChunkId(4, 0), 9, 1, b"p" * 32, 0, chunk_size=4096,
+                   stage_replace=True)
+        want = (len(eng.all_metadata()), eng.used_size(),
+                [m.chunk_id.index for m in eng.pending_metas()])
+        eng.compact()  # base rewrite
+        assert (len(eng.all_metadata()), eng.used_size(),
+                [m.chunk_id.index for m in eng.pending_metas()]) == want
+        # delta over the fresh base: overwrite + erase base-resident keys
+        eng.update(ChunkId(3, 1), 2, 2, b"v2" * 40, 0, chunk_size=4096)
+        eng.commit(ChunkId(3, 1), 2, 2)
+        eng.remove(ChunkId(3, 2))
+        eng.close()
+        eng2 = NativeChunkEngine(str(tmp_path))
+        assert eng2.read(ChunkId(3, 1)) == b"v2" * 40
+        assert eng2.get_meta(ChunkId(3, 2)) is None
+        assert eng2.get_meta(ChunkId(3, 3)).committed_ver == 1
+        assert len(eng2.pending_metas()) == 1
+        # ordered query merges base + delta in key order
+        metas = eng2.all_metadata()
+        keys = [m.chunk_id.to_bytes() for m in metas]
+        assert keys == sorted(keys)
+        assert want[0] == len(metas) + 1  # -overwrite no, -removed 1
+        eng2.close()
+
+    def test_ci_sized_soak_bounds(self):
+        import pytest
+
+        from benchmarks.engine_soak import run
+
+        try:
+            out = run(60_000, dir_base=None)
+        except Exception as e:
+            pytest.skip(f"native engine unavailable: {e!r}")
+        # bounded RSS: resident growth stays far below the full-index
+        # footprint (60k metas would be ~6 MB as a std::map; the bound
+        # here allows delta + allocator + noise)
+        assert out["rss_growth_mb"] < 60, out
+        assert out["reopen_s"] < 2.0, out
+        assert out["used_bytes"] == 60_000 * 64

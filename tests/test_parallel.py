@@ -91,3 +91,90 @@ def test_shuffle_partitions():
         local = out[dst * n : (dst + 1) * n]
         for src in range(n):
             assert (local[src] == src * 16 + dst).all(), (dst, src)
+
+
+class TestIciServingMode:
+    """chain_write_step as the storage service's replication transport
+    (round-4 verdict #7): the SAME writes through the ICI collective and
+    through the messenger must leave byte-identical committed state, and
+    the collective path must actually serve (hit counter)."""
+
+    def _fabric(self, transport, mesh=None):
+        from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+
+        return Fabric(SystemSetupConfig(
+            num_storage_nodes=1, num_chains=2, num_replicas=4,
+            chunk_size=8192, chain_transport=transport, mesh=mesh))
+
+    def _write_workload(self, fab):
+        from tpu3fs.storage.types import ChunkId
+
+        client = fab.storage_client()
+        ops = [(fab.chain_ids[i % 2], ChunkId(31, i), 0,
+                bytes([0x30 + i]) * (1000 + 317 * i))
+               for i in range(8)]
+        replies = client.batch_write(ops, chunk_size=8192)
+        assert all(r.ok for r in replies), replies
+        # partial-offset overwrite rides the same transport
+        r = client.write_chunk(fab.chain_ids[0], ChunkId(31, 0), 500,
+                               b"Z" * 300, chunk_size=8192)
+        assert r.ok
+        return replies
+
+    def _committed_state(self, fab):
+        state = {}
+        for node in fab.nodes.values():
+            for t in node.service.targets():
+                for m in t.engine.all_metadata():
+                    state[(t.target_id - 1000,
+                           m.chunk_id.to_bytes())] = (
+                        m.committed_ver, m.checksum.value, m.length,
+                        t.engine.read(m.chunk_id))
+        return state
+
+    def test_ici_matches_messenger_byte_identical(self):
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            import pytest
+
+            pytest.skip("needs 8 virtual devices")
+        mesh = Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "chain"))
+        fab_ici = self._fabric("ici", mesh)
+        fab_msg = self._fabric("messenger")
+        self._write_workload(fab_ici)
+        self._write_workload(fab_msg)
+        svc = next(iter(fab_ici.nodes.values())).service
+        assert svc._ici.hits > 0, "collective path must actually serve"
+        s_ici = self._committed_state(fab_ici)
+        s_msg = self._committed_state(fab_msg)
+        assert s_ici == s_msg
+        # reads through the normal client verify end to end
+        from tpu3fs.storage.types import ChunkId
+
+        client = fab_ici.storage_client()
+        got = client.read_chunk(fab_ici.chain_ids[0], ChunkId(31, 0))
+        want = bytearray(bytes([0x30]) * 1000)
+        want[500:800] = b"Z" * 300
+        assert got.data == bytes(want)
+
+    def test_ici_falls_back_when_chain_width_mismatched(self):
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            import pytest
+
+            pytest.skip("needs 8 virtual devices")
+        # mesh chain axis (2) != chain width (4): every batch must fall
+        # back to the messenger and still commit correctly
+        mesh = Mesh(np.array(devs[:4]).reshape(2, 2), ("dp", "chain"))
+        fab = self._fabric("ici", mesh)
+        self._write_workload(fab)
+        svc = next(iter(fab.nodes.values())).service
+        assert svc._ici.hits == 0 and svc._ici.fallbacks > 0

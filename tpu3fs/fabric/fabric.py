@@ -58,6 +58,13 @@ class SystemSetupConfig:
     # shard each; num_replicas is ignored for EC chains
     ec_k: int = 0
     ec_m: int = 0
+    # "ici" + a mesh: CR chains replicate staged batches via the
+    # chain_write_step collective (storage/ici_chain.py) instead of the
+    # per-hop messenger — the intra-pod serving mode. Requires every
+    # chain's targets on one node (pass num_storage_nodes=1) and the
+    # mesh's ``chain`` axis equal to num_replicas.
+    chain_transport: str = "messenger"
+    mesh: object = None
 
 
 class _Node:
@@ -149,6 +156,13 @@ class Fabric:
             self.chain_ids.append(chain_id)
         self.mgmtd.upload_chain_table(1, self.chain_ids)
         self.heartbeat_all()
+        if cfg.chain_transport == "ici":
+            from tpu3fs.storage.ici_chain import IciChainReplicator
+
+            assert cfg.mesh is not None, "ici transport needs a mesh"
+            for node in self.nodes.values():
+                node.service.set_ici_replicator(
+                    IciChainReplicator(cfg.mesh))
 
     # -- plumbing -----------------------------------------------------------
     def close(self) -> None:

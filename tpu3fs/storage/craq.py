@@ -353,6 +353,7 @@ class StorageService:
                            "commit_s": 0.0, "wall_s": 0.0,
                            "ops": 0, "bytes": 0}
                     for role in ("head", "mid", "tail")}
+        self._ici = None  # optional IciChainReplicator (set_ici_replicator)
         # native read-fastpath invalidator (storage/native_fastpath.py):
         # called with a target id on local offlining (None = drop all) so
         # the C++ registry honors offline_target's immediate-refusal
@@ -361,6 +362,13 @@ class StorageService:
 
     def set_fastpath_invalidator(self, fn) -> None:
         self._fastpath_invalidate = fn
+
+    def set_ici_replicator(self, replicator) -> None:
+        """Intra-pod chain replication via mesh collectives
+        (storage/ici_chain.py): when set, staged batches for fully-local
+        SERVING chains ride chain_write_step instead of the per-hop
+        messenger forward."""
+        self._ici = replicator
 
     @property
     def stopped(self) -> bool:
@@ -1125,7 +1133,13 @@ class StorageService:
                         (i, res.ver, res.checksum, reqs[i].full_replace))
             if staged:
                 t0 = time.perf_counter()
-                fwd = self._forward_batch(target, reqs, staged, chain)
+                handled = False
+                fwd = None
+                if self._ici is not None:
+                    handled, fwd = self._ici.try_replicate(
+                        self, target, reqs, staged, chain)
+                if not handled:
+                    fwd = self._forward_batch(target, reqs, staged, chain)
                 dt_forward = time.perf_counter() - t0
                 forwarded = fwd is not None
                 commit_items: List[Tuple[ChunkId, int]] = []

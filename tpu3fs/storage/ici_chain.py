@@ -1,0 +1,179 @@
+"""ICI chain replication as a SERVING mode (round-4 verdict #7).
+
+`tpu3fs.parallel.chain.chain_write_step` is the collective form of CRAQ's
+head->tail fan-out (ref src/storage/service/StorageOperator.cc:333-514):
+a staged batch enters at ring position 0 and flows one `lax.ppermute` hop
+per step, with a carried checksum cross-checked at every position. Until
+this module, only the dryrun and unit tests drove it; here it becomes the
+storage service's intra-pod replication transport: when a chain's targets
+all live on this node and the chain's writer count matches the mesh's
+``chain`` axis, `_handle_batch_update` hands the staged batch to
+`IciChainReplicator.try_replicate` INSTEAD of the per-hop messenger
+forward. Every successor position installs the collective's delivered
+payload through the normal engine stage+commit (same versions, same COW
+offset semantics, same checksum cross-check against the head's staged
+CRC), so the committed state is byte-identical to the messenger path —
+a fabric test asserts exactly that.
+
+Anything the collective cannot express — non-local successors, SYNCING
+members (full-replace installs), a chain wider than the mesh axis — falls
+back to the messenger, mirroring how the reference falls from RDMA to TCP.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from tpu3fs.storage.types import Checksum
+from tpu3fs.utils.result import Code
+
+
+class IciChainReplicator:
+    def __init__(self, mesh, chain_axis: str = "chain", dp_axis: str = "dp"):
+        self.mesh = mesh
+        self.chain_axis = chain_axis
+        self.dp_axis = dp_axis
+        self.hits = 0
+        self.fallbacks = 0
+        self._jit_step = None  # built lazily (one function object: the
+        # jit cache keys on it + input shape, so steady state recompiles
+        # only per payload-shape bucket, never per batch)
+
+    def _step(self):
+        if self._jit_step is None:
+            import jax
+
+            from tpu3fs.parallel.chain import chain_write_step
+
+            self._jit_step = jax.jit(
+                lambda d: chain_write_step(self.mesh, d,
+                                           chain_axis=self.chain_axis,
+                                           dp_axis=self.dp_axis))
+        return self._jit_step
+
+    def try_replicate(
+        self, service, target, reqs, staged, chain
+    ) -> Tuple[bool, Optional[List]]:
+        """-> (handled, replies). `replies` follows _forward_batch's
+        contract (one reply per staged op, or None when this target is
+        the chain tail). handled=False => caller uses the messenger."""
+        from tpu3fs.storage.craq import UpdateReply
+
+        writers = chain.writer_chain()
+        if len(writers) < 2:
+            return True, None  # single-writer chain: head IS the tail
+        if writers[0].target_id != target.target_id:
+            self.fallbacks += 1
+            return False, None  # collective mode engages at the head only
+        if len(writers) != self.mesh.shape.get(self.chain_axis):
+            self.fallbacks += 1
+            return False, None
+        succs = []
+        for t in writers[1:]:
+            local = service.target(t.target_id)
+            if local is None or not t.public_state.can_write:
+                self.fallbacks += 1
+                return False, None
+            from tpu3fs.mgmtd.types import PublicTargetState
+
+            if t.public_state != PublicTargetState.SERVING:
+                self.fallbacks += 1
+                return False, None  # SYNCING => full-replace semantics
+            succs.append(local)
+
+        import jax
+        import jax.numpy as jnp
+
+        # payload matrix: one row per staged op, padded to a common
+        # power-of-two width and a dp-divisible power-of-two batch (shape
+        # bucketing bounds XLA compiles at O(log B * log S) for the one
+        # cached jitted step) — zero padding is inert for both the
+        # transfer checksum comparison and the sliced install below
+        rows = [reqs[i].data for i, _ver, _cs, _fr in staged]
+        width = 1
+        while width < max(len(r) for r in rows):
+            width <<= 1
+        dp = self.mesh.shape.get(self.dp_axis, 1)
+        nrows = dp
+        while nrows < len(rows):
+            nrows <<= 1
+        nrows = -(-nrows // dp) * dp
+        buf = np.zeros((nrows, width), dtype=np.uint8)
+        for r, data in enumerate(rows):
+            buf[r, : len(data)] = np.frombuffer(data, dtype=np.uint8)
+        replicas, ok = self._step()(jnp.asarray(buf))
+        replicas = np.asarray(jax.device_get(replicas))
+        ok = np.asarray(jax.device_get(ok))
+
+        from tpu3fs.storage.engine import EngineUpdateOp
+
+        n = len(staged)
+        replies: List[Optional[UpdateReply]] = [None] * n
+        for j, succ in enumerate(succs, start=1):
+            ops = []
+            op_idx = []
+            for i, (ri, ver, cs, _fr) in enumerate(staged):
+                if replies[i] is not None:
+                    continue  # already failed at an earlier position
+                if not bool(ok[j, i]):
+                    replies[i] = UpdateReply(
+                        Code.CHUNK_CHECKSUM_MISMATCH,
+                        message=f"ICI hop corrupt at position {j}")
+                    continue
+                req = reqs[ri]
+                data = replicas[j, i, : len(req.data)].tobytes()
+                ops.append(EngineUpdateOp(
+                    chunk_id=req.chunk_id, data=data, offset=req.offset,
+                    update_ver=ver, full_replace=req.full_replace,
+                    chunk_size=req.chunk_size or succ.chunk_size))
+                op_idx.append(i)
+            results = succ.engine.batch_update(ops, chain.chain_version) \
+                if ops else []
+            commit_items = []
+            commit_slots = []
+            for i, res in zip(op_idx, results):
+                ri, ver, cs, is_fr = staged[i]
+                if res.code == Code.CHUNK_STALE_UPDATE:
+                    replies[i] = replies[i] or UpdateReply(
+                        Code.OK, update_ver=ver, commit_ver=res.ver,
+                        checksum=Checksum(res.checksum, res.length))
+                    continue
+                if not res.ok:
+                    replies[i] = UpdateReply(res.code,
+                                             message="ICI stage failed")
+                    continue
+                succ_cs = Checksum(res.checksum, res.length)
+                if not is_fr and succ_cs.value != cs.value:
+                    replies[i] = UpdateReply(
+                        Code.CHUNK_CHECKSUM_MISMATCH,
+                        message=(f"ICI position {j} "
+                                 f"{succ_cs.value:#x} != head {cs.value:#x}"))
+                    continue
+                if is_fr:
+                    if j == len(succs):
+                        replies[i] = UpdateReply(
+                            Code.OK, update_ver=ver, commit_ver=ver,
+                            checksum=succ_cs)
+                    continue
+                commit_items.append((reqs[ri].chunk_id, ver))
+                commit_slots.append((i, ver, succ_cs))
+            if commit_items:
+                commit_res = succ.engine.batch_commit(
+                    commit_items, chain.chain_version)
+                for (i, ver, succ_cs), cr in zip(commit_slots, commit_res):
+                    if not cr.ok:
+                        replies[i] = UpdateReply(
+                            cr.code, message="ICI commit failed")
+                    elif j == len(succs):
+                        # the TAIL's replies are what the head cross-checks
+                        replies[i] = UpdateReply(
+                            Code.OK, update_ver=ver, commit_ver=cr.ver,
+                            checksum=succ_cs)
+        self.hits += 1
+        for i in range(n):
+            if replies[i] is None:  # no tail reply materialized: refuse
+                replies[i] = UpdateReply(
+                    Code.ENGINE_ERROR, message="ICI replication incomplete")
+        return True, replies
