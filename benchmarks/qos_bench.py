@@ -1,0 +1,209 @@
+"""qos_bench: foreground read latency under a background resync flood,
+with QoS weighted-fair scheduling ON vs OFF.
+
+The overload shape of the QoS acceptance criteria: one storage node, its
+engine slowed to create real queueing, N resync-class writer threads
+flooding full-replace installs (several times the queue's drain rate)
+while a foreground client reads at its own pace. Measures the foreground
+read latency distribution both ways:
+
+- OFF: the seed behavior — one FIFO per target, resync and foreground
+  writes race each other, foreground reads queue behind whatever the
+  flood stacked up.
+- ON: tpu3fs/qos — resync is weighted 2 vs foreground 8, may occupy at
+  most a quarter of the bounded queue, and overflow sheds with the
+  retryable OVERLOADED + retry-after hint that the flooders honor
+  (self-throttling), keeping the queue shallow for foreground.
+
+Prints ONE JSON line (bench.py conventions):
+  {"metric": "fg_read_p99_under_resync_ms", "value": <scheduled p99>,
+   "unscheduled_p99_ms": ..., "speedup": ..., ...}
+
+Usage: python -m benchmarks.qos_bench [--seconds 3] [--flooders 12]
+           [--queue-cap 8] [--json-out BENCH_QOS.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.qos.core import QosConfig, TrafficClass, tagged
+from tpu3fs.storage.craq import WriteReq
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils.result import Code
+
+CHUNKS = 16
+CHUNK_SIZE = 1 << 16     # 64 KiB chunks: reads do real engine work
+BATCH = 8                # ops per flood batch (one update-worker job)
+POOL = 64                # chunk ids each flooder cycles (bounds tmpfs use)
+
+
+def drive(*, qos_on: bool, seconds: float, flooders: int,
+          queue_cap: int, engine: str, engine_dir: str,
+          resync_rate: float) -> dict:
+    qcfg = None
+    if qos_on:
+        qcfg = QosConfig()
+        qcfg.set("update_queue_cap", queue_cap)
+        qcfg.set("resync.queue_share", 0.25)
+        # the operator's admission knob: cap recovery-install throughput
+        # so foreground keeps the engine (resync self-throttles on sheds)
+        qcfg.set("resync.rate", resync_rate)
+        qcfg.set("resync.burst", max(resync_rate / 10.0, 8.0))
+    fab = Fabric(SystemSetupConfig(
+        num_storage_nodes=1, num_chains=1, num_replicas=1,
+        chunk_size=CHUNK_SIZE, engine=engine, engine_dir=engine_dir,
+        qos=qcfg))
+    chain = fab.chain_ids[0]
+    node_id = min(fab.nodes)
+    svc = fab.nodes[node_id].service
+    target = svc.targets()[0]
+    sc = fab.storage_client()
+    payload = b"r" * CHUNK_SIZE
+    for i in range(CHUNKS):
+        assert sc.write_chunk(chain, ChunkId(1, i), 0, payload,
+                              chunk_size=CHUNK_SIZE).ok
+
+    stop = threading.Event()
+    stats = {"bg_writes": 0, "sheds": 0}
+    lock = threading.Lock()
+    bg_payload = b"b" * CHUNK_SIZE
+
+    def bg_flood(fid: int) -> None:
+        # full-chunk recovery installs in update-worker batches: the
+        # resync shape, at whatever rate the queue (and under QoS, the
+        # shed/self-throttle loop) allows
+        i = 0
+        ver = fab.routing().chains[chain].chain_version
+        with tagged(TrafficClass.RESYNC):
+            while not stop.is_set():
+                i += 1
+                reqs = [WriteReq(chain_id=chain, chain_ver=ver,
+                                 chunk_id=ChunkId(
+                                     6000 + fid, (i * BATCH + j) % POOL),
+                                 offset=0, data=bg_payload,
+                                 chunk_size=CHUNK_SIZE,
+                                 update_ver=i, full_replace=True,
+                                 from_target=target.target_id)
+                        for j in range(BATCH)]
+                out = fab.send(node_id, "batch_update", reqs)
+                shed = any(r.code == Code.OVERLOADED for r in out)
+                with lock:
+                    stats["bg_writes"] += len(reqs)
+                    if shed:
+                        stats["sheds"] += 1
+                if shed:
+                    # honor the server's hint: the self-throttle loop
+                    hint = max((r.retry_after_ms for r in out), default=10)
+                    time.sleep((hint or 10) / 1000.0)
+
+    threads = [threading.Thread(target=bg_flood, args=(n,))
+               for n in range(flooders)]
+
+    # one foreground writer alongside the reads: fg writes share the
+    # update-worker queue with the flood — the spot weighted-fair
+    # scheduling and the bounded background share actually defend
+    wlat = []
+    wsc = fab.storage_client()
+
+    def fg_writer() -> None:
+        i = 0
+        while not stop.is_set():
+            i += 1
+            t0 = time.perf_counter()
+            out = wsc.batch_write(
+                [(chain, ChunkId(2, i % CHUNKS), 0, payload)],
+                chunk_size=CHUNK_SIZE)
+            wlat.append(time.perf_counter() - t0)
+            assert out[0].ok
+            time.sleep(0.001)  # a paced foreground writer, not a flood
+
+    wt = threading.Thread(target=fg_writer)
+    for t in threads:
+        t.start()
+    wt.start()
+    lat = []
+    depth_max = 0
+    t_end = time.monotonic() + seconds
+    while time.monotonic() < t_end:
+        t0 = time.perf_counter()
+        r = sc.read_chunk(chain, ChunkId(1, len(lat) % CHUNKS))
+        lat.append(time.perf_counter() - t0)
+        assert r.ok
+        if len(lat) % 16 == 0:  # sampling, not per-read bookkeeping
+            depth_max = max(depth_max, sum(
+                svc.qos_snapshot()["queue_depths"].values()))
+    stop.set()
+    for t in threads:
+        t.join()
+    wt.join()
+    fab.close()
+    lat.sort()
+    wlat.sort()
+
+    def q(vals, p: float) -> float:
+        return vals[min(len(vals) - 1, int(p * len(vals)))] * 1000
+
+    return {
+        "reads": len(lat),
+        "read_p50_ms": round(q(lat, 0.50), 3),
+        "p99_ms": round(q(lat, 0.99), 3),
+        "fg_writes": len(wlat),
+        "fg_write_p50_ms": round(q(wlat, 0.50), 3),
+        "fg_write_p99_ms": round(q(wlat, 0.99), 3),
+        "max_queue_depth": depth_max,
+        "bg_writes": stats["bg_writes"],
+        "bg_sheds": stats["sheds"],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--flooders", type=int, default=12)
+    ap.add_argument("--queue-cap", type=int, default=8)
+    ap.add_argument("--engine", default="native")
+    ap.add_argument("--engine-dir", default="/dev/shm")
+    ap.add_argument("--resync-rate", type=float, default=1500.0,
+                    help="scheduled-mode admission cap, recovery ops/s")
+    ap.add_argument("--json-out", default="")
+    args = ap.parse_args()
+
+    kw = dict(seconds=args.seconds, flooders=args.flooders,
+              queue_cap=args.queue_cap, engine=args.engine,
+              engine_dir=args.engine_dir, resync_rate=args.resync_rate)
+    scheduled = drive(qos_on=True, **kw)
+    unscheduled = drive(qos_on=False, **kw)
+    record = {
+        "metric": "fg_read_p99_under_resync_ms",
+        "value": scheduled["p99_ms"],
+        "unit": "ms",
+        "unscheduled_p99_ms": unscheduled["p99_ms"],
+        "speedup": round(
+            unscheduled["p99_ms"] / max(scheduled["p99_ms"], 1e-9), 2),
+        "fg_write_p99_ms": scheduled["fg_write_p99_ms"],
+        "unscheduled_fg_write_p99_ms": unscheduled["fg_write_p99_ms"],
+        "fg_write_speedup": round(
+            unscheduled["fg_write_p99_ms"]
+            / max(scheduled["fg_write_p99_ms"], 1e-9), 2),
+        "scheduled": scheduled,
+        "unscheduled": unscheduled,
+        "config": {"flooders": args.flooders, "queue_cap": args.queue_cap,
+                   "seconds": args.seconds, "engine": args.engine,
+                   "resync_rate": args.resync_rate,
+                   "chunk_size": CHUNK_SIZE, "batch": BATCH},
+    }
+    line = json.dumps(record)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -550,6 +550,11 @@ struct Engine {
   // TPU3FS_MMAP=0|1.
   bool use_mmap = false;
   bool on_tmpfs = false;  // detected (never forced): gates fsync skipping
+  // set when a post-rename remap_base failure leaves the paged index
+  // half-visible (compact()): every subsequent op refuses with E_IO
+  // rather than serving an index that silently hides base-resident
+  // chunks. Recovery is a process restart (replay rebuilds from disk).
+  bool poisoned = false;
 
   // ensure class `cls`'s file and mapping cover [0, end); -> map or null
   uint8_t* map_for(int cls, size_t end) {
@@ -762,12 +767,25 @@ struct Engine {
   }
 
   // a failed validated install drops the slot it just created (no
-  // phantom); a true phantom is never base-resident, so the overlap
-  // erase below is a no-op for real data
+  // phantom). pin() erased the key from dead_, so a base-resident key —
+  // i.e. one REMOVED since the last rewrite — must be re-masked here
+  // (mirroring erase_meta_nolog), or the next lookup would resurrect the
+  // removed chunk from the base with block refs remove() already freed
+  // (and the allocator may have reassigned): reads could return another
+  // chunk's data and a later remove would double-free a live block.
   void drop_phantom(const Key& k) {
     metas.erase(k);
     logged_len_.erase(k);
     base_overlap_.erase(k);
+    if (base_.find(k) != nullptr) dead_.insert(k);
+  }
+
+  // true when `m` is a slot pin() just created (nothing committed or
+  // staged): every post-pin error return must drop such slots via
+  // drop_phantom, both for the no-phantom rule and the dead_ re-mask
+  static bool is_phantom(const ChunkMeta& m) {
+    return !m.committed.valid() && !m.pending.valid() &&
+           m.committed_ver == 0 && m.pending_ver == 0;
   }
 
   // erase bookkeeping shared by remove() and WAL replay
@@ -834,6 +852,7 @@ struct Engine {
   }
 
   int compact() {
+    if (poisoned) return E_IO;
     // rewrite the BASE RUN: stream-merge (base - dead) with the delta into
     // a fresh sorted record array, swap it in atomically, then truncate
     // the WAL — RAM drops back to an empty delta. The rewrite trigger is
@@ -883,7 +902,16 @@ struct Engine {
     fsync(fd);
     close(fd);
     if (rename(tmp.c_str(), base_path().c_str()) != 0) return E_IO;
-    if (remap_base() != OK) return E_IO;
+    if (remap_base() != OK) {
+      // the old base mapping is already gone (base_.reset inside
+      // remap_base) but the delta/dead_ sets still describe overlays of
+      // it: every base-resident chunk is now silently invisible while
+      // counts/used_ disagree. That index cannot be served — POISON the
+      // engine (all subsequent ops refuse with E_IO) instead of
+      // returning a retryable error with a half-visible index.
+      poisoned = true;
+      return E_IO;
+    }
     metas.clear();
     dead_.clear();
     base_overlap_.clear();
@@ -1066,6 +1094,7 @@ struct Engine {
       int rc = write_block(nb, data, data_len);
       if (rc != OK) {
         classes[cls].release(nb.idx);
+        if (is_phantom(m)) drop_phantom(k);  // restore dead_ mask too
         return rc;
       }
       free_block(m.committed);
@@ -1094,18 +1123,25 @@ struct Engine {
       buf.assign(new_len, 0);
       if (m.committed.valid() && m.committed.length) {
         int rc = read_block(m.committed, buf.data(), 0, m.committed.length);
-        if (rc != OK) return rc;
+        if (rc != OK) {
+          if (is_phantom(m)) drop_phantom(k);
+          return rc;
+        }
       }
       memcpy(buf.data() + offset, data, data_len);
       src = buf.data();
     }
     int cls = class_for(std::max<uint32_t>(new_len, 1));
-    if (cls < 0) return E_INVALID;
+    if (cls < 0) {
+      if (is_phantom(m)) drop_phantom(k);
+      return E_INVALID;
+    }
     uint32_t crc = crc32c(src, new_len);
     if (check_crc && crc != expected_crc) {
-      // drop the meta if this lookup created it (no phantom on refusal)
-      if (!m.committed.valid() && !m.pending.valid() && m.committed_ver == 0)
-        drop_phantom(k);
+      // drop the meta if this lookup created it (no phantom on refusal;
+      // drop_phantom also restores the dead_ mask of a removed
+      // base-resident chunk — see drop_phantom)
+      if (is_phantom(m)) drop_phantom(k);
       return E_CHECKSUM;
     }
     free_block(m.pending);  // re-staging the same pending ver is idempotent
@@ -1114,6 +1150,7 @@ struct Engine {
     int rc = write_block(nb, src, new_len);
     if (rc != OK) {
       classes[cls].release(nb.idx);
+      if (is_phantom(m)) drop_phantom(k);
       return rc;
     }
     m.pending = nb;
@@ -1304,6 +1341,7 @@ int ce_update(void* h, const uint8_t* key, uint64_t update_ver,
               uint32_t aux, int check_crc, uint32_t expected_crc) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   Key k;
   memcpy(k.b, key, kKeyLen);
   uint64_t ver = update_ver;
@@ -1316,6 +1354,7 @@ int ce_update(void* h, const uint8_t* key, uint64_t update_ver,
 int ce_commit(void* h, const uint8_t* key, uint64_t ver, uint64_t chain_ver) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   Key k;
   memcpy(k.b, key, kKeyLen);
   return e->commit(k, ver, chain_ver);
@@ -1325,6 +1364,7 @@ int ce_read(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
             uint32_t offset, int64_t length, int64_t* out_len) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   Key k;
   memcpy(k.b, key, kKeyLen);
   return e->read(k, out, cap, offset, length, out_len);
@@ -1334,6 +1374,7 @@ int ce_read_pending(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
                     int64_t* out_len) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   Key k;
   memcpy(k.b, key, kKeyLen);
   return e->read_pending(k, out, cap, out_len);
@@ -1342,6 +1383,7 @@ int ce_read_pending(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
 int ce_get_meta(void* h, const uint8_t* key, CMeta* out) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   Key k;
   memcpy(k.b, key, kKeyLen);
   const ChunkMeta* m = e->lookup(k);
@@ -1353,6 +1395,7 @@ int ce_get_meta(void* h, const uint8_t* key, CMeta* out) {
 int ce_remove(void* h, const uint8_t* key) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   Key k;
   memcpy(k.b, key, kKeyLen);
   return e->remove(k);
@@ -1362,6 +1405,7 @@ int ce_truncate(void* h, const uint8_t* key, uint32_t new_len,
                 uint64_t chain_ver) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   Key k;
   memcpy(k.b, key, kKeyLen);
   return e->truncate(k, new_len, chain_ver);
@@ -1373,6 +1417,7 @@ int ce_query(void* h, const uint8_t* prefix, uint32_t prefix_len, CMeta* out,
              int max_out) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   if (prefix_len > kKeyLen) return E_INVALID;
   // ordered 2-way merge of the base run and the delta (delta wins on
   // ties; dead_ masks erased base keys) — same key order as before
@@ -1406,6 +1451,7 @@ int ce_query(void* h, const uint8_t* prefix, uint32_t prefix_len, CMeta* out,
 int ce_query_pending(void* h, CMeta* out, int max_out) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   int n = 0;
   for (const auto& k : e->pending_keys) {
     const ChunkMeta* m = e->lookup(k);
@@ -1777,6 +1823,7 @@ int ce_batch_update(void* h, uint64_t chain_ver, const uint8_t* blob,
                     const CUpOp* ops, COpResult* res, int n) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   e->log_buffering = true;  // ONE WAL append for the whole batch
   for (int i = 0; i < n; i++) {
     const CUpOp& op = ops[i];
@@ -1809,6 +1856,7 @@ int ce_batch_write(void* h, uint64_t chain_ver, const uint8_t* blob,
                    const CUpOp* ops, COpResult* res, int n) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   e->log_buffering = true;  // ONE WAL append for the whole batch
   for (int i = 0; i < n; i++) {
     const CUpOp& op = ops[i];
@@ -1836,6 +1884,7 @@ int ce_batch_commit(void* h, uint64_t chain_ver, const uint8_t* keys,
                     const uint64_t* vers, COpResult* res, int n) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   e->log_buffering = true;  // ONE WAL append for the whole batch
   for (int i = 0; i < n; i++) {
     Key k;
@@ -1858,6 +1907,7 @@ int ce_batch_read(void* h, const CReadOp* ops, uint8_t* out, uint64_t cap,
                   COpResult* res, int n) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   // resolve phase: validate each op and turn it into a raw (fd, offset,
   // len, dest) read under the mutex; the IO phase then runs every read
   // through ONE io_uring submit/reap (the AioReadWorker analogue) — or a
@@ -1979,6 +2029,7 @@ int ce_read2(void* h, const uint8_t* key, uint8_t* out, uint64_t cap,
              uint32_t* out_aux) {
   auto* e = static_cast<Engine*>(h);
   std::lock_guard<std::mutex> g(e->mu);
+  if (e->poisoned) return E_IO;
   Key k;
   memcpy(k.b, key, kKeyLen);
   int rc = e->read(k, out, cap, offset, length, out_len);

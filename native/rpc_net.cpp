@@ -894,6 +894,52 @@ struct Job {
   Packet req;
 };
 
+// ---- cheap QoS admission (the native mirror of tpu3fs/qos) ----------------
+// A per-service-id token ceiling checked in the worker BEFORE the fast
+// path or the Python handler run: under extreme overload frames are
+// answered with the retryable OVERLOADED (108) + a retry-after hint
+// without crossing the FFI at all. The full (service, method, class)
+// admission lives in Python (qos/core.py AdmissionController); this is
+// the coarse backstop configured from QosConfig.native_ceiling_rate.
+constexpr int64_t kOverloaded = 108;  // tpu3fs/utils/result.py Code.OVERLOADED
+
+struct QosBucket {
+  std::mutex mu;
+  double rate = 0.0;   // tokens/s; <= 0 = unlimited
+  double burst = 1.0;
+  double tokens = 1.0;
+  double last_s = 0.0;
+
+  // -> 0 when admitted, else suggested retry-after in ms
+  int64_t try_take(int64_t fallback_ms) {
+    std::lock_guard<std::mutex> g(mu);
+    if (rate <= 0.0) return 0;
+    double now = mono_now();  // seconds
+    if (now > last_s)
+      tokens = std::min(burst, tokens + (now - last_s) * rate);
+    last_s = now;
+    if (tokens >= 1.0) {
+      tokens -= 1.0;
+      return 0;
+    }
+    int64_t ms = static_cast<int64_t>((1.0 - tokens) / rate * 1000.0) + 1;
+    return std::max(fallback_ms, ms);
+  }
+};
+
+struct QosState {
+  std::mutex mu;  // guards the map shape; buckets lock themselves
+  std::map<int64_t, std::unique_ptr<QosBucket>> buckets;
+  std::atomic<uint64_t> shed{0};
+  int64_t retry_after_ms = 50;
+
+  QosBucket* find(int64_t service_id) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = buckets.find(service_id);
+    return it == buckets.end() ? nullptr : it->second.get();
+  }
+};
+
 struct Server {
   int listen_fd = -1;
   int epoll_fd = -1;
@@ -912,6 +958,7 @@ struct Server {
   std::map<int, std::shared_ptr<Conn>> conns;
 
   FpState fastpath;
+  QosState qos;
 };
 
 void server_close_conn(Server* s, const std::shared_ptr<Conn>& c) {
@@ -948,6 +995,31 @@ void worker_main(Server* s) {
     rsp.flags = 0;
     memcpy(rsp.ts, req.ts, sizeof(req.ts));
     rsp.ts[4] = mono_now();  // server_run_start
+    // cheap QoS ceiling: shed before the fast path or any FFI crossing
+    if (QosBucket* qb = s->qos.find(req.service_id)) {
+      int64_t ra = qb->try_take(s->qos.retry_after_ms);
+      if (ra > 0) {
+        s->qos.shed.fetch_add(1);
+        rsp.status = kOverloaded;
+        rsp.message = "retry_after_ms=" + std::to_string(ra) +
+                      " (native ceiling)";
+        rsp.ts[5] = mono_now();
+        std::string envq = encode_packet(rsp);
+        uint64_t totalq = envq.size();
+        uint8_t hdrq[4] = {uint8_t(totalq >> 24), uint8_t(totalq >> 16),
+                           uint8_t(totalq >> 8), uint8_t(totalq)};
+        struct iovec iovq[2] = {
+            {hdrq, 4},
+            {const_cast<char*>(envq.data()), envq.size()},
+        };
+        std::lock_guard<std::mutex> g(job.conn->write_mu);
+        if (!job.conn->closed.load() &&
+            !send_iovs(job.conn->fd, iovq, 2, kServerDrainTimeoutMs)) {
+          server_close_conn(s, job.conn);
+        }
+        continue;
+      }
+    }
     // native read fast path: batchRead AND single read against
     // registered native-engine targets never enter Python (so neither do
     // Python-side read metrics / fault-injection points for those ops);
@@ -1513,6 +1585,42 @@ void tpu3fs_rpc_fastpath_set_write_chain(void* srv, int64_t chain_id,
 }
 
 // hits and fallbacks, for tests and metrics
+// ---- cheap QoS ceiling configuration (see QosState above) ------------------
+// Configured by tpu3fs/rpc/native_net.py from QosConfig.native_ceiling_*;
+// re-synced on every hot config update via the controller's reload hook.
+
+void tpu3fs_rpc_qos_set(void* srv, int64_t service_id, double rate_per_s,
+                        double burst, int64_t retry_after_ms) {
+  Server* s = static_cast<Server*>(srv);
+  if (s == nullptr) return;
+  std::lock_guard<std::mutex> g(s->qos.mu);
+  auto& slot = s->qos.buckets[service_id];
+  if (!slot) slot = std::make_unique<QosBucket>();
+  std::lock_guard<std::mutex> bg(slot->mu);
+  slot->rate = rate_per_s;
+  slot->burst = std::max(1.0, burst);
+  slot->tokens = slot->burst;
+  slot->last_s = mono_now();
+  if (retry_after_ms > 0) s->qos.retry_after_ms = retry_after_ms;
+}
+
+void tpu3fs_rpc_qos_clear(void* srv) {
+  Server* s = static_cast<Server*>(srv);
+  if (s == nullptr) return;
+  // disable rather than erase: a worker may hold a bucket pointer from
+  // QosState::find while this runs, so buckets live as long as the server
+  std::lock_guard<std::mutex> g(s->qos.mu);
+  for (auto& kv : s->qos.buckets) {
+    std::lock_guard<std::mutex> bg(kv.second->mu);
+    kv.second->rate = 0.0;
+  }
+}
+
+uint64_t tpu3fs_rpc_qos_shed_count(void* srv) {
+  Server* s = static_cast<Server*>(srv);
+  return s == nullptr ? 0 : s->qos.shed.load();
+}
+
 void tpu3fs_rpc_fastpath_stats(void* srv, uint64_t* hits,
                                uint64_t* fallbacks) {
   auto* s = static_cast<Server*>(srv);

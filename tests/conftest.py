@@ -22,3 +22,8 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", os.environ.get("TPU3FS_TEST_PLATFORM", "cpu"))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long soaks excluded from the tier-1 run")

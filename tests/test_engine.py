@@ -228,6 +228,66 @@ class TestRegressionFixes:
             engine.read(cid(42))
         assert ei.value.code == Code.CHUNK_NOT_FOUND
 
+    def test_removed_base_chunk_not_resurrected_by_failed_install(
+            self, tmp_path):
+        """Round-5 advisor (high): compact() makes a chunk base-resident;
+        remove() then masks it via dead_. A failed VALIDATED install
+        (wrong CRC) pins the key — erasing the dead_ mask — and the
+        refusal path must restore the mask, or the next lookup would
+        resurrect the removed chunk from the base with block refs that
+        remove() already freed (reads of another chunk's data, later
+        double-free)."""
+        eng = NativeChunkEngine(str(tmp_path / "eng"))
+        try:
+            data = b"v" * 256
+            eng.update(cid(7), 1, 1, data, 0, full_replace=True,
+                       chunk_size=CS)
+            eng.compact()          # chunk 7 is now base-resident
+            assert eng.remove(cid(7))
+            assert eng.get_meta(cid(7)) is None
+            # wrong-CRC validated install (the EC shard-install shape)
+            with pytest.raises(FsError) as ei:
+                eng.update(cid(7), 2, 1, data, 0, stage_replace=True,
+                           chunk_size=CS,
+                           expected_crc=(crc32c(data) ^ 0xDEAD))
+            assert ei.value.code == Code.CHUNK_CHECKSUM_MISMATCH
+            # the regression: E_NOT_FOUND, not the resurrected base record
+            assert eng.get_meta(cid(7)) is None
+            assert all(m.chunk_id != cid(7) for m in eng.all_metadata())
+            with pytest.raises(FsError):
+                eng.read(cid(7))
+            # a second remove must be a no-op, not a double free
+            assert not eng.remove(cid(7))
+            # and a correct install over the removed key works cleanly
+            meta = eng.update(cid(7), 3, 1, data, 0, full_replace=True,
+                              chunk_size=CS, expected_crc=crc32c(data))
+            assert meta.committed_ver == 3
+            assert eng.read(cid(7)) == data
+        finally:
+            eng.close()
+
+    def test_cow_failure_after_pin_restores_dead_mask(self, tmp_path):
+        """The COW-mode (mode 0) flavor of the same leak: a post-pin
+        refusal during a plain chain update on a removed base-resident
+        key must also drop the phantom + restore the dead_ mask."""
+        eng = NativeChunkEngine(str(tmp_path / "eng"))
+        try:
+            data = b"w" * 64
+            eng.update(cid(8), 1, 1, data, 0, full_replace=True,
+                       chunk_size=CS)
+            eng.compact()
+            assert eng.remove(cid(8))
+            # COW update at cv+1 passes the version algebra, pins the key,
+            # then the validated-install CRC check refuses post-pin
+            with pytest.raises(FsError) as ei:
+                eng.update(cid(8), 1, 1, data, 0, chunk_size=CS,
+                           expected_crc=(crc32c(data) ^ 1))
+            assert ei.value.code == Code.CHUNK_CHECKSUM_MISMATCH
+            assert eng.get_meta(cid(8)) is None
+            assert not eng.remove(cid(8))
+        finally:
+            eng.close()
+
     def test_empty_file_reads_empty(self):
         from tpu3fs.fabric import Fabric, SystemSetupConfig
         from tpu3fs.meta import OpenFlags

@@ -450,7 +450,29 @@ class TestUpdateWorkerPipeline:
         assert r.ok and r.data == bytes([8]) * 64
         assert r.commit_ver == 8
 
-    def test_bounded_queue_refuses_with_retriable_code(self):
+    def test_single_node_chain_forward_lands_on_successor(self):
+        """A chain whose replicas share ONE node: the forwarded update
+        must land on the SUCCESSOR of from_target, not the first local
+        writer — the latter re-enters the head's own chunk lock while
+        the forwarding thread still holds it (self-deadlock; this test
+        hung forever before _local_receiver)."""
+        fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=1, num_chains=1, num_replicas=2,
+            chunk_size=4096))
+        sc = fab.storage_client()
+        chain_id = fab.chain_ids[0]
+        r = sc.write_chunk(chain_id, ChunkId(77, 0), 0, b"solo",
+                           chunk_size=4096)
+        assert r.ok
+        svc = fab.nodes[min(fab.nodes)].service
+        committed = [t.engine.get_meta(ChunkId(77, 0))
+                     for t in svc.targets()]
+        # replicated to BOTH local targets, both committed
+        assert all(m is not None and m.committed_ver == 1
+                   for m in committed)
+
+    def test_bounded_queue_sheds_with_retriable_overloaded(self):
+        from tpu3fs.qos.core import retry_after_ms_of
         from tpu3fs.storage.update_worker import UpdateWorker
         import threading
 
@@ -480,7 +502,14 @@ class TestUpdateWorkerPipeline:
         gate.set()
         for t in ts:
             t.join()
-        assert overflow == [(Code.TIMEOUT, "update queue full")]
+        # QoS shed: retryable OVERLOADED + a retry-after hint in the
+        # message (legacy two-arg make_reply still receives the hint)
+        assert len(overflow) == 1
+        code, msg = overflow[0]
+        assert code == Code.OVERLOADED
+        from tpu3fs.utils.result import Status
+        assert Status(code).retryable()
+        assert retry_after_ms_of(msg) > 0
         w.stop()
 
 

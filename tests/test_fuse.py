@@ -386,6 +386,39 @@ class TestReaddirplus:
         finally:
             o._meta.stat = real_stat
 
+    def test_racing_readdirplus_cannot_pin_pre_mutation_attrs(self,
+                                                              fuse_ops):
+        """Round-5 advisor (low): the cache is cleared AFTER a mutation
+        completes too, so a readdirplus interleaving with the mutation
+        (re-inserting pre-mutation attrs after the leading clear) cannot
+        leave stale size/mode served for the TTL window. Simulated by
+        re-priming the cache from INSIDE the meta op — the worst-case
+        interleaving point."""
+        o = fuse_ops
+        o.mkdir("/race", 0o755)
+        fh = o.create("/race/f", 0o644)
+        o.write(fh, 0, b"old!")
+        o.release(fh)
+        stale = o.getattr("/race/f")  # primes the cache at size 4
+        real_set_attr = o._meta.set_attr
+
+        def racing_set_attr(path, **kw):
+            out = real_set_attr(path, **kw)
+            # racing readdirplus lands between mutation and return:
+            # re-inserts the PRE-mutation attr after the leading clear
+            import time as _time
+
+            o._attr_cache["/race/f"] = (_time.time(), stale)
+            return out
+
+        o._meta.set_attr = racing_set_attr
+        try:
+            o.chmod("/race/f", 0o600)
+        finally:
+            o._meta.set_attr = real_set_attr
+        # the trailing clear must have dropped the re-inserted entry
+        assert o.getattr("/race/f").mode & 0o7777 == 0o600
+
     def test_mutation_drops_cache(self, fuse_ops):
         o = fuse_ops
         o.mkdir("/mut", 0o755)

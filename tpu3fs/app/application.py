@@ -122,9 +122,34 @@ class ApplicationBase:
         else:
             self.server = RpcServer(self.info.hostname, port)
         self.info.port = self.server.port
+        self._init_qos()
         bind_core_service(self.server, config=self.config,
                           on_shutdown=self.stop)
         self.build_services(self.server)
+
+    def _init_qos(self) -> None:
+        """Every service binary whose config tree declares a ``qos``
+        section gets an AdmissionController enforced in its RPC dispatch
+        (token bucket + concurrency cap per (service, method, traffic
+        class), qos/core.py). Limits hot-update through the same config
+        tree a mgmtd config push lands in — no restart."""
+        self.admission = None
+        qos_cfg = getattr(self.config, "qos", None)
+        from tpu3fs.qos.core import AdmissionController, QosConfig
+
+        if isinstance(qos_cfg, QosConfig):
+            self.admission = AdmissionController(
+                qos_cfg, tags={"node": str(self.info.node_id),
+                               "kind": type(self).__name__})
+            set_adm = getattr(self.server, "set_admission", None)
+            if set_adm is not None:
+                set_adm(self.admission, exempt=self._qos_exempt_services())
+
+    def _qos_exempt_services(self) -> set:
+        """Service ids whose admission happens inside the service itself
+        (storage: the QoS manager shares the controller, so RPC-level
+        charging would double-count)."""
+        return set()
 
     def start_server(self) -> None:
         assert self.server is not None
@@ -306,9 +331,8 @@ class TwoPhaseApplication(ApplicationBase):
 
     def _apply_config_push(self, version: int, content: str) -> None:
         if version > self._config_version and content:
-            import tomllib
-
             from tpu3fs.rpc.services import _flatten
+            from tpu3fs.utils.config import tomllib
 
             try:
                 self.config.hot_update(_flatten(tomllib.loads(content)))

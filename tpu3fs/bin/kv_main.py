@@ -18,9 +18,12 @@ from tpu3fs.kv.service import KvService, bind_kv_service
 from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.utils.config import Config, ConfigItem
+from tpu3fs.qos.core import QosConfig
 
 
 class KvAppConfig(Config):
+    # QoS admission limits for the KV RPC dispatch (tpu3fs/qos)
+    qos = QosConfig
     snapshot_ttl_s = ConfigItem(60.0, hot=True)
 
 

@@ -21,9 +21,12 @@ from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import RpcMessenger, bind_meta_service
 from tpu3fs.utils.config import Config, ConfigItem
+from tpu3fs.qos.core import QosConfig
 
 
 class MetaAppConfig(Config):
+    # QoS admission limits for the meta RPC dispatch (tpu3fs/qos)
+    qos = QosConfig
     chunk_size = ConfigItem(1 << 20)
     stripe = ConfigItem(1)
     gc_interval_s = ConfigItem(10.0, hot=True)
@@ -93,14 +96,19 @@ class MetaApp(TwoPhaseApplication):
         self.spawn(self._gc_loop, "meta-gc")
 
     def run_gc(self) -> int:
+        from tpu3fs.qos.core import TrafficClass, tagged
+
         removed = 0
         fio = self._file_client()
-        for inode in self.meta.gc_scan():
-            if self.meta.has_sessions(inode.id):
-                continue
-            fio.remove_chunks(inode)
-            self.meta.gc_finish(inode.id)
-            removed += 1
+        # chunk removals are GC-class traffic: the storage-side QoS
+        # scheduler keeps them behind foreground IO (tpu3fs/qos)
+        with tagged(TrafficClass.GC):
+            for inode in self.meta.gc_scan():
+                if self.meta.has_sessions(inode.id):
+                    continue
+                fio.remove_chunks(inode)
+                self.meta.gc_finish(inode.id)
+                removed += 1
         return removed
 
     def _gc_loop(self) -> None:

@@ -19,9 +19,12 @@ from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import bind_mgmtd_admin, bind_mgmtd_service
 from tpu3fs.utils.config import Config, ConfigItem
+from tpu3fs.qos.core import QosConfig
 
 
 class MgmtdAppConfig(Config):
+    # QoS admission limits for the mgmtd RPC dispatch (tpu3fs/qos)
+    qos = QosConfig
     lease_length_s = ConfigItem(60.0, hot=True)
     heartbeat_timeout_s = ConfigItem(60.0, hot=True)
     tick_interval_s = ConfigItem(5.0, hot=True)

@@ -14,9 +14,12 @@ from tpu3fs.monitor.collector import CollectorService, bind_collector_service
 from tpu3fs.monitor.recorder import JsonlSink, SqliteSink
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.utils.config import Config, ConfigItem
+from tpu3fs.qos.core import QosConfig
 
 
 class MonitorAppConfig(Config):
+    # QoS admission limits for the collector RPC dispatch (tpu3fs/qos)
+    qos = QosConfig
     out_path = ConfigItem("monitor_samples.jsonl")
 
 

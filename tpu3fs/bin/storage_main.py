@@ -18,6 +18,7 @@ from typing import Dict, List, Optional
 
 from tpu3fs.app.application import TwoPhaseApplication
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType
+from tpu3fs.qos.core import QosConfig
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import RpcMessenger, bind_storage_service
 from tpu3fs.storage.craq import StorageService
@@ -51,6 +52,9 @@ class StorageAppConfig(Config):
     reject_create_threshold = ConfigItem(0.98, hot=True)
     emergency_recycling_ratio = ConfigItem(0.95, hot=True)
     trace_dir = ConfigItem("")  # write-path structured trace; "" = off
+    # QoS: per-class admission/scheduling limits (tpu3fs/qos) — every
+    # item hot-updates via mgmtd config push without restart
+    qos = QosConfig
 
 
 class StorageApp(TwoPhaseApplication):
@@ -64,11 +68,24 @@ class StorageApp(TwoPhaseApplication):
     def default_config(self) -> Config:
         return StorageAppConfig()
 
+    def _qos_exempt_services(self) -> set:
+        # storage methods are admission-checked inside StorageService via
+        # the shared controller (read gates, write entry, WFQ shedding) —
+        # RPC-level charging on top would double-count each op
+        from tpu3fs.rpc.services import STORAGE_SERVICE_ID
+
+        return {STORAGE_SERVICE_ID}
+
     def build_services(self, server: RpcServer) -> None:
         messenger = RpcMessenger(lambda: self.mgmtd_client.routing())
         self.service = StorageService(
             self.info.node_id, lambda: self.mgmtd_client.routing(), messenger
         )
+        from tpu3fs.qos.manager import QosManager
+
+        self.service.set_qos(QosManager(
+            self.config.qos, tags={"node": str(self.info.node_id)},
+            admission=self.admission))
         trace_dir = self.config.get("trace_dir")
         if trace_dir:
             from tpu3fs.analytics.trace import StructuredTraceLog

@@ -372,6 +372,37 @@ class AdminCli:
             f"p99={s.p99:.1f} tags={s.tags}"
             for s in samples)
 
+    def cmd_qos(self, args: List[str]) -> str:
+        """Per-node QoS view (tpu3fs/qos): per-class admission limits,
+        live in-flight counts and update-queue depths.
+        qos [--node N]"""
+        want = self._flag(args, "--node")
+        lines = []
+        for node_id in sorted(getattr(self.fab, "nodes", {})):
+            if want is not None and int(want) != node_id:
+                continue
+            service = self.fab.nodes[node_id].service
+            snap = service.qos_snapshot()
+            lines.append(f"node {node_id}: qos "
+                         f"{'enabled' if snap.get('enabled') else 'disabled'}")
+            classes = snap.get("classes", {})
+            if classes:
+                lines.append("  CLASS       RATE     BURST  INFLIGHT/CAP"
+                             "  WEIGHT  QSHARE  QDEPTH")
+                depths = snap.get("queue_depths", {})
+                for name, c in classes.items():
+                    cap = c["max_inflight"] or "-"
+                    rate = c["rate"] or "-"
+                    lines.append(
+                        f"  {name:<11} {str(rate):<8} {c['burst']:<6.0f} "
+                        f"{c['inflight']}/{cap:<11} {c['weight']:<7} "
+                        f"{c['queue_share']:<7.2f} {depths.get(name, 0)}")
+            else:
+                depths = snap.get("queue_depths", {})
+                if depths:
+                    lines.append(f"  queue depths: {depths}")
+        return "\n".join(lines) if lines else "no storage nodes"
+
     # -- FS shell ------------------------------------------------------------
     def cmd_ls(self, args: List[str]) -> str:
         path = args[0] if args else "/"

@@ -49,6 +49,17 @@ def ec_logical_ver(encoded: int) -> int:
         else encoded
 
 
+def _hint_ms(reply) -> int:
+    """Server retry-after hint of a shed reply: the typed field when the
+    reply carries one, else parsed from the envelope message."""
+    ms = getattr(reply, "retry_after_ms", 0)
+    if ms:
+        return int(ms)
+    from tpu3fs.qos.core import retry_after_ms_of
+
+    return retry_after_ms_of(getattr(reply, "message", "") or "")
+
+
 class TargetSelectionMode(enum.Enum):
     """ref TargetSelection.h:29-46."""
 
@@ -181,10 +192,18 @@ class StorageClient:
         return ((ec_logical_ver(prev_encoded) + 1) << EC_VER_SHIFT) | \
             int.from_bytes(os.urandom(4), "big")
 
-    def _sleep(self, attempt: int) -> None:
-        delay = min(
-            self._retry.backoff_max_s, self._retry.backoff_base_s * (2 ** attempt)
-        )
+    def _sleep(self, attempt: int, hint_ms: int = 0) -> None:
+        """Jittered backoff. A server retry-after hint (an OVERLOADED
+        shed, qos/core.py) REPLACES the exponential guess: the server
+        knows its own refill horizon, so the client waits exactly that
+        (jittered to decorrelate a herd of shed clients) instead of
+        hammering blind."""
+        if hint_ms > 0:
+            delay = min(self._retry.backoff_max_s * 4, hint_ms / 1000.0)
+        else:
+            delay = min(
+                self._retry.backoff_max_s,
+                self._retry.backoff_base_s * (2 ** attempt))
         time.sleep(delay * (0.5 + self._rng.random() / 2))
 
     # -- writes ---------------------------------------------------------------
@@ -249,7 +268,7 @@ class StorageClient:
                     Code.NOT_HEAD,
                     Code.RPC_PEER_CLOSED,
                 ):
-                    self._sleep(attempt)
+                    self._sleep(attempt, _hint_ms(reply))
                     continue
                 return reply
             return last or UpdateReply(Code.CLIENT_RETRIES_EXHAUSTED)
@@ -306,7 +325,7 @@ class StorageClient:
                     return reply
                 last = reply
             if last.code in (Code.CHUNK_NOT_COMMIT,) or Status(last.code).retryable():
-                self._sleep(attempt)
+                self._sleep(attempt, _hint_ms(last))
                 continue
             return last
         return last
@@ -598,7 +617,7 @@ class StorageClient:
             last = last or UpdateReply(
                 Code.TARGET_OFFLINE,
                 message=f"{acked}/{writable} writable shards acked")
-            self._sleep(attempt)
+            self._sleep(attempt, _hint_ms(last))
         return last or UpdateReply(Code.CLIENT_RETRIES_EXHAUSTED)
 
     def _send_shard_batches(self, by_node) -> List[Tuple[int, object]]:

@@ -65,6 +65,10 @@ class SystemSetupConfig:
     # mesh's ``chain`` axis equal to num_replicas.
     chain_transport: str = "messenger"
     mesh: object = None
+    # a qos.QosConfig: every storage node gets a QosManager over it
+    # (admission + weighted-fair update scheduling + shed recorders);
+    # None = legacy unscheduled behavior
+    qos: object = None
 
 
 class _Node:
@@ -114,6 +118,11 @@ class Fabric:
             service = StorageService(
                 node_id, self.routing, self.send
             )
+            if cfg.qos is not None:
+                from tpu3fs.qos.manager import QosManager
+
+                service.set_qos(QosManager(
+                    cfg.qos, tags={"node": str(node_id)}))
             self.nodes[node_id] = _Node(node_id, service)
             self.mgmtd.register_node(node_id, NodeType.STORAGE)
         # chains: targets assigned round-robin over nodes (a chain's replicas
@@ -318,12 +327,17 @@ class Fabric:
 
     # -- GC (driving MetaStore's queue against storage; ref GcManager) -------
     def run_gc(self) -> int:
+        from tpu3fs.qos.core import TrafficClass, tagged
+
         removed = 0
         fio = self.file_client()
-        for inode in self.meta.gc_scan():
-            if self.meta.has_sessions(inode.id):
-                continue  # still write-open somewhere
-            fio.remove_chunks(inode)
-            self.meta.gc_finish(inode.id)
-            removed += 1
+        # chunk removals are GC-class traffic: scheduled behind foreground
+        # IO by the storage-side WFQ (tpu3fs/qos)
+        with tagged(TrafficClass.GC):
+            for inode in self.meta.gc_scan():
+                if self.meta.has_sessions(inode.id):
+                    continue  # still write-open somewhere
+                fio.remove_chunks(inode)
+                self.meta.gc_finish(inode.id)
+                removed += 1
         return removed

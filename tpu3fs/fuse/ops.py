@@ -109,9 +109,19 @@ class FuseOps:
         self._attr_cache: Dict[str, Tuple[float, Attr]] = {}
         self._attr_cache_ttl = 1.0
         # every mutating entry point drops the cache wholesale BEFORE
-        # running (instance-level wrap: one list to keep current, and a
-        # forgotten future mutator fails loudly in tests rather than
-        # serving stale attrs from a path we forgot to hand-invalidate)
+        # running AND AFTER it completes (instance-level wrap: one list to
+        # keep current, and a forgotten future mutator fails loudly in
+        # tests rather than serving stale attrs from a path we forgot to
+        # hand-invalidate). The clear-after matters for the race the
+        # round-5 advisor flagged: a readdirplus interleaving with the
+        # mutation can re-insert PRE-mutation attrs after the leading
+        # clear, and with only that clear a following getattr would serve
+        # the stale size/mode for up to the TTL. The trailing clear (in a
+        # finally, so failed mutations that changed partial state are
+        # covered too) bounds the stale window to the mutation's own
+        # duration. Metadata mutated OUTSIDE this mount (another client,
+        # admin CLI) is still visible up to `_attr_cache_ttl` late — the
+        # documented staleness contract of the readdirplus cache.
         # open/release/fsync/flush belong here too: open(O_TRUNC) cuts the
         # file and release/fsync/flush settle its length at meta — all
         # change the attrs a cached entry would go on serving
@@ -123,7 +133,10 @@ class FuseOps:
 
             def _wrapped(*a, __orig=_orig, **kw):
                 self._attr_cache_clear()
-                return __orig(*a, **kw)
+                try:
+                    return __orig(*a, **kw)
+                finally:
+                    self._attr_cache_clear()
 
             setattr(self, _name, _wrapped)
 

@@ -109,10 +109,21 @@ class MigrationService:
         job._scanned = True
 
     def step(self, job_id: int, batch: int = 64) -> int:
-        """Copy up to `batch` chunks; returns number copied this step."""
+        """Copy up to `batch` chunks; returns number copied this step.
+        Traffic is tagged MIGRATION (tpu3fs/qos) so destination update
+        workers schedule it behind foreground IO; an OVERLOADED shed
+        pauses the job for the server's retry-after hint and leaves it
+        RUNNING — migration self-throttles under pressure instead of
+        failing or hammering."""
+        from tpu3fs.qos.core import TrafficClass, retry_after_ms_of, tagged
+
         job = self.job(job_id)
         if job is None or job.state != JobState.RUNNING:
             return 0
+        with tagged(TrafficClass.MIGRATION):
+            return self._step_tagged(job, batch, retry_after_ms_of)
+
+    def _step_tagged(self, job: Job, batch: int, retry_after_ms_of) -> int:
         try:
             if not job._scanned:
                 self._scan(job)
@@ -128,6 +139,10 @@ class MigrationService:
                 rd = self._send(src_node, "read", ReadReq(
                     chain_id=job.src_chain, chunk_id=chunk_id,
                     target_id=src_target))
+                if rd.code == Code.OVERLOADED:
+                    job._queue.append(raw)  # keep the chunk for next step
+                    self._throttle(rd, retry_after_ms_of)
+                    return copied
                 if not rd.ok:
                     raise err(rd.code, f"read {chunk_id} failed")
                 # full_replace: install the copy as the chunk's entire
@@ -140,6 +155,10 @@ class MigrationService:
                     chunk_size=0,  # 0 = destination target's configured size
                     client_id=f"migration-{job.job_id}",
                     full_replace=True))
+                if wr.code == Code.OVERLOADED:
+                    job._queue.append(raw)
+                    self._throttle(wr, retry_after_ms_of)
+                    return copied
                 if not wr.ok:
                     raise err(wr.code, f"write {chunk_id} failed")
                 copied += 1
@@ -153,6 +172,14 @@ class MigrationService:
             job.state = JobState.FAILED
             job.error = str(e)
             return 0
+
+    @staticmethod
+    def _throttle(reply, retry_after_ms_of) -> None:
+        import time
+
+        hint = (getattr(reply, "retry_after_ms", 0)
+                or retry_after_ms_of(getattr(reply, "message", "") or ""))
+        time.sleep(max(hint, 10) / 1000.0)
 
     def run_job(self, job_id: int, batch: int = 64, max_steps: int = 10_000) -> Job:
         """Drive one job to completion (or failure/stop)."""

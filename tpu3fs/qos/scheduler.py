@@ -1,0 +1,149 @@
+"""Weighted-fair scheduling of storage IO by traffic class.
+
+``WeightedFairQueue`` replaces the single FIFO inside each per-target
+update worker (storage/update_worker.py) with per-class FIFOs drained by
+STRIDE scheduling: each class carries a virtual time that advances by
+cost/weight on every pop, and the nonempty class with the smallest
+virtual time runs next. Foreground read/write (weight 8 by default)
+therefore outweighs resync/EC-rebuild (2) and migration/GC (1) exactly
+in proportion, while an idle foreground leaves the full queue to
+background — work-conserving, no reserved-but-wasted slots.
+
+Within one class order stays FIFO, so the per-chunk ordering contract of
+the old single queue is preserved for client writes (all FG_WRITE);
+cross-class writes to one chunk are ordered by the engine's version
+algebra (recovery installs are versioned and idempotent).
+
+Shedding happens at push: a full queue sheds any class, and a background
+class is shed earlier when it already occupies its configured share of
+the queue — the bounded-queue-depth property the overload stress test
+asserts. A shed returns the retry-after hint for the OVERLOADED reply.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Optional, Tuple
+
+from tpu3fs.qos.core import (
+    BACKGROUND_CLASSES,
+    CLASS_ATTRS,
+    QosConfig,
+    TrafficClass,
+)
+
+
+class WfqPolicy:
+    """Live view of scheduler knobs over a (hot-updated) QosConfig.
+
+    Reads go straight to the config attributes, so a mgmtd config push
+    changes weights/shares/hints for every queue sharing the policy
+    without rebuilding anything."""
+
+    def __init__(self, config: Optional[QosConfig] = None):
+        self.config = config if config is not None else QosConfig()
+
+    def enabled(self) -> bool:
+        return bool(self.config.enabled)
+
+    def weight(self, tclass: TrafficClass) -> int:
+        return max(1, int(getattr(self.config, CLASS_ATTRS[tclass]).weight))
+
+    def queue_share(self, tclass: TrafficClass) -> float:
+        return float(getattr(self.config, CLASS_ATTRS[tclass]).queue_share)
+
+    def retry_after_ms(self) -> int:
+        return int(self.config.shed_retry_after_ms)
+
+    # observation hook: the QosManager overrides this to feed the
+    # queue-wait distribution recorder; the default is free
+    def record_wait(self, tclass: TrafficClass, wait_s: float) -> None:
+        pass
+
+
+class WeightedFairQueue:
+    """Per-class FIFOs + stride-scheduling pop. NOT internally locked —
+    the owning update worker already serializes access under its
+    condition variable, exactly like the deque it replaces."""
+
+    def __init__(self, policy: Optional[WfqPolicy] = None,
+                 cap: int = 512):
+        self.policy = policy or WfqPolicy()
+        self.cap = cap
+        self._queues: Dict[TrafficClass, collections.deque] = {}
+        self._vtime: Dict[TrafficClass, float] = {}
+        self._depth = 0
+
+    def __len__(self) -> int:
+        return self._depth
+
+    def class_depths(self) -> Dict[TrafficClass, int]:
+        return {tc: len(q) for tc, q in self._queues.items() if q}
+
+    def try_push(self, item, tclass: TrafficClass) -> Optional[int]:
+        """Append `item` to its class FIFO; -> None when accepted, else
+        the retry-after hint (ms) for the shed reply."""
+        base = self.policy.retry_after_ms()
+        if self._depth >= self.cap:
+            # full queue: scale the hint by how oversubscribed we are so
+            # a deep backlog spreads retries wider than a grazing overflow
+            return base * 2
+        if tclass in BACKGROUND_CLASSES:
+            share = max(1, int(self.cap * self.policy.queue_share(tclass)))
+            q = self._queues.get(tclass)
+            if q is not None and len(q) >= share:
+                return base
+        q = self._queues.get(tclass)
+        if q is None:
+            q = self._queues[tclass] = collections.deque()
+        if tclass not in self._vtime:
+            # a newly-active class starts at the current minimum virtual
+            # time: no banked credit from its idle period
+            self._vtime[tclass] = min(
+                (self._vtime[c] for c, qq in self._queues.items()
+                 if qq and c in self._vtime), default=0.0)
+        q.append(item)
+        self._depth += 1
+        return None
+
+    def pop(self) -> Optional[Tuple[object, TrafficClass]]:
+        """Pop the head of the nonempty class with least virtual time."""
+        best = None
+        for tc, q in self._queues.items():
+            if not q:
+                continue
+            vt = self._vtime.get(tc, 0.0)
+            if best is None or vt < best[1]:
+                best = (tc, vt)
+        if best is None:
+            return None
+        tc, vt = best
+        item = self._queues[tc].popleft()
+        self._depth -= 1
+        cost = getattr(item, "cost", 1)
+        self._vtime[tc] = vt + cost / self.policy.weight(tc)
+        return item, tc
+
+    def pop_matching(self, tclass: TrafficClass, pred) -> Optional[object]:
+        """Pop this class's HEAD job if pred(head) — the coalescing probe
+        (same-chain/disjoint-chunk group commit stays within one class so
+        per-class FIFO order is untouched)."""
+        q = self._queues.get(tclass)
+        if not q or not pred(q[0]):
+            return None
+        item = q.popleft()
+        self._depth -= 1
+        cost = getattr(item, "cost", 1)
+        self._vtime[tclass] = (
+            self._vtime.get(tclass, 0.0) + cost / self.policy.weight(tclass))
+        return item
+
+    def drain(self):
+        """Pop everything (stop path); class order, FIFO within class."""
+        out = []
+        for q in self._queues.values():
+            while q:
+                out.append(q.popleft())
+        self._depth = 0
+        return out

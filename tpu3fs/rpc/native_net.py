@@ -154,6 +154,13 @@ def _load_lib():
         lib.tpu3fs_rpc_fastpath_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64)]
+        if hasattr(lib, "tpu3fs_rpc_qos_set"):  # stale .so: no C ceiling
+            lib.tpu3fs_rpc_qos_set.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_double,
+                ctypes.c_double, ctypes.c_int64]
+            lib.tpu3fs_rpc_qos_clear.argtypes = [ctypes.c_void_p]
+            lib.tpu3fs_rpc_qos_shed_count.restype = ctypes.c_uint64
+            lib.tpu3fs_rpc_qos_shed_count.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -191,6 +198,8 @@ class NativeRpcServer:
         # the callback object must outlive the server: keep a reference
         self._cb = _HANDLER_T(self._handle)
         self._started = False
+        self._admission = None
+        self._admission_exempt: frozenset = frozenset()
         # bind + run the event loop now so .port is known before start(),
         # matching RpcServer which binds in __init__; dispatch is gated on
         # started so early connections get SHUTTING_DOWN, not half-wired
@@ -209,8 +218,42 @@ class NativeRpcServer:
             raise ValueError(f"duplicate service id {service.service_id}")
         self._services[service.service_id] = service
 
+    def set_admission(self, admission, exempt=()) -> None:
+        """Mirror RpcServer.set_admission. The Python dispatch trampoline
+        enforces the full (service, method, class) admission; additionally
+        a CHEAP per-service token ceiling runs inside the C++ worker
+        (native/rpc_net.cpp) so extreme overload sheds before frames ever
+        cross into Python — including fast-path reads. The ceiling follows
+        hot config updates via the controller's reload hook."""
+        self._admission = admission
+        self._admission_exempt = frozenset(exempt)
+        if admission is not None:
+            admission.add_reload_hook(lambda _adm: self._sync_native_qos())
+        self._sync_native_qos()
+
+    def _sync_native_qos(self) -> None:
+        if (self._srv is None or self._admission is None
+                or not hasattr(self._lib, "tpu3fs_rpc_qos_set")):
+            return
+        cfg = self._admission.config
+        self._lib.tpu3fs_rpc_qos_clear(self._srv)
+        rate = float(cfg.native_ceiling_rate)
+        if rate <= 0:
+            return
+        for sid in self._services:
+            self._lib.tpu3fs_rpc_qos_set(
+                self._srv, sid, rate, float(cfg.native_ceiling_burst),
+                int(cfg.shed_retry_after_ms))
+
+    def qos_shed_count(self) -> int:
+        if self._srv is None or not hasattr(self._lib,
+                                            "tpu3fs_rpc_qos_shed_count"):
+            return 0
+        return int(self._lib.tpu3fs_rpc_qos_shed_count(self._srv))
+
     def start(self) -> None:
         self._started = True
+        self._sync_native_qos()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -287,6 +330,21 @@ class NativeRpcServer:
             if mdef is None:
                 return self._err(out_msg, Code.RPC_METHOD_NOT_FOUND,
                                  f"{service.name}.{method_id}")
+            # QoS admission (the native transport does not carry the
+            # envelope's class bits into this trampoline, so untagged ops
+            # classify by method name — default_class_for)
+            lease = None
+            if self._admission is not None \
+                    and service_id not in self._admission_exempt:
+                from tpu3fs.qos.core import format_retry_after
+
+                lease, shed_ms = self._admission.try_admit(
+                    service.name, mdef.name, None)
+                if lease is None:
+                    return self._err(
+                        out_msg, Code.OVERLOADED,
+                        format_retry_after(shed_ms,
+                                           f"{service.name}.{mdef.name}"))
             bulk = None
             if has_bulk:
                 if not mdef.bulk:
@@ -300,20 +358,24 @@ class NativeRpcServer:
                            if bulk_len else b"")
                 bulk = split_bulk(section)
             try:
-                req = deserialize(payload, mdef.req_type)
-            except Exception as e:
-                return self._err(out_msg, Code.RPC_BAD_REQUEST, repr(e))
-            try:
-                if mdef.bulk:
-                    rsp, reply_iovs = mdef.handler(req, bulk)
-                else:
-                    rsp = mdef.handler(req)
-                    reply_iovs = None
-                raw = serialize(rsp, mdef.rsp_type)
-            except FsError as e:
-                return self._err(out_msg, e.code, e.status.message)
-            except Exception as e:
-                return self._err(out_msg, Code.INTERNAL, repr(e))
+                try:
+                    req = deserialize(payload, mdef.req_type)
+                except Exception as e:
+                    return self._err(out_msg, Code.RPC_BAD_REQUEST, repr(e))
+                try:
+                    if mdef.bulk:
+                        rsp, reply_iovs = mdef.handler(req, bulk)
+                    else:
+                        rsp = mdef.handler(req)
+                        reply_iovs = None
+                    raw = serialize(rsp, mdef.rsp_type)
+                except FsError as e:
+                    return self._err(out_msg, e.code, e.status.message)
+                except Exception as e:
+                    return self._err(out_msg, Code.INTERNAL, repr(e))
+            finally:
+                if lease is not None:
+                    lease.release()
             out_rsp[0] = ctypes.cast(
                 _malloc_bytes(self._lib, raw), ctypes.POINTER(ctypes.c_uint8)
             )

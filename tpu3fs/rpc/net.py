@@ -279,6 +279,18 @@ class RpcServer:
         self._running = False
         self._conns: List[socket.socket] = []
         self._lock = threading.Lock()
+        # QoS admission (qos/core.py): consulted per dispatch, keyed
+        # (service, method, traffic class from the envelope flag bits);
+        # None = admit everything (legacy)
+        self._admission = None
+        self._admission_exempt: frozenset = frozenset()
+
+    def set_admission(self, admission, exempt=()) -> None:
+        """Install an AdmissionController enforced in _dispatch. Service
+        ids in `exempt` skip the RPC-level check (a service that runs its
+        own internal admission — storage — must not be charged twice)."""
+        self._admission = admission
+        self._admission_exempt = frozenset(exempt)
 
     def add_service(self, service: ServiceDef) -> None:
         if service.service_id in self._services:
@@ -349,17 +361,49 @@ class RpcServer:
             return self._error_reply(
                 pkt, Code.RPC_BAD_REQUEST,
                 f"{service.name}.{mdef.name} is not bulk-capable"), None
+        # QoS admission BEFORE deserialization (shedding must stay cheap):
+        # token bucket + concurrency cap keyed (service, method, traffic
+        # class); sheds answer OVERLOADED with the retry-after hint in the
+        # envelope message (qos/core.py)
+        lease = None
+        tclass = None
+        if self._admission is not None \
+                and pkt.service_id not in self._admission_exempt:
+            from tpu3fs.qos.core import class_from_flags, format_retry_after
+
+            tclass = class_from_flags(pkt.flags)
+            lease, shed_ms = self._admission.try_admit(
+                service.name, mdef.name, tclass)
+            if lease is None:
+                return self._error_reply(
+                    pkt, Code.OVERLOADED,
+                    format_retry_after(shed_ms,
+                                       f"{service.name}.{mdef.name}")), None
         try:
             req = deserialize(pkt.payload, mdef.req_type)
         except Exception as e:  # malformed payload
+            if lease is not None:
+                lease.release()
             return self._error_reply(pkt, Code.RPC_BAD_REQUEST, repr(e)), None
         ts.server_run_start = time.monotonic()
         reply_iovs = None
         try:
-            if mdef.bulk:
-                rsp, reply_iovs = mdef.handler(req, bulk)
-            else:
-                rsp = mdef.handler(req)
+            # restore the client's traffic class around the handler so
+            # service internals (update-worker scheduling, read gates)
+            # see the tag the peer carried in the envelope
+            import contextlib
+
+            from tpu3fs.qos.core import class_from_flags, tagged
+
+            if tclass is None:
+                tclass = class_from_flags(pkt.flags)
+            ctx = (tagged(tclass) if tclass is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                if mdef.bulk:
+                    rsp, reply_iovs = mdef.handler(req, bulk)
+                else:
+                    rsp = mdef.handler(req)
             payload = serialize(rsp, mdef.rsp_type)
             status, message = int(Code.OK), ""
         except FsError as e:
@@ -368,6 +412,9 @@ class RpcServer:
         except Exception as e:  # handler bug: surface as INTERNAL
             payload, status, message = b"", int(Code.INTERNAL), repr(e)
             reply_iovs = None
+        finally:
+            if lease is not None:
+                lease.release()
         ts.server_run_end = time.monotonic()
         return MessagePacket(
             uuid=pkt.uuid,
@@ -490,11 +537,16 @@ class RpcClient:
         """call() with bulk riders both ways -> (rsp, reply_segments|None).
         Request `bulk_iovs` buffers are gathered into the socket without
         copies; reply segments are memoryviews over one receive buffer."""
+        from tpu3fs.qos.core import class_to_flags, current_class
+
         pkt = MessagePacket(
             uuid=uuid_mod.uuid4().hex,
             service_id=service_id,
             method_id=method_id,
-            flags=FLAG_IS_REQ,
+            # the calling thread's traffic class rides the envelope flag
+            # bits so the server's admission + scheduler see it (untagged
+            # threads leave the bits 0 — legacy wire form)
+            flags=FLAG_IS_REQ | class_to_flags(current_class()),
             status=int(Code.OK),
             payload=serialize(req, req_type or type(req)),
         )

@@ -1032,7 +1032,8 @@ def bind_core_service(server: RpcServer, *, config=None, on_shutdown=None) -> No
         import time as _time
 
         if config is not None:
-            import tomllib
+            # config.py's shim: stdlib tomllib on 3.11+, tomli on 3.10
+            from tpu3fs.utils.config import tomllib
 
             last_update["seq"] += 1
             last_update["time"] = _time.time()

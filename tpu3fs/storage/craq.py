@@ -80,6 +80,10 @@ class UpdateReply:
     commit_ver: int = 0
     checksum: Checksum = field(default_factory=Checksum)
     message: str = ""
+    # OVERLOADED sheds: how long the client should back off before the
+    # retry (serde trailing-field evolution: older encoders — incl. the
+    # native write fast path — omit it and decoders default to 0)
+    retry_after_ms: int = 0
 
     @property
     def ok(self) -> bool:
@@ -136,6 +140,9 @@ class ReadReply:
     # EC full-stripe reads: the stripe's logical (pre-padding) byte length,
     # derived from trimmed shard lengths; 0 when unknown/not applicable
     logical_len: int = 0
+    # OVERLOADED sheds: the server's retry-after hint (trailing field; the
+    # native read fast path encodes 5 fields and decoders default this)
+    retry_after_ms: int = 0
 
     @property
     def ok(self) -> bool:
@@ -354,6 +361,10 @@ class StorageService:
                            "ops": 0, "bytes": 0}
                     for role in ("head", "mid", "tail")}
         self._ici = None  # optional IciChainReplicator (set_ici_replicator)
+        # optional QoS bundle (qos/manager.py): admission at read/write
+        # entry, WFQ policy for the per-target update workers, per-class
+        # shed/depth recorders. None = legacy unscheduled behavior.
+        self._qos = None
         # native read-fastpath invalidator (storage/native_fastpath.py):
         # called with a target id on local offlining (None = drop all) so
         # the C++ registry honors offline_target's immediate-refusal
@@ -362,6 +373,47 @@ class StorageService:
 
     def set_fastpath_invalidator(self, fn) -> None:
         self._fastpath_invalidate = fn
+
+    def set_qos(self, manager) -> None:
+        """Install a qos.QosManager: write batches are weighted-fair
+        scheduled by traffic class in the per-target update workers, and
+        reads/writes are admission-checked at entry (token bucket +
+        concurrency cap per class), shedding with the retryable
+        OVERLOADED + retry-after hint. Existing update workers keep their
+        policy; install before the first write (the service binaries and
+        the fabric both do)."""
+        self._qos = manager
+
+    @property
+    def qos(self):
+        return self._qos
+
+    def qos_snapshot(self) -> dict:
+        """Live QoS state for the admin CLI: admission limits/counters
+        plus per-class update-queue depths aggregated over local
+        targets."""
+        from tpu3fs.qos.core import CLASS_ATTRS
+
+        depths: Dict = {}
+        with self._update_workers_guard:
+            workers = list(self._update_workers.items())
+        per_target = {}
+        for target_id, w in workers:
+            cd = w.class_depths()
+            per_target[target_id] = {
+                CLASS_ATTRS[tc]: n for tc, n in cd.items()}
+            for tc, n in cd.items():
+                depths[tc] = depths.get(tc, 0) + n
+        out = {
+            "queue_depths": {CLASS_ATTRS[tc]: n for tc, n in depths.items()},
+            "per_target_depths": per_target,
+        }
+        if self._qos is not None:
+            self._qos.record_depths(depths)
+            out.update(self._qos.snapshot())
+        else:
+            out["enabled"] = False
+        return out
 
     def set_ici_replicator(self, replicator) -> None:
         """Intra-pod chain replication via mesh collectives
@@ -439,8 +491,10 @@ class StorageService:
         self, target: StorageTarget, reqs: List[WriteReq]
     ) -> List[UpdateReply]:
         """Run a same-chain unique-chunk batch through the target's update
-        worker: pipelined + group-committed (ref UpdateWorker.h:11-46).
+        worker: pipelined + group-committed (ref UpdateWorker.h:11-46),
+        weighted-fair scheduled by traffic class (qos/scheduler.py).
         Falls back to the inline handler once the node is stopping."""
+        from tpu3fs.qos.core import current_class, infer_write_class
         from tpu3fs.storage.update_worker import UpdateWorker
 
         if self.stopped:
@@ -450,13 +504,28 @@ class StorageService:
             with self._update_workers_guard:
                 worker = self._update_workers.get(target.target_id)
                 if worker is None:
+                    policy = (self._qos.policy
+                              if self._qos is not None else None)
+                    cap = (int(self._qos.config.update_queue_cap)
+                           if self._qos is not None else 512)
                     worker = UpdateWorker(
                         lambda rs, _t=target: self._handle_batch_update(
                             _t, rs),
-                        name=f"{self.node_id}.{target.target_id}")
+                        name=f"{self.node_id}.{target.target_id}",
+                        policy=policy, queue_cap=cap)
                     self._update_workers[target.target_id] = worker
+        # thread-local tag when the submitter carried one (background
+        # workers, tagged RPC dispatch); otherwise infer from the request
+        # shape so untagged transports still schedule recovery vs client
+        # writes correctly
+        tclass = current_class(None)
+        if tclass is None:
+            tclass = infer_write_class(reqs[0])
         return worker.submit(
-            reqs, lambda code, msg: UpdateReply(code, message=msg))
+            reqs,
+            lambda code, msg, ra=0: UpdateReply(code, message=msg,
+                                                retry_after_ms=ra),
+            tclass=tclass)
 
     def stop_workers(self) -> None:
         """Join the per-target update workers (node shutdown)."""
@@ -530,6 +599,24 @@ class StorageService:
                 return t, i, writers
         return None, -1, writers
 
+    def _local_receiver(self, chain: ChainInfo, from_target: int):
+        """The local target a chain-internal forward addresses: the
+        SUCCESSOR of `from_target` in the writer chain. Falling back to
+        the first local writer is only correct when one node hosts one
+        target per chain — with several (single-node fabrics, dense
+        packing) the forward would land back on the sender's own target,
+        re-entering the chunk lock the sending thread still holds
+        (self-deadlock) and never advancing down the chain."""
+        writers = chain.writer_chain()
+        if from_target:
+            idx = next((i for i, t in enumerate(writers)
+                        if t.target_id == from_target), None)
+            if idx is not None and idx + 1 < len(writers) \
+                    and writers[idx + 1].target_id in self._targets:
+                return writers[idx + 1]
+        mine, _, _ = self._local_writer(chain)
+        return mine
+
     # -- client write (HEAD only; ref StorageOperator.cc:233-282) ------------
     def write(self, req: WriteReq) -> UpdateReply:
         import time as _time
@@ -565,9 +652,48 @@ class StorageService:
             # fail a client write that already committed + forwarded
             pass
 
+    def _admit_write(self, req, cost: float = 1.0):
+        """Admission for writes keyed ("storage", "write", class).
+        FOREGROUND chain-internal hops (from_target != 0) are exempt: the
+        head already charged the op and staged it, so a mid-chain shed
+        would only waste the client's whole retry. BACKGROUND classes
+        (resync/EC-rebuild/migration/GC) are checked wherever they enter
+        — including chain-internal recovery installs — because that is
+        precisely the traffic an operator rate-caps (`resync.rate`) and
+        the senders self-throttle on the shed (resync.py, ec_resync.py).
+        -> (lease|None, retry_after_ms|None)."""
+        if self._qos is None:
+            return None, None
+        from tpu3fs.qos.core import (
+            BACKGROUND_CLASSES,
+            current_class,
+            infer_write_class,
+        )
+
+        tclass = current_class(None)
+        if tclass is None:
+            tclass = infer_write_class(req)
+        if getattr(req, "from_target", 0) \
+                and tclass not in BACKGROUND_CLASSES:
+            return None, None
+        return self._qos.try_admit("storage", "write", tclass, cost)
+
     def _write_impl(self, req: WriteReq) -> UpdateReply:
         if self.stopped:
             return UpdateReply(Code.RPC_PEER_CLOSED, message="node stopped")
+        lease, shed_ms = self._admit_write(req)
+        if shed_ms is not None:
+            return UpdateReply(
+                Code.OVERLOADED,
+                message=f"retry_after_ms={shed_ms} (write admission)",
+                retry_after_ms=shed_ms)
+        try:
+            return self._write_admitted(req)
+        finally:
+            if lease is not None:
+                lease.release()
+
+    def _write_admitted(self, req: WriteReq) -> UpdateReply:
         try:
             chain = self._chain(req.chain_id)
         except FsError as e:
@@ -600,12 +726,24 @@ class StorageService:
             chain = self._chain(req.chain_id)
         except FsError as e:
             return UpdateReply(e.code, message=e.status.message)
-        mine, _, _ = self._local_writer(chain)
+        mine = self._local_receiver(chain, req.from_target)
         if mine is None:
             return UpdateReply(
                 Code.TARGET_NOT_FOUND, message="no local writer target in chain"
             )
-        return self._handle_update(self._targets[mine.target_id], req)
+        # background recovery installs (resync full-replaces) are
+        # admission-checked; foreground chain hops pass free
+        lease, shed_ms = self._admit_write(req)
+        if shed_ms is not None:
+            return UpdateReply(
+                Code.OVERLOADED,
+                message=f"retry_after_ms={shed_ms} (write admission)",
+                retry_after_ms=shed_ms)
+        try:
+            return self._handle_update(self._targets[mine.target_id], req)
+        finally:
+            if lease is not None:
+                lease.release()
 
     # -- the shared brain (ref handleUpdate :333-514) -------------------------
     def _handle_update(self, target: StorageTarget, req: WriteReq) -> UpdateReply:
@@ -828,6 +966,25 @@ class StorageService:
         target = self._targets.get(req.target_id)
         if target is None:
             return UpdateReply(Code.TARGET_NOT_FOUND, message=str(req.target_id))
+        lease = None
+        if req.phase != 2:
+            # phase-2 commits are never shed: the shard is already staged
+            # and a shed here would strand the two-phase stripe write
+            lease, shed_ms = self._admit_write(req)
+            if shed_ms is not None:
+                return UpdateReply(
+                    Code.OVERLOADED,
+                    message=f"retry_after_ms={shed_ms} (shard admission)",
+                    retry_after_ms=shed_ms)
+        if lease is not None:
+            try:
+                return self._write_shard_locked(req, target)
+            finally:
+                lease.release()
+        return self._write_shard_locked(req, target)
+
+    def _write_shard_locked(self, req: ShardWriteReq,
+                            target: StorageTarget) -> UpdateReply:
         with self._chunk_lock(req.target_id, req.chunk_id):
             try:
                 inject("storage.write_shard")
@@ -888,11 +1045,37 @@ class StorageService:
 
     # -- batched IO (one request carries many ops; ref BatchReadReq
     # StorageOperator.cc:82-231, batchWrite StorageClientImpl.cc:1771) -------
+    def _admit_read(self, default_class, cost: float = 1.0):
+        """-> (lease|None, retry_after_ms|None): admission for the read
+        path keyed ("storage", "read", class). No QoS manager = admitted
+        free (legacy behavior)."""
+        if self._qos is None:
+            return None, None
+        from tpu3fs.qos.core import current_class
+
+        tclass = current_class(default_class)
+        return self._qos.try_admit("storage", "read", tclass, cost)
+
     def batch_read(self, reqs: List[ReadReq]) -> List[ReadReply]:
         """Many reads in ONE request. Ops are grouped per local target and
         executed as ONE engine crossing per group — the loop runs in the
         native engine with the GIL released (the reference's 32-thread AIO
         pool analogue, AioReadWorker.h:27-29)."""
+        from tpu3fs.qos.core import TrafficClass
+
+        lease, shed_ms = self._admit_read(TrafficClass.FG_READ,
+                                          cost=max(1, len(reqs)))
+        if shed_ms is not None:
+            self._read_rec.failed.add(len(reqs))
+            return [ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
+                    for _ in reqs]
+        try:
+            return self._batch_read_impl(reqs)
+        finally:
+            if lease is not None:
+                lease.release()
+
+    def _batch_read_impl(self, reqs: List[ReadReq]) -> List[ReadReply]:
         replies: List[Optional[ReadReply]] = [None] * len(reqs)
         groups: Dict[int, List[int]] = {}
         for i, req in enumerate(reqs):
@@ -964,6 +1147,22 @@ class StorageService:
                 message=f"head target {head.target_id} not local")
                 for _ in range(n)]
         target = self._targets[head.target_id]
+        lease, shed_ms = self._admit_write(reqs[0], cost=n)
+        if shed_ms is not None:
+            return [UpdateReply(
+                Code.OVERLOADED,
+                message=f"retry_after_ms={shed_ms} (write admission)",
+                retry_after_ms=shed_ms) for _ in range(n)]
+        try:
+            return self._batch_write_chain_admitted(chain, target, reqs)
+        finally:
+            if lease is not None:
+                lease.release()
+
+    def _batch_write_chain_admitted(
+        self, chain: ChainInfo, target: StorageTarget, reqs: List[WriteReq]
+    ) -> List[UpdateReply]:
+        n = len(reqs)
         replies: List[Optional[UpdateReply]] = [None] * n
         todo: List[int] = []
         seen: set = set()
@@ -1035,13 +1234,32 @@ class StorageService:
         except FsError as e:
             return [UpdateReply(e.code, message=e.status.message)
                     for _ in range(n)]
-        mine, _, _ = self._local_writer(chain)
+        mine = self._local_receiver(chain, reqs[0].from_target)
         if mine is None:
             return [UpdateReply(
                 Code.TARGET_NOT_FOUND,
                 message="no local writer target in chain")
                 for _ in range(n)]
         target = self._targets[mine.target_id]
+        # background recovery installs are admission-checked here too
+        # (foreground chain hops pass free — see _admit_write)
+        lease, shed_ms = self._admit_write(reqs[0], cost=n)
+        if shed_ms is not None:
+            return [UpdateReply(
+                Code.OVERLOADED,
+                message=f"retry_after_ms={shed_ms} (write admission)",
+                retry_after_ms=shed_ms) for _ in range(n)]
+        if lease is not None:
+            try:
+                return self._batch_update_admitted(target, reqs)
+            finally:
+                lease.release()
+        return self._batch_update_admitted(target, reqs)
+
+    def _batch_update_admitted(
+        self, target: StorageTarget, reqs: List[WriteReq]
+    ) -> List[UpdateReply]:
+        n = len(reqs)
         replies: List[Optional[UpdateReply]] = [None] * n
         todo: List[int] = []
         seen: set = set()
@@ -1433,6 +1651,11 @@ class StorageService:
         ec_resync._read_shard). Locally-offlined targets still refuse;
         clients must keep using read(), whose public gate protects them
         from stale replicas."""
+        from tpu3fs.qos.core import TrafficClass
+
+        lease, shed_ms = self._admit_read(TrafficClass.EC_REBUILD)
+        if shed_ms is not None:
+            return ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
         with self._read_rec.record() as op:
             try:
                 if self.stopped:
@@ -1449,8 +1672,16 @@ class StorageService:
             except FsError as e:
                 op.fail()
                 return ReadReply(e.code)
+            finally:
+                if lease is not None:
+                    lease.release()
 
     def _read_impl(self, req: ReadReq) -> ReadReply:
+        from tpu3fs.qos.core import TrafficClass
+
+        lease, shed_ms = self._admit_read(TrafficClass.FG_READ)
+        if shed_ms is not None:
+            return ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
         try:
             inject("storage.read")
             target_id = self._resolve_read_target(req)
@@ -1468,6 +1699,9 @@ class StorageService:
             )
         except FsError as e:
             return ReadReply(e.code)
+        finally:
+            if lease is not None:
+                lease.release()
 
     # -- file-level helpers (meta service hooks) ------------------------------
     def query_last_chunk(self, chain_id: int, file_id: int) -> Tuple[int, int]:

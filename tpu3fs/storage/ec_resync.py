@@ -48,7 +48,17 @@ class EcResyncWorker:
         self._repair_memo: Dict[int, frozenset] = {}
 
     def run_once(self) -> int:
-        """One rebuild round over all local EC chains; returns shards moved."""
+        """One rebuild round over all local EC chains; returns shards
+        moved. Traffic is tagged EC_REBUILD (tpu3fs/qos): rebuild reads
+        go through the per-class read gate and shard installs schedule
+        behind foreground writes; OVERLOADED sheds defer work to the next
+        round (the rebuild is idempotent and resumable)."""
+        from tpu3fs.qos.core import TrafficClass, tagged
+
+        with tagged(TrafficClass.EC_REBUILD):
+            return self._run_once_tagged()
+
+    def _run_once_tagged(self) -> int:
         routing: RoutingInfo = self._service._routing()
         local_ids = {t.target_id for t in self._service.targets()}
         moved = 0
@@ -481,6 +491,18 @@ class EcResyncWorker:
                 )
                 try:
                     reply = self._messenger(node_id, "write_shard", req)
+                    if reply.code == Code.OVERLOADED:
+                        # self-throttle: honor the server's retry-after
+                        # hint once, then defer the stripe to the next
+                        # round (rebuild is idempotent and resumable)
+                        import time as _time
+
+                        from tpu3fs.qos.core import retry_after_ms_of
+
+                        hint = (reply.retry_after_ms
+                                or retry_after_ms_of(reply.message))
+                        _time.sleep(max(hint, 10) / 1000.0)
+                        reply = self._messenger(node_id, "write_shard", req)
                 except FsError:
                     skipped += _skip(cid)
                     continue

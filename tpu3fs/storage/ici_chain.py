@@ -26,7 +26,6 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from tpu3fs.storage.types import Checksum
 from tpu3fs.utils.result import Code
 
 
@@ -83,6 +82,49 @@ class IciChainReplicator:
                 return False, None  # SYNCING => full-replace semantics
             succs.append(local)
 
+        # SUCCESSOR LOCKS (round-5 advisor, medium): the messenger path
+        # runs every successor install under that target's own per-chunk
+        # locks (its _handle_batch_update), excluding interleavings with
+        # concurrently forwarded truncate/remove/full-replace on the same
+        # chunks. The collective path installs into successor engines
+        # DIRECTLY, so it must take the same locks itself: every
+        # (successor target, chunk) key, acquired in the one global
+        # sorted key order all batch paths use — no lock-order inversion
+        # against batch_write_shard / _handle_batch_update on those
+        # targets.
+        succ_keys = sorted({
+            service._chunk_key(succ.target_id, reqs[ri].chunk_id)
+            for succ in succs
+            for ri, _ver, _cs, _fr in staged
+        })
+        for key in succ_keys:
+            service._locks.acquire(key)
+        try:
+            # membership re-check UNDER the locks (the data-path race
+            # rule, ref StorageOperator.cc:377-382): a member flipping
+            # out of SERVING between the check above and here means
+            # full-replace semantics we cannot express — fall back to the
+            # messenger before touching any successor engine
+            from tpu3fs.mgmtd.types import PublicTargetState
+
+            chain2 = service._chain(chain.chain_id)
+            writers2 = chain2.writer_chain()
+            if (chain2.chain_version != chain.chain_version
+                    or [t.target_id for t in writers2]
+                    != [t.target_id for t in writers]
+                    or any(t.public_state != PublicTargetState.SERVING
+                           for t in writers2[1:])):
+                self.fallbacks += 1
+                return False, None
+            return self._replicate_locked(service, reqs, staged, chain,
+                                          succs)
+        finally:
+            for key in reversed(succ_keys):
+                service._locks.release(key)
+
+    def _replicate_locked(self, service, reqs, staged, chain, succs):
+        from tpu3fs.storage.craq import UpdateReply
+
         import jax
         import jax.numpy as jnp
 
@@ -138,13 +180,18 @@ class IciChainReplicator:
                 if res.code == Code.CHUNK_STALE_UPDATE:
                     replies[i] = replies[i] or UpdateReply(
                         Code.OK, update_ver=ver, commit_ver=res.ver,
-                        checksum=Checksum(res.checksum, res.length))
+                        checksum=res.checksum)
                     continue
                 if not res.ok:
                     replies[i] = UpdateReply(res.code,
                                              message="ICI stage failed")
                     continue
-                succ_cs = Checksum(res.checksum, res.length)
+                # EngineOpResult.checksum is already a Checksum (crc,
+                # length) — re-wrapping it made .value a Checksum and the
+                # cross-check below compare unlike types (always
+                # "mismatch", then a format TypeError): the bug that kept
+                # this path from ever surviving a real run
+                succ_cs = res.checksum
                 if not is_fr and succ_cs.value != cs.value:
                     replies[i] = UpdateReply(
                         Code.CHUNK_CHECKSUM_MISMATCH,

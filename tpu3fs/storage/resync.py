@@ -11,21 +11,36 @@ successor reports up-to-date in its next heartbeat.
 
 from __future__ import annotations
 
+import time
 from typing import List
 
 from tpu3fs.mgmtd.types import PublicTargetState, RoutingInfo
+from tpu3fs.qos.core import TrafficClass, tagged
 from tpu3fs.storage.craq import Messenger, StorageService, UpdateReply, WriteReq
 from tpu3fs.storage.types import ChunkMeta
 from tpu3fs.utils.result import Code
 
 
 class ResyncWorker:
+    #: bounded OVERLOADED retries per chunk before deferring it to the
+    #: next resync round (recovery is idempotent; skipping is safe)
+    MAX_SHED_RETRIES = 4
+
     def __init__(self, service: StorageService, messenger: Messenger):
         self._service = service
         self._messenger = messenger
 
     def run_once(self) -> int:
-        """One resync round over all local chains. Returns chunks transferred."""
+        """One resync round over all local chains. Returns chunks
+        transferred. Traffic is tagged RESYNC (tpu3fs/qos) so the
+        successor's update workers schedule it behind foreground writes;
+        OVERLOADED sheds are honored by backing off for the server's
+        retry-after hint — the worker throttles ITSELF under pressure
+        instead of retrying blind."""
+        with tagged(TrafficClass.RESYNC):
+            return self._run_once_tagged()
+
+    def _run_once_tagged(self) -> int:
         routing: RoutingInfo = self._service._routing()
         transferred = 0
         for chain in routing.chains.values():
@@ -97,7 +112,7 @@ class ResyncWorker:
                     full_replace=True,
                     from_target=local_target_id,
                 )
-            reply: UpdateReply = self._messenger(succ_node_id, "update", req)
+            reply: UpdateReply = self._send_throttled(succ_node_id, req)
             if reply.code == Code.OK:
                 moved += 1
         # (d) drop successor chunks that no longer exist on the predecessor
@@ -109,3 +124,18 @@ class ResyncWorker:
         # (e) sync-done
         self._messenger(succ_node_id, "sync_done", succ_target_id)
         return moved
+
+    def _send_throttled(self, succ_node_id: int, req: WriteReq) -> UpdateReply:
+        """Send one recovery update, honoring OVERLOADED sheds with the
+        server's retry-after hint (bounded; a still-overloaded successor
+        defers this chunk to the next round)."""
+        reply: UpdateReply = self._messenger(succ_node_id, "update", req)
+        for _ in range(self.MAX_SHED_RETRIES):
+            if reply.code != Code.OVERLOADED:
+                break
+            from tpu3fs.qos.core import retry_after_ms_of
+
+            hint = reply.retry_after_ms or retry_after_ms_of(reply.message)
+            time.sleep(max(hint, 10) / 1000.0)
+            reply = self._messenger(succ_node_id, "update", req)
+        return reply

@@ -26,7 +26,10 @@ from typing import Any, Callable, Dict, List
 try:  # py311+: stdlib toml reader
     import tomllib
 except ImportError:  # pragma: no cover
-    tomllib = None
+    try:  # py310: the tomli backport has the identical API
+        import tomli as tomllib
+    except ImportError:
+        tomllib = None
 
 
 class ConfigItem:

@@ -34,6 +34,7 @@ class Code(enum.IntEnum):
     FAULT_INJECTION = 105
     QUEUE_FULL = 106
     SHUTTING_DOWN = 107
+    OVERLOADED = 108         # QoS shed: retryable, carries retry-after hint
 
     # RPC 2xx
     RPC_CONNECT_FAILED = 200
@@ -123,6 +124,9 @@ RETRYABLE_CODES = frozenset(
         Code.SYNCING,
         Code.CLIENT_ROUTING_STALE,
         Code.QUEUE_FULL,
+        # QoS load shed: the server is telling the client to come back
+        # after the carried retry-after hint (qos.retry_after_ms_of)
+        Code.OVERLOADED,
         # forwarding found no route to the successor after server-side
         # retries: routing is lagging (startup/failover) — clients should
         # back off and ladder, not fail the write
