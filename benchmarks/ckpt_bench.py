@@ -1,0 +1,176 @@
+"""ckpt_bench: checkpoint save/restore throughput + async step-stall.
+
+Drives tpu3fs/ckpt over an in-process fabric (engine="mem" by default;
+point --engine-dir at /dev/shm for the disk-backed engine) and reports:
+
+- sync save / restore GiB/s on a replicated (CR) layout;
+- the same on an erasure-coded EC(k,m) layout (device encode + shard
+  fan-out underneath);
+- async save: the STEP-STALL time (how long save_async blocks the
+  training step — snapshot-to-host only) vs the full sync save wall,
+  plus the background commit wall;
+- resharded restore GiB/s (restore onto a different mesh shape than the
+  checkpoint was saved on).
+
+Prints one JSON object (bench.py conventions) and writes it to
+--json-out (BENCH_CKPT.json).
+
+Usage: python -m benchmarks.ckpt_bench [--total-mb 64] [--leaves 8]
+           [--chains 4] [--nodes 4] [--ec-k 3] [--ec-m 1]
+           [--json-out BENCH_CKPT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from tpu3fs.ckpt import CheckpointManager
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+
+CHUNK = 1 << 20  # 1 MiB chunks, the reference default
+
+
+def _tree(total_bytes: int, leaves: int, seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    per = max(1, total_bytes // leaves) // 4 * 4
+    return {
+        f"layer{i}": {
+            "w": rng.standard_normal(per // 4).astype(np.float32),
+        }
+        for i in range(leaves)
+    }
+
+
+def _gibps(nbytes: int, seconds: float) -> float:
+    return nbytes / max(seconds, 1e-9) / (1 << 30)
+
+
+def _drive(fab: Fabric, tree: dict, *, label: str,
+           reshard_mesh=None) -> dict:
+    mgr = CheckpointManager(fab.meta, fab.file_client(), kv=fab.kv,
+                            root=f"/ckpt-{label}")
+    nbytes = sum(leaf["w"].nbytes for leaf in tree.values())
+
+    t0 = time.perf_counter()
+    manifest = mgr.save(tree, 1)
+    save_s = time.perf_counter() - t0
+    assert manifest.total_bytes() >= nbytes
+
+    t0 = time.perf_counter()
+    out = mgr.restore(1)  # CRC-verified full restore
+    restore_s = time.perf_counter() - t0
+    for k, leaf in tree.items():
+        assert np.array_equal(out[k]["w"], leaf["w"]), k
+
+    t0 = time.perf_counter()
+    mgr.restore(1, verify=False)
+    restore_fast_s = time.perf_counter() - t0
+
+    # async: stall = how long the call blocks; commit runs behind
+    t0 = time.perf_counter()
+    handle = mgr.save_async(tree, 2)
+    stall_s = time.perf_counter() - t0
+    handle.result(120.0)
+    commit_s = time.perf_counter() - t0
+
+    row = {
+        f"{label}_save_gibps": round(_gibps(nbytes, save_s), 3),
+        f"{label}_restore_gibps": round(_gibps(nbytes, restore_s), 3),
+        f"{label}_restore_ranged_gibps": round(
+            _gibps(nbytes, restore_fast_s), 3),
+        f"{label}_async_step_stall_ms": round(stall_s * 1e3, 3),
+        f"{label}_sync_save_ms": round(save_s * 1e3, 3),
+        f"{label}_async_commit_ms": round(commit_s * 1e3, 3),
+        f"{label}_bytes": nbytes,
+    }
+
+    if reshard_mesh is not None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tmpl = {
+            k: {"w": jax.ShapeDtypeStruct(
+                leaf["w"].shape, leaf["w"].dtype,
+                sharding=NamedSharding(reshard_mesh, P("dp")))}
+            for k, leaf in tree.items()
+        }
+        t0 = time.perf_counter()
+        out = mgr.restore(1, like=tmpl, verify=False)
+        reshard_s = time.perf_counter() - t0
+        for k, leaf in tree.items():
+            assert np.array_equal(np.asarray(out[k]["w"]), leaf["w"]), k
+        row[f"{label}_reshard_restore_gibps"] = round(
+            _gibps(nbytes, reshard_s), 3)
+    return row
+
+
+def run_bench(*, total_mb: int = 64, leaves: int = 8, nodes: int = 4,
+              chains: int = 4, replicas: int = 2, ec_k: int = 3,
+              ec_m: int = 1, engine: str = "mem",
+              engine_dir: str = "", reshard: bool = True) -> dict:
+    total = total_mb << 20
+    tree = _tree(total, leaves)
+
+    out = {"metric": "ckpt_save_restore", "total_mb": total_mb,
+           "leaves": leaves, "chunk_mb": CHUNK >> 20}
+
+    fab = Fabric(SystemSetupConfig(
+        num_storage_nodes=nodes, num_chains=chains, num_replicas=replicas,
+        chunk_size=CHUNK, engine=engine, engine_dir=engine_dir or None))
+    try:
+        mesh = None
+        if reshard:
+            from tpu3fs.parallel.mesh import make_storage_mesh
+
+            mesh = make_storage_mesh(1)  # all devices on one dp axis
+        out.update(_drive(fab, tree, label="cr", reshard_mesh=mesh))
+    finally:
+        fab.close()
+
+    fab = Fabric(SystemSetupConfig(
+        num_storage_nodes=max(nodes, ec_k + ec_m), num_chains=chains,
+        chunk_size=CHUNK, engine=engine, engine_dir=engine_dir or None,
+        ec_k=ec_k, ec_m=ec_m))
+    try:
+        out.update(_drive(fab, tree, label=f"ec{ec_k}_{ec_m}"))
+    finally:
+        fab.close()
+
+    # the headline "value" (bench.py conventions): replicated save GiB/s
+    out["value"] = out["cr_save_gibps"]
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--total-mb", type=int, default=64)
+    ap.add_argument("--leaves", type=int, default=8)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--chains", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--ec-k", type=int, default=3)
+    ap.add_argument("--ec-m", type=int, default=1)
+    ap.add_argument("--engine", default="mem")
+    ap.add_argument("--engine-dir", default="")
+    ap.add_argument("--no-reshard", action="store_true")
+    ap.add_argument("--json-out", default="")
+    args = ap.parse_args()
+    row = run_bench(total_mb=args.total_mb, leaves=args.leaves,
+                    nodes=args.nodes, chains=args.chains,
+                    replicas=args.replicas, ec_k=args.ec_k, ec_m=args.ec_m,
+                    engine=args.engine, engine_dir=args.engine_dir,
+                    reshard=not args.no_reshard)
+    line = json.dumps(row)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,6 +4,7 @@ The reference treats its benches as part of the tree (benchmarks/
 storage_bench reuses UnitTestFabric; the fio plugin builds in CI) — these
 keep ours importable and correct without measuring anything."""
 
+from benchmarks.ckpt_bench import run_bench as ckpt_bench
 from benchmarks.rebuild_bench import run_bench as rebuild_bench
 from benchmarks.storage_bench import run_bench as storage_bench
 from benchmarks.usrbio_bench import run_bench as usrbio_bench
@@ -62,6 +63,26 @@ class TestRebuildBench:
         assert len(rows) == 2
         assert rows[0]["metric"] == "rs_rebuild_4_2_lost1"
         assert all(r["value"] > 0 for r in rows)
+
+
+class TestCkptBench:
+    """Fast-mode smoke of benchmarks/ckpt_bench: every reported metric
+    present and positive, data verified inside the bench itself."""
+
+    def test_small_run(self):
+        row = ckpt_bench(total_mb=1, leaves=2, nodes=2, chains=2,
+                         replicas=2, ec_k=2, ec_m=1, reshard=True)
+        assert row["value"] > 0
+        for label in ("cr", "ec2_1"):
+            assert row[f"{label}_save_gibps"] > 0
+            assert row[f"{label}_restore_gibps"] > 0
+            assert row[f"{label}_restore_ranged_gibps"] > 0
+            assert row[f"{label}_bytes"] == 1 << 20
+            # the async stall is the snapshot only: it must not exceed
+            # the full sync save wall (generous 2x slack for CI noise)
+            assert row[f"{label}_async_step_stall_ms"] <= \
+                row[f"{label}_sync_save_ms"] * 2.0 + 5.0
+        assert row["cr_reshard_restore_gibps"] > 0
 
 
 class TestNorthstarBench:

@@ -1,0 +1,78 @@
+"""tools/check_rpc_registry wired into tier-1: the static service-table
+check must stay clean, and its validators must actually detect rot."""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tools.check_rpc_registry import check_serde_type, main, run_checks
+
+
+class TestRegistryClean:
+    def test_run_checks_clean(self):
+        errors, notes = run_checks()
+        assert errors == []
+        # Kv/MonitorCollector share id 5 across binaries by design
+        assert any("id 5" in n for n in notes)
+
+    def test_main_exit_zero(self, capsys):
+        assert main() == 0
+        assert "clean" in capsys.readouterr().out
+
+
+class TestSerdeTypeValidator:
+    def test_accepts_the_wire_shapes(self):
+        @dataclass
+        class Inner:
+            a: int = 0
+            b: bytes = b""
+
+        @dataclass
+        class Ok:
+            xs: List[Inner] = field(default_factory=list)
+            m: Dict[str, float] = field(default_factory=dict)
+            opt: Optional[Inner] = None
+
+        assert check_serde_type(Ok) == []
+
+    def test_rejects_unsupported_hints(self):
+        @dataclass
+        class Bad:
+            anything: object = None
+
+        problems = check_serde_type(Bad)
+        assert problems and "unsupported" in problems[0]
+
+    def test_rejects_bare_containers(self):
+        @dataclass
+        class BareList:
+            xs: list = field(default_factory=list)
+
+        assert any("without element type" in p
+                   for p in check_serde_type(BareList))
+
+
+class TestDuplicateDetection:
+    def test_duplicate_method_id_raises_at_bind(self):
+        import pytest
+
+        from tpu3fs.rpc.net import ServiceDef
+
+        @dataclass
+        class M:
+            x: int = 0
+
+        s = ServiceDef(42, "T")
+        s.method(1, "a", M, M, lambda r: r)
+        with pytest.raises(ValueError):
+            s.method(1, "b", M, M, lambda r: r)
+
+    def test_duplicate_service_id_fails_registry(self):
+        from tools.check_rpc_registry import _Registry
+        from tpu3fs.rpc.net import ServiceDef
+
+        import pytest
+
+        reg = _Registry("x")
+        reg.add_service(ServiceDef(7, "A"))
+        with pytest.raises(ValueError):
+            reg.add_service(ServiceDef(7, "B"))

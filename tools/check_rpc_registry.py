@@ -1,0 +1,230 @@
+"""Static RPC-registry check (CI tier-1; satellite of the ckpt PR).
+
+Binds every service table the binaries compose (without sockets or live
+operators — handlers are bound against attribute stubs and never called)
+and verifies, per deployment unit:
+
+1. UNIQUE IDS — service ids unique within each binary, method ids unique
+   within each service (so every (service id, method id) pair routes to
+   exactly one handler on the wire);
+2. SERDE TYPES — every bound method's request/reply types are statically
+   encodable by rpc/serde.py: dataclasses whose (recursive) field hints
+   stay inside the supported set (int/bool/float/bytes/str/Enum/
+   List/Tuple/Dict/Optional/dataclass);
+3. QOS CLASSIFICATION — every method name resolves to a registered
+   traffic class via qos.default_class_for, so an untagged RPC can never
+   dodge admission keying.
+
+Cross-binary service-id reuse (Kv and MonitorCollector both use 5) is
+reported as a note, not a failure — they never share a process.
+
+Run: ``python tools/check_rpc_registry.py`` (exit 0 = clean);
+tests/test_rpc_registry.py wires it into tier-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import sys
+import typing
+from typing import Dict, List, Tuple
+
+# runnable as a plain script from anywhere in the repo
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu3fs.qos.core import CLASS_ATTRS, TrafficClass, default_class_for
+from tpu3fs.rpc.net import ServiceDef
+from tpu3fs.rpc.serde import _fields_of
+
+
+class _Stub:
+    """Attribute sink standing in for a live operator at bind time: the
+    bind_* functions only TAKE references to handler callables."""
+
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+
+class _Registry:
+    """RpcServer-shaped collector (add_service only)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.services: Dict[int, ServiceDef] = {}
+
+    def add_service(self, service: ServiceDef) -> None:
+        if service.service_id in self.services:
+            raise ValueError(
+                f"{self.name}: duplicate service id {service.service_id} "
+                f"({self.services[service.service_id].name} vs "
+                f"{service.name})")
+        self.services[service.service_id] = service
+
+
+def _bind_all() -> List[_Registry]:
+    """One registry per binary composition (see tpu3fs/bin/*_main.py)."""
+    from tpu3fs.kv.replica import bind_replicated_kv
+    from tpu3fs.kv.service import bind_kv_service
+    from tpu3fs.monitor.collector import bind_collector_service
+    from tpu3fs.rpc.services import (
+        bind_core_service,
+        bind_meta_service,
+        bind_mgmtd_admin,
+        bind_mgmtd_service,
+        bind_storage_service,
+    )
+    from tpu3fs.simple_example.service import bind_simple_example_service
+
+    stub = _Stub()
+    out: List[_Registry] = []
+
+    storage = _Registry("storage_main")
+    bind_storage_service(storage, stub)
+    bind_core_service(storage)
+    out.append(storage)
+
+    meta = _Registry("meta_main")
+    bind_meta_service(meta, stub)
+    bind_core_service(meta)
+    out.append(meta)
+
+    mgmtd = _Registry("mgmtd_main")
+    svc = bind_mgmtd_service(mgmtd, stub)
+    bind_mgmtd_admin(svc, stub)
+    bind_core_service(mgmtd)
+    out.append(mgmtd)
+
+    kv = _Registry("kv_main")
+    bind_replicated_kv(kv, stub)  # superset: Kv + KvRepl tables
+    bind_core_service(kv)
+    out.append(kv)
+
+    monitor = _Registry("monitor_main")
+    bind_collector_service(monitor, stub)
+    bind_core_service(monitor)
+    out.append(monitor)
+
+    example = _Registry("simple_example")
+    bind_simple_example_service(example, stub)
+    bind_core_service(example)
+    out.append(example)
+
+    # standalone-table consistency: plain kvd binds the same Kv schema
+    plain_kv = _Registry("kv_main(plain)")
+    bind_kv_service(plain_kv, stub)
+    bind_core_service(plain_kv)
+    out.append(plain_kv)
+
+    return out
+
+
+# -- serde static type check -------------------------------------------------
+
+_SCALARS = (int, bool, float, bytes, str)
+
+
+def check_serde_type(hint, seen=None) -> List[str]:
+    """Problems (empty = clean) for one type hint, recursively."""
+    seen = seen if seen is not None else set()
+    origin = typing.get_origin(hint)
+    if hint in _SCALARS:
+        return []
+    if hint in (list, tuple, dict):
+        return [f"bare {hint.__name__} without element type: {hint!r}"]
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        return []
+    if origin in (list, tuple):
+        args = typing.get_args(hint)
+        if not args:
+            return [f"bare {origin.__name__} without element type: {hint!r}"]
+        return check_serde_type(args[0], seen)
+    if origin is dict:
+        kt, vt = typing.get_args(hint)
+        return check_serde_type(kt, seen) + check_serde_type(vt, seen)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if len(args) != 1:
+            return [f"non-Optional union not serde-encodable: {hint!r}"]
+        return check_serde_type(args[0], seen)
+    if dataclasses.is_dataclass(hint):
+        if hint in seen:
+            return []  # recursion guard (no recursive types today)
+        seen.add(hint)
+        problems: List[str] = []
+        try:
+            fields = _fields_of(hint)
+        except Exception as e:
+            return [f"{hint.__name__}: unresolvable type hints ({e!r})"]
+        for name, fhint in fields:
+            for p in check_serde_type(fhint, seen):
+                problems.append(f"{hint.__name__}.{name}: {p}")
+        return problems
+    return [f"unsupported serde type: {hint!r}"]
+
+
+# -- driver ------------------------------------------------------------------
+
+def run_checks() -> Tuple[List[str], List[str]]:
+    """-> (errors, notes)."""
+    errors: List[str] = []
+    notes: List[str] = []
+    try:
+        registries = _bind_all()
+    except ValueError as e:  # duplicate service/method id at bind time
+        return [str(e)], []
+
+    # cross-binary id reuse (informational)
+    by_id: Dict[int, set] = {}
+    for reg in registries:
+        for sid, s in reg.services.items():
+            by_id.setdefault(sid, set()).add(s.name)
+    for sid, names in sorted(by_id.items()):
+        if len(names) > 1:
+            notes.append(f"service id {sid} reused across binaries: "
+                         f"{sorted(names)} (never co-bound)")
+
+    checked_services = set()
+    for reg in registries:
+        for sid, service in reg.services.items():
+            key = (sid, service.name)
+            if key in checked_services:
+                continue
+            checked_services.add(key)
+            for mid, m in sorted(service.methods.items()):
+                where = f"{service.name}.{m.name} ({sid}/{mid})"
+                for role, t in (("request", m.req_type),
+                                ("reply", m.rsp_type)):
+                    if not dataclasses.is_dataclass(t):
+                        errors.append(
+                            f"{where}: {role} type {t!r} is not a "
+                            "serde dataclass")
+                        continue
+                    for p in check_serde_type(t):
+                        errors.append(f"{where}: {role} {p}")
+                tclass = default_class_for(m.name)
+                if not isinstance(tclass, TrafficClass) \
+                        or tclass not in CLASS_ATTRS:
+                    errors.append(
+                        f"{where}: no QoS classification "
+                        f"(default_class_for -> {tclass!r})")
+    return errors, notes
+
+
+def main() -> int:
+    errors, notes = run_checks()
+    for n in notes:
+        print(f"note: {n}")
+    if errors:
+        for e in errors:
+            print(f"ERROR: {e}", file=sys.stderr)
+        print(f"check_rpc_registry: {len(errors)} problem(s)",
+              file=sys.stderr)
+        return 1
+    print("check_rpc_registry: all service tables clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""ckpt_gc: standalone checkpoint-retention daemon.
+
+The training-side twin of the trash cleaner (src/client/trash_cleaner):
+connects to a live cluster like admin_cli (--connect HOST:PORT), then
+periodically runs the retention sweep over one checkpoint root —
+keep-last-N / keep-every-K eviction through the trash subsystem plus
+stale ``.tmp`` reaping — under the ``ckpt`` QoS class so sweeps schedule
+behind foreground IO.
+
+    python -m tpu3fs.bin.ckpt_gc_main --connect HOST:PORT \
+        [--root /ckpt] [--keep-last 3] [--keep-every 0] \
+        [--trash-keep 86400] [--interval 300] [--once]
+
+Tests drive run_loop() directly against an in-process Fabric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from tpu3fs.ckpt.retention import CheckpointGC, RetentionPolicy
+
+
+def build_gc(fabric, args: argparse.Namespace) -> CheckpointGC:
+    return CheckpointGC(
+        fabric.meta,
+        fabric.file_client(),
+        root=args.root,
+        policy=RetentionPolicy(keep_last=args.keep_last,
+                               keep_every=args.keep_every),
+        trash_keep_s=args.trash_keep,
+        tmp_ttl_s=args.tmp_ttl,
+    )
+
+
+def run_loop(fabric, args: argparse.Namespace, *, out=sys.stdout) -> int:
+    """Sweep until stopped (or once); returns total steps evicted."""
+    gc = build_gc(fabric, args)
+    total = 0
+    while True:
+        removed = gc.run_once()
+        total += removed
+        print(f"ckpt-gc: root={gc.root} evicted={removed} "
+              f"steps_left={len(gc.steps())}", file=out)
+        if args.once:
+            return total
+        time.sleep(args.interval)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="ckpt_gc", description=__doc__)
+    p.add_argument("--connect", metavar="HOST:PORT",
+                   help="mgmtd address of a live cluster")
+    p.add_argument("--token", default="", help="bearer token (auth mode)")
+    p.add_argument("--root", default="/ckpt")
+    p.add_argument("--keep-last", type=int, default=3)
+    p.add_argument("--keep-every", type=int, default=0)
+    p.add_argument("--trash-keep", type=int, default=86400,
+                   help="seconds an evicted step stays recoverable")
+    p.add_argument("--tmp-ttl", type=float, default=3600.0,
+                   help="age before a crashed save's .tmp dir is reaped")
+    p.add_argument("--interval", type=float, default=300.0)
+    p.add_argument("--once", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv: Optional[List[str]] = None) -> int:  # pragma: no cover
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    if not args.connect:
+        print("ckpt_gc: --connect HOST:PORT is required", file=sys.stderr)
+        return 2
+    from tpu3fs.cli import RpcFabricView
+
+    host, port_s = args.connect.rsplit(":", 1)
+    fabric = RpcFabricView((host, int(port_s)), token=args.token,
+                           client_id="ckpt-gc")
+    run_loop(fabric, args)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

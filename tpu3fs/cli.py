@@ -771,6 +771,69 @@ class AdminCli:
                                 session_id=res.session_id)
         return f"created {n} files at {prefix}0..{prefix}{n - 1}"
 
+    # -- checkpoints (tpu3fs/ckpt) -------------------------------------------
+    def _ckpt(self, args: List[str]):
+        from tpu3fs.ckpt import CheckpointManager
+
+        root = self._flag(args, "--root", "/ckpt")
+        return CheckpointManager(self.fab.meta, self.fab.file_client(),
+                                 root=root, client_id="admin_cli")
+
+    def cmd_ckpt_list(self, args: List[str]) -> str:
+        """ckpt-list [--root /ckpt]: committed steps (+ staging dirs)."""
+        from tpu3fs.ckpt.manifest import parse_staging
+
+        mgr = self._ckpt(args)
+        lines = ["STEP      FILES  BYTES       CREATED"]
+        for step in mgr.steps():
+            try:
+                m = mgr.manifest(step)
+                lines.append(f"{step:<9} {len(m.shards) + 1:<6} "
+                             f"{m.total_bytes():<11} {m.created:.0f}")
+            except FsError as e:
+                lines.append(f"{step:<9} ?      ?           ({e.status})")
+        try:
+            staging = [
+                e.name for e in self.fab.meta.list_dir(mgr.root)
+                if parse_staging(e.name) is not None
+            ]
+        except FsError:
+            staging = []
+        if staging:
+            lines.append("staging (crashed saves, swept by ckpt GC): "
+                         + " ".join(sorted(staging)))
+        return "\n".join(lines) if len(lines) > 1 or staging \
+            else "(no checkpoints)"
+
+    def cmd_ckpt_inspect(self, args: List[str]) -> str:
+        """ckpt-inspect STEP [--root /ckpt]: manifest summary."""
+        step = int([a for a in args if not a.startswith("-")][0])
+        mgr = self._ckpt(args)
+        m = mgr.manifest(step)
+        lines = [
+            f"step {m.step}: {len(m.leaves)} leaves, {len(m.shards)} shards,"
+            f" {m.total_bytes()} bytes, created {m.created:.0f}",
+        ]
+        if m.mesh:
+            lines.append("mesh: " + " ".join(
+                f"{k}={v}" for k, v in m.mesh.items()))
+        for i, leaf in enumerate(m.leaves):
+            nsh = len(m.shards_of_leaf(i))
+            spec = ",".join(s or "." for s in leaf.spec) or "-"
+            lines.append(f"  {leaf.key or '<root>'}: {leaf.dtype} "
+                         f"{tuple(leaf.shape)} sharded[{spec}] x{nsh}")
+        return "\n".join(lines)
+
+    def cmd_ckpt_rm(self, args: List[str]) -> str:
+        """ckpt-rm STEP [--root /ckpt] [--keep SECONDS]: evict one step
+        through the trash subsystem (recoverable until expiry)."""
+        step = int([a for a in args if not a.startswith("-")][0])
+        mgr = self._ckpt(args)
+        mgr.gc.trash_keep_s = int(self._flag(args, "--keep",
+                                             mgr.gc.trash_keep_s))
+        mgr.remove(step)
+        return f"step {step} moved to trash"
+
 
 
 class RpcFabricView:

@@ -188,6 +188,12 @@ class MetaStore:
         self._default_stripe = default_stripe
         self._ensure_root()
 
+    @property
+    def engine(self) -> IKVEngine:
+        """The underlying KV engine (subsystems that keep their own small
+        records — e.g. ckpt save sessions — share the meta keyspace)."""
+        return self._engine
+
     # -- low-level codecs ---------------------------------------------------
     def _emit(self, op: str, path: str, *, inode_id: int = 0,
               uid: int = 0, detail: str = "") -> None:
@@ -388,15 +394,25 @@ class MetaStore:
         chunk_size: Optional[int] = None,
         stripe: Optional[int] = None,
         client_id: str = "",
+        layout: Optional[Layout] = None,
     ) -> OpenResult:
-        """Create (and open) a regular file (ref src/meta/store/ops/Open.cc)."""
-        table_id, chains, seed = self._chains.allocate(stripe or self._default_stripe)
-        layout = Layout(
-            table_id=table_id,
-            chains=chains,
-            chunk_size=chunk_size or self._default_chunk_size,
-            seed=seed,
-        )
+        """Create (and open) a regular file (ref src/meta/store/ops/Open.cc).
+
+        An explicit `layout` overrides the chain allocator — callers that
+        must place a file on specific chains (the checkpoint archiver
+        re-encoding onto EC chains) pass the full Layout; everyone else
+        gets allocator striping."""
+        if layout is None:
+            table_id, chains, seed = self._chains.allocate(
+                stripe or self._default_stripe)
+            layout = Layout(
+                table_id=table_id,
+                chains=chains,
+                chunk_size=chunk_size or self._default_chunk_size,
+                seed=seed,
+            )
+        elif not layout.chains:
+            raise _err(Code.META_BAD_LAYOUT, "explicit layout without chains")
 
         def op(txn: ITransaction) -> OpenResult:
             parent, name, existing = self._walk(txn, path, user)

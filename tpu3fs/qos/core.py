@@ -50,6 +50,7 @@ class TrafficClass(enum.IntEnum):
     EC_REBUILD = 4    # EC decode rebuild + two-phase repair sweeps
     MIGRATION = 5     # chain-to-chain migration jobs
     GC = 6            # garbage collection / trash sweeps
+    CKPT = 7          # training-checkpoint save/restore/archival (ckpt/)
 
 
 #: Classes whose work is elastic: they self-throttle under pressure and
@@ -59,6 +60,7 @@ BACKGROUND_CLASSES = frozenset({
     TrafficClass.EC_REBUILD,
     TrafficClass.MIGRATION,
     TrafficClass.GC,
+    TrafficClass.CKPT,
 })
 
 #: TrafficClass -> QosConfig section attribute name.
@@ -70,6 +72,7 @@ CLASS_ATTRS: Dict[TrafficClass, str] = {
     TrafficClass.EC_REBUILD: "ec_rebuild",
     TrafficClass.MIGRATION: "migration",
     TrafficClass.GC: "gc",
+    TrafficClass.CKPT: "ckpt",
 }
 
 
@@ -313,9 +316,10 @@ class QosConfig(Config):
     native_ceiling_burst = ConfigItem(256.0, hot=True,
                                       checker=lambda v: v >= 1)
     # per-target update-queue bound (jobs), the depth the overload test
-    # asserts stays bounded; read at worker creation (not hot — a live
-    # queue is never shrunk under waiters)
-    update_queue_cap = ConfigItem(512, checker=lambda v: v >= 1)
+    # asserts stays bounded. HOT: a config push resizes live queues —
+    # shrinking only caps new admits (queued work is never dropped; the
+    # queue drains below the new cap, storage/craq.py _on_qos_config)
+    update_queue_cap = ConfigItem(512, hot=True, checker=lambda v: v >= 1)
 
     fg_read = _limits(0.0, 256, 0, 8, 1.0)
     fg_write = _limits(0.0, 256, 0, 8, 1.0)
@@ -324,6 +328,10 @@ class QosConfig(Config):
     ec_rebuild = _limits(0.0, 64, 0, 2, 0.5)
     migration = _limits(0.0, 64, 0, 1, 0.25)
     gc = _limits(0.0, 64, 0, 1, 0.25)
+    # checkpoint saves are bursty whole-model flushes: resync-weight (2)
+    # so restores-under-pressure finish, but share-bounded like any
+    # background class so a save flood cannot starve foreground IO
+    ckpt = _limits(0.0, 64, 0, 2, 0.5)
 
 
 # -- admission ---------------------------------------------------------------

@@ -911,17 +911,27 @@ class MetaRpcClient:
         assert last is not None
         raise last
 
-    def stat(self, path: str, follow: bool = True) -> Inode:
+    # NOTE on `user=` below: in-process MetaStore callers pass an explicit
+    # User; over RPC the server derives identity from the bearer token
+    # (claimed uids are ignored in auth mode), so the kwarg is accepted
+    # for surface compatibility (utils/trash.py, ckpt retention) and
+    # dropped on the wire.
+
+    def stat(self, path: str, user=None, *, follow: bool = True) -> Inode:
         return self._call(2, PathReq(path, follow=follow), InodeRsp).inode
 
     def create(self, path: str, **kw) -> OpenRsp:
+        kw.pop("user", None)
         kw.setdefault("client_id", self.client_id)
         return self._call(3, CreateReq(path, **kw), OpenRsp)
 
-    def mkdirs(self, path: str, recursive: bool = False) -> Inode:
-        return self._call(4, MkdirsReq(path, recursive=recursive), InodeRsp).inode
+    def mkdirs(self, path: str, user=None, perm: int = 0o755,
+               *, recursive: bool = False) -> Inode:
+        return self._call(4, MkdirsReq(path, perm=perm,
+                                       recursive=recursive), InodeRsp).inode
 
-    def remove(self, path: str, recursive: bool = False, request_id: str = "") -> None:
+    def remove(self, path: str, user=None, *, recursive: bool = False,
+               request_id: str = "") -> None:
         self._call(7, RemoveReq(path, recursive=recursive,
                                 client_id=self.client_id, request_id=request_id), Empty)
 
@@ -988,10 +998,23 @@ class MetaRpcClient:
     def batch_stat(self, inode_ids: List[int]) -> List[Optional[Inode]]:
         return self._call(17, BatchStatReq(list(inode_ids)), BatchStatRsp).inodes
 
-    def rename(self, src: str, dst: str) -> None:
+    def batch_stat_by_path(self, paths: List[str]) -> List[Optional[Inode]]:
+        """Missing/forbidden paths come back as None (MetaStore parity —
+        consumers like the ckpt loader and kvcache batch_get treat None
+        as a miss)."""
+        out: List[Optional[Inode]] = []
+        for p in paths:
+            try:
+                out.append(self.stat(p))
+            except FsError:
+                out.append(None)
+        return out
+
+    def rename(self, src: str, dst: str, user=None) -> None:
         self._call(11, RenameReq(src, dst), Empty)
 
-    def list_dir(self, path: str, limit: int = 0, prefix: str = "") -> List[DirEntry]:
+    def list_dir(self, path: str, user=None, *, limit: int = 0,
+                 prefix: str = "") -> List[DirEntry]:
         return self._call(12, ListReq(path, limit=limit, prefix=prefix), ListRsp).entries
 
     def stat_fs(self) -> StatFs:

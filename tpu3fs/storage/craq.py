@@ -381,8 +381,22 @@ class StorageService:
         concurrency cap per class), shedding with the retryable
         OVERLOADED + retry-after hint. Existing update workers keep their
         policy; install before the first write (the service binaries and
-        the fabric both do)."""
+        the fabric both do). A config push that changes update_queue_cap
+        resizes every LIVE queue (shrink = cap new admits only)."""
         self._qos = manager
+        manager.config.add_callback(self._on_qos_config)
+
+    def _on_qos_config(self, _node=None) -> None:
+        """Hot-update hook: push the (possibly changed) queue cap into
+        every live update worker. Workers created later read the fresh
+        value at creation, so both paths agree."""
+        if self._qos is None:
+            return
+        cap = int(self._qos.config.update_queue_cap)
+        with self._update_workers_guard:
+            workers = list(self._update_workers.values())
+        for w in workers:
+            w.set_queue_cap(cap)
 
     @property
     def qos(self):

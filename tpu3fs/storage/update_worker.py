@@ -107,6 +107,18 @@ class UpdateWorker:
         with self._cond:
             return dict(self._q.class_depths())
 
+    @property
+    def queue_cap(self) -> int:
+        with self._cond:
+            return self._q.cap
+
+    def set_queue_cap(self, cap: int) -> None:
+        """Resize the live queue (config push hot-update). Shrinking only
+        caps NEW admits — try_push sheds while depth >= cap, and already-
+        queued jobs drain normally, so no waiter is ever dropped."""
+        with self._cond:
+            self._q.cap = max(1, int(cap))
+
     def submit(self, reqs: list, make_reply,
                tclass: TrafficClass = TrafficClass.FG_WRITE) -> list:
         """Enqueue one same-chain batch; block until its replies are ready.

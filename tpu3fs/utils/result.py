@@ -104,6 +104,11 @@ class Code(enum.IntEnum):
     CLIENT_ROUTING_STALE = 702
     CLIENT_BUSY = 703        # bounded queue/limiter full (backpressure)
 
+    # checkpoint subsystem 8xx (tpu3fs/ckpt)
+    CKPT_BUSY = 800          # another save session holds this root
+    CKPT_NOT_FOUND = 801     # no committed checkpoint at this step
+    CKPT_CORRUPT = 802       # manifest/shard failed decode or CRC check
+
 
 #: Codes on which a client-side retry ladder may re-issue the request.
 RETRYABLE_CODES = frozenset(
