@@ -202,6 +202,9 @@ def _phase_e2e(platform: str) -> dict:
                 for row in storage_bench(chunks=64, size=256 << 10, batch=8,
                                          threads=4, replicas=2, chains=4,
                                          engine=eng):
+                    if "value" not in row:
+                        continue  # diagnostic rows (write_decomp) carry no
+                        # headline value — skipping fixes KeyError('value')
                     suffix = "" if eng == "mem" else "_native"
                     out[f"e2e_{row['metric']}{suffix}_gibps"] = row["value"]
             except Exception as e:
@@ -221,6 +224,8 @@ def _phase_e2e(platform: str) -> dict:
                 for row in run_rpc_bench(chunks=64, size=256 << 10, batch=8,
                                          threads=4, replicas=2, chains=4,
                                          transport=transport, engine=eng):
+                    if "value" not in row:
+                        continue  # diagnostic rows carry no headline value
                     suffix = "" if transport == "python" else "_native"
                     out[f"e2e_{row['metric']}{suffix}_gibps"] = row["value"]
             except Exception as e:

@@ -1,0 +1,251 @@
+"""read_bench: served read-path throughput matrix -> BENCH_READPATH.json.
+
+Measures the zero-copy pipelined read path end to end over real sockets
+(the _RpcCluster harness from benchmarks/storage_bench), across:
+
+- transport: python | native        (both ends of each run use the same)
+- mode:      single  (one read_chunk per op, the RPC-ladder floor)
+             batch   (node-grouped batch_read, pipelined fan-out)
+             striped (batch_read with striping FORCED on, so every node
+                      group splits across connections — the large-transfer
+                      shape ckpt restore sees)
+- prefetch:  FileIoClient sequential scan with readahead on vs off, plus
+             a random-access pattern showing the prefetcher stays cold
+             (bounded memory, no wasted readahead)
+
+Usage:
+  python -m benchmarks.read_bench [--chunks 64] [--size 262144]
+      [--batch 8] [--fast] [--out BENCH_READPATH.json]
+
+Prints one JSON row per cell; --out writes the whole matrix as JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+from benchmarks.storage_bench import _RpcCluster, FILE_ID
+from tpu3fs.client.storage_client import ReadReq, RetryOptions
+from tpu3fs.storage.types import ChunkId
+
+_FAST_RETRY = RetryOptions(backoff_base_s=0.001, backoff_max_s=0.05)
+
+
+def _gibps(nbytes: int, dt: float) -> float:
+    return round(nbytes / max(dt, 1e-9) / (1 << 30), 3)
+
+
+def _write_corpus(cluster, chunks: int, size: int) -> None:
+    client = cluster.storage_client(retry=_FAST_RETRY)
+    payload = bytes(range(256)) * (size // 256)
+    for i in range(chunks):
+        r = client.write_chunk(
+            cluster.chain_ids[i % len(cluster.chain_ids)],
+            ChunkId(FILE_ID, i), 0, payload, chunk_size=size)
+        assert r.ok, r
+    client.close()
+
+
+def _bench_rpc_modes(cluster, *, chunks: int, size: int, batch: int,
+                     transport: str, rounds: int) -> list:
+    rows = []
+    chain_ids = cluster.chain_ids
+
+    def reqs_for(idxs):
+        return [ReadReq(chain_ids[i % len(chain_ids)], ChunkId(FILE_ID, i),
+                        0, -1) for i in idxs]
+
+    # single: the per-op RPC floor
+    client = cluster.storage_client(retry=_FAST_RETRY)
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(rounds):
+        for i in range(chunks):
+            r = client.read_chunk(chain_ids[i % len(chain_ids)],
+                                  ChunkId(FILE_ID, i))
+            assert r.ok, r
+            n += 1
+    rows.append({"metric": "readpath_single", "transport": transport,
+                 "value": _gibps(n * size, time.perf_counter() - t0),
+                 "unit": "GiB/s", "ops": n})
+
+    # batch: pipelined node-grouped fan-out (default striping thresholds)
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(rounds):
+        for base in range(0, chunks, batch):
+            got = client.batch_read(
+                reqs_for(range(base, min(base + batch, chunks))))
+            assert all(r.ok for r in got)
+            n += len(got)
+    rows.append({"metric": "readpath_batch", "transport": transport,
+                 "value": _gibps(n * size, time.perf_counter() - t0),
+                 "unit": "GiB/s", "ops": n, "batch": batch})
+    client.close()
+
+    # striped: striping forced on (every group splits across connections)
+    client = cluster.storage_client(retry=_FAST_RETRY)
+    m = client._messenger
+    if hasattr(m, "_stripe_min_bytes"):
+        m._stripe_min_bytes = size  # force: any 2-op group stripes
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(rounds):
+        for base in range(0, chunks, batch):
+            got = client.batch_read(
+                reqs_for(range(base, min(base + batch, chunks))))
+            assert all(r.ok for r in got)
+            n += len(got)
+    rows.append({"metric": "readpath_striped", "transport": transport,
+                 "value": _gibps(n * size, time.perf_counter() - t0),
+                 "unit": "GiB/s", "ops": n, "batch": batch})
+    client.close()
+    return rows
+
+
+def _bench_prefetch(cluster, *, chunks: int, size: int, transport: str,
+                    rounds: int) -> list:
+    """Record-sized sequential + random scans (the training-data loader
+    shape: samples are much smaller than chunks), prefetch on vs off,
+    over a hand-built inode spanning the cluster's chains (no meta
+    service needed — the layout is the data-plane contract). Readahead's
+    win here is AMORTIZATION + overlap: with prefetch off every record
+    pays a full RPC round trip; with it on, records are served out of
+    multi-chunk windows fetched ahead by ONE pipelined node-grouped batch
+    each, issued while earlier records are being consumed."""
+    from tpu3fs.client.file_io import FileIoClient
+    from tpu3fs.meta.types import Acl, Inode, InodeType, Layout
+
+    rows = []
+    inode = Inode(
+        id=FILE_ID, type=InodeType.FILE, acl=Acl(),
+        layout=Layout(chains=list(cluster.chain_ids), chunk_size=size,
+                      seed=0),
+        length=chunks * size,
+    )
+    # record size: 1/16 chunk (16 KiB at the default 256 KiB chunks) —
+    # the tokenized-sample scale where per-record round trips dominate
+    # and readahead windows amortize them
+    step = max(size // 16, 4096)
+
+    for label, prefetch in (("off", False), ("on", True)):
+        fio = FileIoClient(cluster.storage_client(retry=_FAST_RETRY),
+                           prefetch=prefetch)
+        # COLD sequential passes: the cache is dropped between passes, so
+        # the number measures readahead PIPELINING (window K+1 fetched
+        # while K is consumed), not rereads out of a warm cache
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(rounds):
+            for off in range(0, chunks * size, step):
+                blob = fio.read(inode, off, step)
+                assert len(blob) == step
+                n += step
+            if fio.prefetcher is not None:
+                fio.prefetcher.invalidate_all()
+        seq = _gibps(n, time.perf_counter() - t0)
+        seq_stats = {}
+        if fio.prefetcher is not None:
+            pf = fio.prefetcher
+            seq_stats = {"prefetch_hits": pf.hits._value,
+                         "prefetch_misses": pf.misses._value}
+        fio.close()
+        fio.storage.close()
+        # random access (same volume, FRESH client): readahead must stay
+        # cold — bounded memory, no wasted windows
+        fio = FileIoClient(cluster.storage_client(retry=_FAST_RETRY),
+                           prefetch=prefetch)
+        rng = random.Random(7)
+        offs = [o * step for o in range(0, chunks * size // step)]
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(rounds):
+            rng.shuffle(offs)
+            for off in offs:
+                blob = fio.read(inode, off, step)
+                assert len(blob) == step
+                n += step
+        rnd = _gibps(n, time.perf_counter() - t0)
+        pf = fio.prefetcher
+        rows.append({
+            "metric": f"readpath_prefetch_{label}",
+            "transport": transport,
+            "seq_gibps": seq, "random_gibps": rnd, "unit": "GiB/s",
+            "value": seq,
+            "record_bytes": step,
+            "random_cached_bytes": pf.cached_bytes() if pf else 0,
+            **seq_stats,
+        })
+        fio.close()
+        fio.storage.close()
+    return rows
+
+
+def run(*, chunks: int = 64, size: int = 256 << 10, batch: int = 8,
+        replicas: int = 2, chains: int = 4, rounds: int = 4,
+        transports=("python", "native")) -> list:
+    results = []
+    for transport in transports:
+        engine = "native" if transport == "native" else "mem"
+        try:
+            cluster = _RpcCluster(replicas=replicas, chains=chains,
+                                  size=size, transport=transport,
+                                  engine=engine)
+        except Exception as e:  # no toolchain: report, keep the matrix
+            results.append({"metric": "readpath_error",
+                            "transport": transport, "error": repr(e)[:200]})
+            print(json.dumps(results[-1]), flush=True)
+            continue
+        try:
+            _write_corpus(cluster, chunks, size)
+            for row in _bench_rpc_modes(cluster, chunks=chunks, size=size,
+                                        batch=batch, transport=transport,
+                                        rounds=rounds):
+                row["chunk_size"] = size
+                row["engine"] = engine
+                results.append(row)
+                print(json.dumps(row), flush=True)
+            for row in _bench_prefetch(cluster, chunks=chunks, size=size,
+                                       transport=transport, rounds=rounds):
+                row["chunk_size"] = size
+                row["engine"] = engine
+                results.append(row)
+                print(json.dumps(row), flush=True)
+        finally:
+            cluster.close()
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunks", type=int, default=64)
+    ap.add_argument("--size", type=int, default=256 << 10)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--chains", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--fast", action="store_true",
+                    help="tiny smoke configuration (CI)")
+    ap.add_argument("--transport", choices=["python", "native", "both"],
+                    default="both")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    kw = dict(chunks=args.chunks, size=args.size, batch=args.batch,
+              replicas=args.replicas, chains=args.chains,
+              rounds=args.rounds)
+    if args.fast:
+        kw.update(chunks=16, size=64 << 10, rounds=1)
+    if args.transport != "both":
+        kw["transports"] = (args.transport,)
+    results = run(**kw)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"rows": results}, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

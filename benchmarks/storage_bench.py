@@ -304,7 +304,11 @@ class _RpcCluster:
         services = []
         svc_by_node = {}
         for node_id in node_ids:
-            mcli = MgmtdRpcClient(self.mgmtd_addr, self.shared_client)
+            # TTL-cached routing: per-op getRoutingInfo round trips were a
+            # measured double-digit share of served-read time; the bench
+            # cluster's routing is static, retries invalidate anyway
+            mcli = MgmtdRpcClient(self.mgmtd_addr, self.shared_client,
+                                  routing_ttl_s=1.0)
             svc = StorageService(node_id, mcli.refresh_routing)
             svc.set_messenger(RpcMessenger(mcli.refresh_routing,
                                            self.shared_client))
@@ -354,7 +358,8 @@ class _RpcCluster:
         from tpu3fs.client.storage_client import StorageClient
 
         self._client_seq += 1
-        mcli = self._mgmtd_cli_cls(self.mgmtd_addr, self.shared_client)
+        mcli = self._mgmtd_cli_cls(self.mgmtd_addr, self.shared_client,
+                                   routing_ttl_s=1.0)
         messenger = self._messenger_cls(mcli.refresh_routing,
                                         self.shared_client)
         return StorageClient(f"bench-rpc-{self._client_seq}",

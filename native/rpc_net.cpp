@@ -179,7 +179,12 @@ std::string encode_packet(const Packet& p) {
   return buf;
 }
 
-bool decode_packet(const uint8_t* d, size_t len, Packet& p) {
+// decode one frame. With `bulk_off` given, a bulk section is NOT copied
+// into p.bulk — *bulk_off names its offset inside `d` and the caller reads
+// it in place (the client's zero-copy reply path: the recv buffer itself
+// is handed to Python, which views the section without another copy).
+bool decode_packet(const uint8_t* d, size_t len, Packet& p,
+                   size_t* bulk_off = nullptr) {
   size_t pos = 0;
   uint64_t nfields;
   if (!get_uvarint(d, len, pos, nfields) || nfields < 8) return false;
@@ -199,7 +204,10 @@ bool decode_packet(const uint8_t* d, size_t len, Packet& p) {
   // peer mis-framing rather than silently dropping data)
   if (p.flags & kFlagBulk) {
     p.has_bulk = true;
-    p.bulk.assign(reinterpret_cast<const char*>(d + pos), len - pos);
+    if (bulk_off != nullptr)
+      *bulk_off = pos;
+    else
+      p.bulk.assign(reinterpret_cast<const char*>(d + pos), len - pos);
   } else if (pos != len) {
     return false;
   }
@@ -208,9 +216,8 @@ bool decode_packet(const uint8_t* d, size_t len, Packet& p) {
 
 // minimal bulk-section sanity: varint count + per-segment varint lens must
 // cover the section exactly (the Python split_bulk enforces the same)
-bool bulk_section_valid(const std::string& bulk) {
-  const uint8_t* d = reinterpret_cast<const uint8_t*>(bulk.data());
-  size_t len = bulk.size(), pos = 0;
+bool bulk_section_valid_raw(const uint8_t* d, size_t len) {
+  size_t pos = 0;
   uint64_t count;
   if (!get_uvarint(d, len, pos, count)) return false;
   uint64_t total = 0;
@@ -224,6 +231,11 @@ bool bulk_section_valid(const std::string& bulk) {
     if (total > len) return false;
   }
   return pos <= len && total == len - pos;
+}
+
+bool bulk_section_valid(const std::string& bulk) {
+  return bulk_section_valid_raw(
+      reinterpret_cast<const uint8_t*>(bulk.data()), bulk.size());
 }
 
 // ---- socket helpers -------------------------------------------------------
@@ -505,11 +517,29 @@ void fp_put_reply(std::string& buf, int64_t code, uint64_t data_len,
   put_int(buf, int64_t(aux));
 }
 
+// bulk-gather reply of a fast-path read batch: the control payload plus
+// the bulk header and the engine group buffers the payload segments still
+// live in — worker_main writev's straight from those buffers (no
+// concatenation of the section; the data bytes are copied exactly once,
+// engine -> group buffer, then DMA'd to the socket by the kernel).
+struct FpReadOut {
+  std::string payload;
+  bool reply_bulk = false;
+  std::string bulk_hdr;
+  // owning buffers + the (ptr, len) segments into them, in reply order
+  std::vector<std::unique_ptr<std::vector<uint8_t>>> bufs;
+  std::vector<std::pair<const uint8_t*, size_t>> segs;
+  size_t bulk_bytes() const {
+    size_t total = bulk_hdr.size();
+    for (auto& s : segs) total += s.second;
+    return total;
+  }
+};
+
 // true when handled (reply fields filled); false => fall back to Python.
 // `single` = method 3 (one bare ReadReq in, one bare ReadReply out);
 // otherwise method 11 (BatchReadReq/BatchReadRsp).
-bool fp_try_batch_read(FpState& fp, const Packet& req, std::string& payload,
-                       std::string& bulk_out, bool& reply_bulk,
+bool fp_try_batch_read(FpState& fp, const Packet& req, FpReadOut& out2,
                        bool single = false) {
   std::vector<FpReq> ops;
   const uint8_t* d = reinterpret_cast<const uint8_t*>(req.payload.data());
@@ -613,15 +643,17 @@ bool fp_try_batch_read(FpState& fp, const Packet& req, std::string& payload,
     bufs.push_back(std::move(buf));
   }
   // encode BatchReadRsp{replies} (or one bare ReadReply when single);
-  // data inline or as a bulk section
-  reply_bulk = req.has_bulk;
+  // data inline or as bulk SEGMENTS gathered straight from the group
+  // buffers (no section concatenation — the multi-chunk bulk gather)
+  std::string& payload = out2.payload;
+  out2.reply_bulk = req.has_bulk;
+  bool reply_bulk = out2.reply_bulk;
   payload.clear();
   if (!single) {
     put_uvarint(payload, 1);
     put_uvarint(payload, ops.size());
   }
-  std::string bulk_hdr;
-  uint64_t bulk_data = 0;
+  std::string& bulk_hdr = out2.bulk_hdr;
   if (reply_bulk) put_uvarint(bulk_hdr, ops.size());
   for (size_t i = 0; i < ops.size(); i++) {
     const Out& o = outs[i];
@@ -635,22 +667,12 @@ bool fp_try_batch_read(FpState& fp, const Packet& req, std::string& payload,
       fp_put_reply(payload, FP_OK, o.len, nullptr, o.ver, o.crc, o.aux,
                    false);
       put_uvarint(bulk_hdr, o.len);
-      bulk_data += o.len;
+      if (o.len) out2.segs.emplace_back(data, size_t(o.len));
     } else {
       fp_put_reply(payload, FP_OK, o.len, data, o.ver, o.crc, o.aux, true);
     }
   }
-  if (reply_bulk) {
-    bulk_out.clear();
-    bulk_out.reserve(bulk_hdr.size() + bulk_data);
-    bulk_out += bulk_hdr;
-    for (size_t i = 0; i < ops.size(); i++) {
-      const Out& o = outs[i];
-      if (o.rc == 0 && o.len)
-        bulk_out.append(
-            reinterpret_cast<const char*>(o.buf->data() + o.off), o.len);
-    }
-  }
+  out2.bufs = std::move(bufs);
   fp.hits.fetch_add(1);
   return true;
 }
@@ -859,12 +881,17 @@ constexpr int64_t kReadMethodId = 3;
 constexpr int64_t kBatchUpdateMethodId = 15;
 
 // ---- server ---------------------------------------------------------------
-// handler v2: returns status; on success fills *rsp (malloc'd) + *rsp_len;
-// may fill *msg (malloc'd) with an error message. `bulk`/`bulk_len` carry
-// the request's raw bulk section when has_bulk != 0; the handler may hand
-// back a malloc'd reply bulk section via *rsp_bulk — the transport then
-// writev's it after the envelope without copying. Called from workers.
+// handler v3: returns status; on success fills *rsp (malloc'd) + *rsp_len;
+// may fill *msg (malloc'd) with an error message. `flags` carries the
+// request envelope's flag bits — the QoS traffic-class bits ride there
+// (tpu3fs/qos/core.py class_to_flags), so the Python trampoline can admit
+// and tag by the class the PEER declared instead of guessing from the
+// method name. `bulk`/`bulk_len` carry the request's raw bulk section when
+// has_bulk != 0; the handler may hand back a malloc'd reply bulk section
+// via *rsp_bulk — the transport then writev's it after the envelope
+// without copying. Called from workers.
 typedef int64_t (*tpu3fs_handler_t)(int64_t service_id, int64_t method_id,
+                                    int64_t flags,
                                     const uint8_t* req, size_t req_len,
                                     const uint8_t* bulk, size_t bulk_len,
                                     int has_bulk,
@@ -911,25 +938,39 @@ struct QosBucket {
   double last_s = 0.0;
 
   // -> 0 when admitted, else suggested retry-after in ms
-  int64_t try_take(int64_t fallback_ms) {
+  int64_t try_take(int64_t fallback_ms, double cost = 1.0) {
     std::lock_guard<std::mutex> g(mu);
     if (rate <= 0.0) return 0;
     double now = mono_now();  // seconds
     if (now > last_s)
       tokens = std::min(burst, tokens + (now - last_s) * rate);
     last_s = now;
-    if (tokens >= 1.0) {
-      tokens -= 1.0;
+    if (tokens >= cost) {
+      tokens -= cost;
       return 0;
     }
-    int64_t ms = static_cast<int64_t>((1.0 - tokens) / rate * 1000.0) + 1;
+    int64_t ms = static_cast<int64_t>((cost - tokens) / rate * 1000.0) + 1;
     return std::max(fallback_ms, ms);
+  }
+
+  // undo a take whose request was NOT served here after all (a fast-path
+  // fallback hands the op to Python, whose admission charges it again —
+  // without the refund the op would pay two buckets for one read)
+  void put_back(double cost = 1.0) {
+    std::lock_guard<std::mutex> g(mu);
+    if (rate > 0.0) tokens = std::min(burst, tokens + cost);
   }
 };
 
 struct QosState {
   std::mutex mu;  // guards the map shape; buckets lock themselves
   std::map<int64_t, std::unique_ptr<QosBucket>> buckets;
+  // per-(service, traffic class) gates for ops served WITHOUT entering
+  // Python (the native read fast path): keyed service_id << 8 | class
+  // code, where the class code is the envelope's 4 flag bits
+  // ((flags >> 8) & 0xF; 0 = untagged). Installed from QosConfig's
+  // per-class sections by tpu3fs/rpc/native_net.py.
+  std::map<int64_t, std::unique_ptr<QosBucket>> class_buckets;
   std::atomic<uint64_t> shed{0};
   int64_t retry_after_ms = 50;
 
@@ -937,6 +978,12 @@ struct QosState {
     std::lock_guard<std::mutex> g(mu);
     auto it = buckets.find(service_id);
     return it == buckets.end() ? nullptr : it->second.get();
+  }
+
+  QosBucket* find_class(int64_t service_id, int64_t class_code) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = class_buckets.find((service_id << 8) | (class_code & 0xF));
+    return it == class_buckets.end() ? nullptr : it->second.get();
   }
 };
 
@@ -1027,12 +1074,40 @@ void worker_main(Server* s) {
     if (req.service_id == kStorageServiceId &&
         (req.method_id == kBatchReadMethodId ||
          req.method_id == kReadMethodId)) {
-      std::string fp_payload, fp_bulk;
-      bool fp_reply_bulk = false;
+      // per-class gate (the envelope's traffic-class flag bits): ops the
+      // fast path serves never reach Python's AdmissionController, so
+      // the class limits are enforced HERE; a fallback refunds the take
+      // because the Python dispatch charges the op again
+      QosBucket* cb =
+          s->qos.find_class(req.service_id, (req.flags >> 8) & 0xF);
+      if (cb != nullptr) {
+        int64_t ra = cb->try_take(s->qos.retry_after_ms);
+        if (ra > 0) {
+          s->qos.shed.fetch_add(1);
+          rsp.status = kOverloaded;
+          rsp.message = "retry_after_ms=" + std::to_string(ra) +
+                        " (native class gate)";
+          rsp.ts[5] = mono_now();
+          std::string envq = encode_packet(rsp);
+          uint64_t totalq = envq.size();
+          uint8_t hdrq[4] = {uint8_t(totalq >> 24), uint8_t(totalq >> 16),
+                             uint8_t(totalq >> 8), uint8_t(totalq)};
+          struct iovec iovq[2] = {
+              {hdrq, 4},
+              {const_cast<char*>(envq.data()), envq.size()},
+          };
+          std::lock_guard<std::mutex> g(job.conn->write_mu);
+          if (!job.conn->closed.load() &&
+              !send_iovs(job.conn->fd, iovq, 2, kServerDrainTimeoutMs)) {
+            server_close_conn(s, job.conn);
+          }
+          continue;
+        }
+      }
+      FpReadOut fpo;
       bool handled = false;
       try {
-        handled = fp_try_batch_read(s->fastpath, req, fp_payload, fp_bulk,
-                                    fp_reply_bulk,
+        handled = fp_try_batch_read(s->fastpath, req, fpo,
                                     req.method_id == kReadMethodId);
       } catch (...) {
         // allocation or engine failure must fall back, never kill the
@@ -1041,27 +1116,35 @@ void worker_main(Server* s) {
       }
       if (handled) {
         rsp.status = OK;
-        rsp.payload = std::move(fp_payload);
-        if (fp_reply_bulk) rsp.flags |= kFlagBulk;
+        rsp.payload = std::move(fpo.payload);
+        if (fpo.reply_bulk) rsp.flags |= kFlagBulk;
         rsp.ts[5] = mono_now();
         std::string env2 = encode_packet(rsp);
-        uint64_t total2 = env2.size() + (fp_reply_bulk ? fp_bulk.size() : 0);
+        uint64_t total2 = env2.size() + (fpo.reply_bulk ? fpo.bulk_bytes()
+                                                        : 0);
         uint8_t hdr2[4] = {uint8_t(total2 >> 24), uint8_t(total2 >> 16),
                            uint8_t(total2 >> 8), uint8_t(total2)};
-        struct iovec iov2[3] = {
-            {hdr2, 4},
-            {const_cast<char*>(env2.data()), env2.size()},
-            {const_cast<char*>(fp_bulk.data()),
-             fp_reply_bulk ? fp_bulk.size() : 0},
-        };
+        // gather: header + envelope + bulk header + every payload segment
+        // writev'd straight from the engine group buffers
+        std::vector<struct iovec> iov2;
+        iov2.reserve(3 + fpo.segs.size());
+        iov2.push_back({hdr2, 4});
+        iov2.push_back({const_cast<char*>(env2.data()), env2.size()});
+        if (fpo.reply_bulk) {
+          iov2.push_back({const_cast<char*>(fpo.bulk_hdr.data()),
+                          fpo.bulk_hdr.size()});
+          for (auto& seg : fpo.segs)
+            iov2.push_back({const_cast<uint8_t*>(seg.first), seg.second});
+        }
         std::lock_guard<std::mutex> g(job.conn->write_mu);
         if (!job.conn->closed.load() &&
-            !send_iovs(job.conn->fd, iov2, fp_reply_bulk ? 3 : 2,
+            !send_iovs(job.conn->fd, iov2.data(), int(iov2.size()),
                        kServerDrainTimeoutMs)) {
           server_close_conn(s, job.conn);
         }
         continue;
       }
+      if (cb != nullptr) cb->put_back();
       s->fastpath.fallbacks.fetch_add(1);
     }
     // native write fast path: the chain-internal batchUpdate hop against
@@ -1103,7 +1186,7 @@ void worker_main(Server* s) {
     char* msg = nullptr;
     int64_t status = INTERNAL;
     if (s->handler) {
-      status = s->handler(req.service_id, req.method_id,
+      status = s->handler(req.service_id, req.method_id, req.flags,
                           reinterpret_cast<const uint8_t*>(req.payload.data()),
                           req.payload.size(),
                           reinterpret_cast<const uint8_t*>(req.bulk.data()),
@@ -1258,6 +1341,10 @@ struct Client {
   int call_timeout_ms = 30000;
   std::mt19937_64 rng{std::random_device{}()};
   std::mutex mu;  // one in-flight call per connection
+  // uuid of the request sent by tpu3fs_rpc_client_send, awaiting its
+  // reply via tpu3fs_rpc_client_recv (the pipelined split of call3:
+  // callers may issue on MANY connections before collecting any reply)
+  std::string pending_uuid;
 };
 
 std::string gen_uuid(std::mt19937_64& rng) {
@@ -1398,39 +1485,34 @@ void* tpu3fs_rpc_client_connect(const char* host, int port,
 }
 
 // ABI version marker: the Python loader rebuilds a stale .so whose symbols
-// predate the bulk-framing handler signature (a silent mismatch would
-// corrupt the callback stack instead of failing loud)
-int tpu3fs_rpc_abi_version() { return 2; }
+// predate the flags-carrying handler signature / pipelined client split
+// (a silent mismatch would corrupt the callback stack instead of failing
+// loud)
+int tpu3fs_rpc_abi_version() { return 3; }
 
-// returns 0 on transport success (out_status carries the remote status code);
-// negative on transport failure: -1 send failed, -2 recv failed/timeout,
-// -3 decode failed, -4 uuid mismatch, -5 request exceeds kMaxPacket
-// (found before any bytes moved: the connection is still healthy).
-//
-// Bulk riders: n_iovs < 0 means "no bulk section" (a plain call);
-// n_iovs >= 0 sends kFlagBulk with the given segments gathered into
-// writev straight from the caller's buffers (n_iovs == 0 is the empty
-// section that asks the server to reply in bulk). On success with a
-// bulk reply, *out_bulk is the malloc'd raw section (*out_has_bulk = 1).
-int tpu3fs_rpc_client_call2(void* cli, int64_t service_id, int64_t method_id,
-                            const uint8_t* req, size_t req_len,
-                            const uint8_t* const* iov_ptrs,
-                            const size_t* iov_lens, int64_t n_iovs,
-                            int64_t* out_status, uint8_t** out_rsp,
-                            size_t* out_rsp_len, uint8_t** out_bulk,
-                            size_t* out_bulk_len, int* out_has_bulk,
-                            char** out_msg) {
-  auto* c = static_cast<Client*>(cli);
-  std::lock_guard<std::mutex> g(c->mu);
+namespace {
+
+// send half: frame + writev the request (gathering caller bulk buffers);
+// stores the uuid in c->pending_uuid for the matching recv. extra_flags
+// carries the envelope flag bits beyond kFlagIsReq — the QoS traffic
+// class of the calling thread rides there (class_to_flags).
+// Caller must hold c->mu.
+int client_send_locked(Client* c, int64_t service_id, int64_t method_id,
+                       int64_t extra_flags, const uint8_t* req,
+                       size_t req_len, const uint8_t* const* iov_ptrs,
+                       const size_t* iov_lens, int64_t n_iovs) {
   Packet pkt;
   pkt.uuid = gen_uuid(c->rng);
   pkt.service_id = service_id;
   pkt.method_id = method_id;
-  pkt.flags = kFlagIsReq;
+  pkt.flags = kFlagIsReq | extra_flags;
   pkt.status = OK;
   pkt.payload.assign(reinterpret_cast<const char*>(req), req_len);
   bool bulk = n_iovs >= 0;
-  if (bulk) pkt.flags |= kFlagBulk;
+  if (bulk)
+    pkt.flags |= kFlagBulk;
+  else
+    pkt.flags &= ~kFlagBulk;  // extra_flags must not forge a bulk frame
   pkt.ts[0] = mono_now();  // client_build
   pkt.ts[1] = mono_now();  // client_send
   std::string env = encode_packet(pkt);
@@ -1460,29 +1542,64 @@ int tpu3fs_rpc_client_call2(void* cli, int64_t service_id, int64_t method_id,
   }
   if (!send_iovs(c->fd, iov.data(), int(iov.size()), c->call_timeout_ms))
     return -1;
+  c->pending_uuid = pkt.uuid;
+  return 0;
+}
+
+// recv half: read one reply frame and hand the fields out (malloc'd).
+// Caller must hold c->mu; c->pending_uuid names the expected reply.
+//
+// ZERO-COPY bulk hand-off: a bulk reply's *out_bulk is the whole malloc'd
+// FRAME buffer (recv'd straight from the kernel) and *out_bulk_off names
+// the section's offset inside it — the Python side views the section in
+// place and frees the buffer when its views die. The payload/message
+// control fields are small and copied out as before.
+int client_recv_locked(Client* c, int64_t* out_status, uint8_t** out_rsp,
+                       size_t* out_rsp_len, uint8_t** out_bulk,
+                       size_t* out_bulk_off, size_t* out_bulk_len,
+                       int* out_has_bulk, char** out_msg) {
+  if (c->pending_uuid.empty()) return -6;  // recv without a send
   uint8_t hdr[4];
   if (!recv_exact(c->fd, hdr, 4)) return -2;
   uint32_t n = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |
                (uint32_t(hdr[2]) << 8) | uint32_t(hdr[3]);
   if (n > kMaxPacket) return -3;
-  std::vector<uint8_t> body(n);
-  if (!recv_exact(c->fd, body.data(), n)) return -2;
+  uint8_t* body = static_cast<uint8_t*>(malloc(n ? n : 1));
+  if (!recv_exact(c->fd, body, n)) {
+    free(body);
+    return -2;
+  }
   Packet rsp;
-  if (!decode_packet(body.data(), n, rsp)) return -3;
-  if (rsp.has_bulk && !bulk_section_valid(rsp.bulk)) return -3;
-  if (rsp.uuid != pkt.uuid) return -4;
+  size_t bulk_off = 0;
+  if (!decode_packet(body, n, rsp, &bulk_off)) {
+    free(body);
+    return -3;
+  }
+  if (rsp.has_bulk &&
+      !bulk_section_valid_raw(body + bulk_off, n - bulk_off)) {
+    free(body);
+    return -3;
+  }
+  if (rsp.uuid != c->pending_uuid) {
+    free(body);
+    return -4;
+  }
+  c->pending_uuid.clear();
   *out_status = rsp.status;
   *out_rsp_len = rsp.payload.size();
   *out_rsp = static_cast<uint8_t*>(malloc(rsp.payload.size() + 1));
   memcpy(*out_rsp, rsp.payload.data(), rsp.payload.size());
   if (out_has_bulk != nullptr) *out_has_bulk = rsp.has_bulk ? 1 : 0;
+  bool bulk_escaped = false;
   if (out_bulk != nullptr && out_bulk_len != nullptr) {
     if (rsp.has_bulk) {
-      *out_bulk = static_cast<uint8_t*>(malloc(rsp.bulk.size() + 1));
-      memcpy(*out_bulk, rsp.bulk.data(), rsp.bulk.size());
-      *out_bulk_len = rsp.bulk.size();
+      *out_bulk = body;  // ownership passes to the caller
+      if (out_bulk_off != nullptr) *out_bulk_off = bulk_off;
+      *out_bulk_len = n - bulk_off;
+      bulk_escaped = true;
     } else {
       *out_bulk = nullptr;
+      if (out_bulk_off != nullptr) *out_bulk_off = 0;
       *out_bulk_len = 0;
     }
   }
@@ -1491,17 +1608,79 @@ int tpu3fs_rpc_client_call2(void* cli, int64_t service_id, int64_t method_id,
     memcpy(*out_msg, rsp.message.data(), rsp.message.size());
     (*out_msg)[rsp.message.size()] = 0;
   }
+  if (!bulk_escaped) free(body);
   return 0;
+}
+
+}  // namespace
+
+// returns 0 on transport success (out_status carries the remote status code);
+// negative on transport failure: -1 send failed, -2 recv failed/timeout,
+// -3 decode failed, -4 uuid mismatch, -5 request exceeds kMaxPacket
+// (found before any bytes moved: the connection is still healthy),
+// -6 recv without a pending send.
+//
+// Bulk riders: n_iovs < 0 means "no bulk section" (a plain call);
+// n_iovs >= 0 sends kFlagBulk with the given segments gathered into
+// writev straight from the caller's buffers (n_iovs == 0 is the empty
+// section that asks the server to reply in bulk). On success with a
+// bulk reply, *out_bulk is the malloc'd raw section (*out_has_bulk = 1).
+// `flags` carries extra envelope flag bits (QoS traffic class). A bulk
+// reply's *out_bulk is the malloc'd FRAME buffer with the raw section at
+// *out_bulk_off (zero-copy hand-off — the caller views it in place and
+// frees the buffer when done).
+int tpu3fs_rpc_client_call3(void* cli, int64_t service_id, int64_t method_id,
+                            int64_t flags, const uint8_t* req, size_t req_len,
+                            const uint8_t* const* iov_ptrs,
+                            const size_t* iov_lens, int64_t n_iovs,
+                            int64_t* out_status, uint8_t** out_rsp,
+                            size_t* out_rsp_len, uint8_t** out_bulk,
+                            size_t* out_bulk_off, size_t* out_bulk_len,
+                            int* out_has_bulk, char** out_msg) {
+  auto* c = static_cast<Client*>(cli);
+  std::lock_guard<std::mutex> g(c->mu);
+  int rc = client_send_locked(c, service_id, method_id, flags, req, req_len,
+                              iov_ptrs, iov_lens, n_iovs);
+  if (rc != 0) return rc;
+  return client_recv_locked(c, out_status, out_rsp, out_rsp_len, out_bulk,
+                            out_bulk_off, out_bulk_len, out_has_bulk,
+                            out_msg);
+}
+
+// pipelined split of call3: issue the request now, collect the reply
+// later — the caller may send on MANY connections before receiving any
+// reply (the striped multi-connection read fan-out). One in-flight
+// request per connection; the Python side serializes send..recv pairs
+// per connection with its own lease lock.
+int tpu3fs_rpc_client_send(void* cli, int64_t service_id, int64_t method_id,
+                           int64_t flags, const uint8_t* req, size_t req_len,
+                           const uint8_t* const* iov_ptrs,
+                           const size_t* iov_lens, int64_t n_iovs) {
+  auto* c = static_cast<Client*>(cli);
+  std::lock_guard<std::mutex> g(c->mu);
+  return client_send_locked(c, service_id, method_id, flags, req, req_len,
+                            iov_ptrs, iov_lens, n_iovs);
+}
+
+int tpu3fs_rpc_client_recv(void* cli, int64_t* out_status, uint8_t** out_rsp,
+                           size_t* out_rsp_len, uint8_t** out_bulk,
+                           size_t* out_bulk_off, size_t* out_bulk_len,
+                           int* out_has_bulk, char** out_msg) {
+  auto* c = static_cast<Client*>(cli);
+  std::lock_guard<std::mutex> g(c->mu);
+  return client_recv_locked(c, out_status, out_rsp, out_rsp_len, out_bulk,
+                            out_bulk_off, out_bulk_len, out_has_bulk,
+                            out_msg);
 }
 
 int tpu3fs_rpc_client_call(void* cli, int64_t service_id, int64_t method_id,
                            const uint8_t* req, size_t req_len,
                            int64_t* out_status, uint8_t** out_rsp,
                            size_t* out_rsp_len, char** out_msg) {
-  return tpu3fs_rpc_client_call2(cli, service_id, method_id, req, req_len,
+  return tpu3fs_rpc_client_call3(cli, service_id, method_id, 0, req, req_len,
                                  nullptr, nullptr, -1, out_status, out_rsp,
                                  out_rsp_len, nullptr, nullptr, nullptr,
-                                 out_msg);
+                                 nullptr, out_msg);
 }
 
 void tpu3fs_rpc_client_close(void* cli) {
@@ -1604,6 +1783,28 @@ void tpu3fs_rpc_qos_set(void* srv, int64_t service_id, double rate_per_s,
   if (retry_after_ms > 0) s->qos.retry_after_ms = retry_after_ms;
 }
 
+// per-(service, traffic class) gate for natively-served ops (the read
+// fast path): class_code is the envelope's 4-bit class field
+// ((flags >> 8) & 0xF; 0 = untagged). Consulted ONLY by the fast-path
+// branch — Python-dispatched ops are admitted by the Python controller,
+// and a fast-path fallback refunds its take so no op pays twice.
+void tpu3fs_rpc_qos_set_class(void* srv, int64_t service_id,
+                              int64_t class_code, double rate_per_s,
+                              double burst, int64_t retry_after_ms) {
+  Server* s = static_cast<Server*>(srv);
+  if (s == nullptr) return;
+  std::lock_guard<std::mutex> g(s->qos.mu);
+  auto& slot =
+      s->qos.class_buckets[(service_id << 8) | (class_code & 0xF)];
+  if (!slot) slot = std::make_unique<QosBucket>();
+  std::lock_guard<std::mutex> bg(slot->mu);
+  slot->rate = rate_per_s;
+  slot->burst = std::max(1.0, burst);
+  slot->tokens = slot->burst;
+  slot->last_s = mono_now();
+  if (retry_after_ms > 0) s->qos.retry_after_ms = retry_after_ms;
+}
+
 void tpu3fs_rpc_qos_clear(void* srv) {
   Server* s = static_cast<Server*>(srv);
   if (s == nullptr) return;
@@ -1611,6 +1812,10 @@ void tpu3fs_rpc_qos_clear(void* srv) {
   // QosState::find while this runs, so buckets live as long as the server
   std::lock_guard<std::mutex> g(s->qos.mu);
   for (auto& kv : s->qos.buckets) {
+    std::lock_guard<std::mutex> bg(kv.second->mu);
+    kv.second->rate = 0.0;
+  }
+  for (auto& kv : s->qos.class_buckets) {
     std::lock_guard<std::mutex> bg(kv.second->mu);
     kv.second->rate = 0.0;
   }

@@ -85,6 +85,24 @@ class TestCkptBench:
         assert row["cr_reshard_restore_gibps"] > 0
 
 
+class TestReadBench:
+    """benchmarks/read_bench fast-mode smoke: the matrix runs, every cell
+    reports, prefetch rows carry their hit/miss accounting."""
+
+    def test_python_matrix_smoke(self):
+        from benchmarks.read_bench import run
+
+        rows = run(chunks=8, size=16 << 10, batch=4, replicas=2, chains=2,
+                   rounds=1, transports=("python",))
+        names = [r["metric"] for r in rows]
+        assert names == ["readpath_single", "readpath_batch",
+                         "readpath_striped", "readpath_prefetch_off",
+                         "readpath_prefetch_on"]
+        assert all(r.get("value", 0) > 0 for r in rows)
+        on = rows[-1]
+        assert on["prefetch_hits"] + on["prefetch_misses"] > 0
+
+
 class TestNorthstarBench:
     """BASELINE.md headline workloads at test sizes: each phase must
     produce its e2e_* field and verify its own data integrity."""

@@ -1,0 +1,58 @@
+"""tools/check_copy_hotpath wired into tier-1: the served read path must
+stay copy-free, and the checker must actually detect a reintroduced copy."""
+
+import ast
+
+from tools.check_copy_hotpath import (
+    _BYTES_CALL,
+    _COPY_OK,
+    _JOIN,
+    _PAYLOAD_CONCAT,
+    check,
+    main,
+)
+
+
+class TestHotPathClean:
+    def test_check_clean(self):
+        assert check() == []
+
+    def test_main_exit_zero(self, capsys):
+        assert main() == 0
+        assert "copy-clean" in capsys.readouterr().out
+
+
+class TestDetectors:
+    def test_bytes_call_detected(self):
+        assert _BYTES_CALL.search("data = bytes(seg)")
+        assert not _BYTES_CALL.search("n_bytes(x)")   # suffix words differ
+        assert not _BYTES_CALL.search("pool.bytes(x)" .replace(".", "_"))
+
+    def test_join_detected(self):
+        assert _JOIN.search('whole = b"".join(parts)')
+        assert _JOIN.search("whole = b''.join(parts)")
+        assert not _JOIN.search('", ".join(names)')
+
+    def test_payload_concat_detected(self):
+        assert _PAYLOAD_CONCAT.search("buf += data")
+        assert _PAYLOAD_CONCAT.search("out += reply.payload")
+        assert not _PAYLOAD_CONCAT.search("pos += n")
+
+    def test_copy_ok_requires_reason(self):
+        assert _COPY_OK.search("x = bytes(seg)  # copy-ok: ops outlive req")
+        assert not _COPY_OK.search("x = bytes(seg)  # copy-ok:")
+        assert not _COPY_OK.search("x = bytes(seg)  # copy-ok")
+
+    def test_docstring_lines_exempt(self):
+        # the span extractor must skip docstrings (they may MENTION
+        # bytes() without being code)
+        from tools.check_copy_hotpath import _function_spans
+
+        src = (
+            "def f():\n"
+            '    """calls bytes(seg) — prose, not code."""\n'
+            "    return 1\n"
+        )
+        tree = ast.parse(src)
+        (name, lo, hi), = _function_spans(tree, {"f"})
+        assert lo == 3  # body starts after the docstring

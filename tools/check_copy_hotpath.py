@@ -1,0 +1,123 @@
+"""Static check: the served read path must stay copy-free.
+
+The zero-copy read pipeline (docs/readpath.md) holds only as long as
+nobody quietly re-introduces a payload copy on the wire path — a single
+``bytes(seg)`` on a 1 MiB segment silently costs more than the whole serde
+envelope. This check walks the functions that make up the served read
+path and flags the three ways payload copies sneak back in:
+
+- ``bytes(...)`` calls (materializing a view),
+- ``b"".join(...)`` / ``b''.join(...)`` (concatenation),
+- ``+=`` accumulation whose right-hand side names payload-ish data
+  (``data``/``payload``/``seg``/``blob``/``body``/``chunk``/``part``).
+
+A line that NEEDS a copy (ops that outlive the request, EC decode
+re-buffering) must say so: a ``# copy-ok: <reason>`` comment on the line
+exempts it, and the reason is required.
+
+Run: ``python tools/check_copy_hotpath.py`` (exit 0 = clean); wired into
+tier-1 via tests/test_copy_hotpath.py, like check_rpc_registry.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (file, [function names]) — every function (top-level, nested or method)
+# with a matching name inside the file is checked
+HOT_PATH: List[Tuple[str, List[str]]] = [
+    ("tpu3fs/rpc/net.py",
+     ["_send_packet", "_sendmsg_all", "_recv_packet", "split_bulk",
+      "start_call", "finish_call"]),
+    ("tpu3fs/rpc/services.py",
+     ["_read_h", "_batch_read_h", "_attach_read_segs",
+      "batch_read_pipelined"]),
+    ("tpu3fs/storage/craq.py", ["_batch_read_impl"]),
+    ("tpu3fs/storage/engine.py", ["batch_read_views"]),
+    ("tpu3fs/storage/native_engine.py", ["batch_read_views"]),
+    ("tpu3fs/client/storage_client.py", ["batch_read"]),
+    ("tpu3fs/client/file_io.py",
+     ["read_into", "_batch_read_files_direct", "_fetch_window"]),
+]
+
+_BYTES_CALL = re.compile(r"(?<![\w.])bytes\s*\(")
+_JOIN = re.compile(r"b(\"\"|'')\s*\.\s*join\s*\(")
+_PAYLOAD_CONCAT = re.compile(
+    r"\+=\s*.*\b(data|payload|seg|segment|blob|body|chunk|part)\w*\b")
+_COPY_OK = re.compile(r"#\s*copy-ok:\s*\S")
+
+
+def _function_spans(tree: ast.AST, names: set) -> List[Tuple[str, int, int]]:
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in names:
+            lo = node.lineno
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                lo = body[0].end_lineno + 1  # skip the docstring
+            spans.append((node.name, lo, node.end_lineno))
+    return spans
+
+
+def check() -> List[str]:
+    errors: List[str] = []
+    for rel, names in HOT_PATH:
+        path = os.path.join(REPO, rel)
+        try:
+            with open(path, "r") as f:
+                src = f.read()
+        except OSError as e:
+            errors.append(f"{rel}: unreadable ({e})")
+            continue
+        tree = ast.parse(src)
+        lines = src.splitlines()
+        spans = _function_spans(tree, set(names))
+        found = {n for n, _, _ in spans}
+        for missing in set(names) - found:
+            errors.append(
+                f"{rel}: hot-path function {missing!r} not found — "
+                "update tools/check_copy_hotpath.py HOT_PATH")
+        for fname, lo, hi in spans:
+            for ln in range(lo, hi + 1):
+                line = lines[ln - 1]
+                code = line.split("#", 1)[0]
+                if _COPY_OK.search(line):
+                    continue
+                hit = None
+                if _BYTES_CALL.search(code):
+                    hit = "bytes() materializes a copy"
+                elif _JOIN.search(code):
+                    hit = 'b"".join concatenation copy'
+                elif _PAYLOAD_CONCAT.search(code):
+                    hit = "+= payload concatenation"
+                if hit:
+                    errors.append(
+                        f"{rel}:{ln} in {fname}: {hit} on the served "
+                        f"read path: {line.strip()!r} — make it a "
+                        "view/gather, or annotate '# copy-ok: <why>'")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    if errors:
+        print(f"check_copy_hotpath: {len(errors)} problem(s)",
+              file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print("check_copy_hotpath: served read path is copy-clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,11 +52,14 @@ class FuseApp(TwoPhaseApplication):
             raise SystemExit("no meta servers in routing info")
         meta = MetaRpcClient(meta_addrs,
                              client_id=f"fuse-{self.info.node_id}")
+        # prefetch on: the mount is this client's single mutation path
+        # (its own writes/truncates invalidate), and FUSE readers are the
+        # sequential-scan workload readahead exists for
         fio = FileIoClient(StorageClient(
             f"fuse-{self.info.node_id}",
             lambda: self.mgmtd_client.routing(),
             RpcMessenger(lambda: self.mgmtd_client.routing()),
-        ))
+        ), prefetch=True)
         agent = UsrbioAgent(meta, fio, client_id=f"fuse-{self.info.node_id}")
         self.ops = FuseOps(meta, fio, agent)
 

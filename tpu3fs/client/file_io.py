@@ -18,12 +18,45 @@ from tpu3fs.utils.result import Code, FsError, Status
 
 
 class FileIoClient:
-    def __init__(self, storage: StorageClient):
+    def __init__(self, storage: StorageClient, *, prefetch=False):
+        """prefetch: False (off), True (default readahead config), or a
+        PrefetchConfig. When on, sequential reads arm an async readahead
+        window (client/prefetch.py) that read/read_into/batch_read_files
+        serve from; THIS client's write/truncate/remove invalidate it.
+        Consistency is client-local — multi-writer workflows sharing a
+        file across clients should leave prefetch off (the default)."""
         self._storage = storage
+        self._prefetch = None
+        if prefetch:
+            from tpu3fs.client.prefetch import (
+                PrefetchConfig,
+                ReadaheadPrefetcher,
+            )
+
+            cfg = prefetch if isinstance(prefetch, PrefetchConfig) else None
+            self._prefetch = ReadaheadPrefetcher(self._fetch_window, cfg)
 
     @property
     def storage(self) -> StorageClient:
         return self._storage
+
+    @property
+    def prefetcher(self):
+        return self._prefetch
+
+    def invalidate_prefetch(self, inode_id: Optional[int] = None) -> None:
+        """Drop readahead windows (one inode, or all with None) — for
+        callers that mutate files through a DIFFERENT path than this
+        client (e.g. FUSE truncate going through the meta service)."""
+        if self._prefetch is not None:
+            if inode_id is None:
+                self._prefetch.invalidate_all()
+            else:
+                self._prefetch.invalidate(inode_id)
+
+    def close(self) -> None:
+        if self._prefetch is not None:
+            self._prefetch.close()
 
     @staticmethod
     def _split(
@@ -86,6 +119,9 @@ class FileIoClient:
                     if not reply.ok:
                         raise FsError(Status(reply.code, reply.message))
 
+        if self._prefetch is not None:
+            # write-through invalidation: cached windows may now be stale
+            self._prefetch.invalidate(inode.id)
         pos = 0
         kind: Optional[str] = None
         run: list = []
@@ -162,7 +198,13 @@ class FileIoClient:
             if not reply.ok:
                 raise FsError(Status(reply.code))
             any_data = True
-            parts.append(reply.data.ljust(n, b"\x00"))  # pad short chunk
+            # replies may carry zero-copy transport memoryviews: append
+            # the buffer itself (join below is the ONE assembly copy) and
+            # pad a short chunk with a separate zeros part
+            data = reply.data
+            parts.append(data)
+            if len(data) < n:
+                parts.append(b"\x00" * (n - len(data)))
         if not any_data and inode.length == 0:
             return b""
         return b"".join(parts)
@@ -170,11 +212,24 @@ class FileIoClient:
     def read(self, inode: Inode, offset: int, size: int) -> bytes:
         """POSIX-style read: holes and short chunks inside the file read as
         zeros; the result is clamped to the inode's length (short read at
-        EOF)."""
-        layout = inode.layout
-        assert layout is not None
+        EOF). With prefetch on, sequential reads are served from (and
+        arm) the readahead window."""
         if inode.length:
             size = max(0, min(size, inode.length - offset))
+        pf = self._prefetch
+        if pf is None:
+            return self._read_direct(inode, offset, size)
+        data = pf.lookup(inode.id, offset, size)
+        if data is None:
+            data = self._read_direct(inode, offset, size)
+        pf.record_read(inode, offset, size)
+        return data
+
+    def _read_direct(self, inode: Inode, offset: int, size: int) -> bytes:
+        """The uncached read path (also the prefetcher's fetch fn; size is
+        already clamped by the caller)."""
+        layout = inode.layout
+        assert layout is not None
         # generator: a fatal error on an early chunk short-circuits inside
         # _assemble before the remaining chunk RPCs are ever issued
         def one(chain_id: int, idx: int, in_off: int, n: int):
@@ -208,6 +263,13 @@ class FileIoClient:
             size = max(0, min(size, inode.length - offset))
         if size == 0:
             return 0
+        pf = self._prefetch
+        if pf is not None:
+            hit = pf.lookup(inode.id, offset, size)
+            if hit is not None:
+                dest[:size] = hit
+                pf.record_read(inode, offset, size)
+                return size
         segs = self._split(layout, offset, size)
         reqs = [
             ReadReq(chain_id, ChunkId(inode.id, idx), in_off, n,
@@ -232,6 +294,8 @@ class FileIoClient:
             pos += n
         if not any_data and inode.length == 0:
             return 0
+        if pf is not None:
+            pf.record_read(inode, offset, size)
         return size
 
     def batch_read_files(
@@ -239,7 +303,39 @@ class FileIoClient:
     ) -> List[bytes]:
         """Read many (inode, offset, size) ranges as ONE node-grouped batch
         through StorageClient.batch_read — the data-loader/KVCache path where
-        batching across files is what amortizes round trips."""
+        batching across files is what amortizes round trips. With prefetch
+        on, ranges inside a readahead window are served from cache and the
+        rest go out as one (smaller) batch."""
+        pf = self._prefetch
+        if pf is None:
+            return self._batch_read_files_direct(files)
+        out: List[Optional[bytes]] = [None] * len(files)
+        missing: List[int] = []
+        for i, (inode, offset, size) in enumerate(files):
+            if inode.length:
+                size = max(0, min(size, inode.length - offset))
+            hit = pf.lookup(inode.id, offset, size)
+            if hit is not None:
+                out[i] = hit
+            else:
+                missing.append(i)
+        if missing:
+            got = self._batch_read_files_direct([files[i] for i in missing])
+            for i, blob in zip(missing, got):
+                out[i] = blob
+        for inode, offset, size in files:
+            pf.record_read(inode, offset, size)
+        return out  # type: ignore[return-value]
+
+    def _fetch_window(self, inode: Inode, offset: int, size: int) -> bytes:
+        """The prefetcher's fetch fn: one node-grouped batched read (NOT
+        the per-chunk ladder — a 4 MiB window must not cost 16 serial
+        round trips)."""
+        return self._batch_read_files_direct([(inode, offset, size)])[0]
+
+    def _batch_read_files_direct(
+        self, files: List[Tuple[Inode, int, int]]
+    ) -> List[bytes]:
         from tpu3fs.client.storage_client import ReadReq
 
         reqs: List[ReadReq] = []
@@ -280,6 +376,8 @@ class FileIoClient:
         return best
 
     def remove_chunks(self, inode: Inode) -> None:
+        if self._prefetch is not None:
+            self._prefetch.invalidate(inode.id)
         layout = inode.layout
         if layout is None:
             return
@@ -289,6 +387,8 @@ class FileIoClient:
     def truncate_chunks(self, inode: Inode, length: int) -> None:
         """Drop chunks past the new EOF and trim the boundary chunk, down
         every chain of the layout (the storage half of meta truncate)."""
+        if self._prefetch is not None:
+            self._prefetch.invalidate(inode.id)
         layout = inode.layout
         if layout is None:
             return

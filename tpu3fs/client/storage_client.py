@@ -111,6 +111,14 @@ class StorageClient:
     ):
         self.client_id = client_id
         self._routing = routing_provider
+        # TTL-cached providers (MgmtdRpcClient with routing_ttl_s) expose
+        # an invalidation hook; retry ladders call it before re-resolving
+        # so failover convergence never waits out the cache TTL
+        owner = getattr(routing_provider, "__self__", None)
+        self._routing_invalidate = (
+            getattr(routing_provider, "invalidate", None)
+            or getattr(owner, "invalidate_routing", None)
+            or (lambda: None))
         self._messenger = messenger
         self._retry = retry or RetryOptions()
         self._selection = selection
@@ -198,6 +206,9 @@ class StorageClient:
         knows its own refill horizon, so the client waits exactly that
         (jittered to decorrelate a herd of shed clients) instead of
         hammering blind."""
+        # a retry is about to re-resolve routing: a TTL-cached provider
+        # must poll fresh (the chain may have moved under us)
+        self._routing_invalidate()
         if hint_ms > 0:
             delay = min(self._retry.backoff_max_s * 4, hint_ms / 1000.0)
         else:
@@ -370,22 +381,36 @@ class StorageClient:
         for node_id, i, req in plan:
             by_node[node_id].append((i, req))
 
-        def _issue_read(item) -> None:
-            # ONE BatchRead request per node (ref sendBatchRequest
-            # StorageClientImpl.cc:1303): the round trip is amortized over
-            # the whole group
-            node_id, batch = item
-            idxs = [i for i, _ in batch]
-            try:
-                got = self._messenger(
-                    node_id, "batch_read", [req for _, req in batch])
-                for i, reply in zip(idxs, got):
+        items = list(by_node.items())
+        pipelined = getattr(self._messenger, "batch_read_pipelined", None)
+        if pipelined is not None and items:
+            # striped multi-connection fan-out with pipelined issue: every
+            # node group's stripes go on the wire BEFORE any reply is
+            # collected, each on its own pooled connection — wall clock is
+            # the slowest stripe, not the sum (socket messengers only; the
+            # in-process fabric keeps direct dispatch below)
+            groups = [(node_id, [req for _, req in batch])
+                      for node_id, batch in items]
+            for (node_id, batch), got in zip(items, pipelined(groups)):
+                for (i, _), reply in zip(batch, got):
                     replies[i] = reply
-            except FsError as e:
-                for i in idxs:
-                    replies[i] = ReadReply(e.code)
+        else:
+            def _issue_read(item) -> None:
+                # ONE BatchRead request per node (ref sendBatchRequest
+                # StorageClientImpl.cc:1303): the round trip is amortized
+                # over the whole group
+                node_id, batch = item
+                idxs = [i for i, _ in batch]
+                try:
+                    got = self._messenger(
+                        node_id, "batch_read", [req for _, req in batch])
+                    for i, reply in zip(idxs, got):
+                        replies[i] = reply
+                except FsError as e:
+                    for i in idxs:
+                        replies[i] = ReadReply(e.code)
 
-        self._fan_out(_issue_read, list(by_node.items()))
+            self._fan_out(_issue_read, items)
         # fall back to the single-op retry ladder for failures (EC replies
         # already went through read_stripe's own ladder)
         for i, r in enumerate(replies):
@@ -802,9 +827,15 @@ class StorageClient:
                     return None
                 req = ReadReq(chain_id, chunk_id, 0, -1, t.target_id)
                 try:
-                    return self._messenger(node.node_id, "read", req)
+                    r = self._messenger(node.node_id, "read", req)
                 except FsError as e:
                     return ReadReply(e.code)
+                if r is not None and not isinstance(r.data, bytes):
+                    # the EC decode path pads/joins/ndarray-stacks shard
+                    # payloads: materialize a zero-copy transport view
+                    # once here (copy-ok: device decode re-buffers anyway)
+                    r = replace(r, data=bytes(r.data))
+                return r
 
             direct = {j: fetch(j) for j in range(j0, j1)}
             vers = {

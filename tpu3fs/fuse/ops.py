@@ -227,6 +227,12 @@ class FuseOps:
 
     def truncate(self, path: str, length: int) -> None:
         inode = self._meta.truncate(path, length)
+        # the truncate's chunk drop ran through the META service's own
+        # storage client, not this mount's — drop our readahead windows
+        # explicitly or a sequential reader could be served pre-truncate
+        # bytes from the prefetch cache
+        if hasattr(self._fio, "invalidate_prefetch"):
+            self._fio.invalidate_prefetch(inode.id)
         # clamp open handles' high-water marks or close()'s length hint
         # would resurrect the pre-truncate length (MetaStore.close applies
         # max(length, hint))
@@ -249,6 +255,14 @@ class FuseOps:
         if v is not None and v[1]:
             self._virt_unregister(*v)
             return
+        if hasattr(self._fio, "invalidate_prefetch"):
+            # inode id reuse after remove+create must never serve the old
+            # file's readahead windows
+            try:
+                ino = self._meta.stat(path, follow=False)
+                self._fio.invalidate_prefetch(ino.id)
+            except FsError:
+                pass
         self._meta.remove(path)
 
     def rename(self, src: str, dst: str) -> None:

@@ -33,11 +33,12 @@ from tpu3fs.utils.result import Code, FsError, Status
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libtpu3fs_rpc.so")
 
-_ABI_VERSION = 2  # must match tpu3fs_rpc_abi_version() in rpc_net.cpp
+_ABI_VERSION = 3  # must match tpu3fs_rpc_abi_version() in rpc_net.cpp
 
 _HANDLER_T = ctypes.CFUNCTYPE(
     ctypes.c_int64,                      # status
     ctypes.c_int64, ctypes.c_int64,      # service_id, method_id
+    ctypes.c_int64,                      # envelope flags (QoS class bits)
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,   # req
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,   # bulk section
     ctypes.c_int,                                      # has_bulk
@@ -121,21 +122,31 @@ def _load_lib():
         lib.tpu3fs_rpc_client_connect.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ]
-        lib.tpu3fs_rpc_client_call2.restype = ctypes.c_int
-        lib.tpu3fs_rpc_client_call2.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_void_p),       # iov ptrs
-            ctypes.POINTER(ctypes.c_size_t),       # iov lens
-            ctypes.c_int64,                        # n_iovs (-1 = no bulk)
+        _recv_out_args = [
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.c_size_t),
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),  # out bulk
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),  # out bulk base
+            ctypes.POINTER(ctypes.c_size_t),                 # out bulk off
             ctypes.POINTER(ctypes.c_size_t),                 # out bulk len
             ctypes.POINTER(ctypes.c_int),                    # out has_bulk
             ctypes.POINTER(ctypes.c_char_p),
         ]
+        _send_in_args = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64,                        # extra envelope flags
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_void_p),       # iov ptrs
+            ctypes.POINTER(ctypes.c_size_t),       # iov lens
+            ctypes.c_int64,                        # n_iovs (-1 = no bulk)
+        ]
+        lib.tpu3fs_rpc_client_call3.restype = ctypes.c_int
+        lib.tpu3fs_rpc_client_call3.argtypes = _send_in_args + _recv_out_args
+        lib.tpu3fs_rpc_client_send.restype = ctypes.c_int
+        lib.tpu3fs_rpc_client_send.argtypes = _send_in_args
+        lib.tpu3fs_rpc_client_recv.restype = ctypes.c_int
+        lib.tpu3fs_rpc_client_recv.argtypes = (
+            [ctypes.c_void_p] + _recv_out_args)
         lib.tpu3fs_rpc_client_close.argtypes = [ctypes.c_void_p]
         lib.tpu3fs_rpc_fastpath_install.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p]
@@ -158,11 +169,36 @@ def _load_lib():
             lib.tpu3fs_rpc_qos_set.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_double,
                 ctypes.c_double, ctypes.c_int64]
+            lib.tpu3fs_rpc_qos_set_class.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_double, ctypes.c_double, ctypes.c_int64]
             lib.tpu3fs_rpc_qos_clear.argtypes = [ctypes.c_void_p]
             lib.tpu3fs_rpc_qos_shed_count.restype = ctypes.c_uint64
             lib.tpu3fs_rpc_qos_shed_count.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
+
+
+def _owned_c_buffer(lib, base_ptr, off: int, length: int):
+    """Wrap [off, off+length) of a malloc'd C buffer as a zero-copy
+    memoryview, taking OWNERSHIP of the buffer: a finalizer frees it when
+    the last view dies (memoryviews keep the ctypes array alive, the
+    array keeps the finalizer armed). Empty sections free immediately."""
+    import weakref
+
+    addr = ctypes.cast(base_ptr, ctypes.c_void_p).value
+    if not length or not addr:
+        lib.tpu3fs_rpc_free(base_ptr)
+        return b""
+    try:
+        arr = (ctypes.c_uint8 * (off + length)).from_address(addr)
+        weakref.finalize(arr, lib.tpu3fs_rpc_free, ctypes.c_void_p(addr))
+    except BaseException:
+        lib.tpu3fs_rpc_free(base_ptr)
+        raise
+    # ctypes arrays export format "<B", which memoryview indexing refuses;
+    # cast to plain "B" (still zero-copy, still keeps `arr` alive)
+    return memoryview(arr).cast("B")[off:off + length]
 
 
 def _malloc_bytes(lib, data) -> int:
@@ -175,16 +211,16 @@ def _malloc_bytes(lib, data) -> int:
 def _malloc_section(lib, iovs):
     """Assemble a bulk section (header + segments) into one malloc'd
     buffer for the C side to writev after the envelope. The single copy on
-    the native server's reply path."""
-    hdr = pack_bulk_header(iovs)
-    total = len(hdr) + sum(len(b) for b in iovs)
-    buf = lib.tpu3fs_rpc_alloc(total or 1)
-    ctypes.memmove(buf, hdr, len(hdr))
-    off = len(hdr)
+    the native server's trampoline reply path (engine buffer views append
+    straight into the section — no intermediate bytes objects)."""
+    section = bytearray(pack_bulk_header(iovs))
     for iov in iovs:
-        if len(iov):
-            ctypes.memmove(buf + off, bytes(iov), len(iov))
-            off += len(iov)
+        section += iov  # bytearray += copies from any buffer, no temps
+    total = len(section)
+    buf = lib.tpu3fs_rpc_alloc(total or 1)
+    if total:
+        ctypes.memmove(buf,
+                       (ctypes.c_char * total).from_buffer(section), total)
     return buf, total
 
 
@@ -237,6 +273,25 @@ class NativeRpcServer:
             return
         cfg = self._admission.config
         self._lib.tpu3fs_rpc_qos_clear(self._srv)
+        # per-class gates for the storage read fast path: ops it serves
+        # never cross into Python, so the per-class rate limits from
+        # QosConfig are enforced by C-side buckets keyed on the envelope's
+        # class bits (wire code = TrafficClass + 1; tpu3fs/qos/core.py
+        # class_to_flags). A fast-path fallback refunds its take, so
+        # Python-dispatched ops are never charged twice.
+        if hasattr(self._lib, "tpu3fs_rpc_qos_set_class"):
+            from tpu3fs.qos.core import CLASS_ATTRS
+            from tpu3fs.rpc.services import STORAGE_SERVICE_ID
+
+            if STORAGE_SERVICE_ID in self._services:
+                for tclass, attr in CLASS_ATTRS.items():
+                    sect = getattr(cfg, attr)
+                    if float(sect.rate) > 0:
+                        self._lib.tpu3fs_rpc_qos_set_class(
+                            self._srv, STORAGE_SERVICE_ID,
+                            int(tclass) + 1, float(sect.rate),
+                            float(sect.burst),
+                            int(cfg.shed_retry_after_ms))
         rate = float(cfg.native_ceiling_rate)
         if rate <= 0:
             return
@@ -314,7 +369,7 @@ class NativeRpcServer:
         return hits.value, fallbacks.value
 
     # -- dispatch (same semantics as RpcServer._dispatch) -------------------
-    def _handle(self, service_id, method_id, req_ptr, req_len,
+    def _handle(self, service_id, method_id, flags, req_ptr, req_len,
                 bulk_ptr, bulk_len, has_bulk,
                 out_rsp, out_rsp_len, out_bulk, out_bulk_len,
                 out_msg) -> int:
@@ -330,16 +385,20 @@ class NativeRpcServer:
             if mdef is None:
                 return self._err(out_msg, Code.RPC_METHOD_NOT_FOUND,
                                  f"{service.name}.{method_id}")
-            # QoS admission (the native transport does not carry the
-            # envelope's class bits into this trampoline, so untagged ops
-            # classify by method name — default_class_for)
+            # QoS admission by the envelope's traffic-class bits (handler
+            # ABI v3 threads `flags` through): a tagged peer is admitted
+            # as its declared class; untagged ops classify by method name
+            # (default_class_for) inside the controller
+            from tpu3fs.qos.core import class_from_flags
+
+            tclass = class_from_flags(flags)
             lease = None
             if self._admission is not None \
                     and service_id not in self._admission_exempt:
                 from tpu3fs.qos.core import format_retry_after
 
                 lease, shed_ms = self._admission.try_admit(
-                    service.name, mdef.name, None)
+                    service.name, mdef.name, tclass)
                 if lease is None:
                     return self._err(
                         out_msg, Code.OVERLOADED,
@@ -363,11 +422,21 @@ class NativeRpcServer:
                 except Exception as e:
                     return self._err(out_msg, Code.RPC_BAD_REQUEST, repr(e))
                 try:
-                    if mdef.bulk:
-                        rsp, reply_iovs = mdef.handler(req, bulk)
-                    else:
-                        rsp = mdef.handler(req)
-                        reply_iovs = None
+                    # restore the peer's class around the handler so
+                    # service internals (update-queue scheduling, read
+                    # gates) see the tag — mirrors RpcServer._dispatch
+                    import contextlib
+
+                    from tpu3fs.qos.core import tagged
+
+                    ctx = (tagged(tclass) if tclass is not None
+                           else contextlib.nullcontext())
+                    with ctx:
+                        if mdef.bulk:
+                            rsp, reply_iovs = mdef.handler(req, bulk)
+                        else:
+                            rsp = mdef.handler(req)
+                            reply_iovs = None
                     raw = serialize(rsp, mdef.rsp_type)
                 except FsError as e:
                     return self._err(out_msg, e.code, e.status.message)
@@ -451,31 +520,12 @@ class NativeRpcClient:
                                 req_type=req_type)
         return rsp
 
-    def call_bulk(
-        self,
-        addr: Tuple[str, int],
-        service_id: int,
-        method_id: int,
-        req: Any,
-        rsp_type: Type,
-        *,
-        req_type: Optional[Type] = None,
-        bulk_iovs=None,
-    ):
-        """call() with bulk riders both ways -> (rsp, reply_segments|None).
-        Request buffers are handed to the native writev as raw pointers —
-        zero-copy for bytes; reply segments are memoryviews over one
-        python-owned copy of the reply section."""
+    @staticmethod
+    def _marshal_req(req, req_type, bulk_iovs):
+        """-> (raw, c buffer, iov arrays, n_iovs, keepalive list)."""
         raw = serialize(req, req_type or type(req))
         buf = (ctypes.c_uint8 * max(len(raw), 1)).from_buffer_copy(
             raw or b"\x00")
-        status = ctypes.c_int64(0)
-        rsp_ptr = ctypes.POINTER(ctypes.c_uint8)()
-        rsp_len = ctypes.c_size_t(0)
-        bulk_ptr = ctypes.POINTER(ctypes.c_uint8)()
-        bulk_len = ctypes.c_size_t(0)
-        has_bulk = ctypes.c_int(0)
-        msg_ptr = ctypes.c_char_p()
         n_iovs = -1
         iov_ptrs = None
         iov_lens = None
@@ -493,15 +543,76 @@ class NativeRpcClient:
                 arr_l[i] = len(b)
             iov_ptrs = arr_p
             iov_lens = arr_l
+        return raw, buf, iov_ptrs, iov_lens, n_iovs, keepalive
+
+    def _unmarshal_reply(self, status, rsp_ptr, rsp_len, bulk_ptr, bulk_off,
+                         bulk_len, has_bulk, msg_ptr, rsp_type):
+        section = None
+        try:
+            if has_bulk.value:
+                # ZERO-COPY hand-off: bulk_ptr is the malloc'd FRAME
+                # buffer recv'd straight from the kernel, with the raw
+                # section at bulk_off. Wrap it in place (ownership passes
+                # unconditionally); a finalizer frees the C buffer when
+                # the last memoryview dies.
+                section = _owned_c_buffer(
+                    self._lib, bulk_ptr, bulk_off.value, bulk_len.value)
+            payload = ctypes.string_at(rsp_ptr, rsp_len.value) \
+                if rsp_len.value else b""
+            message = (msg_ptr.value or b"").decode("utf-8", "replace")
+        finally:
+            self._lib.tpu3fs_rpc_free(rsp_ptr)
+            self._lib.tpu3fs_rpc_free(
+                ctypes.cast(msg_ptr, ctypes.c_void_p))
+        if status.value != int(Code.OK):
+            raise FsError(Status(Code(status.value), message))
+        segments = split_bulk(section) if section is not None else None
+        return deserialize(payload, rsp_type), segments
+
+    @staticmethod
+    def _class_flags() -> int:
+        """The calling thread's QoS class as envelope flag bits, so the
+        native server's admission (and its read fast path's per-class
+        gates) see the tag the Python transport already carries."""
+        from tpu3fs.qos.core import class_to_flags, current_class
+
+        return class_to_flags(current_class())
+
+    def call_bulk(
+        self,
+        addr: Tuple[str, int],
+        service_id: int,
+        method_id: int,
+        req: Any,
+        rsp_type: Type,
+        *,
+        req_type: Optional[Type] = None,
+        bulk_iovs=None,
+    ):
+        """call() with bulk riders both ways -> (rsp, reply_segments|None).
+        Request buffers are handed to the native writev as raw pointers —
+        zero-copy for bytes; reply segments are memoryviews over one
+        python-owned copy of the reply section."""
+        raw, buf, iov_ptrs, iov_lens, n_iovs, keepalive = \
+            self._marshal_req(req, req_type, bulk_iovs)
+        status = ctypes.c_int64(0)
+        rsp_ptr = ctypes.POINTER(ctypes.c_uint8)()
+        rsp_len = ctypes.c_size_t(0)
+        bulk_ptr = ctypes.POINTER(ctypes.c_uint8)()
+        bulk_off = ctypes.c_size_t(0)
+        bulk_len = ctypes.c_size_t(0)
+        has_bulk = ctypes.c_int(0)
+        msg_ptr = ctypes.c_char_p()
         conn = self._get_conn(addr)
         try:
-            rc = self._lib.tpu3fs_rpc_client_call2(
-                conn.handle, service_id, method_id,
+            rc = self._lib.tpu3fs_rpc_client_call3(
+                conn.handle, service_id, method_id, self._class_flags(),
                 buf, len(raw),
                 iov_ptrs, iov_lens, n_iovs,
                 ctypes.byref(status), ctypes.byref(rsp_ptr),
                 ctypes.byref(rsp_len),
-                ctypes.byref(bulk_ptr), ctypes.byref(bulk_len),
+                ctypes.byref(bulk_ptr), ctypes.byref(bulk_off),
+                ctypes.byref(bulk_len),
                 ctypes.byref(has_bulk),
                 ctypes.byref(msg_ptr),
             )
@@ -520,23 +631,81 @@ class NativeRpcClient:
             del keepalive
             if conn.lock.locked():
                 conn.lock.release()
+        return self._unmarshal_reply(status, rsp_ptr, rsp_len, bulk_ptr,
+                                     bulk_off, bulk_len, has_bulk, msg_ptr,
+                                     rsp_type)
+
+    # -- pipelined split (multi-connection striped read fan-out) -------------
+    def start_call(
+        self,
+        addr: Tuple[str, int],
+        service_id: int,
+        method_id: int,
+        req: Any,
+        rsp_type: Type,
+        *,
+        req_type: Optional[Type] = None,
+        bulk_iovs=None,
+    ):
+        """Issue the request NOW on an exclusively-leased connection and
+        return a pending handle; finish_call collects the reply. Callers
+        may start many calls (each takes its own pooled connection) before
+        finishing any — the pipelined issue of the striped read fan-out."""
+        raw, buf, iov_ptrs, iov_lens, n_iovs, keepalive = \
+            self._marshal_req(req, req_type, bulk_iovs)
+        conn = self._get_conn(addr)
         try:
-            payload = ctypes.string_at(rsp_ptr, rsp_len.value) \
-                if rsp_len.value else b""
-            message = (msg_ptr.value or b"").decode("utf-8", "replace")
-            section = None
-            if has_bulk.value:
-                section = (ctypes.string_at(bulk_ptr, bulk_len.value)
-                           if bulk_len.value else b"")
+            rc = self._lib.tpu3fs_rpc_client_send(
+                conn.handle, service_id, method_id, self._class_flags(),
+                buf, len(raw), iov_ptrs, iov_lens, n_iovs)
+        except BaseException:
+            if conn.lock.locked():
+                conn.lock.release()
+            raise
         finally:
-            self._lib.tpu3fs_rpc_free(rsp_ptr)
-            self._lib.tpu3fs_rpc_free(bulk_ptr)
-            self._lib.tpu3fs_rpc_free(
-                ctypes.cast(msg_ptr, ctypes.c_void_p))
-        if status.value != int(Code.OK):
-            raise FsError(Status(Code(status.value), message))
-        segments = split_bulk(section) if section is not None else None
-        return deserialize(payload, rsp_type), segments
+            del keepalive
+        if rc == -5:
+            conn.lock.release()
+            raise FsError(Status(Code.RPC_BAD_REQUEST,
+                                 f"{addr}: request exceeds max packet"))
+        if rc != 0:
+            self._drop_conn(addr, conn)
+            conn.lock.release()
+            # RPC_PEER_CLOSED: the same code the monolithic call maps send
+            # failures to, so retry ladders behave identically
+            raise FsError(Status(Code.RPC_PEER_CLOSED,
+                                 f"{addr}: transport rc={rc}"))
+        return (addr, conn, rsp_type)
+
+    def finish_call(self, pending):
+        """Collect the reply of a start_call -> (rsp, segments|None)."""
+        addr, conn, rsp_type = pending
+        status = ctypes.c_int64(0)
+        rsp_ptr = ctypes.POINTER(ctypes.c_uint8)()
+        rsp_len = ctypes.c_size_t(0)
+        bulk_ptr = ctypes.POINTER(ctypes.c_uint8)()
+        bulk_off = ctypes.c_size_t(0)
+        bulk_len = ctypes.c_size_t(0)
+        has_bulk = ctypes.c_int(0)
+        msg_ptr = ctypes.c_char_p()
+        try:
+            rc = self._lib.tpu3fs_rpc_client_recv(
+                conn.handle,
+                ctypes.byref(status), ctypes.byref(rsp_ptr),
+                ctypes.byref(rsp_len),
+                ctypes.byref(bulk_ptr), ctypes.byref(bulk_off),
+                ctypes.byref(bulk_len),
+                ctypes.byref(has_bulk), ctypes.byref(msg_ptr))
+            if rc != 0:
+                self._drop_conn(addr, conn)
+                code = Code.RPC_TIMEOUT if rc == -2 else Code.RPC_PEER_CLOSED
+                raise FsError(Status(code, f"{addr}: transport rc={rc}"))
+        finally:
+            if conn.lock.locked():
+                conn.lock.release()
+        return self._unmarshal_reply(status, rsp_ptr, rsp_len, bulk_ptr,
+                                     bulk_off, bulk_len, has_bulk, msg_ptr,
+                                     rsp_type)
 
     def close(self) -> None:
         with self._lock:

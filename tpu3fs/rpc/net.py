@@ -537,6 +537,27 @@ class RpcClient:
         """call() with bulk riders both ways -> (rsp, reply_segments|None).
         Request `bulk_iovs` buffers are gathered into the socket without
         copies; reply segments are memoryviews over one receive buffer."""
+        pending = self.start_call(addr, service_id, method_id, req, rsp_type,
+                                  req_type=req_type, bulk_iovs=bulk_iovs)
+        return self.finish_call(pending)
+
+    def start_call(
+        self,
+        addr: Tuple[str, int],
+        service_id: int,
+        method_id: int,
+        req: Any,
+        rsp_type: Type,
+        *,
+        req_type: Optional[Type] = None,
+        bulk_iovs=None,
+    ):
+        """Issue the request NOW on an exclusively-leased pooled connection
+        and return a pending handle for finish_call. Starting many calls
+        before finishing any is the pipelined multi-connection fan-out of
+        the read path: each start takes its OWN connection (the pool grows
+        on demand), so the server works on every request concurrently
+        while the client is still issuing."""
         from tpu3fs.qos.core import class_to_flags, current_class
 
         pkt = MessagePacket(
@@ -552,13 +573,34 @@ class RpcClient:
         )
         pkt.timestamps.client_build = time.monotonic()
         conn = self._get_conn(addr)
+        # the connection must not return to the pool until the stream is
+        # known to be in sync (uuid validated in finish_call) — releasing
+        # earlier would let another thread claim a connection we may still
+        # drop/close
         try:
-            # the connection must not return to the pool until the stream is
-            # known to be in sync (uuid validated) — releasing earlier would
-            # let another thread claim a connection we may still drop/close
+            pkt.timestamps.client_send = time.monotonic()
+            _send_packet(conn.sock, pkt, conn.write_lock, bulk_iovs)
+        except FsError:
+            # sizing error found before any bytes hit the wire: the
+            # connection is healthy — return it to the pool
+            conn.lock.release()
+            raise
+        except (ConnectionError, OSError, socket.timeout) as e:
+            self._drop_conn(addr, conn)
+            conn.lock.release()
+            # RPC_PEER_CLOSED (not SEND_FAILED): chain forwarding's
+            # RETRIABLE_FORWARD_CODES matches on it, same as before the
+            # send/recv split
+            code = (Code.RPC_TIMEOUT if isinstance(e, socket.timeout)
+                    else Code.RPC_PEER_CLOSED)
+            raise FsError(Status(code, f"{addr}: {e}"))
+        return (addr, conn, pkt, rsp_type)
+
+    def finish_call(self, pending):
+        """Collect the reply of a start_call -> (rsp, reply_segments|None)."""
+        addr, conn, pkt, rsp_type = pending
+        try:
             try:
-                pkt.timestamps.client_send = time.monotonic()
-                _send_packet(conn.sock, pkt, conn.write_lock, bulk_iovs)
                 reply, reply_bulk = _recv_packet(conn.sock)
                 reply.timestamps.client_receive = time.monotonic()
             except (ConnectionError, OSError, socket.timeout) as e:

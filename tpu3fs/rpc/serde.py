@@ -68,114 +68,257 @@ def _unzigzag(v: int) -> int:
     return (v >> 1) ^ -(v & 1)
 
 
-# -- encode -----------------------------------------------------------------
+# -- compiled codecs ---------------------------------------------------------
+# The wire format above is UNCHANGED; what changed is how it's driven.
+# The original walker re-derived get_origin/get_args per FIELD per VALUE —
+# measured ~20% of served-read CPU once payload copies were gone. Codecs
+# are now compiled once per type into closure trees (one closure per node
+# of the type tree) and cached; the hot loop runs no reflection at all.
+# Dataclass field codecs resolve lazily on first use, which also breaks
+# recursive type cycles.
 
-def _encode(buf: bytearray, value: Any, hint: Any) -> None:
+_ENCODERS: dict = {}
+_DECODERS: dict = {}
+
+
+def _uvarint_bytes(v: int) -> bytes:
+    buf = bytearray()
+    _write_uvarint(buf, v)
+    return bytes(buf)
+
+
+def _build_encoder(hint: Any):
     origin = get_origin(hint)
     if hint is int:
-        _write_uvarint(buf, _zigzag(int(value)))
-    elif hint is bool:
-        buf.append(1 if value else 0)
-    elif hint is float:
-        buf += struct.pack("<d", value)
-    elif hint is bytes:
-        _write_uvarint(buf, len(value))
-        buf += value
-    elif hint is str:
-        raw = value.encode("utf-8")
-        _write_uvarint(buf, len(raw))
-        buf += raw
-    elif isinstance(hint, type) and issubclass(hint, enum.Enum):
-        _write_uvarint(buf, _zigzag(int(value.value)))
-    elif origin in (list, tuple):
-        (elem,) = get_args(hint)[:1]
-        _write_uvarint(buf, len(value))
-        for item in value:
-            _encode(buf, item, elem)
-    elif origin is dict:
-        kt, vt = get_args(hint)
-        _write_uvarint(buf, len(value))
-        for k, v in value.items():
-            _encode(buf, k, kt)
-            _encode(buf, v, vt)
-    elif origin is typing.Union:
-        args = [a for a in get_args(hint) if a is not type(None)]
-        if len(args) != 1:
-            raise TypeError(f"only Optional unions supported, got {hint}")
-        if value is None:
-            buf.append(0)
-        else:
-            buf.append(1)
-            _encode(buf, value, args[0])
-    elif dataclasses.is_dataclass(hint):
-        fields = _fields_of(hint)
-        _write_uvarint(buf, len(fields))
-        for name, fhint in fields:
-            _encode(buf, getattr(value, name), fhint)
-    else:
-        raise TypeError(f"unsupported serde type: {hint!r}")
-
-
-# -- decode -----------------------------------------------------------------
-
-def _decode(data: memoryview, pos: int, hint: Any):
-    origin = get_origin(hint)
-    if hint is int:
-        v, pos = _read_uvarint(data, pos)
-        return _unzigzag(v), pos
+        def enc_int(buf, value):
+            v = int(value)
+            v = (v << 1) ^ (v >> 63) if v < 0 else v << 1
+            while v > 0x7F:
+                buf.append((v & 0x7F) | 0x80)
+                v >>= 7
+            buf.append(v)
+        return enc_int
     if hint is bool:
-        return bool(data[pos]), pos + 1
+        return lambda buf, value: buf.append(1 if value else 0)
     if hint is float:
-        return struct.unpack_from("<d", data, pos)[0], pos + 8
+        pack = struct.Struct("<d").pack
+
+        def enc_float(buf, value):
+            buf += pack(value)
+        return enc_float
     if hint is bytes:
-        n, pos = _read_uvarint(data, pos)
-        return bytes(data[pos : pos + n]), pos + n
+        def enc_bytes(buf, value):
+            n = len(value)
+            while n > 0x7F:
+                buf.append((n & 0x7F) | 0x80)
+                n >>= 7
+            buf.append(n)
+            buf += value
+        return enc_bytes
     if hint is str:
-        n, pos = _read_uvarint(data, pos)
-        return str(data[pos : pos + n], "utf-8"), pos + n
+        def enc_str(buf, value):
+            raw = value.encode("utf-8")
+            n = len(raw)
+            while n > 0x7F:
+                buf.append((n & 0x7F) | 0x80)
+                n >>= 7
+            buf.append(n)
+            buf += raw
+        return enc_str
     if isinstance(hint, type) and issubclass(hint, enum.Enum):
-        v, pos = _read_uvarint(data, pos)
-        return hint(_unzigzag(v)), pos
+        def enc_enum(buf, value):
+            v = int(value.value)
+            v = (v << 1) ^ (v >> 63) if v < 0 else v << 1
+            while v > 0x7F:
+                buf.append((v & 0x7F) | 0x80)
+                v >>= 7
+            buf.append(v)
+        return enc_enum
     if origin in (list, tuple):
         (elem,) = get_args(hint)[:1]
-        n, pos = _read_uvarint(data, pos)
-        out = []
-        for _ in range(n):
-            item, pos = _decode(data, pos, elem)
-            out.append(item)
-        return (tuple(out) if origin is tuple else out), pos
+        elem_enc = _encoder_for(elem)
+
+        def enc_seq(buf, value):
+            n = len(value)
+            while n > 0x7F:
+                buf.append((n & 0x7F) | 0x80)
+                n >>= 7
+            buf.append(n)
+            for item in value:
+                elem_enc(buf, item)
+        return enc_seq
     if origin is dict:
         kt, vt = get_args(hint)
-        n, pos = _read_uvarint(data, pos)
-        out = {}
-        for _ in range(n):
-            k, pos = _decode(data, pos, kt)
-            v, pos = _decode(data, pos, vt)
-            out[k] = v
-        return out, pos
+        kenc = _encoder_for(kt)
+        venc = _encoder_for(vt)
+
+        def enc_dict(buf, value):
+            _write_uvarint(buf, len(value))
+            for k, v in value.items():
+                kenc(buf, k)
+                venc(buf, v)
+        return enc_dict
     if origin is typing.Union:
         args = [a for a in get_args(hint) if a is not type(None)]
         if len(args) != 1:
             raise TypeError(f"only Optional unions supported, got {hint}")
-        present = data[pos]
-        pos += 1
-        if not present:
-            return None, pos
-        return _decode(data, pos, args[0])
+        inner = _encoder_for(args[0])
+
+        def enc_opt(buf, value):
+            if value is None:
+                buf.append(0)
+            else:
+                buf.append(1)
+                inner(buf, value)
+        return enc_opt
     if dataclasses.is_dataclass(hint):
-        nfields, pos = _read_uvarint(data, pos)
         fields = _fields_of(hint)
-        kwargs = {}
-        for i, (name, fhint) in enumerate(fields):
-            if i >= nfields:
-                break  # decoder is newer: default the missing trailing fields
-            val, pos = _decode(data, pos, fhint)
-            kwargs[name] = val
-        # encoder newer than decoder: skip unknown trailing fields is not
-        # possible without self-describing wire; enforce at call sites by
-        # only appending fields (same rule as the reference).
-        return hint(**kwargs), pos
+        header = _uvarint_bytes(len(fields))
+        state: list = []
+
+        def enc_dc(buf, value):
+            if not state:  # lazy: breaks recursive type cycles
+                state.append([(n, _encoder_for(h)) for n, h in fields])
+            buf += header
+            for name, fenc in state[0]:
+                fenc(buf, getattr(value, name))
+        return enc_dc
     raise TypeError(f"unsupported serde type: {hint!r}")
+
+
+def _encoder_for(hint: Any):
+    try:
+        return _ENCODERS[hint]
+    except (KeyError, TypeError):
+        pass
+    enc = _build_encoder(hint)
+    try:
+        _ENCODERS[hint] = enc
+    except TypeError:
+        pass  # unhashable hint: rebuilt per use (not seen in practice)
+    return enc
+
+
+def _build_decoder(hint: Any):
+    origin = get_origin(hint)
+    if hint is int:
+        def dec_int(data, pos):
+            shift = 0
+            out = 0
+            while True:
+                b = data[pos]
+                pos += 1
+                out |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    return (out >> 1) ^ -(out & 1), pos
+                shift += 7
+        return dec_int
+    if hint is bool:
+        return lambda data, pos: (bool(data[pos]), pos + 1)
+    if hint is float:
+        unpack_from = struct.Struct("<d").unpack_from
+
+        def dec_float(data, pos):
+            return unpack_from(data, pos)[0], pos + 8
+        return dec_float
+    if hint is bytes:
+        def dec_bytes(data, pos):
+            n, pos = _read_uvarint(data, pos)
+            return bytes(data[pos:pos + n]), pos + n
+        return dec_bytes
+    if hint is str:
+        def dec_str(data, pos):
+            n, pos = _read_uvarint(data, pos)
+            return str(data[pos:pos + n], "utf-8"), pos + n
+        return dec_str
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        def dec_enum(data, pos):
+            v, pos = _read_uvarint(data, pos)
+            return hint((v >> 1) ^ -(v & 1)), pos
+        return dec_enum
+    if origin in (list, tuple):
+        (elem,) = get_args(hint)[:1]
+        elem_dec = _decoder_for(elem)
+        as_tuple = origin is tuple
+
+        def dec_seq(data, pos):
+            n, pos = _read_uvarint(data, pos)
+            out = []
+            append = out.append
+            for _ in range(n):
+                item, pos = elem_dec(data, pos)
+                append(item)
+            return (tuple(out) if as_tuple else out), pos
+        return dec_seq
+    if origin is dict:
+        kt, vt = get_args(hint)
+        kdec = _decoder_for(kt)
+        vdec = _decoder_for(vt)
+
+        def dec_dict(data, pos):
+            n, pos = _read_uvarint(data, pos)
+            out = {}
+            for _ in range(n):
+                k, pos = kdec(data, pos)
+                v, pos = vdec(data, pos)
+                out[k] = v
+            return out, pos
+        return dec_dict
+    if origin is typing.Union:
+        args = [a for a in get_args(hint) if a is not type(None)]
+        if len(args) != 1:
+            raise TypeError(f"only Optional unions supported, got {hint}")
+        inner = _decoder_for(args[0])
+
+        def dec_opt(data, pos):
+            present = data[pos]
+            pos += 1
+            if not present:
+                return None, pos
+            return inner(data, pos)
+        return dec_opt
+    if dataclasses.is_dataclass(hint):
+        fields = _fields_of(hint)
+        state: list = []
+
+        def dec_dc(data, pos):
+            if not state:  # lazy: breaks recursive type cycles
+                state.append([(n, _decoder_for(h)) for n, h in fields])
+            nfields, pos = _read_uvarint(data, pos)
+            kwargs = {}
+            for i, (name, fdec) in enumerate(state[0]):
+                if i >= nfields:
+                    break  # decoder newer: default missing trailing fields
+                kwargs[name], pos = fdec(data, pos)
+            # encoder newer than decoder: skipping unknown trailing fields
+            # is not possible without self-describing wire; enforce at
+            # call sites by only appending fields (same reference rule).
+            return hint(**kwargs), pos
+        return dec_dc
+    raise TypeError(f"unsupported serde type: {hint!r}")
+
+
+def _decoder_for(hint: Any):
+    try:
+        return _DECODERS[hint]
+    except (KeyError, TypeError):
+        pass
+    dec = _build_decoder(hint)
+    try:
+        _DECODERS[hint] = dec
+    except TypeError:
+        pass
+    return dec
+
+
+# -- encode / decode (compat shims over the compiled codecs) -----------------
+
+def _encode(buf: bytearray, value: Any, hint: Any) -> None:
+    _encoder_for(hint)(buf, value)
+
+
+def _decode(data: memoryview, pos: int, hint: Any):
+    return _decoder_for(hint)(data, pos)
 
 
 _FIELD_CACHE: dict = {}
@@ -194,12 +337,12 @@ def _fields_of(cls) -> list:
 
 def serialize(value: Any, hint: Optional[Any] = None) -> bytes:
     buf = bytearray()
-    _encode(buf, value, hint if hint is not None else type(value))
+    _encoder_for(hint if hint is not None else type(value))(buf, value)
     return bytes(buf)
 
 
 def deserialize(data: bytes, hint: Type[T]) -> T:
-    value, pos = _decode(memoryview(data), 0, hint)
+    value, pos = _decoder_for(hint)(memoryview(data), 0)
     if pos != len(data):
         raise ValueError(f"trailing bytes after decode: {len(data) - pos}")
     return value
@@ -210,7 +353,7 @@ def deserialize_prefix(data, hint: Type[T]):
     -> (value, bytes_consumed). Trailing bytes are the caller's business —
     the bulk-framed RPC transport rides raw payload sections after the
     envelope (the RDMA-batch analogue, ref IBSocket.h:155-229)."""
-    value, pos = _decode(memoryview(data), 0, hint)
+    value, pos = _decoder_for(hint)(memoryview(data), 0)
     return value, pos
 
 

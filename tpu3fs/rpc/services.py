@@ -222,14 +222,17 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
         return h
 
     def _read_h(r, bulk):
-        rsp = svc.read(r)
+        # bulk mode rides the zero-copy serving path: engine hands out
+        # buffer views, the transport gathers them into the socket — the
+        # reply payload is never copied into the serde envelope
         if bulk is None:
-            return rsp, None
+            return svc.read(r), None
+        rsp = svc.batch_read([r], views=True)[0]
         ctrl, data = _detach(rsp)
         return ctrl, [data]
 
     def _batch_read_h(r, bulk):
-        replies = svc.batch_read(r.reqs)
+        replies = svc.batch_read(r.reqs, views=bulk is not None)
         if bulk is None:
             return BatchReadRsp(replies), None
         ctrls, iovs = [], []
@@ -306,12 +309,113 @@ class RpcMessenger:
         # A/B lever: TPU3FS_RPC_INLINE=1 turns bulk framing off so the
         # two wire forms can be benchmarked against each other
         self._bulk = os.environ.get("TPU3FS_RPC_INLINE", "") != "1"
+        # striped read fan-out: a node group whose estimated payload
+        # clears the threshold is split into up to TPU3FS_READ_STRIPES
+        # sub-batches, each pipelined on its OWN pooled connection — the
+        # server's workers run the stripes concurrently and the replies
+        # stream back in parallel instead of serializing on one socket
+        # threshold tuned on the rpc storage_bench: sub-MiB stripes cost
+        # more in per-RPC serde/GIL than they win in parallelism, so only
+        # multi-MiB node groups (ckpt restore, large batch loads) split
+        self._stripes = max(1, int(os.environ.get(
+            "TPU3FS_READ_STRIPES", "4")))
+        self._stripe_min_bytes = int(os.environ.get(
+            "TPU3FS_READ_STRIPE_MIN", str(4 << 20)))
 
     def _addr(self, node_id: int) -> Tuple[str, int]:
         node = self._routing().nodes.get(node_id)
         if node is None or not node.host:
             raise FsError(Status(Code.RPC_CONNECT_FAILED, f"no address for node {node_id}"))
         return node.host, node.port
+
+    @staticmethod
+    def _attach_read_segs(replies, segs):
+        """Re-attach bulk segments as reply data — ZERO-COPY: each .data
+        is a memoryview over the transport's receive buffer, which stays
+        alive exactly as long as the views do. Consumers that retain
+        replies beyond the request must copy (bytes(data))."""
+        if not segs:
+            return replies
+        return [replace(rp, data=seg) if len(seg) else rp
+                for rp, seg in zip(replies, segs)]
+
+    def _stripe_spans(self, reqs) -> List[Tuple[int, int]]:
+        """Split one node group into contiguous stripe spans. Groups below
+        2x the stripe threshold stay whole (a tiny stripe pays more in
+        per-RPC overhead than it wins in parallelism)."""
+        n = len(reqs)
+        if n <= 1 or self._stripes <= 1:
+            return [(0, n)]
+        est = sum(
+            r.length if r.length >= 0 else (r.chunk_size or (1 << 20))
+            for r in reqs)
+        if est < 2 * self._stripe_min_bytes:
+            return [(0, n)]
+        k = min(self._stripes, n,
+                max(1, est // self._stripe_min_bytes))
+        base, rem = divmod(n, k)
+        spans, lo = [], 0
+        for i in range(k):
+            hi = lo + base + (1 if i < rem else 0)
+            spans.append((lo, hi))
+            lo = hi
+        return spans
+
+    def batch_read_pipelined(self, groups):
+        """Striped, pipelined batch-read fan-out: `groups` is
+        [(node_id, [ReadReq, ...])]. Every group is split into stripes
+        (each a BatchRead RPC on its own pooled connection), ALL requests
+        are issued before any reply is collected — so the last node's
+        stripes are on the wire while the first node is still reading —
+        then replies are collected in issue order. -> per-group reply
+        lists aligned with the input reqs; ops a stripe failed for carry
+        the transport error code as their reply."""
+        pend = []     # (group idx, span lo, span hi, pending | FsError)
+        results = [[None] * len(reqs) for _, reqs in groups]
+        c = self._client
+        for gi, (node_id, reqs) in enumerate(groups):
+            try:
+                addr = self._addr(node_id)
+            except FsError as e:
+                pend.append((gi, 0, len(reqs), e))
+                continue
+            if not self._bulk:
+                # inline wire form: one unstriped call per group (the A/B
+                # lever measures framing, not fan-out)
+                try:
+                    pend.append((gi, 0, len(reqs), c.start_call(
+                        addr, STORAGE_SERVICE_ID, 11, BatchReadReq(reqs),
+                        BatchReadRsp)))
+                except FsError as e:
+                    pend.append((gi, 0, len(reqs), e))
+                continue
+            for lo, hi in self._stripe_spans(reqs):
+                try:
+                    pend.append((gi, lo, hi, c.start_call(
+                        addr, STORAGE_SERVICE_ID, 11,
+                        BatchReadReq(reqs[lo:hi]), BatchReadRsp,
+                        bulk_iovs=())))
+                except FsError as e:
+                    pend.append((gi, lo, hi, e))
+        for gi, lo, hi, p in pend:
+            if isinstance(p, FsError):
+                err = p
+            else:
+                try:
+                    rsp, segs = c.finish_call(p)
+                    replies = self._attach_read_segs(rsp.replies, segs)
+                    results[gi][lo:lo + len(replies)] = replies
+                    continue
+                except FsError as e:
+                    err = e
+            for i in range(lo, min(hi, len(results[gi]))):
+                if results[gi][i] is None:
+                    results[gi][i] = ReadReply(err.code)
+        for out in results:
+            for i, r in enumerate(out):
+                if r is None:  # short reply list from a confused server
+                    out[i] = ReadReply(Code.RPC_PEER_CLOSED)
+        return results
 
     def _one_write(self, addr, method_id: int, op):
         """Single write-ish op: the chunk payload rides the bulk section,
@@ -351,10 +455,11 @@ class RpcMessenger:
             # empty bulk section = "I speak bulk; reply with data in bulk"
             rsp, segs = c.call_bulk(addr, sid, 3, payload, ReadReply,
                                     bulk_iovs=())
-            if segs:
-                # owned copy: .data must stay bytes for every consumer
-                # (slicing, ljust, joins) — the ONE copy on this path
-                rsp = replace(rsp, data=bytes(segs[0]))
+            if segs and len(segs[0]):
+                # ZERO-COPY hand-off: .data is a memoryview over the
+                # transport's receive buffer (alive as long as the view);
+                # consumers that retain replies must copy (bytes(data))
+                rsp = replace(rsp, data=segs[0])
             return rsp
         if method == "dump_chunkmeta":
             return c.call(addr, sid, 4, TargetIdReq(payload), ChunkMetaList).metas
@@ -381,11 +486,7 @@ class RpcMessenger:
                               BatchReadRsp).replies
             rsp, segs = c.call_bulk(addr, sid, 11, BatchReadReq(payload),
                                     BatchReadRsp, bulk_iovs=())
-            replies = rsp.replies
-            if segs:
-                replies = [replace(rp, data=bytes(seg))
-                           for rp, seg in zip(replies, segs)]
-            return replies
+            return self._attach_read_segs(rsp.replies, segs)
         if method == "batch_write":
             return self._batch_write(addr, 12, payload, BatchWriteReq)
         if method == "write_shard":
@@ -444,7 +545,8 @@ class MgmtdRpcClient:
         Code.RPC_SEND_FAILED, Code.MGMTD_NOT_PRIMARY,
     )
 
-    def __init__(self, addr, client: Optional[RpcClient] = None):
+    def __init__(self, addr, client: Optional[RpcClient] = None, *,
+                 routing_ttl_s: float = 0.0):
         try:
             if (isinstance(addr, (tuple, list)) and len(addr) == 2
                     and isinstance(addr[0], str)):
@@ -461,6 +563,15 @@ class MgmtdRpcClient:
         self._cursor = 0
         self._client = client or RpcClient()
         self._routing: Optional[RoutingInfo] = None
+        # refresh_routing TTL: with ttl 0 (default) every call is an RPC
+        # (legacy behavior); a positive ttl serves the cached snapshot and
+        # only polls mgmtd when it expires — data-plane hot paths resolve
+        # node addresses on EVERY op, and one getRoutingInfo round trip
+        # per read was a measured double-digit share of served-read time.
+        # Retry ladders call invalidate_routing() before re-resolving, so
+        # failover convergence does not wait out the TTL.
+        self._routing_ttl_s = float(routing_ttl_s)
+        self._routing_ts = float("-inf")
 
     @property
     def _addr(self):  # sticky current server (back-compat accessor)
@@ -497,7 +608,18 @@ class MgmtdRpcClient:
         )
         return self._call(1, req, HeartbeatReply)
 
+    def invalidate_routing(self) -> None:
+        """Expire the TTL cache now: the next refresh_routing polls mgmtd.
+        Called by retry ladders before re-resolving a failed op."""
+        self._routing_ts = float("-inf")
+
     def refresh_routing(self) -> RoutingInfo:
+        import time as _time
+
+        if (self._routing is not None and self._routing_ttl_s > 0
+                and _time.monotonic() - self._routing_ts
+                < self._routing_ttl_s):
+            return self._routing
         known = self._routing.version if self._routing else -1
         rsp = self._call(2, RoutingReq(known), RoutingRsp)
         if rsp.changed and rsp.routing is not None:
@@ -507,6 +629,7 @@ class MgmtdRpcClient:
             if self._routing is None or \
                     rsp.routing.version > self._routing.version:
                 self._routing = rsp.routing
+        self._routing_ts = _time.monotonic()
         assert self._routing is not None
         return self._routing
 

@@ -1070,11 +1070,18 @@ class StorageService:
         tclass = current_class(default_class)
         return self._qos.try_admit("storage", "read", tclass, cost)
 
-    def batch_read(self, reqs: List[ReadReq]) -> List[ReadReply]:
+    def batch_read(self, reqs: List[ReadReq], *,
+                   views: bool = False) -> List[ReadReply]:
         """Many reads in ONE request. Ops are grouped per local target and
         executed as ONE engine crossing per group — the loop runs in the
         native engine with the GIL released (the reference's 32-thread AIO
-        pool analogue, AioReadWorker.h:27-29)."""
+        pool analogue, AioReadWorker.h:27-29).
+
+        views=True is the zero-copy serving mode (RPC bulk replies): data
+        fields may be memoryviews over engine-owned/per-call buffers,
+        gathered straight into the socket by the transport — callers that
+        RETAIN replies past the request must copy. The in-process fabric
+        path keeps views=False (plain bytes)."""
         from tpu3fs.qos.core import TrafficClass
 
         lease, shed_ms = self._admit_read(TrafficClass.FG_READ,
@@ -1084,12 +1091,13 @@ class StorageService:
             return [ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
                     for _ in reqs]
         try:
-            return self._batch_read_impl(reqs)
+            return self._batch_read_impl(reqs, views=views)
         finally:
             if lease is not None:
                 lease.release()
 
-    def _batch_read_impl(self, reqs: List[ReadReq]) -> List[ReadReply]:
+    def _batch_read_impl(self, reqs: List[ReadReq], *,
+                         views: bool = False) -> List[ReadReply]:
         replies: List[Optional[ReadReply]] = [None] * len(reqs)
         groups: Dict[int, List[int]] = {}
         for i, req in enumerate(reqs):
@@ -1107,7 +1115,9 @@ class StorageService:
                 (reqs[i].chunk_id, reqs[i].offset, reqs[i].length)
                 for i in idxs
             ]
-            outs = target.engine.batch_read(items, target.chunk_size)
+            read_fn = (target.engine.batch_read_views if views
+                       else target.engine.batch_read)
+            outs = read_fn(items, target.chunk_size)
             for i, (code, data, ver, crc, aux) in zip(idxs, outs):
                 if code == Code.OK:
                     self._read_rec.succeeded.add()

@@ -232,6 +232,18 @@ class ChunkEngine(abc.ABC):
                 out.append((e.code, b"", 0, 0, 0))
         return out
 
+    def batch_read_views(
+        self, items: List[Tuple[ChunkId, int, int]], cap: int
+    ) -> List[Tuple[Code, object, int, int, int]]:
+        """batch_read whose data entries may be OWNED buffer views
+        (memoryview/bytes) instead of fresh bytes — the zero-copy read
+        path: the RPC reply gathers these straight into the socket without
+        a serde-payload copy. The buffers must stay valid for as long as
+        the caller holds the views (engines return views only over
+        immutable or per-call-owned memory, NEVER over reused scratch).
+        Default: plain batch_read (bytes are views of themselves)."""
+        return self.batch_read(items, cap)
+
 
 @dataclass
 class _Slot:
@@ -287,6 +299,34 @@ class MemChunkEngine(ChunkEngine):
             else:
                 crc = Checksum.of(data).value
             return data, meta.committed_ver, crc, meta.aux
+
+    def batch_read_views(self, items, cap: int):
+        """Zero-copy batch read: data entries are memoryviews over the
+        slots' committed bytes. Safe because committed content is
+        IMMUTABLE — an overwrite installs a NEW bytes object (the old one
+        stays alive as long as any view does), it never mutates in place.
+        """
+        out = []
+        with self._lock:
+            for chunk_id, offset, length in items:
+                slot = self._slot(chunk_id)
+                if slot is None:
+                    out.append((Code.CHUNK_NOT_FOUND, b"", 0, 0, 0))
+                    continue
+                meta = slot.meta
+                if meta.committed_ver == 0:
+                    out.append((Code.CHUNK_NOT_COMMIT, b"", 0, 0, 0))
+                    continue
+                mv = memoryview(slot.committed)
+                data = mv[offset:] if length < 0 \
+                    else mv[offset:offset + length]
+                if offset == 0 and len(data) == meta.length:
+                    crc = meta.checksum.value   # checksum reuse
+                else:
+                    crc = Checksum.of(data).value
+                out.append((Code.OK, data, meta.committed_ver, crc,
+                            meta.aux))
+        return out
 
     # -- updates (COW + version algebra) -------------------------------------
     def update(

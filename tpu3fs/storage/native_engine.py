@@ -498,6 +498,58 @@ class NativeChunkEngine(ChunkEngine):
                 out[i] = (e.code, b"", 0, 0, 0)
         return out
 
+    def batch_read_views(self, items, cap: int):
+        """Zero-copy variant for the served read path: one C crossing into
+        a FRESH per-call buffer (not the reused per-thread scratch — views
+        over scratch would alias the next batch), data handed out as
+        memoryviews over it. The buffer's ownership passes to the views;
+        GC reclaims it when the reply is dropped. Costs exactly one copy
+        (engine mmap -> buffer); the transport writev's the views straight
+        to the socket, so the scratch->bytes copy of batch_read is gone.
+        """
+        n = len(items)
+        if n == 0:
+            return []
+        c_ops = (_CReadOp * n)()
+        total = 0
+        offs = []
+        for i, (chunk_id, offset, length) in enumerate(items):
+            c = c_ops[i]
+            ctypes.memmove(c.key, chunk_id.to_bytes(), _KEYLEN)
+            c.out_off = total
+            c.offset = offset
+            c.length = length
+            c.slot_len = cap if length < 0 else min(length, cap)
+            offs.append(total)
+            total += c.slot_len
+        buf = bytearray(total or 1)
+        cbuf = (ctypes.c_char * len(buf)).from_buffer(buf)
+        res = (_COpResult * n)()
+        _check(self._lib.ce_batch_read(
+            self._h, c_ops, cbuf, len(buf), res, n), "batch_read")
+        del cbuf  # release the exported-buffer hold before views escape
+        mv = memoryview(buf)
+        out = []
+        for i in range(n):
+            r = res[i]
+            if r.rc == -10:
+                # committed content outgrew the per-op cap: exact re-read
+                # (bytes, not a view — correctness over zero-copy here)
+                try:
+                    chunk_id, offset, length = items[i]
+                    out.append((Code.OK,) + self.read_verified(
+                        chunk_id, offset, length))
+                except FsError as e:
+                    out.append((e.code, b"", 0, 0, 0))
+            elif r.rc != 0:
+                out.append((_ERR_TO_CODE.get(r.rc, Code.ENGINE_ERROR),
+                            b"", 0, 0, 0))
+            else:
+                off = offs[i]
+                out.append((Code.OK, mv[off:off + r.len], r.ver, r.crc,
+                            r.aux))
+        return out
+
     def close(self) -> None:
         if self._h:
             self._lib.ce_close(self._h)

@@ -21,6 +21,19 @@ import threading
 from typing import Dict, List
 
 
+def _alloc(n: int):
+    """An UNINITIALIZED writable buffer of n bytes. numpy.empty skips the
+    page-zeroing a fresh bytearray pays — receive buffers are filled by
+    recv_into before any byte is read, so zeroing was pure memory traffic
+    (measured ~13% of served-read client time at 256 KiB chunks)."""
+    try:
+        import numpy as np
+
+        return np.empty(n, dtype=np.uint8)
+    except ImportError:  # minimal envs: correctness over the zeroing cost
+        return bytearray(n)
+
+
 def _class_of(n: int) -> int:
     """Smallest power-of-two >= n (min 4 KiB) — the pooling size class."""
     size = 4096
@@ -43,22 +56,24 @@ class BufferPool:
         self.hits = 0
         self.misses = 0
 
-    def acquire(self, n: int) -> bytearray:
-        """A bytearray of len >= n (callers track their own exact length)."""
+    def acquire(self, n: int):
+        """A writable buffer of len >= n (callers track their own exact
+        length). May be a numpy uint8 array (uninitialized — see _alloc)
+        or a bytearray; both support len/memoryview/recv_into."""
         cls = _class_of(n)
         if cls > self._max_class_bytes:
             with self._mu:
                 self.misses += 1
-            return bytearray(n)
+            return _alloc(n)
         with self._mu:
             free = self._free.get(cls)
             if free:
                 self.hits += 1
                 return free.pop()
             self.misses += 1
-        return bytearray(cls)
+        return _alloc(cls)
 
-    def release(self, buf: bytearray) -> None:
+    def release(self, buf) -> None:
         """Return a lease. ONLY for buffers with no escaped memoryviews."""
         cls = len(buf)
         # non-class-sized buffers were allocated fresh (oversize path)
