@@ -5,6 +5,7 @@ storage_bench reuses UnitTestFabric; the fio plugin builds in CI) — these
 keep ours importable and correct without measuring anything."""
 
 from benchmarks.ckpt_bench import run_bench as ckpt_bench
+from benchmarks.dataload_bench import run_bench as dataload_bench
 from benchmarks.rebuild_bench import run_bench as rebuild_bench
 from benchmarks.storage_bench import run_bench as storage_bench
 from benchmarks.usrbio_bench import run_bench as usrbio_bench
@@ -83,6 +84,26 @@ class TestCkptBench:
             assert row[f"{label}_async_step_stall_ms"] <= \
                 row[f"{label}_sync_save_ms"] * 2.0 + 5.0
         assert row["cr_reshard_restore_gibps"] > 0
+
+
+class TestDataloadBench:
+    """benchmarks/dataload_bench fast-mode smoke: the harness runs over
+    real sockets, every reported field lands, data is verified inside
+    (per-record CRC), and resume-from-state is EXACT."""
+
+    def test_small_run(self):
+        row = dataload_bench(total_mb=1, record_kbs=(16,), batch=8,
+                             depth=2, chains=2, replicas=2)
+        p = "r16k"
+        assert row["value"] > 0
+        assert row[f"{p}_records"] >= 64
+        assert row[f"{p}_naive_samples_s"] > 0
+        assert row[f"{p}_shuffled_samples_s"] > 0
+        assert row[f"{p}_seq_samples_s"] > 0
+        assert row[f"{p}_train_samples_s"] > 0
+        assert row[f"{p}_resume_exact"] is True
+        for d in (1, 2, 4):
+            assert row[f"{p}_depth{d}_samples_s"] > 0
 
 
 class TestReadBench:

@@ -462,6 +462,58 @@ class TestCliAndDaemon:
         assert "evicted=2" in out.getvalue()
         assert mgr.steps() == [3]
 
+    def test_gc_daemon_auto_archives_cold_steps(self):
+        """ROADMAP follow-up: the daemon tick archives steps older than
+        the newest N onto EC chains — no explicit archive calls — and
+        the sweep is idempotent (already-EC steps are skipped)."""
+        import io
+
+        from tpu3fs.bin.ckpt_gc_main import parse_args, run_loop
+
+        fab = _fabric(num_storage_nodes=4)
+        ec_layout = _add_ec_chain(fab)
+        mgr = _manager(fab)
+        rng = np.random.default_rng(23)
+        tree = {"w": rng.standard_normal((32, 16)).astype(np.float32)}
+        for step in (1, 2, 3, 4):
+            mgr.save(tree, step)
+        args = parse_args([
+            "--once", "--keep-last", "10", "--archive-after", "2",
+            "--archive-ec-k", "3", "--archive-ec-m", "1",
+            "--archive-chunk-size", str(CHUNK)])
+        out = io.StringIO()
+        run_loop(fab, args, out=out)
+        assert "archived=2" in out.getvalue()
+        assert mgr.steps() == [1, 2, 3, 4]  # archived, not evicted
+        # cold steps moved onto the EC chain; hot ones stayed replicated
+        for step, chains in ((1, ec_layout.chains), (2, ec_layout.chains)):
+            ino = fab.meta.stat(f"{mgr.root}/{step}/l0.s0")
+            assert ino.layout.chains == chains, step
+        for step in (3, 4):
+            ino = fab.meta.stat(f"{mgr.root}/{step}/l0.s0")
+            assert ino.layout.chains != ec_layout.chains, step
+        # restores read through the EC stripes
+        assert np.array_equal(mgr.restore(1)["w"], tree["w"])
+        # second tick: nothing new to archive (idempotent)
+        out2 = io.StringIO()
+        run_loop(fab, args, out=out2)
+        assert "archived=0" in out2.getvalue()
+
+    def test_gc_daemon_archive_skipped_without_ec_chains(self):
+        import io
+
+        from tpu3fs.bin.ckpt_gc_main import parse_args, run_loop
+
+        fab = _fabric()
+        mgr = _manager(fab)
+        tree = {"w": np.arange(16, dtype=np.float32)}
+        mgr.save(tree, 1)
+        args = parse_args(["--once", "--archive-after", "1"])
+        out = io.StringIO()
+        run_loop(fab, args, out=out)
+        assert "archive pass skipped" in out.getvalue()
+        assert mgr.steps() == [1]
+
 
 class TestMonitorRecorders:
     def test_ckpt_metrics_reach_the_monitor(self):

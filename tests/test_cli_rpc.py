@@ -70,6 +70,26 @@ def socket_cluster():
 
 
 class TestAdminCliOverSockets:
+    def test_view_storage_clients_get_unique_wire_ids(self, socket_cluster):
+        """Two storage_client() instances from one view must NOT share a
+        wire client id: the server's exactly-once channel table is keyed
+        (client id, channel, seq), and a second instance restarting its
+        channel seqs under the same id has its writes silently deduped
+        as replays (found by the live dataload drive — a fresh client's
+        state-file write 'succeeded' without landing)."""
+        view = RpcFabricView(socket_cluster["mgmtd_addr"],
+                             client_id="dup")
+        a = view.storage_client()
+        b = view.storage_client()
+        assert a.client_id != b.client_id
+        # and ids from a SECOND process-like view differ too
+        view2 = RpcFabricView(socket_cluster["mgmtd_addr"],
+                              client_id="dup")
+        assert view2.storage_client().client_id not in (
+            a.client_id, b.client_id)
+        for c in (a, b):
+            c.close()
+
     def test_ec_chain_created_via_cli_serves_stripes(self, socket_cluster):
         c = socket_cluster
         view = RpcFabricView(c["mgmtd_addr"])

@@ -75,6 +75,63 @@ class TestWorkerPool:
         assert ei.value.code == Code.SHUTTING_DOWN
 
 
+class TestContextPropagation:
+    """submit() captures the caller's contextvars: QoS class tags and
+    armed fault injection follow tasks into pool threads (fanned-out IO
+    must stay classified; armed fault points must keep firing)."""
+
+    def test_qos_class_follows_submit(self):
+        from tpu3fs.qos.core import TrafficClass, current_class, tagged
+
+        pool = WorkerPool("ctx", num_workers=2)
+        try:
+            with tagged(TrafficClass.RESYNC):
+                fut = pool.submit(lambda: current_class())
+            untagged = pool.submit(lambda: current_class())
+            assert fut.get(5) == TrafficClass.RESYNC
+            assert untagged.get(5) is None
+        finally:
+            pool.shutdown()
+
+    def test_qos_class_follows_map(self):
+        from tpu3fs.qos.core import TrafficClass, current_class, tagged
+
+        pool = WorkerPool("ctx", num_workers=3)
+        try:
+            with tagged(TrafficClass.CKPT):
+                got = pool.map(lambda _i: current_class(), range(8))
+            assert got == [TrafficClass.CKPT] * 8
+        finally:
+            pool.shutdown()
+
+    def test_fault_injection_follows_submit(self):
+        from tpu3fs.utils.fault_injection import fault_injection, inject
+
+        # one worker: the shared times budget decrements without racing,
+        # so the firing count is deterministic
+        pool = WorkerPool("ctx", num_workers=1)
+
+        def poke():
+            try:
+                inject("pool-point")
+                return "clean"
+            except FsError as e:
+                return e.code
+
+        try:
+            with fault_injection(1.0, times=2):
+                futs = [pool.submit(poke) for _ in range(4)]
+                got = [f.get(5) for f in futs]
+            # the armed injection fired in pool threads, and the SHARED
+            # times budget capped total firings at 2 across all tasks
+            assert got.count(Code.FAULT_INJECTION) == 2
+            assert got.count("clean") == 2
+            # outside the arming context nothing fires
+            assert pool.submit(poke).get(5) == "clean"
+        finally:
+            pool.shutdown()
+
+
 class TestConcurrencyLimiter:
     def test_limits_holders(self):
         lim = ConcurrencyLimiter("t", 2)

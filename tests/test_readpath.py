@@ -152,6 +152,56 @@ class TestPrefetchCorrectness:
         assert all(c == TrafficClass.CKPT for c in seen)
         fio.close()
 
+    def test_shuffled_batches_do_not_thrash_readahead(self, fab):
+        """The dataload-loader shape: sorted per-batch extents with gaps
+        and the odd file-adjacent pair. min_run alone armed (and fetched
+        a window) on EVERY adjacent pair — dozens of wasted windows per
+        epoch; the jump-fraction thrash guard must keep readahead
+        bounded to at most the cold-start window or two, fetched before
+        any jump history exists (a fresh sequential reader is
+        indistinguishable at that point)."""
+        import random as _random
+
+        nrec = 64
+        rec = CHUNK // 4
+        window = 2 * CHUNK
+        inode = _mkfile(fab, "/shuf", b"r" * (nrec * rec))
+        fio = _pfio(fab, window_bytes=window, min_run=2)
+        rng = _random.Random(17)
+        adjacent_pairs = 0
+        for _step in range(16):
+            batch = sorted(rng.sample(range(nrec), 12))
+            adjacent_pairs += sum(
+                1 for a, b in zip(batch, batch[1:]) if b - a == 1)
+            for ri in batch:
+                fio.read(inode, ri * rec, rec)
+        # the pattern really contained the adjacency that used to thrash
+        assert adjacent_pairs > 10
+        _drain(fio.prefetcher)
+        pf = fio.prefetcher
+        assert pf.prefetched_bytes._value <= 2 * window, \
+            "shuffled batches kept arming readahead (thrash)"
+        fio.close()
+
+    def test_guard_recovers_for_sequential_reader(self, fab):
+        """After a shuffled phase, a genuinely sequential scan re-arms
+        within about one history window of reads."""
+        import random as _random
+
+        inode = _mkfile(fab, "/recov", b"s" * (64 * CHUNK))
+        fio = _pfio(fab, window_bytes=2 * CHUNK, min_run=2)
+        rng = _random.Random(5)
+        offs = rng.sample(range(0, 64), 32)
+        for o in offs:
+            fio.read(inode, o * CHUNK, CHUNK // 2)
+        assert fio.prefetcher.cached_bytes() == 0
+        for off in range(0, 64 * CHUNK, CHUNK):
+            fio.read(inode, off, CHUNK)
+        _drain(fio.prefetcher)
+        assert fio.prefetcher.hits._value > 0, \
+            "sequential reader never re-armed after the shuffled phase"
+        fio.close()
+
     def test_kvcache_and_loader_paths_ride_batches(self, fab):
         """batch_read_files consults the prefetch cache and still returns
         exact contents (the kvcache.batch_get / ckpt loader path)."""

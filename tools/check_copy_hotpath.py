@@ -44,6 +44,12 @@ HOT_PATH: List[Tuple[str, List[str]]] = [
     ("tpu3fs/client/storage_client.py", ["batch_read"]),
     ("tpu3fs/client/file_io.py",
      ["read_into", "_batch_read_files_direct", "_fetch_window"]),
+    # the dataload batch-assembly hot loop: records must be sliced out of
+    # fetched spans as views and land in the batch array in ONE copy
+    ("tpu3fs/dataload/recordio.py", ["read_batch", "plan_coalesced"]),
+    ("tpu3fs/dataload/loader.py",
+     ["_fetch", "_assemble_array", "_read_with_backoff"]),
+    ("tpu3fs/dataload/dataset.py", ["read_samples"]),
 ]
 
 _BYTES_CALL = re.compile(r"(?<![\w.])bytes\s*\(")

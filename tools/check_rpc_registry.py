@@ -13,7 +13,14 @@ and verifies, per deployment unit:
    List/Tuple/Dict/Optional/dataclass);
 3. QOS CLASSIFICATION — every method name resolves to a registered
    traffic class via qos.default_class_for, so an untagged RPC can never
-   dodge admission keying.
+   dodge admission keying;
+4. TRAFFIC-CLASS WIRING — every ``TrafficClass`` member (including
+   client-side-only classes like ``ckpt``/``dataload`` that no method
+   name maps to) is fully registered: a CLASS_ATTRS name, a QosConfig
+   limits section with the full knob set, a lossless envelope-flag
+   round trip within the 4-bit wire field, and membership sets that stay
+   inside the enum. Adding an enum value without the config/flag wiring
+   fails here, not at 3am under load.
 
 Cross-binary service-id reuse (Kv and MonitorCollector both use 5) is
 reported as a note, not a failure — they never share a process.
@@ -164,16 +171,76 @@ def check_serde_type(hint, seen=None) -> List[str]:
     return [f"unsupported serde type: {hint!r}"]
 
 
+# -- traffic-class wiring ----------------------------------------------------
+
+def check_traffic_classes() -> List[str]:
+    """Every TrafficClass member fully wired end-to-end (check 4)."""
+    from tpu3fs.qos.core import (
+        BACKGROUND_CLASSES,
+        SHARE_BOUNDED_CLASSES,
+        TC_FLAG_MASK,
+        TC_FLAG_SHIFT,
+        QosConfig,
+        class_from_flags,
+        class_to_flags,
+    )
+
+    errors: List[str] = []
+    cfg = QosConfig()
+    knobs = ("rate", "burst", "max_inflight", "weight", "queue_share")
+    seen_attrs = set()
+    for tc in TrafficClass:
+        attr = CLASS_ATTRS.get(tc)
+        if attr is None:
+            errors.append(f"TrafficClass.{tc.name}: no CLASS_ATTRS entry")
+            continue
+        if attr in seen_attrs:
+            errors.append(f"TrafficClass.{tc.name}: CLASS_ATTRS name "
+                          f"{attr!r} reused")
+        seen_attrs.add(attr)
+        sec = getattr(cfg, attr, None)
+        if sec is None:
+            errors.append(f"TrafficClass.{tc.name}: QosConfig has no "
+                          f"{attr!r} limits section")
+        else:
+            for knob in knobs:
+                if not hasattr(sec, knob):
+                    errors.append(f"QosConfig.{attr}: missing {knob!r}")
+        # envelope carriage: the wire field is 4 bits, value 0 reserved
+        # for untagged — the enum must fit and round-trip losslessly
+        flags = class_to_flags(tc)
+        if flags & ~TC_FLAG_MASK:
+            errors.append(f"TrafficClass.{tc.name}: flag bits escape the "
+                          f"envelope field (shift {TC_FLAG_SHIFT})")
+        if int(tc) + 1 > 0xF:
+            errors.append(f"TrafficClass.{tc.name}: wire code "
+                          f"{int(tc) + 1} exceeds the 4-bit field")
+        if class_from_flags(flags) != tc:
+            errors.append(f"TrafficClass.{tc.name}: envelope flag "
+                          "round-trip lost the class")
+    for name, group in (("BACKGROUND_CLASSES", BACKGROUND_CLASSES),
+                        ("SHARE_BOUNDED_CLASSES", SHARE_BOUNDED_CLASSES)):
+        for tc in group:
+            if not isinstance(tc, TrafficClass):
+                errors.append(f"{name}: {tc!r} is not a TrafficClass")
+    if not BACKGROUND_CLASSES <= SHARE_BOUNDED_CLASSES:
+        errors.append("BACKGROUND_CLASSES not a subset of "
+                      "SHARE_BOUNDED_CLASSES (background work lost its "
+                      "queue-share bound)")
+    return errors
+
+
 # -- driver ------------------------------------------------------------------
 
 def run_checks() -> Tuple[List[str], List[str]]:
     """-> (errors, notes)."""
     errors: List[str] = []
     notes: List[str] = []
+    errors.extend(check_traffic_classes())
     try:
         registries = _bind_all()
     except ValueError as e:  # duplicate service/method id at bind time
-        return [str(e)], []
+        return errors + [str(e)], []
 
     # cross-binary id reuse (informational)
     by_id: Dict[int, set] = {}

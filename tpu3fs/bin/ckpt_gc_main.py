@@ -7,9 +7,19 @@ keep-last-N / keep-every-K eviction through the trash subsystem plus
 stale ``.tmp`` reaping — under the ``ckpt`` QoS class so sweeps schedule
 behind foreground IO.
 
+With ``--archive-after N`` each tick ALSO auto-archives: committed steps
+older than the newest N re-encode onto an erasure-coded layout
+(CheckpointGC.archive_pass) — cold checkpoints stop paying replication's
+capacity overhead without an operator ever issuing explicit archive
+calls. The EC chains come from the cluster's routing table (filtered by
+``--archive-ec-k/-m`` when given); already-EC steps are skipped, so the
+sweep is idempotent.
+
     python -m tpu3fs.bin.ckpt_gc_main --connect HOST:PORT \
         [--root /ckpt] [--keep-last 3] [--keep-every 0] \
-        [--trash-keep 86400] [--interval 300] [--once]
+        [--trash-keep 86400] [--interval 300] [--once] \
+        [--archive-after N] [--archive-ec-k K] [--archive-ec-m M] \
+        [--archive-chunk-size BYTES]
 
 Tests drive run_loop() directly against an in-process Fabric.
 """
@@ -36,6 +46,29 @@ def build_gc(fabric, args: argparse.Namespace) -> CheckpointGC:
     )
 
 
+def ec_archive_layout(fabric, args: argparse.Namespace):
+    """EC layout for auto-archival, from the live routing table: every
+    SERVING EC chain (optionally filtered to EC(k, m)). None when the
+    cluster has no matching EC chains — archival is then skipped, not an
+    error, so one daemon config works across clusters."""
+    from tpu3fs.meta.types import Layout
+
+    routing = fabric.routing()
+    chains = []
+    for c in routing.chains.values():
+        if not c.is_ec:
+            continue
+        if args.archive_ec_k and c.ec_k != args.archive_ec_k:
+            continue
+        if args.archive_ec_m and c.ec_m != args.archive_ec_m:
+            continue
+        chains.append(c.chain_id)
+    if not chains:
+        return None
+    return Layout(table_id=1, chains=sorted(chains),
+                  chunk_size=args.archive_chunk_size, seed=1)
+
+
 def run_loop(fabric, args: argparse.Namespace, *, out=sys.stdout) -> int:
     """Sweep until stopped (or once); returns total steps evicted."""
     gc = build_gc(fabric, args)
@@ -43,8 +76,18 @@ def run_loop(fabric, args: argparse.Namespace, *, out=sys.stdout) -> int:
     while True:
         removed = gc.run_once()
         total += removed
+        archived = 0
+        if args.archive_after > 0:
+            layout = ec_archive_layout(fabric, args)
+            if layout is None:
+                print("ckpt-gc: no EC chains in routing; archive pass "
+                      "skipped", file=out)
+            else:
+                archived = gc.archive_pass(
+                    layout, keep_replicated=args.archive_after)
         print(f"ckpt-gc: root={gc.root} evicted={removed} "
-              f"steps_left={len(gc.steps())}", file=out)
+              f"archived={archived} steps_left={len(gc.steps())}",
+              file=out)
         if args.once:
             return total
         time.sleep(args.interval)
@@ -64,6 +107,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="age before a crashed save's .tmp dir is reaped")
     p.add_argument("--interval", type=float, default=300.0)
     p.add_argument("--once", action="store_true")
+    p.add_argument("--archive-after", type=int, default=0,
+                   help="auto-archive steps older than the newest N onto "
+                        "EC chains each tick (0 = off)")
+    p.add_argument("--archive-ec-k", type=int, default=0,
+                   help="only use EC chains with this k (0 = any)")
+    p.add_argument("--archive-ec-m", type=int, default=0,
+                   help="only use EC chains with this m (0 = any)")
+    p.add_argument("--archive-chunk-size", type=int, default=1 << 20)
     return p.parse_args(argv)
 
 

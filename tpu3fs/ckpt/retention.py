@@ -158,6 +158,43 @@ class CheckpointGC:
         return reaped
 
     # -- archival ---------------------------------------------------------
+    def step_is_archived(self, step: int) -> bool:
+        """True when the step's files already live on EC chains (layout-
+        independent: re-pointing the archive layout at different EC
+        chains does not re-archive already-cold steps)."""
+        try:
+            inode = self._meta.stat(
+                f"{step_dir(self.root, step)}/{MANIFEST_NAME}")
+        except FsError:
+            return False
+        layout = inode.layout
+        if layout is None or not layout.chains:
+            return False
+        try:
+            return all(self._fio.is_ec_chain(c) for c in set(layout.chains))
+        except FsError:
+            return False  # routing gap: treat as not archived, retry later
+
+    def archive_pass(self, layout: Layout, *,
+                     keep_replicated: int) -> int:
+        """Auto-archive sweep (the ckpt_gc daemon tick): every committed
+        step older than the newest ``keep_replicated`` that is not
+        already erasure-coded re-encodes onto ``layout``. Newest steps
+        stay replicated — they are the restart-likely ones, and CR
+        restores skip the decode path. Returns steps archived."""
+        if keep_replicated < 0:
+            raise _err(Code.INVALID_ARG,
+                       f"keep_replicated {keep_replicated}")
+        steps = self.steps()
+        cold = steps[:-keep_replicated] if keep_replicated > 0 else steps
+        archived = 0
+        for s in cold:
+            if self.step_is_archived(s):
+                continue
+            self.archive_step(s, layout)
+            archived += 1
+        return archived
+
     def archive_step(self, step: int, layout: Layout) -> Manifest:
         """Re-encode one cold step onto `layout` (an EC-chain layout):
         copy every data file + manifest into ``<step>.arc/`` on the new

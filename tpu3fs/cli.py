@@ -824,6 +824,55 @@ class AdminCli:
                          f"{tuple(leaf.shape)} sharded[{spec}] x{nsh}")
         return "\n".join(lines)
 
+    # -- training data loader (tpu3fs/dataload) ------------------------------
+    def cmd_dataload_pack(self, args: List[str]) -> str:
+        """dataload-pack OUT LOCAL_FILE... [--from-dir DIR]: pack local
+        sample files into a packed record file (one record per file)."""
+        import argparse as _argparse
+
+        from tpu3fs.bin.dataload_pack_main import run as _pack_run
+
+        from_dir = self._flag(args, "--from-dir", "")
+        rest = []
+        skip = False
+        for i, a in enumerate(args):
+            if skip:
+                skip = False
+                continue
+            if a == "--from-dir":
+                skip = True
+                continue
+            rest.append(a)
+        if not rest:
+            return "usage: dataload-pack OUT LOCAL_FILE... [--from-dir DIR]"
+        ns = _argparse.Namespace(out=rest[0], files=rest[1:],
+                                 from_dir=from_dir, inspect="")
+        import io as _io
+
+        buf = _io.StringIO()
+        rc = _pack_run(self.fab, ns, out=buf)
+        return buf.getvalue().strip() if rc == 0 else f"pack failed ({rc})"
+
+    def cmd_dataload_inspect(self, args: List[str]) -> str:
+        """dataload-inspect PATH [--records N]: packed-file summary (+
+        the first N record extents/CRCs)."""
+        from tpu3fs.dataload.recordio import RecordFile
+
+        path = [a for a in args if not a.startswith("-")][0]
+        show = int(self._flag(args, "--records", 0))
+        rf = RecordFile.open(self.fab.meta, self.fab.file_client(), path)
+        s = rf.summary()
+        lines = [
+            f"{path}: {s['records']} records, {s['payload_bytes']} payload "
+            f"bytes ({s['file_bytes']} on disk), record size "
+            f"{s['min_record']}..{s['max_record']}"
+        ]
+        for i in range(min(show, rf.num_records)):
+            off, n = rf.extent(i)
+            lines.append(f"  [{i}] offset={off} length={n} "
+                         f"crc={rf.record_crc(i):#010x}")
+        return "\n".join(lines)
+
     def cmd_ckpt_rm(self, args: List[str]) -> str:
         """ckpt-rm STEP [--root /ckpt] [--keep SECONDS]: evict one step
         through the trash subsystem (recoverable until expiry)."""
@@ -844,6 +893,9 @@ class RpcFabricView:
     role split, src/client/mgmtd/MgmtdClient.cc)."""
 
     def __init__(self, mgmtd_addr, token: str = "", client_id: str = "admin"):
+        import itertools
+        import uuid
+
         from tpu3fs.client.file_io import FileIoClient
         from tpu3fs.client.storage_client import StorageClient
         from tpu3fs.mgmtd.types import NodeType
@@ -856,6 +908,16 @@ class RpcFabricView:
 
         self._rpc = RpcClient()
         self._client_id = client_id
+        # storage clients need UNIQUE wire ids (like Fabric's client-N):
+        # the server's exactly-once channel table is keyed (client id,
+        # channel, seq) — two client INSTANCES sharing one id restart
+        # their channel seqs and the server silently dedupes the second
+        # client's writes as replays (found by the live dataload drive:
+        # a fresh client's 9-byte state write "succeeded" without
+        # landing). The uuid part keeps two operator PROCESSES with the
+        # same client_id apart as well.
+        self._storage_id_base = f"{client_id}-{uuid.uuid4().hex[:8]}"
+        self._storage_seq = itertools.count(1)
         self.mgmtd = MgmtdAdminRpcClient(mgmtd_addr, self._rpc)
         self._messenger = RpcMessenger(self.mgmtd.refresh_routing, self._rpc)
         self._StorageClient = StorageClient
@@ -884,8 +946,8 @@ class RpcFabricView:
 
     def storage_client(self, **kw):
         return self._StorageClient(
-            self._client_id, self.mgmtd.refresh_routing, self._messenger,
-            **kw)
+            f"{self._storage_id_base}-{next(self._storage_seq)}",
+            self.mgmtd.refresh_routing, self._messenger, **kw)
 
     def file_client(self, **kw):
         return self._FileIoClient(self.storage_client(**kw))

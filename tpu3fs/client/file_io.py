@@ -80,6 +80,11 @@ class FileIoClient:
         chain = self._storage._chain(chain_id)
         return chain.is_ec
 
+    def is_ec_chain(self, chain_id: int) -> bool:
+        """Whether a layout chain is erasure-coded (routing lookup) — the
+        ckpt archiver's already-archived test."""
+        return self._is_ec(chain_id)
+
     def write(self, inode: Inode, offset: int, data: bytes) -> int:
         """Write a byte range. Chunk ops are BATCHED, not issued one at a
         time: consecutive CR chunks go through StorageClient.batch_write

@@ -18,6 +18,14 @@ Correctness contract:
   plus at most max_inflight fetches in flight; adversarial access patterns
   (random offsets, many files) never arm the window, so they cache
   nothing.
+- shuffled access does not thrash: arming requires BOTH a sequential run
+  (min_run) and a mostly-sequential recent history per inode (the jump
+  fraction over a sliding window stays under 1/2). A shuffled/random
+  reader — e.g. the dataload loader's SORTED per-batch extents, where
+  occasional records happen to be file-adjacent — sees jumps dominate its
+  window and never arms, so no 4 MiB windows are fetched for reads that
+  will not come back. A genuinely sequential reader re-arms within ~one
+  window of reads after a seek.
 - QoS: a prefetch runs under the TRAFFIC CLASS of the read that armed it
   (captured at schedule time, restored in the worker via qos.tagged), so
   background-class readers cannot smuggle foreground-priced readahead.
@@ -48,6 +56,9 @@ class ReadaheadPrefetcher:
     FileIoClient); it runs on background workers only.
     """
 
+    #: sliding-window length (reads) for the jump-fraction thrash guard
+    _HIST_WINDOW = 16
+
     def __init__(self, fetch: Callable, config: Optional[PrefetchConfig] = None):
         self._fetch = fetch
         self.config = config or PrefetchConfig()
@@ -59,6 +70,10 @@ class ReadaheadPrefetcher:
         self._bytes = 0
         # inode id -> (next expected offset, run length)
         self._runs: Dict[int, Tuple[int, int]] = {}
+        # inode id -> (jumps, total) over a sliding read window: the
+        # thrash guard (see module docstring). Halved when total reaches
+        # _HIST_WINDOW so old history decays instead of pinning a verdict.
+        self._hist: Dict[int, Tuple[int, int]] = {}
         # invalidation generation per inode: a fetch completing after its
         # inode was invalidated must NOT install a stale window
         self._gen: Dict[int, int] = {}
@@ -132,9 +147,23 @@ class ReadaheadPrefetcher:
         end = offset + size
         with self._mu:
             expected, run = self._runs.get(inode.id, (None, 0))
-            run = run + 1 if expected == offset else 1
+            sequential = expected == offset
+            run = run + 1 if sequential else 1
             self._runs[inode.id] = (end, run)
-            if run < cfg.min_run:
+            # thrash guard: a JUMP is any read that breaks the expected
+            # sequence (the first-ever read of an inode is neither). Arm
+            # only while jumps stay a strict minority of the recent
+            # window — a shuffled reader whose sorted batches contain the
+            # odd adjacent pair can satisfy min_run, but never this.
+            jumps, total = self._hist.get(inode.id, (0, 0))
+            total += 1
+            if expected is not None and not sequential:
+                jumps += 1
+            if total >= self._HIST_WINDOW:
+                jumps //= 2
+                total //= 2
+            self._hist[inode.id] = (jumps, total)
+            if run < cfg.min_run or jumps * 2 > total:
                 return
             # the next window begins where cached/in-flight coverage of
             # the current position ends — back-to-back windows, no overlap
@@ -257,6 +286,7 @@ class ReadaheadPrefetcher:
         with self._mu:
             self._gen[inode_id] = self._gen.get(inode_id, 0) + 1
             self._runs.pop(inode_id, None)
+            self._hist.pop(inode_id, None)
             wins = self._windows.pop(inode_id, None)
             if wins:
                 for start, blob in wins:
@@ -274,6 +304,7 @@ class ReadaheadPrefetcher:
             self._windows.clear()
             self._lru.clear()
             self._runs.clear()
+            self._hist.clear()
             self._bytes = 0
 
     def cached_bytes(self) -> int:

@@ -4,7 +4,7 @@ The reference gets crude isolation from per-disk worker pools and RDMA
 transmission limits (SURVEY §2.3 UpdateWorker/AioReadWorker, IBSocket); a
 multi-tenant tpu3fs makes it a first-class, hot-configurable layer:
 
-- ``core``: the traffic-class taxonomy, thread-local tagging, token
+- ``core``: the traffic-class taxonomy, context-local tagging, token
   buckets + concurrency gates, the declarative ``QosConfig`` tree and the
   ``AdmissionController`` enforced in RPC dispatch (tpu3fs/rpc/net.py and,
   as a cheap ceiling, native/rpc_net.cpp).
@@ -20,6 +20,7 @@ client/storage_client.py with jittered backoff instead of blind retry.
 
 from tpu3fs.qos.core import (
     BACKGROUND_CLASSES,
+    SHARE_BOUNDED_CLASSES,
     AdmissionController,
     ConcurrencyGate,
     QosConfig,
@@ -43,6 +44,7 @@ __all__ = [
     "ConcurrencyGate",
     "QosConfig",
     "QosManager",
+    "SHARE_BOUNDED_CLASSES",
     "TokenBucket",
     "TrafficClass",
     "WeightedFairQueue",

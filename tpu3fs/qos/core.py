@@ -27,6 +27,7 @@ encoding of that hint in envelope messages.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import enum
 import threading
 import time
@@ -51,6 +52,7 @@ class TrafficClass(enum.IntEnum):
     MIGRATION = 5     # chain-to-chain migration jobs
     GC = 6            # garbage collection / trash sweeps
     CKPT = 7          # training-checkpoint save/restore/archival (ckpt/)
+    DATALOAD = 8      # training data loader batch reads (dataload/)
 
 
 #: Classes whose work is elastic: they self-throttle under pressure and
@@ -63,6 +65,13 @@ BACKGROUND_CLASSES = frozenset({
     TrafficClass.CKPT,
 })
 
+#: Classes subject to the per-queue share bound. DATALOAD is here but NOT
+#: in BACKGROUND_CLASSES: the training input pipeline is latency-coupled
+#: to the step loop (foreground scheduler weight), yet a misconfigured
+#: loader flood must still be unable to occupy a whole update queue and
+#: starve foreground writes.
+SHARE_BOUNDED_CLASSES = BACKGROUND_CLASSES | {TrafficClass.DATALOAD}
+
 #: TrafficClass -> QosConfig section attribute name.
 CLASS_ATTRS: Dict[TrafficClass, str] = {
     TrafficClass.FG_READ: "fg_read",
@@ -73,17 +82,25 @@ CLASS_ATTRS: Dict[TrafficClass, str] = {
     TrafficClass.MIGRATION: "migration",
     TrafficClass.GC: "gc",
     TrafficClass.CKPT: "ckpt",
+    TrafficClass.DATALOAD: "dataload",
 }
 
 
-# -- thread-local tagging ----------------------------------------------------
+# -- context-local tagging ---------------------------------------------------
+#
+# A ContextVar, not threading.local: per-thread semantics are identical
+# (every thread starts untagged), but the tag additionally travels with
+# contextvars.copy_context() — which is how WorkerPool.submit carries the
+# submitter's class into pool threads (utils/executor.py), so fanned-out
+# IO stays tagged like the armed fault_injection state it rides next to.
 
-_tls = threading.local()
+_tclass_var: contextvars.ContextVar[Optional["TrafficClass"]] = \
+    contextvars.ContextVar("tpu3fs_qos_tclass", default=None)
 
 
 def current_class(default: Optional[TrafficClass] = None):
-    """The calling thread's traffic class, or `default` when untagged."""
-    tc = getattr(_tls, "tclass", None)
+    """The calling context's traffic class, or `default` when untagged."""
+    tc = _tclass_var.get()
     # explicit None test: TrafficClass.FG_READ is 0 and must not fall
     # through to the default like an untagged thread would
     return default if tc is None else tc
@@ -91,13 +108,12 @@ def current_class(default: Optional[TrafficClass] = None):
 
 @contextlib.contextmanager
 def tagged(tclass: TrafficClass):
-    """Tag the calling thread's traffic for the duration of the block."""
-    prev = getattr(_tls, "tclass", None)
-    _tls.tclass = tclass
+    """Tag the calling context's traffic for the duration of the block."""
+    token = _tclass_var.set(tclass)
     try:
         yield
     finally:
-        _tls.tclass = prev
+        _tclass_var.reset(token)
 
 
 # -- envelope flag carriage (MessagePacket.flags bits 8-11) ------------------
@@ -332,6 +348,11 @@ class QosConfig(Config):
     # so restores-under-pressure finish, but share-bounded like any
     # background class so a save flood cannot starve foreground IO
     ckpt = _limits(0.0, 64, 0, 2, 0.5)
+    # the training data loader is on the step loop's critical path:
+    # foreground weight (8) so batch fetches schedule with client IO, but
+    # share-bounded (SHARE_BOUNDED_CLASSES) so a loader flood cannot fill
+    # an update queue and starve foreground writes
+    dataload = _limits(0.0, 128, 0, 8, 0.5)
 
 
 # -- admission ---------------------------------------------------------------

@@ -14,10 +14,12 @@ the old single queue is preserved for client writes (all FG_WRITE);
 cross-class writes to one chunk are ordered by the engine's version
 algebra (recovery installs are versioned and idempotent).
 
-Shedding happens at push: a full queue sheds any class, and a background
-class is shed earlier when it already occupies its configured share of
-the queue — the bounded-queue-depth property the overload stress test
-asserts. A shed returns the retry-after hint for the OVERLOADED reply.
+Shedding happens at push: a full queue sheds any class, and a
+share-bounded class (every background class plus the foreground-weighted
+``dataload``, qos.core.SHARE_BOUNDED_CLASSES) is shed earlier when it
+already occupies its configured share of the queue — the
+bounded-queue-depth property the overload stress test asserts. A shed
+returns the retry-after hint for the OVERLOADED reply.
 """
 
 from __future__ import annotations
@@ -27,8 +29,8 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from tpu3fs.qos.core import (
-    BACKGROUND_CLASSES,
     CLASS_ATTRS,
+    SHARE_BOUNDED_CLASSES,
     QosConfig,
     TrafficClass,
 )
@@ -89,7 +91,7 @@ class WeightedFairQueue:
             # full queue: scale the hint by how oversubscribed we are so
             # a deep backlog spreads retries wider than a grazing overflow
             return base * 2
-        if tclass in BACKGROUND_CLASSES:
+        if tclass in SHARE_BOUNDED_CLASSES:
             share = max(1, int(self.cap * self.policy.queue_share(tclass)))
             q = self._queues.get(tclass)
             if q is not None and len(q) >= share:

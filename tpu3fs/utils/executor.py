@@ -15,6 +15,7 @@ released — the same reason the per-target UpdateWorker queues scale.
 
 from __future__ import annotations
 
+import contextvars
 import random
 import threading
 import time
@@ -58,6 +59,13 @@ class WorkerPool:
     submit() applies backpressure: when the queue is full it BLOCKS (the
     reference's bounded channel semantics) unless block=False, which
     raises instead — callers on a latency budget pick their poison.
+
+    Each task runs inside a ``contextvars.copy_context()`` snapshot taken
+    at submit time, so context-scoped request state — the QoS ``tagged()``
+    traffic class and armed ``fault_injection`` — follows work into the
+    pool instead of silently resetting: fanned-out IO stays classified
+    and armed fault points keep firing (the reference's coroutine pools
+    get this for free from coroutine-local state).
     """
 
     def __init__(self, name: str, num_workers: int = 4,
@@ -81,6 +89,7 @@ class WorkerPool:
     def submit(self, fn: Callable, *args, block: bool = True,
                timeout: Optional[float] = None) -> Future:
         fut = Future()
+        ctx = contextvars.copy_context()
         with self._mu:
             if not self._running:
                 raise FsError(Status(Code.SHUTTING_DOWN, self.name))
@@ -101,7 +110,7 @@ class WorkerPool:
                     self._not_full.wait(left)
                 if not self._running:
                     raise FsError(Status(Code.SHUTTING_DOWN, self.name))
-            self._queue.append((fn, args, fut))
+            self._queue.append((ctx, fn, args, fut))
             self._not_empty.notify()
         return fut
 
@@ -134,10 +143,10 @@ class WorkerPool:
                     self._not_empty.wait()
                 if not self._running and not self._queue:
                     return
-                fn, args, fut = self._queue.pop(0)
+                ctx, fn, args, fut = self._queue.pop(0)
                 self._not_full.notify()
             try:
-                fut.set_result(fn(*args))
+                fut.set_result(ctx.run(fn, *args))
             except BaseException as e:  # noqa: BLE001 — delivered via Future
                 fut.set_exception(e)
 

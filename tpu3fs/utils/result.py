@@ -109,6 +109,10 @@ class Code(enum.IntEnum):
     CKPT_NOT_FOUND = 801     # no committed checkpoint at this step
     CKPT_CORRUPT = 802       # manifest/shard failed decode or CRC check
 
+    # dataload subsystem 9xx (tpu3fs/dataload)
+    DATALOAD_CORRUPT = 900   # record file header/index/record CRC mismatch
+    DATALOAD_STATE_MISMATCH = 901  # resume state does not fit this dataset
+
 
 #: Codes on which a client-side retry ladder may re-issue the request.
 RETRYABLE_CODES = frozenset(
