@@ -1,0 +1,260 @@
+"""kvcache_bench: the inference KV-cache serving tier over real sockets.
+
+Drives tpu3fs/kvcache against the _RpcCluster harness (real socket
+transports for every chunk read/write; the metadata store runs in-process
+over MemKV, as in the ckpt/dataload benches — the storage wire is what a
+per-key read pays for) and reports:
+
+- NAIVE per-key gets: one ``KVCacheClient.get`` per prefix block, the
+  access pattern of a cache client without batching — each key pays its
+  own stat + serial chunk read round trip;
+- BATCHED prefix-block get: ``PrefixBlockStore.get_blocks`` fetching the
+  whole chain as ONE node-grouped, pipelined, striped ``batch_read_files``
+  (the PR 3 read path) plus ONE batched mtime touch — the speedup this
+  subsystem exists for (README's 40 GiB/s cached-KV read story);
+- HOST-TIER hits: per-get latency once the working set is resident in
+  the bounded host-RAM LRU, with an instrumented storage client proving
+  hits issue ZERO storage RPCs;
+- PREFIX REUSE: a second session sharing a prompt prefix — blocks
+  written by each session (shared blocks stored exactly once), matched
+  tokens, and the dedup ratio;
+- GC remove-op IOPS over the expired pool (the README's GC chart).
+
+Data integrity is verified inside the bench (block arrays compared
+against what was stored). Prints one JSON object (bench.py conventions)
+and writes it to --json-out (BENCH_KVCACHE.json).
+
+Usage: python -m benchmarks.kvcache_bench [--blocks 64] [--block-kb 128]
+           [--chains 4] [--replicas 2] [--json-out BENCH_KVCACHE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from benchmarks.storage_bench import _RpcCluster
+from tpu3fs.client.file_io import FileIoClient
+from tpu3fs.client.storage_client import RetryOptions
+from tpu3fs.kv.mem import MemKVEngine
+from tpu3fs.kvcache import (
+    KVCacheClient,
+    KVCacheGC,
+    PrefixBlockStore,
+    TieredKVCache,
+)
+from tpu3fs.meta.store import ChainAllocator, MetaStore
+
+CHUNK = 256 << 10
+_FAST_RETRY = RetryOptions(backoff_base_s=0.001, backoff_max_s=0.05)
+
+
+class _Env:
+    """One socket cluster + in-process meta + a fresh cache client."""
+
+    def __init__(self, *, chains: int, replicas: int,
+                 transport: str) -> None:
+        self.cluster = _RpcCluster(replicas=replicas, chains=chains,
+                                   size=CHUNK, transport=transport)
+        self.storage = self.cluster.storage_client(retry=_FAST_RETRY)
+        self.fio = FileIoClient(self.storage)
+        self.meta = MetaStore(
+            MemKVEngine(),
+            ChainAllocator(1, list(self.cluster.chain_ids)),
+            file_length_hook=self.fio.file_length,
+            truncate_hook=self.fio.truncate_chunks,
+            default_chunk_size=CHUNK,
+        )
+        # the serving client: inode-cached (content-addressed blocks are
+        # immutable; staleness detected by the array-header magic) with
+        # LRU touches coalesced off the read critical path
+        self.cache = KVCacheClient(self.meta, self.fio, inode_cache=65536,
+                                   touch_coalesce_s=0.25)
+        # the naive-baseline client: stock configuration, per-key gets
+        self.naive = KVCacheClient(self.meta, self.fio)
+
+    def close(self) -> None:
+        self.fio.close()
+        self.storage.close()
+        self.cluster.close()
+
+
+def _count_storage_rpcs(storage) -> dict:
+    """Instrument a StorageClient's read surface; returns a live counter
+    dict (monkey-patch spy, removed with the client)."""
+    counts = {"rpcs": 0}
+    for name in ("read_chunk", "batch_read", "read_stripe"):
+        real = getattr(storage, name)
+
+        def spy(*a, _real=real, **kw):
+            counts["rpcs"] += 1
+            return _real(*a, **kw)
+
+        setattr(storage, name, spy)
+    return counts
+
+
+def _block_array(i: int, block_bytes: int) -> np.ndarray:
+    # [2(kv), heads, tokens, head_dim] f16 page shaped to block_bytes
+    head_dim = 64
+    heads = 4
+    toks = max(1, block_bytes // (2 * heads * head_dim * 2))
+    rng = np.random.default_rng(1000 + i)
+    return rng.integers(-3, 3, size=(2, heads, toks, head_dim)) \
+        .astype(np.float16)
+
+
+def run_bench(*, blocks: int = 64, block_kb: int = 128,
+              block_tokens: int = 16, chains: int = 4, replicas: int = 2,
+              transport: str = "python", gc_entries: int = 0) -> dict:
+    block_bytes = block_kb << 10
+    env = _Env(chains=chains, replicas=replicas, transport=transport)
+    try:
+        toks = list(range(blocks * block_tokens))
+        pages = [_block_array(i, block_bytes) for i in range(blocks)]
+        nbytes = sum(p.nbytes for p in pages)
+
+        # -- store session A's chain (fs tier, synchronous) --------------
+        store = PrefixBlockStore(env.cache, block_tokens=block_tokens)
+        t0 = time.perf_counter()
+        stored_a = store.append_blocks(toks, pages)
+        put_s = time.perf_counter() - t0
+        assert stored_a == blocks
+
+        # -- naive per-key gets (steady state: best of 3 warm passes) ----
+        keys = store.block_keys(toks)
+        naive_runs = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            for key in keys:
+                blob = env.naive.get(key)
+                assert blob is not None
+            naive_runs.append(time.perf_counter() - t0)
+        naive_s = min(naive_runs[1:])
+
+        # -- batched prefix-block get (steady state, same warmth) --------
+        batched_runs = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            got = store.get_blocks(toks)
+            batched_runs.append(time.perf_counter() - t0)
+        batched_s = min(batched_runs[1:])
+        for arr, page in zip(got, pages):
+            assert arr is not None and np.array_equal(arr, page)
+
+        # -- host-tier hits (zero storage RPCs proven) -------------------
+        tiered = TieredKVCache(env.cache,
+                               capacity_bytes=2 * nbytes + (1 << 20))
+        tstore = PrefixBlockStore(tiered, block_tokens=block_tokens)
+        t0 = time.perf_counter()
+        tstore.get_blocks(toks)          # cold: fills the tier
+        fill_s = time.perf_counter() - t0
+        counts = _count_storage_rpcs(env.storage)
+        t0 = time.perf_counter()
+        hot = tstore.get_blocks(toks)
+        host_s = time.perf_counter() - t0
+        assert all(a is not None for a in hot)
+        t0 = time.perf_counter()
+        for _ in range(32):
+            assert tiered.get(keys[0]) is not None
+        host_get_us = (time.perf_counter() - t0) / 32 * 1e6
+        assert counts["rpcs"] == 0, "host-tier hit issued a storage RPC"
+        t0 = time.perf_counter()
+        for _ in range(8):
+            assert env.cache.get(keys[0]) is not None  # per-get fs ref
+        fs_get_us = (time.perf_counter() - t0) / 8 * 1e6
+        tiered.close()
+
+        # -- prefix reuse: session B shares 3/4 of the prompt ------------
+        shared = (blocks * 3 // 4) * block_tokens
+        toks_b = toks[:shared] + [10_000_000 + t for t in
+                                  range(len(toks) - shared)]
+        store_b = PrefixBlockStore(env.cache, block_tokens=block_tokens)
+        match = store_b.match_prefix(toks_b)
+        stored_b = store_b.append_blocks(
+            toks_b, [_block_array(5000 + i, block_bytes)
+                     for i in range(match.blocks, blocks)],
+            start_block=match.blocks)
+        assert match.blocks == blocks * 3 // 4
+        assert stored_b == blocks - match.blocks
+
+        row = {
+            "metric": "kvcache_serving",
+            "blocks": blocks,
+            "block_kb": block_kb,
+            "block_tokens": block_tokens,
+            "bytes": nbytes,
+            "transport": transport,
+            "put_gibps": round(nbytes / max(put_s, 1e-9) / (1 << 30), 3),
+            "naive_get_gibps": round(
+                nbytes / max(naive_s, 1e-9) / (1 << 30), 3),
+            "naive_get_ops_s": round(blocks / max(naive_s, 1e-9), 1),
+            "block_get_gibps": round(
+                nbytes / max(batched_s, 1e-9) / (1 << 30), 3),
+            "block_get_ops_s": round(blocks / max(batched_s, 1e-9), 1),
+            "block_speedup_vs_naive": round(naive_s / batched_s, 2),
+            "tier_fill_gibps": round(
+                nbytes / max(fill_s, 1e-9) / (1 << 30), 3),
+            "host_hit_gibps": round(
+                nbytes / max(host_s, 1e-9) / (1 << 30), 3),
+            "host_hit_storage_rpcs": 0,
+            "host_get_us": round(host_get_us, 1),
+            "fs_get_us": round(fs_get_us, 1),
+            "host_hit_speedup": round(fs_get_us / max(host_get_us, 1e-3),
+                                      1),
+            "prefix_shared_blocks": match.blocks,
+            "prefix_matched_tokens": match.tokens,
+            "session_b_blocks_written": stored_b,
+            "prefix_dedup_ratio": round(match.blocks / blocks, 3),
+        }
+
+        # -- GC remove IOPS over an expired pool -------------------------
+        if gc_entries:
+            for i in range(gc_entries):
+                env.cache.put(f"expired/{i}", b"x" * 4096)
+            gc = KVCacheGC(env.meta, ttl_s=1e-6, max_shards=1 << 20)
+            t0 = time.perf_counter()
+            removed = 0
+            deadline = time.time() + 120
+            while removed < gc_entries and time.time() < deadline:
+                removed += gc.run_once(now=time.time() + 10)
+            gc_s = time.perf_counter() - t0
+            row["gc_removed"] = removed
+            row["gc_remove_iops"] = round(removed / max(gc_s, 1e-9), 1)
+
+        # headline (bench.py conventions): batched block-get throughput
+        row["value"] = row["block_get_gibps"]
+        return row
+    finally:
+        env.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", type=int, default=64)
+    ap.add_argument("--block-kb", type=int, default=128)
+    ap.add_argument("--block-tokens", type=int, default=16)
+    ap.add_argument("--chains", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--gc-entries", type=int, default=512)
+    ap.add_argument("--transport", choices=["python", "native"],
+                    default="python")
+    ap.add_argument("--json-out", default="")
+    args = ap.parse_args()
+    row = run_bench(blocks=args.blocks, block_kb=args.block_kb,
+                    block_tokens=args.block_tokens, chains=args.chains,
+                    replicas=args.replicas, transport=args.transport,
+                    gc_entries=args.gc_entries)
+    line = json.dumps(row)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -568,3 +568,107 @@ class TestCliAndPacker:
         assert HEADER_SIZE == 32
         assert data_start(0) == 32
         assert data_start(4) == 32 + 64
+
+
+class TestTransformAndEpochCallback:
+    """ROADMAP satellite: loader-side sample transforms (decode/augment
+    between fetch and device hand-off) + an epoch-boundary callback for
+    curriculum schedules — with resume exactness preserved."""
+
+    def test_transform_applies_to_raw_records(self, fab):
+        ds, recs = _dataset(fab, n=32, size=512)
+        with DataLoader(ds, LoaderConfig(
+                global_batch=8, seed=3, epochs=1,
+                transform=lambda r: bytes(r)[::-1])) as ld:
+            for b in ld:
+                for rec, gid in zip(b.data, b.ids):
+                    assert rec == recs[gid][::-1]
+
+    def test_transform_feeds_array_assembly(self, fab):
+        import numpy as np
+
+        ds, recs = _dataset(fab, n=32, size=512)
+
+        def decode_plus_one(raw):  # bytes in, decoded ndarray out
+            return np.frombuffer(raw, dtype=np.uint8) + 1
+
+        with DataLoader(ds, LoaderConfig(
+                global_batch=8, seed=3, epochs=1, dtype="uint8",
+                sample_shape=(512,),
+                transform=decode_plus_one)) as ld:
+            for b in ld:
+                assert b.data.shape == (8, 512)
+                for row, gid in zip(b.data, b.ids):
+                    expect = np.frombuffer(recs[gid], dtype=np.uint8) + 1
+                    assert np.array_equal(row, expect)
+
+    def test_transform_size_mismatch_is_corrupt(self, fab):
+        ds, _ = _dataset(fab, n=16, size=512)
+        with DataLoader(ds, LoaderConfig(
+                global_batch=8, seed=1, epochs=1, dtype="uint8",
+                sample_shape=(512,),
+                transform=lambda r: bytes(r)[:100])) as ld:
+            with pytest.raises(FsError) as ei:
+                next(ld)
+            assert ei.value.code == Code.DATALOAD_CORRUPT
+
+    def test_epoch_callback_fires_per_epoch_including_resume(self, fab):
+        ds, _ = _dataset(fab, n=32, size=256)
+        epochs = []
+        cfg = dict(global_batch=8, seed=5, epochs=2)
+        with DataLoader(ds, LoaderConfig(
+                epoch_callback=epochs.append, **cfg)) as ld:
+            list(ld)
+        assert epochs == [0, 1]
+        # resume mid-epoch-1: the callback replays the RESUME epoch first
+        epochs2 = []
+        with DataLoader(ds, LoaderConfig(
+                epoch_callback=epochs2.append, **cfg)) as ld:
+            for _ in range(ds.steps_per_epoch(8) + 1):  # into epoch 1
+                next(ld)
+            st = ld.state()
+        assert st.epoch == 1
+        epochs3 = []
+        with DataLoader(ds, LoaderConfig(
+                epoch_callback=epochs3.append, **cfg), state=st) as ld:
+            list(ld)
+        assert epochs3 == [1]
+
+    def test_transforms_preserve_resume_exactness(self, fab):
+        """The satellite's core contract: a transforming loader restored
+        mid-epoch reproduces the exact remaining (id, data) sequence."""
+        ds, _ = _dataset(fab, n=32, size=256)
+
+        def mk(state=None):
+            return DataLoader(ds, LoaderConfig(
+                global_batch=8, seed=9, epochs=2,
+                transform=lambda r: bytes(r)[::-1]), state=state)
+
+        with mk() as full:
+            expect = [(b.ids, [bytes(r) for r in b.data]) for b in full]
+        half = mk()
+        got = [next(half) for _ in range(3)]
+        consumed = [(b.ids, [bytes(r) for r in b.data]) for b in got]
+        st = half.state()
+        half.close()
+        with mk(state=st) as resumed:
+            rest = [(b.ids, [bytes(r) for r in b.data]) for b in resumed]
+        assert consumed + rest == expect
+
+    def test_curriculum_swap_at_epoch_boundary(self, fab):
+        """A callback flipping the transform per epoch (the curriculum
+        shape) sees every epoch-0 record untouched and every epoch-1
+        record reversed — depth 1 pins the boundary exactly."""
+        ds, recs = _dataset(fab, n=32, size=256)
+        cfg = LoaderConfig(global_batch=8, seed=2, epochs=2, depth=1)
+
+        def on_epoch(epoch):
+            cfg.transform = (None if epoch == 0
+                             else (lambda r: bytes(r)[::-1]))
+
+        cfg.epoch_callback = on_epoch
+        with DataLoader(ds, cfg) as ld:
+            for b in ld:
+                for rec, gid in zip(b.data, b.ids):
+                    want = recs[gid] if b.epoch == 0 else recs[gid][::-1]
+                    assert bytes(rec) == want

@@ -432,3 +432,68 @@ class TestBatchClose:
         import pytest as _pytest
         with _pytest.raises(FsError):
             fab.meta.close(items[5].inode_id, items[5].session_id)
+
+
+class TestBatchSetAttr:
+    """Batched time touch (the kvcache touch-on-get satellite): one
+    transaction per chunk, by path or walk-free by inode id."""
+
+    def test_touch_many_paths(self, store):
+        ids = []
+        for i in range(5):
+            res = store.create(f"/t{i}")
+            store.close(res.inode.id, res.session_id)
+            ids.append(res.inode.id)
+        out = store.batch_set_attr([f"/t{i}" for i in range(5)],
+                                   mtime=1234.5, atime=77.0)
+        assert [o.id for o in out] == ids
+        for i in range(5):
+            ino = store.stat(f"/t{i}")
+            assert ino.mtime == 1234.5 and ino.atime == 77.0
+
+    def test_touch_by_inode_id_skips_walks(self, store):
+        res = store.create("/byid")
+        store.close(res.inode.id, res.session_id)
+        out = store.batch_set_attr(inode_ids=[res.inode.id, 999_999],
+                                   mtime=42.0)
+        assert out[0].id == res.inode.id
+        assert isinstance(out[1], FsError)
+        assert out[1].code == Code.META_NOT_FOUND
+        assert store.stat("/byid").mtime == 42.0
+
+    def test_per_item_failures_do_not_poison_batchmates(self, store):
+        res = store.create("/ok")
+        store.close(res.inode.id, res.session_id)
+        out = store.batch_set_attr(["/missing", "/ok"], mtime=5.0)
+        assert isinstance(out[0], FsError)
+        assert out[0].code == Code.META_NOT_FOUND
+        assert out[1].id == res.inode.id
+        assert store.stat("/ok").mtime == 5.0
+
+    def test_permission_enforced_per_item(self, store):
+        store.mkdirs("/home", perm=0o777)
+        store.create("/home/mine", ALICE)
+        store.create("/home/theirs", BOB)
+        out = store.batch_set_attr(["/home/mine", "/home/theirs"],
+                                   ALICE, mtime=9.0)
+        assert out[0].acl.uid == ALICE.uid
+        assert isinstance(out[1], FsError)
+        assert out[1].code == Code.META_NO_PERMISSION
+
+    def test_paths_xor_inode_ids(self, store):
+        with pytest.raises(FsError) as ei:
+            store.batch_set_attr(["/x"], inode_ids=[1])
+        assert code_of(ei) == Code.INVALID_ARG
+        with pytest.raises(FsError) as ei:
+            store.batch_set_attr()
+        assert code_of(ei) == Code.INVALID_ARG
+
+    def test_many_items_chunk_transactions(self, store):
+        paths = []
+        for i in range(70):  # crosses the txn_batch=64 boundary
+            res = store.create(f"/m{i}")
+            store.close(res.inode.id, res.session_id)
+            paths.append(f"/m{i}")
+        out = store.batch_set_attr(paths, mtime=7.0)
+        assert all(not isinstance(o, FsError) for o in out)
+        assert store.stat("/m69").mtime == 7.0

@@ -918,3 +918,131 @@ class TestQueueCapHotShrink:
         qcfg.hot_update({"update_queue_cap": 128})
         assert all(w.queue_cap == 128 for w in workers)
         fab.close()
+
+
+class TestKvcacheTrafficClass:
+    """The kvcache class registered end-to-end — enum, config section,
+    envelope bits, WFQ share bound, admin_cli row — so an inference
+    cache-fill flood demonstrably cannot starve foreground IO, while
+    decode-loop reads schedule at foreground weight."""
+
+    def test_registered_in_enum_config_flags_and_share_bound(self):
+        from tpu3fs.qos.core import (
+            BACKGROUND_CLASSES,
+            CLASS_ATTRS,
+            SHARE_BOUNDED_CLASSES,
+        )
+
+        assert CLASS_ATTRS[TrafficClass.KVCACHE] == "kvcache"
+        # foreground-weighted, share-bounded, NOT background-weighted
+        # (like dataload: latency-coupled to a serving loop)
+        assert TrafficClass.KVCACHE in SHARE_BOUNDED_CLASSES
+        assert TrafficClass.KVCACHE not in BACKGROUND_CLASSES
+        cfg = QosConfig()
+        assert cfg.kvcache.weight == 8
+        assert cfg.kvcache.queue_share == 0.5
+        assert class_from_flags(class_to_flags(
+            TrafficClass.KVCACHE)) == TrafficClass.KVCACHE
+        adm = AdmissionController(cfg)
+        assert "kvcache" in adm.snapshot()
+
+    def test_wfq_share_bounds_kvcache_but_not_fg(self):
+        q = WeightedFairQueue(WfqPolicy(QosConfig()), cap=8)
+
+        class _Item:
+            cost = 1
+
+        for _ in range(4):  # share 0.5 * cap 8 = 4
+            assert q.try_push(_Item(), TrafficClass.KVCACHE) is None
+        assert q.try_push(_Item(), TrafficClass.KVCACHE) is not None
+        for _ in range(4):  # foreground fills the rest, unbounded
+            assert q.try_push(_Item(), TrafficClass.FG_WRITE) is None
+
+    def test_cli_qos_view_has_kvcache_row(self):
+        from tpu3fs.cli import AdminCli
+
+        fab = _qos_fabric(QosConfig())
+        out = AdminCli(fab).run("qos")
+        assert "kvcache" in out
+
+    def test_client_ops_ride_the_kvcache_class(self):
+        from tpu3fs.kvcache import KVCacheClient
+
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=2, num_chains=2,
+                                       num_replicas=2, chunk_size=4096))
+        try:
+            fio = fab.file_client()
+            c = KVCacheClient(fab.meta, fio)
+            seen = []
+            for name in ("read", "batch_read_files", "write"):
+                real = getattr(fio, name)
+
+                def spy(*a, _real=real, **kw):
+                    seen.append(current_class())
+                    return _real(*a, **kw)
+
+                setattr(fio, name, spy)
+            c.put("q/1", b"v" * 256)
+            c.get("q/1")
+            c.batch_get(["q/1"])
+            assert seen and all(tc == TrafficClass.KVCACHE for tc in seen)
+        finally:
+            fab.close()
+
+    def test_kvcache_flood_cannot_starve_foreground_writes(self):
+        """Integration: a tagged kvcache-class write-back flood
+        saturating a 4-deep queue over a slowed engine sheds at its
+        share bound while every foreground write still lands."""
+        qcfg = QosConfig()
+        qcfg.set("update_queue_cap", 4)
+        qcfg.set("kvcache.queue_share", 0.25)
+        fab = _qos_fabric(qcfg, num_storage_nodes=1, num_replicas=1)
+        chain = fab.chain_ids[0]
+        node_id = min(fab.nodes)
+        svc = fab.nodes[node_id].service
+        target = svc.targets()[0]
+        real = target.engine.batch_update
+
+        def slow(ops, chain_ver):
+            time.sleep(0.002)
+            return real(ops, chain_ver)
+
+        target.engine.batch_update = slow
+        stop = threading.Event()
+        kv_sheds = [0]
+
+        def flood(fid: int):
+            ver = fab.routing().chains[chain].chain_version
+            i = 0
+            with tagged(TrafficClass.KVCACHE):
+                while not stop.is_set():
+                    i += 1
+                    req = WriteReq(chain_id=chain, chain_ver=ver,
+                                   chunk_id=ChunkId(7700 + fid, i),
+                                   offset=0, data=b"k" * 256,
+                                   chunk_size=4096, update_ver=1,
+                                   full_replace=True,
+                                   from_target=target.target_id)
+                    r = fab.send(node_id, "batch_update", [req])[0]
+                    if r.code == Code.OVERLOADED:
+                        kv_sheds[0] += 1
+                        time.sleep((r.retry_after_ms or 5) / 1000.0)
+
+        flooders = [threading.Thread(target=flood, args=(n,))
+                    for n in range(8)]
+        for f in flooders:
+            f.start()
+        try:
+            sc = fab.storage_client()
+            for i in range(20):
+                r = sc.write_chunk(chain, ChunkId(7800, i), 0, b"f" * 256,
+                                   chunk_size=4096)
+                assert r.ok, (i, r)
+            depths = svc.qos_snapshot()["queue_depths"]
+            assert sum(depths.values()) <= 4
+        finally:
+            stop.set()
+            for f in flooders:
+                f.join()
+            fab.close()
+        assert kv_sheds[0] > 0  # the share bound actually engaged

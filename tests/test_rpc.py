@@ -315,3 +315,24 @@ class TestEcOverSockets:
         finally:
             for s in servers:
                 s.stop()
+
+    def test_batch_set_attr_over_sockets(self, rpc_cluster):
+        meta = MetaRpcClient([rpc_cluster["meta_addr"]],
+                             rpc_cluster["client"], client_id="mc2")
+        meta.mkdirs("/touch", recursive=True)
+        ids = []
+        for i in range(3):
+            rsp = meta.create(f"/touch/f{i}", flags=2)
+            meta.close(rsp.inode.id, rsp.session_id, length_hint=1)
+            ids.append(rsp.inode.id)
+        # by path, with one failure entry (MetaStore parity)
+        out = meta.batch_set_attr(["/touch/f0", "/touch/nope"],
+                                  mtime=1111.0)
+        assert out[0].id == ids[0]
+        assert isinstance(out[1], FsError)
+        assert out[1].code == Code.META_NOT_FOUND
+        assert meta.stat("/touch/f0").mtime == 1111.0
+        # walk-free by inode id
+        out = meta.batch_set_attr(inode_ids=ids, atime=2222.0)
+        assert [o.id for o in out] == ids
+        assert meta.stat("/touch/f2").atime == 2222.0

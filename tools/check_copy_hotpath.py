@@ -50,6 +50,11 @@ HOT_PATH: List[Tuple[str, List[str]]] = [
     ("tpu3fs/dataload/loader.py",
      ["_fetch", "_assemble_array", "_read_with_backoff"]),
     ("tpu3fs/dataload/dataset.py", ["read_samples"]),
+    # the kvcache serving read path: host-tier hits and batched fill must
+    # hand buffers through as views; block decode is a frombuffer view
+    ("tpu3fs/kvcache/tier.py", ["batch_get", "_local", "_fill"]),
+    ("tpu3fs/kvcache/blocks.py", ["get_blocks"]),
+    ("tpu3fs/kvcache/layout.py", ["decode_array"]),
 ]
 
 _BYTES_CALL = re.compile(r"(?<![\w.])bytes\s*\(")

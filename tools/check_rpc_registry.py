@@ -227,6 +227,25 @@ def check_traffic_classes() -> List[str]:
         errors.append("BACKGROUND_CLASSES not a subset of "
                       "SHARE_BOUNDED_CLASSES (background work lost its "
                       "queue-share bound)")
+    # share-bound defaults must MEAN something: a bounded class shipping
+    # queue_share 1.0 has no bound (a flood fills whole queues), and an
+    # unbounded (pure foreground) class shipping < 1.0 silently sheds —
+    # both are wiring mistakes for a freshly added class (ckpt/dataload/
+    # kvcache all had to pick a side)
+    for tc in TrafficClass:
+        attr = CLASS_ATTRS.get(tc)
+        sec = getattr(cfg, attr, None) if attr else None
+        if sec is None:
+            continue  # already reported above
+        if tc in SHARE_BOUNDED_CLASSES and not sec.queue_share < 1.0:
+            errors.append(f"TrafficClass.{tc.name}: in SHARE_BOUNDED_"
+                          f"CLASSES but default queue_share is "
+                          f"{sec.queue_share} (1.0 = no bound)")
+        if tc not in SHARE_BOUNDED_CLASSES and sec.queue_share < 1.0:
+            errors.append(f"TrafficClass.{tc.name}: default queue_share "
+                          f"{sec.queue_share} < 1.0 but the class is not "
+                          "in SHARE_BOUNDED_CLASSES (the bound would "
+                          "shed silently)")
     return errors
 
 

@@ -873,6 +873,51 @@ class AdminCli:
                          f"crc={rf.record_crc(i):#010x}")
         return "\n".join(lines)
 
+    # -- inference KV cache (tpu3fs/kvcache) ---------------------------------
+    def cmd_kvcache_stats(self, args: List[str]) -> str:
+        """kvcache-stats [--root /kvcache]: fs-tier entries, bytes, lease
+        count, oldest/newest touch ages — the capacity-planning view."""
+        from tpu3fs.kvcache import KVCacheGC
+
+        root = self._flag(args, "--root", "/kvcache")
+        gc = KVCacheGC(self.fab.meta, root=root)
+        now = time.time()
+        entries = gc.scan_entries(now)
+        if not entries:
+            return f"{root}: empty"
+        total = sum(length for _, length, _, _ in entries)
+        leased = sum(1 for _, _, is_leased, _ in entries if is_leased)
+        oldest = min(mtime for mtime, _, _, _ in entries)
+        newest = max(mtime for mtime, _, _, _ in entries)
+        return (f"{root}: entries={len(entries)} bytes={total} "
+                f"leased={leased} oldest_age_s={now - oldest:.0f} "
+                f"newest_age_s={now - newest:.0f}")
+
+    def cmd_kvcache_gc(self, args: List[str]) -> str:
+        """kvcache-gc [--root /kvcache] [--ttl S] [--capacity-bytes N]
+        [--max-shards N]: one GC pass — TTL scan, then capacity-target
+        LRU eviction when a bytes budget is given. Lease-pinned entries
+        survive both."""
+        from tpu3fs.kvcache import KVCacheGC
+
+        cap = self._flag(args, "--capacity-bytes")
+        gc = KVCacheGC(
+            self.fab.meta,
+            root=self._flag(args, "--root", "/kvcache"),
+            ttl_s=float(self._flag(args, "--ttl", 3600.0)),
+            max_shards=int(self._flag(args, "--max-shards", 64)),
+            capacity_bytes=int(cap) if cap is not None else None,
+        )
+        ttl_removed = gc.run_once()
+        cap_removed = gc.capacity_pass()
+        run_gc = getattr(self.fab, "run_gc", None)
+        if run_gc is not None:  # live clusters reclaim via the meta GC scan
+            run_gc()
+        out = f"ttl pass removed {ttl_removed}"
+        if cap is not None:
+            out += f"; capacity pass removed {cap_removed}"
+        return out
+
     def cmd_ckpt_rm(self, args: List[str]) -> str:
         """ckpt-rm STEP [--root /ckpt] [--keep SECONDS]: evict one step
         through the trash subsystem (recoverable until expiry)."""

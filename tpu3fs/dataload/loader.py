@@ -34,7 +34,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -76,6 +76,25 @@ class LoaderConfig:
     # stop after this many epochs (None = run forever)
     epochs: Optional[int] = None
     max_overload_waits: int = 64
+    # per-sample transform between fetch and assembly/device_put
+    # (decode/augment: bytes-or-view in, bytes or ndarray out; with
+    # dtype/sample_shape set, the result must still be `want` bytes or a
+    # sample_shape-compatible array). Runs on the producer/fetch threads,
+    # overlapped with training like the IO it follows. MUST be a pure
+    # per-record function: the resume contract replays samples through it
+    # again, so a stateful transform would break resume exactness.
+    transform: Optional[Callable] = None
+    # invoked on the producer as each epoch STARTS fetching (including
+    # the resume epoch) — curriculum schedules flip transforms or
+    # difficulty knobs here. Fires once per (loader, epoch); raising
+    # fails the loader like a fetch error.
+    epoch_callback: Optional[Callable[[int], None]] = None
+
+
+def _rec_nbytes(rec) -> int:
+    """Payload bytes of a record in either shape a transform may hand
+    back (bytes/memoryview or ndarray)."""
+    return rec.nbytes if hasattr(rec, "nbytes") else len(rec)
 
 
 @dataclass
@@ -238,6 +257,11 @@ class DataLoader:
         steps = self._ds.steps_per_epoch(cfg.global_batch)
         epoch, step = self._epoch, self._step
         while cfg.epochs is None or epoch < cfg.epochs:
+            if cfg.epoch_callback is not None:
+                # epoch boundary (incl. the resume epoch): no fetch of
+                # THIS epoch has started yet (with depth>1, tail fetches
+                # of the previous epoch may still be in flight)
+                cfg.epoch_callback(epoch)
             perm = self._ds.permutation(cfg.seed, epoch,
                                         shuffle=cfg.shuffle)
             while step < steps:
@@ -323,7 +347,11 @@ class DataLoader:
                                           dp_rank=r,
                                           dp_size=self._dp_size))
         recs = self._read_with_backoff(ids)
-        nbytes = sum(len(r) for r in recs)
+        if cfg.transform is not None:
+            # decode/augment between fetch and assembly — per record, on
+            # the fetch thread (overlapped with training like the IO)
+            recs = [cfg.transform(r) for r in recs]
+        nbytes = sum(_rec_nbytes(r) for r in recs)
         if cfg.dtype:
             data = self._assemble_array(ids, recs)
         else:
@@ -367,13 +395,17 @@ class DataLoader:
             if shape else dtype.itemsize
         out = np.empty((len(ids),) + shape, dtype=dtype)
         for i, rec in enumerate(recs):
-            if len(rec) != want:
+            if _rec_nbytes(rec) != want:
                 raise _err(Code.DATALOAD_CORRUPT,
-                           f"sample {ids[i]}: {len(rec)} bytes, want "
-                           f"{want} for {dtype}{shape}")
-            # frombuffer is a view; the assignment below is the batch's
-            # ONE assembly copy
-            out[i] = np.frombuffer(rec, dtype=dtype).reshape(shape)
+                           f"sample {ids[i]}: {_rec_nbytes(rec)} bytes, "
+                           f"want {want} for {dtype}{shape}")
+            if isinstance(rec, np.ndarray):
+                # transformed record already decoded to an array
+                out[i] = rec.reshape(shape)
+            else:
+                # frombuffer is a view; the assignment below is the
+                # batch's ONE assembly copy
+                out[i] = np.frombuffer(rec, dtype=dtype).reshape(shape)
         return out
 
     def _to_device(self, host: np.ndarray, rows: List[int]):

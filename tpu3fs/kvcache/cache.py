@@ -1,10 +1,14 @@
-"""KVCache for LLM inference over the cluster (ref README.md:17,45-51).
+"""KVCache fs tier for LLM inference over the cluster (ref README.md:17,
+45-51).
 
 The reference positions 3FS as a DRAM-alternative KV cache: decoder-layer
 key/value tensors of previous tokens are cached in files, read back at up to
 40 GiB/s, and reclaimed by a GC whose remove-op IOPS the README charts. The
 reference implements this as a usage pattern over the normal file API — so
-does this build, as a typed client:
+does this build. This module is the durable tier of the serving stack
+(docs/kvcache.md): ``tier.TieredKVCache`` puts a host-RAM hot tier in front
+of it and ``blocks.PrefixBlockStore`` a content-addressed prefix-hash
+keyspace on top.
 
 - entries live under a cache root, sharded two hex levels deep (256×256
   dirs) so directory listings stay short at billions of entries;
@@ -12,37 +16,44 @@ does this build, as a typed client:
   the write session so lengths settle;
 - get()/batch_get() are chunk-batched reads (batch_read groups chunk IOs by
   node exactly like the training data loaders do);
-- touch-on-get refreshes an entry's mtime so the TTL GC is an LRU;
-- KVCacheGC scans shards round-robin and removes expired entries — the
-  remove-op counter mirrors the README's GC IOPS chart.
+- touch-on-get refreshes an entry's mtime so the GC is an LRU — BATCHED
+  (MetaStore.batch_set_attr): a 64-key batch_get refreshes all its hits in
+  one metadata transaction, not 64 round trips;
+- all IO is tagged ``TrafficClass.KVCACHE`` (foreground-weighted,
+  share-bounded — qos/core.py);
+- KVCacheGC reclaims in two modes: TTL round-robin shard scans, and a
+  capacity-target pass evicting oldest-touched entries until the tier fits
+  a bytes budget. Both respect pin leases (leases.py) — the remove-op
+  counter mirrors the README's GC IOPS chart.
 
-JAX arrays ride along via put_array/get_array (dtype+shape header, zero
-parsing beyond a 16-byte prefix) so inference servers can device_put the
-result straight onto a TPU.
+JAX arrays ride along via put_array/get_array (layout.encode_array: dtype+
+shape header, zero parsing beyond a 16-byte prefix) so inference servers
+can device_put the result straight onto a TPU.
 """
 
 from __future__ import annotations
 
-import hashlib
-import struct
 import threading
 import time
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
-
-import numpy as np
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
 
 from tpu3fs.client.file_io import FileIoClient
+from tpu3fs.kvcache.layout import (
+    decode_array,
+    encode_array,
+    lease_active,
+    shard_path,
+)
 from tpu3fs.meta.store import MetaStore, OpenFlags
 from tpu3fs.monitor.recorder import CounterRecorder, LatencyRecorder
+from tpu3fs.qos.core import TrafficClass, tagged
 from tpu3fs.utils.result import Code, FsError
-
-_HEADER = struct.Struct("<8sII")  # dtype name, ndim, reserved
-_MAGIC_DIMS = struct.Struct("<Q")
 
 
 def _shard_path(root: str, key: str) -> str:
-    h = hashlib.blake2b(key.encode(), digest_size=16).hexdigest()
-    return f"{root}/{h[:2]}/{h[2:4]}/{h}"
+    # back-compat alias (tests and older callers import it from here)
+    return shard_path(root, key)
 
 
 class KVCacheClient:
@@ -56,14 +67,39 @@ class KVCacheClient:
         root: str = "/kvcache",
         client_id: str = "kvcache",
         touch_on_get: bool = True,
+        inode_cache: int = 0,
+        touch_coalesce_s: float = 0.0,
     ):
+        """inode_cache > 0 enables a bounded client-side inode cache of
+        that many entries: repeat gets skip the stat walk and touch by
+        inode id (walk-free batch_set_attr), so a hot serving set pays
+        only its storage reads. ONLY sound for immutable, staleness-
+        detectable namespaces — content-addressed block entries, whose
+        array-header magic turns a GC'd entry's zero-hole read into
+        KVCACHE_STALE (blocks.py invalidates and re-stats). Leave 0 for
+        mutable byte-API use: a cached inode cannot see another client's
+        overwrite lengths.
+
+        touch_coalesce_s > 0 takes the LRU touch off the read critical
+        path: touched ids accumulate client-side and drain as ONE
+        batch_set_attr at most once per interval (flush_touches() forces
+        it). The GC's mtime axis lags by at most the interval — pair it
+        with a GC ttl comfortably above it (any sane TTL is)."""
         self._meta = meta
         self._fio = fio
         self.root = root.rstrip("/") or "/kvcache"
         self._client_id = client_id
-        self._touch = touch_on_get
+        self._touch_on_get = touch_on_get
         self._dir_lock = threading.Lock()
         self._dirs_made: set = set()
+        self._ino_lock = threading.Lock()
+        self._ino_cap = int(inode_cache)
+        self._inodes: "OrderedDict[str, object]" = OrderedDict()
+        self._touch_coalesce_s = float(touch_coalesce_s)
+        self._touch_lock = threading.Lock()
+        self._pending_ids: set = set()
+        self._pending_paths: set = set()
+        self._last_touch_flush = time.monotonic()
         self._hits = CounterRecorder("kvcache.hits")
         self._misses = CounterRecorder("kvcache.misses")
         self._read_bytes = CounterRecorder("kvcache.read_bytes")
@@ -85,10 +121,91 @@ class KVCacheClient:
         with self._dir_lock:
             self._dirs_made.add(parent)
 
+    def _touch(self, paths: Sequence[str], now: float,
+               inode_ids: Optional[Sequence[int]] = None) -> None:
+        """LRU refresh, batched; losing a race to GC is harmless. With
+        inode ids the touch is walk-free; with coalescing it leaves the
+        read critical path entirely (one drain per interval). The one
+        exception guard for every touch path (get/batch_get used to
+        differ): FsError from concurrent removes, TypeError from meta
+        doubles without time kwargs."""
+        if self._touch_coalesce_s > 0:
+            with self._touch_lock:
+                if inode_ids is not None:
+                    self._pending_ids.update(inode_ids)
+                else:
+                    self._pending_paths.update(paths)
+                if (time.monotonic() - self._last_touch_flush
+                        < self._touch_coalesce_s):
+                    return
+            self.flush_touches(now)
+            return
+        self._touch_now(paths, now, inode_ids)
+
+    def flush_touches(self, now: Optional[float] = None) -> None:
+        """Drain coalesced touches as one batched settle."""
+        now = time.time() if now is None else now
+        with self._touch_lock:
+            ids, self._pending_ids = self._pending_ids, set()
+            paths, self._pending_paths = self._pending_paths, set()
+            self._last_touch_flush = time.monotonic()
+        if ids:
+            self._touch_now([], now, sorted(ids))
+        if paths:
+            self._touch_now(sorted(paths), now)
+
+    def _touch_now(self, paths: Sequence[str], now: float,
+                   inode_ids: Optional[Sequence[int]] = None) -> None:
+        batched = getattr(self._meta, "batch_set_attr", None)
+        if batched is not None and inode_ids is not None:
+            try:
+                batched(inode_ids=list(inode_ids), mtime=now)
+                return
+            except TypeError:  # meta without id addressing: use paths
+                pass
+            except FsError:
+                return
+        try:
+            if batched is not None:
+                batched(paths, mtime=now)
+            else:  # minimal meta double: per-path fallback
+                for p in paths:
+                    self._meta.set_attr(p, mtime=now)
+        except (FsError, TypeError):
+            pass
+
+    # -- inode cache (immutable namespaces only; see __init__) --------------
+    def _cached_inode(self, key: str):
+        if self._ino_cap <= 0:
+            return None
+        with self._ino_lock:
+            ino = self._inodes.get(key)
+            if ino is not None:
+                self._inodes.move_to_end(key)
+            return ino
+
+    def _cache_inode(self, key: str, inode) -> None:
+        if self._ino_cap <= 0:
+            return
+        with self._ino_lock:
+            self._inodes[key] = inode
+            self._inodes.move_to_end(key)
+            while len(self._inodes) > self._ino_cap:
+                self._inodes.popitem(last=False)
+
+    def invalidate(self, key: Optional[str] = None) -> None:
+        """Drop cached inode state (one key, or all with None) — blocks.py
+        calls this on a KVCACHE_STALE decode before re-statting."""
+        with self._ino_lock:
+            if key is None:
+                self._inodes.clear()
+            else:
+                self._inodes.pop(key, None)
+
     # -- byte API -----------------------------------------------------------
     def put(self, key: str, value: bytes) -> None:
-        with self._put_rec.record():
-            path = _shard_path(self.root, key)
+        with self._put_rec.record(), tagged(TrafficClass.KVCACHE):
+            path = shard_path(self.root, key)
             self._ensure_dir(path)
             res = self._meta.create(
                 path, flags=OpenFlags.WRITE | OpenFlags.CREATE
@@ -104,98 +221,114 @@ class KVCacheClient:
                 except FsError:
                     pass
                 raise
-            self._meta.close(res.inode.id, res.session_id,
-                             length_hint=n, wrote=True)
+            settled = self._meta.close(res.inode.id, res.session_id,
+                                       length_hint=n, wrote=True)
+            self._cache_inode(key, settled)
             self._write_bytes.add(n)
 
     def get(self, key: str) -> Optional[bytes]:
-        with self._get_rec.record() as op:
-            path = _shard_path(self.root, key)
-            try:
-                inode = self._meta.stat(path)
-            except FsError:
-                self._misses.add()
-                op.fail()
-                return None
+        with self._get_rec.record() as op, tagged(TrafficClass.KVCACHE):
+            path = shard_path(self.root, key)
+            inode = self._cached_inode(key)
+            if inode is None:
+                try:
+                    inode = self._meta.stat(path)
+                except FsError:
+                    self._misses.add()
+                    op.fail()
+                    return None
+                self._cache_inode(key, inode)
             data = self._fio.read(inode, 0, inode.length)
             self._hits.add()
             self._read_bytes.add(len(data))
-            if self._touch:
-                try:  # LRU refresh; losing the race to GC is harmless
-                    self._meta.set_attr(path, mtime=time.time())
-                except (FsError, TypeError):
-                    pass
+            if self._touch_on_get:
+                self._touch([path], time.time(), inode_ids=[inode.id])
             return data
 
     def batch_get(self, keys: Sequence[str]) -> List[Optional[bytes]]:
         """Stat all keys, then read every hit as ONE node-grouped chunk
-        batch (StorageClient.batch_read underneath)."""
-        paths = [_shard_path(self.root, k) for k in keys]
-        inodes = self._meta.batch_stat_by_path(paths)
-        hits = [(i, ino) for i, ino in enumerate(inodes) if ino is not None]
-        self._misses.add(len(keys) - len(hits))
-        out: List[Optional[bytes]] = [None] * len(keys)
-        if not hits:
+        batch (StorageClient.batch_read underneath) and refresh every
+        hit's mtime as ONE batched touch."""
+        with tagged(TrafficClass.KVCACHE):
+            paths = [shard_path(self.root, k) for k in keys]
+            inodes: List[object] = [self._cached_inode(k) for k in keys]
+            unknown = [i for i, ino in enumerate(inodes) if ino is None]
+            if unknown:
+                fresh = self._meta.batch_stat_by_path(
+                    [paths[i] for i in unknown])
+                for i, ino in zip(unknown, fresh):
+                    inodes[i] = ino
+                    if ino is not None:
+                        self._cache_inode(keys[i], ino)
+            hits = [(i, ino) for i, ino in enumerate(inodes)
+                    if ino is not None]
+            self._misses.add(len(keys) - len(hits))
+            out: List[Optional[bytes]] = [None] * len(keys)
+            if not hits:
+                return out
+            blobs = self._fio.batch_read_files(
+                [(ino, 0, ino.length) for _, ino in hits])
+            for (i, ino), blob in zip(hits, blobs):
+                out[i] = blob
+                self._hits.add()
+                self._read_bytes.add(len(blob))
+            if self._touch_on_get:
+                self._touch([paths[i] for i, _ in hits], time.time(),
+                            inode_ids=[ino.id for _, ino in hits])
             return out
-        blobs = self._fio.batch_read_files(
-            [(ino, 0, ino.length) for _, ino in hits])
-        now = time.time()
-        for (i, ino), blob in zip(hits, blobs):
-            out[i] = blob
-            self._hits.add()
-            self._read_bytes.add(len(blob))
-            if self._touch:
-                try:  # same LRU contract as get()
-                    self._meta.set_attr(paths[i], mtime=now)
-                except FsError:
-                    pass
-        return out
 
     def remove(self, key: str) -> bool:
-        path = _shard_path(self.root, key)
+        path = shard_path(self.root, key)
+        self.invalidate(key)
         try:
-            self._meta.remove(path)
+            with tagged(TrafficClass.KVCACHE):
+                self._meta.remove(path)
             return True
         except FsError:
             return False
 
     def contains(self, key: str) -> bool:
         try:
-            self._meta.stat(_shard_path(self.root, key))
+            self._meta.stat(shard_path(self.root, key))
             return True
         except FsError:
             return False
 
+    def batch_contains(self, keys: Sequence[str]) -> List[bool]:
+        """Presence of many keys via one batched stat — the prefix-match
+        probe (blocks.match_prefix) where per-key stats would make prefix
+        lookup O(chain length) round trips."""
+        paths = [shard_path(self.root, k) for k in keys]
+        with tagged(TrafficClass.KVCACHE):
+            inodes = self._meta.batch_stat_by_path(paths)
+        return [ino is not None for ino in inodes]
+
     # -- array API (decoder-layer KV tensors) -------------------------------
     def put_array(self, key: str, array) -> None:
-        arr = np.asarray(array)
-        name = arr.dtype.str.encode().ljust(8, b"\0")
-        header = _HEADER.pack(name, arr.ndim, 0)
-        dims = b"".join(_MAGIC_DIMS.pack(d) for d in arr.shape)
-        self.put(key, header + dims + arr.tobytes())
+        self.put(key, encode_array(array))
 
     def get_array(self, key: str):
         raw = self.get(key)
         if raw is None:
             return None
-        name, ndim, _ = _HEADER.unpack_from(raw, 0)
-        off = _HEADER.size
-        shape = tuple(
-            _MAGIC_DIMS.unpack_from(raw, off + i * _MAGIC_DIMS.size)[0]
-            for i in range(ndim)
-        )
-        off += ndim * _MAGIC_DIMS.size
-        dtype = np.dtype(name.rstrip(b"\0").decode())
-        return np.frombuffer(raw, dtype=dtype, offset=off).reshape(shape)
+        return decode_array(raw)
 
 
 class KVCacheGC:
-    """TTL garbage collector (ref README.md:48 — GC remove-op IOPS).
+    """Garbage collector (ref README.md:48 — GC remove-op IOPS), two modes:
 
-    Scans shard directories round-robin, removing entries whose mtime is
-    older than ttl_s. Each run_once() visits at most max_shards shards so a
-    GC pass never monopolizes the metadata service; removals go through the
-    normal remove path (chunks reclaimed by meta GC scan)."""
+    - ``run_once()``: TTL scan — shard directories round-robin, removing
+      entries whose mtime is older than ttl_s. Each pass visits at most
+      max_shards shards so it never monopolizes the metadata service.
+    - ``capacity_pass()``: capacity-target LRU eviction — scan the tier,
+      and while it exceeds ``capacity_bytes``, remove entries in
+      oldest-touched order (touch-on-get makes mtime the LRU axis).
+
+    Both modes skip entries under an active pin lease (leases.py): an
+    inference session holding a lease on its prefix blocks can never lose
+    them mid-decode, however old or over-budget the tier is. Removals go
+    through the normal remove path (chunks reclaimed by meta GC scan).
+    """
 
     def __init__(
         self,
@@ -204,22 +337,33 @@ class KVCacheGC:
         root: str = "/kvcache",
         ttl_s: float = 3600.0,
         max_shards: int = 64,
+        capacity_bytes: Optional[int] = None,
         client_id: str = "kvcache-gc",
     ):
         self._meta = meta
         self.root = root.rstrip("/") or "/kvcache"
         self.ttl_s = ttl_s
         self.max_shards = max_shards
+        self.capacity_bytes = capacity_bytes
         self._client_id = client_id
         self._cursor: Tuple[int, int] = (0, 0)
         self._removes = CounterRecorder("kvcache.gc.removes")
         self._scans = CounterRecorder("kvcache.gc.scans")
+        self._lease_skips = CounterRecorder("kvcache.gc.lease_skips")
 
     def _list(self, path: str) -> List[str]:
         try:
             return [e.name for e in self._meta.list_dir(path)]
         except FsError:
             return []
+
+    def _try_remove(self, path: str) -> bool:
+        try:
+            self._meta.remove(path)
+            self._removes.add()
+            return True
+        except FsError:
+            return False  # concurrent remove/touch: next pass decides
 
     def run_once(self, now: Optional[float] = None) -> int:
         """Scan up to max_shards leaf dirs; returns entries removed.
@@ -258,16 +402,61 @@ class KVCacheGC:
                         inode = self._meta.stat(path)
                     except FsError:
                         continue
-                    if now - inode.mtime >= self.ttl_s:
-                        try:
-                            self._meta.remove(path)
-                            removed += 1
-                            self._removes.add()
-                        except FsError:
-                            pass  # concurrent remove/touch: next pass decides
+                    if now - inode.mtime < self.ttl_s:
+                        continue
+                    if lease_active(inode, now):
+                        self._lease_skips.add()
+                        continue
+                    if self._try_remove(path):
+                        removed += 1
             if not wrapped and si >= len(subs):
                 ti = (ti + 1) % len(tops)
                 si = 0
                 tops_touched += 1
         self._cursor = (ti, si)
+        return removed
+
+    def scan_entries(self, now: Optional[float] = None):
+        """Full-tier enumeration -> [(mtime, length, leased, path)] —
+        shared by capacity_pass and the admin CLI stats view."""
+        now = time.time() if now is None else now
+        out = []
+        for top in self._list(self.root):
+            for sub in self._list(f"{self.root}/{top}"):
+                leaf = f"{self.root}/{top}/{sub}"
+                for name in self._list(leaf):
+                    path = f"{leaf}/{name}"
+                    try:
+                        inode = self._meta.stat(path)
+                    except FsError:
+                        continue
+                    out.append((inode.mtime, inode.length,
+                                lease_active(inode, now), path))
+        return out
+
+    def capacity_pass(self, now: Optional[float] = None,
+                      capacity_bytes: Optional[int] = None) -> int:
+        """Evict oldest-touched unleased entries until the tier's total
+        bytes fit the budget; returns entries removed. A tier that cannot
+        fit (everything leased) stops at the leased floor rather than
+        violating a lease."""
+        budget = self.capacity_bytes if capacity_bytes is None \
+            else capacity_bytes
+        if budget is None:
+            return 0
+        now = time.time() if now is None else now
+        entries = self.scan_entries(now)
+        total = sum(length for _, length, _, _ in entries)
+        if total <= budget:
+            return 0
+        removed = 0
+        for mtime, length, leased, path in sorted(entries):
+            if total <= budget:
+                break
+            if leased:
+                self._lease_skips.add()
+                continue
+            if self._try_remove(path):
+                total -= length
+                removed += 1
         return removed

@@ -1,0 +1,106 @@
+"""On-disk layout shared by every kvcache module: shard paths, the array
+wire format, the lease xattr.
+
+One module owns the formats so the fs tier (cache.py), the host tier
+(tier.py), the prefix-block store (blocks.py), the lease manager
+(leases.py) and the GC can never drift apart on what an entry looks
+like.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpu3fs.utils.result import Code
+from tpu3fs.utils.result import err as _err
+
+_HEADER = struct.Struct("<8sII")  # dtype name, ndim, magic
+#: Array-header magic. Its real job is STALENESS detection for cached
+#: inodes: a content-addressed entry GC'd out from under a client-side
+#: inode cache reads back as all zeros (removed chunks are holes), which
+#: fails the magic check deterministically — the reader invalidates and
+#: re-stats instead of serving zeros as KV state.
+ARRAY_MAGIC = 0x4B564131  # "KVA1"
+_DIM = struct.Struct("<Q")
+
+#: xattr carrying a pin lease: b"<expire_ts> <owner>". GC skips entries
+#: whose lease has not expired — an active decode can never lose its
+#: prefix blocks to TTL or capacity eviction underneath it.
+LEASE_XATTR = "kvcache.lease"
+
+
+def shard_path(root: str, key: str) -> str:
+    """Entry path: two hex levels (256x256 dirs) keep listings short at
+    billions of entries."""
+    h = hashlib.blake2b(key.encode(), digest_size=16).hexdigest()
+    return f"{root}/{h[:2]}/{h[2:4]}/{h}"
+
+
+# -- array wire format (decoder-layer KV tensors) ----------------------------
+
+def encode_array(array) -> bytes:
+    """dtype+shape header then raw bytes: zero parsing beyond a 16-byte
+    prefix, so inference servers can device_put the payload directly."""
+    arr = np.asarray(array)
+    name = arr.dtype.str.encode().ljust(8, b"\0")
+    header = _HEADER.pack(name, arr.ndim, ARRAY_MAGIC)
+    dims = b"".join(_DIM.pack(d) for d in arr.shape)
+    return header + dims + arr.tobytes()
+
+
+def decode_array(raw) -> np.ndarray:
+    """Inverse of encode_array; `raw` may be a zero-copy transport view
+    (the result is a frombuffer VIEW over it, no payload copy). Raises
+    KVCACHE_STALE on an all-hole read (see ARRAY_MAGIC), KVCACHE_CORRUPT
+    on any other malformed header."""
+    if len(raw) < _HEADER.size:
+        raise _err(Code.KVCACHE_CORRUPT, f"{len(raw)}-byte array entry")
+    name, ndim, magic = _HEADER.unpack_from(raw, 0)
+    if magic != ARRAY_MAGIC:
+        if magic == 0 and name == b"\0" * 8:
+            raise _err(Code.KVCACHE_STALE, "zero-hole read (entry GC'd)")
+        raise _err(Code.KVCACHE_CORRUPT, f"bad magic {magic:#x}")
+    off = _HEADER.size
+    shape = tuple(
+        _DIM.unpack_from(raw, off + i * _DIM.size)[0] for i in range(ndim)
+    )
+    off += ndim * _DIM.size
+    try:
+        dtype = np.dtype(name.rstrip(b"\0").decode())
+    except (TypeError, UnicodeDecodeError) as e:
+        raise _err(Code.KVCACHE_CORRUPT, f"dtype {name!r}: {e!r}")
+    return np.frombuffer(raw, dtype=dtype, offset=off).reshape(shape)
+
+
+# -- lease encoding ----------------------------------------------------------
+
+def encode_lease(expire_ts: float, owner: str) -> bytes:
+    # repr round-trips exactly: unpin compares the decoded expiry against
+    # the lease handle's to tell its own pin from a longer one stacked on
+    # the same (content-addressed) entry
+    return f"{expire_ts!r} {owner}".encode()
+
+
+def decode_lease(raw: bytes) -> Tuple[float, str]:
+    """-> (expire_ts, owner); a malformed value reads as expired."""
+    try:
+        ts_s, _, owner = bytes(raw).decode().partition(" ")
+        return float(ts_s), owner
+    except (ValueError, UnicodeDecodeError):
+        return 0.0, ""
+
+
+def lease_active(inode, now: Optional[float] = None) -> bool:
+    """Whether an entry inode carries an unexpired pin lease. The lease
+    rides the inode's xattrs, so every GC stat() already has it — the
+    check costs no extra metadata round trip."""
+    raw = getattr(inode, "xattrs", {}).get(LEASE_XATTR)
+    if raw is None:
+        return False
+    expire_ts, _ = decode_lease(raw)
+    return expire_ts > (time.time() if now is None else now)

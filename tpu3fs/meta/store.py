@@ -324,14 +324,28 @@ class MetaStore:
         return with_transaction(self._engine, op, read_only=True)
 
     def batch_stat_by_path(
-        self, paths: List[str], user: User = ROOT_USER
+        self, paths: List[str], user: User = ROOT_USER,
+        *, txn_batch: int = 64,
     ) -> List[Optional[Inode]]:
+        """Walk many paths per read-only transaction instead of one txn
+        per path (the kvcache batch_get / prefix-probe shape: 64 stats
+        used to pay 64 transaction setups). Missing/forbidden paths come
+        back as None."""
         out: List[Optional[Inode]] = []
-        for p in paths:
-            try:
-                out.append(self.stat(p, user))
-            except FsError:
-                out.append(None)
+        for base in range(0, len(paths), txn_batch):
+            chunk = paths[base:base + txn_batch]
+
+            def op(txn: ITransaction, _chunk=chunk):
+                res: List[Optional[Inode]] = []
+                for p in _chunk:
+                    try:
+                        _, _, inode = self._walk(txn, p, user)
+                        res.append(inode)
+                    except FsError:
+                        res.append(None)
+                return res
+
+            out.extend(with_transaction(self._engine, op, read_only=True))
         return out
 
     def mkdirs(
@@ -888,6 +902,64 @@ class MetaStore:
             return inode
 
         return with_transaction(self._engine, op)
+
+    def batch_set_attr(
+        self,
+        paths: Optional[List[str]] = None,
+        user: User = ROOT_USER,
+        *,
+        inode_ids: Optional[List[int]] = None,
+        atime: Optional[float] = None,
+        mtime: Optional[float] = None,
+        txn_batch: int = 64,
+    ) -> List[object]:
+        """Settle atime/mtime on MANY inodes in O(len/txn_batch) KV
+        transactions instead of one per item — the KVCache touch-on-get
+        path, where every batched read otherwise pays one metadata round
+        trip per hit. Address by path, or by inode id (``inode_ids``) to
+        skip the path walks entirely when the caller already statted —
+        like ``sync``, id addressing is the capability the stat handed
+        out. Times only (ownership changes stay single-op: chmod/chown
+        want per-path error surfaces). Per-item failures come back as
+        FsError entries without failing their batch-mates."""
+        if (paths is None) == (inode_ids is None):
+            raise _err(Code.INVALID_ARG,
+                       "batch_set_attr takes paths OR inode_ids")
+        items: List[object] = list(paths if paths is not None
+                                   else inode_ids)
+        results: List[object] = [None] * len(items)
+        for base in range(0, len(items), txn_batch):
+            chunk = list(enumerate(items[base:base + txn_batch],
+                                   start=base))
+
+            def op(txn: ITransaction, _chunk=chunk):
+                out = []
+                for i, item in _chunk:
+                    try:
+                        # checks before mutation, like _close_in_txn: a
+                        # failed item must leave no buffered writes
+                        if isinstance(item, str):
+                            _, _, inode = self._walk(txn, item, user)
+                        else:
+                            inode = self._load_inode(txn, int(item))
+                        if inode is None:
+                            raise _err(Code.META_NOT_FOUND, str(item))
+                        if not user.is_root and user.uid != inode.acl.uid:
+                            raise _err(Code.META_NO_PERMISSION, str(item))
+                        if atime is not None:
+                            inode.atime = atime
+                        if mtime is not None:
+                            inode.mtime = mtime
+                        inode.ctime = time.time()
+                        self._store_inode(txn, inode)
+                        out.append((i, inode))
+                    except FsError as e:
+                        out.append((i, e))
+                return out
+
+            for i, res in with_transaction(self._engine, op):
+                results[i] = res
+        return results
 
     # -- extended attributes (ref fuse_lowlevel_ops setxattr/getxattr/
     # listxattr/removexattr, FuseOps.cc:2580-2613) --------------------------

@@ -53,6 +53,7 @@ class TrafficClass(enum.IntEnum):
     GC = 6            # garbage collection / trash sweeps
     CKPT = 7          # training-checkpoint save/restore/archival (ckpt/)
     DATALOAD = 8      # training data loader batch reads (dataload/)
+    KVCACHE = 9       # inference KV-cache serving tier (kvcache/)
 
 
 #: Classes whose work is elastic: they self-throttle under pressure and
@@ -65,12 +66,14 @@ BACKGROUND_CLASSES = frozenset({
     TrafficClass.CKPT,
 })
 
-#: Classes subject to the per-queue share bound. DATALOAD is here but NOT
-#: in BACKGROUND_CLASSES: the training input pipeline is latency-coupled
-#: to the step loop (foreground scheduler weight), yet a misconfigured
-#: loader flood must still be unable to occupy a whole update queue and
-#: starve foreground writes.
-SHARE_BOUNDED_CLASSES = BACKGROUND_CLASSES | {TrafficClass.DATALOAD}
+#: Classes subject to the per-queue share bound. DATALOAD and KVCACHE are
+#: here but NOT in BACKGROUND_CLASSES: the training input pipeline and the
+#: inference KV-cache tier are latency-coupled to their serving loops
+#: (foreground scheduler weight), yet a misconfigured loader or cache-fill
+#: flood must still be unable to occupy a whole update queue and starve
+#: foreground writes.
+SHARE_BOUNDED_CLASSES = BACKGROUND_CLASSES | {TrafficClass.DATALOAD,
+                                              TrafficClass.KVCACHE}
 
 #: TrafficClass -> QosConfig section attribute name.
 CLASS_ATTRS: Dict[TrafficClass, str] = {
@@ -83,6 +86,7 @@ CLASS_ATTRS: Dict[TrafficClass, str] = {
     TrafficClass.GC: "gc",
     TrafficClass.CKPT: "ckpt",
     TrafficClass.DATALOAD: "dataload",
+    TrafficClass.KVCACHE: "kvcache",
 }
 
 
@@ -353,6 +357,11 @@ class QosConfig(Config):
     # share-bounded (SHARE_BOUNDED_CLASSES) so a loader flood cannot fill
     # an update queue and starve foreground writes
     dataload = _limits(0.0, 128, 0, 8, 0.5)
+    # the inference KV-cache tier serves decode-loop reads: foreground
+    # weight (8) like dataload — a token can't be generated until its
+    # prefix KV arrives — but share-bounded so a cache-fill/write-back
+    # flood cannot fill an update queue and starve foreground writes
+    kvcache = _limits(0.0, 128, 0, 8, 0.5)
 
 
 # -- admission ---------------------------------------------------------------

@@ -800,6 +800,29 @@ class SetAttrReq:
 
 
 @dataclass
+class BatchSetAttrReq:
+    """Batched time touch (atime/mtime only — see MetaStore.batch_set_attr
+    for why ownership changes stay single-op). Address by paths OR by
+    inode_ids (walk-free; exactly one list may be non-empty)."""
+
+    paths: List[str] = field(default_factory=list)
+    inode_ids: List[int] = field(default_factory=list)
+    uid: int = 0
+    gid: int = 0
+    atime: float = 0.0
+    mtime: float = 0.0
+    has_atime: bool = False
+    has_mtime: bool = False
+    token: str = ""
+
+
+@dataclass
+class BatchSetAttrRsp:
+    # per-item inode-or-error, same shape as a batched close settle
+    results: List[BatchCloseRspItem] = field(default_factory=list)
+
+
+@dataclass
 class TruncateReq:
     path: str
     length: int
@@ -986,6 +1009,24 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
         return BatchCloseRsp(out)
 
     s.method(23, "batchClose", BatchCloseReq, BatchCloseRsp, batch_close)
+
+    def batch_set_attr(r: BatchSetAttrReq) -> BatchSetAttrRsp:
+        out = []
+        for res in meta.batch_set_attr(
+                r.paths if r.paths or not r.inode_ids else None, u(r),
+                inode_ids=r.inode_ids or None,
+                atime=r.atime if r.has_atime else None,
+                mtime=r.mtime if r.has_mtime else None):
+            if isinstance(res, FsError):
+                out.append(BatchCloseRspItem(
+                    ok=False, code=int(res.code),
+                    message=res.status.message))
+            else:
+                out.append(BatchCloseRspItem(ok=True, inode=res))
+        return BatchSetAttrRsp(out)
+
+    s.method(24, "batchSetAttr", BatchSetAttrReq, BatchSetAttrRsp,
+             batch_set_attr)
     server.add_service(s)
 
 
@@ -1114,6 +1155,26 @@ class MetaRpcClient:
             has_mtime=mtime is not None,
         )
         return self._call(15, req, InodeRsp).inode
+
+    def batch_set_attr(self, paths: Optional[List[str]] = None, user=None,
+                       *, inode_ids: Optional[List[int]] = None,
+                       atime: Optional[float] = None,
+                       mtime: Optional[float] = None) -> List[object]:
+        """Touch many inodes' times in one RPC, by path or walk-free by
+        inode id (MetaStore parity: each result is an Inode or an
+        FsError; per-item failures don't poison batch-mates)."""
+        req = BatchSetAttrReq(
+            list(paths or []), list(inode_ids or []),
+            atime=atime or 0.0, mtime=mtime or 0.0,
+            has_atime=atime is not None, has_mtime=mtime is not None)
+        rsp = self._call(24, req, BatchSetAttrRsp)
+        out: List[object] = []
+        for r in rsp.results:
+            if r.ok:
+                out.append(r.inode)
+            else:
+                out.append(FsError(Status(Code(r.code), r.message)))
+        return out
 
     def prune_session(self, client_id: str) -> int:
         return self._call(16, PruneSessionReq(client_id), IntReply).value

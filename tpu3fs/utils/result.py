@@ -113,6 +113,12 @@ class Code(enum.IntEnum):
     DATALOAD_CORRUPT = 900   # record file header/index/record CRC mismatch
     DATALOAD_STATE_MISMATCH = 901  # resume state does not fit this dataset
 
+    # kvcache subsystem 10xx (tpu3fs/kvcache)
+    KVCACHE_STALE = 1000     # entry bytes fail the array-header magic —
+    #                          a cached inode outlived its entry (GC'd);
+    #                          invalidate and re-stat
+    KVCACHE_CORRUPT = 1001   # array header malformed beyond staleness
+
 
 #: Codes on which a client-side retry ladder may re-issue the request.
 RETRYABLE_CODES = frozenset(
