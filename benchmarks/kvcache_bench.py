@@ -168,6 +168,19 @@ def run_bench(*, blocks: int = 64, block_kb: int = 128,
         fs_get_us = (time.perf_counter() - t0) / 8 * 1e6
         tiered.close()
 
+        # -- write-back flush drain (rides the pipelined write path) -----
+        wb = TieredKVCache(env.cache, capacity_bytes=2 * nbytes + (1 << 20),
+                           dirty_max_bytes=nbytes + (1 << 20))
+        try:
+            t0 = time.perf_counter()
+            for i, p in enumerate(pages):
+                wb.put(f"wb/{i}", p.tobytes())
+            buffer_s = time.perf_counter() - t0
+            assert wb.flush(timeout=120.0)
+            drain_s = time.perf_counter() - t0
+        finally:
+            wb.close(flush=False)
+
         # -- prefix reuse: session B shares 3/4 of the prompt ------------
         shared = (blocks * 3 // 4) * block_tokens
         toks_b = toks[:shared] + [10_000_000 + t for t in
@@ -205,6 +218,9 @@ def run_bench(*, blocks: int = 64, block_kb: int = 128,
             "fs_get_us": round(fs_get_us, 1),
             "host_hit_speedup": round(fs_get_us / max(host_get_us, 1e-3),
                                       1),
+            "writeback_put_us": round(buffer_s / blocks * 1e6, 1),
+            "writeback_flush_gibps": round(
+                nbytes / max(drain_s, 1e-9) / (1 << 30), 3),
             "prefix_shared_blocks": match.blocks,
             "prefix_matched_tokens": match.tokens,
             "session_b_blocks_written": stored_b,

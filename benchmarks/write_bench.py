@@ -1,0 +1,232 @@
+"""write_bench: served write-path throughput matrix -> BENCH_WRITEPATH.json.
+
+Measures the zero-copy pipelined write path end to end over real sockets
+(the _RpcCluster harness from benchmarks/storage_bench) across:
+
+- transport: python | native      (both ends of each run use the same)
+- mode:      single       (one write_chunk per op, the RPC-ladder floor)
+             batch_nopipe (the PRE-PR wire form: payloads serialized
+                           inline in the envelope — assembly copy on
+                           send, copy back out on receive — per-node
+                           fan-out, no pipelining, no overlapped
+                           forward; the bench's baseline)
+             batch        (bulk-frame gather + pipelined issue +
+                           ship-default forward overlap)
+             striped      (batch with striping FORCED on, so every node
+                           group splits across pooled connections — the
+                           large-transfer shape ckpt save sees)
+
+Batched modes run INTERLEAVED (round-robin passes, accumulated time) so
+host drift hits every mode equally; default --batch 32 is the whole-file
+batch shape the ckpt saver and kvcache flusher actually produce.
+
+Every mode writes through the full CRAQ chain (replicas=2 by default),
+so the numbers include replication: the chain forward re-ships every
+byte to the successor.
+
+Usage:
+  python -m benchmarks.write_bench [--chunks 64] [--size 1048576]
+      [--batch 8] [--fast] [--out BENCH_WRITEPATH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+from benchmarks.storage_bench import _RpcCluster, FILE_ID
+from tpu3fs.client.storage_client import RetryOptions
+from tpu3fs.storage.types import ChunkId
+
+_FAST_RETRY = RetryOptions(backoff_base_s=0.001, backoff_max_s=0.05)
+
+
+def _gibps(nbytes: int, dt: float) -> float:
+    return round(nbytes / max(dt, 1e-9) / (1 << 30), 3)
+
+
+def _payloads(chunks: int, size: int):
+    base = bytes(range(256)) * (size // 256)
+    return [base[i:] + base[:i] for i in (0, 1, 2, 3)], base
+
+
+def _bench_write_modes(cluster, *, chunks: int, size: int, batch: int,
+                       transport: str, rounds: int) -> list:
+    rows = []
+    chain_ids = cluster.chain_ids
+    variants, base = _payloads(chunks, size)
+
+    def writes_for(idxs, rnd):
+        payload = variants[rnd % len(variants)]
+        return [(chain_ids[i % len(chain_ids)], ChunkId(FILE_ID, i), 0,
+                 payload) for i in idxs]
+
+    # single: the per-op RPC floor
+    client = cluster.storage_client(retry=_FAST_RETRY)
+    t0 = time.perf_counter()
+    n = 0
+    for rnd in range(rounds):
+        payload = variants[rnd % len(variants)]
+        for i in range(chunks):
+            r = client.write_chunk(chain_ids[i % len(chain_ids)],
+                                   ChunkId(FILE_ID, i), 0, payload,
+                                   chunk_size=size)
+            assert r.ok, r
+            n += 1
+    rows.append({"metric": "writepath_single", "transport": transport,
+                 "value": _gibps(n * size, time.perf_counter() - t0),
+                 "unit": "GiB/s", "ops": n})
+    client.close()
+
+    # batched modes, INTERLEAVED round-robin so host drift (CPU freq,
+    # noisy neighbors — this class of host swings ~2x minute-to-minute)
+    # lands on every mode equally; per-mode time accumulates across the
+    # alternating passes. Mode levers:
+    #   batch_nopipe — the pre-PR wire form: payloads serialized INLINE
+    #     in the envelope (serde assembly copy on send, payload copied
+    #     back out on receive, python handler path), per-node fan-out,
+    #     no pipelining, no overlapped forward
+    #   batch   — bulk-frame gather + pipelined issue (ship defaults)
+    #   striped — batch with striping FORCED on, every node group split
+    #     across pooled connections (the large-transfer ckpt-save shape)
+    class _Mode:
+        def __init__(self, label, *, pipelined, overlap, force_stripes,
+                     inline=False):
+            self.label, self.overlap = label, overlap
+            self.spent, self.ops = 0.0, 0
+            if inline:
+                os.environ["TPU3FS_RPC_INLINE"] = "1"
+            try:
+                self.client = cluster.storage_client(retry=_FAST_RETRY)
+            finally:
+                os.environ.pop("TPU3FS_RPC_INLINE", None)
+            m = self.client._messenger
+            m.write_pipelined = pipelined
+            if force_stripes and hasattr(m, "_write_stripe_min_bytes"):
+                m._write_stripe_min_bytes = size  # any 2-op group stripes
+
+        def one_pass(self, rnd):
+            # overlap is a server-side dynamic env read: set per pass
+            if self.overlap is None:  # ship-default (adaptive)
+                os.environ.pop("TPU3FS_WRITE_OVERLAP", None)
+            else:
+                os.environ["TPU3FS_WRITE_OVERLAP"] = \
+                    "1" if self.overlap else "0"
+            t0 = time.perf_counter()
+            for lo in range(0, chunks, batch):
+                got = self.client.batch_write(
+                    writes_for(range(lo, min(lo + batch, chunks)), rnd),
+                    chunk_size=size)
+                assert all(r.ok for r in got), [r for r in got
+                                               if not r.ok][:1]
+                self.ops += len(got)
+            self.spent += time.perf_counter() - t0
+
+    prev = os.environ.get("TPU3FS_WRITE_OVERLAP")
+    modes = [
+        _Mode("batch_nopipe", pipelined=False, overlap=False,
+              force_stripes=False, inline=True),
+        _Mode("batch", pipelined=True, overlap=None, force_stripes=False),
+        _Mode("striped", pipelined=True, overlap=None, force_stripes=True),
+    ]
+    try:
+        for mode in modes:
+            mode.one_pass(0)        # warm every client/connection pool
+            mode.spent, mode.ops = 0.0, 0
+        for rnd in range(rounds):
+            for mode in modes:
+                mode.one_pass(rnd + 1)
+    finally:
+        for mode in modes:
+            mode.client.close()
+        if prev is None:
+            os.environ.pop("TPU3FS_WRITE_OVERLAP", None)
+        else:
+            os.environ["TPU3FS_WRITE_OVERLAP"] = prev
+    for mode in modes:
+        rows.append({"metric": f"writepath_{mode.label}",
+                     "transport": transport,
+                     "value": _gibps(mode.ops * size, mode.spent),
+                     "unit": "GiB/s", "ops": mode.ops, "batch": batch})
+    return rows
+
+
+def run(*, chunks: int = 64, size: int = 1 << 20, batch: int = 32,
+        replicas: int = 2, chains: int = 4, rounds: int = 4,
+        transports=("python", "native")) -> list:
+    # warm the mem engines' shared content pool (engine preallocation —
+    # see benchmarks/ckpt_bench.py): install copies land in recycled
+    # warm extents instead of paying this host's first-touch page cost
+    os.environ.setdefault("TPU3FS_MEM_PREALLOC_MB", "128")
+    results = []
+    for transport in transports:
+        engine = "native" if transport == "native" else "mem"
+        try:
+            cluster = _RpcCluster(replicas=replicas, chains=chains,
+                                  size=size, transport=transport,
+                                  engine=engine)
+        except Exception as e:  # no toolchain: report, keep the matrix
+            results.append({"metric": "writepath_error",
+                            "transport": transport, "error": repr(e)[:200]})
+            print(json.dumps(results[-1]), flush=True)
+            continue
+        try:
+            for row in _bench_write_modes(cluster, chunks=chunks, size=size,
+                                          batch=batch, transport=transport,
+                                          rounds=rounds):
+                row["chunk_size"] = size
+                row["engine"] = engine
+                row["replicas"] = replicas
+                results.append(row)
+                print(json.dumps(row), flush=True)
+        finally:
+            cluster.close()
+    # headline ratio per transport: striped pipelined vs the baseline
+    by = {(r["metric"], r["transport"]): r.get("value")
+          for r in results if "value" in r}
+    for transport in transports:
+        nopipe = by.get(("writepath_batch_nopipe", transport))
+        best = max(filter(None, (by.get(("writepath_batch", transport)),
+                                 by.get(("writepath_striped", transport)))),
+                   default=None)
+        if nopipe and best:
+            row = {"metric": "writepath_speedup_vs_nopipe",
+                   "transport": transport,
+                   "value": round(best / nopipe, 2), "unit": "x"}
+            results.append(row)
+            print(json.dumps(row), flush=True)
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunks", type=int, default=64)
+    ap.add_argument("--size", type=int, default=1 << 20)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--chains", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--fast", action="store_true",
+                    help="tiny smoke configuration (CI)")
+    ap.add_argument("--transport", choices=["python", "native", "both"],
+                    default="both")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    kw = dict(chunks=args.chunks, size=args.size, batch=args.batch,
+              replicas=args.replicas, chains=args.chains,
+              rounds=args.rounds)
+    if args.fast:
+        kw.update(chunks=16, size=64 << 10, rounds=1)
+    if args.transport != "both":
+        kw["transports"] = (args.transport,)
+    results = run(**kw)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"rows": results}, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

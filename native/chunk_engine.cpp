@@ -1488,7 +1488,7 @@ int ce_compact(void* h) {
 
 // ABI fingerprint scanned as raw bytes by the Python loader BEFORE dlopen;
 // bump in lockstep with native_engine._ABI_TAG on any layout change
-__attribute__((used)) const char kAbiTag[] = "TPU3FS_ENGINE_ABI_4";
+__attribute__((used)) const char kAbiTag[] = "TPU3FS_ENGINE_ABI_5";
 
 uint32_t ce_crc32c(const uint8_t* data, uint64_t n) { return crc32c(data, n); }
 uint32_t ce_crc32c_seed(const uint8_t* data, uint64_t n, uint32_t crc) {
@@ -1797,7 +1797,11 @@ struct CUpOp {
   uint32_t data_len;
   uint32_t chunk_size;
   uint32_t aux;        // opaque tag stored with the staged content
-  uint64_t data_off;   // offset of this op's payload in the shared blob
+  uint64_t data_off;   // offset of this op's payload in the shared blob;
+                       // when the batch call's blob is NULL, this is the
+                       // op payload's ABSOLUTE ADDRESS instead (iovec
+                       // mode: callers pass per-op buffer pointers and
+                       // skip the blob concatenation copy entirely)
   uint64_t update_ver; // 0 = assign committed+1 (head write)
   uint32_t expected_crc;  // content CRC to enforce when flags & 2
   uint32_t pad1;
@@ -1819,6 +1823,14 @@ struct CReadOp {
   int32_t length;      // -1 = to end of committed content
 };
 
+// op payload resolution: shared-blob offset, or absolute pointer when the
+// caller passed blob == NULL (iovec mode — no concatenation copy)
+static inline const uint8_t* op_payload(const uint8_t* blob,
+                                        const CUpOp& op) {
+  return blob ? blob + op.data_off
+              : reinterpret_cast<const uint8_t*>(uintptr_t(op.data_off));
+}
+
 int ce_batch_update(void* h, uint64_t chain_ver, const uint8_t* blob,
                     const CUpOp* ops, COpResult* res, int n) {
   auto* e = static_cast<Engine*>(h);
@@ -1833,7 +1845,7 @@ int ce_batch_update(void* h, uint64_t chain_ver, const uint8_t* blob,
     r = COpResult{};
     uint64_t ver = op.update_ver;
     uint32_t len = 0, crc = 0;
-    r.rc = e->update(k, &ver, chain_ver, blob + op.data_off, op.data_len,
+    r.rc = e->update(k, &ver, chain_ver, op_payload(blob, op), op.data_len,
                      op.offset,
                      (op.flags & 4) ? 2 : (op.flags & 1),
                      op.chunk_size, op.aux, &len,
@@ -1866,7 +1878,7 @@ int ce_batch_write(void* h, uint64_t chain_ver, const uint8_t* blob,
     r = COpResult{};
     uint64_t ver = op.update_ver;
     uint32_t len = 0, crc = 0;
-    r.rc = e->update(k, &ver, chain_ver, blob + op.data_off, op.data_len,
+    r.rc = e->update(k, &ver, chain_ver, op_payload(blob, op), op.data_len,
                      op.offset, (op.flags & 4) ? 2 : (op.flags & 1),
                      op.chunk_size, op.aux, &len, &crc,
                      (op.flags >> 1) & 1, op.expected_crc);

@@ -691,14 +691,17 @@ struct FpWReq {
   int64_t from_target = 0;
 };
 
-// decode ONE WriteReq (12 fields; serde reflection order of
+// decode ONE WriteReq (13 fields; serde reflection order of
 // storage/craq.py WriteReq). Returns false on any shape mismatch OR a
 // non-empty inline data field (bulk mode keeps payloads out of the
-// envelope; inline payloads take the Python path).
+// envelope; inline payloads take the Python path). The trailing
+// trusted_crc is decoded and DISCARDED: it is only ever meaningful for
+// in-process forwards, and anything arriving over a socket must be
+// re-verified anyway.
 bool fp_decode_write_one(const uint8_t* d, size_t len, size_t& pos,
                          FpWReq& r) {
   uint64_t nf;
-  if (!get_uvarint(d, len, pos, nf) || nf != 12) return false;
+  if (!get_uvarint(d, len, pos, nf) || nf != 13) return false;
   int64_t tmp;
   if (!get_int(d, len, pos, r.chain_id)) return false;
   if (!get_int(d, len, pos, r.chain_ver)) return false;
@@ -723,6 +726,7 @@ bool fp_decode_write_one(const uint8_t* d, size_t len, size_t& pos,
   if (pos >= len) return false;
   r.full_replace = d[pos++] != 0;  // bool = one raw byte
   if (!get_int(d, len, pos, r.from_target)) return false;
+  if (!get_int(d, len, pos, tmp)) return false;  // trusted_crc (ignored)
   return true;
 }
 
@@ -1257,6 +1261,9 @@ void loop_main(Server* s) {
           if (cfd < 0) break;
           int one = 1;
           setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          int bufsz = 1 << 20;  // MiB-scale bulk frames: fewer syscalls
+          setsockopt(cfd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+          setsockopt(cfd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
           set_nonblocking(cfd, true);
           auto conn = std::make_shared<Conn>();
           conn->fd = cfd;
@@ -1478,6 +1485,9 @@ void* tpu3fs_rpc_client_connect(const char* host, int port,
   setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int bufsz = 1 << 20;  // MiB-scale bulk frames: fewer syscalls
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
   auto* c = new Client();
   c->fd = fd;
   c->call_timeout_ms = call_timeout_ms;

@@ -212,6 +212,96 @@ class TestReadIntoBoundaries:
         ])
         assert got == [a, b[cs - 3:cs + 4], a[cs:], b]
 
+    def test_write_boundaries_cr_spanning_chunks_and_tails(self):
+        """Write-side twin of the range tests: batched writes landing at
+        chunk edges, offsets and short tails must read back byte-exact
+        through ranged reads (write-then-ranged-read equivalence)."""
+        rng = np.random.default_rng(31)
+        fab = self._fab()
+        fio = fab.file_client()
+        cs = self.CS
+        from tpu3fs.meta.store import OpenFlags
+
+        cases = [
+            (0, cs),                  # exactly one chunk
+            (cs - 7, 14),             # straddles chunk 0/1 edge
+            (cs * 2 - 100, cs + 200),  # spans three chunks
+            (cs * 3, cs // 2),        # short tail chunk
+            (5, 3 * cs + 11),         # offset start spanning everything
+        ]
+        base = rng.integers(0, 256, cs * 4, dtype=np.uint8).tobytes()
+        inode = _file_with_data(fab, "/wb", base)
+        shadow = bytearray(base)
+        for off, size in cases:
+            patch = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+            assert fio.write(inode, off, patch) == size
+            shadow[off:off + size] = patch
+            # ranged read-back across the patch's boundaries
+            lo = max(0, off - 3)
+            n = min(len(shadow) - lo, size + 6)
+            assert fio.read(inode, lo, n) == bytes(shadow[lo:lo + n]), \
+                (off, size)
+        fab.close()
+
+    def test_write_boundaries_ec_stripes_and_partial_tails(self):
+        """EC(3,1) writes: full stripes ride write_stripes, partials the
+        read-modify-write ladder; both must read back exactly across
+        stripe and shard boundaries."""
+        rng = np.random.default_rng(32)
+        fab = self._fab(ec_k=3, ec_m=1, num_chains=1)
+        fio = fab.file_client()
+        cs = self.CS
+        shard = -(-cs // 3)
+        base = rng.integers(0, 256, cs * 3, dtype=np.uint8).tobytes()
+        inode = _file_with_data(fab, "/wbe", base)
+        shadow = bytearray(base)
+        cases = [
+            (0, cs),                  # whole stripe (write_stripes path)
+            (cs, 2 * cs),             # two whole stripes in one batch
+            (shard - 5, 10),          # partial: straddles shard 0/1 edge
+            (cs - 9, 18),             # partial: straddles stripe 0/1 edge
+            (cs * 2 + 7, cs // 3),    # partial inside the last stripe
+        ]
+        for off, size in cases:
+            patch = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+            assert fio.write(inode, off, patch) == size
+            shadow[off:off + size] = patch
+            lo = max(0, off - 3)
+            n = min(len(shadow) - lo, size + 6)
+            assert fio.read(inode, lo, n) == bytes(shadow[lo:lo + n]), \
+                (off, size)
+        assert fio.read(inode, 0, len(shadow)) == bytes(shadow)
+        fab.close()
+
+    def test_batch_write_files_mixed_cr_and_ec_write_read_equivalence(self):
+        """ONE batch_write_files spanning a CR file and an EC file: every
+        op gathers into the batched fan-out, and ranged reads reproduce
+        each file exactly (including a partial EC tail stripe)."""
+        from tpu3fs.meta.store import OpenFlags
+
+        rng = np.random.default_rng(33)
+        cs = self.CS
+        fab = self._fab(ec_k=3, ec_m=1, num_chains=2)
+        fio = fab.file_client()
+        a = rng.integers(0, 256, 2 * cs + 123, dtype=np.uint8).tobytes()
+        b = rng.integers(0, 256, cs + cs // 2, dtype=np.uint8).tobytes()
+        ra = fab.meta.create("/bwa", flags=OpenFlags.WRITE, client_id="t")
+        rb = fab.meta.create("/bwb", flags=OpenFlags.WRITE, client_id="t",
+                             stripe=1)
+        counts = fio.batch_write_files(
+            [(ra.inode, 0, a), (rb.inode, 0, b)])
+        assert counts == [len(a), len(b)]
+        ia = fab.meta.close(ra.inode.id, ra.session_id, length_hint=len(a),
+                            wrote=True)
+        ib = fab.meta.close(rb.inode.id, rb.session_id, length_hint=len(b),
+                            wrote=True)
+        assert fio.read(ia, 0, len(a)) == a
+        assert fio.read(ib, 0, len(b)) == b
+        # ranged equivalence across chunk/stripe edges
+        assert fio.read(ia, cs - 3, 7) == a[cs - 3:cs + 4]
+        assert fio.read(ib, cs - 3, 7) == b[cs - 3:cs + 4]
+        fab.close()
+
     def test_read_into_zero_and_hole_semantics(self):
         fab = self._fab()
         from tpu3fs.meta.store import OpenFlags

@@ -148,6 +148,24 @@ class TestReadBench:
         assert on["prefetch_hits"] + on["prefetch_misses"] > 0
 
 
+class TestWriteBench:
+    """benchmarks/write_bench fast-mode smoke: the full mode matrix over
+    real sockets (python transport; native is exercised in its own
+    tier-2 runs), pre-PR inline baseline included, speedup row present."""
+
+    def test_small_run(self):
+        from benchmarks.write_bench import run as write_bench
+
+        rows = write_bench(chunks=8, size=32 << 10, batch=4, rounds=1,
+                           chains=2, replicas=2, transports=("python",))
+        by = {r["metric"]: r for r in rows if "value" in r}
+        for m in ("writepath_single", "writepath_batch_nopipe",
+                  "writepath_batch", "writepath_striped"):
+            assert by[m]["value"] > 0, by
+            assert by[m]["ops"] == 8, by
+        assert "writepath_speedup_vs_nopipe" in by
+
+
 class TestNorthstarBench:
     """BASELINE.md headline workloads at test sizes: each phase must
     produce its e2e_* field and verify its own data integrity."""

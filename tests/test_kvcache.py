@@ -300,7 +300,14 @@ class TestTieredKVCache:
             stall.wait(30.0)
             return real_put(key, value)
 
+        real_batch_put = base.batch_put
+
+        def stalled_batch_put(items):
+            stall.wait(30.0)
+            return real_batch_put(items)
+
         base.put = stalled_put
+        base.batch_put = stalled_batch_put  # the flusher's batched drain
         tc = TieredKVCache(base, dirty_max_bytes=4096)
         try:
             for i in range(4):  # 4 x 1KiB fill the bound
@@ -324,6 +331,88 @@ class TestTieredKVCache:
             t.join(5.0)
         finally:
             stall.set()
+            tc.close()
+            fab.close()
+
+    def test_flush_error_budget_poisons_put(self, cache):
+        """Carried follow-up from PR 5: after N consecutive failed flush
+        cycles the write-back buffer POISONS — put() raises
+        KVCACHE_FLUSH_POISONED to the producer instead of buffering
+        silently forever; a successful flush clears the poison."""
+        from tpu3fs.utils.result import Code, FsError, Status
+
+        fab, base = cache
+        dead = threading.Event()
+        dead.set()
+        real_put, real_batch_put = base.put, base.batch_put
+
+        def failing_put(key, value):
+            if dead.is_set():
+                raise FsError(Status(Code.TARGET_OFFLINE, "storage down"))
+            return real_put(key, value)
+
+        def failing_batch_put(items):
+            if dead.is_set():
+                raise FsError(Status(Code.TARGET_OFFLINE, "storage down"))
+            return real_batch_put(items)
+
+        base.put = failing_put
+        base.batch_put = failing_batch_put
+        tc = TieredKVCache(base, flush_error_budget=3)
+        try:
+            tc.put("p/0", b"a" * 100)  # buffered; flusher starts failing
+            deadline = time.monotonic() + 10.0
+            while not tc.flush_poisoned and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert tc.flush_poisoned
+            with pytest.raises(FsError) as ei:
+                tc.put("p/1", b"b" * 100)
+            assert ei.value.code == Code.KVCACHE_FLUSH_POISONED
+            # reads of the buffered value still work (read-your-writes)
+            assert tc.get("p/0") == b"a" * 100
+            # storage recovers: the flusher drains and the poison clears
+            dead.clear()
+            assert tc.flush(10.0)
+            assert not tc.flush_poisoned
+            tc.put("p/2", b"c" * 100)  # accepted again
+            assert tc.flush(10.0)
+            assert base.get("p/2") == b"c" * 100
+        finally:
+            dead.clear()
+            tc.close()
+            fab.close()
+
+    def test_flusher_drains_via_batch_put(self, cache):
+        """The write-back flusher drains the dirty buffer as ONE batched
+        striped write (batch_put -> batch_write_files), not per-key
+        puts."""
+        fab, base = cache
+        batches = []
+        real_batch_put = base.batch_put
+
+        def spy_batch_put(items):
+            batches.append(len(list(items)))
+            return real_batch_put(items)
+
+        base.batch_put = spy_batch_put
+        tc = TieredKVCache(base, flush_batch=8)
+        try:
+            gate = threading.Event()
+            real_put = base.put
+
+            def gated_put(key, value):  # hold the loop so puts pile up
+                gate.wait(5.0)
+                return real_put(key, value)
+
+            base.put = gated_put
+            for i in range(6):
+                tc.put(f"bf/{i}", bytes([i]) * 500)
+            gate.set()
+            assert tc.flush(10.0)
+            assert any(n > 1 for n in batches), batches
+            for i in range(6):
+                assert base.get(f"bf/{i}") == bytes([i]) * 500
+        finally:
             tc.close()
             fab.close()
 

@@ -1,10 +1,13 @@
-"""Static check: the served read path must stay copy-free.
+"""Static check: the served read AND write paths must stay copy-free.
 
-The zero-copy read pipeline (docs/readpath.md) holds only as long as
-nobody quietly re-introduces a payload copy on the wire path — a single
-``bytes(seg)`` on a 1 MiB segment silently costs more than the whole serde
-envelope. This check walks the functions that make up the served read
-path and flags the three ways payload copies sneak back in:
+The zero-copy pipelines (docs/readpath.md, docs/writepath.md) hold only
+as long as nobody quietly re-introduces a payload copy on the wire path —
+a single ``bytes(seg)`` on a 1 MiB segment silently costs more than the
+whole serde envelope. This check walks the functions that make up the
+served read path (engine view -> gather reply -> client receive view)
+and the served write path (client bulk-frame gather -> server
+receive-view attach -> engine hand-off -> streaming chain forward) and
+flags the three ways payload copies sneak back in:
 
 - ``bytes(...)`` calls (materializing a view),
 - ``b"".join(...)`` / ``b''.join(...)`` (concatenation),
@@ -37,13 +40,29 @@ HOT_PATH: List[Tuple[str, List[str]]] = [
       "start_call", "finish_call"]),
     ("tpu3fs/rpc/services.py",
      ["_read_h", "_batch_read_h", "_attach_read_segs",
-      "batch_read_pipelined"]),
-    ("tpu3fs/storage/craq.py", ["_batch_read_impl"]),
+      "batch_read_pipelined",
+      # write path: bulk-frame receive attach + handler unwrap + the
+      # client-side striped pipelined gather fan-out
+      "_attach", "_write_h", "_batch_write_h", "_one_write",
+      "_batch_write", "batch_write_pipelined"]),
+    ("tpu3fs/storage/craq.py",
+     ["_batch_read_impl",
+      # write path: batched stage/forward/commit pipeline + the streaming
+      # chain forward (the received views are re-gathered onward)
+      "_handle_batch_update", "_forward_batch", "_make_forward_req"]),
     ("tpu3fs/storage/engine.py", ["batch_read_views"]),
-    ("tpu3fs/storage/native_engine.py", ["batch_read_views"]),
-    ("tpu3fs/client/storage_client.py", ["batch_read"]),
+    ("tpu3fs/storage/native_engine.py",
+     ["batch_read_views",
+      # write path: iovec-mode engine hand-off (no blob concatenation)
+      "batch_update", "_payload_addr"]),
+    ("tpu3fs/client/storage_client.py",
+     ["batch_read",
+      # write path: pipelined batch fan-out + batched stripe writes
+      "batch_write", "write_stripes", "_send_shard_batches"]),
     ("tpu3fs/client/file_io.py",
-     ["read_into", "_batch_read_files_direct", "_fetch_window"]),
+     ["read_into", "_batch_read_files_direct", "_fetch_window",
+      # write path: user-buffer gather into per-chunk views
+      "write", "batch_write_files", "_byte_view", "_flush_cr"]),
     # the dataload batch-assembly hot loop: records must be sliced out of
     # fetched spans as views and land in the batch array in ONE copy
     ("tpu3fs/dataload/recordio.py", ["read_batch", "plan_coalesced"]),
@@ -51,8 +70,11 @@ HOT_PATH: List[Tuple[str, List[str]]] = [
      ["_fetch", "_assemble_array", "_read_with_backoff"]),
     ("tpu3fs/dataload/dataset.py", ["read_samples"]),
     # the kvcache serving read path: host-tier hits and batched fill must
-    # hand buffers through as views; block decode is a frombuffer view
-    ("tpu3fs/kvcache/tier.py", ["batch_get", "_local", "_fill"]),
+    # hand buffers through as views; block decode is a frombuffer view.
+    # write-back: the flusher drains as one batched striped write
+    ("tpu3fs/kvcache/tier.py",
+     ["batch_get", "_local", "_fill", "_flush_items"]),
+    ("tpu3fs/kvcache/cache.py", ["batch_put"]),
     ("tpu3fs/kvcache/blocks.py", ["get_blocks"]),
     ("tpu3fs/kvcache/layout.py", ["decode_array"]),
 ]
@@ -112,8 +134,8 @@ def check() -> List[str]:
                     hit = "+= payload concatenation"
                 if hit:
                     errors.append(
-                        f"{rel}:{ln} in {fname}: {hit} on the served "
-                        f"read path: {line.strip()!r} — make it a "
+                        f"{rel}:{ln} in {fname}: {hit} on a served "
+                        f"hot path: {line.strip()!r} — make it a "
                         "view/gather, or annotate '# copy-ok: <why>'")
     return errors
 
@@ -126,7 +148,7 @@ def main() -> int:
         for e in errors:
             print(f"  - {e}", file=sys.stderr)
         return 1
-    print("check_copy_hotpath: served read path is copy-clean")
+    print("check_copy_hotpath: served read/write paths are copy-clean")
     return 0
 
 

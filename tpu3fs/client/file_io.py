@@ -13,8 +13,22 @@ from typing import Iterable, List, Optional, Tuple
 
 from tpu3fs.client.storage_client import StorageClient
 from tpu3fs.meta.types import Inode, Layout
-from tpu3fs.storage.types import ChunkId
+from tpu3fs.storage.types import Checksum, ChunkId
 from tpu3fs.utils.result import Code, FsError, Status
+
+
+def _byte_view(data) -> memoryview:
+    """A flat byte view of any caller buffer (bytes / bytearray /
+    memoryview / C-contiguous ndarray) — the no-copy gather entry of the
+    write path. Non-contiguous buffers take one owned copy (they cannot
+    be scattered into iovecs)."""
+    mv = memoryview(data)
+    if mv.format != "B" or mv.ndim != 1:
+        try:
+            mv = mv.cast("B")
+        except TypeError:
+            mv = memoryview(bytes(mv))  # copy-ok: non-contiguous source
+    return mv
 
 
 class FileIoClient:
@@ -127,11 +141,19 @@ class FileIoClient:
         if self._prefetch is not None:
             # write-through invalidation: cached windows may now be stale
             self._prefetch.invalidate(inode.id)
+        # gather: per-chunk parts are VIEWS of the caller's buffer
+        # (bytes/bytearray/ndarray), not slices — they ride the bulk
+        # request frames straight into sendmsg with no assembly copy
+        mv = _byte_view(data)
         pos = 0
         kind: Optional[str] = None
         run: list = []
-        for idx, chain_id, in_off, n in self._split(layout, offset, len(data)):
-            part = data[pos : pos + n]
+        for idx, chain_id, in_off, n in self._split(layout, offset, len(mv)):
+            # a part covering the whole caller buffer passes the original
+            # bytes object through: the native transport borrows a bytes
+            # pointer for free but must copy a read-only view
+            part = data if (pos == 0 and n == len(mv)
+                            and type(data) is bytes) else mv[pos : pos + n]
             pos += n
             if self._is_ec(chain_id):
                 if in_off == 0 and n == cs:
@@ -147,7 +169,113 @@ class FileIoClient:
                 kind, run = seg_kind, []
             run.append(seg)
         flush(kind, run)
-        return len(data)
+        return len(mv)
+
+    def batch_write_files(
+        self, files: List[Tuple[Inode, int, bytes]], *,
+        with_checksums: bool = False,
+    ):
+        """Write many (inode, offset, data) ranges as ONE node-grouped
+        batch through StorageClient.batch_write — the write-side twin of
+        batch_read_files (ckpt save / kvcache write-back: batching across
+        files is what amortizes round trips and feeds the striped
+        pipelined fan-out). CR chunk ops across ALL files gather into one
+        batch; full EC stripes group into one write_stripes per chain;
+        partial EC stripes take the read-modify-write ladder. Any failed
+        op raises (after batch_write's internal retry ladder); on success
+        returns per-file byte counts.
+
+        ``with_checksums=True`` returns ``(counts, checksums)`` where
+        checksums[i] is the CRC32C of file i's WRITTEN range, built from
+        ONE pooled native pass over the per-chunk slices (combined with
+        crc32c_combine — no second content pass). The same per-chunk CRCs
+        ride down to batch_write as trusted CRCs, so an in-process chain
+        (the fabric) does not checksum the payload again anywhere: the
+        ckpt saver turns them directly into manifest shard CRCs."""
+        cr_runs: List[Tuple[list, int, list]] = []  # (ops, chunk_size, crc idxs)
+        cr_ops: List[Tuple[int, ChunkId, int, object]] = []
+        cr_idx: List[int] = []
+        cr_cs: Optional[int] = None
+        ec_full: dict = {}          # chain_id -> [(ChunkId, part)]
+        ec_partial: list = []       # (inode, chain_id, idx, in_off, part, cs)
+        counts: List[int] = []
+        parts: List[object] = []    # every written slice, file order
+        spans: List[Tuple[int, int]] = []  # per file: [lo, hi) into parts
+        for inode, offset, data in files:
+            layout = inode.layout
+            assert layout is not None
+            if self._prefetch is not None:
+                self._prefetch.invalidate(inode.id)
+            mv = _byte_view(data)
+            counts.append(len(mv))
+            cs = layout.chunk_size
+            pos = 0
+            lo = len(parts)
+            for idx, chain_id, in_off, n in self._split(
+                    layout, offset, len(mv)):
+                part = data if (pos == 0 and n == len(mv)
+                                and type(data) is bytes) \
+                    else mv[pos : pos + n]
+                pos += n
+                parts.append(part)
+                if self._is_ec(chain_id):
+                    if in_off == 0 and n == cs:
+                        ec_full.setdefault(chain_id, []).append(
+                            (ChunkId(inode.id, idx), part))
+                    else:
+                        ec_partial.append(
+                            (inode, chain_id, idx, in_off, part, cs))
+                else:
+                    if cr_cs is None:
+                        cr_cs = cs
+                    elif cr_cs != cs:
+                        # batch_write carries ONE chunk_size; mixed-layout
+                        # batches close the run so far and start a new one
+                        cr_runs.append((cr_ops, cr_cs, cr_idx))
+                        cr_ops, cr_idx, cr_cs = [], [], cs
+                    cr_ops.append((chain_id, ChunkId(inode.id, idx),
+                                   in_off, part))
+                    cr_idx.append(len(parts) - 1)
+            spans.append((lo, len(parts)))
+        if cr_ops:
+            cr_runs.append((cr_ops, cr_cs, cr_idx))
+        part_crcs: Optional[List] = None
+        sums: Optional[List] = None
+        if with_checksums:
+            part_crcs = Checksum.of_many(parts) if parts else []
+            sums = []
+            for lo, hi in spans:
+                acc = Checksum()
+                for c in part_crcs[lo:hi]:
+                    acc = acc.combine(c)
+                sums.append(acc)
+        for ops, run_cs, idxs in cr_runs:
+            self._flush_cr(ops, run_cs,
+                           op_crcs=([part_crcs[j].value for j in idxs]
+                                    if part_crcs is not None else None))
+        for chain_id, items in ec_full.items():
+            # full stripes only land here, so any part's length IS the
+            # layout chunk size
+            for reply in self._storage.write_stripes(
+                    chain_id, items, chunk_size=len(items[0][1])):
+                if not reply.ok:
+                    raise FsError(Status(reply.code, reply.message))
+        for inode, chain_id, idx, in_off, part, cs in ec_partial:
+            reply = self._write_ec_chunk(inode, chain_id, idx, in_off,
+                                         part, cs)
+            if not reply.ok:
+                raise FsError(Status(reply.code, reply.message))
+        if with_checksums:
+            return counts, sums
+        return counts
+
+    def _flush_cr(self, ops, chunk_size, op_crcs=None) -> None:
+        if not ops:
+            return
+        for reply in self._storage.batch_write(ops, chunk_size=chunk_size,
+                                               op_crcs=op_crcs):
+            if not reply.ok:
+                raise FsError(Status(reply.code, reply.message))
 
     def _write_ec_chunk(self, inode: Inode, chain_id: int, idx: int,
                         in_off: int, part: bytes, chunk_size: int):

@@ -81,6 +81,10 @@ class _Node:
 
 class Fabric:
     MGMTD_NODE_ID = 1
+    # direct-dispatch marker: chain forwards through `send` stay inside
+    # this process, so CRAQ hands successors its owned staged buffers +
+    # checksums (trusted forward) instead of re-shipping/re-verifying
+    in_process = True
     FIRST_STORAGE_NODE_ID = 10
     FIRST_TARGET_ID = 1000
     FIRST_CHAIN_ID = 900_000

@@ -226,6 +226,51 @@ class KVCacheClient:
             self._cache_inode(key, settled)
             self._write_bytes.add(n)
 
+    def batch_put(self, items) -> None:
+        """Write many (key, value) entries as ONE node-grouped striped
+        batch (FileIoClient.batch_write_files) and settle the sessions in
+        one batch_close — the write-back flusher's drain path, mirroring
+        batch_get's shape. Raises on the first failed entry."""
+        from tpu3fs.meta.store import BatchCloseItem
+
+        items = list(items)
+        if not items:
+            return
+        with self._put_rec.record(), tagged(TrafficClass.KVCACHE):
+            opened: List[Tuple[str, object]] = []
+            try:
+                for key, _ in items:
+                    path = shard_path(self.root, key)
+                    self._ensure_dir(path)
+                    opened.append((key, self._meta.create(
+                        path, flags=OpenFlags.WRITE | OpenFlags.CREATE
+                        | OpenFlags.TRUNC,
+                        client_id=self._client_id)))
+                counts = self._fio.batch_write_files(
+                    [(res.inode, 0, value)
+                     for (_, res), (_, value) in zip(opened, items)])
+            except BaseException:
+                for _, res in opened:
+                    try:
+                        self._meta.close(res.inode.id, res.session_id)
+                    except FsError:
+                        pass
+                raise
+            closes = [BatchCloseItem(
+                inode_id=res.inode.id, session_id=res.session_id,
+                length_hint=n, client_id=self._client_id, wrote=1)
+                for (_, res), n in zip(opened, counts)]
+            batch_close = getattr(self._meta, "batch_close", None)
+            settled = (batch_close(closes) if batch_close is not None else
+                       [self._meta.close(c.inode_id, c.session_id,
+                                         length_hint=c.length_hint,
+                                         wrote=True) for c in closes])
+            for (key, _), res, n in zip(opened, settled, counts):
+                if isinstance(res, FsError):
+                    raise res
+                self._cache_inode(key, res)
+                self._write_bytes.add(n)
+
     def get(self, key: str) -> Optional[bytes]:
         with self._get_rec.record() as op, tagged(TrafficClass.KVCACHE):
             path = shard_path(self.root, key)

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Sequence
 from tpu3fs.kvcache.cache import KVCacheClient
 from tpu3fs.kvcache.layout import decode_array, encode_array
 from tpu3fs.monitor.recorder import CounterRecorder, ValueRecorder
-from tpu3fs.utils.result import FsError
+from tpu3fs.utils.result import Code, FsError, Status
 
 
 class HostTier:
@@ -106,12 +106,20 @@ class TieredKVCache:
                  capacity_bytes: int = 256 << 20,
                  dirty_max_bytes: int = 64 << 20,
                  write_through: bool = False,
-                 flush_batch: int = 16):
+                 flush_batch: int = 16,
+                 flush_error_budget: int = 16):
         self._fs = cache
         self.tier = HostTier(capacity_bytes)
         self.write_through = write_through
         self.dirty_max_bytes = int(dirty_max_bytes)
         self._flush_batch = max(1, flush_batch)
+        # error budget: after this many CONSECUTIVE failed flush cycles
+        # the buffer is POISONED — put() raises KVCACHE_FLUSH_POISONED to
+        # the producer instead of buffering (and eventually blocking)
+        # silently forever against a dead storage tier. One successful
+        # flush clears the poison (carried follow-up from PR 5).
+        self.flush_error_budget = max(1, int(flush_error_budget))
+        self._flush_fail_streak = 0
         self._mu = threading.Lock()
         self._cond = threading.Condition(self._mu)
         self._dirty: "OrderedDict[str, bytes]" = OrderedDict()
@@ -194,6 +202,15 @@ class TieredKVCache:
             self._fs.put(key, value)
             self._evictions.add(self.tier.put(key, value))
             return
+        if self.flush_poisoned:
+            # the flusher burned its whole error budget: surface the
+            # storage failure to the producer NOW instead of buffering
+            # toward the dirty bound and stalling silently (write_through
+            # still works — its errors surface synchronously anyway)
+            raise FsError(Status(
+                Code.KVCACHE_FLUSH_POISONED,
+                f"write-back flusher failed {self._flush_fail_streak} "
+                f"consecutive cycles (budget {self.flush_error_budget})"))
         with self._cond:
             while (not self._stop.is_set() and self._dirty
                    and self._dirty_bytes + len(value)
@@ -271,12 +288,43 @@ class TieredKVCache:
                 batch = list(self._dirty.items())[:self._flush_batch]
             self._flush_items(batch)
 
+    @property
+    def flush_poisoned(self) -> bool:
+        """True once the flusher's consecutive-failure streak reached the
+        budget; cleared by the next successful flush cycle."""
+        return self._flush_fail_streak >= self.flush_error_budget
+
+    def _retire(self, key, value) -> None:
+        with self._cond:
+            if self._dirty.get(key) is value:
+                del self._dirty[key]
+                self._dirty_bytes -= len(value)
+                self._dirty_gauge.set(self._dirty_bytes)
+                self._cond.notify_all()
+
     def _flush_items(self, batch) -> None:
         """Write a snapshot through the fs tier, then retire exactly the
         values that were flushed: the entry stays readable in the dirty
         buffer DURING the put (no visibility hole if the tier evicted
         it), and a concurrent overwrite (different value object under the
-        same key) survives for the next cycle."""
+        same key) survives for the next cycle. The whole batch drains as
+        ONE batched striped write (KVCacheClient.batch_put riding the
+        pipelined write path) when the fs tier supports it; a failed
+        batch falls back to per-key puts so one bad entry cannot wedge
+        the rest. Every all-failed cycle burns one unit of the error
+        budget (see flush_error_budget); any success resets it."""
+        batch_put = getattr(self._fs, "batch_put", None)
+        if batch_put is not None and len(batch) > 1:
+            try:
+                batch_put(batch)
+                for key, value in batch:
+                    self._flush_bytes.add(len(value))
+                    self._retire(key, value)
+                self._flush_fail_streak = 0
+                return
+            except FsError:
+                pass  # per-key fallback isolates the failing entry
+        flushed_any = False
         for key, value in batch:
             try:
                 self._fs.put(key, value)
@@ -285,12 +333,16 @@ class TieredKVCache:
                 self._flush_err.add()
                 self._stop.wait(0.05)  # storage unhappy: back off, retry
                 continue
-            with self._cond:
-                if self._dirty.get(key) is value:
-                    del self._dirty[key]
-                    self._dirty_bytes -= len(value)
-                    self._dirty_gauge.set(self._dirty_bytes)
-                    self._cond.notify_all()
+            flushed_any = True
+            self._retire(key, value)
+        if flushed_any:
+            self._flush_fail_streak = 0
+        else:
+            self._flush_fail_streak += 1
+            if self.flush_poisoned:
+                # poisoned: stop hammering a dead tier at full tilt; one
+                # retry cycle per interval keeps probing for recovery
+                self._stop.wait(0.2)
 
     def flush(self, timeout: float = 30.0) -> bool:
         """Block until the dirty buffer drains (True) or timeout."""

@@ -170,9 +170,30 @@ def crc32c_multi(bufs) -> np.ndarray:
     out = np.empty(n, dtype=np.uint32)
     if n == 0:
         return out
-    ptrs = (ctypes.c_char_p * n)(*bufs)  # borrows; no copies
+    # borrow every buffer's address without copying: bytes via c_char_p,
+    # writable buffers (transport receive-frame memoryviews) via
+    # from_buffer; read-only non-bytes buffers fall back to one copy
+    ptrs = (ctypes.c_void_p * n)()
+    keepalive = []
+    for i, b in enumerate(bufs):
+        if isinstance(b, bytes):
+            ref = ctypes.c_char_p(b)
+            keepalive.append(ref)
+            ptrs[i] = ctypes.cast(ref, ctypes.c_void_p).value
+        else:
+            try:
+                arr = (ctypes.c_char * len(b)).from_buffer(b)
+            except (TypeError, ValueError):
+                owned = bytes(b)  # copy-ok: read-only non-bytes buffer
+                ref = ctypes.c_char_p(owned)
+                keepalive.append((owned, ref))
+                ptrs[i] = ctypes.cast(ref, ctypes.c_void_p).value
+                continue
+            keepalive.append(arr)
+            ptrs[i] = ctypes.addressof(arr)
     lens = (ctypes.c_uint64 * n)(*map(len, bufs))
     rc = lib.ce_crc32c_multi(ptrs, lens, n, out.ctypes.data)
+    del keepalive
     if rc != 0:
         raise RuntimeError(f"ce_crc32c_multi rc={rc}")
     return out

@@ -536,11 +536,26 @@ class NativeRpcClient:
             arr_l = (ctypes.c_size_t * max(n_iovs, 1))()
             for i, iov in enumerate(bulk_iovs):
                 # c_char_p on a bytes object points at its internal buffer
-                # (no copy); non-bytes buffers take one owned copy here
-                b = iov if isinstance(iov, bytes) else bytes(iov)
-                keepalive.append(b)
-                arr_p[i] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
-                arr_l[i] = len(b)
+                # (no copy); writable buffers (memoryview gathers from the
+                # write path) borrow their address via from_buffer; only
+                # read-only non-bytes buffers take an owned copy
+                if isinstance(iov, bytes):
+                    ref = ctypes.c_char_p(iov)
+                    keepalive.append((iov, ref))
+                    arr_p[i] = ctypes.cast(ref, ctypes.c_void_p)
+                    arr_l[i] = len(iov)
+                    continue
+                try:
+                    arr = (ctypes.c_char * len(iov)).from_buffer(iov)
+                    keepalive.append(arr)
+                    arr_p[i] = ctypes.addressof(arr)
+                    arr_l[i] = len(iov)
+                except (TypeError, ValueError):
+                    b = bytes(iov)  # copy-ok: read-only non-bytes buffer
+                    ref = ctypes.c_char_p(b)
+                    keepalive.append((b, ref))
+                    arr_p[i] = ctypes.cast(ref, ctypes.c_void_p)
+                    arr_l[i] = len(b)
             iov_ptrs = arr_p
             iov_lens = arr_l
         return raw, buf, iov_ptrs, iov_lens, n_iovs, keepalive

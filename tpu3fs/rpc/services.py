@@ -180,11 +180,14 @@ class RegisterNodeReq:
 # interoperate.
 
 def _attach(op, seg):
-    """Re-attach a bulk segment as an op's data field. Segments arrive as
-    memoryviews over the transport's receive buffer; ops may outlive the
-    request (per-target update queues), so take an owned copy — the ONE
-    copy on the whole receive path."""
-    return replace(op, data=seg if isinstance(seg, bytes) else bytes(seg))
+    """Re-attach a bulk segment as an op's data field — ZERO-COPY: the
+    segment is a memoryview over the transport's receive buffer, and the
+    buffer is detached from the pool (GC-owned) so it stays alive exactly
+    as long as the op references the view. The dispatch is synchronous
+    (update-worker submit blocks until replies are built), so nothing
+    retains the view past the request; the engine takes its own owned
+    copy at install time — the only copy left on the receive path."""
+    return replace(op, data=seg)
 
 
 def _detach(rsp):
@@ -196,7 +199,7 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     s = ServiceDef(STORAGE_SERVICE_ID, "StorageSerde")
 
     def _one_write(fn):
-        def h(r, bulk):
+        def _write_h(r, bulk):
             # `is not None`, not truthiness: a bulk-flagged request with a
             # count=0 section must be rejected, not silently run with
             # data=b'' (empty-section probes are a read-path convention)
@@ -207,10 +210,10 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
                         f"bulk segments {len(bulk)} != 1"))
                 r = _attach(r, bulk[0])
             return fn(r), None
-        return h
+        return _write_h
 
     def _batch_write(fn):
-        def h(r, bulk):
+        def _batch_write_h(r, bulk):
             reqs = r.reqs
             if bulk is not None:
                 if len(bulk) != len(reqs):
@@ -219,7 +222,7 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
                         f"bulk segments {len(bulk)} != ops {len(reqs)}"))
                 reqs = [_attach(op, seg) for op, seg in zip(reqs, bulk)]
             return BatchWriteRsp(fn(reqs)), None
-        return h
+        return _batch_write_h
 
     def _read_h(r, bulk):
         # bulk mode rides the zero-copy serving path: engine hands out
@@ -321,6 +324,15 @@ class RpcMessenger:
             "TPU3FS_READ_STRIPES", "4")))
         self._stripe_min_bytes = int(os.environ.get(
             "TPU3FS_READ_STRIPE_MIN", str(4 << 20)))
+        # write-side twin of the read striping knobs; write_pipelined is
+        # the A/B lever the write bench uses (off = the per-node fan-out
+        # path, the pre-pipelining wire behavior)
+        self.write_pipelined = os.environ.get(
+            "TPU3FS_WRITE_PIPELINED", "1") != "0"
+        self._write_stripes = max(1, int(os.environ.get(
+            "TPU3FS_WRITE_STRIPES", "4")))
+        self._write_stripe_min_bytes = int(os.environ.get(
+            "TPU3FS_WRITE_STRIPE_MIN", str(4 << 20)))
 
     def _addr(self, node_id: int) -> Tuple[str, int]:
         node = self._routing().nodes.get(node_id)
@@ -415,6 +427,94 @@ class RpcMessenger:
             for i, r in enumerate(out):
                 if r is None:  # short reply list from a confused server
                     out[i] = ReadReply(Code.RPC_PEER_CLOSED)
+        return results
+
+    def _write_stripe_spans(self, ops) -> List[Tuple[int, int]]:
+        """Split one node group of write ops into contiguous stripe spans
+        (payload-weighted twin of _stripe_spans: write sizes are known
+        exactly from the op data, no estimation)."""
+        n = len(ops)
+        if n <= 1 or self._write_stripes <= 1:
+            return [(0, n)]
+        est = sum(len(op.data) for op in ops)
+        if est < 2 * self._write_stripe_min_bytes:
+            return [(0, n)]
+        k = min(self._write_stripes, n,
+                max(1, est // self._write_stripe_min_bytes))
+        base, rem = divmod(n, k)
+        spans, lo = [], 0
+        for i in range(k):
+            hi = lo + base + (1 if i < rem else 0)
+            spans.append((lo, hi))
+            lo = hi
+        return spans
+
+    # wire method ids of the batched write-ish RPCs (bind_storage_service)
+    _WRITE_METHODS = {
+        "batch_write": (12, BatchWriteReq),
+        "batch_write_shard": (14, BatchShardWriteReq),
+        "batch_update": (15, BatchWriteReq),
+    }
+
+    def batch_write_pipelined(self, groups, method: str = "batch_write"):
+        """Striped, pipelined batch-write fan-out — the send-side mirror
+        of batch_read_pipelined: `groups` is [(node_id, [op, ...])] where
+        each op is a WriteReq/ShardWriteReq whose payload rides the bulk
+        section (gather-written straight from the caller's buffers, no
+        assembly copy). Every group splits into stripes, each a bulk RPC
+        on its OWN pooled connection; ALL requests go on the wire before
+        any reply is collected, so the server pipelines engine staging of
+        stripe K with the upload of stripe K+1 and the chain forward of
+        earlier stripes. -> per-group reply lists aligned with the input
+        ops; ops a stripe failed for carry the transport error code."""
+        method_id, req_cls = self._WRITE_METHODS[method]
+        pend = []     # (group idx, span lo, span hi, pending | FsError)
+        results = [[None] * len(ops) for _, ops in groups]
+        c = self._client
+        for gi, (node_id, ops) in enumerate(groups):
+            try:
+                addr = self._addr(node_id)
+            except FsError as e:
+                pend.append((gi, 0, len(ops), e))
+                continue
+            if not self._bulk:
+                # inline wire form: one unstriped call per group (the A/B
+                # lever measures framing, not fan-out)
+                try:
+                    pend.append((gi, 0, len(ops), c.start_call(
+                        addr, STORAGE_SERVICE_ID, method_id, req_cls(ops),
+                        BatchWriteRsp)))
+                except FsError as e:
+                    pend.append((gi, 0, len(ops), e))
+                continue
+            for lo, hi in self._write_stripe_spans(ops):
+                span = ops[lo:hi]
+                ctrl = req_cls([replace(op, data=b"") for op in span])
+                try:
+                    pend.append((gi, lo, hi, c.start_call(
+                        addr, STORAGE_SERVICE_ID, method_id, ctrl,
+                        BatchWriteRsp,
+                        bulk_iovs=[op.data for op in span])))
+                except FsError as e:
+                    pend.append((gi, lo, hi, e))
+        for gi, lo, hi, p in pend:
+            if isinstance(p, FsError):
+                err = p
+            else:
+                try:
+                    rsp, _ = c.finish_call(p)
+                    results[gi][lo:lo + len(rsp.replies)] = rsp.replies
+                    continue
+                except FsError as e:
+                    err = e
+            for i in range(lo, min(hi, len(results[gi]))):
+                if results[gi][i] is None:
+                    results[gi][i] = UpdateReply(err.code,
+                                                 message=err.status.message)
+        for out in results:
+            for i, r in enumerate(out):
+                if r is None:  # short reply list from a confused server
+                    out[i] = UpdateReply(Code.RPC_PEER_CLOSED)
         return results
 
     def _one_write(self, addr, method_id: int, op):

@@ -55,6 +55,13 @@ class WriteReq:
     update_ver: int = 0          # 0 = head assigns committed+1
     full_replace: bool = False
     from_target: int = 0         # predecessor's target id (0 = from client)
+    # CRC32C of `data` that an IN-PROCESS predecessor already computed
+    # while staging the very same buffer (-1 = absent). Only ever set on
+    # direct-dispatch (fabric) forwards, where sender and receiver share
+    # one address space: the receiver installs the forwarded bytes as its
+    # own content without re-copying or re-checksumming. Socket hops
+    # never set it — a wire crossing must re-own and re-verify.
+    trusted_crc: int = -1
 
 
 @dataclass
@@ -151,6 +158,85 @@ class ReadReply:
 
 # messenger: (node_id, "update"|"sync_dump"|..., payload) -> reply
 Messenger = Callable[[int, str, object], object]
+
+
+# -- chain-forward overlap ----------------------------------------------------
+# The head (and every mid hop) streams the bulk payload to its successor
+# WHILE the local engine stage is in flight, so chain latency approaches
+# max(local, forward) instead of their sum (the reference overlaps RDMA
+# pull + disk write + forwarding per chunk — SURVEY §3.2/§5). Commit is
+# untouched: it still happens only after BOTH the local stage succeeded
+# and the suffix acked, so commit ordering stays head→tail and the
+# checksum cross-check still runs. The one new window: a local stage that
+# fails AFTER the forward went out leaves the suffix ahead of this
+# replica; the client's reply is the local failure, and the exactly-once
+# retry (same channel/seq, same bytes) converges the chain — the engine
+# treats the successor's already-applied version as an idempotent
+# duplicate. Engine hard failures beyond that poison the engine/offline
+# the target, which is already the resync path.
+
+def _inproc_messenger(messenger) -> bool:
+    """True when the chain messenger direct-dispatches inside THIS
+    process (the fabric): forwards hand the successor the head's owned
+    immutable buffer + its checksum instead of re-shipping bytes, and
+    the thread-handoff overlap is skipped (a single GIL serializes the
+    two stages anyway, so the handoff only costs latency)."""
+    return bool(
+        getattr(messenger, "in_process", False)
+        or getattr(getattr(messenger, "__self__", None), "in_process",
+                   False))
+
+
+def _overlap_enabled() -> bool:
+    v = os.environ.get("TPU3FS_WRITE_OVERLAP")
+    if v is not None:
+        return v != "0"
+    # adaptive default: a single hardware thread cannot actually run the
+    # local stage and the forward concurrently — the helper-thread
+    # handoff only adds latency there (the reference assumes dedicated
+    # IO threads). TPU3FS_WRITE_OVERLAP=1/0 forces either way.
+    return (os.cpu_count() or 1) > 1
+
+
+def _overlap_min_bytes() -> int:
+    # below this, a thread handoff costs more than the overlap wins
+    return int(os.environ.get("TPU3FS_WRITE_OVERLAP_MIN", str(32 << 10)))
+
+
+class _SyncReplaceNeeded(Exception):
+    """Raised inside an overlapped forward when the successor turns out to
+    be SYNCING (its full-chunk-replace needs the locally staged content,
+    which may not exist yet) — the caller re-forwards sequentially after
+    staging completes."""
+
+
+class _OverlapForward:
+    """Run a forward callable on a helper thread; join() -> (result,
+    needs_sequential). Exceptions other than the SYNCING marker surface
+    on join (forwarding errors are UpdateReply values, not raises)."""
+
+    def __init__(self, fn):
+        self._result = None
+        self._needs_sequential = False
+        self._error: Optional[BaseException] = None
+
+        def _run():
+            try:
+                self._result = fn()
+            except _SyncReplaceNeeded:
+                self._needs_sequential = True
+            except BaseException as e:  # surface on the joining thread
+                self._error = e
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="chain-forward")
+        self._thread.start()
+
+    def join(self):
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+        return self._result, self._needs_sequential
 
 # forwarding errors that mean "the chain may have moved under us: refresh
 # the routing snapshot and retry" (ReliableForwarding.h:15-40); shared by
@@ -470,7 +556,11 @@ class StorageService:
         predecessor and ended the chain. A forwarder's forward_s CONTAINS
         its successor's whole pipeline (it runs inside the forwarded RPC),
         so across any chain depth the pure messaging/serde cost is
-        Σ(forwarders' forward_s) − Σ(non-head wall_s)."""
+        Σ(forwarders' forward_s) − Σ(non-head wall_s). With the overlapped
+        forward (chain-forward overlap, module note) forward_s records
+        only the EXPOSED wait after the local stage finished — the hidden
+        (overlapped) part is inside stage_s's wall — so stage+forward can
+        legitimately sum to less than the pre-overlap pipeline."""
         with self._wp_lock:
             out = {role: dict(vals) for role, vals in self._wp.items()}
             if reset:
@@ -788,6 +878,19 @@ class StorageService:
                 update_ver = req.update_ver
                 if update_ver == 0:
                     update_ver = (meta.committed_ver if meta else 0) + 1
+                # overlapped forward: the update version is known BEFORE
+                # staging (explicit, or committed+1 which cannot move —
+                # we hold the chunk lock), so the bulk payload can stream
+                # to the successor while the local engine stages it
+                overlap = None
+                inproc = _inproc_messenger(self._messenger)
+                if (self._messenger is not None and not inproc
+                        and _overlap_enabled()
+                        and len(req.data) >= _overlap_min_bytes()
+                        and self._successor_of(target, chain) is not None):
+                    overlap = _OverlapForward(
+                        lambda: self._forward(target, req, update_ver,
+                                              chain, sync_replace_ok=False))
                 # stage pending version (COW)
                 try:
                     staged = engine.update(
@@ -798,8 +901,19 @@ class StorageService:
                         req.offset,
                         full_replace=req.full_replace,
                         chunk_size=req.chunk_size or target.chunk_size,
+                        content_crc=(
+                            Checksum(req.trusted_crc, len(req.data))
+                            if req.trusted_crc >= 0 else None),
+                        # chain-internal trusted forward: the buffer is the
+                        # predecessor replica's own immutable content —
+                        # install it by reference (client buffers, even
+                        # trusted-CRC ones, are mutable: always copied)
+                        adopt=(req.trusted_crc >= 0
+                               and req.from_target != 0),
                     )
                 except FsError as e:
+                    if overlap is not None:
+                        overlap.join()  # see module note on this window
                     if e.code == Code.CHUNK_STALE_UPDATE:
                         # duplicate of an already-committed update: report the
                         # committed state (idempotent success)
@@ -811,10 +925,19 @@ class StorageService:
                             checksum=cur.checksum if cur else Checksum(),
                         )
                     return UpdateReply(e.code, message=e.status.message)
+                if overlap is not None:
+                    fwd, needs_seq = overlap.join()
+                    if needs_seq:  # successor went SYNCING: re-forward now
+                        fwd = self._forward(target, req, update_ver, chain)
+                else:
+                    fwd = self._forward(
+                        target, req, update_ver, chain,
+                        owned=self._owned_forward(
+                            engine, req, update_ver, staged) if inproc
+                        else None)
                 if req.full_replace:
                     # recovery write: installed as committed already; still
                     # forward if a successor exists in the writer chain
-                    fwd = self._forward(target, req, update_ver, chain)
                     if fwd is not None and not fwd.ok:
                         return fwd
                     return UpdateReply(
@@ -827,7 +950,6 @@ class StorageService:
                 # the engine computed it while staging (native: inside the
                 # C++ COW write) — no chunk content crosses back into Python
                 our_sum = staged.pending_checksum
-                fwd = self._forward(target, req, update_ver, chain)
                 if fwd is not None:
                     if not fwd.ok:
                         return fwd
@@ -853,6 +975,28 @@ class StorageService:
     def _pending_content(self, target: StorageTarget, chunk_id: ChunkId) -> bytes:
         return target.engine.pending_content(chunk_id)
 
+    @staticmethod
+    def _owned_forward(engine, req: WriteReq, update_ver: int, staged):
+        """(owned bytes, trusted crc) for an in-process forward, or None.
+
+        After staging, the engine holds the FULL chunk content for
+        ``update_ver`` as an immutable owned buffer whose checksum it just
+        computed. A direct-dispatch successor can install that very
+        object — no re-copy, no re-CRC — because both replicas live in
+        one address space and installed content is never mutated in
+        place. Engines without the accessor (native: content lives in C
+        memory) fall back to the normal forward."""
+        get = getattr(engine, "content_for_ver", None)
+        if get is None:
+            return None
+        content = get(req.chunk_id, update_ver)
+        if content is None:
+            return None
+        cs = staged.checksum if req.full_replace else staged.pending_checksum
+        if cs.length != len(content):
+            return None
+        return content, cs.value
+
     # -- forwarding (ref ReliableForwarding.h:15-40) --------------------------
     def _successor_of(self, target: StorageTarget, chain: ChainInfo):
         """(successor target, its node) in the writer chain, or None when
@@ -875,12 +1019,20 @@ class StorageService:
         update_ver: int,
         chain: ChainInfo,
         succ,
+        sync_replace_ok: bool = True,
+        owned=None,
     ) -> WriteReq:
+        # the forwarded req carries the SAME data buffer the hop received
+        # (a memoryview over the bulk receive frame on socket transports):
+        # the chain forward streams it onward with no re-assembly copy
         freq = replace(
             req, from_target=target.target_id, update_ver=update_ver,
             chain_ver=chain.chain_version)
         if (succ.public_state == PublicTargetState.SYNCING
                 and not freq.full_replace):
+            if not sync_replace_ok:
+                # overlapped forward: the staged content may not exist yet
+                raise _SyncReplaceNeeded()
             # syncing successor gets the whole chunk (full-chunk-replace);
             # materialize the staged content only on this rare path
             freq = replace(
@@ -889,6 +1041,12 @@ class StorageService:
                 data=self._pending_content(target, req.chunk_id),
                 offset=0,
             )
+        elif owned is not None:
+            # in-process trusted forward: ship the engine's owned staged
+            # content (the FULL post-merge chunk, so any original offset
+            # becomes a whole-content write) with its already-computed CRC
+            freq = replace(freq, data=owned[0], offset=0,
+                           trusted_crc=owned[1])
         return freq
 
     def _forward(
@@ -897,6 +1055,8 @@ class StorageService:
         req: WriteReq,
         update_ver: int,
         chain: ChainInfo,
+        sync_replace_ok: bool = True,
+        owned=None,
     ) -> Optional[UpdateReply]:
         """Forward to the successor; None when this target is the tail."""
         for attempt in range(self._max_forward_retries):
@@ -915,7 +1075,8 @@ class StorageService:
                     chain = self._chain(req.chain_id)
                     continue
                 return UpdateReply(Code.NO_SUCCESSOR, message="no route to successor")
-            freq = self._make_forward_req(target, req, update_ver, chain, succ)
+            freq = self._make_forward_req(target, req, update_ver, chain,
+                                          succ, sync_replace_ok, owned)
             try:
                 reply = self._messenger(node.node_id, "update", freq)
             except FsError as e:
@@ -1330,8 +1491,18 @@ class StorageService:
             chain = self._chain(reqs[0].chain_id)
             chain_ver = chain.chain_version
             engine = target.engine
+            # overlap eligibility BEFORE building ops: predicting head
+            # update versions costs one get_meta per op, only paid when
+            # the forward will actually run concurrently
+            do_overlap = (
+                self._messenger is not None and self._ici is None
+                and not _inproc_messenger(self._messenger)
+                and _overlap_enabled()
+                and sum(len(r.data) for r in reqs) >= _overlap_min_bytes()
+                and self._successor_of(target, chain) is not None)
             ops: List[EngineUpdateOp] = []
             op_idx: List[int] = []
+            pred: List[Tuple[int, int, Optional[Checksum], bool]] = []
             for i, r in enumerate(reqs):
                 if r.from_target == 0 and r.chain_ver != chain_ver:
                     replies[i] = UpdateReply(
@@ -1345,15 +1516,38 @@ class StorageService:
                         Code.NO_SPACE,
                         message=f"target {target.target_id} rejects creates")
                     continue
+                pver = r.update_ver
+                if do_overlap and pver == 0:
+                    # the assigned version is knowable NOW: committed+1
+                    # cannot move while we hold the chunk lock, so the
+                    # forward can ship the exact version before staging
+                    m = engine.get_meta(r.chunk_id)
+                    pver = (m.committed_ver if m else 0) + 1
                 ops.append(EngineUpdateOp(
                     chunk_id=r.chunk_id,
                     data=r.data,
                     offset=r.offset,
-                    update_ver=r.update_ver,
+                    update_ver=pver,
                     full_replace=r.full_replace,
                     chunk_size=r.chunk_size or target.chunk_size,
+                    content_crc=(Checksum(r.trusted_crc, len(r.data))
+                                 if r.trusted_crc >= 0 else None),
+                    # by-reference install only for chain-internal trusted
+                    # forwards (predecessor-owned immutable buffers)
+                    adopt=r.trusted_crc >= 0 and r.from_target != 0,
                 ))
                 op_idx.append(i)
+                pred.append((i, pver, None, r.full_replace))
+            overlap = None
+            if do_overlap and ops:
+                # stream the batch to the successor WHILE the local engine
+                # stages it: wall time becomes ~max(stage, forward). Ops
+                # the local stage later rejects were forwarded too — the
+                # successor's engine treats replays/stales idempotently,
+                # and the module note covers the hard-failure window.
+                overlap = _OverlapForward(
+                    lambda: self._forward_batch(
+                        target, reqs, pred, chain, sync_replace_ok=False))
             t0 = time.perf_counter()
             results = engine.batch_update(ops, chain_ver) if ops else []
             dt_stage = time.perf_counter() - t0
@@ -1373,7 +1567,20 @@ class StorageService:
                 else:
                     staged.append(
                         (i, res.ver, res.checksum, reqs[i].full_replace))
-            if staged:
+            fwd_by_i: Optional[Dict[int, UpdateReply]] = None
+            if overlap is not None:
+                t0 = time.perf_counter()
+                fwd_all, needs_seq = overlap.join()
+                dt_forward = time.perf_counter() - t0  # exposed wait only
+                if needs_seq:
+                    # successor turned SYNCING mid-flight: re-forward
+                    # sequentially now that the staged content exists
+                    overlap = None
+                elif fwd_all is not None:
+                    fwd_by_i = {i: fr for (i, _, _, _), fr
+                                in zip(pred, fwd_all)}
+                    forwarded = True
+            if staged and overlap is None:
                 t0 = time.perf_counter()
                 handled = False
                 fwd = None
@@ -1384,10 +1591,14 @@ class StorageService:
                     fwd = self._forward_batch(target, reqs, staged, chain)
                 dt_forward = time.perf_counter() - t0
                 forwarded = fwd is not None
+                if fwd is not None:
+                    fwd_by_i = {i: fr for (i, _, _, _), fr
+                                in zip(staged, fwd)}
+            if staged:
                 commit_items: List[Tuple[ChunkId, int]] = []
                 commit_slots: List[Tuple[int, int, Checksum]] = []
-                for pos, (i, ver, cs, is_fr) in enumerate(staged):
-                    fr = fwd[pos] if fwd is not None else None
+                for i, ver, cs, is_fr in staged:
+                    fr = fwd_by_i.get(i) if fwd_by_i is not None else None
                     if fr is not None and not fr.ok:
                         replies[i] = fr
                         continue
@@ -1436,7 +1647,7 @@ class StorageService:
                 wp["commit_s"] += dt_commit
                 wp["wall_s"] += time.perf_counter() - t_wall
                 wp["ops"] += n
-                wp["bytes"] += sum(len(r.data) for r in reqs)
+                wp["bytes"] += sum(len(r.data) for r in reqs)  # copy-ok: integer counter, not payload
         return replies
 
     def _forward_batch(
@@ -1445,10 +1656,14 @@ class StorageService:
         reqs: List[WriteReq],
         staged: List[Tuple[int, int, Checksum, bool]],
         chain: ChainInfo,
+        sync_replace_ok: bool = True,
     ) -> Optional[List[UpdateReply]]:
         """Forward the staged batch to the successor in ONE RPC; None when
         this target is the tail. Retries across chain-version bumps like
-        the per-op _forward (ReliableForwarding.h:15-40)."""
+        the per-op _forward (ReliableForwarding.h:15-40). The forwarded
+        reqs carry the SAME payload buffers this hop received — the bulk
+        frame re-gathers them into the next socket (streaming chain
+        forwarding, no re-assembly copy)."""
         for attempt in range(self._max_forward_retries):
             hop = self._successor_of(target, chain)
             if hop is None:
@@ -1465,8 +1680,24 @@ class StorageService:
                 return [UpdateReply(Code.NO_SUCCESSOR,
                                     message="no route to successor")
                         for _ in staged]
+            owned_of = None
+            if _inproc_messenger(self._messenger):
+                # direct-dispatch successor: hand over the engine's owned
+                # staged buffers + their computed CRCs (no re-copy/re-CRC
+                # on the next hop); engines without the accessor (native)
+                # forward the received buffers as usual
+                get = getattr(target.engine, "content_for_ver", None)
+                if get is not None:
+                    def owned_of(i, ver, cs):
+                        content = get(reqs[i].chunk_id, ver)
+                        if content is None or cs.length != len(content):
+                            return None
+                        return content, cs.value
             freqs = [
-                self._make_forward_req(target, reqs[i], ver, chain, succ)
+                self._make_forward_req(target, reqs[i], ver, chain, succ,
+                                       sync_replace_ok,
+                                       owned_of(i, ver, cs)
+                                       if owned_of is not None else None)
                 for i, ver, cs, is_fr in staged
             ]
             try:
@@ -1493,7 +1724,8 @@ class StorageService:
                 chain = self._chain(reqs[staged[0][0]].chain_id)
                 for pos in retriable:
                     i, ver, cs, is_fr = staged[pos]
-                    out[pos] = self._forward(target, reqs[i], ver, chain)
+                    out[pos] = self._forward(target, reqs[i], ver, chain,
+                                             sync_replace_ok)
             return out
         return [UpdateReply(Code.CLIENT_RETRIES_EXHAUSTED,
                             message="forwarding retries exhausted")

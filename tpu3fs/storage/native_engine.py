@@ -38,6 +38,10 @@ _ERR_TO_CODE = {
 
 _KEYLEN = 12
 
+# valid (never-read) address for zero-length payloads in iovec-mode batch
+# updates: a NULL src with len 0 would still be UB in the C memcpy
+_EMPTY_PAYLOAD = ctypes.create_string_buffer(1)
+
 
 class _CMeta(ctypes.Structure):
     _fields_ = [
@@ -97,7 +101,7 @@ _lib_lock = threading.Lock()
 # native/chunk_engine.cpp. Checked as raw bytes in the .so BEFORE dlopen —
 # once a stale library is dlopen'ed, no in-process rebuild can replace it
 # (dlopen dedups by pathname), so the check has to happen first.
-_ABI_TAG = b"TPU3FS_ENGINE_ABI_4"
+_ABI_TAG = b"TPU3FS_ENGINE_ABI_5"
 
 
 def _abi_matches(path: str) -> bool:
@@ -313,6 +317,7 @@ class NativeChunkEngine(ChunkEngine):
         aux: int = 0,
         expected_crc: Optional[int] = None,
         content_crc=None,  # computed natively during staging; unused here
+        adopt: bool = False,  # C owns its block pool; always copies in
     ) -> ChunkMeta:
         mode = 2 if stage_replace else (1 if full_replace else 0)
         rc = self._lib.ce_update(
@@ -372,6 +377,28 @@ class NativeChunkEngine(ChunkEngine):
 
     # -- batched ops: ONE ctypes crossing per batch; the loop runs in C++
     # with the GIL released (ctypes drops it for the call duration) ----------
+    @staticmethod
+    def _payload_addr(data, keepalive) -> int:
+        """Raw address of a payload buffer, taken WITHOUT copying where
+        the buffer protocol allows: bytes expose their internal pointer
+        via c_char_p; writable buffers (the transport's receive-frame
+        memoryviews) via from_buffer. Only read-only non-bytes buffers
+        (rare) pay a copy. Whatever keeps the address alive is appended
+        to `keepalive`, which the caller holds across the C call."""
+        if isinstance(data, bytes):
+            ref = ctypes.c_char_p(data)  # borrows the bytes' buffer
+            keepalive.append((data, ref))
+            return ctypes.cast(ref, ctypes.c_void_p).value or 0
+        try:
+            arr = (ctypes.c_char * len(data)).from_buffer(data)
+        except (TypeError, ValueError):
+            b = bytes(data)  # copy-ok: read-only non-bytes buffer
+            ref = ctypes.c_char_p(b)
+            keepalive.append((b, ref))
+            return ctypes.cast(ref, ctypes.c_void_p).value or 0
+        keepalive.append(arr)
+        return ctypes.addressof(arr)
+
     def batch_update(self, ops, chain_ver: int):
         from tpu3fs.storage.engine import EngineOpResult
 
@@ -379,8 +406,11 @@ class NativeChunkEngine(ChunkEngine):
         if n == 0:
             return []
         c_ops = (_CUpOp * n)()
-        parts = []
-        blob_off = 0
+        # iovec mode: data_off carries each payload's ABSOLUTE address and
+        # blob is NULL — the engine reads straight from the transport's
+        # receive-frame views (or the caller's bytes), no concatenation
+        # copy of the batch payloads
+        keepalive: list = []
         for i, op in enumerate(ops):
             c = c_ops[i]
             ctypes.memmove(c.key, op.chunk_id.to_bytes(), _KEYLEN)
@@ -391,15 +421,14 @@ class NativeChunkEngine(ChunkEngine):
             c.data_len = len(op.data)
             c.chunk_size = op.chunk_size
             c.aux = op.aux
-            c.data_off = blob_off
+            c.data_off = self._payload_addr(op.data, keepalive) \
+                if len(op.data) else ctypes.addressof(_EMPTY_PAYLOAD)
             c.update_ver = op.update_ver
             c.expected_crc = (op.expected_crc or 0) & 0xFFFFFFFF
-            parts.append(op.data)
-            blob_off += len(op.data)
-        blob = b"".join(parts)
         res = (_COpResult * n)()
         _check(self._lib.ce_batch_update(
-            self._h, chain_ver, blob, c_ops, res, n), "batch_update")
+            self._h, chain_ver, None, c_ops, res, n), "batch_update")
+        del keepalive
         out = []
         for i in range(n):
             r = res[i]

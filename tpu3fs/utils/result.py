@@ -118,6 +118,9 @@ class Code(enum.IntEnum):
     #                          a cached inode outlived its entry (GC'd);
     #                          invalidate and re-stat
     KVCACHE_CORRUPT = 1001   # array header malformed beyond staleness
+    KVCACHE_FLUSH_POISONED = 1002  # write-back flusher exhausted its
+    #                          consecutive-failure budget: producers must
+    #                          stop buffering (tier.py error budget)
 
 
 #: Codes on which a client-side retry ladder may re-issue the request.
