@@ -1,0 +1,425 @@
+"""ec_bench: the EC-first data plane end to end -> BENCH_EC.json.
+
+Measures, over real sockets (mgmtd + k+m storage nodes, python
+transport):
+
+- host RS(k,m) encode throughput (XOR-scheduled LUT / native SIMD — the
+  kernel the fused write path runs),
+- ENCODE-FUSED EC writes (write_stripes: encode once client-side, fan
+  data+parity shards out payload-weighted and pipelined) vs the
+  ENCODE-THEN-WRITE baseline (the pre-PR archival shape: land the bytes
+  on a replicated CR chain first, read them back, re-encode onto the EC
+  chain — every byte written twice plus a separate encode pass),
+- sub-stripe writes: delta-parity RMW (P' = P ^ c*(D'^D), touched+parity
+  shards only) vs the full read-reencode-rewrite ladder,
+- degraded reads: per-stripe read latency with every shard up vs with
+  one shard's server STOPPED (any-k decode on the client), and
+- kill-a-target rebuild: wipe one target, drive EcResyncWorker through
+  the batched recovery path, report rebuilt MiB/s and the per-peer
+  recovery-read spread (source-disjoint scheduling must touch >= 2
+  surviving peers).
+
+Usage:
+  python -m benchmarks.ec_bench [--k 4] [--m 2] [--stripes 48]
+      [--size 1048576] [--fast] [--out BENCH_EC.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from tpu3fs.client.storage_client import RetryOptions
+from tpu3fs.storage.types import ChunkId
+
+FILE_ID = 77_001
+_FAST_RETRY = RetryOptions(backoff_base_s=0.001, backoff_max_s=0.05)
+
+
+def _gibps(nbytes: int, dt: float) -> float:
+    return round(nbytes / max(dt, 1e-9) / (1 << 30), 3)
+
+
+class _EcCluster:
+    """mgmtd + (k+m) storage nodes over sockets: one EC(k, m) chain with
+    one shard target per node, plus a 2-replica CR chain (the baseline's
+    first landing spot). The mgmtd stays in-process so the bench can
+    drive SYNCING/heartbeat transitions for the rebuild scenario."""
+
+    def __init__(self, *, k: int, m: int, size: int):
+        from tpu3fs.fabric.fabric import FabricClock
+        from tpu3fs.kv.mem import MemKVEngine
+        from tpu3fs.mgmtd.service import Mgmtd, MgmtdConfig
+        from tpu3fs.mgmtd.types import LocalTargetState, NodeType
+        from tpu3fs.rpc.net import RpcClient, RpcServer
+        from tpu3fs.rpc.services import (
+            MgmtdRpcClient,
+            RpcMessenger,
+            bind_mgmtd_service,
+            bind_storage_service,
+        )
+        from tpu3fs.storage.craq import StorageService
+        from tpu3fs.storage.target import StorageTarget
+
+        self.k, self.m, self.size = k, m, size
+        # controllable clock: the rebuild scenario declares the victim
+        # dead by advancing past the heartbeat timeout, like the fabric
+        self.clock = FabricClock()
+        self.mgmtd = Mgmtd(1, MemKVEngine(),
+                           MgmtdConfig(heartbeat_timeout_s=5.0,
+                                       lease_length_s=1e9),
+                           clock=self.clock)
+        self.mgmtd.extend_lease()
+        self.alive = {}
+        self.servers = []
+        mgmtd_server = RpcServer()
+        bind_mgmtd_service(mgmtd_server, self.mgmtd)
+        mgmtd_server.start()
+        self.servers.append(mgmtd_server)
+        self.mgmtd_addr = mgmtd_server.address
+        self.shared_client = RpcClient()
+        self._mgmtd_cli_cls = MgmtdRpcClient
+        self._messenger_cls = RpcMessenger
+
+        from tpu3fs.ops.stripe import shard_size_of
+
+        shard = shard_size_of(size, k)
+        self.ec_chain = 910_001
+        self.cr_chain = 910_002
+        self.node_ids = [10 + i for i in range(k + m)]
+        self.services = {}
+        self.server_of_node = {}
+        node_states: dict = {n: {} for n in self.node_ids}
+        for node_id in self.node_ids:
+            mcli = MgmtdRpcClient(self.mgmtd_addr, self.shared_client,
+                                  routing_ttl_s=0.2)
+            svc = StorageService(node_id, mcli.refresh_routing)
+            svc.set_messenger(RpcMessenger(mcli.refresh_routing,
+                                           self.shared_client))
+            server = RpcServer()
+            bind_storage_service(server, svc)
+            server.start()
+            self.mgmtd.register_node(node_id, NodeType.STORAGE,
+                                     host=server.host, port=server.port)
+            self.servers.append(server)
+            self.services[node_id] = svc
+            self.server_of_node[node_id] = server
+        # EC chain: one shard-sized target per node
+        ec_targets = []
+        for i, node_id in enumerate(self.node_ids):
+            tid = 2000 + i
+            self.services[node_id].add_target(
+                StorageTarget(tid, self.ec_chain, chunk_size=shard))
+            self.mgmtd.create_target(tid, node_id=node_id)
+            node_states[node_id][tid] = LocalTargetState.UPTODATE
+            ec_targets.append(tid)
+        self.mgmtd.upload_chain(self.ec_chain, ec_targets, ec_k=k, ec_m=m)
+        # CR chain (2 replicas on the first two nodes): the baseline's
+        # replicated first hop
+        cr_targets = []
+        for r in range(2):
+            node_id = self.node_ids[r]
+            tid = 3000 + r
+            self.services[node_id].add_target(
+                StorageTarget(tid, self.cr_chain, chunk_size=size))
+            self.mgmtd.create_target(tid, node_id=node_id)
+            node_states[node_id][tid] = LocalTargetState.UPTODATE
+            cr_targets.append(tid)
+        self.mgmtd.upload_chain(self.cr_chain, cr_targets)
+        self.mgmtd.upload_chain_table(1, [self.ec_chain, self.cr_chain])
+        self._hb = 1
+        for node_id in self.node_ids:
+            self.mgmtd.heartbeat(node_id, self._hb, node_states[node_id])
+        self._client_seq = 0
+
+    def heartbeat_all(self) -> None:
+        self._hb += 1
+        for node_id, svc in self.services.items():
+            if not self.alive.get(node_id, True):
+                continue
+            states = {t.target_id: t.local_state for t in svc.targets()}
+            self.mgmtd.heartbeat(node_id, self._hb, states)
+
+    def tick(self) -> None:
+        self.heartbeat_all()
+        self.mgmtd.tick()
+
+    def storage_client(self, **kw):
+        from tpu3fs.client.storage_client import StorageClient
+
+        self._client_seq += 1
+        mcli = self._mgmtd_cli_cls(self.mgmtd_addr, self.shared_client,
+                                   routing_ttl_s=0.2)
+        messenger = self._messenger_cls(mcli.refresh_routing,
+                                        self.shared_client)
+        return StorageClient(f"ec-bench-{self._client_seq}",
+                             mcli.refresh_routing, messenger, **kw)
+
+    def messenger(self):
+        mcli = self._mgmtd_cli_cls(self.mgmtd_addr, self.shared_client,
+                                   routing_ttl_s=0.2)
+        return self._messenger_cls(mcli.refresh_routing, self.shared_client)
+
+    def close(self) -> None:
+        self.shared_client.close()
+        for s in self.servers:
+            s.stop()
+
+
+def _bench_encode(k: int, m: int, size: int, batch: int) -> dict:
+    from tpu3fs.ops.stripe import get_codec, shard_size_of
+
+    S = shard_size_of(size, k)
+    codec = get_codec(k, m, S)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (batch, k, S), dtype=np.uint8)
+    codec.encode_parity(data)  # warm tables / native lib
+    iters, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < 0.5:
+        codec.encode_parity(data)
+        iters += 1
+    dt = time.perf_counter() - t0
+    return {
+        "metric": f"ec_encode_host_{k}_{m}",
+        "value": _gibps(iters * batch * k * S, dt),
+        "unit": "GiB/s data encoded",
+        "shard_kb": S >> 10,
+    }
+
+
+def run_bench(*, k: int = 4, m: int = 2, stripes: int = 48,
+              size: int = 1 << 20, fast: bool = False) -> list:
+    from tpu3fs.storage.ec_resync import EcResyncWorker
+
+    results = [_bench_encode(k, m, size, batch=4 if fast else 32)]
+    print(json.dumps(results[0]), flush=True)
+
+    cluster = _EcCluster(k=k, m=m, size=size)
+    try:
+        client = cluster.storage_client(retry=_FAST_RETRY)
+        rng = np.random.default_rng(1)
+        payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        items = [(ChunkId(FILE_ID, i), payload) for i in range(stripes)]
+
+        # -- fused EC writes: encode once, shard fan-out, no second copy --
+        t0 = time.perf_counter()
+        replies = client.write_stripes(cluster.ec_chain, items,
+                                       chunk_size=size)
+        dt_fused = time.perf_counter() - t0
+        assert all(r.ok for r in replies)
+        fused = _gibps(stripes * size, dt_fused)
+
+        # -- baseline: land on CR (2 replicas), read back, re-encode ------
+        # the pre-PR archival shape: every EC byte is written twice and
+        # encoded in a separate pass
+        from tpu3fs.client.storage_client import ReadReq
+
+        base_items = [(ChunkId(FILE_ID + 1, i), payload)
+                      for i in range(stripes)]
+        t0 = time.perf_counter()
+        cr = client.batch_write(
+            [(cluster.cr_chain, cid, 0, data) for cid, data in base_items],
+            chunk_size=size)
+        assert all(r.ok for r in cr)
+        back = client.batch_read([
+            ReadReq(cluster.cr_chain, cid, 0, size)
+            for cid, _ in base_items])
+        assert all(r.ok for r in back)
+        replies = client.write_stripes(
+            cluster.ec_chain,
+            [(ChunkId(FILE_ID + 2, i), bytes(r.data))
+             for i, r in enumerate(back)],
+            chunk_size=size)
+        assert all(r.ok for r in replies)
+        dt_base = time.perf_counter() - t0
+        baseline = _gibps(stripes * size, dt_base)
+        results.append({
+            "metric": f"ec_write_fused_{k}_{m}",
+            "value": fused, "unit": "GiB/s",
+            "baseline_encode_then_write": baseline,
+            "speedup_vs_baseline": round(fused / max(baseline, 1e-9), 2),
+            "stripes": stripes, "stripe_bytes": size,
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+        # -- sub-stripe RMW: delta parity vs full re-encode ----------------
+        from tpu3fs.ops.stripe import shard_size_of
+
+        S = shard_size_of(size, k)
+        n_rmw = 8 if fast else 32
+        patch = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+        t0 = time.perf_counter()
+        for i in range(n_rmw):
+            r = client.write_stripe_rmw(
+                cluster.ec_chain, ChunkId(FILE_ID, i % stripes),
+                (i * 131) % (size - len(patch)), patch, chunk_size=size)
+            assert r is not None and r.ok
+        dt_delta = time.perf_counter() - t0
+        # full ladder: read stripe + re-encode + rewrite all shards
+        t0 = time.perf_counter()
+        for i in range(n_rmw):
+            cid = ChunkId(FILE_ID, i % stripes)
+            cur = client.read_stripe(cluster.ec_chain, cid, 0, size,
+                                     chunk_size=size)
+            merged = bytearray(cur.data.ljust(size, b"\x00"))
+            off = (i * 137) % (size - len(patch))
+            merged[off:off + len(patch)] = patch
+            assert client.write_stripe(
+                cluster.ec_chain, cid,
+                bytes(merged[:max(cur.logical_len, off + len(patch))]),
+                chunk_size=size,
+                update_ver=client.next_stripe_ver(cur.commit_ver)).ok
+        dt_full = time.perf_counter() - t0
+        results.append({
+            "metric": f"ec_substripe_rmw_{k}_{m}",
+            "value": round(n_rmw / dt_delta, 1), "unit": "writes/s",
+            "full_reencode_writes_s": round(n_rmw / dt_full, 1),
+            "speedup_vs_full_rmw": round(dt_full / max(dt_delta, 1e-9), 2),
+            "patch_bytes": len(patch),
+            "delta_sheds_shard_payloads":
+                f"{1 + m}/{k + m} shards per write",
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+        # -- degraded reads: clean vs one shard server stopped ------------
+        n_read = 8 if fast else 24
+        lat = []
+        for i in range(n_read):
+            t0 = time.perf_counter()
+            r = client.read_stripe(cluster.ec_chain,
+                                   ChunkId(FILE_ID, i % stripes), 0, size,
+                                   chunk_size=size)
+            lat.append((time.perf_counter() - t0) * 1000)
+            assert r.ok
+        clean_ms = float(np.median(lat))
+        routing = client._routing()
+        chain = routing.chains[cluster.ec_chain]
+        victim = chain.target_of_shard(1)
+        vnode = routing.node_of_target(victim.target_id)
+        cluster.server_of_node[vnode.node_id].stop()
+        deg_before = client._ec_degraded._value
+        lat = []
+        for i in range(n_read):
+            t0 = time.perf_counter()
+            r = client.read_stripe(cluster.ec_chain,
+                                   ChunkId(FILE_ID, i % stripes), 0, size,
+                                   chunk_size=size)
+            lat.append((time.perf_counter() - t0) * 1000)
+            assert r.ok and bytes(r.data[:64]) != b""
+        degraded_ms = float(np.median(lat))
+        assert client._ec_degraded._value > deg_before
+        results.append({
+            "metric": f"ec_degraded_read_{k}_{m}",
+            "value": round(degraded_ms, 2), "unit": "ms median (stripe read)",
+            "clean_ms": round(clean_ms, 2),
+            "slowdown_vs_clean": round(degraded_ms / max(clean_ms, 1e-9), 2),
+            "stripe_bytes": size,
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+        # -- kill-a-target rebuild ----------------------------------------
+        # the stopped node "lost its disk": declare it dead (heartbeat
+        # timeout), wipe the engine, restart its server, walk the target
+        # through WAITING -> SYNCING, and let the coordinator's
+        # EcResyncWorker rebuild over real sockets
+        from tpu3fs.mgmtd.types import (
+            LocalTargetState,
+            NodeType,
+            PublicTargetState,
+        )
+        from tpu3fs.rpc.net import RpcServer
+        from tpu3fs.rpc.services import bind_storage_service
+
+        cluster.alive[vnode.node_id] = False
+        cluster.clock.advance(6.0)
+        cluster.tick()  # victim times out: public OFFLINE, chain bumps
+        vsvc = cluster.services[vnode.node_id]
+        tgt = vsvc.target(victim.target_id)
+        for meta in tgt.engine.all_metadata():
+            tgt.engine.remove(meta.chunk_id)
+        vsvc.stopped = False
+        server = RpcServer()
+        bind_storage_service(server, vsvc)
+        server.start()
+        cluster.servers.append(server)
+        cluster.server_of_node[vnode.node_id] = server
+        cluster.mgmtd.register_node(vnode.node_id, NodeType.STORAGE,
+                                    host=server.host, port=server.port)
+        tgt.local_state = LocalTargetState.ONLINE  # back, NOT up-to-date
+        cluster.alive[vnode.node_id] = True
+        cluster.tick()
+        cluster.tick()  # WAITING -> SYNCING
+        chain = cluster.mgmtd.get_routing_info().chains[cluster.ec_chain]
+        serving = chain.serving_targets()
+        coordinator = next(
+            svc for svc in cluster.services.values()
+            if serving and any(t.target_id == serving[0].target_id
+                               for t in svc.targets()))
+        worker = EcResyncWorker(coordinator, cluster.messenger(),
+                                batch_stripes=64)
+        t0 = time.perf_counter()
+        moved = 0
+        for _ in range(10):
+            moved += worker.run_once()
+            cluster.tick()
+            chain = cluster.mgmtd.get_routing_info().chains[cluster.ec_chain]
+            if all(t.public_state == PublicTargetState.SERVING
+                   for t in chain.targets):
+                break
+            # let the 0.2s routing TTLs expire so every party sees the
+            # SYNCING transition (wall-clock noise, not rebuild time —
+            # mibps below comes from the worker's own round timing)
+            time.sleep(0.25)
+        dt = time.perf_counter() - t0
+        stats = worker.last_stats
+        spread = len(stats["read_sources"])
+        results.append({
+            "metric": f"ec_rebuild_{k}_{m}",
+            "value": stats["mibps"], "unit": "MiB/s rebuilt (shard bytes)",
+            "stripes": stats["stripes"], "installed": stats["installed"],
+            "shards_moved": moved,
+            "wall_s": round(dt, 3),
+            "recovery_read_sources": spread,
+            "read_sources": {str(t): n
+                             for t, n in sorted(
+                                 stats["read_sources"].items())},
+            "sources_spread_ok": spread >= 2,
+        })
+        print(json.dumps(results[-1]), flush=True)
+        assert moved >= stripes, f"rebuild incomplete: {moved}/{stripes}"
+        assert spread >= 2
+        # clean read-back through the rebuilt target proves convergence
+        r = client.read_stripe(cluster.ec_chain, ChunkId(FILE_ID, 0), 0,
+                               size, chunk_size=size)
+        assert r.ok
+        client.close()
+    finally:
+        cluster.close()
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--m", type=int, default=2)
+    ap.add_argument("--stripes", type=int, default=48)
+    ap.add_argument("--size", type=int, default=1 << 20)
+    ap.add_argument("--fast", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    if args.fast:
+        args.stripes = min(args.stripes, 8)
+        args.size = min(args.size, 1 << 16)
+    rows = run_bench(k=args.k, m=args.m, stripes=args.stripes,
+                     size=args.size, fast=args.fast)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ import pytest
 
 from tpu3fs.client.storage_client import ReadReq, StorageClient
 from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.mgmtd.types import PublicTargetState
 from tpu3fs.storage.types import ChunkId
 from tpu3fs.utils.result import Code
 
@@ -319,3 +320,282 @@ class TestReadIntoBoundaries:
         assert n == 2 * cs + 100  # clamped to length
         assert bytes(dest[:2 * cs]) == b"\x00" * (2 * cs)  # holes zero-fill
         assert bytes(dest[2 * cs:2 * cs + 100]) == b"\x5a" * 100
+
+
+class TestEcFirstClassWrites:
+    """EC as a first-class layout through the normal write path: delta-
+    parity RMW for sub-stripe writes, inline degraded decode in batched
+    reads, rebuild under concurrent writes, trusted-CRC installs."""
+
+    CS = 4096
+
+    def _ec_fab(self, k=3, m=1, nodes=6):
+        return Fabric(SystemSetupConfig(
+            num_storage_nodes=nodes, num_chains=1, chunk_size=self.CS,
+            ec_k=k, ec_m=m))
+
+    def test_partial_stripe_rmw_matches_full_reencode(self):
+        """A sub-stripe write through the delta-parity RMW must leave
+        EXACTLY the parity bytes a full re-encode of the merged stripe
+        produces — and actually take the fast path."""
+        from tpu3fs.ops.stripe import get_codec, shard_size_of
+
+        rng = np.random.default_rng(60)
+        fab = self._ec_fab(k=3, m=2, nodes=5)
+        client = fab.storage_client()
+        cs = self.CS
+        k, m = 3, 2
+        S = shard_size_of(cs, k)
+        cid = ChunkId(90, 0)
+        base = rng.integers(0, 256, cs, dtype=np.uint8).tobytes()
+        assert client.write_stripe(fab.chain_ids[0], cid, base,
+                                   chunk_size=cs).ok
+        shadow = bytearray(base)
+        for off, n in [(7, 100), (S - 9, 30), (cs - 64, 64)]:
+            patch = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            reply = client.write_stripe_rmw(
+                fab.chain_ids[0], cid, off, patch, chunk_size=cs)
+            assert reply is not None and reply.ok, (off, n)
+            shadow[off:off + n] = patch
+        assert client._ec_parity_rmw._value == 3
+        assert client._ec_rmw_fallback._value == 0
+        # parity on disk == full re-encode of the merged stripe
+        codec = get_codec(k, m, S)
+        want_shards, _ = codec.encode_stripe(bytes(shadow))
+        routing = fab.routing()
+        chain = routing.chains[fab.chain_ids[0]]
+        for j in range(k + m):
+            t = chain.target_of_shard(j)
+            node = routing.node_of_target(t.target_id)
+            eng = fab.nodes[node.node_id].service.target(t.target_id).engine
+            stored = eng.read(cid)
+            assert stored.ljust(S, b"\x00") == \
+                want_shards[j].tobytes(), f"shard {j}"
+        # and the stripe-version invariant held: one committed version
+        vers = set()
+        for j in range(k + m):
+            t = chain.target_of_shard(j)
+            node = routing.node_of_target(t.target_id)
+            eng = fab.nodes[node.node_id].service.target(t.target_id).engine
+            vers.add(eng.get_meta(cid).committed_ver)
+        assert len(vers) == 1
+        fab.close()
+
+    def test_rmw_moves_fewer_shard_bytes_than_reencode(self):
+        """The point of delta parity: a one-shard write ships touched +
+        parity payloads, NOT the whole stripe."""
+        rng = np.random.default_rng(61)
+        fab = self._ec_fab(k=4, m=1, nodes=5)
+        client = fab.storage_client()
+        cs = self.CS
+        cid = ChunkId(91, 0)
+        base = rng.integers(0, 256, cs, dtype=np.uint8).tobytes()
+        assert client.write_stripe(fab.chain_ids[0], cid, base,
+                                   chunk_size=cs).ok
+        sent = []
+        orig = fab.send
+
+        def counting(node_id, method, payload):
+            if method in ("write_shard", "batch_write_shard"):
+                ops = payload if isinstance(payload, list) else [payload]
+                sent.extend(len(op.data) for op in ops)
+            return orig(node_id, method, payload)
+
+        probe = StorageClient("probe-rmw", fab.routing, counting)
+        reply = probe.write_stripe_rmw(
+            fab.chain_ids[0], cid, 16, b"\xaa" * 32, chunk_size=cs)
+        assert reply is not None and reply.ok
+        payload_bytes = sum(sent)
+        S = -(-cs // 4)
+        # touched data shard + 1 parity shard, NOT 4+1 shards
+        assert payload_bytes <= 2 * 1024 + 2 * S, payload_bytes
+        fab.close()
+
+    def test_ranged_reads_over_degraded_files_byte_exact(self):
+        """batch_read_files over an EC file with a DEAD shard node:
+        every ranged read decodes inline and stays byte-exact."""
+        rng = np.random.default_rng(62)
+        fab = self._ec_fab(k=3, m=1, nodes=4)
+        fio = fab.file_client()
+        cs = self.CS
+        shard = -(-cs // 3)
+        data = rng.integers(0, 256, 3 * cs - 117, dtype=np.uint8).tobytes()
+        inode = _file_with_data(fab, "/deg", data)
+        routing = fab.routing()
+        chain = routing.chains[fab.chain_ids[0]]
+        victim = chain.target_of_shard(1)
+        fab.fail_node(routing.node_of_target(victim.target_id).node_id)
+        client = fio.storage
+        before = client._ec_degraded._value
+        ranges = [
+            (0, cs),                   # whole stripe
+            (shard - 5, 10),           # straddles the dead shard's edge
+            (cs - 9, 18),              # straddles stripe boundary
+            (cs + shard, shard),       # inside the dead shard, stripe 1
+            (2 * cs, cs),              # the short tail stripe
+        ]
+        blobs = fio.batch_read_files(
+            [(inode, off, size) for off, size in ranges])
+        for (off, size), blob in zip(ranges, blobs):
+            assert blob == data[off:off + size], (off, size)
+        assert client._ec_degraded._value > before
+        fab.close()
+
+    def test_rebuild_under_concurrent_writes_converges(self):
+        """Kill a target, wipe its disk, and keep WRITING (overwrites +
+        new stripes, full and sub-stripe) while rebuild rounds run: the
+        chain must converge to SERVING with every stripe byte-exact."""
+        from tpu3fs.storage.ec_resync import EcResyncWorker
+
+        rng = np.random.default_rng(63)
+        fab = self._ec_fab(k=3, m=2, nodes=5)
+        client = fab.storage_client()
+        cs = self.CS
+        cid_of = lambda i: ChunkId(92, i)  # noqa: E731
+        shadow = {}
+        for i in range(10):
+            data = rng.integers(0, 256, cs, dtype=np.uint8).tobytes()
+            assert client.write_stripe(fab.chain_ids[0], cid_of(i), data,
+                                       chunk_size=cs).ok
+            shadow[i] = bytearray(data)
+        routing = fab.routing()
+        chain = routing.chains[fab.chain_ids[0]]
+        victim = chain.target_of_shard(2)
+        vnode = routing.node_of_target(victim.target_id)
+        fab.fail_node(vnode.node_id)
+        svc = fab.nodes[vnode.node_id].service
+        eng = svc.target(victim.target_id).engine
+        for meta in eng.all_metadata():
+            eng.remove(meta.chunk_id)
+        fab.restart_node(vnode.node_id)
+        fab.tick()
+        workers = {nid: EcResyncWorker(node.service, fab.send)
+                   for nid, node in fab.nodes.items()}
+        for rnd in range(8):
+            for nid, w in workers.items():
+                if fab.nodes[nid].alive:
+                    w.run_once()
+            # concurrent mutations between rounds: overwrite one stripe,
+            # sub-stripe-write another, add a brand-new one
+            i_over = rnd % 10
+            data = rng.integers(0, 256, cs, dtype=np.uint8).tobytes()
+            assert client.write_stripe(
+                fab.chain_ids[0], cid_of(i_over), data, chunk_size=cs).ok
+            shadow[i_over] = bytearray(data)
+            patch = rng.integers(0, 256, 64, dtype=np.uint8).tobytes()
+            r = client.write_stripe_rmw(
+                fab.chain_ids[0], cid_of((rnd + 1) % 10), 100, patch,
+                chunk_size=cs)
+            if r is None:  # mid-rebuild fallback: full RMW ladder
+                cur = client.read_stripe(
+                    fab.chain_ids[0], cid_of((rnd + 1) % 10), 0, cs,
+                    chunk_size=cs)
+                merged = bytearray(cur.data.ljust(cs, b"\x00"))
+                merged[100:164] = patch
+                assert client.write_stripe(
+                    fab.chain_ids[0], cid_of((rnd + 1) % 10),
+                    bytes(merged[:max(cur.logical_len, 164)]),
+                    chunk_size=cs,
+                    update_ver=client.next_stripe_ver(cur.commit_ver)).ok
+                shadow[(rnd + 1) % 10][:] = merged[:cs]
+            else:
+                shadow[(rnd + 1) % 10][100:164] = patch
+            new_i = 10 + rnd
+            data = rng.integers(0, 256, cs - 33, dtype=np.uint8).tobytes()
+            assert client.write_stripes(
+                fab.chain_ids[0], [(cid_of(new_i), data)],
+                chunk_size=cs)[0].ok
+            shadow[new_i] = bytearray(data.ljust(cs, b"\x00"))
+            fab.tick()
+            if all(t.public_state == PublicTargetState.SERVING
+                   for t in fab.routing().chains[fab.chain_ids[0]].targets):
+                break
+        # a couple of quiesced rounds mop up stripes written mid-rebuild
+        for _ in range(4):
+            for nid, w in workers.items():
+                if fab.nodes[nid].alive:
+                    w.run_once()
+            fab.tick()
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in fab.routing().chains[fab.chain_ids[0]].targets)
+        for i, want in shadow.items():
+            got = client.read_stripe(fab.chain_ids[0], cid_of(i), 0, cs,
+                                     chunk_size=cs)
+            assert got.ok and got.data == bytes(want).ljust(cs, b"\x00"), i
+        fab.close()
+
+    def test_trusted_crc_validated_installs_on_ec_chains(self):
+        """The EC install contract: the client-computed shard CRC is the
+        ONE checksum pass — the engine validates against it and adopts it
+        as the stored checksum; a wrong CRC is refused before anything
+        mutates; a rebase stage re-adopts the committed checksum."""
+        from tpu3fs.ops.crc32c import crc32c
+        from tpu3fs.storage.craq import ShardWriteReq
+
+        fab = self._ec_fab(k=3, m=1, nodes=4)
+        client = fab.storage_client()
+        cs = self.CS
+        cid = ChunkId(93, 0)
+        base = bytes(range(256)) * (cs // 256)
+        assert client.write_stripe(fab.chain_ids[0], cid, base,
+                                   chunk_size=cs).ok
+        routing = fab.routing()
+        chain = routing.chains[fab.chain_ids[0]]
+        t0 = chain.target_of_shard(0)
+        node0 = routing.node_of_target(t0.target_id)
+        eng = fab.nodes[node0.node_id].service.target(t0.target_id).engine
+        meta = eng.get_meta(cid)
+        from tpu3fs.ops.stripe import shard_size_of
+
+        S = shard_size_of(cs, 3)
+        want = base[:S]
+        # stored checksum IS the client's CRC of the trimmed shard bytes
+        assert meta.checksum.value == crc32c(want)
+        # a corrupt CRC is refused, committed shard untouched
+        bad = ShardWriteReq(
+            chain_id=fab.chain_ids[0], chain_ver=chain.chain_version,
+            target_id=t0.target_id, chunk_id=cid, data=b"\x11" * S,
+            crc=12345, update_ver=client.next_stripe_ver(meta.committed_ver),
+            chunk_size=S, logical_len=cs, phase=1)
+        reply = fab.send(node0.node_id, "write_shard", bad)
+        assert reply.code == Code.CHUNK_CHECKSUM_MISMATCH
+        assert eng.read(cid) == want
+        # a rebase stage adopts the committed content + checksum
+        ver2 = client.next_stripe_ver(meta.committed_ver)
+        rebase = ShardWriteReq(
+            chain_id=fab.chain_ids[0], chain_ver=chain.chain_version,
+            target_id=t0.target_id, chunk_id=cid, data=b"", crc=0,
+            update_ver=ver2, chunk_size=S, logical_len=cs, phase=1,
+            rebase_of=meta.committed_ver)
+        reply = fab.send(node0.node_id, "write_shard", rebase)
+        assert reply.ok and reply.checksum.value == crc32c(want)
+        # rebase against a superseded base version is refused
+        stale = ShardWriteReq(
+            chain_id=fab.chain_ids[0], chain_ver=chain.chain_version,
+            target_id=t0.target_id, chunk_id=cid, data=b"", crc=0,
+            update_ver=client.next_stripe_ver(ver2), chunk_size=S,
+            logical_len=cs, phase=1, rebase_of=meta.committed_ver + 7)
+        reply = fab.send(node0.node_id, "write_shard", stale)
+        assert reply.code == Code.CHUNK_STALE_UPDATE
+        fab.close()
+
+    def test_rmw_falls_back_when_chain_degraded(self):
+        """A partial write on a degraded chain must still land (full
+        re-encode ladder) — the RMW fast path declines, it never wedges."""
+        rng = np.random.default_rng(64)
+        fab = self._ec_fab(k=3, m=2, nodes=5)
+        fio = fab.file_client()
+        cs = self.CS
+        data = rng.integers(0, 256, cs, dtype=np.uint8).tobytes()
+        inode = _file_with_data(fab, "/degw", data)
+        routing = fab.routing()
+        chain = routing.chains[fab.chain_ids[0]]
+        victim = chain.target_of_shard(4)  # a parity shard's node
+        fab.fail_node(routing.node_of_target(victim.target_id).node_id)
+        patch = rng.integers(0, 256, 50, dtype=np.uint8).tobytes()
+        assert fio.write(inode, 123, patch) == 50
+        shadow = bytearray(data)
+        shadow[123:173] = patch
+        assert fio.read(inode, 0, len(data)) == bytes(shadow)
+        assert fio.storage._ec_rmw_fallback._value >= 1
+        fab.close()

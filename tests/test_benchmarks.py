@@ -194,3 +194,25 @@ class TestNorthstarBench:
         out = failed_target_rebuild(file_mb=8, chunk_mb=1)
         assert out["e2e_rebuild_gibps"] > 0
         assert out["e2e_rebuild_bytes"] > 0
+
+
+class TestEcBench:
+    """benchmarks/ec_bench fast-mode smoke: encode kernel, fused vs
+    encode-then-write EC writes, delta-parity RMW, degraded reads, and
+    the kill-a-target rebuild with recovery-read spread — over real
+    sockets at test sizes."""
+
+    def test_small_run(self):
+        from benchmarks.ec_bench import run_bench
+
+        rows = run_bench(k=3, m=1, stripes=6, size=1 << 16, fast=True)
+        by = {r["metric"]: r for r in rows}
+        assert by["ec_encode_host_3_1"]["value"] > 0
+        w = by["ec_write_fused_3_1"]
+        assert w["value"] > 0 and w["baseline_encode_then_write"] > 0
+        assert by["ec_substripe_rmw_3_1"]["value"] > 0
+        d = by["ec_degraded_read_3_1"]
+        assert d["value"] > 0 and d["clean_ms"] > 0
+        r = by["ec_rebuild_3_1"]
+        assert r["installed"] >= 6
+        assert r["sources_spread_ok"]

@@ -746,3 +746,56 @@ class TestKvcacheCli:
                       "--max-shards 100000")
         assert "capacity pass removed 0" in out  # all remaining leased
         fab.close()
+
+
+class TestBatchPutCreateFanIn:
+    def test_batch_put_uses_one_batch_create(self, cache):
+        """The create half of the write-back drain fans IN: one
+        batch_create call for the whole batch, zero per-key meta.create
+        round trips (the PR 6 follow-up that left the flush meta-bound)."""
+        fab, c = cache
+        calls = {"create": 0, "batch_create": 0}
+        real_create = fab.meta.create
+        real_batch_create = fab.meta.batch_create
+
+        def spy_create(*a, **kw):
+            calls["create"] += 1
+            return real_create(*a, **kw)
+
+        def spy_batch_create(items, *a, **kw):
+            calls["batch_create"] += 1
+            return real_batch_create(items, *a, **kw)
+
+        fab.meta.create = spy_create
+        fab.meta.batch_create = spy_batch_create
+        try:
+            c.batch_put([(f"bk{i}", bytes([i]) * 500) for i in range(12)])
+        finally:
+            fab.meta.create = real_create
+            fab.meta.batch_create = real_batch_create
+        assert calls["batch_create"] == 1
+        assert calls["create"] == 0
+        for i in range(12):
+            assert c.get(f"bk{i}") == bytes([i]) * 500
+
+    def test_batch_put_failed_create_raises_and_closes(self, cache):
+        fab, c = cache
+        real_batch_create = fab.meta.batch_create
+
+        def failing(items, *a, **kw):
+            res = real_batch_create(items, *a, **kw)
+            res[-1] = FsError.__new__(FsError)
+            FsError.__init__(res[-1], __import__(
+                "tpu3fs.utils.result", fromlist=["Status"]).Status(
+                    Code.META_NO_PERMISSION, "nope"))
+            return res
+
+        fab.meta.batch_create = failing
+        try:
+            with pytest.raises(FsError):
+                c.batch_put([("ok", b"x"), ("bad", b"y")])
+        finally:
+            fab.meta.batch_create = real_batch_create
+        # no leaked write sessions: a fresh put on the same key succeeds
+        c.put("ok", b"z")
+        assert c.get("ok") == b"z"

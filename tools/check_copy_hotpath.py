@@ -58,7 +58,17 @@ HOT_PATH: List[Tuple[str, List[str]]] = [
     ("tpu3fs/client/storage_client.py",
      ["batch_read",
       # write path: pipelined batch fan-out + batched stripe writes
-      "batch_write", "write_stripes", "_send_shard_batches"]),
+      "batch_write", "write_stripes", "_send_shard_batches",
+      # EC data plane: batched shard fetch, clean/degraded stripe
+      # assembly (the degraded fill), delta-parity sub-stripe RMW
+      "_issue_wire_reads", "_plan_stripe_read", "_stripe_clean",
+      "_stripe_degraded", "_finish_stripe_reads", "_write_stripe_rmw"]),
+    # EC kernels: XOR-scheduled host encode + delta-parity column apply
+    ("tpu3fs/ops/rs.py", ["encode_np", "delta_parity_host"]),
+    ("tpu3fs/ops/stripe.py", ["encode_parity", "delta_parity"]),
+    # EC rebuild: batched recovery gather + batched shard install
+    ("tpu3fs/storage/ec_resync.py",
+     ["_gather_batched", "_install_batch", "_rebuild_batch"]),
     ("tpu3fs/client/file_io.py",
      ["read_into", "_batch_read_files_direct", "_fetch_window",
       # write path: user-buffer gather into per-chunk views

@@ -38,7 +38,6 @@ from tpu3fs.client.file_io import FileIoClient
 from tpu3fs.meta.store import MetaStore, OpenFlags
 from tpu3fs.meta.types import Layout
 from tpu3fs.monitor.recorder import CounterRecorder
-from tpu3fs.ops.crc32c import crc32c
 from tpu3fs.qos.core import TrafficClass, tagged
 from tpu3fs.utils import trash as _trash
 from tpu3fs.utils.result import Code, FsError
@@ -196,10 +195,16 @@ class CheckpointGC:
         return archived
 
     def archive_step(self, step: int, layout: Layout) -> Manifest:
-        """Re-encode one cold step onto `layout` (an EC-chain layout):
-        copy every data file + manifest into ``<step>.arc/`` on the new
-        layout, verify shard CRCs against the manifest, then swap — old
-        replicas to trash, ``.arc`` renamed into place."""
+        """Re-encode one cold step onto `layout` (an EC-chain layout)
+        through the FIRST-CLASS batched EC write path: every data file
+        reads back as ONE batch_read_files, the ``<step>.arc/`` files
+        create as one batch_create, and the whole step lands as ONE
+        encode-fused ``batch_write_files(with_checksums=True)`` — full
+        stripes encode once client-side and fan out shard-batched, and
+        the returned per-file CRC32Cs verify against the manifest with
+        no separate content pass (the old path copied file-by-file and
+        CRC-checked in its own read pass). Then swap — old replicas to
+        trash, ``.arc`` renamed into place."""
         sdir = step_dir(self.root, step)
         apath = arc_dir(self.root, step)
         with tagged(TrafficClass.CKPT):
@@ -218,15 +223,40 @@ class CheckpointGC:
                     raise
                 self._meta.remove(apath, recursive=True)
                 self._meta.mkdirs(apath, recursive=True)
-            for sh in manifest.shards:
-                src = self._meta.stat(f"{sdir}/{sh.file}")
-                raw = self._fio.read(src, 0, src.length)
-                if len(raw) != sh.length or crc32c(raw) != sh.crc:
+            srcs = [self._meta.stat(f"{sdir}/{sh.file}")
+                    for sh in manifest.shards]
+            blobs = self._fio.batch_read_files(
+                [(src, 0, sh.length)
+                 for src, sh in zip(srcs, manifest.shards)])
+            for sh, raw in zip(manifest.shards, blobs):
+                if len(raw) != sh.length:
+                    raise _err(Code.CKPT_CORRUPT,
+                               f"shard {sh.file}: short read on archive")
+            names = [sh.file for sh in manifest.shards] + [MANIFEST_NAME]
+            payloads = blobs + [manifest.encode()]
+            opened = self._create_all(
+                [f"{apath}/{name}" for name in names], layout)
+            try:
+                counts, sums = self._fio.batch_write_files(
+                    [(res.inode, 0, blob)
+                     for res, blob in zip(opened, payloads)],
+                    with_checksums=True)
+            except BaseException:
+                for res in opened:
+                    try:
+                        self._meta.close(res.inode.id, res.session_id)
+                    except FsError:
+                        pass
+                raise
+            # the write-side CRCs come from the SAME pooled pass that fed
+            # the trusted-CRC install, so comparing them to the manifest
+            # verifies source bytes -> EC shards end to end without a
+            # re-read
+            for sh, crc in zip(manifest.shards, sums):
+                if crc.value != sh.crc:
                     raise _err(Code.CKPT_CORRUPT,
                                f"shard {sh.file}: CRC mismatch on archive")
-                self._copy_in(f"{apath}/{sh.file}", raw, layout)
-            self._copy_in(f"{apath}/{MANIFEST_NAME}", manifest.encode(),
-                          layout)
+            self._close_all(opened, counts)
             # swap: the step vanishes for at most the gap between the two
             # renames; the .arc dir is complete before the old leaves.
             # (trash routing, but NOT counted as a gc_removed eviction —
@@ -237,17 +267,49 @@ class CheckpointGC:
             self._meta.rename(apath, sdir)
         return manifest
 
-    def _copy_in(self, path: str, data: bytes, layout: Layout) -> None:
-        res = self._meta.create(
-            path, flags=OpenFlags.WRITE | OpenFlags.CREATE | OpenFlags.TRUNC,
-            client_id=self._client_id, layout=layout)
-        try:
-            n = self._fio.write(res.inode, 0, data)
-        except BaseException:
-            try:
-                self._meta.close(res.inode.id, res.session_id)
-            except FsError:
-                pass
-            raise
-        self._meta.close(res.inode.id, res.session_id, length_hint=n,
-                         wrote=True)
+    def _create_all(self, paths: List[str], layout: Layout) -> List:
+        """Create the archive files in one batch_create when the meta
+        surface has one (in-process store or RPC client), else the
+        per-file ladder."""
+        flags = OpenFlags.WRITE | OpenFlags.CREATE | OpenFlags.TRUNC
+        batch_create = getattr(self._meta, "batch_create", None)
+        if batch_create is not None:
+            from tpu3fs.meta.store import BatchCreateItem
+
+            results = batch_create([
+                BatchCreateItem(path=p, flags=flags,
+                                client_id=self._client_id, layout=layout)
+                for p in paths])
+            opened = []
+            for res in results:
+                if isinstance(res, FsError):
+                    for prev in opened:
+                        try:
+                            self._meta.close(prev.inode.id, prev.session_id)
+                        except FsError:
+                            pass
+                    raise res
+                opened.append(res)
+            return opened
+        return [self._meta.create(p, flags=flags,
+                                  client_id=self._client_id, layout=layout)
+                for p in paths]
+
+    def _close_all(self, opened: List, counts: List[int]) -> None:
+        from tpu3fs.meta.store import BatchCloseItem
+
+        batch_close = getattr(self._meta, "batch_close", None)
+        if batch_close is not None:
+            results = batch_close([
+                BatchCloseItem(inode_id=res.inode.id,
+                               session_id=res.session_id,
+                               length_hint=n, client_id=self._client_id,
+                               wrote=1)
+                for res, n in zip(opened, counts)])
+            for res in results:
+                if isinstance(res, FsError):
+                    raise res
+            return
+        for res, n in zip(opened, counts):
+            self._meta.close(res.inode.id, res.session_id, length_hint=n,
+                             wrote=True)

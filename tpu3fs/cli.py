@@ -403,6 +403,90 @@ class AdminCli:
                     lines.append(f"  queue depths: {depths}")
         return "\n".join(lines) if lines else "no storage nodes"
 
+    def cmd_ec_status(self, args: List[str]) -> str:
+        """Per-EC-chain health: shard -> target/state map, degraded
+        summary, and with --counts the per-target stripe counts
+        (dump_chunkmeta), rebuild progress of SYNCING shards and the
+        file ids currently served degraded.
+        ec-status [--chain ID] [--counts]"""
+        want = self._flag(args, "--chain")
+        deep = "--counts" in args
+        routing = self.fab.routing()
+        lines = []
+        for cid, chain in sorted(routing.chains.items()):
+            if not chain.is_ec:
+                continue
+            if want is not None and int(want) != cid:
+                continue
+            states = [t.public_state.name for t in chain.targets]
+            degraded = sum(1 for s in states if s != "SERVING")
+            syncing = sum(1 for s in states if s == "SYNCING")
+            head = (f"chain {cid} EC({chain.ec_k},{chain.ec_m}) "
+                    f"v{chain.chain_version}: ")
+            if degraded == 0:
+                head += "healthy"
+            else:
+                head += f"DEGRADED ({degraded} shard(s) not serving"
+                if syncing:
+                    head += f", {syncing} rebuilding"
+                head += ")"
+            lines.append(head)
+            metas = {}
+            if deep:
+                for t in chain.targets:
+                    node = routing.node_of_target(t.target_id)
+                    if node is None:
+                        continue
+                    try:
+                        metas[t.target_id] = self.fab.send(
+                            node.node_id, "dump_chunkmeta", t.target_id)
+                    except FsError:
+                        metas[t.target_id] = None
+            # shard positions come from preferred_order (chain_sm may
+            # rotate `targets`; the shard layout never moves)
+            for j in range(chain.ec_k + chain.ec_m):
+                t = chain.target_of_shard(j)
+                if t is None:
+                    lines.append(f"  shard {j}: no target")
+                    continue
+                node = routing.node_of_target(t.target_id)
+                kind = "data" if j < chain.ec_k else "parity"
+                extra = ""
+                if deep:
+                    got = metas.get(t.target_id)
+                    extra = f"  stripes={len(got) if got is not None else '?'}"
+                lines.append(
+                    f"  shard {j} ({kind:<6}) target {t.target_id} node "
+                    f"{node.node_id if node else '?'} "
+                    f"{t.public_state.name}{extra}")
+            if deep and degraded:
+                # rebuild progress: the recovering shard's stripe count vs
+                # the fullest serving peer; degraded files = files whose
+                # stripes a serving peer still holds (reads decode inline)
+                serving_ids = {t.target_id for t in chain.targets
+                               if t.public_state.name == "SERVING"}
+                peer_counts = [len(v) for tid, v in metas.items()
+                               if v is not None and tid in serving_ids]
+                goal = max(peer_counts, default=0)
+                for t in chain.targets:
+                    if t.public_state.name != "SYNCING":
+                        continue
+                    have = metas.get(t.target_id)
+                    have_n = len(have) if have is not None else 0
+                    lines.append(f"  rebuild: target {t.target_id} "
+                                 f"{have_n}/{goal} stripes")
+                files = sorted({m.chunk_id.file_id
+                                for tid, v in metas.items()
+                                if v is not None and tid in serving_ids
+                                for m in v})
+                if files:
+                    shown = ", ".join(str(f) for f in files[:8])
+                    more = ("" if len(files) <= 8
+                            else f" (+{len(files) - 8} more)")
+                    lines.append(
+                        f"  degraded files: {shown}{more}")
+        return "\n".join(lines) if lines else "no EC chains"
+
     # -- FS shell ------------------------------------------------------------
     def cmd_ls(self, args: List[str]) -> str:
         path = args[0] if args else "/"

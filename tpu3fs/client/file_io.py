@@ -279,16 +279,23 @@ class FileIoClient:
 
     def _write_ec_chunk(self, inode: Inode, chain_id: int, idx: int,
                         in_off: int, part: bytes, chunk_size: int):
-        """EC chunks are whole stripes: a full-chunk write encodes directly;
-        a partial write is read-modify-write of the stripe (parity must be
-        re-encoded over the merged content). Concurrent partial writers of
-        the SAME stripe race on the stripe version (last write wins) — like
-        the reference, non-overlapping writers of a shared file should write
-        different chunks."""
+        """EC chunks are whole stripes: a full-chunk write encodes directly.
+        A partial write first tries DELTA-PARITY RMW (write_stripe_rmw:
+        read touched data + parity shards, ``P' = P ^ c*(D'^D)``, stage
+        touched + parity + payload-free rebases — no stripe re-encode);
+        when the fast path does not apply (fresh/degraded/raced stripe) it
+        falls back to full read-modify-write re-encoding the stripe.
+        Concurrent partial writers of the SAME stripe race on the stripe
+        version (last write wins) — like the reference, non-overlapping
+        writers of a shared file should write different chunks."""
         cid = ChunkId(inode.id, idx)
         if in_off == 0 and len(part) == chunk_size:
             return self._storage.write_stripe(
                 chain_id, cid, part, chunk_size=chunk_size)
+        fast = self._storage.write_stripe_rmw(
+            chain_id, cid, in_off, part, chunk_size=chunk_size)
+        if fast is not None:
+            return fast
         cur = self._storage.read_stripe(
             chain_id, cid, 0, chunk_size, chunk_size=chunk_size)
         if cur.ok:

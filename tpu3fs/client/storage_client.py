@@ -128,6 +128,18 @@ class StorageClient:
         self._pool = None  # lazy batch fan-out pool (multi-node batches)
         self._pool_mu = threading.Lock()
         self._pool_finalizer = None
+        # EC data-plane health/throughput recorders (docs/ec.md)
+        from tpu3fs.monitor.recorder import (
+            CounterRecorder,
+            DistributionRecorder,
+            ValueRecorder,
+        )
+
+        self._ec_degraded = CounterRecorder("ec.degraded_read")
+        self._ec_degraded_ms = DistributionRecorder("ec.degraded_read_ms")
+        self._ec_parity_rmw = CounterRecorder("ec.parity_rmw")
+        self._ec_rmw_fallback = CounterRecorder("ec.parity_rmw_fallback")
+        self._ec_encode_gibps = ValueRecorder("ec.encode_gibps")
 
     def close(self) -> None:
         """Release the fan-out pool's worker threads. Explicit close is
@@ -344,10 +356,21 @@ class StorageClient:
     def batch_read(
         self, reqs: List[ReadReq]
     ) -> List[ReadReply]:
-        """Group per node (ref groupOpsByNodeId) then issue node batches."""
+        """Group per node (ref groupOpsByNodeId) then issue node batches.
+
+        EC requests ride the SAME node-grouped striped fan-out as the CR
+        ops: their covering shard reads interleave into the per-node
+        batches (one wire round trip for the whole mixed batch), and a
+        stripe whose direct shards fail — dead target, missing shard,
+        version skew — goes DEGRADED inline: the surviving shards of
+        every degraded stripe are fetched in one more batched round and
+        decoded client-side (any k of k+m), with ec.degraded_read /
+        ec.degraded_read_ms recording the detour."""
         routing = self._routing()
-        plan: List[Tuple[int, int, ReadReq]] = []  # (node, original idx, req)
         replies: List[Optional[ReadReply]] = [None] * len(reqs)
+        wire: List[Tuple[int, ReadReq]] = []   # (node_id, wire op)
+        tags: List[Tuple] = []                 # ("cr", i) | ("ec", i, j)
+        ec_specs: Dict[int, dict] = {}
         for i, req in enumerate(reqs):
             chain = routing.chains.get(req.chain_id)
             if chain is None:
@@ -361,9 +384,14 @@ class StorageClient:
                 if not req.chunk_size:
                     replies[i] = ReadReply(Code.INVALID_ARG)
                     continue
-                replies[i] = self.read_stripe(
-                    req.chain_id, req.chunk_id, req.offset, req.length,
-                    chunk_size=req.chunk_size)
+                spec = self._plan_stripe_read(chain, routing, req)
+                if spec["length"] == 0:
+                    replies[i] = ReadReply(Code.OK, data=b"")
+                    continue
+                ec_specs[i] = spec
+                for j, (node_id, rr) in spec["wire"].items():
+                    tags.append(("ec", i, j))
+                    wire.append((node_id, rr))
                 continue
             targets = self._pick_targets(chain)
             if not targets:
@@ -374,45 +402,23 @@ class StorageClient:
             if node is None:
                 replies[i] = ReadReply(Code.TARGET_NOT_FOUND)
                 continue
-            plan.append((node.node_id, i, ReadReq(
+            tags.append(("cr", i))
+            wire.append((node.node_id, ReadReq(
                 req.chain_id, req.chunk_id, req.offset, req.length, target_id
             )))
-        by_node: Dict[int, List[Tuple[int, ReadReq]]] = defaultdict(list)
-        for node_id, i, req in plan:
-            by_node[node_id].append((i, req))
-
-        items = list(by_node.items())
-        pipelined = getattr(self._messenger, "batch_read_pipelined", None)
-        if pipelined is not None and items:
-            # striped multi-connection fan-out with pipelined issue: every
-            # node group's stripes go on the wire BEFORE any reply is
-            # collected, each on its own pooled connection — wall clock is
-            # the slowest stripe, not the sum (socket messengers only; the
-            # in-process fabric keeps direct dispatch below)
-            groups = [(node_id, [req for _, req in batch])
-                      for node_id, batch in items]
-            for (node_id, batch), got in zip(items, pipelined(groups)):
-                for (i, _), reply in zip(batch, got):
-                    replies[i] = reply
-        else:
-            def _issue_read(item) -> None:
-                # ONE BatchRead request per node (ref sendBatchRequest
-                # StorageClientImpl.cc:1303): the round trip is amortized
-                # over the whole group
-                node_id, batch = item
-                idxs = [i for i, _ in batch]
-                try:
-                    got = self._messenger(
-                        node_id, "batch_read", [req for _, req in batch])
-                    for i, reply in zip(idxs, got):
-                        replies[i] = reply
-                except FsError as e:
-                    for i in idxs:
-                        replies[i] = ReadReply(e.code)
-
-            self._fan_out(_issue_read, items)
+        wire_replies = self._issue_wire_reads(wire)
+        shard_replies: Dict[int, Dict[int, ReadReply]] = {
+            i: {} for i in ec_specs}
+        for tag, r in zip(tags, wire_replies):
+            if tag[0] == "cr":
+                replies[tag[1]] = r
+            else:
+                shard_replies[tag[1]][tag[2]] = r
+        if ec_specs:
+            self._finish_stripe_reads(
+                reqs, replies, ec_specs, shard_replies, routing)
         # fall back to the single-op retry ladder for failures (EC replies
-        # already went through read_stripe's own ladder)
+        # already went through the degraded decode / read_stripe ladder)
         for i, r in enumerate(replies):
             if r is None or (not r.ok and r.code != Code.CHUNK_NOT_FOUND):
                 chain = routing.chains.get(reqs[i].chain_id)
@@ -421,6 +427,49 @@ class StorageClient:
                 replies[i] = self.read_chunk(
                     reqs[i].chain_id, reqs[i].chunk_id, reqs[i].offset, reqs[i].length
                 )
+        return replies  # type: ignore[return-value]
+
+    def _issue_wire_reads(
+        self, wire: List[Tuple[int, ReadReq]]
+    ) -> List[ReadReply]:
+        """Issue already-planned (node_id, op) reads grouped per node —
+        striped multi-connection fan-out with pipelined issue when the
+        messenger supports it: every node group's stripes go on the wire
+        BEFORE any reply is collected, each on its own pooled connection,
+        so wall clock is the slowest stripe, not the sum (socket
+        messengers only; the in-process fabric keeps direct dispatch via
+        the pool fan-out). -> replies aligned with `wire`."""
+        replies: List[Optional[ReadReply]] = [None] * len(wire)
+        by_node: Dict[int, List[int]] = defaultdict(list)
+        for w, (node_id, _) in enumerate(wire):
+            by_node[node_id].append(w)
+        items = list(by_node.items())
+        pipelined = getattr(self._messenger, "batch_read_pipelined", None)
+        if pipelined is not None and items:
+            groups = [(node_id, [wire[w][1] for w in idxs])
+                      for node_id, idxs in items]
+            for (node_id, idxs), got in zip(items, pipelined(groups)):
+                for w, reply in zip(idxs, got):
+                    replies[w] = reply
+        else:
+            def _issue_read(item) -> None:
+                # ONE BatchRead request per node (ref sendBatchRequest
+                # StorageClientImpl.cc:1303): the round trip is amortized
+                # over the whole group
+                node_id, idxs = item
+                try:
+                    got = self._messenger(
+                        node_id, "batch_read", [wire[w][1] for w in idxs])
+                    for w, reply in zip(idxs, got):
+                        replies[w] = reply
+                except FsError as e:
+                    for w in idxs:
+                        replies[w] = ReadReply(e.code)
+
+            self._fan_out(_issue_read, items)
+        for w, r in enumerate(replies):
+            if r is None:  # short reply list from a confused server
+                replies[w] = ReadReply(Code.RPC_PEER_CLOSED)
         return replies  # type: ignore[return-value]
 
     def batch_write(
@@ -746,7 +795,11 @@ class StorageClient:
         # parity-only encode: data-shard payloads below are slices of the
         # caller's bytes, so materializing a concatenated (B, k+m, S)
         # array would be a multi-MiB copy per batch for nothing
+        t_enc = time.monotonic()
         parity, crcs = codec.encode_parity(buf)
+        dt_enc = time.monotonic() - t_enc
+        if dt_enc > 0:
+            self._ec_encode_gibps.set(B * k * S / dt_enc / (1 << 30))
 
         routing = self._routing()
         # one-RPC version probe: max committed over probed shards is the
@@ -837,6 +890,383 @@ class StorageClient:
                     update_ver=vers[b]))
         return out
 
+    def write_stripe_rmw(
+        self,
+        chain_id: int,
+        chunk_id: ChunkId,
+        in_off: int,
+        part,
+        *,
+        chunk_size: int = 1 << 20,
+    ) -> Optional[UpdateReply]:
+        """Sub-stripe write via DELTA PARITY (see _write_stripe_rmw);
+        every fast-path decline counts on ec.parity_rmw_fallback so the
+        monitor can answer "is the RMW path actually engaging"."""
+        out = self._write_stripe_rmw(chain_id, chunk_id, in_off, part,
+                                     chunk_size=chunk_size)
+        if out is None:
+            self._ec_rmw_fallback.add()
+        return out
+
+    def _write_stripe_rmw(
+        self,
+        chain_id: int,
+        chunk_id: ChunkId,
+        in_off: int,
+        part,
+        *,
+        chunk_size: int = 1 << 20,
+    ) -> Optional[UpdateReply]:
+        """Sub-stripe write via DELTA PARITY: read only the touched data
+        shards + the m parity shards, apply ``P' = P ^ c_ij * (D' ^ D)``
+        (ops/rs.py delta_parity), stage the touched shards and new parity
+        under a fresh stripe version, and bump the UNTOUCHED data shards
+        with payload-free rebase stages (ShardWriteReq.rebase_of) — the
+        server re-stages its own committed bytes. A sub-stripe write thus
+        moves (touched + m) shards each way instead of reading k and
+        rewriting k+m, with no stripe re-encode anywhere.
+
+        Returns an UpdateReply on success; None when the fast path does
+        not apply (missing/degraded/mid-write stripe, version race,
+        partial stage) — the caller falls back to the full
+        read-reencode-rewrite ladder, which handles every case. The
+        whole-stripe-version invariant is preserved: every shard of the
+        stripe lands at the new version (rebase included), so readers
+        never see mixed versions from a completed RMW."""
+        import numpy as np
+
+        from tpu3fs.ops.stripe import get_codec, shard_size_of
+
+        chain = self._chain(chain_id)
+        if not chain.is_ec:
+            raise FsError(Status(Code.INVALID_ARG,
+                                 "write_stripe_rmw on CR chain"))
+        k, m = chain.ec_k, chain.ec_m
+        n = len(part)
+        if m == 0 or n == 0 or in_off + n > chunk_size:
+            return None
+        S = shard_size_of(chunk_size, k)
+        routing = self._routing()
+        ja0, ja1 = in_off // S, (in_off + n - 1) // S + 1
+        touched = list(range(ja0, ja1))
+        if len(touched) >= k:
+            return None  # whole-stripe rewrite: plain re-encode is cheaper
+        # the delta path has no partial-staging story: every shard target
+        # must be writable, readable and routable, or fall back
+        nodes: Dict[int, tuple] = {}
+        for j in range(k + m):
+            t = chain.target_of_shard(j)
+            if (t is None or not t.public_state.can_write
+                    or not t.public_state.can_read):
+                return None
+            node = routing.node_of_target(t.target_id)
+            if node is None:
+                return None
+            nodes[j] = (t, node)
+        # old content: touched data shards + every parity shard, one
+        # node-grouped batched fetch
+        fetch_idx = touched + [k + i for i in range(m)]
+        wire = [(nodes[j][1].node_id,
+                 ReadReq(chain_id, chunk_id, 0, -1, nodes[j][0].target_id))
+                for j in fetch_idx]
+        got = dict(zip(fetch_idx, self._issue_wire_reads(wire)))
+        vers = set()
+        for r in got.values():
+            if not r.ok:
+                return None  # absent stripe / degraded shard: fall back
+            vers.add(r.commit_ver)
+        if len(vers) != 1:
+            return None  # a write is mid-flight: fall back (ladder retries)
+        base_ver = vers.pop()
+        logical = max((r.logical_len for r in got.values()
+                       if r.logical_len), default=0)
+        if logical == 0:
+            return None  # aux-less legacy stripe: exact extent unknown
+        new_logical = max(logical, in_off + n)
+        codec = get_codec(k, m, S)
+        mv = memoryview(part)
+        payloads: Dict[int, bytes] = {}
+        crcs: Dict[int, int] = {}
+        parity = [
+            np.frombuffer(
+                bytes(got[k + i].data)  # copy-ok: delta math re-buffers
+                .ljust(S, b"\x00"), dtype=np.uint8).copy()  # copy-ok: XOR target
+            for i in range(m)
+        ]
+        pos = 0
+        for j in touched:
+            old = np.frombuffer(
+                bytes(got[j].data)  # copy-ok: delta math re-buffers
+                .ljust(S, b"\x00"), dtype=np.uint8)
+            new = old.copy()  # copy-ok: merged shard content
+            lo = max(in_off - j * S, 0)
+            hi = min(in_off + n - j * S, S)
+            new[lo:hi] = np.frombuffer(mv[pos : pos + (hi - lo)],
+                                       dtype=np.uint8)
+            pos += hi - lo
+            for i, row in enumerate(codec.delta_parity(j, old ^ new)):
+                parity[i] ^= row
+            extent = min(max(new_logical - j * S, 0), S)
+            payload = new[:extent].tobytes()
+            payloads[j] = payload
+            crcs[j] = codec.crc_host(payload)
+        for i in range(m):
+            payloads[k + i] = parity[i].tobytes()
+            crcs[k + i] = codec.crc_host(payloads[k + i])
+        ver = self._ec_next_ver(base_ver)
+        by_node: Dict[int, List[Tuple[int, ShardWriteReq]]] = defaultdict(list)
+        for j in range(k + m):
+            t, node = nodes[j]
+            if j in payloads:
+                req = ShardWriteReq(
+                    chain_id=chain_id, chain_ver=chain.chain_version,
+                    target_id=t.target_id, chunk_id=chunk_id,
+                    data=payloads[j], crc=crcs[j], update_ver=ver,
+                    chunk_size=S, logical_len=new_logical, phase=1)
+            else:
+                # untouched data shard: payload-free version bump — the
+                # server stages its own committed bytes iff still at
+                # base_ver (a racing writer fails the rebase, we fall back)
+                req = ShardWriteReq(
+                    chain_id=chain_id, chain_ver=chain.chain_version,
+                    target_id=t.target_id, chunk_id=chunk_id,
+                    data=b"", crc=0, update_ver=ver, chunk_size=S,
+                    logical_len=new_logical, phase=1, rebase_of=base_ver)
+            by_node[node.node_id].append((j, req))
+        staged = {j for j, reply in self._send_shard_batches(by_node)
+                  if reply.ok}
+        if len(staged) != k + m:
+            # version race or unreachable shard: orphan pendings are
+            # displaced by the fallback's re-stage / reclaimed by the
+            # repair sweep
+            return None
+        commit_by_node: Dict[int, List[Tuple[int, ShardWriteReq]]] = (
+            defaultdict(list))
+        for node_id, group in by_node.items():
+            for j, r in group:
+                commit_by_node[node_id].append((j, replace(
+                    r, data=b"", crc=0, phase=2, rebase_of=0)))
+        landed: set = set()
+        for attempt in range(self._retry.max_retries + 1):
+            displaced = False
+            for j, reply in self._send_shard_batches(commit_by_node):
+                if reply.ok:
+                    landed.add(j)
+                elif reply.code == Code.CHUNK_MISSING_UPDATE:
+                    displaced = True
+            if len(landed) == k + m:
+                self._ec_parity_rmw.add()
+                return UpdateReply(Code.OK, update_ver=ver, commit_ver=ver)
+            # commits are idempotent: retry the stragglers (transient
+            # node hiccup); a pending displaced by a concurrent writer
+            # (CHUNK_MISSING_UPDATE) can never land — fall back
+            if displaced:
+                break
+            commit_by_node = defaultdict(list)
+            for node_id, group in by_node.items():
+                for j, r in group:
+                    if j not in landed:
+                        commit_by_node[node_id].append((j, replace(
+                            r, data=b"", crc=0, phase=2, rebase_of=0)))
+            if not commit_by_node:
+                break
+            self._sleep(attempt)
+        # partial commit: the staged version holds a full-coverage quorum,
+        # so the repair sweep's roll-forward (or the fallback's re-stage)
+        # converges the stripe — report "not applied" to the caller
+        return None
+
+    def _plan_stripe_read(self, chain: ChainInfo, routing: RoutingInfo,
+                          req: ReadReq) -> dict:
+        """Shard-read plan for one EC range request: which shards cover
+        [offset, offset+length) and the wire ops (node-routed, target-
+        addressed whole-shard reads) that fetch them. Unroutable or
+        publicly-unreadable shards simply get no wire entry — the finish
+        step treats them as failed and goes degraded."""
+        from tpu3fs.ops.stripe import shard_size_of
+
+        k, m = chain.ec_k, chain.ec_m
+        S = shard_size_of(req.chunk_size, k)
+        length = req.length if req.length >= 0 else req.chunk_size - req.offset
+        length = max(0, min(length, req.chunk_size - req.offset))
+        j0 = req.offset // S
+        j1 = (req.offset + length - 1) // S + 1 if length else j0 + 1
+        spec = {"chain": chain, "k": k, "m": m, "S": S, "j0": j0, "j1": j1,
+                "offset": req.offset, "length": length, "wire": {}}
+        for j in range(j0, j1):
+            t = chain.target_of_shard(j)
+            if t is None or not t.public_state.can_read:
+                continue
+            node = routing.node_of_target(t.target_id)
+            if node is None:
+                continue
+            spec["wire"][j] = (node.node_id, ReadReq(
+                chain.chain_id, req.chunk_id, 0, -1, t.target_id))
+        return spec
+
+    @staticmethod
+    def _stripe_logical(spec: dict, replies: Dict[int, ReadReply],
+                        group: Optional[Dict[int, bytes]] = None,
+                        parts: Optional[Dict[int, bytes]] = None) -> int:
+        """Logical (pre-padding) stripe length: exact from any shard's
+        stored aux tag (ShardWriteReq.logical_len persisted by the
+        server); full-cover reads without one infer it from stored shard
+        extents (decoded shards via trim_rebuilt_shard)."""
+        k, S, j0, j1 = spec["k"], spec["S"], spec["j0"], spec["j1"]
+        logical = max(
+            (r.logical_len for r in replies.values()
+             if r is not None and r.ok and r.logical_len), default=0)
+        if logical == 0 and (j0, j1) == (0, k):
+            if group is None:
+                logical = max(
+                    (j * S + len(replies[j].data) for j in range(j0, j1)
+                     if len(replies[j].data) > 0), default=0)
+            else:
+                from tpu3fs.ops.stripe import trim_rebuilt_shard
+
+                lens = {j: len(group[j]) for j in group if j < k}
+                logical = max(
+                    (j * S + len(group[j]) for j in group
+                     if j < k and len(group[j]) > 0), default=0)
+                for j in range(j0, j1):
+                    if j in group or j >= k:
+                        continue
+                    trimmed = trim_rebuilt_shard(parts[j], j, lens, k, S)
+                    if len(trimmed) > 0:
+                        logical = max(logical, j * S + len(trimmed))
+        return logical
+
+    def _stripe_clean(self, spec: dict,
+                      direct: Dict[int, ReadReply]) -> Optional[ReadReply]:
+        """Assemble the fast path: every covering shard answered OK at ONE
+        committed version. None = not clean (degraded decode next)."""
+        j0, j1, S = spec["j0"], spec["j1"], spec["S"]
+        rs = [direct.get(j) for j in range(j0, j1)]
+        if any(r is None or not r.ok for r in rs):
+            return None
+        vers = {r.commit_ver for r in rs}
+        if len(vers) != 1:
+            return None
+        whole = b"".join(  # copy-ok: range assembly of shard payloads
+            bytes(direct[j].data).ljust(S, b"\x00")  # copy-ok: pad to slot
+            for j in range(j0, j1))
+        lo = spec["offset"] - j0 * S
+        return ReadReply(
+            Code.OK,
+            data=whole[lo : lo + spec["length"]],
+            commit_ver=vers.pop(),
+            logical_len=self._stripe_logical(spec, direct),
+        )
+
+    def _stripe_degraded(self, spec: dict,
+                         replies: Dict[int, ReadReply]) -> Optional[ReadReply]:
+        """Degraded decode over ALL fetched shards: group by committed
+        version, reconstruct the covering shards from the newest version
+        holding a k-quorum. CHUNK_NOT_FOUND when every shard is missing;
+        None when no version is decodable yet (mixed versions mid-write —
+        the caller's ladder retries)."""
+        from tpu3fs.ops.stripe import get_codec
+
+        k, m, S = spec["k"], spec["m"], spec["S"]
+        j0, j1 = spec["j0"], spec["j1"]
+        by_ver: Dict[int, Dict[int, bytes]] = defaultdict(dict)
+        all_missing = True
+        for j, r in replies.items():
+            if r is None:
+                continue
+            if r.ok:
+                # the decode path pads/joins/ndarray-stacks shard
+                # payloads: materialize any zero-copy transport view once
+                by_ver[r.commit_ver][j] = bytes(r.data)  # copy-ok: decode input
+                all_missing = False
+            elif r.code != Code.CHUNK_NOT_FOUND:
+                all_missing = False
+        if all_missing:
+            return ReadReply(Code.CHUNK_NOT_FOUND)
+        usable = [v for v, g in by_ver.items() if len(g) >= k]
+        if not usable:
+            return None
+        import numpy as np
+
+        ver = max(usable)
+        group = by_ver[ver]
+        present = sorted(group)[:k]
+        lost = [j for j in range(j0, j1) if j not in present]
+        surv = np.stack([
+            np.frombuffer(
+                group[j].ljust(S, b"\x00"), dtype=np.uint8)
+            for j in present
+        ])
+        codec = get_codec(k, m, S)
+        parts: Dict[int, bytes] = {
+            j: group[j].ljust(S, b"\x00") for j in present
+            if j0 <= j < j1
+        }
+        if lost:
+            rebuilt = codec.reconstruct_batch(present, lost, surv[None])[0]
+            for i, j in enumerate(lost):
+                parts[j] = rebuilt[i].tobytes()
+        whole = b"".join(  # copy-ok: range assembly of decoded shards
+            parts[j] for j in range(j0, j1))
+        lo = spec["offset"] - j0 * S
+        ok_replies = {j: r for j, r in replies.items()
+                      if r is not None and r.ok and r.commit_ver == ver}
+        return ReadReply(
+            Code.OK, data=whole[lo : lo + spec["length"]], commit_ver=ver,
+            logical_len=self._stripe_logical(spec, ok_replies, group, parts))
+
+    def _finish_stripe_reads(self, reqs, replies, ec_specs,
+                             shard_replies, routing) -> None:
+        """Resolve every EC request of a batch from its first-round shard
+        replies; stripes that did not assemble cleanly go DEGRADED
+        together — the missing/failed shards of ALL of them fetch in one
+        more batched round (any k of k+m survive), decode inline, and the
+        detour is recorded (ec.degraded_read / ec.degraded_read_ms)."""
+        degraded: List[int] = []
+        for i, spec in ec_specs.items():
+            out = self._stripe_clean(spec, shard_replies[i])
+            if out is not None:
+                replies[i] = out
+            else:
+                degraded.append(i)
+        if not degraded:
+            return
+        t0 = time.monotonic()
+        wire: List[Tuple[int, ReadReq]] = []
+        tags: List[Tuple[int, int]] = []
+        for i in degraded:
+            spec = ec_specs[i]
+            chain = spec["chain"]
+            have = shard_replies[i]
+            for j in range(spec["k"] + spec["m"]):
+                r = have.get(j)
+                if r is not None and r.ok:
+                    continue
+                t = chain.target_of_shard(j)
+                if t is None or not t.public_state.can_read:
+                    continue
+                node = routing.node_of_target(t.target_id)
+                if node is None:
+                    continue
+                tags.append((i, j))
+                wire.append((node.node_id, ReadReq(
+                    chain.chain_id, reqs[i].chunk_id, 0, -1, t.target_id)))
+        for (i, j), r in zip(tags, self._issue_wire_reads(wire)):
+            shard_replies[i][j] = r
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        for i in degraded:
+            out = self._stripe_degraded(ec_specs[i], shard_replies[i])
+            if out is None:
+                # no decodable version in this snapshot (write/rebuild in
+                # flight): the single-op ladder retries with backoff
+                out = self.read_stripe(
+                    reqs[i].chain_id, reqs[i].chunk_id,
+                    ec_specs[i]["offset"], ec_specs[i]["length"],
+                    chunk_size=reqs[i].chunk_size)
+            replies[i] = out
+            self._ec_degraded.add()
+            self._ec_degraded_ms.record(dt_ms)
+
     def read_stripe(
         self,
         chain_id: int,
@@ -847,133 +1277,60 @@ class StorageClient:
         chunk_size: int = 1 << 20,
     ) -> ReadReply:
         """Read [offset, offset+length) of an EC-striped chunk: fetch the
-        covering data shards; on a missing/failed shard, gather any k
-        same-version survivors and reconstruct on device (degraded read)."""
-        from tpu3fs.ops.stripe import get_codec, shard_size_of
-
+        covering data shards (batched per node); on a missing/failed
+        shard, gather any k same-version survivors and reconstruct
+        (degraded read). Shares its planning/assembly/decode helpers with
+        batch_read so the two paths cannot drift apart."""
         chain = self._chain(chain_id)
         if not chain.is_ec:
             raise FsError(Status(Code.INVALID_ARG, "read_stripe on CR chain"))
-        k, m = chain.ec_k, chain.ec_m
-        S = shard_size_of(chunk_size, k)
         if length < 0:
             length = chunk_size - offset
         length = max(0, min(length, chunk_size - offset))
         if length == 0:
             return ReadReply(Code.OK, data=b"")
-        j0, j1 = offset // S, (offset + length - 1) // S + 1
+        req = ReadReq(chain_id, chunk_id, offset, length,
+                      chunk_size=chunk_size)
 
         last = ReadReply(Code.TARGET_NOT_FOUND)
         for attempt in range(self._retry.max_retries + 1):
             chain = self._chain(chain_id)
             routing = self._routing()
-
-            def fetch(j: int) -> Optional[ReadReply]:
+            spec = self._plan_stripe_read(chain, routing, req)
+            wire = list(spec["wire"].items())
+            direct: Dict[int, ReadReply] = {}
+            for (j, _), r in zip(wire, self._issue_wire_reads(
+                    [entry for _, entry in wire])):
+                direct[j] = r
+            out = self._stripe_clean(spec, direct)
+            if out is not None:
+                return out
+            # degraded: gather every remaining readable shard, group by
+            # version, reconstruct from the newest k-quorum
+            t0 = time.monotonic()
+            extra: List[Tuple[int, Tuple[int, ReadReq]]] = []
+            for j in range(spec["k"] + spec["m"]):
+                r = direct.get(j)
+                if r is not None and r.ok:
+                    continue
                 t = chain.target_of_shard(j)
                 if t is None or not t.public_state.can_read:
-                    return None
+                    continue
                 node = routing.node_of_target(t.target_id)
                 if node is None:
-                    return None
-                req = ReadReq(chain_id, chunk_id, 0, -1, t.target_id)
-                try:
-                    r = self._messenger(node.node_id, "read", req)
-                except FsError as e:
-                    return ReadReply(e.code)
-                if r is not None and not isinstance(r.data, bytes):
-                    # the EC decode path pads/joins/ndarray-stacks shard
-                    # payloads: materialize a zero-copy transport view
-                    # once here (copy-ok: device decode re-buffers anyway)
-                    r = replace(r, data=bytes(r.data))
-                return r
-
-            direct = {j: fetch(j) for j in range(j0, j1)}
-            vers = {
-                r.commit_ver for r in direct.values() if r is not None and r.ok
-            }
-            if (len(vers) == 1
-                    and all(r is not None and r.ok for r in direct.values())):
-                whole = b"".join(
-                    direct[j].data.ljust(S, b"\x00") for j in range(j0, j1)
-                )
-                lo = offset - j0 * S
-                # exact logical length from the shard's stored aux tag
-                # (ShardWriteReq.logical_len persisted by the server);
-                # fall back to inferring from stored shard extents
-                logical = max(
-                    (r.logical_len for r in direct.values() if r.logical_len),
-                    default=0)
-                if logical == 0 and (j0, j1) == (0, k):
-                    logical = max(
-                        (j * S + len(direct[j].data) for j in range(j0, j1)
-                         if len(direct[j].data) > 0),
-                        default=0,
-                    )
-                return ReadReply(
-                    Code.OK,
-                    data=whole[lo : lo + length],
-                    commit_ver=vers.pop(),
-                    logical_len=logical,
-                )
-            # degraded: gather every readable shard, group by version,
-            # reconstruct from the newest version with >= k members
-            replies = {j: (direct.get(j) or fetch(j)) for j in range(k + m)}
-            by_ver: Dict[int, Dict[int, bytes]] = defaultdict(dict)
-            all_missing = True
-            for j, r in replies.items():
-                if r is None:
                     continue
-                if r.ok:
-                    by_ver[r.commit_ver][j] = r.data
-                    all_missing = False
-                elif r.code != Code.CHUNK_NOT_FOUND:
-                    all_missing = False
-            if all_missing:
-                return ReadReply(Code.CHUNK_NOT_FOUND)
-            usable = [v for v, g in by_ver.items() if len(g) >= k]
-            if usable:
-                ver = max(usable)
-                group = by_ver[ver]
-                present = sorted(group)[:k]
-                lost = [j for j in range(j0, j1) if j not in present]
-                import numpy as np
-
-                surv = np.stack([
-                    np.frombuffer(
-                        group[j].ljust(S, b"\x00"), dtype=np.uint8)
-                    for j in present
-                ])
-                codec = get_codec(k, m, S)
-                parts: Dict[int, bytes] = {
-                    j: group[j].ljust(S, b"\x00") for j in present
-                    if j0 <= j < j1
-                }
-                if lost:
-                    rebuilt = codec.reconstruct_batch(
-                        present, lost, surv[None])[0]
-                    for i, j in enumerate(lost):
-                        parts[j] = rebuilt[i].tobytes()
-                whole = b"".join(parts[j] for j in range(j0, j1))
-                lo = offset - j0 * S
-                # exact from any survivor's aux tag, else infer
-                logical = max(
-                    (r.logical_len for r in replies.values()
-                     if r is not None and r.ok and r.logical_len), default=0)
-                if logical == 0 and (j0, j1) == (0, k):
-                    from tpu3fs.ops.stripe import trim_rebuilt_shard
-
-                    lens = {j: len(group[j]) for j in present if j < k}
-                    logical = max(
-                        (j * S + len(group[j]) for j in present
-                         if j < k and len(group[j]) > 0), default=0)
-                    for j in lost:
-                        trimmed = trim_rebuilt_shard(
-                            parts[j], j, lens, k, S)
-                        if len(trimmed) > 0:
-                            logical = max(logical, j * S + len(trimmed))
-                return ReadReply(
-                    Code.OK, data=whole[lo : lo + length], commit_ver=ver,
-                    logical_len=logical)
+                extra.append((j, (node.node_id, ReadReq(
+                    chain_id, chunk_id, 0, -1, t.target_id))))
+            for (j, _), r in zip(extra, self._issue_wire_reads(
+                    [entry for _, entry in extra])):
+                direct[j] = r
+            out = self._stripe_degraded(spec, direct)
+            if out is not None:
+                if out.ok:
+                    self._ec_degraded.add()
+                    self._ec_degraded_ms.record(
+                        (time.monotonic() - t0) * 1000.0)
+                return out
             # mixed versions / not enough shards yet: transient (a stripe
             # write or rebuild is in flight) — retry
             last = ReadReply(Code.CHUNK_NOT_COMMIT)

@@ -210,6 +210,8 @@ class Fabric:
             return svc.update(payload)
         if method == "read_rebuild":
             return svc.read_rebuild(payload)
+        if method == "batch_read_rebuild":
+            return svc.batch_read_rebuild(payload)
         if method == "read":
             return svc.read(payload)
         if method == "batch_read":

@@ -230,8 +230,11 @@ class KVCacheClient:
         """Write many (key, value) entries as ONE node-grouped striped
         batch (FileIoClient.batch_write_files) and settle the sessions in
         one batch_close — the write-back flusher's drain path, mirroring
-        batch_get's shape. Raises on the first failed entry."""
-        from tpu3fs.meta.store import BatchCloseItem
+        batch_get's shape. Creates fan IN too: one batch_create RPC for
+        the whole drain (O(len/64) server transactions) instead of N
+        serial create round trips — the meta-bound half of the write-back
+        flush number. Raises on the first failed entry."""
+        from tpu3fs.meta.store import BatchCloseItem, BatchCreateItem
 
         items = list(items)
         if not items:
@@ -239,13 +242,29 @@ class KVCacheClient:
         with self._put_rec.record(), tagged(TrafficClass.KVCACHE):
             opened: List[Tuple[str, object]] = []
             try:
+                paths = []
                 for key, _ in items:
                     path = shard_path(self.root, key)
                     self._ensure_dir(path)
-                    opened.append((key, self._meta.create(
-                        path, flags=OpenFlags.WRITE | OpenFlags.CREATE
-                        | OpenFlags.TRUNC,
-                        client_id=self._client_id)))
+                    paths.append(path)
+                batch_create = getattr(self._meta, "batch_create", None)
+                if batch_create is not None:
+                    flags = (OpenFlags.WRITE | OpenFlags.CREATE
+                             | OpenFlags.TRUNC)
+                    created = batch_create([
+                        BatchCreateItem(path=p, flags=flags,
+                                        client_id=self._client_id)
+                        for p in paths])
+                    for (key, _), res in zip(items, created):
+                        if isinstance(res, FsError):
+                            raise res
+                        opened.append((key, res))
+                else:
+                    for (key, _), path in zip(items, paths):
+                        opened.append((key, self._meta.create(
+                            path, flags=OpenFlags.WRITE | OpenFlags.CREATE
+                            | OpenFlags.TRUNC,
+                            client_id=self._client_id)))
                 counts = self._fio.batch_write_files(
                     [(res.inode, 0, value)
                      for (_, res), (_, value) in zip(opened, items)])

@@ -142,6 +142,21 @@ class BatchCloseItem:
 
 
 @dataclass
+class BatchCreateItem:
+    """One create in a batched open (wire-friendly: 0 = unset). The
+    optional explicit layout pins chains the way MetaStore.create's
+    ``layout=`` does — the ckpt archiver placing files on EC chains."""
+
+    path: str = ""
+    perm: int = 0o644
+    flags: int = 0
+    chunk_size: int = 0
+    stripe: int = 0
+    client_id: str = ""
+    layout: Optional[Layout] = None
+
+
+@dataclass
 class OpenResult:
     inode: Inode
     session_id: str = ""
@@ -416,44 +431,116 @@ class MetaStore:
         must place a file on specific chains (the checkpoint archiver
         re-encoding onto EC chains) pass the full Layout; everyone else
         gets allocator striping."""
-        if layout is None:
-            table_id, chains, seed = self._chains.allocate(
-                stripe or self._default_stripe)
-            layout = Layout(
-                table_id=table_id,
-                chains=chains,
-                chunk_size=chunk_size or self._default_chunk_size,
-                seed=seed,
-            )
-        elif not layout.chains:
-            raise _err(Code.META_BAD_LAYOUT, "explicit layout without chains")
+        layout = self._resolve_create_layout(chunk_size, stripe, layout)
 
         def op(txn: ITransaction) -> OpenResult:
-            parent, name, existing = self._walk(txn, path, user)
-            if name is None:
-                raise _err(Code.META_IS_DIRECTORY, "/")
-            if existing is not None:
-                if flags & OpenFlags.EXCL:
-                    raise _err(Code.META_EXISTS, path)
-                return self._do_open(txn, existing, user, flags, client_id)
-            self._check_dir_writable(parent, user)
-            inode = Inode.new_file(
-                self._ids.allocate(), Acl(user.uid, user.gid, perm), layout
-            )
-            self._store_inode(txn, inode)
-            self._store_dirent(
-                txn, DirEntry(parent.id, name, inode.id, InodeType.FILE)
-            )
-            session_id = ""
-            if flags & OpenFlags.WRITE:
-                session_id = self._add_session(txn, inode.id, client_id,
-                                               user.uid)
-            return OpenResult(inode, session_id)
+            return self._create_in_txn(txn, path, user, perm, flags,
+                                       client_id, layout)
 
         result = with_transaction(self._engine, op)
         self._maybe_truncate_chunks(result, flags)
         self._emit("create", path, inode_id=result.inode.id, uid=user.uid)
         return result
+
+    def _resolve_create_layout(
+        self,
+        chunk_size: Optional[int],
+        stripe: Optional[int],
+        layout: Optional[Layout],
+    ) -> Layout:
+        if layout is None:
+            table_id, chains, seed = self._chains.allocate(
+                stripe or self._default_stripe)
+            return Layout(
+                table_id=table_id,
+                chains=chains,
+                chunk_size=chunk_size or self._default_chunk_size,
+                seed=seed,
+            )
+        if not layout.chains:
+            raise _err(Code.META_BAD_LAYOUT, "explicit layout without chains")
+        return layout
+
+    def _create_in_txn(
+        self,
+        txn: ITransaction,
+        path: str,
+        user: User,
+        perm: int,
+        flags: int,
+        client_id: str,
+        layout: Layout,
+    ) -> OpenResult:
+        parent, name, existing = self._walk(txn, path, user)
+        if name is None:
+            raise _err(Code.META_IS_DIRECTORY, "/")
+        if existing is not None:
+            if flags & OpenFlags.EXCL:
+                raise _err(Code.META_EXISTS, path)
+            return self._do_open(txn, existing, user, flags, client_id)
+        self._check_dir_writable(parent, user)
+        inode = Inode.new_file(
+            self._ids.allocate(), Acl(user.uid, user.gid, perm), layout
+        )
+        self._store_inode(txn, inode)
+        self._store_dirent(
+            txn, DirEntry(parent.id, name, inode.id, InodeType.FILE)
+        )
+        session_id = ""
+        if flags & OpenFlags.WRITE:
+            session_id = self._add_session(txn, inode.id, client_id,
+                                           user.uid)
+        return OpenResult(inode, session_id)
+
+    def batch_create(
+        self,
+        items: List["BatchCreateItem"],
+        user: User = ROOT_USER,
+        *,
+        txn_batch: int = 64,
+    ) -> List[object]:
+        """Create (and open) MANY regular files in O(len/txn_batch) KV
+        transactions — the create fan-in behind KVCacheClient.batch_put
+        and the ckpt archiver (one meta transaction per 64 files instead
+        of one round trip per file). Each result is an OpenResult or an
+        FsError: per-item failures (missing parent, EXCL conflict,
+        permission) don't poison their batch-mates; a KV conflict retries
+        the whole chunk via with_transaction. Chain allocation happens up
+        front per item, so allocator striping is identical to N singleton
+        creates."""
+        prepped: List[object] = []
+        for it in items:
+            try:
+                prepped.append(self._resolve_create_layout(
+                    it.chunk_size or None, it.stripe or None, it.layout))
+            except FsError as e:
+                prepped.append(e)
+        results: List[object] = [None] * len(items)
+        for base in range(0, len(items), txn_batch):
+            chunk = list(enumerate(items[base:base + txn_batch], start=base))
+
+            def op(txn: ITransaction, _chunk=chunk):
+                out = []
+                for i, it in _chunk:
+                    if isinstance(prepped[i], FsError):
+                        out.append((i, prepped[i]))
+                        continue
+                    try:
+                        out.append((i, self._create_in_txn(
+                            txn, it.path, user, it.perm, it.flags,
+                            it.client_id, prepped[i])))
+                    except FsError as e:
+                        out.append((i, e))
+                return out
+
+            for i, res in with_transaction(self._engine, op):
+                results[i] = res
+        for it, res in zip(items, results):
+            if isinstance(res, OpenResult):
+                self._maybe_truncate_chunks(res, it.flags)
+                self._emit("create", it.path, inode_id=res.inode.id,
+                           uid=user.uid)
+        return results
 
     def open(
         self,

@@ -20,7 +20,7 @@ is the concatenation (..., k+m, S). S is the shard size in bytes.
 from __future__ import annotations
 
 import functools
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -88,6 +88,8 @@ class RSCode:
         self._reconstruct_fns: dict = {}
         self._pallas_matrices: dict = {}
         self._einsum_fns: dict = {}
+        self._xor_schedule: Optional[list] = None
+        self._delta_cols: dict = {}
 
     # -- kernel selection ---------------------------------------------------
     def _apply_bit_matrix(self, A_bits: jnp.ndarray, key,
@@ -156,26 +158,90 @@ class RSCode:
             return native_ec.gf_apply(R, np.asarray(present_shards))
         return self.reconstruct_np(present_idx, lost_idx, present_shards)
 
+    def _encode_schedule(self) -> list:
+        """XOR-scheduled LUT program for the host encode, cached per code:
+        per parity row i, the columns grouped by coefficient value, so
+
+            P_i = XOR_c  MUL[c][ XOR_{j : C_ij == c} D_j ]
+
+        A naive encode pays one 256-entry LUT gather per (i, j) term —
+        k*m gathers. Grouping equal coefficients first XOR-accumulates
+        their shards at memory speed and gathers ONCE per distinct
+        coefficient per row (the XOR-level program optimization of
+        PAPERS.md arxiv 1603.05806 applied at LUT-pass granularity);
+        row 0 is all-ones by construction, so it costs zero gathers."""
+        if self._xor_schedule is None:
+            sched = []
+            for i in range(self.m):
+                by_c: dict = {}
+                for j in range(self.k):
+                    c = int(self.parity_matrix[i, j])
+                    if c:
+                        by_c.setdefault(c, []).append(j)
+                sched.append(sorted(by_c.items()))
+            self._xor_schedule = sched
+        return self._xor_schedule
+
     def encode_np(self, data: np.ndarray) -> np.ndarray:
-        """Numpy host encode: one pass per (i, j) coefficient. c==1 rows
-        (parity row 0 is all-ones by construction) reduce to plain XOR at
-        memory speed — the CPU-backend serving path; general coefficients
-        are one 256-entry LUT gather per pass."""
+        """Numpy host encode, XOR-scheduled (see _encode_schedule): shards
+        sharing a coefficient XOR-reduce first (memory speed), then one
+        256-entry LUT gather per DISTINCT coefficient per row; c==1 groups
+        (all of parity row 0 by construction) skip the gather entirely —
+        the CPU-backend serving path's gold kernel."""
         data = np.asarray(data, dtype=np.uint8)
         *lead, k, s = data.shape
         assert k == self.k
         flat = data.reshape(-1, k, s)
         out = np.zeros((flat.shape[0], self.m, s), dtype=np.uint8)
-        for i in range(self.m):
-            for j in range(k):
-                c = int(self.parity_matrix[i, j])
-                if c == 0:
-                    continue
+        for i, groups in enumerate(self._encode_schedule()):
+            for c, cols in groups:
+                acc = flat[:, cols[0], :]
+                for j in cols[1:]:
+                    acc = acc ^ flat[:, j, :]
                 if c == 1:
-                    out[:, i, :] ^= flat[:, j, :]
+                    out[:, i, :] ^= acc
                 else:
-                    out[:, i, :] ^= GF.MUL_TABLE[c][flat[:, j, :]]
+                    out[:, i, :] ^= GF.MUL_TABLE[c][acc]
         return out.reshape(*lead, self.m, s)
+
+    # -- delta parity (sub-stripe RMW) --------------------------------------
+    def parity_delta_matrix(self, j: int) -> np.ndarray:
+        """(m, 1) parity-coefficient column for data shard j, cached —
+        the k x m coefficient products of the delta-parity update
+        ``P'_i = P_i ^ c_ij * (D'_j ^ D_j)`` (RapidRAID-style in-place
+        parity maintenance: a sub-stripe write never re-encodes the
+        stripe, it applies the delta through this column)."""
+        col = self._delta_cols.get(j)
+        if col is None:
+            if not 0 <= j < self.k:
+                raise ValueError(f"data shard index {j} out of range")
+            col = np.ascontiguousarray(
+                self.parity_matrix[:, j : j + 1], dtype=np.uint8)
+            self._delta_cols[j] = col
+        return col
+
+    def delta_parity_host(self, j: int, delta: np.ndarray) -> np.ndarray:
+        """Host-side parity delta for a change on data shard j:
+        (..., S) uint8 delta (D' ^ D, zero-padded to the shard size)
+        -> (..., m, S) rows to XOR into the current parity shards.
+        Native SIMD when available, LUT gold otherwise."""
+        from tpu3fs.ops import native_ec
+
+        col = self.parity_delta_matrix(j)
+        d = np.asarray(delta, dtype=np.uint8)
+        lead, s = d.shape[:-1], d.shape[-1]
+        if native_ec.available():
+            return native_ec.gf_apply(col, d.reshape(*lead, 1, s))
+        out = np.empty((*lead, self.m, s), dtype=np.uint8)
+        for i in range(self.m):
+            c = int(col[i, 0])
+            if c == 0:
+                out[..., i, :] = 0
+            elif c == 1:
+                out[..., i, :] = d
+            else:
+                out[..., i, :] = GF.MUL_TABLE[c][d]
+        return out
 
     # -- decode ------------------------------------------------------------
     def _reconstruct_matrix(

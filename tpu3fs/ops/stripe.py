@@ -154,6 +154,20 @@ class StripeCodec:
         return (np.asarray(shards)[:b],
                 np.asarray(crcs).reshape(bp, k + self.m)[:b])
 
+    def delta_parity(self, j: int, delta) -> np.ndarray:
+        """Parity-row deltas for a sub-stripe change on data shard j:
+        ``delta`` is D'_j ^ D_j zero-padded to S bytes -> (m, S) rows to
+        XOR into the stored parity shards (``P'_i = P_i ^ c_ij * dD``).
+        The RMW write path calls this instead of re-encoding the stripe:
+        the moved bytes drop from k*S reads + (k+m)*S writes to
+        (touched + m) shards each way. Host kernels (native SIMD / LUT
+        gold) — the serving-path policy of _use_host applies, and the
+        device path has no per-call win at one stripe."""
+        d = np.frombuffer(delta, dtype=np.uint8) \
+            if not isinstance(delta, np.ndarray) else delta
+        assert d.shape[-1] == self.shard_size, (d.shape, self.shard_size)
+        return self.rs.delta_parity_host(j, d)
+
     def encode_stripe(self, chunk: bytes) -> Tuple[np.ndarray, np.ndarray]:
         """One chunk (<= k*S bytes, zero-padded) -> ((k+m, S), (k+m,))."""
         buf = np.zeros((self.k, self.shard_size), dtype=np.uint8)

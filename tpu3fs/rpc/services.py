@@ -18,12 +18,13 @@ from typing import Dict, List, Optional, Tuple
 
 from tpu3fs.meta.store import (
     BatchCloseItem,
+    BatchCreateItem,
     MetaStore,
     OpenResult,
     StatFs,
     User,
 )
-from tpu3fs.meta.types import DirEntry, Inode
+from tpu3fs.meta.types import DirEntry, Inode, Layout
 from tpu3fs.mgmtd.service import HeartbeatReply, Mgmtd
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType, RoutingInfo
 from tpu3fs.rpc.net import RpcClient, RpcServer, ServiceDef
@@ -289,6 +290,10 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     s.method(19, "readRebuild", ReadReq, ReadReply, svc.read_rebuild)
     s.method(20, "dumpPendingChunkMeta", TargetIdReq, ChunkMetaList,
              lambda r: ChunkMetaList(svc.dump_pending_chunkmeta(r.target_id)))
+    # batched rebuild-coordinator reads: the EC rebuilder's recovery
+    # fan-in (one RPC per surviving peer per stripe batch)
+    s.method(21, "batchReadRebuild", BatchReadReq, BatchReadRsp,
+             lambda r: BatchReadRsp(svc.batch_read_rebuild(r.reqs)))
     server.add_service(s)
 
 
@@ -600,6 +605,9 @@ class RpcMessenger:
             return [tuple(t) for t in rsp.stats]
         if method == "read_rebuild":
             return c.call(addr, sid, 19, payload, ReadReply)
+        if method == "batch_read_rebuild":
+            return c.call(addr, sid, 21, BatchReadReq(payload),
+                          BatchReadRsp).replies
         raise FsError(Status(Code.RPC_METHOD_NOT_FOUND, method))
 
 
@@ -778,6 +786,32 @@ class CreateReq:
     stripe: int = 0
     client_id: str = ""
     token: str = ""
+    # explicit chain placement (MetaStore.create layout= parity): the
+    # ckpt archiver creating files on EC chains over RPC (trailing
+    # field; older encoders omit it and decoders default to None)
+    layout: Optional[Layout] = None
+
+
+@dataclass
+class BatchCreateReq:
+    items: List[BatchCreateItem] = field(default_factory=list)
+    uid: int = 0
+    gid: int = 0
+    token: str = ""
+
+
+@dataclass
+class BatchCreateRspItem:
+    ok: bool = False
+    inode: Optional[Inode] = None
+    session_id: str = ""
+    code: int = 0
+    message: str = ""
+
+
+@dataclass
+class BatchCreateRsp:
+    results: List[BatchCreateRspItem] = field(default_factory=list)
 
 
 @dataclass
@@ -1047,7 +1081,7 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
     s.method(3, "create", CreateReq, OpenRsp, lambda r: _open_rsp(
         meta.create(r.path, u(r), r.perm, flags=r.flags,
                     chunk_size=r.chunk_size or None, stripe=r.stripe or None,
-                    client_id=r.client_id)))
+                    client_id=r.client_id, layout=r.layout)))
     s.method(4, "mkdirs", MkdirsReq, InodeRsp, lambda r: InodeRsp(
         meta.mkdirs(r.path, u(r), r.perm, recursive=r.recursive)))
     s.method(5, "symlink", SymlinkReq, InodeRsp,
@@ -1127,6 +1161,22 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
 
     s.method(24, "batchSetAttr", BatchSetAttrReq, BatchSetAttrRsp,
              batch_set_attr)
+
+    def batch_create(r: BatchCreateReq) -> BatchCreateRsp:
+        # one transaction per 64 creates (MetaStore.batch_create) — the
+        # create fan-in that unblocks the kvcache write-back drain
+        out = []
+        for res in meta.batch_create(r.items, u(r)):
+            if isinstance(res, FsError):
+                out.append(BatchCreateRspItem(
+                    ok=False, code=int(res.code),
+                    message=res.status.message))
+            else:
+                out.append(BatchCreateRspItem(
+                    ok=True, inode=res.inode, session_id=res.session_id))
+        return BatchCreateRsp(out)
+
+    s.method(25, "batchCreate", BatchCreateReq, BatchCreateRsp, batch_create)
     server.add_service(s)
 
 
@@ -1213,6 +1263,25 @@ class MetaRpcClient:
         return self._call(10, CloseReq(inode_id, session_id, hint,
                                        self.client_id, request_id, w),
                           InodeRsp).inode
+
+    def batch_create(self, items: List[BatchCreateItem],
+                     user=None) -> List[object]:
+        """Create many files in O(len/64) server transactions; each
+        result is an OpenResult or an FsError (MetaStore parity — the
+        kvcache flusher and the ckpt archiver drive either surface).
+        Items without a client_id inherit this client's."""
+        items = list(items)
+        for it in items:
+            if not it.client_id:
+                it.client_id = self.client_id
+        rsp = self._call(25, BatchCreateReq(items), BatchCreateRsp)
+        out: List[object] = []
+        for r in rsp.results:
+            if r.ok:
+                out.append(OpenResult(r.inode, r.session_id))
+            else:
+                out.append(FsError(Status(Code(r.code), r.message)))
+        return out
 
     def batch_close(self, items: List[BatchCloseItem]) -> List[object]:
         """Settle many sessions in O(len/64) server transactions; each

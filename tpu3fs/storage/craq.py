@@ -126,6 +126,14 @@ class ShardWriteReq:
     # stripe has NO version with a k-quorum left (found by the EC model
     # check, tests/test_model_ec.py).
     phase: int = 0
+    # REBASE stage (phase 1 only): stage the target's own COMMITTED shard
+    # content under update_ver instead of shipping a payload — the
+    # delta-parity RMW bumps the stripe's untouched data shards this way,
+    # so a sub-stripe write moves only (touched + parity) shard bytes.
+    # The committed version must still be exactly rebase_of, or the
+    # client's delta was computed against a superseded stripe and the
+    # server answers CHUNK_STALE_UPDATE. 0 = normal payload stage.
+    rebase_of: int = 0
 
 
 @dataclass
@@ -1125,6 +1133,24 @@ class StorageService:
             )
         return None
 
+    @staticmethod
+    def _resolve_rebase(engine, r: ShardWriteReq):
+        """Resolve a rebase stage (phase 1, rebase_of > 0): the staged
+        content is the target's own COMMITTED shard bytes, promoted under
+        the new stripe version with no payload on the wire. -> (data,
+        committed crc) to stage, or an UpdateReply refusal. The committed
+        version must still be exactly rebase_of — a concurrent writer
+        landing in between means the RMW client's parity delta was
+        computed against superseded content, and staging the old bytes
+        under a new version would fork the stripe."""
+        meta = engine.get_meta(r.chunk_id)
+        if meta is None or meta.committed_ver != r.rebase_of:
+            return UpdateReply(
+                Code.CHUNK_STALE_UPDATE,
+                commit_ver=meta.committed_ver if meta is not None else 0,
+                message=f"rebase base {r.rebase_of} superseded")
+        return engine.read(r.chunk_id), meta.checksum.value
+
     def write_shard(self, req: ShardWriteReq) -> UpdateReply:
         """Install one stripe shard on a local EC target: validate the
         device-computed CRC, then full-replace at the stripe version.
@@ -1181,6 +1207,12 @@ class StorageService:
                 triaged = self._triage_shard_install(engine, req)
                 if triaged is not None:
                     return triaged
+                data, crc = req.data, req.crc
+                if req.phase == 1 and req.rebase_of:
+                    resolved = self._resolve_rebase(engine, req)
+                    if isinstance(resolved, UpdateReply):
+                        return resolved
+                    data, crc = resolved
                 # VALIDATED install: req.crc covers the stored (trimmed)
                 # shard bytes; the engine computes the content CRC during
                 # staging anyway and refuses on mismatch — one checksum
@@ -1191,7 +1223,7 @@ class StorageService:
                     req.chunk_id,
                     req.update_ver,
                     chain.chain_version,
-                    req.data,
+                    data,
                     0,
                     full_replace=req.phase == 0,
                     stage_replace=req.phase == 1,
@@ -1201,7 +1233,7 @@ class StorageService:
                     # by queryLastChunk and rebuild-trim instead of
                     # zero-stripping (round-2 weak #8)
                     aux=req.logical_len,
-                    expected_crc=req.crc,
+                    expected_crc=crc,
                 )
                 return UpdateReply(
                     Code.OK,
@@ -1818,16 +1850,23 @@ class StorageService:
                 if triaged is not None:
                     replies[i] = triaged
                     continue
+                data, crc = r.data, r.crc
+                if r.phase == 1 and r.rebase_of:
+                    resolved = self._resolve_rebase(engine, r)
+                    if isinstance(resolved, UpdateReply):
+                        replies[i] = resolved
+                        continue
+                    data, crc = resolved
                 ops.append(EngineUpdateOp(
                     chunk_id=r.chunk_id,
-                    data=r.data,
+                    data=data,
                     offset=0,
                     update_ver=r.update_ver,
                     full_replace=r.phase == 0,
                     stage_replace=r.phase == 1,
                     chunk_size=r.chunk_size,
                     aux=r.logical_len,
-                    expected_crc=r.crc,
+                    expected_crc=crc,
                 ))
                 op_idx.append(i)
             # commits of staged versions: one engine crossing too
@@ -1912,6 +1951,13 @@ class StorageService:
         lease, shed_ms = self._admit_read(TrafficClass.EC_REBUILD)
         if shed_ms is not None:
             return ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
+        try:
+            return self._read_rebuild_impl(req)
+        finally:
+            if lease is not None:
+                lease.release()
+
+    def _read_rebuild_impl(self, req: ReadReq) -> ReadReply:
         with self._read_rec.record() as op:
             try:
                 if self.stopped:
@@ -1928,9 +1974,26 @@ class StorageService:
             except FsError as e:
                 op.fail()
                 return ReadReply(e.code)
-            finally:
-                if lease is not None:
-                    lease.release()
+
+    def batch_read_rebuild(self, reqs: List[ReadReq]) -> List[ReadReply]:
+        """Many rebuild-coordinator reads in one request — the EC
+        rebuilder's batched recovery fan-in (one RPC per surviving peer
+        per stripe batch instead of one per shard). Same public-state
+        bypass + safety argument as read_rebuild; ONE admission covers
+        the batch at per-op cost so the EC_REBUILD token bucket still
+        meters recovery traffic accurately."""
+        from tpu3fs.qos.core import TrafficClass
+
+        lease, shed_ms = self._admit_read(TrafficClass.EC_REBUILD,
+                                          cost=max(1, len(reqs)))
+        if shed_ms is not None:
+            return [ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
+                    for _ in reqs]
+        try:
+            return [self._read_rebuild_impl(r) for r in reqs]
+        finally:
+            if lease is not None:
+                lease.release()
 
     def _read_impl(self, req: ReadReq) -> ReadReply:
         from tpu3fs.qos.core import TrafficClass

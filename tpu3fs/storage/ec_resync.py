@@ -33,12 +33,25 @@ from tpu3fs.utils.result import Code, FsError
 class EcResyncWorker:
     def __init__(self, service: StorageService, messenger: Messenger, *,
                  batch_stripes: int = 64, mesh=None):
+        from tpu3fs.monitor.recorder import CounterRecorder, ValueRecorder
+
         self._service = service
         self._messenger = messenger
         self._batch = batch_stripes
         # optional device mesh: rebuild through the ICI all-gather collective
         # (tpu3fs.parallel.rebuild) instead of the single-chip decode
         self._mesh = mesh
+        self._rebuilt_shards = CounterRecorder("ec.rebuild_shards")
+        self._rebuilt_bytes = CounterRecorder("ec.rebuild_bytes")
+        self._rebuild_mibps = ValueRecorder("ec.rebuild_mibps")
+        # last completed rebuild round, for admin_cli ec-status and the
+        # bench's source-spread verification: recovery reads per SOURCE
+        # target prove the source-disjoint rotation actually spreads load
+        self.last_stats: Dict = {
+            "stripes": 0, "installed": 0, "bytes": 0,
+            "read_sources": {}, "mibps": 0.0}
+        self._round_stats: Dict = dict(self.last_stats,
+                                       read_sources={})
         # healthy-repair memo: per chain, the pending signature of the last
         # sweep that committed nothing. A pending set that can never reach
         # the roll-forward quorum (e.g. a phase-1 crash that staged < k
@@ -163,6 +176,15 @@ class EcResyncWorker:
         moved = 0
         failed = 0
         todo = list(stripes.values())
+        import time as _time
+
+        # fresh per-round stats dict; published to last_stats only when
+        # the round actually rebuilt something, so a later no-op sweep
+        # does not wipe the numbers ec-status / the bench report
+        round_stats: Dict = {"stripes": len(todo), "installed": 0,
+                             "bytes": 0, "read_sources": {}, "mibps": 0.0}
+        self._round_stats = round_stats
+        t0 = _time.monotonic()
         for base in range(0, len(todo), self._batch):
             batch = todo[base : base + self._batch]
             ok, bad = self._rebuild_batch(
@@ -170,6 +192,14 @@ class EcResyncWorker:
                 required)
             moved += ok
             failed += bad
+        dt = _time.monotonic() - t0
+        round_stats["installed"] = moved
+        if moved:
+            if dt > 0:
+                mibps = round_stats["bytes"] / dt / (1 << 20)
+                round_stats["mibps"] = round(mibps, 3)
+                self._rebuild_mibps.set(mibps)
+            self.last_stats = round_stats
         # stale-chunk cleanup: shards on the recovering target for stripes
         # no peer knows anymore
         try:
@@ -361,87 +391,246 @@ class EcResyncWorker:
             return None
         return (r, safe) if r.ok else None
 
+    def _gather_serial(self, routing: RoutingInfo, chain: ChainInfo,
+                       cid: ChunkId, lost_shard: int):
+        """Per-stripe serial gather — the pre-batched path, kept as the
+        fallback when peer stats are unavailable or a batched read raced
+        a writer. -> (row | None, skip): row = (cid, ver, {shard: bytes},
+        S, logical); skip marks a promotion-relevant failure (quorum
+        unprovable this round), False with no row means nothing to do
+        (already holding the proven version / all-empty stripe)."""
+        from tpu3fs.ops.stripe import aligned_shard_size
+
+        k, m = chain.ec_k, chain.ec_m
+        by_ver: Dict[int, Dict[int, bytes]] = {}
+        aux_ver: Dict[int, int] = {}
+        max_safe_ver = 0
+        # the recovering target's OWN committed shard participates in the
+        # version quorum: after several bounces it often already holds the
+        # newest shard (disk intact), and without its vote a one-at-a-time
+        # promotion queue can deadlock — every SYNCING rebuild waiting on
+        # stale WAITING peers that are queued behind it
+        own_ver = -1
+        for j in range(k + m):
+            rs = self._read_shard(routing, chain, j, cid)
+            if rs is None:
+                continue
+            r, safe = rs
+            by_ver.setdefault(r.commit_ver, {})[j] = r.data
+            if j == lost_shard:
+                own_ver = r.commit_ver
+            if safe:
+                max_safe_ver = max(max_safe_ver, r.commit_ver)
+            if r.logical_len:
+                aux_ver[r.commit_ver] = max(
+                    aux_ver.get(r.commit_ver, 0), r.logical_len)
+        usable = [v for v, g in by_ver.items() if len(g) >= k]
+        if not usable:
+            return None, True
+        ver = max(usable)
+        if ver < max_safe_ver:
+            # a publicly-readable source has a NEWER committed stripe
+            # than anything k shards can prove: rebuilding at the old
+            # version would roll the stripe back — wait for the newer
+            # version's shard set to become reachable
+            return None, True
+        if own_ver == ver:
+            # already holding the proven version (engine-validated CRC)
+            return None, False
+        shards = {j: b for j, b in by_ver[ver].items() if j != lost_shard}
+        if len(shards) < k:
+            # fewer than k true survivors cannot decode — wait for peers
+            return None, True
+        logical = aux_ver.get(ver, 0)
+        # shard size is per-file (S = ceil(chunk_size/k)); the max stored
+        # survivor length is a safe working size: content beyond any
+        # shard's stored extent is zeros, and GF-multiplying zeros
+        # contributes zeros, so decoding at the shorter padded size is
+        # byte-exact over the true extents
+        S = max(len(b) for b in shards.values())
+        if S == 0:
+            return None, False  # all-empty stripe: nothing to rebuild
+        return (cid, ver, shards, aligned_shard_size(S), logical), False
+
+    def _gather_batched(self, routing: RoutingInfo, chain: ChainInfo,
+                        chunk_ids: List[ChunkId], lost_shard: int):
+        """-> (rows, skip_cids, fallback_cids): the PARALLEL gather.
+        Versions probe as ONE stat_chunks per peer (no payload), the k
+        survivors of each stripe are chosen by ROTATING over that
+        version's holders — source-disjoint scheduling, so recovery
+        reads spread over ALL surviving peers instead of hammering the
+        lowest-indexed shards — and the reads issue as ONE
+        batch_read_rebuild per peer node. Safety guards mirror
+        _gather_serial (safe-version ceiling, own-shard vote, k-quorum);
+        stripes the stats cannot prove or whose reads raced a writer
+        fall back to the serial gather."""
+        from tpu3fs.ops.stripe import aligned_shard_size
+
+        k, m = chain.ec_k, chain.ec_m
+        stats: Dict[int, list] = {}
+        safe: Dict[int, bool] = {}
+        route: Dict[int, tuple] = {}
+        for j in range(k + m):
+            t = chain.target_of_shard(j)
+            if t is None:
+                continue
+            pn = routing.node_of_target(t.target_id)
+            if pn is None:
+                continue
+            try:
+                st = self._messenger(pn.node_id, "stat_chunks",
+                                     (t.target_id, list(chunk_ids)))
+            except FsError:
+                continue
+            if len(st) != len(chunk_ids):
+                continue
+            stats[j] = st
+            safe[j] = t.public_state.can_read
+            route[j] = (t.target_id, pn.node_id)
+        if sum(1 for j in stats if j != lost_shard) < k:
+            return [], [], list(chunk_ids)  # stats too thin: serial decides
+        plans: List[dict] = []
+        skip_cids: List[ChunkId] = []
+        fallback: List[ChunkId] = []
+        reads: Dict[int, list] = {}  # node -> [(plan idx, shard j, req)]
+        for idx, cid in enumerate(chunk_ids):
+            by_ver: Dict[int, set] = {}
+            aux_by_ver: Dict[int, int] = {}
+            lens: Dict[tuple, int] = {}
+            own_ver = -1
+            max_safe = 0
+            for j, st in stats.items():
+                cv, length, aux = st[idx]
+                if cv <= 0:
+                    continue
+                by_ver.setdefault(cv, set()).add(j)
+                lens[(cv, j)] = length
+                if j == lost_shard:
+                    own_ver = cv
+                if safe.get(j):
+                    max_safe = max(max_safe, cv)
+                if aux:
+                    aux_by_ver[cv] = max(aux_by_ver.get(cv, 0), aux)
+            usable = [v for v, g in by_ver.items() if len(g) >= k]
+            if not usable:
+                fallback.append(cid)  # stats can't prove: serial decides
+                continue
+            ver = max(usable)
+            if ver < max_safe:
+                skip_cids.append(cid)  # newer committed stripe exists
+                continue
+            if own_ver == ver:
+                continue  # already holding the proven version
+            holders = sorted(j for j in by_ver[ver] if j != lost_shard)
+            if len(holders) < k:
+                skip_cids.append(cid)
+                continue
+            # working size over ALL holders of the version (parity shards
+            # store full S): a rotation choosing only short data shards
+            # must still decode at the stripe's true extent
+            S_work = max(lens.get((ver, j), 0) for j in by_ver[ver])
+            if S_work == 0:
+                continue  # all-empty stripe: nothing to rebuild
+            rot = idx % len(holders)
+            chosen = [holders[(rot + t) % len(holders)] for t in range(k)]
+            pi = len(plans)
+            plans.append({"cid": cid, "ver": ver,
+                          "S": aligned_shard_size(S_work),
+                          "logical": aux_by_ver.get(ver, 0),
+                          "shards": {}, "want": len(chosen), "bad": False})
+            for j in chosen:
+                tid, nid = route[j]
+                reads.setdefault(nid, []).append((pi, j, ReadReq(
+                    chain.chain_id, cid, 0, -1, tid)))
+        for nid, entries in reads.items():
+            try:
+                replies = self._messenger(
+                    nid, "batch_read_rebuild", [rq for _, _, rq in entries])
+            except FsError:
+                replies = [None] * len(entries)
+            for (pi, j, _rq), r in zip(entries, replies):
+                plan = plans[pi]
+                if r is None or not r.ok or r.commit_ver != plan["ver"]:
+                    plan["bad"] = True  # raced/failed: serial decides
+                    continue
+                plan["shards"][j] = bytes(r.data)  # copy-ok: decode input
+                src = route[j][0]
+                sources = self._round_stats["read_sources"]
+                sources[src] = sources.get(src, 0) + 1
+        rows = []
+        for plan in plans:
+            if plan["bad"] or len(plan["shards"]) < plan["want"]:
+                fallback.append(plan["cid"])
+                continue
+            rows.append((plan["cid"], plan["ver"], plan["shards"],
+                         plan["S"], plan["logical"]))
+        return rows, skip_cids, fallback
+
+    def _install_batch(self, node_id: int,
+                       reqs: List[ShardWriteReq]) -> List[object]:
+        """Install rebuilt shards on the recovering node as ONE
+        batch_write_shard (the pipelined decode -> install leg);
+        OVERLOADED sheds honor the server's retry-after hint once as a
+        single re-batch, then defer to the next round (rebuild is
+        idempotent and resumable). -> per-req replies (None = transport
+        failure)."""
+        if not reqs:
+            return []
+        try:
+            replies = list(self._messenger(node_id, "batch_write_shard",
+                                           reqs))
+        except FsError:
+            return [None] * len(reqs)
+        shed = [i for i, r in enumerate(replies)
+                if r is not None and r.code == Code.OVERLOADED]
+        if shed:
+            import time as _time
+
+            from tpu3fs.qos.core import retry_after_ms_of
+
+            hint = max((replies[i].retry_after_ms
+                        or retry_after_ms_of(replies[i].message))
+                       for i in shed)
+            _time.sleep(max(hint, 10) / 1000.0)
+            try:
+                again = self._messenger(node_id, "batch_write_shard",
+                                        [reqs[i] for i in shed])
+                for i, r in zip(shed, again):
+                    replies[i] = r
+            except FsError:
+                for i in shed:
+                    replies[i] = None
+        return replies
+
     def _rebuild_batch(self, routing: RoutingInfo, chain: ChainInfo,
                        chunk_ids: List[ChunkId], lost_shard: int,
                        node_id: int, target_id: int,
                        required: Optional[set] = None) -> tuple:
         """-> (shards installed, REQUIRED stripes skipped/failed this
         round). Best-effort stripes (known only to degraded peers) never
-        block promotion."""
-        from tpu3fs.ops.stripe import (
-            aligned_shard_size,
-            get_codec,
-            trim_rebuilt_shard,
-        )
+        block promotion.
+
+        Pipeline: batched version probe + source-disjoint batched
+        recovery reads (_gather_batched; serial per-shard fallback),
+        one batched GF(2) decode per survivor-set group, installs as
+        batch_write_shard on the recovering node."""
+        from tpu3fs.ops.stripe import get_codec, trim_rebuilt_shard
 
         k, m = chain.ec_k, chain.ec_m
-        # gather survivors per stripe; stripes whose shard sets disagree on
-        # version are skipped this round (a write is in flight)
-        gathered = []  # (chunk_id, ver, {shard: bytes}, S, logical)
-        skipped = 0
 
         def _skip(cid) -> int:
             return 1 if (required is None
                          or cid.to_bytes() in required) else 0
 
-        for cid in chunk_ids:
-            by_ver: Dict[int, Dict[int, bytes]] = {}
-            aux_ver: Dict[int, int] = {}
-            max_safe_ver = 0
-            # the recovering target's OWN committed shard participates in
-            # the version quorum: after several bounces it often already
-            # holds the newest shard (disk intact), and without its vote a
-            # one-at-a-time promotion queue can deadlock — every SYNCING
-            # rebuild waiting on stale WAITING peers that are queued
-            # behind it
-            own_ver = -1
-            for j in range(k + m):
-                rs = self._read_shard(routing, chain, j, cid)
-                if rs is None:
-                    continue
-                r, safe = rs
-                by_ver.setdefault(r.commit_ver, {})[j] = r.data
-                if j == lost_shard:
-                    own_ver = r.commit_ver
-                if safe:
-                    max_safe_ver = max(max_safe_ver, r.commit_ver)
-                if r.logical_len:
-                    aux_ver[r.commit_ver] = max(
-                        aux_ver.get(r.commit_ver, 0), r.logical_len)
-            usable = [v for v, g in by_ver.items() if len(g) >= k]
-            if not usable:
+        gathered, skip_cids, fb_cids = self._gather_batched(
+            routing, chain, chunk_ids, lost_shard)
+        skipped = sum(_skip(cid) for cid in skip_cids)
+        for cid in fb_cids:
+            row, skip = self._gather_serial(routing, chain, cid, lost_shard)
+            if row is not None:
+                gathered.append(row)
+            elif skip:
                 skipped += _skip(cid)
-                continue
-            ver = max(usable)
-            if ver < max_safe_ver:
-                # a publicly-readable source has a NEWER committed stripe
-                # than anything k shards can prove: rebuilding at the old
-                # version would roll the stripe back — wait for the newer
-                # version's shard set to become reachable
-                skipped += _skip(cid)
-                continue
-            if own_ver == ver:
-                # already holding the proven version (engine-validated
-                # CRC): nothing to install for this stripe
-                continue
-            shards = {j: b for j, b in by_ver[ver].items()
-                      if j != lost_shard}
-            if len(shards) < k:
-                # quorum only reached WITH our own stale... no: own_ver !=
-                # ver here, so own shard is not in by_ver[ver]; fewer than
-                # k true survivors cannot decode — wait for peers
-                skipped += _skip(cid)
-                continue
-            logical = aux_ver.get(ver, 0)
-            # shard size is per-file (S = ceil(chunk_size/k)); the max stored
-            # survivor length is a safe working size: content beyond any
-            # shard's stored extent is zeros, and GF-multiplying zeros
-            # contributes zeros, so decoding at the shorter padded size is
-            # byte-exact over the true extents
-            S = max(len(b) for b in shards.values())
-            if S == 0:
-                continue  # all-empty stripe: nothing to rebuild
-            gathered.append((cid, ver, shards, aligned_shard_size(S), logical))
         if not gathered:
             return 0, skipped
         # group stripes by (survivor index set, working size) so each group
@@ -450,7 +639,8 @@ class EcResyncWorker:
         for i, (_, _, shards, S, _logical) in enumerate(gathered):
             present = tuple(sorted(shards)[:k])
             groups.setdefault((present, S), []).append(i)
-        moved = 0
+        installs: List[ShardWriteReq] = []
+        install_cids: List[ChunkId] = []
         for (present, S), idxs in groups.items():
             codec = get_codec(k, m, S)
             surv = np.stack([
@@ -477,39 +667,30 @@ class EcResyncWorker:
                     lens = {j: len(b) for j, b in shards.items() if j < k}
                     payload = trim_rebuilt_shard(
                         raw, lost_shard, lens, k, S)
-                crc = codec.crc_host(payload)
-                req = ShardWriteReq(
+                installs.append(ShardWriteReq(
                     chain_id=chain.chain_id,
                     chain_ver=chain.chain_version,
                     target_id=target_id,
                     chunk_id=cid,
                     data=payload,
-                    crc=crc,
+                    crc=codec.crc_host(payload),
                     update_ver=ver,
                     chunk_size=S,
                     logical_len=logical,
-                )
-                try:
-                    reply = self._messenger(node_id, "write_shard", req)
-                    if reply.code == Code.OVERLOADED:
-                        # self-throttle: honor the server's retry-after
-                        # hint once, then defer the stripe to the next
-                        # round (rebuild is idempotent and resumable)
-                        import time as _time
-
-                        from tpu3fs.qos.core import retry_after_ms_of
-
-                        hint = (reply.retry_after_ms
-                                or retry_after_ms_of(reply.message))
-                        _time.sleep(max(hint, 10) / 1000.0)
-                        reply = self._messenger(node_id, "write_shard", req)
-                except FsError:
-                    skipped += _skip(cid)
-                    continue
-                if reply.ok:
-                    moved += 1
-                else:
-                    skipped += _skip(cid)
+                ))
+                install_cids.append(cid)
+        moved = 0
+        for cid, req, reply in zip(
+                install_cids, installs,
+                self._install_batch(node_id, installs)):
+            if reply is not None and reply.ok:
+                moved += 1
+                nbytes = len(req.data)
+                self._round_stats["bytes"] += nbytes
+                self._rebuilt_shards.add()
+                self._rebuilt_bytes.add(nbytes)
+            else:
+                skipped += _skip(cid)
         return moved, skipped
 
     def _reconstruct(self, codec, present, lost, surv: np.ndarray) -> np.ndarray:
