@@ -1,0 +1,144 @@
+"""trace_bench: distributed-tracing overhead on the pipelined write path
+-> BENCH_TRACE.json.
+
+Runs the write-bench shape (batched pipelined batch_write over the
+_RpcCluster socket harness, full CRAQ chain) with the tracer OFF, then
+ON at sampling 0 / 0.01 / 1.0, INTERLEAVED round-robin so host drift
+hits every mode equally. The acceptance bound: sampling-off throughput
+within 3% of tracer-off (the hot-path cost at rate 0 is one ContextVar
+read per op, the envelope trace string per RPC, and the per-stage
+accumulation that slow-op capture needs).
+
+Usage:
+  python -m benchmarks.trace_bench [--chunks 32] [--size 1048576]
+      [--rounds 6] [--fast] [--out BENCH_TRACE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+from benchmarks.storage_bench import FILE_ID, _RpcCluster
+from tpu3fs.analytics import spans
+from tpu3fs.client.storage_client import RetryOptions
+from tpu3fs.storage.types import ChunkId
+
+_FAST_RETRY = RetryOptions(backoff_base_s=0.001, backoff_max_s=0.05)
+
+
+def _gibps(nbytes: int, dt: float) -> float:
+    return round(nbytes / max(dt, 1e-9) / (1 << 30), 3)
+
+
+class _Mode:
+    def __init__(self, label, rate, enabled):
+        self.label = label
+        self.rate = rate
+        self.enabled = enabled
+        self.dt = 0.0
+        self.nbytes = 0
+
+    def arm(self, directory):
+        t = spans.tracer()
+        if self.enabled:
+            t.configure(service="bench", node=0, directory=directory,
+                        sample_rate=self.rate, slow_op_ms=0,
+                        enabled=True)
+            # slow-op capture ARMED but not firing: threshold far above
+            # any op (the acceptance shape: capture ready at rate 0)
+            t.slow_op_us = 60_000_000.0
+        else:
+            t.enabled = False
+
+
+def run(*, chunks: int = 32, size: int = 1 << 20, batch: int = 32,
+        rounds: int = 6, out: str = "BENCH_TRACE.json") -> dict:
+    tmp = tempfile.mkdtemp(prefix="trace_bench_")
+    cluster = _RpcCluster(replicas=2, chains=4, size=size,
+                          transport="python", engine="mem")
+    old_tracer = spans._TRACER
+    spans._TRACER = spans.Tracer()
+    rows = []
+    try:
+        client = cluster.storage_client(retry=_FAST_RETRY)
+        chain_ids = cluster.chain_ids
+        base = bytes(range(256)) * (size // 256)
+        variants = [base[i:] + base[:i] for i in (0, 1, 2, 3)]
+
+        modes = [
+            _Mode("off", 0.0, False),
+            _Mode("sample_0", 0.0, True),
+            _Mode("sample_0.01", 0.01, True),
+            _Mode("sample_1.0", 1.0, True),
+        ]
+
+        def one_pass(mode, rnd):
+            payload = variants[rnd % len(variants)]
+            writes = [(chain_ids[i % len(chain_ids)],
+                       ChunkId(FILE_ID, i), 0, payload)
+                      for i in range(chunks)]
+            mode.arm(tmp)
+            t0 = time.perf_counter()
+            for lo in range(0, chunks, batch):
+                got = client.batch_write(writes[lo:lo + batch],
+                                         chunk_size=size)
+                assert all(r.ok for r in got), got
+            mode.dt += time.perf_counter() - t0
+            mode.nbytes += chunks * size
+
+        for mode in modes:  # warmup pass per mode (arena, connections)
+            one_pass(mode, 0)
+            mode.dt = 0.0
+            mode.nbytes = 0
+        for rnd in range(rounds):  # interleaved AND rotated: host drift
+            # and position-in-round effects hit every mode equally
+            for k in range(len(modes)):
+                one_pass(modes[(rnd + k) % len(modes)], rnd)
+
+        base_gibps = _gibps(modes[0].nbytes, modes[0].dt)
+        for mode in modes:
+            v = _gibps(mode.nbytes, mode.dt)
+            rows.append({
+                "metric": f"trace_write_{mode.label}",
+                "value": v, "unit": "GiB/s",
+                "overhead_pct": round((base_gibps - v) / base_gibps
+                                      * 100.0, 2) if base_gibps else 0.0,
+            })
+        spans._TRACER.flush()
+        span_files = len(spans._TRACER.span_paths)
+        rows.append({"metric": "trace_span_files", "value": span_files,
+                     "unit": "files"})
+    finally:
+        spans._TRACER = old_tracer
+        cluster.close()
+    result = {"bench": "trace", "rows": rows,
+              "config": {"chunks": chunks, "size": size, "batch": batch,
+                         "rounds": rounds, "replicas": 2}}
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunks", type=int, default=32)
+    ap.add_argument("--size", type=int, default=1 << 20)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--fast", action="store_true")
+    ap.add_argument("--out", default="BENCH_TRACE.json")
+    args = ap.parse_args()
+    if args.fast:
+        args.chunks, args.size, args.rounds = 8, 256 << 10, 2
+    run(chunks=args.chunks, size=args.size, batch=args.batch,
+        rounds=args.rounds, out=args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -885,17 +885,21 @@ constexpr int64_t kReadMethodId = 3;
 constexpr int64_t kBatchUpdateMethodId = 15;
 
 // ---- server ---------------------------------------------------------------
-// handler v3: returns status; on success fills *rsp (malloc'd) + *rsp_len;
+// handler v4: returns status; on success fills *rsp (malloc'd) + *rsp_len;
 // may fill *msg (malloc'd) with an error message. `flags` carries the
 // request envelope's flag bits — the QoS traffic-class bits ride there
 // (tpu3fs/qos/core.py class_to_flags), so the Python trampoline can admit
 // and tag by the class the PEER declared instead of guessing from the
-// method name. `bulk`/`bulk_len` carry the request's raw bulk section when
-// has_bulk != 0; the handler may hand back a malloc'd reply bulk section
-// via *rsp_bulk — the transport then writev's it after the envelope
-// without copying. Called from workers.
+// method name. `req_msg` is the request envelope's message field (NUL-
+// terminated; "" when absent) — a traced peer carries its TraceContext
+// there (tpu3fs/analytics/spans.py), and the field is already part of
+// the wire envelope, so old peers interop untouched. `bulk`/`bulk_len`
+// carry the request's raw bulk section when has_bulk != 0; the handler
+// may hand back a malloc'd reply bulk section via *rsp_bulk — the
+// transport then writev's it after the envelope without copying. Called
+// from workers.
 typedef int64_t (*tpu3fs_handler_t)(int64_t service_id, int64_t method_id,
-                                    int64_t flags,
+                                    int64_t flags, const char* req_msg,
                                     const uint8_t* req, size_t req_len,
                                     const uint8_t* bulk, size_t bulk_len,
                                     int has_bulk,
@@ -1191,6 +1195,7 @@ void worker_main(Server* s) {
     int64_t status = INTERNAL;
     if (s->handler) {
       status = s->handler(req.service_id, req.method_id, req.flags,
+                          req.message.c_str(),
                           reinterpret_cast<const uint8_t*>(req.payload.data()),
                           req.payload.size(),
                           reinterpret_cast<const uint8_t*>(req.bulk.data()),
@@ -1498,17 +1503,20 @@ void* tpu3fs_rpc_client_connect(const char* host, int port,
 // predate the flags-carrying handler signature / pipelined client split
 // (a silent mismatch would corrupt the callback stack instead of failing
 // loud)
-int tpu3fs_rpc_abi_version() { return 3; }
+int tpu3fs_rpc_abi_version() { return 4; }
 
 namespace {
 
 // send half: frame + writev the request (gathering caller bulk buffers);
 // stores the uuid in c->pending_uuid for the matching recv. extra_flags
 // carries the envelope flag bits beyond kFlagIsReq — the QoS traffic
-// class of the calling thread rides there (class_to_flags).
+// class of the calling thread rides there (class_to_flags). `msg` (may
+// be null) rides the envelope message field — the trace context of a
+// traced caller (spans.py to_wire).
 // Caller must hold c->mu.
 int client_send_locked(Client* c, int64_t service_id, int64_t method_id,
-                       int64_t extra_flags, const uint8_t* req,
+                       int64_t extra_flags, const char* msg,
+                       const uint8_t* req,
                        size_t req_len, const uint8_t* const* iov_ptrs,
                        const size_t* iov_lens, int64_t n_iovs) {
   Packet pkt;
@@ -1517,6 +1525,7 @@ int client_send_locked(Client* c, int64_t service_id, int64_t method_id,
   pkt.method_id = method_id;
   pkt.flags = kFlagIsReq | extra_flags;
   pkt.status = OK;
+  if (msg != nullptr) pkt.message = msg;
   pkt.payload.assign(reinterpret_cast<const char*>(req), req_len);
   bool bulk = n_iovs >= 0;
   if (bulk)
@@ -1640,7 +1649,8 @@ int client_recv_locked(Client* c, int64_t* out_status, uint8_t** out_rsp,
 // *out_bulk_off (zero-copy hand-off — the caller views it in place and
 // frees the buffer when done).
 int tpu3fs_rpc_client_call3(void* cli, int64_t service_id, int64_t method_id,
-                            int64_t flags, const uint8_t* req, size_t req_len,
+                            int64_t flags, const char* msg,
+                            const uint8_t* req, size_t req_len,
                             const uint8_t* const* iov_ptrs,
                             const size_t* iov_lens, int64_t n_iovs,
                             int64_t* out_status, uint8_t** out_rsp,
@@ -1649,8 +1659,8 @@ int tpu3fs_rpc_client_call3(void* cli, int64_t service_id, int64_t method_id,
                             int* out_has_bulk, char** out_msg) {
   auto* c = static_cast<Client*>(cli);
   std::lock_guard<std::mutex> g(c->mu);
-  int rc = client_send_locked(c, service_id, method_id, flags, req, req_len,
-                              iov_ptrs, iov_lens, n_iovs);
+  int rc = client_send_locked(c, service_id, method_id, flags, msg, req,
+                              req_len, iov_ptrs, iov_lens, n_iovs);
   if (rc != 0) return rc;
   return client_recv_locked(c, out_status, out_rsp, out_rsp_len, out_bulk,
                             out_bulk_off, out_bulk_len, out_has_bulk,
@@ -1663,13 +1673,14 @@ int tpu3fs_rpc_client_call3(void* cli, int64_t service_id, int64_t method_id,
 // request per connection; the Python side serializes send..recv pairs
 // per connection with its own lease lock.
 int tpu3fs_rpc_client_send(void* cli, int64_t service_id, int64_t method_id,
-                           int64_t flags, const uint8_t* req, size_t req_len,
+                           int64_t flags, const char* msg,
+                           const uint8_t* req, size_t req_len,
                            const uint8_t* const* iov_ptrs,
                            const size_t* iov_lens, int64_t n_iovs) {
   auto* c = static_cast<Client*>(cli);
   std::lock_guard<std::mutex> g(c->mu);
-  return client_send_locked(c, service_id, method_id, flags, req, req_len,
-                            iov_ptrs, iov_lens, n_iovs);
+  return client_send_locked(c, service_id, method_id, flags, msg, req,
+                            req_len, iov_ptrs, iov_lens, n_iovs);
 }
 
 int tpu3fs_rpc_client_recv(void* cli, int64_t* out_status, uint8_t** out_rsp,
@@ -1687,7 +1698,8 @@ int tpu3fs_rpc_client_call(void* cli, int64_t service_id, int64_t method_id,
                            const uint8_t* req, size_t req_len,
                            int64_t* out_status, uint8_t** out_rsp,
                            size_t* out_rsp_len, char** out_msg) {
-  return tpu3fs_rpc_client_call3(cli, service_id, method_id, 0, req, req_len,
+  return tpu3fs_rpc_client_call3(cli, service_id, method_id, 0, nullptr,
+                                 req, req_len,
                                  nullptr, nullptr, -1, out_status, out_rsp,
                                  out_rsp_len, nullptr, nullptr, nullptr,
                                  nullptr, out_msg);

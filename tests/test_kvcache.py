@@ -325,6 +325,11 @@ class TestTieredKVCache:
             assert blocked.wait(5.0)
             assert not done.wait(0.3)          # still blocked
             assert tc.dirty_bytes() <= 4096 + 1024
+            # the memory-observability gauges see the same bound (what
+            # admin_cli top reports: kvcache.dirty_bytes/host_bytes)
+            assert tc._dirty_gauge._value <= 4096 + 1024
+            assert tc._host_gauge._value is not None
+            assert tc._host_gauge._value <= tc.tier.capacity_bytes
             stall.set()                        # storage recovers
             assert done.wait(10.0)             # producer unblocks
             assert tc.flush(10.0)

@@ -56,9 +56,11 @@ HOT_PATH: List[Tuple[str, List[str]]] = [
       # write path: iovec-mode engine hand-off (no blob concatenation)
       "batch_update", "_payload_addr"]),
     ("tpu3fs/client/storage_client.py",
-     ["batch_read",
+     # the public batch_read/batch_write/write_stripes names are thin
+     # tracing wrappers (root spans); the hot bodies are the _op twins
+     ["_batch_read_op",
       # write path: pipelined batch fan-out + batched stripe writes
-      "batch_write", "write_stripes", "_send_shard_batches",
+      "_batch_write_op", "_write_stripes_op", "_send_shard_batches",
       # EC data plane: batched shard fetch, clean/degraded stripe
       # assembly (the degraded fill), delta-parity sub-stripe RMW
       "_issue_wire_reads", "_plan_stripe_read", "_stripe_clean",

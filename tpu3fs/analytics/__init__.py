@@ -5,3 +5,11 @@ from tpu3fs.analytics.trace import (  # noqa: F401
     read_records,
     write_records,
 )
+from tpu3fs.analytics.spans import (  # noqa: F401
+    SpanEvent,
+    TraceConfig,
+    TraceContext,
+    current_trace,
+    root_span,
+    tracer,
+)

@@ -157,6 +157,14 @@ class SerdeObjectWriter:
             if len(self._rows) >= self._flush_rows:
                 self._flush_locked()
 
+    def write_row(self, row: Dict[str, Any]) -> None:
+        """Append an already-flat row (no reflection walk — the span
+        sink's hot path: SpanEvent is flat, its __dict__ IS the row)."""
+        with self._lock:
+            self._rows.append(row)
+            if len(self._rows) >= self._flush_rows:
+                self._flush_locked()
+
     def _flush_locked(self) -> None:
         if not self._rows:
             return
@@ -233,6 +241,10 @@ class StructuredTraceLog:
     def append(self, event: Any) -> None:
         if self.enabled:
             self._writer.write(event)
+
+    def append_row(self, row: Dict[str, Any]) -> None:
+        if self.enabled:
+            self._writer.write_row(row)
 
     def flush(self) -> None:
         self._writer.flush()

@@ -102,14 +102,45 @@ class ApplicationBase:
 
     # -- lifecycle ----------------------------------------------------------
     def init_common_components(self) -> None:
-        """ref initCommonComponents: logging + monitor (IBManager has no TPU
-        analogue; ICI links need no per-process bring-up)."""
+        """ref initCommonComponents: logging + monitor + tracing (IBManager
+        has no TPU analogue; ICI links need no per-process bring-up)."""
         init_logging(
             path=self.flag("log_file") or None,
             level=self.flag("log_level", "INFO"),
         )
+        self._init_tracing()
         xlog("INFO", "%s node %d starting (pid %d)",
              type(self).__name__, self.info.node_id, self.info.pid)
+
+    def _init_tracing(self) -> None:
+        """Configure the per-process tracer (tpu3fs/analytics/spans.py)
+        from the config tree's ``trace`` section when the binary declares
+        one (hot-updatable via config push), with ``--trace-dir`` /
+        ``--trace-sample`` / ``--trace-slow-ms`` flag overrides for
+        binaries run by hand."""
+        from tpu3fs.analytics.spans import TraceConfig, tracer
+
+        service = type(self).__name__.replace("App", "").lower() or "proc"
+        tcfg = getattr(self.config, "trace", None)
+        if isinstance(tcfg, TraceConfig):
+            if self.flag("trace_dir"):
+                tcfg.set("dir", self.flag("trace_dir"))
+            if self.flag("trace_sample"):
+                tcfg.set("sample_rate", float(self.flag("trace_sample")))
+            if self.flag("trace_slow_ms"):
+                tcfg.set("slow_op_ms", float(self.flag("trace_slow_ms")))
+            tracer().apply_config(tcfg, service=service,
+                                  node=self.info.node_id)
+        elif self.flag("trace_dir"):
+            tracer().configure(
+                service=service, node=self.info.node_id,
+                directory=self.flag("trace_dir"),
+                sample_rate=float(self.flag("trace_sample", "0") or 0),
+                slow_op_ms=float(self.flag("trace_slow_ms", "200") or 200))
+        if tracer().enabled:
+            # bounded visibility lag for live trace consumers (the
+            # assembler, trace-show): flush the columnar buffer on a tick
+            self.spawn_periodic("trace-flush", 2.0, tracer().flush)
 
     def init_server(self) -> None:
         port = int(self.flag("port", "0"))
@@ -173,18 +204,67 @@ class ApplicationBase:
         self.init_server()
         self.start_server()
         self._start_memory_monitor()
+        self._start_monitor_push()
         if block:
             self._install_signal_handlers()
             self.wait()
         return self
 
+    def _start_monitor_push(self) -> None:
+        """Ship this process's Monitor samples to monitor_collector on a
+        period — every service binary, not just the ones that remembered
+        to (ref Monitor.cc periodic collection + MonitorCollectorClient).
+
+        The collector address comes from ``--collector host:port`` or the
+        config item ``collector`` (hot: a config push can point the fleet
+        at a collector, or away from a dead one, live); the period from
+        ``monitor_push_period_s`` (hot) or ``--monitor-period``. With no
+        address the loop still collects (recorders reset each window) but
+        ships nothing. Outages buffer bounded with drop-counting
+        (monitor.collector.BufferedCollectorSink)."""
+        from tpu3fs.monitor.collector import BufferedCollectorSink
+        from tpu3fs.monitor.recorder import Monitor
+
+        def addr():
+            spec = getattr(self.config, "collector", "")
+            return spec or self.flag("collector") or None
+
+        def period() -> float:
+            p = getattr(self.config, "monitor_push_period_s", None)
+            if p is not None:
+                return float(p)
+            return float(self.flag("monitor_period", "5") or 5)
+
+        self.monitor_sink = BufferedCollectorSink(addr)
+        monitor = Monitor.default()
+        monitor.add_sink(self.monitor_sink)
+        self.spawn_periodic("monitor-push", period, monitor.collect)
+
     def _start_memory_monitor(self, interval_s: float = 30.0) -> None:
-        """Periodic process-memory gauges (ref src/memory counters)."""
+        """Periodic process-memory gauges (ref src/memory counters), plus
+        the subsystem memory sources: content-arena resident/recycled
+        extent bytes (storage/engine.py), transport BufferPool leases —
+        kvcache host/dirty gauges are set by their owning tier objects."""
         from tpu3fs.monitor.memory import MemoryMonitor
 
         self.memory_monitor = MemoryMonitor(
             {"node": str(self.info.node_id),
              "kind": type(self).__name__})
+        from tpu3fs.storage.engine import arena_stats
+        from tpu3fs.utils.bufpool import GLOBAL_POOL
+
+        self.memory_monitor.add_source(
+            "mem.arena_resident_bytes",
+            lambda: arena_stats()["resident_bytes"])
+        self.memory_monitor.add_source(
+            "mem.arena_recycled_bytes",
+            lambda: arena_stats()["recycled_bytes"])
+        self.memory_monitor.add_source(
+            "mem.bufpool_pooled_bytes",
+            lambda: GLOBAL_POOL.stats()["pooled_bytes"])
+        self.memory_monitor.add_source(
+            "mem.bufpool_outstanding",
+            lambda: GLOBAL_POOL.stats()["outstanding"])
 
         self.memory_monitor.poll_once()
         self.spawn_periodic("memory-monitor", interval_s,
@@ -215,6 +295,11 @@ class ApplicationBase:
             if t is not me:
                 t.join(timeout=2.0)
         self.after_stop()
+        # the span sink buffers flush_rows rows; a stop must not lose the
+        # tail of the trace (same contract as the storage event trace)
+        from tpu3fs.analytics.spans import tracer
+
+        tracer().flush()
         xlog("INFO", "node %d stopped", self.info.node_id)
 
     def spawn(self, fn, name: str) -> None:
@@ -412,6 +497,11 @@ class TwoPhaseApplication(ApplicationBase):
         self.heartbeat_once()
         self.spawn(self._heartbeat_loop, "heartbeat")
         self.spawn(self._routing_loop, "routing-poll")
+        # two-phase services get the same observability plumbing as
+        # one-phase ones (this run() does not call the base run(), and
+        # several binaries historically shipped no samples at all)
+        self._start_memory_monitor()
+        self._start_monitor_push()
         if block:
             self._install_signal_handlers()
             self.wait()

@@ -19,11 +19,17 @@ from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import MetaRpcClient, RpcMessenger
 from tpu3fs.usrbio.agent import UsrbioAgent
+from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.utils.logging import xlog
 
 
 class FuseAppConfig(Config):
+    # observability: distributed tracing + monitor sample push
+    # (tpu3fs/analytics/spans.py; both hot-configured)
+    trace = TraceConfig
+    collector = ConfigItem("", hot=True)   # host:port; "" = off
+    monitor_push_period_s = ConfigItem(5.0, hot=True)
     mountpoint = ConfigItem("")
     fsname = ConfigItem("tpu3fs")
     # shared mounts want allow_other, but non-root mounts need

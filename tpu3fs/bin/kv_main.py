@@ -17,6 +17,7 @@ from tpu3fs.app.application import OnePhaseApplication
 from tpu3fs.kv.service import KvService, bind_kv_service
 from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.rpc.net import RpcServer
+from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.qos.core import QosConfig
 
@@ -24,6 +25,11 @@ from tpu3fs.qos.core import QosConfig
 class KvAppConfig(Config):
     # QoS admission limits for the KV RPC dispatch (tpu3fs/qos)
     qos = QosConfig
+    # observability: distributed tracing + monitor sample push
+    # (tpu3fs/analytics/spans.py; both hot-configured)
+    trace = TraceConfig
+    collector = ConfigItem("", hot=True)   # host:port; "" = off
+    monitor_push_period_s = ConfigItem(5.0, hot=True)
     snapshot_ttl_s = ConfigItem(60.0, hot=True)
 
 

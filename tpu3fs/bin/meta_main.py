@@ -20,6 +20,7 @@ from tpu3fs.meta.store import ChainAllocator, MetaStore
 from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import RpcMessenger, bind_meta_service
+from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.qos.core import QosConfig
 
@@ -27,6 +28,11 @@ from tpu3fs.qos.core import QosConfig
 class MetaAppConfig(Config):
     # QoS admission limits for the meta RPC dispatch (tpu3fs/qos)
     qos = QosConfig
+    # observability: distributed tracing + monitor sample push
+    # (tpu3fs/analytics/spans.py; both hot-configured)
+    trace = TraceConfig
+    collector = ConfigItem("", hot=True)   # host:port; "" = off
+    monitor_push_period_s = ConfigItem(5.0, hot=True)
     chunk_size = ConfigItem(1 << 20)
     stripe = ConfigItem(1)
     gc_interval_s = ConfigItem(10.0, hot=True)

@@ -18,6 +18,7 @@ from tpu3fs.mgmtd.service import Mgmtd, MgmtdConfig
 from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import bind_mgmtd_admin, bind_mgmtd_service
+from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.qos.core import QosConfig
 
@@ -25,6 +26,11 @@ from tpu3fs.qos.core import QosConfig
 class MgmtdAppConfig(Config):
     # QoS admission limits for the mgmtd RPC dispatch (tpu3fs/qos)
     qos = QosConfig
+    # observability: distributed tracing + monitor sample push
+    # (tpu3fs/analytics/spans.py; both hot-configured)
+    trace = TraceConfig
+    collector = ConfigItem("", hot=True)   # host:port; "" = off
+    monitor_push_period_s = ConfigItem(5.0, hot=True)
     lease_length_s = ConfigItem(60.0, hot=True)
     heartbeat_timeout_s = ConfigItem(60.0, hot=True)
     tick_interval_s = ConfigItem(5.0, hot=True)

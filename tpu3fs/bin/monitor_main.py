@@ -13,6 +13,7 @@ from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.monitor.collector import CollectorService, bind_collector_service
 from tpu3fs.monitor.recorder import JsonlSink, SqliteSink
 from tpu3fs.rpc.net import RpcServer
+from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.qos.core import QosConfig
 
@@ -20,6 +21,11 @@ from tpu3fs.qos.core import QosConfig
 class MonitorAppConfig(Config):
     # QoS admission limits for the collector RPC dispatch (tpu3fs/qos)
     qos = QosConfig
+    # observability: distributed tracing + monitor sample push
+    # (tpu3fs/analytics/spans.py; both hot-configured)
+    trace = TraceConfig
+    collector = ConfigItem("", hot=True)   # host:port; "" = off
+    monitor_push_period_s = ConfigItem(5.0, hot=True)
     out_path = ConfigItem("monitor_samples.jsonl")
 
 

@@ -16,6 +16,7 @@ import os
 import sys
 from typing import Dict, List, Optional
 
+from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.app.application import TwoPhaseApplication
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType
 from tpu3fs.qos.core import QosConfig
@@ -55,6 +56,11 @@ class StorageAppConfig(Config):
     # QoS: per-class admission/scheduling limits (tpu3fs/qos) — every
     # item hot-updates via mgmtd config push without restart
     qos = QosConfig
+    # distributed request tracing (tpu3fs/analytics/spans.py) + monitor
+    # sample push to monitor_collector — both hot-configured
+    trace = TraceConfig
+    collector = ConfigItem("", hot=True)          # host:port; "" = off
+    monitor_push_period_s = ConfigItem(5.0, hot=True)
 
 
 class StorageApp(TwoPhaseApplication):

@@ -403,6 +403,148 @@ class AdminCli:
                     lines.append(f"  queue depths: {depths}")
         return "\n".join(lines) if lines else "no storage nodes"
 
+    # -- distributed tracing (tpu3fs/analytics/spans.py + assemble.py) -------
+    @staticmethod
+    def _load_trace_dirs(args: List[str]):
+        """--dir D[,D2,...] (span files or directories, recursive)."""
+        from tpu3fs.analytics import assemble
+
+        spec = None
+        if "--dir" in args:
+            spec = args[args.index("--dir") + 1]
+        elif args and not args[0].startswith("--"):
+            spec = args[0]
+        if not spec:
+            raise ValueError("usage: --dir <span-dir[,span-dir...]>")
+        rows = assemble.load_spans(spec.split(","))
+        return assemble, rows
+
+    def cmd_trace_show(self, args: List[str]) -> str:
+        """One trace as a cross-process span tree with the per-stage
+        latency breakdown and stage coverage.
+        trace-show --dir D[,D...] [--trace TRACE_ID | --op OP]
+        (default: the slowest assembled trace)"""
+        assemble, rows = self._load_trace_dirs(args)
+        trees = assemble.assemble_traces(rows)
+        if not trees:
+            return "no traces found"
+        want = self._flag(args, "--trace")
+        if want:
+            tree = trees.get(want)
+            if tree is None:
+                return f"trace {want} not found ({len(trees)} traces)"
+            return assemble.format_trace(tree)
+        op = self._flag(args, "--op")
+        ranked = assemble.top_traces(trees, len(trees))
+        if op:
+            ranked = [t for t in ranked
+                      if t.root is not None and t.root.get("op") == op]
+            if not ranked:
+                return f"no trace with root op {op}"
+        return assemble.format_trace(ranked[0])
+
+    def cmd_trace_top(self, args: List[str]) -> str:
+        """Slowest traced ops + per-stage percentile breakdown over every
+        loaded span file. trace-top --dir D[,D...] [--n N]"""
+        assemble, rows = self._load_trace_dirs(args)
+        trees = assemble.assemble_traces(rows)
+        if not trees:
+            return "no traces found"
+        return assemble.format_top(trees, rows,
+                                   n=int(self._flag(args, "--n", 10)))
+
+    def cmd_top(self, args: List[str]) -> str:
+        """Live cluster top from monitor_collector output: per-class
+        admitted/shed rates, queue depths, per-subsystem GiB/s, memory
+        gauges. top --collector HOST:PORT [--window SEC] [--watch SEC]
+        (--watch polls until interrupted; default prints once)"""
+        coll = self._flag(args, "--collector") or (
+            args[0] if args and not args[0].startswith("--") else None)
+        if not coll:
+            return ("usage: top --collector <host:port> [--window SEC] "
+                    "[--watch SEC]")
+        window = float(self._flag(args, "--window", 60))
+        watch = self._flag(args, "--watch")
+        out = self._top_once(coll, window)
+        if watch is None:
+            return out
+        import time as _time  # pragma: no cover - interactive loop
+
+        try:
+            while True:
+                print(out)
+                _time.sleep(float(watch))
+                out = self._top_once(coll, window)
+        except KeyboardInterrupt:
+            return out
+
+    def _top_once(self, coll: str, window: float) -> str:
+        import json as _json
+        import time as _time
+
+        from tpu3fs.monitor.collector import (
+            COLLECTOR_SERVICE_ID,
+            QueryReq,
+            SampleBatch,
+        )
+        from tpu3fs.rpc.net import RpcClient
+
+        host, port = coll.rsplit(":", 1)
+        since = _time.time() - window
+        rsp = RpcClient().call(
+            (host, int(port)), COLLECTOR_SERVICE_ID, 2,
+            QueryReq(since=since, limit=100000), SampleBatch)
+        def is_gauge(name: str) -> bool:
+            # ValueRecorder names (last-value semantics): the memory
+            # observability set + the pre-existing gauge families.
+            # Everything else reports per-window deltas (counters).
+            return name.startswith(("mem.", "memory.", "mgmtd.",
+                                    "storage.disk_info",
+                                    "storage.allocate")) \
+                or name in ("kvcache.dirty_bytes", "kvcache.host_bytes",
+                            "kvcache.leases", "dataload.buffered_bytes",
+                            "qos.queue_depth", "ec.rebuild_mibps",
+                            "ec.encode_gibps")
+
+        counters: Dict[tuple, float] = {}
+        gauges: Dict[tuple, tuple] = {}
+        for s in rsp.samples:
+            tags = s.tags if isinstance(s.tags, dict) else _json.loads(
+                s.tags or "{}")
+            key = (s.name, tags.get("class", ""), tags.get("node", ""))
+            if is_gauge(s.name):
+                cur = gauges.get(key)
+                if cur is None or s.ts >= cur[0]:
+                    gauges[key] = (s.ts, s.value)
+            else:
+                counters[key] = counters.get(key, 0.0) + s.value
+        lines = [f"cluster top  (window {window:.0f}s, "
+                 f"{len(rsp.samples)} samples)"]
+        qos = [(k, v) for k, v in counters.items()
+               if k[0] in ("qos.admitted", "qos.shed")]
+        if qos:
+            lines.append(f"  {'CLASS':<12} {'NODE':<6} {'ADMIT/s':>10} "
+                         f"{'SHED/s':>10}")
+            combos = sorted({(k[1], k[2]) for k, _ in qos})
+            for cls, node in combos:
+                a = counters.get(("qos.admitted", cls, node), 0.0)
+                d = counters.get(("qos.shed", cls, node), 0.0)
+                lines.append(f"  {cls or '-':<12} {node or '-':<6} "
+                             f"{a / window:>10.1f} {d / window:>10.1f}")
+        tput = [(k, v) for k, v in counters.items()
+                if k[0].endswith((".bytes", "_bytes")) and v > 0]
+        if tput:
+            lines.append(f"  {'THROUGHPUT':<28} {'GiB/s':>10}")
+            for (name, cls, node), v in sorted(tput):
+                lines.append(
+                    f"  {name + (f'[{cls}]' if cls else ''):<28} "
+                    f"{v / window / (1 << 30):>10.4f}")
+        if gauges:
+            lines.append(f"  {'GAUGE':<28} {'NODE':<6} {'VALUE':>14}")
+            for (name, cls, node), (_, v) in sorted(gauges.items()):
+                lines.append(f"  {name:<28} {node or '-':<6} {v:>14.0f}")
+        return "\n".join(lines)
+
     def cmd_ec_status(self, args: List[str]) -> str:
         """Per-EC-chain health: shard -> target/state map, degraded
         summary, and with --counts the per-target stripe counts

@@ -216,9 +216,14 @@ class ReadaheadPrefetcher:
                 blob = None
             else:
                 try:
+                    from tpu3fs.analytics import spans as _spans
+
                     ctx = (tagged(tclass) if tclass is not None
                            else contextlib.nullcontext())
-                    with ctx:
+                    # trace DETACHED: a readahead completes long after the
+                    # arming reader's op span closed — its RPCs must not
+                    # append to (or re-sample) that finished trace
+                    with ctx, _spans.trace_scope(None):
                         blob = self._fetch(inode, start, window)
                 except BaseException:
                     blob = None  # best-effort: a failed readahead serves

@@ -356,6 +356,17 @@ class StorageClient:
     def batch_read(
         self, reqs: List[ReadReq]
     ) -> List[ReadReply]:
+        """Traced entry: see _batch_read_op. The root span head-samples a
+        trace when none is active (tpu3fs/analytics/spans.py); sampled or
+        slow ops capture their whole cross-process stage breakdown."""
+        from tpu3fs.analytics import spans as _spans
+
+        with _spans.root_span("client.batch_read"):
+            return self._batch_read_op(reqs)
+
+    def _batch_read_op(
+        self, reqs: List[ReadReq]
+    ) -> List[ReadReply]:
         """Group per node (ref groupOpsByNodeId) then issue node batches.
 
         EC requests ride the SAME node-grouped striped fan-out as the CR
@@ -473,6 +484,24 @@ class StorageClient:
         return replies  # type: ignore[return-value]
 
     def batch_write(
+        self,
+        writes: List[Tuple[int, ChunkId, int, bytes]],
+        *,
+        chunk_size: int = 1 << 20,
+        op_crcs: Optional[List[Optional[int]]] = None,
+    ) -> List[UpdateReply]:
+        """Traced entry: see _batch_write_op. The root span is the
+        client-observed latency the trace assembler's stage coverage is
+        measured against (docs/observability.md)."""
+        from tpu3fs.analytics import spans as _spans
+
+        with _spans.root_span(
+                "client.batch_write",
+                nbytes=sum(len(w[3]) for w in writes)):
+            return self._batch_write_op(writes, chunk_size=chunk_size,
+                                        op_crcs=op_crcs)
+
+    def _batch_write_op(
         self,
         writes: List[Tuple[int, ChunkId, int, bytes]],
         *,
@@ -768,6 +797,21 @@ class StorageClient:
         *,
         chunk_size: int = 1 << 20,
     ) -> List[UpdateReply]:
+        """Traced entry: see _write_stripes_op."""
+        from tpu3fs.analytics import spans as _spans
+
+        with _spans.root_span("client.write_stripes",
+                              nbytes=sum(len(d) for _, d in items)):
+            return self._write_stripes_op(chain_id, items,
+                                          chunk_size=chunk_size)
+
+    def _write_stripes_op(
+        self,
+        chain_id: int,
+        items: List[Tuple[ChunkId, bytes]],
+        *,
+        chunk_size: int = 1 << 20,
+    ) -> List[UpdateReply]:
         """Batched EC writes: encode MANY stripes with ONE device kernel
         launch (amortizing the PCIe round trip — the whole point of the TPU
         data plane) and install shards with one BatchShardWrite per node.
@@ -902,8 +946,12 @@ class StorageClient:
         """Sub-stripe write via DELTA PARITY (see _write_stripe_rmw);
         every fast-path decline counts on ec.parity_rmw_fallback so the
         monitor can answer "is the RMW path actually engaging"."""
-        out = self._write_stripe_rmw(chain_id, chunk_id, in_off, part,
-                                     chunk_size=chunk_size)
+        from tpu3fs.analytics import spans as _spans
+
+        with _spans.root_span("client.write_stripe_rmw",
+                              nbytes=len(part)):
+            out = self._write_stripe_rmw(chain_id, chunk_id, in_off, part,
+                                         chunk_size=chunk_size)
         if out is None:
             self._ec_rmw_fallback.add()
         return out

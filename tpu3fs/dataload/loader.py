@@ -40,7 +40,11 @@ import numpy as np
 
 from tpu3fs.dataload.dataset import PackedDataset, dp_info
 from tpu3fs.dataload.state import DataloadState
-from tpu3fs.monitor.recorder import CounterRecorder, DistributionRecorder
+from tpu3fs.monitor.recorder import (
+    CounterRecorder,
+    DistributionRecorder,
+    ValueRecorder,
+)
 from tpu3fs.qos.core import TrafficClass, retry_after_ms_of, tagged
 from tpu3fs.utils.result import Code, FsError
 from tpu3fs.utils.result import err as _err
@@ -67,7 +71,8 @@ class LoaderConfig:
     # merge sorted record extents when the gap is below this: 64 KiB
     # measured best on the served read path (dataload_bench sweep —
     # over-read costs wire bytes faster than spans cost round trips
-    # beyond that)
+    # beyond that). <= 0 = ADAPTIVE: a GapController (autotune.py)
+    # learns the gap online from observed dataload.batch_ms
     coalesce_gap: int = 64 << 10
     max_span_bytes: int = 8 << 20
     # fixed-size sample decode: "" leaves records as raw bytes views
@@ -175,6 +180,16 @@ class DataLoader:
         self._bytes = CounterRecorder("dataload.bytes")
         self._crc_err = CounterRecorder("dataload.crc_err")
         self._batches = CounterRecorder("dataload.batches")
+        # memory observability: decoded-ahead bytes (bounded by
+        # max_buffered_bytes — the stalled-consumer tests assert it)
+        self._buffered_gauge = ValueRecorder("dataload.buffered_bytes")
+        # adaptive coalesce gap (cfg.coalesce_gap <= 0): learned online
+        # from the batch_ms signal (dataload/autotune.py)
+        self.gap_controller = None
+        if cfg.coalesce_gap <= 0:
+            from tpu3fs.dataload.autotune import GapController
+
+            self.gap_controller = GapController()
         self._thread = threading.Thread(
             target=self._produce, daemon=True, name="dataload-producer")
         self._thread.start()
@@ -225,6 +240,7 @@ class DataLoader:
             if self._buf:
                 batch = self._buf.pop(0)
                 self._buffered_bytes -= batch.nbytes
+                self._buffered_gauge.set(self._buffered_bytes)
                 # consumed-cursor advance (the state() contract)
                 steps = self._ds.steps_per_epoch(self.config.global_batch)
                 self._epoch, self._step = (
@@ -333,6 +349,7 @@ class DataLoader:
                 return False
             self._buf.append(batch)
             self._buffered_bytes += batch.nbytes
+            self._buffered_gauge.set(self._buffered_bytes)
             self._cond.notify_all()
         return True
 
@@ -346,7 +363,12 @@ class DataLoader:
             ids.extend(self._ds.batch_ids(perm, step, cfg.global_batch,
                                           dp_rank=r,
                                           dp_size=self._dp_size))
-        recs = self._read_with_backoff(ids)
+        gap = (self.gap_controller.next_gap()
+               if self.gap_controller is not None else cfg.coalesce_gap)
+        from tpu3fs.analytics import spans as _spans
+
+        with _spans.root_span("dataload.fetch"):
+            recs = self._read_with_backoff(ids, gap)
         if cfg.transform is not None:
             # decode/augment between fetch and assembly — per record, on
             # the fetch thread (overlapped with training like the IO)
@@ -360,18 +382,24 @@ class DataLoader:
             data = self._to_device(data, rows)
         self._bytes.add(nbytes)
         self._batches.add()
-        self._batch_ms.record((time.perf_counter() - t0) * 1e3)
+        batch_ms = (time.perf_counter() - t0) * 1e3
+        self._batch_ms.record(batch_ms)
+        if self.gap_controller is not None:
+            # feedback: the gap this batch used, its wall, its bytes
+            self.gap_controller.observe(gap, batch_ms, nbytes)
         return Batch(epoch=epoch, step=step, ids=ids, data=data,
                      nbytes=nbytes, rows=rows)
 
-    def _read_with_backoff(self, ids: List[int]):
+    def _read_with_backoff(self, ids: List[int],
+                           coalesce_gap: Optional[int] = None):
         cfg = self.config
+        gap = coalesce_gap if coalesce_gap is not None else cfg.coalesce_gap
         with tagged(TrafficClass.DATALOAD):
             for _ in range(cfg.max_overload_waits):
                 try:
                     return self._ds.read_samples(
                         ids, verify=cfg.verify_crc,
-                        coalesce_gap=cfg.coalesce_gap,
+                        coalesce_gap=gap,
                         max_span_bytes=cfg.max_span_bytes)
                 except FsError as e:
                     if e.code == Code.DATALOAD_CORRUPT:

@@ -129,7 +129,29 @@ def with_transaction(
 
     fn may be re-executed; it must be idempotent up to its KV effects (the
     same contract as the reference's WithTransaction::run retry loop).
+
+    Traced ops get a ``meta.txn`` stage span covering the whole retry
+    ladder — the "where did the meta op's time go" stage of the
+    distributed trace (tpu3fs/analytics/spans.py).
     """
+    from tpu3fs.analytics import spans as _spans
+
+    _tctx = _spans.current_trace()
+    if _tctx is not None:
+        with _spans.span("kv.with_transaction", "txn"):
+            return _with_transaction_untraced(engine, fn, retry,
+                                              read_only=read_only)
+    return _with_transaction_untraced(engine, fn, retry,
+                                      read_only=read_only)
+
+
+def _with_transaction_untraced(
+    engine: IKVEngine,
+    fn: Callable[[ITransaction], T],
+    retry: Optional[RetryConfig] = None,
+    *,
+    read_only: bool = False,
+) -> T:
     retry = retry or RetryConfig()
     attempt = 0
     while True:

@@ -132,9 +132,15 @@ class TieredKVCache:
         self._flush_bytes = CounterRecorder("kvcache.flush_bytes")
         self._flush_err = CounterRecorder("kvcache.flush_err")
         self._dirty_gauge = ValueRecorder("kvcache.dirty_bytes")
+        # host-tier residency gauge (memory observability: admin_cli top
+        # + the bounded-memory assertions in tests/test_kvcache.py)
+        self._host_gauge = ValueRecorder("kvcache.host_bytes")
         self._flusher = threading.Thread(
             target=self._flush_loop, daemon=True, name="kvcache-flush")
         self._flusher.start()
+
+    def _note_host(self) -> None:
+        self._host_gauge.set(self.tier.bytes)
 
     @property
     def root(self) -> str:
@@ -189,6 +195,7 @@ class TieredKVCache:
     def _fill(self, key: str, value) -> None:
         self._fill_bytes.add(len(value))
         self._evictions.add(self.tier.put(key, value))
+        self._note_host()
 
     # -- writes -------------------------------------------------------------
     def put(self, key: str, value: bytes,
@@ -224,6 +231,7 @@ class TieredKVCache:
             self._dirty_gauge.set(self._dirty_bytes)
             self._cond.notify_all()
         self._evictions.add(self.tier.put(key, value))
+        self._note_host()
 
     def remove(self, key: str) -> bool:
         """Drops the local copies and the fs entry. Racing an in-flight

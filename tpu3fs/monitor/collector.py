@@ -89,3 +89,74 @@ class CollectorSink:
         self._client.call(
             self._addr, COLLECTOR_SERVICE_ID, 1, SampleBatch(list(samples)), Ack
         )
+
+
+class BufferedCollectorSink:
+    """Collector push with BOUNDED buffering across outages.
+
+    The plain CollectorSink raises on every push while the collector is
+    down, and Monitor.collect only logs sink errors — samples collected
+    during an outage were simply lost. Here samples queue up to
+    ``cap_samples``; every write() attempts to drain the whole backlog
+    (oldest first, FLUSH_BATCH per RPC), overflow drops the OLDEST
+    samples (the newest window is the one an operator debugging the
+    outage needs) and counts them on ``monitor.push_dropped`` so the
+    loss itself is observable once the collector returns.
+
+    ``addr`` may be a (host, port) tuple or a zero-arg callable
+    returning one / None — the hot-config shape (a config push can point
+    every service at a collector, or away from a dead one, live).
+    """
+
+    def __init__(self, addr, client: RpcClient | None = None,
+                 cap_samples: int = 65536):
+        import collections
+
+        from tpu3fs.monitor.recorder import CounterRecorder
+
+        self._addr = addr
+        self._client = client or RpcClient()
+        self._buf = collections.deque()
+        self._cap = int(cap_samples)
+        self._lock = threading.Lock()
+        self.dropped = CounterRecorder("monitor.push_dropped")
+        self.pushed = CounterRecorder("monitor.push_samples")
+
+    def _resolve_addr(self):
+        addr = self._addr() if callable(self._addr) else self._addr
+        if not addr:
+            return None
+        if isinstance(addr, str):
+            host, _, port = addr.rpartition(":")
+            try:
+                return (host or "127.0.0.1", int(port))
+            except ValueError:
+                return None
+        return tuple(addr)
+
+    def backlog(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def write(self, samples: List[Sample]) -> None:
+        with self._lock:
+            self._buf.extend(samples)
+            over = len(self._buf) - self._cap
+            if over > 0:
+                for _ in range(over):
+                    self._buf.popleft()
+                self.dropped.add(over)
+            addr = self._resolve_addr()
+            if addr is None:
+                return  # unconfigured: buffer (bounded) until pointed
+            while self._buf:
+                batch = [self._buf.popleft()
+                         for _ in range(min(FLUSH_BATCH, len(self._buf)))]
+                try:
+                    self._client.call(addr, COLLECTOR_SERVICE_ID, 1,
+                                      SampleBatch(batch), Ack)
+                except Exception:
+                    # collector outage: keep the batch for the next period
+                    self._buf.extendleft(reversed(batch))
+                    raise
+                self.pushed.add(len(batch))

@@ -33,12 +33,13 @@ from tpu3fs.utils.result import Code, FsError, Status
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libtpu3fs_rpc.so")
 
-_ABI_VERSION = 3  # must match tpu3fs_rpc_abi_version() in rpc_net.cpp
+_ABI_VERSION = 4  # must match tpu3fs_rpc_abi_version() in rpc_net.cpp
 
 _HANDLER_T = ctypes.CFUNCTYPE(
     ctypes.c_int64,                      # status
     ctypes.c_int64, ctypes.c_int64,      # service_id, method_id
     ctypes.c_int64,                      # envelope flags (QoS class bits)
+    ctypes.c_char_p,                     # request envelope message (trace)
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,   # req
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,   # bulk section
     ctypes.c_int,                                      # has_bulk
@@ -135,6 +136,7 @@ def _load_lib():
         _send_in_args = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64,                        # extra envelope flags
+            ctypes.c_char_p,                       # envelope message (trace)
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_void_p),       # iov ptrs
             ctypes.POINTER(ctypes.c_size_t),       # iov lens
@@ -369,8 +371,8 @@ class NativeRpcServer:
         return hits.value, fallbacks.value
 
     # -- dispatch (same semantics as RpcServer._dispatch) -------------------
-    def _handle(self, service_id, method_id, flags, req_ptr, req_len,
-                bulk_ptr, bulk_len, has_bulk,
+    def _handle(self, service_id, method_id, flags, req_msg, req_ptr,
+                req_len, bulk_ptr, bulk_len, has_bulk,
                 out_rsp, out_rsp_len, out_bulk, out_bulk_len,
                 out_msg) -> int:
         try:
@@ -426,18 +428,39 @@ class NativeRpcServer:
                     # service internals (update-queue scheduling, read
                     # gates) see the tag — mirrors RpcServer._dispatch
                     import contextlib
+                    import time as _time
 
+                    from tpu3fs.analytics import spans as _spans
                     from tpu3fs.qos.core import tagged
 
+                    # distributed tracing (mirrors RpcServer._dispatch):
+                    # the peer's context rides the envelope message,
+                    # threaded through the handler ABI (v4) as req_msg
+                    sctx = None
+                    if _spans.tracer().enabled:
+                        in_ctx = _spans.decode_wire(
+                            (req_msg or b"").decode("utf-8", "replace"))
+                        sctx = (in_ctx.child() if in_ctx is not None
+                                else _spans.tracer().start_trace())
+                    t0 = _time.perf_counter()
                     ctx = (tagged(tclass) if tclass is not None
                            else contextlib.nullcontext())
-                    with ctx:
+                    with ctx, _spans.trace_scope(sctx) \
+                            if sctx is not None \
+                            else contextlib.nullcontext():
                         if mdef.bulk:
                             rsp, reply_iovs = mdef.handler(req, bulk)
                         else:
                             rsp = mdef.handler(req)
                             reply_iovs = None
                     raw = serialize(rsp, mdef.rsp_type)
+                    if sctx is not None:
+                        dur = _time.perf_counter() - t0
+                        _spans.tracer().finish_op(
+                            sctx, f"rpc.{service.name}.{mdef.name}",
+                            _time.time() - dur, dur,
+                            tclass=(tclass.name.lower()
+                                    if tclass is not None else ""))
                 except FsError as e:
                     return self._err(out_msg, e.code, e.status.message)
                 except Exception as e:
@@ -593,6 +616,33 @@ class NativeRpcClient:
 
         return class_to_flags(current_class())
 
+    @staticmethod
+    def _trace_hop():
+        """-> (rpc child context | None, envelope message bytes | None):
+        the trace stamping the Python client does in start_call, for the
+        native send entry points (msg rides the same envelope field)."""
+        from tpu3fs.analytics import spans as _spans
+
+        ctx = _spans.current_trace()
+        if ctx is None:
+            return None, None
+        rpc_ctx = ctx.child()
+        return rpc_ctx, rpc_ctx.to_wire().encode()
+
+    @staticmethod
+    def _trace_finish(rpc_ctx, service_id, method_id, t0, status) -> None:
+        if rpc_ctx is None:
+            return
+        import time as _time
+
+        from tpu3fs.analytics import spans as _spans
+
+        dur = _time.perf_counter() - t0
+        _spans.tracer().end_op(
+            rpc_ctx, f"rpc.client.{service_id}.{method_id}",
+            _time.time() - dur, dur,
+            code=status if status != int(Code.OK) else 0)
+
     def call_bulk(
         self,
         addr: Tuple[str, int],
@@ -618,11 +668,15 @@ class NativeRpcClient:
         bulk_len = ctypes.c_size_t(0)
         has_bulk = ctypes.c_int(0)
         msg_ptr = ctypes.c_char_p()
+        rpc_ctx, trace_msg = self._trace_hop()
+        import time as _time
+
+        t0 = _time.perf_counter()
         conn = self._get_conn(addr)
         try:
             rc = self._lib.tpu3fs_rpc_client_call3(
                 conn.handle, service_id, method_id, self._class_flags(),
-                buf, len(raw),
+                trace_msg, buf, len(raw),
                 iov_ptrs, iov_lens, n_iovs,
                 ctypes.byref(status), ctypes.byref(rsp_ptr),
                 ctypes.byref(rsp_len),
@@ -646,6 +700,7 @@ class NativeRpcClient:
             del keepalive
             if conn.lock.locked():
                 conn.lock.release()
+        self._trace_finish(rpc_ctx, service_id, method_id, t0, status.value)
         return self._unmarshal_reply(status, rsp_ptr, rsp_len, bulk_ptr,
                                      bulk_off, bulk_len, has_bulk, msg_ptr,
                                      rsp_type)
@@ -668,11 +723,15 @@ class NativeRpcClient:
         finishing any — the pipelined issue of the striped read fan-out."""
         raw, buf, iov_ptrs, iov_lens, n_iovs, keepalive = \
             self._marshal_req(req, req_type, bulk_iovs)
+        rpc_ctx, trace_msg = self._trace_hop()
+        import time as _time
+
+        t0 = _time.perf_counter()
         conn = self._get_conn(addr)
         try:
             rc = self._lib.tpu3fs_rpc_client_send(
                 conn.handle, service_id, method_id, self._class_flags(),
-                buf, len(raw), iov_ptrs, iov_lens, n_iovs)
+                trace_msg, buf, len(raw), iov_ptrs, iov_lens, n_iovs)
         except BaseException:
             if conn.lock.locked():
                 conn.lock.release()
@@ -690,11 +749,20 @@ class NativeRpcClient:
             # failures to, so retry ladders behave identically
             raise FsError(Status(Code.RPC_PEER_CLOSED,
                                  f"{addr}: transport rc={rc}"))
-        return (addr, conn, rsp_type)
+        if rpc_ctx is not None:
+            from tpu3fs.analytics import spans as _spans
+
+            dur = _time.perf_counter() - t0
+            _spans.add_span(rpc_ctx, "rpc.client", "issue",
+                            _time.time() - dur, dur)
+        return (addr, conn, rsp_type, service_id, method_id, rpc_ctx, t0)
 
     def finish_call(self, pending):
         """Collect the reply of a start_call -> (rsp, segments|None)."""
-        addr, conn, rsp_type = pending
+        addr, conn, rsp_type, service_id, method_id, rpc_ctx, t0 = pending
+        import time as _time
+
+        t1 = _time.perf_counter()
         status = ctypes.c_int64(0)
         rsp_ptr = ctypes.POINTER(ctypes.c_uint8)()
         rsp_len = ctypes.c_size_t(0)
@@ -718,6 +786,16 @@ class NativeRpcClient:
         finally:
             if conn.lock.locked():
                 conn.lock.release()
+        if rpc_ctx is not None:
+            import time as _time
+
+            from tpu3fs.analytics import spans as _spans
+
+            dur = _time.perf_counter() - t1
+            _spans.add_span(rpc_ctx, "rpc.client", "collect",
+                            _time.time() - dur, dur)
+            self._trace_finish(rpc_ctx, service_id, method_id, t0,
+                               status.value)
         return self._unmarshal_reply(status, rsp_ptr, rsp_len, bulk_ptr,
                                      bulk_off, bulk_len, has_bulk, msg_ptr,
                                      rsp_type)

@@ -21,6 +21,7 @@ import uuid as uuid_mod
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
+from tpu3fs.analytics import spans as _spans
 from tpu3fs.rpc.serde import (
     _read_uvarint,
     _write_uvarint,
@@ -401,6 +402,17 @@ class RpcServer:
             if lease is not None:
                 lease.release()
             return self._error_reply(pkt, Code.RPC_BAD_REQUEST, repr(e)), None
+        # distributed tracing: a traced peer stamps its context into the
+        # request envelope's message field (version-tolerant: untraced
+        # servers — and every pre-tracing decoder — parse and ignore it);
+        # with a tracer but no inbound context this server head-samples.
+        # Scoped via ContextVar so service internals (update workers,
+        # chain forwards, pool fan-outs) inherit and extend the trace.
+        sctx = None
+        if _spans.tracer().enabled:
+            in_ctx = _spans.decode_wire(pkt.message) if pkt.message else None
+            sctx = (in_ctx.child() if in_ctx is not None
+                    else _spans.tracer().start_trace())
         ts.server_run_start = time.monotonic()
         reply_iovs = None
         try:
@@ -415,7 +427,8 @@ class RpcServer:
                 tclass = class_from_flags(pkt.flags)
             ctx = (tagged(tclass) if tclass is not None
                    else contextlib.nullcontext())
-            with ctx:
+            with ctx, _spans.trace_scope(sctx) \
+                    if sctx is not None else contextlib.nullcontext():
                 if mdef.bulk:
                     rsp, reply_iovs = mdef.handler(req, bulk)
                 else:
@@ -432,6 +445,9 @@ class RpcServer:
             if lease is not None:
                 lease.release()
         ts.server_run_end = time.monotonic()
+        if sctx is not None:
+            self._trace_dispatch(sctx, service, mdef, ts, status,
+                                 tclass)
         return MessagePacket(
             uuid=pkt.uuid,
             service_id=pkt.service_id,
@@ -442,6 +458,23 @@ class RpcServer:
             message=message,
             timestamps=ts,
         ), reply_iovs
+
+    @staticmethod
+    def _trace_dispatch(sctx, service, mdef, ts: Timestamps, status: int,
+                        tclass) -> None:
+        """Emit the server-side spans of one dispatch: the admission-wait
+        stage (receive -> handler start: queueing + admission + request
+        decode) and the dispatch op span, then flush-or-drop (slow-op
+        capture applies even to unsampled traces)."""
+        dur = ts.server_run_end - ts.server_receive
+        wall_end = time.time()
+        _spans.add_span(
+            sctx, "rpc.server", "admission_wait",
+            wall_end - dur, ts.server_run_start - ts.server_receive)
+        _spans.tracer().finish_op(
+            sctx, f"rpc.{service.name}.{mdef.name}", wall_end - dur, dur,
+            code=status if status != int(Code.OK) else 0,
+            tclass=tclass.name.lower() if tclass is not None else "")
 
     @staticmethod
     def _error_reply(pkt: MessagePacket, code: Code, msg: str) -> MessagePacket:
@@ -577,6 +610,12 @@ class RpcClient:
         while the client is still issuing."""
         from tpu3fs.qos.core import class_to_flags, current_class
 
+        # distributed tracing: the calling context's trace rides the
+        # request envelope's message field — a child span id per wire hop
+        # so server spans nest under this rpc. Untraced calls pay one
+        # ContextVar read and nothing else.
+        tctx = _spans.current_trace()
+        rpc_ctx = tctx.child() if tctx is not None else None
         pkt = MessagePacket(
             uuid=uuid_mod.uuid4().hex,
             service_id=service_id,
@@ -587,6 +626,7 @@ class RpcClient:
             flags=FLAG_IS_REQ | class_to_flags(current_class()),
             status=int(Code.OK),
             payload=serialize(req, req_type or type(req)),
+            message=rpc_ctx.to_wire() if rpc_ctx is not None else "",
         )
         pkt.timestamps.client_build = time.monotonic()
         conn = self._get_conn(addr)
@@ -611,11 +651,21 @@ class RpcClient:
             code = (Code.RPC_TIMEOUT if isinstance(e, socket.timeout)
                     else Code.RPC_PEER_CLOSED)
             raise FsError(Status(code, f"{addr}: {e}"))
-        return (addr, conn, pkt, rsp_type)
+        if rpc_ctx is not None:
+            # "issue" = serialize + put-on-wire; for MiB-scale bulk frames
+            # the blocking send carries most of the wire transfer time, so
+            # issue + server stages partition the client-observed latency
+            dur = time.monotonic() - pkt.timestamps.client_build
+            _spans.add_span(
+                rpc_ctx, "rpc.client", "issue", time.time() - dur, dur,
+                nbytes=(sum(len(b) for b in bulk_iovs)
+                        if bulk_iovs else len(pkt.payload)))
+        return (addr, conn, pkt, rsp_type, rpc_ctx)
 
     def finish_call(self, pending):
         """Collect the reply of a start_call -> (rsp, reply_segments|None)."""
-        addr, conn, pkt, rsp_type = pending
+        addr, conn, pkt, rsp_type, rpc_ctx = pending
+        t0 = time.monotonic()
         try:
             try:
                 reply, reply_bulk = _recv_packet(conn.sock)
@@ -634,6 +684,29 @@ class RpcClient:
         finally:
             if conn.lock.locked():
                 conn.lock.release()
+        if rpc_ctx is not None:
+            now = time.monotonic()
+            total = now - pkt.timestamps.client_build
+            _spans.add_span(rpc_ctx, "rpc.client", "collect",
+                            time.time() - (now - t0), now - t0)
+            rts = reply.timestamps
+            if rts.server_run_end >= rts.server_receive > 0:
+                # "wire" = the collect wait MINUS the server's
+                # receive->run_end window (which the server's own spans
+                # attribute): frame receive on the server, reply
+                # serialize/send/receive/decode — the residue that would
+                # otherwise be invisible in the stage breakdown. The two
+                # server stamps share the server's monotonic clock, so
+                # their difference is valid cross-process.
+                wire = (now - t0) - (rts.server_run_end
+                                     - rts.server_receive)
+                if wire > 0:
+                    _spans.add_span(rpc_ctx, "rpc.client", "wire",
+                                    time.time() - (now - t0), wire)
+            _spans.tracer().end_op(
+                rpc_ctx, f"rpc.client.{pkt.service_id}.{pkt.method_id}",
+                time.time() - total, total,
+                code=reply.status if reply.status != int(Code.OK) else 0)
         if reply.status != int(Code.OK):
             raise FsError(Status(Code(reply.status), reply.message))
         reply.timestamps.client_done = time.monotonic()

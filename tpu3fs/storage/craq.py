@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
+from tpu3fs.analytics import spans as _spans
 from tpu3fs.mgmtd.types import ChainInfo, PublicTargetState, RoutingInfo
 from tpu3fs.storage.target import StorageTarget
 from tpu3fs.storage.types import Checksum, ChunkId, ChunkMeta, SpaceInfo
@@ -227,10 +228,16 @@ class _OverlapForward:
         self._result = None
         self._needs_sequential = False
         self._error: Optional[BaseException] = None
+        # the helper thread runs inside a snapshot of the spawning
+        # context: QoS class AND trace context follow the forward onto
+        # the wire (plain threads don't inherit ContextVars)
+        import contextvars
+
+        ctx = contextvars.copy_context()
 
         def _run():
             try:
-                self._result = fn()
+                self._result = ctx.run(fn)
             except _SyncReplaceNeeded:
                 self._needs_sequential = True
             except BaseException as e:  # surface on the joining thread
@@ -899,6 +906,10 @@ class StorageService:
                     overlap = _OverlapForward(
                         lambda: self._forward(target, req, update_ver,
                                               chain, sync_replace_ok=False))
+                # per-op stage timings for the trace (None = untraced:
+                # no clock reads beyond what the op pays anyway)
+                tctx = _spans.current_trace()
+                t_st = time.perf_counter() if tctx is not None else 0.0
                 # stage pending version (COW)
                 try:
                     staged = engine.update(
@@ -933,6 +944,12 @@ class StorageService:
                             checksum=cur.checksum if cur else Checksum(),
                         )
                     return UpdateReply(e.code, message=e.status.message)
+                if tctx is not None:
+                    now = time.perf_counter()
+                    _spans.add_span(tctx, "storage.update", "stage",
+                                    time.time() - (now - t_st), now - t_st,
+                                    nbytes=len(req.data))
+                    t_st = now
                 if overlap is not None:
                     fwd, needs_seq = overlap.join()
                     if needs_seq:  # successor went SYNCING: re-forward now
@@ -943,6 +960,12 @@ class StorageService:
                         owned=self._owned_forward(
                             engine, req, update_ver, staged) if inproc
                         else None)
+                if tctx is not None and self._successor_of(
+                        target, chain) is not None:
+                    now = time.perf_counter()
+                    _spans.add_span(tctx, "storage.update", "forward",
+                                    time.time() - (now - t_st), now - t_st)
+                    t_st = now
                 if req.full_replace:
                     # recovery write: installed as committed already; still
                     # forward if a successor exists in the writer chain
@@ -971,6 +994,10 @@ class StorageService:
                         )
                 # suffix acked (or we are tail): commit (ref doCommit :611-631)
                 meta = engine.commit(req.chunk_id, update_ver, chain_ver)
+                if tctx is not None:
+                    now = time.perf_counter()
+                    _spans.add_span(tctx, "storage.update", "commit",
+                                    time.time() - (now - t_st), now - t_st)
                 return UpdateReply(
                     Code.OK,
                     update_ver=update_ver,
@@ -1668,6 +1695,7 @@ class StorageService:
         finally:
             for key in reversed(keys):
                 self._locks.release(key)
+            wall_s = time.perf_counter() - t_wall
             with self._wp_lock:
                 if reqs and reqs[0].from_target == 0:
                     role = "head"  # single-target chains: head IS the tail
@@ -1677,9 +1705,27 @@ class StorageService:
                 wp["stage_s"] += dt_stage
                 wp["forward_s"] += dt_forward
                 wp["commit_s"] += dt_commit
-                wp["wall_s"] += time.perf_counter() - t_wall
+                wp["wall_s"] += wall_s
                 wp["ops"] += n
                 wp["bytes"] += sum(len(r.data) for r in reqs)  # copy-ok: integer counter, not payload
+            # trace stage spans: the stage/forward/commit walls this round
+            # already measured, fanned out to every trace the round serves
+            # (the update worker's round scope). With the overlapped
+            # forward, "forward" records only the EXPOSED wait.
+            tctxs = _spans.round_traces()
+            if tctxs:
+                t0_wall = time.time() - wall_s
+                nbytes = sum(len(r.data) for r in reqs)  # copy-ok: counter
+                _spans.add_span_multi(tctxs, "storage.update", "stage",
+                                      t0_wall, dt_stage, nbytes=nbytes)
+                if forwarded:
+                    _spans.add_span_multi(
+                        tctxs, "storage.update", "forward",
+                        t0_wall + dt_stage, dt_forward, nbytes=nbytes)
+                if dt_commit:
+                    _spans.add_span_multi(
+                        tctxs, "storage.update", "commit",
+                        t0_wall + dt_stage + dt_forward, dt_commit)
         return replies
 
     def _forward_batch(

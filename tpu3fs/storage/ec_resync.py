@@ -187,9 +187,15 @@ class EcResyncWorker:
         t0 = _time.monotonic()
         for base in range(0, len(todo), self._batch):
             batch = todo[base : base + self._batch]
-            ok, bad = self._rebuild_batch(
-                routing, chain, batch, lost_shard, node.node_id, target_id,
-                required)
+            # each rebuild batch is a traceable op: head-sampled like any
+            # client op, its recovery reads/installs carry the context
+            # over the batchReadRebuild / batch_write_shard RPCs
+            from tpu3fs.analytics import spans as _spans
+
+            with _spans.root_span("ec.rebuild_batch"):
+                ok, bad = self._rebuild_batch(
+                    routing, chain, batch, lost_shard, node.node_id,
+                    target_id, required)
             moved += ok
             failed += bad
         dt = _time.monotonic() - t0

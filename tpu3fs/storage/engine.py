@@ -315,6 +315,11 @@ class _Arena:
     _pool: List = []          # process-global warm extents
     _pool_lock = threading.Lock()
     _pool_prefaulted = False
+    # process-wide arena accounting for the memory-observability gauges
+    # (mem.arena_* via monitor/memory.py): extents ever materialized and
+    # extent draws satisfied by recycling instead of fresh allocation
+    _created_extents = 0
+    _recycled_extents = 0
 
     @classmethod
     def _pool_cap_bytes(cls) -> int:
@@ -342,18 +347,24 @@ class _Arena:
             self._prefault_pool(prefault_bytes)
 
     def _next_extent(self):
+        cls = type(self)
         with self._pool_lock:
-            pool = type(self)._pool
+            pool = cls._pool
             for i in range(len(pool)):
                 # list slot + getrefcount argument == 2: no content view
                 # (buffer export) pins this extent anymore. NOTE: indexed
                 # access on purpose — a `for ... in enumerate(...)` loop
                 # binding holds a third reference and defeats the gate.
                 if sys.getrefcount(pool[i]) == 2:
+                    cls._recycled_extents += 1
                     return pool.pop(i)
         for i in range(len(self._retired)):
             if sys.getrefcount(self._retired[i]) == 2:
+                with self._pool_lock:
+                    cls._recycled_extents += 1
                 return self._retired.pop(i)
+        with self._pool_lock:
+            cls._created_extents += 1
         return np.empty(self._extent_bytes, dtype=np.uint8)
 
     def close(self) -> None:
@@ -376,6 +387,19 @@ class _Arena:
                 type(self)._pool.append(ext)
                 budget -= self._extent_bytes
 
+    @classmethod
+    def stats(cls) -> dict:
+        """Process-wide arena accounting for the mem.arena_* gauges:
+        resident = extents ever materialized (they live in arenas or the
+        warm pool until their last content view dies), recycled =
+        cumulative draws served warm instead of via fresh allocation."""
+        with cls._pool_lock:
+            return {
+                "resident_bytes": cls._created_extents * cls._EXTENT_BYTES,
+                "recycled_bytes": cls._recycled_extents * cls._EXTENT_BYTES,
+                "pool_extents": len(cls._pool),
+            }
+
     def alloc(self, n: int) -> Optional[memoryview]:
         """A writable n-byte view of warm arena memory, or None when n
         doesn't fit an extent (caller falls back to a plain bytes copy)."""
@@ -389,6 +413,11 @@ class _Arena:
         off = self._off
         self._off = off + n
         return memoryview(self._cur)[off:off + n]
+
+
+def arena_stats() -> dict:
+    """Public accessor for the content-arena gauges (monitor/memory.py)."""
+    return _Arena.stats()
 
 
 class MemChunkEngine(ChunkEngine):

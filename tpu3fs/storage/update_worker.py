@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from tpu3fs.analytics import spans as _spans
 from tpu3fs.qos.core import TrafficClass, format_retry_after
 from tpu3fs.qos.scheduler import WeightedFairQueue, WfqPolicy
 from tpu3fs.utils.result import Code
@@ -48,7 +49,7 @@ from tpu3fs.utils.result import Code
 
 class _Job:
     __slots__ = ("reqs", "replies", "done", "make_reply", "tclass",
-                 "cost", "enq_ts")
+                 "cost", "enq_ts", "sub_ts", "trace")
 
     def __init__(self, reqs, make_reply, tclass):
         self.reqs = reqs
@@ -56,6 +57,11 @@ class _Job:
         self.tclass = tclass
         self.cost = max(1, len(reqs))
         self.enq_ts = 0.0
+        # submit time + the submitter's trace context: the queue-wait
+        # stage span (time between submit and the round starting) is
+        # attributed to the trace that experienced it
+        self.sub_ts = time.monotonic()
+        self.trace = _spans.current_trace()
         self.replies: Optional[list] = None
         self.done = threading.Event()
 
@@ -224,9 +230,22 @@ class UpdateWorker:
         worker thread OR inline on a submitting thread (never both at
         once: _active guards)."""
         reqs = [r for j in round_jobs for r in j.reqs]
+        # trace plumbing: per-job queue-wait stage spans, then the round
+        # executes under a round scope so the runner's stage/forward/
+        # commit spans fan out to EVERY trace the round coalesced (and
+        # chain-forward RPCs propagate the first)
+        traces = []
+        now_m = time.monotonic()
+        for j in round_jobs:
+            if j.trace is not None:
+                traces.append(j.trace)
+                wait = max(0.0, now_m - j.sub_ts)
+                _spans.add_span(j.trace, "storage.update", "queue_wait",
+                                time.time() - wait, wait)
         err = None
         try:
-            outs = self._runner(reqs)
+            with _spans.round_scope(traces):
+                outs = self._runner(reqs)
         except Exception as e:  # runner bug: report, don't wedge
             import logging
 

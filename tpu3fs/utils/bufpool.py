@@ -55,6 +55,12 @@ class BufferPool:
         self._max_class_bytes = max_class_bytes
         self.hits = 0
         self.misses = 0
+        # lease accounting for the mem.bufpool_* gauges: acquires minus
+        # releases. Detached bulk frames are never release()d by design
+        # (their memoryviews own the buffer, GC reclaims), so outstanding
+        # counts them until collected — a leak DETECTOR, not a leak.
+        self.acquired = 0
+        self.released = 0
 
     def acquire(self, n: int):
         """A writable buffer of len >= n (callers track their own exact
@@ -64,9 +70,11 @@ class BufferPool:
         if cls > self._max_class_bytes:
             with self._mu:
                 self.misses += 1
+                self.acquired += 1
             return _alloc(n)
         with self._mu:
             free = self._free.get(cls)
+            self.acquired += 1
             if free:
                 self.hits += 1
                 return free.pop()
@@ -75,6 +83,8 @@ class BufferPool:
 
     def release(self, buf) -> None:
         """Return a lease. ONLY for buffers with no escaped memoryviews."""
+        with self._mu:
+            self.released += 1
         cls = len(buf)
         # non-class-sized buffers were allocated fresh (oversize path)
         if cls > self._max_class_bytes or cls & (cls - 1):
@@ -89,6 +99,7 @@ class BufferPool:
             return {
                 "hits": self.hits,
                 "misses": self.misses,
+                "outstanding": self.acquired - self.released,
                 "pooled_bytes": sum(
                     cls * len(v) for cls, v in self._free.items()),
             }
