@@ -1,0 +1,151 @@
+"""tail_bench: read p99 under a gray (straggling) replica, hedging +
+health demotion ON vs OFF — the A/B lever of the robustness PR.
+
+Shape: a 3-node fabric, one 3-replica chain, N chunks written; the
+cluster fault plane injects a ``delay_ms`` rule on ONE node's
+``storage.read`` point (a slow-but-alive replica — exactly what the
+mgmtd heartbeat checker can NOT see). A foreground client then issues
+single-chunk reads with LOAD_BALANCE selection:
+
+- OFF (``hedge_reads=False, health_reorder=False``): ~1/3 of reads land
+  on the straggler and eat the full injected delay — read p99 ≈ the
+  straggle.
+- ON: the first slow observation marks the node a latency outlier
+  (rpc/health.py suspect), demoting it to the END of replica order, and
+  the transition reads are rescued by hedges (client/hedging.py) that
+  arm after max(floor, 3x EWMA) — p99 collapses to the hedge delay +
+  fast-replica service time, with hedge extra load bounded by the token
+  budget.
+
+Prints ONE JSON line (bench.py conventions):
+  {"metric": "gray_read_p99_speedup", "value": <off p99 / on p99>,
+   "p99_off_ms": ..., "p99_on_ms": ..., "hedge": {...}, ...}
+
+Acceptance (BENCH_TAIL.json): speedup >= 5 with a 100ms straggler, and
+hedge extra-load ratio <= the configured budget (+burst amortized).
+
+Usage: python -m benchmarks.tail_bench [--reads 400] [--straggle-ms 100]
+           [--json-out BENCH_TAIL.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import List
+
+from tpu3fs.client.storage_client import RetryOptions
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils.fault_injection import plane
+
+CHUNK_SIZE = 1 << 16
+CHUNKS = 8
+
+
+def _pct(xs: List[float], p: float) -> float:
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+
+def drive(*, defenses_on: bool, reads: int, straggle_ms: float,
+          seed: int) -> dict:
+    fab = Fabric(SystemSetupConfig(
+        num_storage_nodes=3, num_replicas=3, num_chains=1,
+        chunk_size=CHUNK_SIZE))
+    try:
+        retry = RetryOptions(
+            hedge_reads=defenses_on,
+            health_reorder=defenses_on,
+            hedge_delay_floor_ms=5.0,
+            hedge_budget_ratio=0.05,
+            hedge_budget_burst=16.0,
+        )
+        sc = fab.storage_client(retry=retry, seed=seed)
+        cid = fab.chain_ids[0]
+        payload = b"\xa5" * (CHUNK_SIZE // 2)
+        for i in range(CHUNKS):
+            assert sc.write_chunk(cid, ChunkId(1, i), 0, payload,
+                                  chunk_size=CHUNK_SIZE).ok
+        # make ONE replica node gray: every read it serves straggles
+        routing = fab.routing()
+        chain = routing.chains[cid]
+        gray_node = routing.node_of_target(
+            chain.targets[0].target_id).node_id
+        plane().configure(
+            f"point=storage.read,kind=delay_ms,arg={straggle_ms},"
+            f"node={gray_node}", seed=seed)
+        lat_ms: List[float] = []
+        t_bench = time.monotonic()
+        for i in range(reads):
+            ck = ChunkId(1, i % CHUNKS)
+            t0 = time.monotonic()
+            r = sc.read_chunk(cid, ck, 0, -1)
+            lat_ms.append((time.monotonic() - t0) * 1000.0)
+            assert r.ok, r.code
+        wall_s = time.monotonic() - t_bench
+        out = {
+            "p50_ms": round(_pct(lat_ms, 0.50), 3),
+            "p90_ms": round(_pct(lat_ms, 0.90), 3),
+            "p99_ms": round(_pct(lat_ms, 0.99), 3),
+            "max_ms": round(max(lat_ms), 3),
+            "mean_ms": round(sum(lat_ms) / len(lat_ms), 3),
+            "reads": reads,
+            "wall_s": round(wall_s, 3),
+            "hedge": sc._hedge.stats(),
+            "health": {str(k): v
+                       for k, v in sc._health.snapshot().items()},
+        }
+        return out
+    finally:
+        plane().clear()
+        fab.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reads", type=int, default=400)
+    ap.add_argument("--straggle-ms", type=float, default=100.0)
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--json-out", default="")
+    args = ap.parse_args()
+
+    off = drive(defenses_on=False, reads=args.reads,
+                straggle_ms=args.straggle_ms, seed=args.seed)
+    on = drive(defenses_on=True, reads=args.reads,
+               straggle_ms=args.straggle_ms, seed=args.seed)
+    hedge = on["hedge"]
+    # the budget bound: steady-state extra load <= ratio, plus the burst
+    # the bucket legitimately started with, amortized over the run
+    budget_bound = 0.05 + 16.0 / max(1, hedge["primaries"])
+    record = {
+        "metric": "gray_read_p99_speedup",
+        "value": round(off["p99_ms"] / max(on["p99_ms"], 1e-9), 2),
+        "straggle_ms": args.straggle_ms,
+        "p99_off_ms": off["p99_ms"],
+        "p99_on_ms": on["p99_ms"],
+        "p50_off_ms": off["p50_ms"],
+        "p50_on_ms": on["p50_ms"],
+        "mean_off_ms": off["mean_ms"],
+        "mean_on_ms": on["mean_ms"],
+        "hedge": hedge,
+        "hedge_extra_load_ratio": hedge["extra_load_ratio"],
+        "hedge_budget_bound": round(budget_bound, 4),
+        "budget_respected": hedge["extra_load_ratio"] <= budget_bound,
+        "off": off,
+        "on": on,
+    }
+    print(json.dumps(record))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    ok = record["value"] >= 5.0 and record["budget_respected"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    raise SystemExit(main())

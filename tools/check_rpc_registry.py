@@ -21,6 +21,11 @@ and verifies, per deployment unit:
    round trip within the 4-bit wire field, and membership sets that stay
    inside the enum. Adding an enum value without the config/flag wiring
    fails here, not at 3am under load.
+5. IDEMPOTENCY / HEDGE SAFETY — every bound method has a classification
+   in ``tpu3fs/rpc/idempotency.py`` (no stale rows either), and every
+   messenger method the hedged-read client may back up with a second
+   replica request resolves to a method classified IDEMPOTENT. Hedging
+   can never silently grow onto a mutating RPC.
 
 Cross-binary service-id reuse (Kv and MonitorCollector both use 5) is
 reported as a note, not a failure — they never share a process.
@@ -249,6 +254,45 @@ def check_traffic_classes() -> List[str]:
     return errors
 
 
+# -- idempotency / hedge safety ----------------------------------------------
+
+def check_idempotency(registries: List[_Registry]) -> List[str]:
+    """Every bound method classified; hedge targets idempotent (check 5)."""
+    from tpu3fs.rpc.idempotency import (
+        CLASSIFICATION,
+        HEDGE_SAFE_MESSENGER_METHODS,
+        IDEMPOTENT,
+        classify,
+    )
+
+    errors: List[str] = []
+    bound = set()
+    for reg in registries:
+        for service in reg.services.values():
+            for m in service.methods.values():
+                bound.add((service.name, m.name))
+    for svc, name in sorted(bound):
+        if classify(svc, name) is None:
+            errors.append(
+                f"{svc}.{name}: no idempotency/hedge-safety "
+                "classification (add to tpu3fs/rpc/idempotency.py)")
+    for svc, name in sorted(set(CLASSIFICATION) - bound):
+        errors.append(
+            f"idempotency table lists {svc}.{name} but no binary binds "
+            "it (stale row)")
+    for mname, key in sorted(HEDGE_SAFE_MESSENGER_METHODS.items()):
+        if key not in bound:
+            errors.append(
+                f"hedge-eligible messenger method {mname!r} resolves to "
+                f"unbound {key[0]}.{key[1]}")
+        if CLASSIFICATION.get(key) != IDEMPOTENT:
+            errors.append(
+                f"hedge-eligible messenger method {mname!r} resolves to "
+                f"{key[0]}.{key[1]}, which is NOT classified idempotent "
+                "— hedging a mutating RPC double-applies it")
+    return errors
+
+
 # -- driver ------------------------------------------------------------------
 
 def run_checks() -> Tuple[List[str], List[str]]:
@@ -260,6 +304,7 @@ def run_checks() -> Tuple[List[str], List[str]]:
         registries = _bind_all()
     except ValueError as e:  # duplicate service/method id at bind time
         return errors + [str(e)], []
+    errors.extend(check_idempotency(registries))
 
     # cross-binary id reuse (informational)
     by_id: Dict[int, set] = {}

@@ -154,6 +154,7 @@ class ApplicationBase:
             self.server = RpcServer(self.info.hostname, port)
         self.info.port = self.server.port
         self._init_qos()
+        self._init_fault_plane()
         bind_core_service(self.server, config=self.config,
                           on_shutdown=self.stop)
         self.build_services(self.server)
@@ -181,6 +182,20 @@ class ApplicationBase:
         (storage: the QoS manager shares the controller, so RPC-level
         charging would double-count)."""
         return set()
+
+    def _init_fault_plane(self) -> None:
+        """Bind the process-global cluster fault plane to the binary's
+        ``faults`` config section when it declares one: a mgmtd config
+        push of ``[faults] spec=...`` then arms/retunes/clears injected
+        faults live (utils/fault_injection.py; admin_cli fault verbs)."""
+        from tpu3fs.utils.fault_injection import (
+            FaultPlaneConfig,
+            apply_plane_config,
+        )
+
+        fcfg = getattr(self.config, "faults", None)
+        if isinstance(fcfg, FaultPlaneConfig):
+            apply_plane_config(fcfg)
 
     def start_server(self) -> None:
         assert self.server is not None
@@ -438,6 +453,18 @@ class TwoPhaseApplication(ApplicationBase):
             self._last_mgmtd_contact = time.time()
             self._hb_fail_start = None
             self._apply_config_push(reply.config_version, reply.config_content)
+            # PROMPT routing convergence: the heartbeat reply carries the
+            # primary's routing version — when it is ahead of our cached
+            # snapshot (e.g. a target was just demoted OFFLINE), expire
+            # the TTL cache and refresh NOW instead of serving the stale
+            # snapshot for up to a full TTL window
+            known = self.mgmtd_client.known_routing_version()
+            if 0 <= known < reply.routing_version:
+                self.mgmtd_client.invalidate_routing()
+                try:
+                    self.mgmtd_client.refresh_routing()
+                except Exception:
+                    pass  # the next data-plane resolve retries
             return True
         except Exception as e:
             xlog("WARN", "node %d heartbeat failed: %r", self.info.node_id, e)

@@ -23,11 +23,15 @@ from tpu3fs.rpc.services import RpcMessenger, bind_meta_service
 from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.qos.core import QosConfig
+from tpu3fs.utils.fault_injection import FaultPlaneConfig
 
 
 class MetaAppConfig(Config):
     # QoS admission limits for the meta RPC dispatch (tpu3fs/qos)
     qos = QosConfig
+    # cluster fault plane (utils/fault_injection.py): hot-pushed
+    # fault rules for chaos drives / gray-failure testing
+    faults = FaultPlaneConfig
     # observability: distributed tracing + monitor sample push
     # (tpu3fs/analytics/spans.py; both hot-configured)
     trace = TraceConfig

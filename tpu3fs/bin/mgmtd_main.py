@@ -21,11 +21,15 @@ from tpu3fs.rpc.services import bind_mgmtd_admin, bind_mgmtd_service
 from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.qos.core import QosConfig
+from tpu3fs.utils.fault_injection import FaultPlaneConfig
 
 
 class MgmtdAppConfig(Config):
     # QoS admission limits for the mgmtd RPC dispatch (tpu3fs/qos)
     qos = QosConfig
+    # cluster fault plane (utils/fault_injection.py): hot-pushed
+    # fault rules for chaos drives / gray-failure testing
+    faults = FaultPlaneConfig
     # observability: distributed tracing + monitor sample push
     # (tpu3fs/analytics/spans.py; both hot-configured)
     trace = TraceConfig
@@ -64,6 +68,19 @@ class MgmtdApp(OnePhaseApplication):
         )
         self.mgmtd = Mgmtd(self.info.node_id or 1, self.engine, cfg,
                            clock=self._clock_override or _time.time)
+
+        # HOT-configurable failure detection: a hotUpdateConfig push of
+        # lease_length_s / heartbeat_timeout_s retunes the LIVE Mgmtd
+        # (check cadence is already hot via the callable tick interval) —
+        # an operator can shorten the gray-node declaration window
+        # without restarting the cluster manager
+        def _sync_mgmtd_config(_node=None) -> None:
+            self.mgmtd.config.lease_length_s = float(
+                self.config.get("lease_length_s"))
+            self.mgmtd.config.heartbeat_timeout_s = float(
+                self.config.get("heartbeat_timeout_s"))
+
+        self.config.add_callback(_sync_mgmtd_config)
         svc = bind_mgmtd_service(server, self.mgmtd)
         bind_mgmtd_admin(svc, self.mgmtd)
 

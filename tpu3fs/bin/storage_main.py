@@ -20,6 +20,7 @@ from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.app.application import TwoPhaseApplication
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType
 from tpu3fs.qos.core import QosConfig
+from tpu3fs.utils.fault_injection import FaultPlaneConfig
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import RpcMessenger, bind_storage_service
 from tpu3fs.storage.craq import StorageService
@@ -56,6 +57,9 @@ class StorageAppConfig(Config):
     # QoS: per-class admission/scheduling limits (tpu3fs/qos) — every
     # item hot-updates via mgmtd config push without restart
     qos = QosConfig
+    # cluster fault plane (utils/fault_injection.py): hot-pushed
+    # fault rules for chaos drives / gray-failure testing
+    faults = FaultPlaneConfig
     # distributed request tracing (tpu3fs/analytics/spans.py) + monitor
     # sample push to monitor_collector — both hot-configured
     trace = TraceConfig

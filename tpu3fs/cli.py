@@ -629,6 +629,98 @@ class AdminCli:
                         f"  degraded files: {shown}{more}")
         return "\n".join(lines) if lines else "no EC chains"
 
+    # -- cluster fault plane (utils/fault_injection.py) ----------------------
+    @staticmethod
+    def _merge_faults_toml(content: str, spec: str, seed: int) -> str:
+        """Merge a [faults] section into an existing pushed-config blob
+        (set_config replaces the whole blob; operators must not lose the
+        qos/trace sections they pushed earlier)."""
+        from tpu3fs.utils.config import tomllib
+
+        data = tomllib.loads(content) if content else {}
+        data.setdefault("faults", {})
+        data["faults"]["spec"] = spec
+        data["faults"]["seed"] = seed
+
+        def render(d: dict, prefix: str = "") -> List[str]:
+            lines = []
+            for k in sorted(d):
+                v = d[k]
+                if isinstance(v, dict):
+                    continue
+                if isinstance(v, bool):
+                    lines.append(f"{k} = {'true' if v else 'false'}")
+                elif isinstance(v, (int, float)):
+                    lines.append(f"{k} = {v!r}")
+                else:
+                    s = str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    lines.append(f'{k} = "{s}"')
+            for k in sorted(d):
+                v = d[k]
+                if isinstance(v, dict):
+                    lines.append("")
+                    lines.append(f"[{prefix}{k}]")
+                    lines.extend(render(v, f"{prefix}{k}."))
+            return lines
+
+        return "\n".join(render(data)).strip() + "\n"
+
+    def cmd_fault(self, args: List[str]) -> str:
+        """Cluster fault plane (gray-failure chaos tooling):
+        fault set --spec "point=...,kind=...,..." [--seed N]
+                  [--node-type storage] — merge a [faults] section into
+                  the node type's pushed config (heartbeats deliver it,
+                  every node of that type arms the rules live)
+        fault clear [--node-type storage] — push an empty spec
+        fault show [--node-type storage] — pushed spec + local plane
+        fault local --spec ... [--seed N] — arm THIS process's plane"""
+        from tpu3fs.utils.fault_injection import parse_spec, plane
+
+        if not args:
+            return "usage: fault set|clear|show|local ..."
+        sub, rest = args[0], args[1:]
+        if sub == "local":
+            spec = self._flag(rest, "--spec", "")
+            seed = int(self._flag(rest, "--seed", 0))
+            plane().configure(spec, seed)
+            return (f"local fault plane: {len(plane().snapshot())} rule(s) "
+                    f"armed")
+        if sub == "show":
+            lines = []
+            for r in plane().snapshot():
+                lines.append(f"local rule: {r}")
+            lines.append(f"local fired total: {plane().fired_total}")
+            nt = self._node_type_flag(rest)
+            try:
+                blob = self.fab.mgmtd.get_config(nt)
+            except (FsError, AttributeError):
+                blob = None
+            if blob is not None and blob.content:
+                import re as _re
+
+                m = _re.search(r'^spec\s*=\s*"(.*)"$', blob.content,
+                               _re.MULTILINE)
+                lines.append(f"pushed {nt.name} config v{blob.version} "
+                             f"spec: {m.group(1) if m else '(none)'}")
+            return "\n".join(lines)
+        if sub in ("set", "clear"):
+            spec = "" if sub == "clear" else self._flag(rest, "--spec", "")
+            seed = int(self._flag(rest, "--seed", 0))
+            rules = parse_spec(spec)  # validate BEFORE pushing
+            nt = self._node_type_flag(rest)
+            blob = self.fab.mgmtd.get_config(nt)
+            content = self._merge_faults_toml(blob.content, spec, seed)
+            ver = self.fab.mgmtd.set_config(nt, content)
+            return (f"pushed {len(rules)} fault rule(s) to {nt.name} "
+                    f"config v{ver} (heartbeats deliver within one "
+                    f"interval)")
+        return "usage: fault set|clear|show|local ..."
+
+    def _node_type_flag(self, args: List[str]):
+        from tpu3fs.mgmtd.types import NodeType
+
+        return NodeType[self._flag(args, "--node-type", "storage").upper()]
+
     # -- FS shell ------------------------------------------------------------
     def cmd_ls(self, args: List[str]) -> str:
         path = args[0] if args else "/"

@@ -93,9 +93,28 @@ class UpdateChannelAllocator:
 
 @dataclass
 class RetryOptions:
+    """Retry / gray-failure defense knobs (docs/robustness.md)."""
+
     max_retries: int = 8
     backoff_base_s: float = 0.002
     backoff_max_s: float = 0.25
+    # default per-op deadline budget armed at every public entry when the
+    # caller has no ambient deadline; 0 = none. The ABSOLUTE deadline
+    # rides every RPC envelope (rpc/deadline.py): servers shed expired
+    # work, _sleep never sleeps past it, ladders stop at it.
+    op_deadline_s: float = 0.0
+    # hedged reads (client/hedging.py): arm a backup read to the next
+    # replica after delay = max(floor, factor x per-peer latency EWMA);
+    # hedges spend a token budget earning budget_ratio per primary, so
+    # extra load stays <= ~budget_ratio
+    hedge_reads: bool = True
+    hedge_delay_floor_ms: float = 5.0
+    hedge_delay_factor: float = 3.0
+    hedge_budget_ratio: float = 0.05
+    hedge_budget_burst: float = 16.0
+    # per-peer health (rpc/health.py): demote suspect (breaker-open or
+    # latency-outlier) nodes to the END of read replica order
+    health_reorder: bool = True
 
 
 class StorageClient:
@@ -140,6 +159,24 @@ class StorageClient:
         self._ec_parity_rmw = CounterRecorder("ec.parity_rmw")
         self._ec_rmw_fallback = CounterRecorder("ec.parity_rmw_fallback")
         self._ec_encode_gibps = ValueRecorder("ec.encode_gibps")
+        # gray-failure defenses (docs/robustness.md): per-peer health —
+        # the socket messenger shares its registry (its breaker also
+        # fail-fasts writes); in-process messengers get a client-local one
+        # fed by the timed reads below — plus the hedged-read controller
+        # riding the same latency EWMAs
+        from tpu3fs.client.hedging import HedgeController
+        from tpu3fs.rpc.health import HealthRegistry
+
+        self._health = getattr(messenger, "health", None)
+        if self._health is None:
+            self._health = HealthRegistry()
+        r = self._retry
+        self._hedge = HedgeController(
+            budget_ratio=r.hedge_budget_ratio,
+            burst=r.hedge_budget_burst,
+            delay_floor_ms=r.hedge_delay_floor_ms,
+            delay_factor=r.hedge_delay_factor,
+            health=self._health)
 
     def close(self) -> None:
         """Release the fan-out pool's worker threads. Explicit close is
@@ -213,21 +250,50 @@ class StorageClient:
             int.from_bytes(os.urandom(4), "big")
 
     def _sleep(self, attempt: int, hint_ms: int = 0) -> None:
-        """Jittered backoff. A server retry-after hint (an OVERLOADED
-        shed, qos/core.py) REPLACES the exponential guess: the server
-        knows its own refill horizon, so the client waits exactly that
-        (jittered to decorrelate a herd of shed clients) instead of
-        hammering blind."""
+        """Backoff with FULL jitter: uniform(0, cap) where cap doubles per
+        attempt — decorrelates a retry herd better than the old
+        half-jitter (which never slept below cap/2, so herds re-collided
+        at cap-ish). A server retry-after hint (an OVERLOADED shed,
+        qos/core.py) REPLACES the exponential guess: the server knows its
+        own refill horizon, so the client waits ~that (still jittered).
+        NEVER sleeps past the ambient deadline — the remaining budget
+        caps every delay (regression-tested in test_robustness)."""
+        from tpu3fs.rpc import deadline as _dl
+
         # a retry is about to re-resolve routing: a TTL-cached provider
         # must poll fresh (the chain may have moved under us)
         self._routing_invalidate()
         if hint_ms > 0:
-            delay = min(self._retry.backoff_max_s * 4, hint_ms / 1000.0)
+            cap = min(self._retry.backoff_max_s * 4, hint_ms / 1000.0)
+            delay = cap * (0.5 + self._rng.random() / 2)
         else:
-            delay = min(
+            cap = min(
                 self._retry.backoff_max_s,
                 self._retry.backoff_base_s * (2 ** attempt))
-        time.sleep(delay * (0.5 + self._rng.random() / 2))
+            delay = cap * self._rng.random()
+        left = _dl.remaining()
+        if left is not None:
+            delay = min(delay, max(0.0, left))
+        if delay > 0:
+            time.sleep(delay)
+
+    def _op_scope(self):
+        """Deadline scope for one public client op: the ambient deadline
+        when the caller armed one, else RetryOptions.op_deadline_s (0 =
+        none). The absolute deadline then rides every RPC this op issues."""
+        import contextlib
+
+        from tpu3fs.rpc import deadline as _dl
+
+        if self._retry.op_deadline_s > 0 and _dl.current_deadline() is None:
+            return _dl.deadline_after(self._retry.op_deadline_s)
+        return contextlib.nullcontext()
+
+    @staticmethod
+    def _deadline_expired() -> bool:
+        from tpu3fs.rpc import deadline as _dl
+
+        return _dl.expired()
 
     # -- writes ---------------------------------------------------------------
     def write_chunk(
@@ -240,6 +306,19 @@ class StorageClient:
         chunk_size: int = 1 << 20,
     ) -> UpdateReply:
         """Write with the full retry ladder; exactly-once via channel identity."""
+        with self._op_scope():
+            return self._write_chunk_op(chain_id, chunk_id, offset, data,
+                                        chunk_size=chunk_size)
+
+    def _write_chunk_op(
+        self,
+        chain_id: int,
+        chunk_id: ChunkId,
+        offset: int,
+        data: bytes,
+        *,
+        chunk_size: int = 1 << 20,
+    ) -> UpdateReply:
         try:
             if self._chain(chain_id).is_ec:
                 # a CRAQ write would install full-chunk bytes on shard-sized
@@ -287,6 +366,9 @@ class StorageClient:
                 if reply.ok:
                     return reply
                 last = reply
+                if self._deadline_expired():
+                    return UpdateReply(Code.DEADLINE_EXCEEDED,
+                                       message="op deadline exhausted")
                 if Status(reply.code).retryable() or reply.code in (
                     Code.NOT_HEAD,
                     Code.RPC_PEER_CLOSED,
@@ -318,7 +400,36 @@ class StorageClient:
         else:  # LOAD_BALANCE / RANDOM
             order = list(serving)
             self._rng.shuffle(order)
+        # gray-node demotion: SUSPECT peers (breaker not closed, or a
+        # latency-EWMA outlier) sort to the END — a sick replica is
+        # routed around within milliseconds of the first slow/failed
+        # observation instead of after a 60s heartbeat timeout. Stable:
+        # the selection mode's order is preserved within each class.
+        if self._retry.health_reorder and len(order) > 1:
+            routing = self._routing()
+
+            def _suspect(tid: int) -> bool:
+                node = routing.node_of_target(tid)
+                return (node is not None
+                        and self._health.suspect(node.node_id))
+
+            order.sort(key=_suspect)
         return order
+
+    def _timed_read(self, node_id: int, req: ReadReq) -> ReadReply:
+        """One messenger read with latency fed to the health EWMA (the
+        hedge-delay / gray-demotion signal). Transport errors come back
+        as replies (the ladder's existing shape)."""
+        t0 = time.monotonic()
+        try:
+            reply = self._messenger(node_id, "read", req)
+        except FsError as e:
+            if e.code in (Code.RPC_CONNECT_FAILED, Code.RPC_PEER_CLOSED,
+                          Code.RPC_TIMEOUT, Code.PEER_UNHEALTHY):
+                self._health.observe(node_id, 0.0, ok=False)
+            return ReadReply(e.code)
+        self._health.observe(node_id, time.monotonic() - t0, ok=True)
+        return reply
 
     def read_chunk(
         self,
@@ -327,26 +438,68 @@ class StorageClient:
         offset: int = 0,
         length: int = -1,
     ) -> ReadReply:
+        with self._op_scope():
+            return self._read_chunk_op(chain_id, chunk_id, offset, length)
+
+    def _read_chunk_op(
+        self,
+        chain_id: int,
+        chunk_id: ChunkId,
+        offset: int = 0,
+        length: int = -1,
+    ) -> ReadReply:
+        from tpu3fs.client.hedging import run_hedged
+
         last = ReadReply(Code.TARGET_NOT_FOUND)
         for attempt in range(self._retry.max_retries + 1):
+            if self._deadline_expired():
+                return ReadReply(Code.DEADLINE_EXCEEDED)
             try:
                 chain = self._chain(chain_id)
             except FsError as e:
                 return ReadReply(e.code)
             targets = self._pick_targets(chain)
             routing = self._routing()
-            for target_id in targets:
-                node = routing.node_of_target(target_id)
-                if node is None:
-                    continue
-                req = ReadReq(chain_id, chunk_id, offset, length, target_id)
-                try:
-                    reply = self._messenger(node.node_id, "read", req)
-                except FsError as e:
-                    reply = ReadReply(e.code)
-                if reply.ok or reply.code == Code.CHUNK_NOT_FOUND:
+            resolved = [(t, routing.node_of_target(t)) for t in targets]
+            resolved = [(t, n) for t, n in resolved if n is not None]
+
+            def _attempt(pair):
+                t, n = pair
+                return self._timed_read(
+                    n.node_id,
+                    ReadReq(chain_id, chunk_id, offset, length, t))
+
+            def _good(r) -> bool:
+                return r.ok or r.code == Code.CHUNK_NOT_FOUND
+
+            # failover walk with hedging at EVERY step: CRAQ committed
+            # reads may be served by any replica, so each attempt arms a
+            # backup to the NEXT replica after the adaptive delay and the
+            # first good reply wins (client/hedging.py — budgeted,
+            # idempotent-only). A straggler encountered mid-failover is
+            # rescued exactly like one hit first.
+            hedging = self._retry.hedge_reads and not chain.is_ec
+            i = 0
+            while i < len(resolved):
+                primary = resolved[i]
+                backup = (resolved[i + 1]
+                          if hedging and i + 1 < len(resolved) else None)
+                if backup is None:
+                    self._hedge.note_primary()
+                    reply = _attempt(primary)
+                    i += 1
+                else:
+                    reply, hedged, _backup_won = run_hedged(
+                        lambda p=primary: _attempt(p),
+                        lambda b=backup: _attempt(b),
+                        self._hedge.delay_s(primary[1].node_id),
+                        self._hedge, good=_good)
+                    i += 2 if hedged else 1
+                if _good(reply):
                     return reply
                 last = reply
+            if self._deadline_expired():
+                return ReadReply(Code.DEADLINE_EXCEEDED)
             if last.code in (Code.CHUNK_NOT_COMMIT,) or Status(last.code).retryable():
                 self._sleep(attempt, _hint_ms(last))
                 continue
@@ -361,7 +514,7 @@ class StorageClient:
         slow ops capture their whole cross-process stage breakdown."""
         from tpu3fs.analytics import spans as _spans
 
-        with _spans.root_span("client.batch_read"):
+        with _spans.root_span("client.batch_read"), self._op_scope():
             return self._batch_read_op(reqs)
 
     def _batch_read_op(
@@ -463,25 +616,99 @@ class StorageClient:
                 for w, reply in zip(idxs, got):
                     replies[w] = reply
         else:
+            from tpu3fs.client.hedging import run_hedged
+
+            routing = self._routing()
+
+            def _call_group(node_id, ops) -> List[ReadReply]:
+                t0 = time.monotonic()
+                try:
+                    got = list(self._messenger(node_id, "batch_read", ops))
+                except FsError as e:
+                    if e.code in (Code.RPC_CONNECT_FAILED,
+                                  Code.RPC_PEER_CLOSED, Code.RPC_TIMEOUT,
+                                  Code.PEER_UNHEALTHY):
+                        self._health.observe(node_id, 0.0, ok=False)
+                    return [ReadReply(e.code)] * len(ops)
+                self._health.observe(node_id, time.monotonic() - t0,
+                                     ok=True)
+                got += [ReadReply(Code.RPC_PEER_CLOSED)] * (
+                    len(ops) - len(got))
+                return got[:len(ops)]
+
+            def _group_good(rs) -> bool:
+                return any(r.ok or r.code == Code.CHUNK_NOT_FOUND
+                           for r in rs)
+
             def _issue_read(item) -> None:
                 # ONE BatchRead request per node (ref sendBatchRequest
                 # StorageClientImpl.cc:1303): the round trip is amortized
-                # over the whole group
+                # over the whole group. When every op in the group has a
+                # serving replica on ANOTHER node, the group is hedge-
+                # eligible: a backup batch to the alternates arms after
+                # the adaptive delay and the first useful reply set wins.
                 node_id, idxs = item
-                try:
-                    got = self._messenger(
-                        node_id, "batch_read", [wire[w][1] for w in idxs])
-                    for w, reply in zip(idxs, got):
-                        replies[w] = reply
-                except FsError as e:
-                    for w in idxs:
-                        replies[w] = ReadReply(e.code)
+                ops = [wire[w][1] for w in idxs]
+                backup = (self._plan_group_backup(routing, ops, node_id)
+                          if self._retry.hedge_reads else None)
+                if backup is None:
+                    self._hedge.note_primary()
+                    got = _call_group(node_id, ops)
+                else:
+                    got, _hedged, _won = run_hedged(
+                        lambda: _call_group(node_id, ops), backup,
+                        self._hedge.delay_s(node_id), self._hedge,
+                        good=_group_good)
+                for w, reply in zip(idxs, got):
+                    replies[w] = reply
 
             self._fan_out(_issue_read, items)
         for w, r in enumerate(replies):
             if r is None:  # short reply list from a confused server
                 replies[w] = ReadReply(Code.RPC_PEER_CLOSED)
         return replies  # type: ignore[return-value]
+
+    def _plan_group_backup(self, routing, ops: List[ReadReq],
+                           primary_node: int):
+        """Backup thunk for one hedged batch-read group, or None when any
+        op lacks a serving replica on a DIFFERENT node (hedging to the
+        same sick node buys nothing). CR ops only — EC shard reads are
+        shard-addressed, each shard has exactly one home."""
+        alts: List[Tuple[int, ReadReq]] = []
+        for op in ops:
+            chain = routing.chains.get(op.chain_id)
+            if chain is None or chain.is_ec:
+                return None
+            alt = None
+            for t in chain.targets:
+                if (t.public_state == PublicTargetState.SERVING
+                        and t.target_id != op.target_id):
+                    node = routing.node_of_target(t.target_id)
+                    if node is not None and node.node_id != primary_node:
+                        alt = (node.node_id,
+                               replace(op, target_id=t.target_id))
+                        break
+            if alt is None:
+                return None
+            alts.append(alt)
+
+        def _backup() -> List[ReadReply]:
+            out: List[Optional[ReadReply]] = [None] * len(alts)
+            by_n: Dict[int, List[int]] = defaultdict(list)
+            for i, (n, _a) in enumerate(alts):
+                by_n[n].append(i)
+            for n, iidx in by_n.items():
+                try:
+                    got = self._messenger(
+                        n, "batch_read", [alts[i][1] for i in iidx])
+                except FsError as e:
+                    got = [ReadReply(e.code)] * len(iidx)
+                for i, r in zip(iidx, got):
+                    out[i] = r
+            return [r if r is not None else ReadReply(Code.RPC_PEER_CLOSED)
+                    for r in out]
+
+        return _backup
 
     def batch_write(
         self,
@@ -497,7 +724,7 @@ class StorageClient:
 
         with _spans.root_span(
                 "client.batch_write",
-                nbytes=sum(len(w[3]) for w in writes)):
+                nbytes=sum(len(w[3]) for w in writes)), self._op_scope():
             return self._batch_write_op(writes, chunk_size=chunk_size,
                                         op_crcs=op_crcs)
 
@@ -633,6 +860,9 @@ class StorageClient:
         done: set = set()     # shard indices STAGED at `ver`
         landed: set = set()   # shard indices COMMITTED at `ver`
         for attempt in range(self._retry.max_retries + 1):
+            if attempt and self._deadline_expired():
+                return UpdateReply(Code.DEADLINE_EXCEEDED,
+                                   message="op deadline exhausted")
             chain = self._chain(chain_id)
             routing = self._routing()
             writable = 0
@@ -801,7 +1031,8 @@ class StorageClient:
         from tpu3fs.analytics import spans as _spans
 
         with _spans.root_span("client.write_stripes",
-                              nbytes=sum(len(d) for _, d in items)):
+                              nbytes=sum(len(d) for _, d in items)), \
+                self._op_scope():
             return self._write_stripes_op(chain_id, items,
                                           chunk_size=chunk_size)
 
@@ -1329,6 +1560,19 @@ class StorageClient:
         shard, gather any k same-version survivors and reconstruct
         (degraded read). Shares its planning/assembly/decode helpers with
         batch_read so the two paths cannot drift apart."""
+        with self._op_scope():
+            return self._read_stripe_op(chain_id, chunk_id, offset, length,
+                                        chunk_size=chunk_size)
+
+    def _read_stripe_op(
+        self,
+        chain_id: int,
+        chunk_id: ChunkId,
+        offset: int = 0,
+        length: int = -1,
+        *,
+        chunk_size: int = 1 << 20,
+    ) -> ReadReply:
         chain = self._chain(chain_id)
         if not chain.is_ec:
             raise FsError(Status(Code.INVALID_ARG, "read_stripe on CR chain"))
@@ -1382,6 +1626,8 @@ class StorageClient:
             # mixed versions / not enough shards yet: transient (a stripe
             # write or rebuild is in flight) — retry
             last = ReadReply(Code.CHUNK_NOT_COMMIT)
+            if self._deadline_expired():
+                return ReadReply(Code.DEADLINE_EXCEEDED)
             self._sleep(attempt)
         return last
 
@@ -1523,5 +1769,8 @@ class StorageClient:
                         Code.TARGET_OFFLINE,
                         f"no serving replica on chain {chain_id}"))
             if attempt < self._retry.max_retries:
+                if self._deadline_expired():
+                    raise FsError(Status(Code.DEADLINE_EXCEEDED,
+                                         "op deadline exhausted"))
                 self._sleep(attempt)
         raise last_err

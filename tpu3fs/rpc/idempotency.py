@@ -1,0 +1,136 @@
+"""Static idempotency / hedge-safety classification of every RPC method.
+
+Hedged reads issue the SAME request to a second replica and take the
+first reply — only safe when executing a request twice (possibly with
+both executions landing) is indistinguishable from executing it once.
+That property is STATIC, so it lives in one table that
+``tools/check_rpc_registry.py`` enforces against every bound service
+method (tier-1): a new method without a classification fails CI, and a
+method the hedging client uses that is not classified idempotent fails
+CI — hedging can never silently grow onto a mutating RPC.
+
+Classification values:
+
+- ``idempotent``: repeat execution is free of side effects (committed
+  reads, stats, routing fetches). HEDGE-SAFE.
+- ``mutating``: repeat execution changes state or double-charges a
+  resource. Never hedged; subject to breaker fail-fast instead
+  (rpc/health.py). CRAQ writes are exactly-once per (client, channel,
+  seqnum) — replay-SAFE for retries — but hedging one would consume two
+  update-queue slots and two chain pipelines for one logical update, so
+  they classify mutating on purpose.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+IDEMPOTENT = "idempotent"
+MUTATING = "mutating"
+
+#: (service name, method name) -> classification. check_rpc_registry
+#: verifies this table covers every bound method and carries no stale
+#: rows, so it IS the registry.
+CLASSIFICATION: Dict[Tuple[str, str], str] = {
+    # -- StorageSerde -----------------------------------------------------
+    ("StorageSerde", "write"): MUTATING,
+    ("StorageSerde", "update"): MUTATING,
+    ("StorageSerde", "read"): IDEMPOTENT,
+    ("StorageSerde", "dumpChunkMeta"): IDEMPOTENT,
+    ("StorageSerde", "syncDone"): MUTATING,
+    ("StorageSerde", "removeChunk"): MUTATING,
+    ("StorageSerde", "removeFileChunks"): MUTATING,
+    ("StorageSerde", "queryLastChunk"): IDEMPOTENT,
+    ("StorageSerde", "truncateChunks"): MUTATING,
+    ("StorageSerde", "spaceInfo"): IDEMPOTENT,
+    ("StorageSerde", "batchRead"): IDEMPOTENT,
+    ("StorageSerde", "batchWrite"): MUTATING,
+    ("StorageSerde", "writeShard"): MUTATING,
+    ("StorageSerde", "batchWriteShard"): MUTATING,
+    ("StorageSerde", "batchUpdate"): MUTATING,
+    ("StorageSerde", "statChunks"): IDEMPOTENT,
+    ("StorageSerde", "pruneClientChannels"): MUTATING,
+    ("StorageSerde", "offlineTarget"): MUTATING,
+    ("StorageSerde", "readRebuild"): IDEMPOTENT,
+    ("StorageSerde", "dumpPendingChunkMeta"): IDEMPOTENT,
+    ("StorageSerde", "batchReadRebuild"): IDEMPOTENT,
+    # -- MetaSerde --------------------------------------------------------
+    ("MetaSerde", "statFs"): IDEMPOTENT,
+    ("MetaSerde", "stat"): IDEMPOTENT,
+    ("MetaSerde", "create"): MUTATING,
+    ("MetaSerde", "mkdirs"): MUTATING,
+    ("MetaSerde", "symlink"): MUTATING,
+    ("MetaSerde", "hardLink"): MUTATING,
+    ("MetaSerde", "remove"): MUTATING,
+    ("MetaSerde", "open"): MUTATING,   # allocates a session
+    ("MetaSerde", "sync"): MUTATING,
+    ("MetaSerde", "close"): MUTATING,
+    ("MetaSerde", "rename"): MUTATING,
+    ("MetaSerde", "list"): IDEMPOTENT,
+    ("MetaSerde", "truncate"): MUTATING,
+    ("MetaSerde", "getRealPath"): IDEMPOTENT,
+    ("MetaSerde", "setAttr"): MUTATING,
+    ("MetaSerde", "pruneSession"): MUTATING,
+    ("MetaSerde", "batchStat"): IDEMPOTENT,
+    ("MetaSerde", "authenticate"): IDEMPOTENT,
+    ("MetaSerde", "setXattr"): MUTATING,
+    ("MetaSerde", "getXattr"): IDEMPOTENT,
+    ("MetaSerde", "listXattrs"): IDEMPOTENT,
+    ("MetaSerde", "removeXattr"): MUTATING,
+    ("MetaSerde", "batchClose"): MUTATING,
+    ("MetaSerde", "batchSetAttr"): MUTATING,
+    ("MetaSerde", "batchCreate"): MUTATING,
+    # -- Mgmtd ------------------------------------------------------------
+    ("Mgmtd", "heartbeat"): MUTATING,   # versioned: replay rejected anyway
+    ("Mgmtd", "getRoutingInfo"): IDEMPOTENT,
+    ("Mgmtd", "registerNode"): MUTATING,
+    ("Mgmtd", "createTarget"): MUTATING,
+    ("Mgmtd", "uploadChain"): MUTATING,
+    ("Mgmtd", "uploadChainTable"): MUTATING,
+    ("Mgmtd", "setConfig"): MUTATING,
+    ("Mgmtd", "getConfig"): IDEMPOTENT,
+    ("Mgmtd", "tick"): MUTATING,
+    # -- Core -------------------------------------------------------------
+    ("Core", "echo"): IDEMPOTENT,
+    ("Core", "renderConfig"): IDEMPOTENT,
+    ("Core", "hotUpdateConfig"): MUTATING,
+    ("Core", "shutdown"): MUTATING,
+    ("Core", "getConfig"): IDEMPOTENT,
+    ("Core", "getLastConfigUpdateRecord"): IDEMPOTENT,
+    # -- Kv ---------------------------------------------------------------
+    ("Kv", "snapshot"): MUTATING,   # allocates a read-snapshot lease
+    ("Kv", "get"): IDEMPOTENT,
+    ("Kv", "getRange"): IDEMPOTENT,
+    ("Kv", "commit"): MUTATING,
+    ("Kv", "release"): MUTATING,
+    # -- KvRepl (raft internals: term/log state machines) -----------------
+    ("KvRepl", "appendEntries"): MUTATING,
+    ("KvRepl", "requestVote"): MUTATING,
+    ("KvRepl", "installSnapshot"): MUTATING,
+    ("KvRepl", "status"): IDEMPOTENT,
+    ("KvRepl", "reconfig"): MUTATING,
+    # -- MonitorCollector -------------------------------------------------
+    ("MonitorCollector", "write"): MUTATING,   # double-counts samples
+    ("MonitorCollector", "query"): IDEMPOTENT,
+    # -- SimpleExample ----------------------------------------------------
+    ("SimpleExample", "write"): MUTATING,
+    ("SimpleExample", "read"): IDEMPOTENT,
+}
+
+#: messenger-level method names the hedging client may back up with a
+#: second replica request, mapped to the wire method they resolve to.
+#: check_rpc_registry asserts every target classifies IDEMPOTENT.
+HEDGE_SAFE_MESSENGER_METHODS: Dict[str, Tuple[str, str]] = {
+    "read": ("StorageSerde", "read"),
+    "batch_read": ("StorageSerde", "batchRead"),
+}
+
+
+def classify(service: str, method: str) -> Optional[str]:
+    """Classification for one bound method, or None when unclassified
+    (which the static registry check turns into a CI failure)."""
+    return CLASSIFICATION.get((service, method))
+
+
+def hedge_safe(service: str, method: str) -> bool:
+    return CLASSIFICATION.get((service, method)) == IDEMPOTENT

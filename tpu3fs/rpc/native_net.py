@@ -387,6 +387,32 @@ class NativeRpcServer:
             if mdef is None:
                 return self._err(out_msg, Code.RPC_METHOD_NOT_FOUND,
                                  f"{service.name}.{method_id}")
+            msg_str = (req_msg or b"").decode("utf-8", "replace")
+            # cluster fault plane at the dispatch boundary (mirrors
+            # RpcServer._dispatch); drop rules surface as PEER_CLOSED on
+            # this transport (the C side owns the socket, so the bridge
+            # answers an error instead of tearing the stream)
+            from tpu3fs.rpc import deadline as _dl
+            from tpu3fs.utils.fault_injection import plane as _fault_plane
+
+            try:
+                _fault_plane().fire(
+                    f"rpc.dispatch.{service.name}.{mdef.name}")
+            except FsError as e:
+                return self._err(out_msg, e.code, e.status.message)
+            except ConnectionError as e:
+                return self._err(out_msg, Code.RPC_PEER_CLOSED, str(e))
+            # DEADLINE admission shed before request decode (expired work
+            # never reaches the engine; rpc/deadline.py)
+            import time as _time
+
+            dl = _dl.decode_deadline(msg_str) if msg_str else None
+            if dl is not None and _time.time() > dl:
+                _dl.record_shed("admission")
+                return self._err(
+                    out_msg, Code.DEADLINE_EXCEEDED,
+                    f"deadline passed before "
+                    f"{service.name}.{mdef.name} admission")
             # QoS admission by the envelope's traffic-class bits (handler
             # ABI v3 threads `flags` through): a tagged peer is admitted
             # as its declared class; untagged ops classify by method name
@@ -438,14 +464,15 @@ class NativeRpcServer:
                     # threaded through the handler ABI (v4) as req_msg
                     sctx = None
                     if _spans.tracer().enabled:
-                        in_ctx = _spans.decode_wire(
-                            (req_msg or b"").decode("utf-8", "replace"))
+                        in_ctx = _spans.decode_wire(msg_str)
                         sctx = (in_ctx.child() if in_ctx is not None
                                 else _spans.tracer().start_trace())
                     t0 = _time.perf_counter()
                     ctx = (tagged(tclass) if tclass is not None
                            else contextlib.nullcontext())
-                    with ctx, _spans.trace_scope(sctx) \
+                    dctx = (_dl.deadline_scope(dl) if dl is not None
+                            else contextlib.nullcontext())
+                    with ctx, dctx, _spans.trace_scope(sctx) \
                             if sctx is not None \
                             else contextlib.nullcontext():
                         if mdef.bulk:
@@ -608,6 +635,17 @@ class NativeRpcClient:
         return deserialize(payload, rsp_type), segments
 
     @staticmethod
+    def _fire_send_fault(addr, service_id: int, method_id: int) -> None:
+        """Client-side fault-plane hook at the send boundary (mirrors the
+        Python transport's start_call hook)."""
+        from tpu3fs.utils.fault_injection import plane as _fault_plane
+
+        try:
+            _fault_plane().fire(f"rpc.send.{service_id}.{method_id}")
+        except ConnectionError as e:
+            raise FsError(Status(Code.RPC_PEER_CLOSED, f"{addr}: {e}"))
+
+    @staticmethod
     def _class_flags() -> int:
         """The calling thread's QoS class as envelope flag bits, so the
         native server's admission (and its read fast path's per-class
@@ -619,15 +657,18 @@ class NativeRpcClient:
     @staticmethod
     def _trace_hop():
         """-> (rpc child context | None, envelope message bytes | None):
-        the trace stamping the Python client does in start_call, for the
-        native send entry points (msg rides the same envelope field)."""
+        the trace + deadline stamping the Python client does in
+        start_call, for the native send entry points (both ride the same
+        envelope message field; rpc/deadline.py)."""
         from tpu3fs.analytics import spans as _spans
+        from tpu3fs.rpc import deadline as _dl
 
         ctx = _spans.current_trace()
-        if ctx is None:
-            return None, None
-        rpc_ctx = ctx.child()
-        return rpc_ctx, rpc_ctx.to_wire().encode()
+        rpc_ctx = ctx.child() if ctx is not None else None
+        msg = _dl.encode_envelope(
+            rpc_ctx.to_wire() if rpc_ctx is not None else "",
+            _dl.current_deadline())
+        return rpc_ctx, (msg.encode() if msg else None)
 
     @staticmethod
     def _trace_finish(rpc_ctx, service_id, method_id, t0, status) -> None:
@@ -669,6 +710,7 @@ class NativeRpcClient:
         has_bulk = ctypes.c_int(0)
         msg_ptr = ctypes.c_char_p()
         rpc_ctx, trace_msg = self._trace_hop()
+        self._fire_send_fault(addr, service_id, method_id)
         import time as _time
 
         t0 = _time.perf_counter()
@@ -724,6 +766,7 @@ class NativeRpcClient:
         raw, buf, iov_ptrs, iov_lens, n_iovs, keepalive = \
             self._marshal_req(req, req_type, bulk_iovs)
         rpc_ctx, trace_msg = self._trace_hop()
+        self._fire_send_fault(addr, service_id, method_id)
         import time as _time
 
         t0 = _time.perf_counter()

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
 from tpu3fs.analytics import spans as _spans
+from tpu3fs.rpc import deadline as _deadline
 from tpu3fs.rpc.serde import (
     _read_uvarint,
     _write_uvarint,
@@ -378,6 +379,28 @@ class RpcServer:
             return self._error_reply(
                 pkt, Code.RPC_BAD_REQUEST,
                 f"{service.name}.{mdef.name} is not bulk-capable"), None
+        # cluster fault plane: the server-side dispatch boundary
+        # (utils/fault_injection.py). `drop` rules raise ConnectionError,
+        # which _serve_conn turns into a torn connection — the realistic
+        # shape of a half-dead peer.
+        from tpu3fs.utils.fault_injection import plane as _fault_plane
+
+        try:
+            _fault_plane().fire(
+                f"rpc.dispatch.{service.name}.{mdef.name}")
+        except FsError as e:
+            return self._error_reply(pkt, e.code, e.status.message), None
+        # DEADLINE admission shed (before QoS and before request decode —
+        # expired work must never reach the engine stage, and shedding it
+        # must cost less than anything downstream): an envelope whose
+        # absolute deadline passed answers the retryable DEADLINE_EXCEEDED
+        dl = _deadline.decode_deadline(pkt.message) if pkt.message else None
+        if dl is not None and time.time() > dl:
+            _deadline.record_shed("admission")
+            return self._error_reply(
+                pkt, Code.DEADLINE_EXCEEDED,
+                f"deadline passed {time.time() - dl:.3f}s before "
+                f"{service.name}.{mdef.name} admission"), None
         # QoS admission BEFORE deserialization (shedding must stay cheap):
         # token bucket + concurrency cap keyed (service, method, traffic
         # class); sheds answer OVERLOADED with the retry-after hint in the
@@ -427,7 +450,11 @@ class RpcServer:
                 tclass = class_from_flags(pkt.flags)
             ctx = (tagged(tclass) if tclass is not None
                    else contextlib.nullcontext())
-            with ctx, _spans.trace_scope(sctx) \
+            # the peer's deadline scopes the handler: service internals
+            # (update-queue submit, nested RPCs) inherit and re-propagate
+            dctx = (_deadline.deadline_scope(dl) if dl is not None
+                    else contextlib.nullcontext())
+            with ctx, dctx, _spans.trace_scope(sctx) \
                     if sctx is not None else contextlib.nullcontext():
                 if mdef.bulk:
                     rsp, reply_iovs = mdef.handler(req, bulk)
@@ -626,8 +653,20 @@ class RpcClient:
             flags=FLAG_IS_REQ | class_to_flags(current_class()),
             status=int(Code.OK),
             payload=serialize(req, req_type or type(req)),
-            message=rpc_ctx.to_wire() if rpc_ctx is not None else "",
+            # trace context + absolute deadline compose in the message
+            # field (version-tolerant both ways; rpc/deadline.py)
+            message=_deadline.encode_envelope(
+                rpc_ctx.to_wire() if rpc_ctx is not None else "",
+                _deadline.current_deadline()),
         )
+        # client-side fault plane hook: the send boundary (drop rules
+        # surface as the peer-closed transport error retry ladders know)
+        from tpu3fs.utils.fault_injection import plane as _fault_plane
+
+        try:
+            _fault_plane().fire(f"rpc.send.{service_id}.{method_id}")
+        except ConnectionError as e:
+            raise FsError(Status(Code.RPC_PEER_CLOSED, f"{addr}: {e}"))
         pkt.timestamps.client_build = time.monotonic()
         conn = self._get_conn(addr)
         # the connection must not return to the pool until the stream is

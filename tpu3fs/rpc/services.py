@@ -13,6 +13,7 @@ ResyncWorker run unchanged over sockets.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
 
@@ -312,8 +313,17 @@ class RpcMessenger:
     def __init__(self, routing_provider, client: Optional[RpcClient] = None):
         import os
 
+        from tpu3fs.rpc.health import HealthRegistry
+
         self._routing = routing_provider
         self._client = client or RpcClient()
+        # per-peer health + circuit breakers (rpc/health.py): every timed
+        # call feeds the node's EWMA/error streak; an OPEN breaker makes
+        # MUTATING calls fail fast with the retryable PEER_UNHEALTHY
+        # (reads are replica-reordered client-side instead, and serve as
+        # free probes). StorageClient shares this registry for its
+        # replica ordering + hedge delays.
+        self.health = HealthRegistry()
         # A/B lever: TPU3FS_RPC_INLINE=1 turns bulk framing off so the
         # two wire forms can be benchmarked against each other
         self._bulk = os.environ.get("TPU3FS_RPC_INLINE", "") != "1"
@@ -344,6 +354,44 @@ class RpcMessenger:
         if node is None or not node.host:
             raise FsError(Status(Code.RPC_CONNECT_FAILED, f"no address for node {node_id}"))
         return node.host, node.port
+
+    #: transport error codes that count against a peer's breaker (an
+    #: application error reply proves the peer alive — never counted)
+    _HEALTH_ERROR_CODES = (Code.RPC_CONNECT_FAILED, Code.RPC_PEER_CLOSED,
+                           Code.RPC_TIMEOUT, Code.RPC_SEND_FAILED)
+
+    def _guard(self, node_id: int, method: str) -> None:
+        """Pre-send gate: the fault plane's send hook, then the breaker.
+        Mutating methods to an OPEN-breaker peer fail FAST with the
+        retryable PEER_UNHEALTHY (the client ladder refreshes routing and
+        retries; the half-open probe re-tests the peer); hedge-safe reads
+        always pass — read selection already routes around suspects, and
+        a read reaching an open peer is a free probe."""
+        from tpu3fs.rpc.idempotency import HEDGE_SAFE_MESSENGER_METHODS
+        from tpu3fs.utils.fault_injection import plane as _fault_plane
+
+        try:
+            _fault_plane().fire(f"rpc.send.{method}", node=node_id)
+        except ConnectionError as e:
+            raise FsError(Status(Code.RPC_PEER_CLOSED, str(e)))
+        if method in HEDGE_SAFE_MESSENGER_METHODS:
+            return
+        if not self.health.allow(node_id):
+            raise FsError(Status(
+                Code.PEER_UNHEALTHY,
+                f"breaker open for node {node_id} ({method})"))
+
+    def _observe(self, node_id: int, t0: float, err=None) -> None:
+        if err is None:
+            self.health.observe(node_id, time.monotonic() - t0, ok=True)
+        elif err.code in self._HEALTH_ERROR_CODES:
+            self.health.observe(node_id, 0.0, ok=False)
+        elif err.code == Code.PEER_UNHEALTHY:
+            pass  # our own fail-fast: no new evidence about the peer
+        else:
+            # an application-level reply: the peer answered — clear any
+            # half-open probe by scoring the round trip as a success
+            self.health.observe(node_id, time.monotonic() - t0, ok=True)
 
     @staticmethod
     def _attach_read_segs(replies, segs):
@@ -414,17 +462,22 @@ class RpcMessenger:
                         bulk_iovs=())))
                 except FsError as e:
                     pend.append((gi, lo, hi, e))
+        t_issue = time.monotonic()
         for gi, lo, hi, p in pend:
+            node_id = groups[gi][0]
             if isinstance(p, FsError):
                 err = p
+                self._observe(node_id, t_issue, err=err)
             else:
                 try:
                     rsp, segs = c.finish_call(p)
+                    self._observe(node_id, t_issue)
                     replies = self._attach_read_segs(rsp.replies, segs)
                     results[gi][lo:lo + len(replies)] = replies
                     continue
                 except FsError as e:
                     err = e
+                    self._observe(node_id, t_issue, err=err)
             for i in range(lo, min(hi, len(results[gi]))):
                 if results[gi][i] is None:
                     results[gi][i] = ReadReply(err.code)
@@ -478,6 +531,7 @@ class RpcMessenger:
         c = self._client
         for gi, (node_id, ops) in enumerate(groups):
             try:
+                self._guard(node_id, method)
                 addr = self._addr(node_id)
             except FsError as e:
                 pend.append((gi, 0, len(ops), e))
@@ -502,16 +556,21 @@ class RpcMessenger:
                         bulk_iovs=[op.data for op in span])))
                 except FsError as e:
                     pend.append((gi, lo, hi, e))
+        t_issue = time.monotonic()
         for gi, lo, hi, p in pend:
+            node_id = groups[gi][0]
             if isinstance(p, FsError):
                 err = p
+                self._observe(node_id, t_issue, err=err)
             else:
                 try:
                     rsp, _ = c.finish_call(p)
+                    self._observe(node_id, t_issue)
                     results[gi][lo:lo + len(rsp.replies)] = rsp.replies
                     continue
                 except FsError as e:
                     err = e
+                    self._observe(node_id, t_issue, err=err)
             for i in range(lo, min(hi, len(results[gi]))):
                 if results[gi][i] is None:
                     results[gi][i] = UpdateReply(err.code,
@@ -547,6 +606,17 @@ class RpcMessenger:
         return rsp.replies
 
     def __call__(self, node_id: int, method: str, payload):
+        self._guard(node_id, method)
+        t0 = time.monotonic()
+        try:
+            out = self._dispatch_method(node_id, method, payload)
+        except FsError as e:
+            self._observe(node_id, t0, err=e)
+            raise
+        self._observe(node_id, t0)
+        return out
+
+    def _dispatch_method(self, node_id: int, method: str, payload):
         addr = self._addr(node_id)
         c = self._client
         sid = STORAGE_SERVICE_ID
@@ -720,6 +790,12 @@ class MgmtdRpcClient:
         """Expire the TTL cache now: the next refresh_routing polls mgmtd.
         Called by retry ladders before re-resolving a failed op."""
         self._routing_ts = float("-inf")
+
+    def known_routing_version(self) -> int:
+        """Version of the cached snapshot (-1 = none yet) — lets the
+        heartbeat loop detect a routing bump in the reply and expire the
+        TTL cache promptly (no full-TTL stale window after a demotion)."""
+        return self._routing.version if self._routing is not None else -1
 
     def refresh_routing(self) -> RoutingInfo:
         import time as _time

@@ -262,6 +262,11 @@ RETRIABLE_FORWARD_CODES = (
     Code.RPC_PEER_CLOSED,
     Code.RPC_CONNECT_FAILED,
     Code.TIMEOUT,
+    # messenger breaker fail-fast (rpc/health.py): the successor is
+    # suspected sick — exactly the "chain may have moved under us"
+    # shape; refresh the snapshot and retry (the half-open probe or the
+    # chain updater resolves it within the retry ladder)
+    Code.PEER_UNHEALTHY,
 )
 
 
@@ -771,6 +776,20 @@ class StorageService:
             # fail a client write that already committed + forwarded
             pass
 
+    @staticmethod
+    def _deadline_expired() -> bool:
+        """Admission-time deadline shed for entries the RPC dispatch did
+        not already cover (the in-process/fabric messenger dispatches
+        straight into these methods). Chain-INTERNAL hops never check:
+        shedding a forward mid-chain would leave the suffix divergent for
+        a client that is no longer retrying — head/read entries only."""
+        from tpu3fs.rpc import deadline as _dl
+
+        if _dl.expired():
+            _dl.record_shed("admission")
+            return True
+        return False
+
     def _admit_write(self, req, cost: float = 1.0):
         """Admission for writes keyed ("storage", "write", class).
         FOREGROUND chain-internal hops (from_target != 0) are exempt: the
@@ -800,6 +819,9 @@ class StorageService:
     def _write_impl(self, req: WriteReq) -> UpdateReply:
         if self.stopped:
             return UpdateReply(Code.RPC_PEER_CLOSED, message="node stopped")
+        if not req.from_target and self._deadline_expired():
+            return UpdateReply(Code.DEADLINE_EXCEEDED,
+                               message="deadline passed at write admission")
         lease, shed_ms = self._admit_write(req)
         if shed_ms is not None:
             return UpdateReply(
@@ -868,7 +890,7 @@ class StorageService:
     def _handle_update(self, target: StorageTarget, req: WriteReq) -> UpdateReply:
         with self._chunk_lock(target.target_id, req.chunk_id):
             try:
-                inject("storage.update")
+                inject("storage.update", node=self.node_id)
                 self._check_target_serving(target)
                 # re-check the chain AFTER taking the chunk lock (ref :377-382)
                 chain = self._chain(req.chain_id)
@@ -1215,7 +1237,7 @@ class StorageService:
                             target: StorageTarget) -> UpdateReply:
         with self._chunk_lock(req.target_id, req.chunk_id):
             try:
-                inject("storage.write_shard")
+                inject("storage.write_shard", node=self.node_id)
                 self._check_target_serving(target)
                 chain = self._chain(req.chain_id)  # re-check under the lock
                 engine = target.engine
@@ -1304,6 +1326,8 @@ class StorageService:
         path keeps views=False (plain bytes)."""
         from tpu3fs.qos.core import TrafficClass
 
+        if self._deadline_expired():
+            return [ReadReply(Code.DEADLINE_EXCEEDED) for _ in reqs]
         lease, shed_ms = self._admit_read(TrafficClass.FG_READ,
                                           cost=max(1, len(reqs)))
         if shed_ms is not None:
@@ -1322,7 +1346,7 @@ class StorageService:
         groups: Dict[int, List[int]] = {}
         for i, req in enumerate(reqs):
             try:
-                inject("storage.read")
+                inject("storage.read", node=self.node_id)
                 target_id = self._resolve_read_target(req)
             except FsError as e:
                 self._read_rec.failed.add()
@@ -1357,6 +1381,10 @@ class StorageService:
         cross-check, one native batch commit — the server half of the
         reference's per-node request batching (StorageClientImpl.cc:1030,
         1303,1771; per-disk serialization as in UpdateWorker.h:11-46)."""
+        if self._deadline_expired():
+            return [UpdateReply(Code.DEADLINE_EXCEEDED,
+                                message="deadline passed at write admission")
+                    for _ in reqs]
         replies: List[Optional[UpdateReply]] = [None] * len(reqs)
         groups: Dict[int, List[int]] = {}
         for i, r in enumerate(reqs):
@@ -1544,7 +1572,7 @@ class StorageService:
         for key in keys:
             self._locks.acquire(key)
         try:
-            inject("storage.update")
+            inject("storage.update", node=self.node_id)
             self._check_target_serving(target)
             # re-check the chain AFTER taking the chunk locks (ref :377-382)
             chain = self._chain(reqs[0].chain_id)
@@ -1869,7 +1897,7 @@ class StorageService:
         for key in keys:
             self._locks.acquire(key)
         try:
-            inject("storage.write_shard")
+            inject("storage.write_shard", node=self.node_id)
             self._check_target_serving(target)
             engine = target.engine
             ops: List[EngineUpdateOp] = []
@@ -2044,11 +2072,13 @@ class StorageService:
     def _read_impl(self, req: ReadReq) -> ReadReply:
         from tpu3fs.qos.core import TrafficClass
 
+        if self._deadline_expired():
+            return ReadReply(Code.DEADLINE_EXCEEDED)
         lease, shed_ms = self._admit_read(TrafficClass.FG_READ)
         if shed_ms is not None:
             return ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
         try:
-            inject("storage.read")
+            inject("storage.read", node=self.node_id)
             target_id = self._resolve_read_target(req)
             engine = self._targets[target_id].engine
             # one engine-lock hold for data+ver+crc (full-content reads

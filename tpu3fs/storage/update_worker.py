@@ -44,12 +44,13 @@ from typing import Callable, List, Optional
 from tpu3fs.analytics import spans as _spans
 from tpu3fs.qos.core import TrafficClass, format_retry_after
 from tpu3fs.qos.scheduler import WeightedFairQueue, WfqPolicy
+from tpu3fs.rpc import deadline as _deadline
 from tpu3fs.utils.result import Code
 
 
 class _Job:
     __slots__ = ("reqs", "replies", "done", "make_reply", "tclass",
-                 "cost", "enq_ts", "sub_ts", "trace")
+                 "cost", "enq_ts", "sub_ts", "trace", "deadline")
 
     def __init__(self, reqs, make_reply, tclass):
         self.reqs = reqs
@@ -62,19 +63,28 @@ class _Job:
         # attributed to the trace that experienced it
         self.sub_ts = time.monotonic()
         self.trace = _spans.current_trace()
+        # the submitter's absolute deadline (rode the RPC envelope /
+        # ambient context): checked again at ROUND START so work whose
+        # caller gave up while it queued is shed, never executed
+        self.deadline = _deadline.current_deadline()
         self.replies: Optional[list] = None
         self.done = threading.Event()
 
 
-def _shed_replies(job: _Job, retry_after_ms: int) -> list:
-    msg = format_retry_after(retry_after_ms, "update queue full")
+def _failure_replies(job: _Job, code: Code, msg: str,
+                     retry_after_ms: int = 0) -> list:
     try:
-        return [job.make_reply(Code.OVERLOADED, msg, retry_after_ms)
+        return [job.make_reply(code, msg, retry_after_ms)
                 for _ in job.reqs]
     except TypeError:
         # legacy two-arg make_reply (tests, older callers): the hint
         # still rides the message
-        return [job.make_reply(Code.OVERLOADED, msg) for _ in job.reqs]
+        return [job.make_reply(code, msg) for _ in job.reqs]
+
+
+def _shed_replies(job: _Job, retry_after_ms: int) -> list:
+    msg = format_retry_after(retry_after_ms, "update queue full")
+    return _failure_replies(job, Code.OVERLOADED, msg, retry_after_ms)
 
 
 class UpdateWorker:
@@ -229,6 +239,25 @@ class UpdateWorker:
         """Execute one coalesced round and distribute replies. Runs on the
         worker thread OR inline on a submitting thread (never both at
         once: _active guards)."""
+        # DEQUEUE-TIME deadline shed: a job whose submitter's deadline
+        # passed while it waited in the queue is answered (retryable)
+        # DEADLINE_EXCEEDED here — expired work never reaches the engine
+        # stage (the second shed point of rpc/deadline.py; the first is
+        # RPC admission)
+        now_w = time.time()
+        live: List[_Job] = []
+        for j in round_jobs:
+            if j.deadline is not None and now_w > j.deadline:
+                _deadline.record_shed("dequeue")
+                j.replies = _failure_replies(
+                    j, Code.DEADLINE_EXCEEDED,
+                    "deadline passed in update queue")
+                j.done.set()
+            else:
+                live.append(j)
+        round_jobs = live
+        if not round_jobs:
+            return
         reqs = [r for j in round_jobs for r in j.reqs]
         # trace plumbing: per-job queue-wait stage spans, then the round
         # executes under a round scope so the runner's stage/forward/

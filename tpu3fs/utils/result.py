@@ -35,6 +35,9 @@ class Code(enum.IntEnum):
     QUEUE_FULL = 106
     SHUTTING_DOWN = 107
     OVERLOADED = 108         # QoS shed: retryable, carries retry-after hint
+    DEADLINE_EXCEEDED = 109  # the op's absolute deadline passed: work shed
+    #                          at RPC admission / update-queue dequeue, or a
+    #                          client ladder gave up (docs/robustness.md)
 
     # RPC 2xx
     RPC_CONNECT_FAILED = 200
@@ -44,6 +47,9 @@ class Code(enum.IntEnum):
     RPC_METHOD_NOT_FOUND = 204
     RPC_SERVICE_NOT_FOUND = 205
     RPC_PEER_CLOSED = 206
+    PEER_UNHEALTHY = 207     # circuit breaker open for this peer: the call
+    #                          failed FAST without touching the wire — retry
+    #                          after a routing refresh (docs/robustness.md)
 
     # KV / transaction 3xx
     KV_CONFLICT = 300
@@ -149,6 +155,13 @@ RETRYABLE_CODES = frozenset(
         # retries: routing is lagging (startup/failover) — clients should
         # back off and ladder, not fail the write
         Code.NO_SUCCESSOR,
+        # the server shed work whose deadline had already passed; a caller
+        # with budget left may re-issue (ladders check their own deadline
+        # before each retry, so an expired caller stops immediately)
+        Code.DEADLINE_EXCEEDED,
+        # breaker fail-fast: the peer is suspected sick — refresh routing
+        # and retry (the half-open probe re-tests the peer independently)
+        Code.PEER_UNHEALTHY,
     }
 )
 
