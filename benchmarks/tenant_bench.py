@@ -1,0 +1,275 @@
+"""tenant_bench: a well-behaved tenant's latency under a same-class
+noisy neighbor, per-tenant quotas + nested WFQ ON vs OFF.
+
+The many-tenant flood shape of the tenancy acceptance criteria: ONE
+storage node, a small update queue (so queue positions are the scarce
+resource), a paced VICTIM tenant issuing foreground writes+reads at its
+own rhythm, and N flooder threads of a NOISY tenant pushing foreground
+writes as fast as the node lets them — demand >= 4x the noisy tenant's
+configured bytes/s quota. Both tenants ride the same ``fg`` classes:
+class-level QoS cannot tell them apart by construction.
+
+Three modes, INTERLEAVED round-robin on one fabric (the write_bench
+discipline: host drift lands on every arm equally; quota flips between
+segments exercise the hot-reconfigure path for free):
+
+- ALONE: no flood; the victim's baseline latency distribution.
+- ON: the noisy tenant's bytes/s bucket sheds its excess at admission
+  with the retryable ``TENANT_THROTTLED`` (tenant.shed > 0) BEFORE it
+  can occupy queue slots; survivors share the class's capacity with the
+  victim by nested-WFQ weight; fg CLASS-level sheds stay ~0 (the class
+  is never the thing that overflows).
+- OFF (quota table cleared): the seed behavior inside one class — noisy
+  and victim race FIFO for queue slots and the victim's tail rides the
+  flood's backlog.
+
+Prints ONE JSON line (bench.py conventions):
+  {"metric": "victim_p99_vs_alone_ratio", "value": <on p99 / alone p99>,
+   "alone_p99_ms": ..., "on_p99_ms": ..., "off_p99_ms": ...,
+   "noisy_demand_ratio": ..., "tenant_sheds": ..., "fg_class_sheds": ...}
+
+Acceptance (BENCH_TENANT.json): with the noisy tenant flooding at >= 4x
+its quota, victim_p99_vs_alone_ratio <= 1.5 and tenant_sheds > 0 with
+fg class-level sheds ~ 0.
+
+Usage: python -m benchmarks.tenant_bench [--seconds 6] [--rounds 3]
+           [--flooders 6] [--queue-cap 16] [--json-out BENCH_TENANT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.qos.core import QosConfig
+from tpu3fs.storage.craq import ReadReq, WriteReq
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.tenant import registry, tenant_scope
+from tpu3fs.utils.result import Code
+
+CHUNK_SIZE = 1 << 16
+CHUNKS = 16
+BATCH = 2      # noisy ops per batch (one update-worker job): the
+#                victim's worst queue wait is ONE admitted round
+#                (non-preemptive), so round size bounds its tail
+
+
+def _pct(vals: List[float], p: float) -> float:
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(p * len(vals)))]
+
+
+class _Flood:
+    """Noisy-tenant flooder threads, pausable between segments."""
+
+    def __init__(self, fab, node_id: int, chain: int, flooders: int):
+        self.stats = {"attempt_bytes": 0, "ok": 0,
+                      "tenant_sheds": 0, "class_sheds": 0}
+        self._lock = threading.Lock()
+        self._run = threading.Event()
+        self._stop = threading.Event()
+        ver = fab.routing().chains[chain].chain_version
+        payload = b"n" * CHUNK_SIZE
+
+        def loop(fid: int) -> None:
+            i = 0
+            with tenant_scope("noisy"):
+                while not self._stop.is_set():
+                    if not self._run.is_set():
+                        self._run.wait(0.05)
+                        continue
+                    i += 1
+                    reqs = [WriteReq(
+                        chain_id=chain, chain_ver=ver,
+                        chunk_id=ChunkId(5000 + fid,
+                                         (i * BATCH + j) % 64),
+                        offset=0, data=payload, chunk_size=CHUNK_SIZE,
+                        client_id=f"noisy-{fid}", channel_id=1 + j,
+                        seqnum=i)
+                        for j in range(BATCH)]
+                    out = fab.send(node_id, "batch_write", reqs)
+                    t_shed = sum(1 for r in out
+                                 if r.code == Code.TENANT_THROTTLED)
+                    c_shed = sum(1 for r in out
+                                 if r.code == Code.OVERLOADED)
+                    with self._lock:
+                        self.stats["attempt_bytes"] += \
+                            len(reqs) * CHUNK_SIZE
+                        self.stats["ok"] += sum(1 for r in out if r.ok)
+                        self.stats["tenant_sheds"] += t_shed
+                        self.stats["class_sheds"] += c_shed
+                    if t_shed or c_shed:
+                        # back off a token 5ms (a fraction of the hint):
+                        # an aggressive client, not a pure GIL spin
+                        time.sleep(0.005)
+
+        self._threads = [threading.Thread(target=loop, args=(f,))
+                         for f in range(flooders)]
+        for t in self._threads:
+            t.start()
+
+    def resume(self) -> None:
+        self._run.set()
+
+    def pause(self) -> None:
+        self._run.clear()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._run.set()
+        for t in self._threads:
+            t.join()
+
+
+def run_bench(*, seconds: float = 6.0, rounds: int = 3,
+              flooders: int = 6, queue_cap: int = 16,
+              engine: str = "mem", engine_dir: Optional[str] = None,
+              noisy_quota_bps: float = float(4 << 20)) -> dict:
+    # noisy burst deliberately small: a deep burst admits a queue-cap of
+    # backlog in one instant — the head-of-line spike quotas prevent
+    quota_spec = (f"tenant=noisy,weight=1,"
+                  f"bytes_per_s={int(noisy_quota_bps)},burst_s=0.1;"
+                  f"tenant=victim,weight=4")
+    registry().clear()
+    qcfg = QosConfig()
+    qcfg.set("update_queue_cap", queue_cap)
+    fab = Fabric(SystemSetupConfig(
+        num_storage_nodes=1, num_chains=1, num_replicas=1,
+        chunk_size=CHUNK_SIZE, engine=engine, engine_dir=engine_dir,
+        qos=qcfg))
+    seg = max(0.2, seconds / (rounds * 3))
+    lats: Dict[str, Dict[str, List[float]]] = {
+        m: {"w": [], "r": []} for m in ("alone", "on", "off")}
+    flood_windows = {"on": 0.0, "off": 0.0}
+    sheds_on = [0, 0]   # [tenant sheds, class sheds] during ON windows
+    try:
+        chain = fab.chain_ids[0]
+        node_id = min(fab.nodes)
+        sc = fab.storage_client()
+        payload = b"v" * CHUNK_SIZE
+        with tenant_scope("victim"):
+            for i in range(CHUNKS):
+                assert sc.write_chunk(chain, ChunkId(1, i), 0, payload,
+                                      chunk_size=CHUNK_SIZE).ok
+        flood = _Flood(fab, node_id, chain, flooders)
+
+        def victim_segment(mode: str) -> None:
+            t_end = time.monotonic() + seg
+            i = 0
+            with tenant_scope("victim"):
+                while time.monotonic() < t_end:
+                    i += 1
+                    t0 = time.perf_counter()
+                    w = sc.write_chunk(chain, ChunkId(1, i % CHUNKS), 0,
+                                       payload, chunk_size=CHUNK_SIZE)
+                    lats[mode]["w"].append(time.perf_counter() - t0)
+                    assert w.ok, w.code
+                    t0 = time.perf_counter()
+                    r = fab.send(node_id, "read", ReadReq(
+                        chain_id=chain, chunk_id=ChunkId(1, i % CHUNKS),
+                        offset=0, length=CHUNK_SIZE))
+                    lats[mode]["r"].append(time.perf_counter() - t0)
+                    assert r.ok, r.code
+                    time.sleep(0.002)
+
+        for _ in range(rounds):
+            # ALONE: flood paused; let the queue drain first
+            flood.pause()
+            time.sleep(0.1)
+            victim_segment("alone")
+            # ON: quotas armed (the hot-reconfigure path), flood running
+            registry().configure(quota_spec)
+            before = dict(flood.stats)
+            flood.resume()
+            time.sleep(0.1)  # burst decays; steady state is the claim
+            victim_segment("on")
+            flood_windows["on"] += seg + 0.1
+            sheds_on[0] += flood.stats["tenant_sheds"] \
+                - before["tenant_sheds"]
+            sheds_on[1] += flood.stats["class_sheds"] \
+                - before["class_sheds"]
+            # OFF: quota table cleared live, flood still running
+            registry().clear()
+            time.sleep(0.05)
+            victim_segment("off")
+            flood_windows["off"] += seg + 0.05
+        flood.stop()
+        stats = flood.stats
+    finally:
+        fab.close()
+        registry().clear()
+
+    def p99_ms(mode: str, axis: str) -> float:
+        return round(_pct(lats[mode][axis], 0.99) * 1e3, 3)
+
+    demand_bps = stats["attempt_bytes"] / max(
+        flood_windows["on"] + flood_windows["off"], 1e-6)
+    return {
+        "metric": "victim_p99_vs_alone_ratio",
+        "value": round(
+            p99_ms("on", "w") / max(p99_ms("alone", "w"), 1e-6), 3),
+        "unit": "ratio",
+        "alone_p99_ms": p99_ms("alone", "w"),
+        "on_p99_ms": p99_ms("on", "w"),
+        "off_p99_ms": p99_ms("off", "w"),
+        "off_vs_alone_ratio": round(
+            p99_ms("off", "w") / max(p99_ms("alone", "w"), 1e-6), 3),
+        "read_alone_p99_ms": p99_ms("alone", "r"),
+        "read_on_p99_ms": p99_ms("on", "r"),
+        "read_off_p99_ms": p99_ms("off", "r"),
+        "victim_ops": {m: len(lats[m]["w"]) + len(lats[m]["r"])
+                       for m in lats},
+        "noisy_demand_bps": round(demand_bps),
+        "noisy_demand_ratio": round(demand_bps / noisy_quota_bps, 2),
+        "noisy_ok_writes": stats["ok"],
+        "tenant_sheds": sheds_on[0],
+        "fg_class_sheds": sheds_on[1],
+        "config": {"seconds": seconds, "rounds": rounds,
+                   "flooders": flooders, "queue_cap": queue_cap,
+                   "engine": engine,
+                   "noisy_quota_bps": noisy_quota_bps,
+                   "chunk_size": CHUNK_SIZE, "batch": BATCH},
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=6.0)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--flooders", type=int, default=6)
+    ap.add_argument("--queue-cap", type=int, default=16)
+    ap.add_argument("--engine", default="native")
+    ap.add_argument("--engine-dir", default="/dev/shm")
+    ap.add_argument("--noisy-quota-mbps", type=float, default=4.0,
+                    help="noisy tenant bytes/s quota, MiB/s")
+    ap.add_argument("--json-out", default="")
+    args = ap.parse_args()
+
+    record = run_bench(
+        seconds=args.seconds, rounds=args.rounds,
+        flooders=args.flooders, queue_cap=args.queue_cap,
+        engine=args.engine, engine_dir=args.engine_dir or None,
+        noisy_quota_bps=args.noisy_quota_mbps * (1 << 20))
+    line = json.dumps(record)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    ok = (record["value"] <= 1.5
+          and record["noisy_demand_ratio"] >= 4.0
+          and record["tenant_sheds"] > 0)
+    print(f"acceptance: victim p99 ratio {record['value']} <= 1.5: "
+          f"{record['value'] <= 1.5}; demand ratio "
+          f"{record['noisy_demand_ratio']} >= 4: "
+          f"{record['noisy_demand_ratio'] >= 4.0}; tenant sheds "
+          f"{record['tenant_sheds']} > 0: {record['tenant_sheds'] > 0}; "
+          f"fg class sheds {record['fg_class_sheds']}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

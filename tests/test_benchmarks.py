@@ -213,6 +213,29 @@ class TestNorthstarBench:
         assert out["e2e_rebuild_bytes"] > 0
 
 
+class TestTenantBench:
+    """benchmarks/tenant_bench fast-mode smoke: the noisy-neighbor
+    scenario scaled down — quota sheds fire, the class never sheds, and
+    the victim keeps completing ops in every mode."""
+
+    def test_small_run(self):
+        from benchmarks.tenant_bench import run_bench
+        from tpu3fs.tenant import registry
+
+        out = run_bench(seconds=1.2, rounds=1, flooders=3,
+                        queue_cap=16, engine="mem",
+                        noisy_quota_bps=float(1 << 20))
+        registry().clear()
+        assert out["tenant_sheds"] > 0          # noisy excess shed
+        assert out["fg_class_sheds"] == 0       # ...by ITS bucket only
+        assert out["noisy_demand_ratio"] >= 4.0
+        for mode, ops in out["victim_ops"].items():
+            assert ops > 0, mode
+        assert out["alone_p99_ms"] > 0 and out["on_p99_ms"] > 0
+        # no latency acceptance at smoke scale (single tiny segment on a
+        # loaded CI host); BENCH_TENANT.json carries the measured claim
+
+
 class TestEcBench:
     """benchmarks/ec_bench fast-mode smoke: encode kernel, fused vs
     encode-then-write EC writes, delta-parity RMW, degraded reads, and

@@ -1,0 +1,643 @@
+"""Multi-tenant fairness (tpu3fs/tenant): wire codec tolerance, ContextVar
+inheritance, nested per-tenant WFQ, quota enforcement, attribution."""
+
+import threading
+import time
+
+import pytest
+
+from tpu3fs.analytics import spans as _spans
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.qos.core import AdmissionController, QosConfig, TrafficClass, tagged
+from tpu3fs.qos.scheduler import WeightedFairQueue, WfqPolicy
+from tpu3fs.rpc import deadline as dl
+from tpu3fs.rpc.net import RpcClient, RpcServer, ServiceDef
+from tpu3fs.rpc.services import EchoReq, EchoRsp
+from tpu3fs.storage.craq import WriteReq, _OverlapForward
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.tenant import (
+    DEFAULT_TENANT,
+    current_tenant,
+    decode_tenant,
+    registry,
+    resolved_tenant,
+    tenant_scope,
+)
+from tpu3fs.tenant.identity import append_wire, valid_tenant
+from tpu3fs.tenant.quota import TenantConfig, apply_tenant_config, parse_spec
+from tpu3fs.utils.result import Code, FsError
+
+CHUNK = 1 << 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """The tenant registry is process-global: every test starts and ends
+    permissive so quota state can never leak across tests."""
+    registry().clear()
+    yield
+    registry().clear()
+
+
+# -- wire codec ---------------------------------------------------------------
+
+
+class TestTenantWireCodec:
+    def test_bare_round_trip(self):
+        msg = append_wire("", "alice")
+        assert msg == "u1.alice"
+        assert decode_tenant(msg) == "alice"
+
+    def test_composes_with_trace_and_deadline_all_parsers(self):
+        """NEW encoder -> the trace, deadline AND tenant decoders each
+        read their own field (appended-fields tolerance everywhere)."""
+        ctx = _spans.TraceContext("a" * 16, "b" * 16, sampled=True)
+        t = time.time() + 2.0
+        for base in (ctx.to_wire(),
+                     dl.encode_envelope("", t),
+                     dl.encode_envelope(ctx.to_wire(), t)):
+            msg = append_wire(base, "alice")
+            assert decode_tenant(msg) == "alice", msg
+        full = append_wire(dl.encode_envelope(ctx.to_wire(), t), "bob")
+        back = _spans.decode_wire(full)          # old trace-only parser
+        assert back is not None and back.trace_id == "a" * 16
+        assert back.sampled
+        assert dl.decode_deadline(full) == pytest.approx(t, abs=1e-5)
+        assert decode_tenant(full) == "bob"
+
+    def test_old_messages_decode_to_none(self):
+        """OLD encoders (trace-only, deadline-only, empty, junk) -> no
+        tenant; no exception either direction."""
+        ctx = _spans.TraceContext("a" * 16, "b" * 16)
+        for legacy in ("", ctx.to_wire(),
+                       dl.encode_envelope("", time.time() + 1),
+                       dl.encode_envelope(ctx.to_wire(), time.time() + 1),
+                       "retry_after_ms=5", "u1.", "u1", "t1.x"):
+            assert decode_tenant(legacy) is None, legacy
+
+    def test_trace_fields_spelling_u1_not_misread(self):
+        """A trace/span id that happens to spell 'u1' is positional trace
+        payload, never a tenant introducer."""
+        assert decode_tenant("t1.u1.bbbb.1") is None
+        assert decode_tenant("t1.aaaa.u1.1") is None
+        # ...but a REAL tenant after those fields still parses
+        assert decode_tenant("t1.u1.u1.1.u1.alice") == "alice"
+
+    def test_invalid_names(self):
+        assert not valid_tenant("")
+        assert not valid_tenant("has.dot")
+        assert not valid_tenant("UPPER")
+        assert not valid_tenant("x" * 65)
+        assert valid_tenant("ab-c_9")
+        # append_wire drops invalid names instead of corrupting envelopes
+        assert append_wire("t1.a.b.0", "has.dot") == "t1.a.b.0"
+        with pytest.raises(ValueError):
+            with tenant_scope("has.dot"):
+                pass
+
+    def test_scope_resolution(self):
+        assert current_tenant() is None
+        assert resolved_tenant() == DEFAULT_TENANT
+        with tenant_scope("alice"):
+            assert current_tenant() == "alice"
+            with tenant_scope("bob"):     # innermost explicit scope wins
+                assert resolved_tenant() == "bob"
+            assert resolved_tenant() == "alice"
+        assert current_tenant() is None
+
+
+# -- quota table --------------------------------------------------------------
+
+
+class TestQuotaTable:
+    def test_parse_validates(self):
+        table = parse_spec(
+            "tenant=alice,weight=4,bytes_per_s=1048576,iops=200,"
+            "kvcache_bytes=1073741824;tenant=default,weight=1")
+        assert table["alice"].weight == 4
+        assert table["alice"].bytes_per_s == 1048576
+        assert table["default"].weight == 1
+        for bad in ("weight=4", "tenant=has.dot", "tenant=a,weight=0",
+                    "tenant=a,nope=1", "tenant=a;tenant=a",
+                    "tenant=a,iops=x"):
+            with pytest.raises(ValueError):
+                parse_spec(bad)
+
+    def test_default_fallback_and_weights(self):
+        registry().configure("tenant=alice,weight=4;tenant=default,weight=2")
+        assert registry().weight("alice") == 4
+        assert registry().weight("nobody") == 2  # default row applies
+
+    def test_iops_shed_with_hint(self):
+        registry().configure("tenant=a,iops=2,burst_s=1")
+        assert registry().try_admit("a") is None
+        assert registry().try_admit("a") is None
+        hint = registry().try_admit("a")
+        assert hint is not None and hint >= 50
+        assert registry().shed_total("a") >= 1
+        # another tenant is untouched (default = unlimited)
+        assert registry().try_admit("b") is None
+
+    def test_bytes_shed(self):
+        registry().configure("tenant=a,bytes_per_s=1000,burst_s=1")
+        assert registry().try_admit("a", nbytes=900) is None
+        hint = registry().try_admit("a", nbytes=900)
+        assert hint is not None
+        tot = registry().totals()["a"]
+        assert tot["bytes"] == 900 and tot["shed_bytes"] >= 1
+
+    def test_hot_reconfigure_in_place(self):
+        registry().configure("tenant=a,iops=1,burst_s=1")
+        assert registry().try_admit("a") is None
+        assert registry().try_admit("a") is not None  # bucket dry
+        registry().configure("tenant=a,iops=1000,burst_s=1")
+        time.sleep(0.01)  # refill happens at the NEW rate
+        assert registry().try_admit("a") is None      # same bucket, new rate
+
+    def test_config_binding(self):
+        cfg = TenantConfig()
+        from tpu3fs.tenant.quota import TenantRegistry
+
+        reg = TenantRegistry()
+        apply_tenant_config(cfg, reg)
+        cfg.hot_update({"spec": "tenant=z,weight=7"})
+        assert reg.weight("z") == 7
+        with pytest.raises(ValueError):
+            cfg.hot_update({"spec": "tenant=:::"})  # checker rejects
+        assert reg.weight("z") == 7  # table untouched by the bad push
+
+    def test_disabled_admits_everything(self):
+        registry().configure("tenant=a,iops=1", enabled=False)
+        for _ in range(10):
+            assert registry().try_admit("a") is None
+
+
+# -- RPC dispatch: resolution, scoping, enforcement ---------------------------
+
+
+class _TenantEcho:
+    """Bound under the SimpleExample name so the enforcement table's
+    BYTES row applies to this test service."""
+
+
+def _tenant_echo_server():
+    server = RpcServer()
+    s = ServiceDef(90, "SimpleExample")
+    seen = []
+
+    def handler(req):
+        seen.append(resolved_tenant())
+        return EchoRsp(resolved_tenant())
+
+    s.method(1, "write", EchoReq, EchoRsp, handler)
+    server.add_service(s)
+    server.start()
+    return server, seen
+
+
+class TestRpcDispatchTenancy:
+    def test_tenant_rides_envelope_and_scopes_handler(self):
+        server, seen = _tenant_echo_server()
+        try:
+            client = RpcClient()
+            with tenant_scope("alice"):
+                rsp = client.call(server.address, 90, 1, EchoReq("x"),
+                                  EchoRsp)
+            assert rsp.text == "alice" and seen == ["alice"]
+            # untenanted legacy client resolves the default owner
+            rsp = client.call(server.address, 90, 1, EchoReq("y"), EchoRsp)
+            assert rsp.text == DEFAULT_TENANT
+        finally:
+            server.stop()
+
+    def test_quota_shed_at_dispatch_before_handler(self):
+        registry().configure("tenant=noisy,iops=1,burst_s=1")
+        server, seen = _tenant_echo_server()
+        try:
+            client = RpcClient()
+            with tenant_scope("noisy"):
+                assert client.call(server.address, 90, 1, EchoReq("a"),
+                                   EchoRsp).text == "noisy"
+                with pytest.raises(FsError) as ei:
+                    client.call(server.address, 90, 1, EchoReq("b"),
+                                EchoRsp)
+            assert ei.value.code == Code.TENANT_THROTTLED
+            from tpu3fs.qos.core import retry_after_ms_of
+
+            assert retry_after_ms_of(ei.value.status.message) >= 1
+            assert seen == ["noisy"]  # the shed call never ran
+            # a well-behaved tenant on the same method is untouched
+            with tenant_scope("polite"):
+                assert client.call(server.address, 90, 1, EchoReq("c"),
+                                   EchoRsp).text == "polite"
+        finally:
+            server.stop()
+
+    def test_throttle_is_retryable(self):
+        from tpu3fs.utils.result import Status
+
+        assert Status(Code.TENANT_THROTTLED).retryable()
+
+
+# -- ContextVar inheritance ---------------------------------------------------
+
+
+class TestContextInheritance:
+    def test_worker_pool_carries_tenant(self):
+        from tpu3fs.utils.executor import WorkerPool
+
+        pool = WorkerPool("tenant-test", num_workers=2, queue_cap=8)
+        try:
+            out = []
+            with tenant_scope("alice"):
+                f = pool.submit(lambda: out.append(resolved_tenant()))
+            f.get(timeout=5)
+            assert out == ["alice"]
+        finally:
+            pool.shutdown(wait=True)
+
+    def test_overlap_forward_carries_tenant(self):
+        got = []
+        with tenant_scope("bob"):
+            fwd = _OverlapForward(lambda: got.append(resolved_tenant()))
+        fwd.join()
+        assert got == ["bob"]
+
+    def test_plain_thread_does_not_inherit(self):
+        """The control: ContextVars don't cross plain threads — the
+        machinery above is what carries the tenant."""
+        got = []
+        with tenant_scope("alice"):
+            t = threading.Thread(
+                target=lambda: got.append(resolved_tenant()))
+            t.start()
+            t.join()
+        assert got == [DEFAULT_TENANT]
+
+    def test_update_worker_job_captures_tenant(self):
+        from tpu3fs.storage.update_worker import _Job
+
+        with tenant_scope("carol"):
+            job = _Job([object()], lambda c, m, ra=0: (c, m),
+                       TrafficClass.FG_WRITE)
+        assert job.tenant == "carol"
+        job2 = _Job([object()], lambda c, m, ra=0: (c, m),
+                    TrafficClass.FG_WRITE)
+        assert job2.tenant == DEFAULT_TENANT
+
+    def test_prefetcher_carries_tenant_detaches_trace(self):
+        from tpu3fs.client.prefetch import (
+            PrefetchConfig,
+            ReadaheadPrefetcher,
+        )
+
+        seen = []
+
+        class _Inode:
+            id = 7
+            length = 1 << 20
+
+        def fetch(inode, start, n):
+            seen.append((resolved_tenant(), _spans.current_trace()))
+            return b"x" * n
+
+        pf = ReadaheadPrefetcher(fetch, PrefetchConfig(window_bytes=4096))
+        try:
+            with tenant_scope("alice"), \
+                    _spans.trace_scope(_spans.TraceContext("t" * 16,
+                                                           "s" * 16)):
+                pf._submit(_Inode(), 0, 4096, 0, TrafficClass.FG_READ,
+                           current_tenant(), threading.Event())
+            deadline = time.time() + 5
+            while not seen and time.time() < deadline:
+                time.sleep(0.01)
+            assert seen, "prefetch job never ran"
+            tenant, trace = seen[0]
+            assert tenant == "alice"   # quota charges the arming reader
+            assert trace is None       # ...but the trace is detached
+        finally:
+            pf.close()
+
+
+# -- nested per-tenant WFQ ----------------------------------------------------
+
+
+class _Item:
+    def __init__(self, tag, cost=1):
+        self.tag = tag
+        self.cost = cost
+
+
+class TestNestedWfq:
+    def test_same_class_tenants_split_by_weight(self):
+        registry().configure("tenant=big,weight=3;tenant=small,weight=1")
+        q = WeightedFairQueue(WfqPolicy(QosConfig()), cap=64)
+        for i in range(12):
+            assert q.try_push(_Item(f"b{i}"), TrafficClass.FG_WRITE,
+                              "big") is None
+            assert q.try_push(_Item(f"s{i}"), TrafficClass.FG_WRITE,
+                              "small") is None
+        order = [q.pop()[0].tag for _ in range(16)]
+        # the first 16 pops should serve big ~3x as often as small
+        big = sum(1 for t in order if t.startswith("b"))
+        small = sum(1 for t in order if t.startswith("s"))
+        assert big == 12 and small == 4, order
+
+    def test_fifo_within_lane(self):
+        q = WeightedFairQueue(WfqPolicy(QosConfig()), cap=64)
+        for i in range(6):
+            q.try_push(_Item(i), TrafficClass.FG_WRITE, "a")
+        got = [q.pop()[0].tag for _ in range(6)]
+        assert got == [0, 1, 2, 3, 4, 5]
+
+    def test_new_lane_no_banked_credit(self):
+        """A tenant that idles does not bank virtual time: once it shows
+        up it shares from NOW instead of monopolizing the queue."""
+        registry().configure("tenant=a,weight=1;tenant=late,weight=1")
+        q = WeightedFairQueue(WfqPolicy(QosConfig()), cap=256)
+        for i in range(50):
+            q.try_push(_Item(f"a{i}"), TrafficClass.FG_WRITE, "a")
+        for _ in range(40):
+            q.pop()
+        for i in range(10):
+            q.try_push(_Item(f"l{i}"), TrafficClass.FG_WRITE, "late")
+        nxt = [q.pop()[0].tag for _ in range(4)]
+        # alternating-ish, not 10 straight "late" pops
+        assert any(t.startswith("a") for t in nxt), nxt
+
+    def test_class_ordering_unchanged_across_classes(self):
+        """The class level still outweighs: fg (8) vs gc (1)."""
+        q = WeightedFairQueue(WfqPolicy(QosConfig()), cap=256)
+        for i in range(16):
+            q.try_push(_Item(f"fg{i}"), TrafficClass.FG_WRITE, "t")
+            q.try_push(_Item(f"gc{i}"), TrafficClass.GC, "t")
+        first9 = [q.pop()[0].tag for _ in range(9)]
+        assert sum(1 for t in first9 if t.startswith("fg")) == 8
+
+    def test_pop_matching_only_lane_heads(self):
+        q = WeightedFairQueue(WfqPolicy(QosConfig()), cap=64)
+        q.try_push(_Item("a0"), TrafficClass.FG_WRITE, "a")
+        q.try_push(_Item("a1"), TrafficClass.FG_WRITE, "a")
+        q.try_push(_Item("b0"), TrafficClass.FG_WRITE, "b")
+        # a1 is NOT a lane head; only a0 and b0 are eligible
+        got = q.pop_matching(TrafficClass.FG_WRITE,
+                             lambda it: it.tag == "a1")
+        assert got is None
+        got = q.pop_matching(TrafficClass.FG_WRITE,
+                             lambda it: it.tag == "b0")
+        assert got is not None and got.tag == "b0"
+
+    def test_tenant_depths_and_drain(self):
+        q = WeightedFairQueue(WfqPolicy(QosConfig()), cap=64)
+        q.try_push(_Item(1), TrafficClass.FG_WRITE, "a")
+        q.try_push(_Item(2), TrafficClass.FG_WRITE, "b")
+        q.try_push(_Item(3), TrafficClass.GC, "a")
+        assert q.tenant_depths() == {
+            (TrafficClass.FG_WRITE, "a"): 1,
+            (TrafficClass.FG_WRITE, "b"): 1,
+            (TrafficClass.GC, "a"): 1,
+        }
+        assert len(q.drain()) == 3 and len(q) == 0
+
+
+# -- storage-path quota enforcement (the fabric/in-process entry) -------------
+
+
+class TestStorageTenantQuota:
+    def _fab(self):
+        return Fabric(SystemSetupConfig(
+            num_storage_nodes=1, num_replicas=1, num_chains=1,
+            chunk_size=CHUNK, qos=QosConfig()))
+
+    def test_write_flood_sheds_tenant_throttled(self):
+        registry().configure(f"tenant=noisy,bytes_per_s={CHUNK * 2},"
+                             "burst_s=1")
+        fab = self._fab()
+        try:
+            chain = fab.chain_ids[0]
+            node = min(fab.nodes)
+            ver = fab.routing().chains[chain].chain_version
+            payload = b"n" * CHUNK
+
+            def req(i, seq):
+                return WriteReq(chain_id=chain, chain_ver=ver,
+                                chunk_id=ChunkId(1, i), offset=0,
+                                data=payload, chunk_size=CHUNK,
+                                client_id="noisy-c", channel_id=1 + i,
+                                seqnum=seq)
+
+            with tenant_scope("noisy"):
+                codes = [fab.send(node, "write", req(i, 1)).code
+                         for i in range(6)]
+            assert Code.OK in codes
+            assert Code.TENANT_THROTTLED in codes, codes
+            assert registry().shed_total("noisy") > 0
+            # the CLASS never shed: fairness came from the tenant's own
+            # bucket, not from pushing fg into overload
+            snap = fab.nodes[node].service.qos_snapshot()
+            assert snap["classes"]["fg_write"]["rate"] == 0  # class open
+            # a polite tenant writes freely through the same node
+            with tenant_scope("polite"):
+                r = fab.send(node, "write", req(50, 1))
+            assert r.ok, r.code
+        finally:
+            fab.close()
+
+    def test_read_flood_sheds_on_byte_quota(self):
+        registry().configure(f"tenant=reader,bytes_per_s={CHUNK * 2},"
+                             "burst_s=1")
+        fab = self._fab()
+        try:
+            chain = fab.chain_ids[0]
+            node = min(fab.nodes)
+            sc = fab.storage_client()
+            assert sc.write_chunk(chain, ChunkId(2, 0), 0, b"r" * CHUNK,
+                                  chunk_size=CHUNK).ok
+            from tpu3fs.storage.craq import ReadReq
+
+            with tenant_scope("reader"):
+                codes = [
+                    fab.send(node, "read",
+                             ReadReq(chain_id=chain,
+                                     chunk_id=ChunkId(2, 0),
+                                     offset=0, length=CHUNK)).code
+                    for _ in range(6)]
+            assert Code.OK in codes
+            assert Code.TENANT_THROTTLED in codes, codes
+        finally:
+            fab.close()
+
+    def test_background_recovery_not_tenant_charged(self):
+        """A resync-class full-replace install under a (tiny) tenant
+        quota is NOT charged to the tenant: system work."""
+        registry().configure("tenant=t,bytes_per_s=1,iops=1,burst_s=1")
+        fab = self._fab()
+        try:
+            chain = fab.chain_ids[0]
+            node = min(fab.nodes)
+            target = fab.nodes[node].service.targets()[0]
+            ver = fab.routing().chains[chain].chain_version
+            with tenant_scope("t"), tagged(TrafficClass.RESYNC):
+                for i in range(3):
+                    r = fab.send(node, "write", WriteReq(
+                        chain_id=chain, chain_ver=ver,
+                        chunk_id=ChunkId(3, i), offset=0,
+                        data=b"x" * 128, chunk_size=CHUNK,
+                        update_ver=1, full_replace=True,
+                        from_target=target.target_id,
+                        client_id="resync-c", channel_id=40 + i,
+                        seqnum=1))
+                    assert r.code != Code.TENANT_THROTTLED
+            assert registry().shed_total("t") == 0
+        finally:
+            fab.close()
+
+    def test_client_ladder_waits_out_throttle(self):
+        """TENANT_THROTTLED is retryable with a hint: a bucket sized so
+        the refill lands within the ladder makes the op SUCCEED, just
+        slower — the well-behaved-client contract."""
+        registry().configure(f"tenant=w,bytes_per_s={CHUNK * 8},burst_s=0.5")
+        fab = self._fab()
+        try:
+            chain = fab.chain_ids[0]
+            sc = fab.storage_client()
+            with tenant_scope("w"):
+                out = [sc.write_chunk(chain, ChunkId(4, i), 0,
+                                      b"w" * CHUNK, chunk_size=CHUNK)
+                       for i in range(8)]
+            assert all(r.ok for r in out)
+            assert registry().shed_total("w") > 0  # it DID get throttled
+        finally:
+            fab.close()
+
+
+# -- per-tenant accounting in AdmissionController -----------------------------
+
+
+class TestAdmissionAccounting:
+    def test_admits_attributed_to_ambient_tenant(self):
+        ac = AdmissionController(QosConfig())
+        with tenant_scope("alice"):
+            lease, shed = ac.try_admit("Svc", "read", TrafficClass.FG_READ)
+        assert lease is not None and shed is None
+        lease, shed = ac.try_admit("Svc", "read", TrafficClass.FG_READ,
+                                   tenant="bob")
+        assert lease is not None
+        tot = registry().totals()
+        assert tot["alice"]["admitted"] == 1
+        assert tot["bob"]["admitted"] == 1
+
+    def test_class_shed_attributed(self):
+        cfg = QosConfig()
+        cfg.set("fg_read.rate", 1.0)
+        cfg.set("fg_read.burst", 1.0)
+        ac = AdmissionController(cfg)
+        with tenant_scope("greedy"):
+            ac.try_admit("Svc", "read", TrafficClass.FG_READ)
+            lease, shed = ac.try_admit("Svc", "read",
+                                       TrafficClass.FG_READ)
+        assert lease is None and shed is not None
+        assert registry().totals()["greedy"]["shed_class"] == 1
+
+
+# -- kvcache resident budget --------------------------------------------------
+
+
+class TestKvcacheBudget:
+    def test_writer_gate_sheds_over_budget(self):
+        from tpu3fs.client.file_io import FileIoClient
+        from tpu3fs.kvcache.cache import KVCacheClient
+
+        registry().configure("tenant=infer,kvcache_bytes=1024")
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=1,
+                                       num_replicas=1, num_chains=1,
+                                       chunk_size=CHUNK))
+        try:
+            kv = KVCacheClient(fab.meta, fab.file_client(),
+                               root="/kvcache/infer", tenant="infer")
+            kv.put("k1", b"a" * 800)
+            assert registry().kvcache_resident("infer") == 800
+            kv.put("k2", b"b" * 800)   # crosses the budget
+            with pytest.raises(FsError) as ei:
+                kv.put("k3", b"c" * 10)
+            assert ei.value.code == Code.TENANT_THROTTLED
+            assert registry().totals()["infer"]["shed_kvcache"] >= 1
+            # reads still serve (budget gates WRITERS, not the cache)
+            assert kv.get("k1") == b"a" * 800
+        finally:
+            fab.close()
+
+    def test_gc_daemon_per_tenant_pass_and_gauge(self):
+        from tpu3fs.bin import kvcache_gc_main as gcmain
+        from tpu3fs.kvcache.cache import KVCacheClient
+
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=1,
+                                       num_replicas=1, num_chains=1,
+                                       chunk_size=CHUNK))
+        try:
+            kv = KVCacheClient(fab.meta, fab.file_client(),
+                               root="/kvcache/infer", tenant="infer")
+            for i in range(6):
+                kv.put(f"k{i}", bytes([i]) * 1024)
+            # the budget lands AFTER the cache filled (the usual shape:
+            # an operator reins in an already-hot tenant)
+            registry().configure("tenant=infer,kvcache_bytes=2048")
+            args = gcmain.parse_args([
+                "--root", "/kvcache", "--per-tenant", "--ttl", "86400",
+                "--once"])
+            import io
+
+            out = io.StringIO()
+            stats = gcmain.run_once(fab, args, gcs={}, out=out)
+            assert stats["tenants"] == 1
+            assert stats["removed_capacity"] >= 4  # evicted to <= 2048
+            resident = registry().kvcache_resident("infer")
+            assert 0 < resident <= 2048
+            # the writer gate reopens once under budget
+            kv.put("fresh", b"f" * 100)
+        finally:
+            fab.close()
+
+
+# -- span attribution ---------------------------------------------------------
+
+
+class TestSpanTenantTag:
+    def test_op_spans_carry_ambient_tenant(self, tmp_path):
+        tracer = _spans.tracer()
+        tracer.configure(service="test", node=1,
+                         directory=str(tmp_path), sample_rate=1.0,
+                         enabled=True)
+        try:
+            ctx = tracer.start_trace()
+            with tenant_scope("alice"):
+                tracer.finish_op(ctx, "client.op", time.time(), 0.001)
+            tracer.flush()
+            from tpu3fs.analytics import assemble
+
+            rows = assemble.load_spans([str(tmp_path)])
+            ops = [r for r in rows if r.get("op") == "client.op"]
+            assert ops and ops[0]["tenant"] == "alice"
+            top = assemble.format_top(assemble.assemble_traces(rows),
+                                      rows, by_tenant=True)
+            assert "alice" in top
+        finally:
+            tracer.configure(enabled=False)
+
+
+# -- registry check 6 ---------------------------------------------------------
+
+
+class TestEnforcementTable:
+    def test_registry_check_is_clean(self):
+        import tools.check_rpc_registry as chk
+
+        errors, _notes = chk.run_checks()
+        assert errors == []
+
+    def test_every_row_classified(self):
+        from tpu3fs.rpc.idempotency import CLASSIFICATION
+        from tpu3fs.tenant.enforcement import enforcement_of
+
+        for svc, name in CLASSIFICATION:
+            assert enforcement_of(svc, name) is not None, (svc, name)

@@ -278,7 +278,8 @@ class TestInvariantsOnNewPath:
         svc, _ = _head_service(rpc_cluster, chain)
 
         class _DenyAll:
-            def try_admit(self, service, method, tclass, cost=1.0):
+            def try_admit(self, service, method, tclass, cost=1.0,
+                          tenant=None):
                 return None, 25
 
         svc._qos = _DenyAll()

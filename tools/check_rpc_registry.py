@@ -26,6 +26,13 @@ and verifies, per deployment unit:
    messenger method the hedged-read client may back up with a second
    replica request resolves to a method classified IDEMPOTENT. Hedging
    can never silently grow onto a mutating RPC.
+6. TENANCY — every bound method has a tenant-quota enforcement
+   classification in ``tpu3fs/tenant/enforcement.py`` (no stale rows),
+   so every envelope-bearing dispatch path resolves a tenant and knows
+   which buckets to charge; and a DATA-PLANE method (one whose untagged
+   QoS classification is foreground read/write, on the data-plane
+   services) can never classify ``exempt`` and silently dodge quota
+   enforcement.
 
 Cross-binary service-id reuse (Kv and MonitorCollector both use 5) is
 reported as a note, not a failure — they never share a process.
@@ -293,6 +300,56 @@ def check_idempotency(registries: List[_Registry]) -> List[str]:
     return errors
 
 
+# -- tenancy -----------------------------------------------------------------
+
+#: services whose methods ARE the data plane: a foreground-classified
+#: method here must charge tenant quotas (bytes/iops), never exempt
+_DATA_PLANE_SERVICES = frozenset({"StorageSerde", "MetaSerde",
+                                  "SimpleExample"})
+
+
+def check_tenancy(registries: List[_Registry]) -> List[str]:
+    """Every bound method tenant-classified; data plane enforced
+    (check 6 — the idempotency-table pattern for tpu3fs/tenant)."""
+    from tpu3fs.tenant.enforcement import (
+        BYTES,
+        ENFORCEMENT,
+        EXEMPT,
+        IOPS,
+        enforcement_of,
+    )
+
+    errors: List[str] = []
+    bound = set()
+    for reg in registries:
+        for service in reg.services.values():
+            for m in service.methods.values():
+                bound.add((service.name, m.name))
+    for svc, name in sorted(bound):
+        kind = enforcement_of(svc, name)
+        if kind is None:
+            errors.append(
+                f"{svc}.{name}: no tenant-quota enforcement "
+                "classification (add to tpu3fs/tenant/enforcement.py)")
+            continue
+        if kind not in (BYTES, IOPS, EXEMPT):
+            errors.append(
+                f"{svc}.{name}: unknown enforcement kind {kind!r}")
+            continue
+        if svc in _DATA_PLANE_SERVICES and kind == EXEMPT:
+            tclass = default_class_for(name)
+            if tclass in (TrafficClass.FG_READ, TrafficClass.FG_WRITE):
+                errors.append(
+                    f"{svc}.{name}: foreground data-plane method "
+                    "classified 'exempt' — tenant quotas would never "
+                    "charge it (classify bytes or iops)")
+    for svc, name in sorted(set(ENFORCEMENT) - bound):
+        errors.append(
+            f"tenant enforcement table lists {svc}.{name} but no binary "
+            "binds it (stale row)")
+    return errors
+
+
 # -- driver ------------------------------------------------------------------
 
 def run_checks() -> Tuple[List[str], List[str]]:
@@ -305,6 +362,7 @@ def run_checks() -> Tuple[List[str], List[str]]:
     except ValueError as e:  # duplicate service/method id at bind time
         return errors + [str(e)], []
     errors.extend(check_idempotency(registries))
+    errors.extend(check_tenancy(registries))
 
     # cross-binary id reuse (informational)
     by_id: Dict[int, set] = {}

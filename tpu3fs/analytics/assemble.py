@@ -118,6 +118,12 @@ class TraceTree:
         return sorted({f"{r.get('service', '')}:{r.get('node', 0)}"
                        for r in self.rows})
 
+    def tenants(self) -> List[str]:
+        """Tenant tags this trace's op spans carry (tpu3fs/tenant):
+        empty for pre-tenancy span files."""
+        return sorted({r.get("tenant", "") for r in self.rows
+                       if r.get("tenant")})
+
 
 def assemble_traces(rows: Sequence[dict]) -> Dict[str, TraceTree]:
     groups: Dict[str, List[dict]] = {}
@@ -204,19 +210,62 @@ def top_traces(trees: Dict[str, TraceTree], n: int = 10) -> List[TraceTree]:
     return sorted(trees.values(), key=key)[:max(1, n)]
 
 
+def tenant_percentiles(rows: Sequence[dict]) -> Dict[str, dict]:
+    """tenant -> {count, p50, p90, p99, total_ms, bytes} over every
+    tenant-tagged OP span: the "who is hurting whom" rollup of trace-top
+    (tpu3fs/tenant). Untagged (pre-tenancy / internal) spans group under
+    '-'. Only op spans count — stage spans would double-bill an op's
+    wall to its owner."""
+    groups: Dict[str, List[float]] = {}
+    nbytes: Dict[str, int] = {}
+    for r in rows:
+        if r.get("stage"):
+            continue
+        tenant = r.get("tenant") or "-"
+        groups.setdefault(tenant, []).append(r.get("dur_us", 0.0))
+        nbytes[tenant] = nbytes.get(tenant, 0) + int(r.get("nbytes", 0))
+    out: Dict[str, dict] = {}
+    for tenant, durs in groups.items():
+        durs.sort()
+        out[tenant] = {
+            "count": len(durs),
+            "p50_us": _pct(durs, 0.5),
+            "p90_us": _pct(durs, 0.9),
+            "p99_us": _pct(durs, 0.99),
+            "total_ms": sum(durs) / 1e3,
+            "bytes": nbytes.get(tenant, 0),
+        }
+    return out
+
+
 def format_top(trees: Dict[str, TraceTree], rows: Sequence[dict],
-               n: int = 10) -> str:
+               n: int = 10, by_tenant: bool = False) -> str:
     lines = [f"{len(trees)} traces, {len(rows)} spans; slowest {n}:"]
     for t in top_traces(trees, n):
         root = t.root
         if root is None:
             continue
         slow = " SLOW" if any(r.get("slow") for r in t.rows) else ""
+        tenants = t.tenants()
+        who = f"  [{','.join(tenants)}]" if tenants else ""
         lines.append(
             f"  {t.trace_id}  {root.get('op', '?'):<24s} "
             f"{root.get('dur_us', 0.0) / 1e3:9.3f} ms  "
             f"cov {t.coverage() * 100.0:5.1f}%  "
-            f"{len(t.services())} procs{slow}")
+            f"{len(t.services())} procs{slow}{who}")
+    if by_tenant:
+        tp = tenant_percentiles(rows)
+        if tp:
+            lines.append(f"  {'tenant':<18s} {'ops':>6s} {'p50ms':>9s} "
+                         f"{'p90ms':>9s} {'p99ms':>9s} {'MiB':>9s}")
+            for tenant in sorted(tp):
+                s = tp[tenant]
+                lines.append(
+                    f"  {tenant:<18s} {s['count']:>6d} "
+                    f"{s['p50_us'] / 1e3:>9.3f} "
+                    f"{s['p90_us'] / 1e3:>9.3f} "
+                    f"{s['p99_us'] / 1e3:>9.3f} "
+                    f"{s['bytes'] / (1 << 20):>9.2f}")
     pcts = stage_percentiles(rows)
     if pcts:
         lines.append(f"  {'stage':<18s} {'count':>6s} {'p50ms':>9s} "

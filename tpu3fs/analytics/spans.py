@@ -72,6 +72,7 @@ class SpanEvent:
     code: int = 0          # status code (0 = OK)
     nbytes: int = 0
     tclass: str = ""       # QoS traffic class, when tagged
+    tenant: str = ""       # owning tenant (op spans; tpu3fs/tenant)
     sampled: bool = False
     slow: bool = False     # flushed by the slow-op/forced path
 
@@ -273,22 +274,29 @@ class Tracer:
 
     def end_op(self, ctx: TraceContext, op: str, ts: float, dur_s: float,
                *, code: int = 0, nbytes: int = 0,
-               tclass: str = "") -> None:
+               tclass: str = "", tenant: str = "") -> None:
         """Append the op span for a NESTED op (the flush decision belongs
-        to whichever op owns the accumulator — the process root)."""
+        to whichever op owns the accumulator — the process root). An
+        empty tenant resolves from the ambient scope, so every op span
+        carries its owner without each call site threading it."""
+        if not tenant:
+            from tpu3fs.tenant.identity import current_tenant
+
+            tenant = current_tenant() or ""
         ctx.events.append(SpanEvent(
             trace_id=ctx.trace_id, span_id=ctx.span_id,
             parent_id=ctx.parent_id, service=self.service, node=self.node,
             op=op, stage="", ts=ts, dur_us=dur_s * 1e6, code=code,
-            nbytes=nbytes, tclass=tclass, sampled=ctx.sampled))
+            nbytes=nbytes, tclass=tclass, tenant=tenant,
+            sampled=ctx.sampled))
 
     def finish_op(self, ctx: TraceContext, op: str, ts: float,
                   dur_s: float, *, code: int = 0, nbytes: int = 0,
-                  tclass: str = "") -> None:
+                  tclass: str = "", tenant: str = "") -> None:
         """Emit the op span and make the flush-or-drop decision for every
         event the op accumulated in this process."""
         self.end_op(ctx, op, ts, dur_s, code=code, nbytes=nbytes,
-                    tclass=tclass)
+                    tclass=tclass, tenant=tenant)
         is_slow = ctx.slow or dur_s * 1e6 >= self.slow_op_us
         if ctx.sampled or is_slow:
             self._flush_events(ctx.events, is_slow and not ctx.sampled)

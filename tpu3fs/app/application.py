@@ -154,6 +154,7 @@ class ApplicationBase:
             self.server = RpcServer(self.info.hostname, port)
         self.info.port = self.server.port
         self._init_qos()
+        self._init_tenants()
         self._init_fault_plane()
         bind_core_service(self.server, config=self.config,
                           on_shutdown=self.stop)
@@ -182,6 +183,17 @@ class ApplicationBase:
         (storage: the QoS manager shares the controller, so RPC-level
         charging would double-count)."""
         return set()
+
+    def _init_tenants(self) -> None:
+        """Bind the process-global tenant registry to the binary's
+        ``tenants`` config section when it declares one: a mgmtd config
+        push of ``[tenants] spec=...`` then retunes the per-tenant quota
+        buckets + WFQ lane weights live (tpu3fs/tenant, docs/tenancy.md)."""
+        from tpu3fs.tenant.quota import TenantConfig, apply_tenant_config
+
+        tcfg = getattr(self.config, "tenants", None)
+        if isinstance(tcfg, TenantConfig):
+            apply_tenant_config(tcfg)
 
     def _init_fault_plane(self) -> None:
         """Bind the process-global cluster fault plane to the binary's

@@ -22,6 +22,7 @@ from tpu3fs.analytics.spans import TraceConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.qos.core import QosConfig
 from tpu3fs.utils.fault_injection import FaultPlaneConfig
+from tpu3fs.tenant.quota import TenantConfig
 
 
 class MgmtdAppConfig(Config):
@@ -30,6 +31,9 @@ class MgmtdAppConfig(Config):
     # cluster fault plane (utils/fault_injection.py): hot-pushed
     # fault rules for chaos drives / gray-failure testing
     faults = FaultPlaneConfig
+    # multi-tenant quota table (tpu3fs/tenant): per-tenant
+    # WFQ weights + token-bucket limits, hot-pushed via mgmtd
+    tenants = TenantConfig
     # observability: distributed tracing + monitor sample push
     # (tpu3fs/analytics/spans.py; both hot-configured)
     trace = TraceConfig

@@ -21,6 +21,7 @@ from tpu3fs.app.application import TwoPhaseApplication
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType
 from tpu3fs.qos.core import QosConfig
 from tpu3fs.utils.fault_injection import FaultPlaneConfig
+from tpu3fs.tenant.quota import TenantConfig
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import RpcMessenger, bind_storage_service
 from tpu3fs.storage.craq import StorageService
@@ -60,6 +61,9 @@ class StorageAppConfig(Config):
     # cluster fault plane (utils/fault_injection.py): hot-pushed
     # fault rules for chaos drives / gray-failure testing
     faults = FaultPlaneConfig
+    # multi-tenant quota table (tpu3fs/tenant): per-tenant
+    # WFQ weights + token-bucket limits, hot-pushed via mgmtd
+    tenants = TenantConfig
     # distributed request tracing (tpu3fs/analytics/spans.py) + monitor
     # sample push to monitor_collector — both hot-configured
     trace = TraceConfig

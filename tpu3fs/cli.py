@@ -445,13 +445,15 @@ class AdminCli:
 
     def cmd_trace_top(self, args: List[str]) -> str:
         """Slowest traced ops + per-stage percentile breakdown over every
-        loaded span file. trace-top --dir D[,D...] [--n N]"""
+        loaded span file; --by-tenant adds the per-tenant op rollup.
+        trace-top --dir D[,D...] [--n N] [--by-tenant]"""
         assemble, rows = self._load_trace_dirs(args)
         trees = assemble.assemble_traces(rows)
         if not trees:
             return "no traces found"
         return assemble.format_top(trees, rows,
-                                   n=int(self._flag(args, "--n", 10)))
+                                   n=int(self._flag(args, "--n", 10)),
+                                   by_tenant="--by-tenant" in args)
 
     def cmd_top(self, args: List[str]) -> str:
         """Live cluster top from monitor_collector output: per-class
@@ -504,7 +506,7 @@ class AdminCli:
                 or name in ("kvcache.dirty_bytes", "kvcache.host_bytes",
                             "kvcache.leases", "dataload.buffered_bytes",
                             "qos.queue_depth", "ec.rebuild_mibps",
-                            "ec.encode_gibps")
+                            "ec.encode_gibps", "tenant.kvcache_bytes")
 
         counters: Dict[tuple, float] = {}
         gauges: Dict[tuple, tuple] = {}
@@ -543,6 +545,117 @@ class AdminCli:
             lines.append(f"  {'GAUGE':<28} {'NODE':<6} {'VALUE':>14}")
             for (name, cls, node), (_, v) in sorted(gauges.items()):
                 lines.append(f"  {name:<28} {node or '-':<6} {v:>14.0f}")
+        return "\n".join(lines)
+
+    # -- multi-tenant fairness (tpu3fs/tenant; docs/tenancy.md) --------------
+    def cmd_tenant_quota(self, args: List[str]) -> str:
+        """Tenant quota table (tpu3fs/tenant):
+        tenant-quota [show] [--tenant NAME] — THIS process's registry:
+                  quotas + live per-tenant totals
+        tenant-quota set --spec "tenant=a,weight=4,bytes_per_s=...;..."
+                  [--node-type storage] — merge a [tenants] section into
+                  the node type's pushed config (heartbeats deliver it;
+                  every node of that type retunes buckets + lane weights
+                  live)
+        tenant-quota clear [--node-type storage] — push an empty table"""
+        from tpu3fs.tenant.quota import parse_spec, registry
+
+        if args and args[0] in ("set", "clear"):
+            sub, rest = args[0], args[1:]
+            spec = "" if sub == "clear" else self._flag(rest, "--spec", "")
+            table = parse_spec(spec)  # validate BEFORE pushing
+            nt = self._node_type_flag(rest)
+            blob = self.fab.mgmtd.get_config(nt)
+            content = self._merge_section_toml(
+                blob.content, "tenants", {"spec": spec})
+            ver = self.fab.mgmtd.set_config(nt, content)
+            return (f"pushed {len(table)} tenant quota row(s) to "
+                    f"{nt.name} config v{ver} (heartbeats deliver "
+                    f"within one interval)")
+        want = self._flag(args, "--tenant")
+        snap = registry().snapshot()
+        lines = [f"{'TENANT':<16} {'WEIGHT':>6} {'BYTES/S':>12} "
+                 f"{'IOPS':>8} {'KV_BUDGET':>12} {'KV_RES':>10} "
+                 f"{'ADMIT':>8} {'SHED':>6} {'BYTES':>12}"]
+        for name, row in snap.items():
+            if want is not None and name != want:
+                continue
+            star = "" if row["explicit"] else "*"
+            lines.append(
+                f"{name + star:<16} {row['weight']:>6} "
+                f"{row['bytes_per_s']:>12.0f} {row['iops']:>8.0f} "
+                f"{row['kvcache_bytes']:>12} {row['kv_resident']:>10} "
+                f"{row['admitted']:>8} {row['shed']:>6} "
+                f"{row['bytes']:>12}")
+        lines.append("(* = default-quota tenant, no explicit row)")
+        return "\n".join(lines)
+
+    def cmd_tenant_top(self, args: List[str]) -> str:
+        """Live per-tenant cluster view from monitor_collector output:
+        admitted/shed rates by kind, bytes GiB/s, queue-wait p99,
+        kvcache resident gauges — "who is hurting whom".
+        tenant-top --collector HOST:PORT [--window SEC]"""
+        import json as _json
+        import time as _time
+
+        from tpu3fs.monitor.collector import (
+            COLLECTOR_SERVICE_ID,
+            QueryReq,
+            SampleBatch,
+        )
+        from tpu3fs.rpc.net import RpcClient
+
+        coll = self._flag(args, "--collector") or (
+            args[0] if args and not args[0].startswith("--") else None)
+        if not coll:
+            return ("usage: tenant-top --collector <host:port> "
+                    "[--window SEC]")
+        window = float(self._flag(args, "--window", 60))
+        host, port = coll.rsplit(":", 1)
+        rsp = RpcClient().call(
+            (host, int(port)), COLLECTOR_SERVICE_ID, 2,
+            QueryReq(name_prefix="tenant.", since=_time.time() - window,
+                     limit=100000), SampleBatch)
+        counters: Dict[tuple, float] = {}
+        waits: Dict[str, float] = {}
+        kv: Dict[str, tuple] = {}
+        for s in rsp.samples:
+            tags = s.tags if isinstance(s.tags, dict) else _json.loads(
+                s.tags or "{}")
+            tenant = tags.get("tenant", "-")
+            if s.name == "tenant.queue_wait_us":
+                waits[tenant] = max(waits.get(tenant, 0.0), s.p99)
+            elif s.name == "tenant.kvcache_bytes":
+                cur = kv.get(tenant)
+                if cur is None or s.ts >= cur[0]:
+                    kv[tenant] = (s.ts, s.value)
+            else:
+                key = (s.name, tenant, tags.get("kind", ""))
+                counters[key] = counters.get(key, 0.0) + s.value
+        tenants = sorted({k[1] for k in counters}
+                         | set(waits) | set(kv))
+        if not tenants:
+            return f"no tenant samples in the last {window:.0f}s"
+        lines = [f"tenant top  (window {window:.0f}s, "
+                 f"{len(rsp.samples)} samples)",
+                 f"  {'TENANT':<16} {'ADMIT/s':>9} {'SHED/s':>8} "
+                 f"{'by-kind':<26} {'GiB/s':>8} {'QWAITp99':>10} "
+                 f"{'KV_RES':>10}"]
+        for tenant in tenants:
+            admit = counters.get(("tenant.admitted", tenant, ""), 0.0)
+            sheds = {k[2]: v for k, v in counters.items()
+                     if k[0] == "tenant.shed" and k[1] == tenant}
+            shed_total = sum(sheds.values())
+            by_kind = ",".join(f"{k}={v:.0f}"
+                               for k, v in sorted(sheds.items()) if v)
+            gib = counters.get(("tenant.bytes", tenant, ""), 0.0) \
+                / window / (1 << 30)
+            wait_ms = waits.get(tenant, 0.0) / 1e3
+            kres = int(kv.get(tenant, (0, 0))[1])
+            lines.append(
+                f"  {tenant:<16} {admit / window:>9.1f} "
+                f"{shed_total / window:>8.1f} {by_kind:<26} "
+                f"{gib:>8.4f} {wait_ms:>9.2f}ms {kres:>10}")
         return "\n".join(lines)
 
     def cmd_ec_status(self, args: List[str]) -> str:
@@ -635,12 +748,19 @@ class AdminCli:
         """Merge a [faults] section into an existing pushed-config blob
         (set_config replaces the whole blob; operators must not lose the
         qos/trace sections they pushed earlier)."""
+        return AdminCli._merge_section_toml(content, "faults",
+                                            {"spec": spec, "seed": seed})
+
+    @staticmethod
+    def _merge_section_toml(content: str, section: str,
+                            items: Dict[str, object]) -> str:
+        """Merge one [section] of scalar items into a pushed-config blob,
+        preserving every other section (faults/tenants share this)."""
         from tpu3fs.utils.config import tomllib
 
         data = tomllib.loads(content) if content else {}
-        data.setdefault("faults", {})
-        data["faults"]["spec"] = spec
-        data["faults"]["seed"] = seed
+        data.setdefault(section, {})
+        data[section].update(items)
 
         def render(d: dict, prefix: str = "") -> List[str]:
             lines = []

@@ -197,13 +197,17 @@ class ReadaheadPrefetcher:
             event = _threading.Event()
             self._inflight[key] = (start + window, event, gen)
         from tpu3fs.qos.core import current_class
+        from tpu3fs.tenant.identity import current_tenant
 
-        self._submit(inode, start, window, gen, current_class(), event)
+        self._submit(inode, start, window, gen, current_class(),
+                     current_tenant(), event)
 
-    def _submit(self, inode, start, window, gen, tclass, event) -> None:
+    def _submit(self, inode, start, window, gen, tclass, tenant,
+                event) -> None:
         import contextlib
 
         from tpu3fs.qos.core import tagged
+        from tpu3fs.tenant.identity import tenant_scope
 
         def job() -> None:
             key = (inode.id, start)
@@ -222,8 +226,11 @@ class ReadaheadPrefetcher:
                            else contextlib.nullcontext())
                     # trace DETACHED: a readahead completes long after the
                     # arming reader's op span closed — its RPCs must not
-                    # append to (or re-sample) that finished trace
-                    with ctx, _spans.trace_scope(None):
+                    # append to (or re-sample) that finished trace. The
+                    # TENANT is carried like the class: readahead is IO on
+                    # the arming reader's behalf, so its quota pays
+                    with ctx, tenant_scope(tenant), \
+                            _spans.trace_scope(None):
                         blob = self._fetch(inode, start, window)
                 except BaseException:
                     blob = None  # best-effort: a failed readahead serves

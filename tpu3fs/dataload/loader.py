@@ -94,6 +94,11 @@ class LoaderConfig:
     # difficulty knobs here. Fires once per (loader, epoch); raising
     # fails the loader like a fetch error.
     epoch_callback: Optional[Callable[[int], None]] = None
+    # owning tenant (tpu3fs/tenant): loader fetch IO runs under this
+    # tenant scope so the envelope carries it, per-tenant quotas charge
+    # it and the tenant.* recorders attribute it — a training job is a
+    # tenant like any inference client. "" = untenanted (legacy).
+    tenant: str = ""
 
 
 def _rec_nbytes(rec) -> int:
@@ -394,7 +399,9 @@ class DataLoader:
                            coalesce_gap: Optional[int] = None):
         cfg = self.config
         gap = coalesce_gap if coalesce_gap is not None else cfg.coalesce_gap
-        with tagged(TrafficClass.DATALOAD):
+        from tpu3fs.tenant.identity import tenant_scope
+
+        with tagged(TrafficClass.DATALOAD), tenant_scope(cfg.tenant):
             for _ in range(cfg.max_overload_waits):
                 try:
                     return self._ds.read_samples(
@@ -405,7 +412,8 @@ class DataLoader:
                     if e.code == Code.DATALOAD_CORRUPT:
                         self._crc_err.add()
                         raise
-                    if e.code != Code.OVERLOADED:
+                    if e.code not in (Code.OVERLOADED,
+                                      Code.TENANT_THROTTLED):
                         raise
                     # shed past the client's own ladder: self-throttle
                     # for the server's hint instead of failing the epoch

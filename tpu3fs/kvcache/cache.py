@@ -69,6 +69,7 @@ class KVCacheClient:
         touch_on_get: bool = True,
         inode_cache: int = 0,
         touch_coalesce_s: float = 0.0,
+        tenant: str = "",
     ):
         """inode_cache > 0 enables a bounded client-side inode cache of
         that many entries: repeat gets skip the stat walk and touch by
@@ -89,6 +90,11 @@ class KVCacheClient:
         self._fio = fio
         self.root = root.rstrip("/") or "/kvcache"
         self._client_id = client_id
+        # owning tenant (tpu3fs/tenant): every op runs under this scope
+        # (so the wire carries it and quotas charge it) — set explicitly
+        # because the write-back flusher calls batch_put from a
+        # background thread that inherits NO producer context
+        self._tenant = tenant or ""
         self._touch_on_get = touch_on_get
         self._dir_lock = threading.Lock()
         self._dirs_made: set = set()
@@ -108,6 +114,39 @@ class KVCacheClient:
         self._put_rec = LatencyRecorder("kvcache.put")
 
     # -- plumbing -----------------------------------------------------------
+    def _tenant_ctx(self):
+        from tpu3fs.tenant.identity import tenant_scope
+
+        return tenant_scope(self._tenant)
+
+    def _charge_resident(self, nbytes: int) -> None:
+        """Per-tenant kvcache resident-bytes estimate (tpu3fs/tenant):
+        incremental from the writer; the GC daemon's scans set the
+        authoritative figure (bin/kvcache_gc_main.py)."""
+        from tpu3fs.tenant.identity import current_tenant
+        from tpu3fs.tenant.quota import registry
+
+        tenant = self._tenant or current_tenant()
+        if tenant:
+            registry().charge_kvcache(tenant, nbytes)
+
+    def _check_resident_budget(self) -> None:
+        """Writer-side kvcache budget gate: a tenant whose resident bytes
+        exceed its quota sheds TENANT_THROTTLED before creating more
+        entries — eviction (GC capacity pass) is what brings it back
+        under (docs/tenancy.md)."""
+        from tpu3fs.tenant.identity import current_tenant
+        from tpu3fs.tenant.quota import registry
+        from tpu3fs.utils.result import Status
+
+        tenant = self._tenant or current_tenant()
+        if tenant and registry().kvcache_over(tenant):
+            registry().shed_kvcache(tenant)
+            raise FsError(Status(
+                Code.TENANT_THROTTLED,
+                f"retry_after_ms=1000 (tenant {tenant} over its kvcache "
+                f"resident budget)"))
+
     def _ensure_dir(self, path: str) -> None:
         parent = path.rsplit("/", 1)[0]
         with self._dir_lock:
@@ -204,7 +243,9 @@ class KVCacheClient:
 
     # -- byte API -----------------------------------------------------------
     def put(self, key: str, value: bytes) -> None:
-        with self._put_rec.record(), tagged(TrafficClass.KVCACHE):
+        with self._put_rec.record(), tagged(TrafficClass.KVCACHE), \
+                self._tenant_ctx():
+            self._check_resident_budget()
             path = shard_path(self.root, key)
             self._ensure_dir(path)
             res = self._meta.create(
@@ -225,6 +266,7 @@ class KVCacheClient:
                                        length_hint=n, wrote=True)
             self._cache_inode(key, settled)
             self._write_bytes.add(n)
+            self._charge_resident(n)
 
     def batch_put(self, items) -> None:
         """Write many (key, value) entries as ONE node-grouped striped
@@ -239,7 +281,9 @@ class KVCacheClient:
         items = list(items)
         if not items:
             return
-        with self._put_rec.record(), tagged(TrafficClass.KVCACHE):
+        with self._put_rec.record(), tagged(TrafficClass.KVCACHE), \
+                self._tenant_ctx():
+            self._check_resident_budget()
             opened: List[Tuple[str, object]] = []
             try:
                 paths = []
@@ -289,9 +333,11 @@ class KVCacheClient:
                     raise res
                 self._cache_inode(key, res)
                 self._write_bytes.add(n)
+                self._charge_resident(n)
 
     def get(self, key: str) -> Optional[bytes]:
-        with self._get_rec.record() as op, tagged(TrafficClass.KVCACHE):
+        with self._get_rec.record() as op, tagged(TrafficClass.KVCACHE), \
+                self._tenant_ctx():
             path = shard_path(self.root, key)
             inode = self._cached_inode(key)
             if inode is None:
@@ -313,7 +359,7 @@ class KVCacheClient:
         """Stat all keys, then read every hit as ONE node-grouped chunk
         batch (StorageClient.batch_read underneath) and refresh every
         hit's mtime as ONE batched touch."""
-        with tagged(TrafficClass.KVCACHE):
+        with tagged(TrafficClass.KVCACHE), self._tenant_ctx():
             paths = [shard_path(self.root, k) for k in keys]
             inodes: List[object] = [self._cached_inode(k) for k in keys]
             unknown = [i for i, ino in enumerate(inodes) if ino is None]

@@ -474,14 +474,43 @@ class AdmissionController:
         return out
 
     # -- decisions --------------------------------------------------------
+    @staticmethod
+    def _tenant_of(tenant: Optional[str]) -> str:
+        if tenant:
+            return tenant
+        from tpu3fs.tenant.identity import resolved_tenant
+
+        return resolved_tenant()
+
+    @staticmethod
+    def _tenant_admit(tenant: str) -> None:
+        from tpu3fs.tenant.quota import registry
+
+        registry().account_admit(tenant)
+
+    @staticmethod
+    def _tenant_shed(tenant: str) -> None:
+        from tpu3fs.tenant.quota import registry
+
+        registry().account_shed(tenant)
+
     def try_admit(self, service: str, method: str,
-                  tclass: Optional[TrafficClass], cost: float = 1.0):
+                  tclass: Optional[TrafficClass], cost: float = 1.0,
+                  *, tenant: Optional[str] = None):
         """-> (lease, None) when admitted, (None, retry_after_ms) when
-        shed. Callers MUST release the lease when the op finishes."""
+        shed. Callers MUST release the lease when the op finishes.
+
+        Every decision is ALSO attributed to the op's tenant (explicit
+        arg, else the ambient tenant scope) on the ``tenant.admitted`` /
+        ``tenant.shed`` recorders — the per-tenant accounting that lets
+        the monitor answer "who is hurting whom" even before any quota
+        is configured (tpu3fs/tenant)."""
         if tclass is None:
             tclass = default_class_for(method)
+        tname = self._tenant_of(tenant)
         if not self.config.enabled:
             self._admitted[tclass].add()
+            self._tenant_admit(tname)
             return _NOOP_LEASE, None
         base_ms = int(self.config.shed_retry_after_ms)
         bucket = (self._overrides.get((service, method, tclass))
@@ -490,6 +519,7 @@ class AdmissionController:
         wait_s = bucket.try_acquire(cost)
         if wait_s > 0.0:
             self._shed[tclass].add()
+            self._tenant_shed(tname)
             return None, max(base_ms, int(wait_s * 1000) + 1)
         gate = self._gates[tclass]
         if gate.cap <= 0:
@@ -497,11 +527,14 @@ class AdmissionController:
             # hot-path cost of admission must stay a couple of lock-free
             # checks + one counter for fully-open classes)
             self._admitted[tclass].add()
+            self._tenant_admit(tname)
             return _NOOP_LEASE, None
         if not gate.try_enter():
             self._shed[tclass].add()
+            self._tenant_shed(tname)
             return None, base_ms
         self._admitted[tclass].add()
+        self._tenant_admit(tname)
         return _Lease(gate), None
 
     def snapshot(self) -> Dict[str, dict]:

@@ -68,10 +68,12 @@ class QosManager:
 
     # -- service-entry admission -----------------------------------------
     def try_admit(self, service: str, method: str,
-                  tclass: Optional[TrafficClass], cost: float = 1.0):
+                  tclass: Optional[TrafficClass], cost: float = 1.0,
+                  *, tenant: Optional[str] = None):
         """(lease, None) | (None, retry_after_ms); see
         AdmissionController.try_admit."""
-        return self.admission.try_admit(service, method, tclass, cost)
+        return self.admission.try_admit(service, method, tclass, cost,
+                                        tenant=tenant)
 
     # -- scheduler plumbing ----------------------------------------------
     def record_wait(self, tclass: TrafficClass, wait_s: float) -> None:

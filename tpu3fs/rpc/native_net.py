@@ -413,6 +413,34 @@ class NativeRpcServer:
                     out_msg, Code.DEADLINE_EXCEEDED,
                     f"deadline passed before "
                     f"{service.name}.{mdef.name} admission")
+            # TENANT resolution + quota admission (mirrors
+            # RpcServer._dispatch): the envelope's u1.* token names the
+            # owner; bytes/iops-classified methods charge its buckets
+            # before request decode, shedding TENANT_THROTTLED with a
+            # retry-after hint
+            from tpu3fs.tenant import identity as _tid
+
+            tenant = _tid.decode_tenant(msg_str) if msg_str else None
+            tname = tenant or _tid.DEFAULT_TENANT
+            if service_id not in self._admission_exempt:
+                from tpu3fs.qos.core import format_retry_after
+                from tpu3fs.tenant import enforcement as _tenf
+                from tpu3fs.tenant.quota import registry as _treg
+
+                kind = _tenf.enforcement_of(service.name, mdef.name)
+                if kind in (_tenf.BYTES, _tenf.IOPS):
+                    nbytes = 0
+                    if kind == _tenf.BYTES:
+                        nbytes = int(req_len) + (int(bulk_len)
+                                                 if has_bulk else 0)
+                    t_shed = _treg().try_admit(tname, nbytes=nbytes)
+                    if t_shed is not None:
+                        return self._err(
+                            out_msg, Code.TENANT_THROTTLED,
+                            format_retry_after(
+                                t_shed,
+                                f"tenant {tname} over quota at "
+                                f"{service.name}.{mdef.name}"))
             # QoS admission by the envelope's traffic-class bits (handler
             # ABI v3 threads `flags` through): a tagged peer is admitted
             # as its declared class; untagged ops classify by method name
@@ -426,7 +454,7 @@ class NativeRpcServer:
                 from tpu3fs.qos.core import format_retry_after
 
                 lease, shed_ms = self._admission.try_admit(
-                    service.name, mdef.name, tclass)
+                    service.name, mdef.name, tclass, tenant=tname)
                 if lease is None:
                     return self._err(
                         out_msg, Code.OVERLOADED,
@@ -472,7 +500,13 @@ class NativeRpcServer:
                            else contextlib.nullcontext())
                     dctx = (_dl.deadline_scope(dl) if dl is not None
                             else contextlib.nullcontext())
-                    with ctx, dctx, _spans.trace_scope(sctx) \
+                    # the peer's tenant scopes the handler (mirrors
+                    # RpcServer._dispatch): storage internal admission
+                    # and update-queue lanes see the envelope's owner
+                    tctx = (_tid.tenant_scope(tenant)
+                            if tenant is not None
+                            else contextlib.nullcontext())
+                    with ctx, dctx, tctx, _spans.trace_scope(sctx) \
                             if sctx is not None \
                             else contextlib.nullcontext():
                         if mdef.bulk:
@@ -487,7 +521,8 @@ class NativeRpcServer:
                             sctx, f"rpc.{service.name}.{mdef.name}",
                             _time.time() - dur, dur,
                             tclass=(tclass.name.lower()
-                                    if tclass is not None else ""))
+                                    if tclass is not None else ""),
+                            tenant=tname)
                 except FsError as e:
                     return self._err(out_msg, e.code, e.status.message)
                 except Exception as e:
@@ -657,17 +692,21 @@ class NativeRpcClient:
     @staticmethod
     def _trace_hop():
         """-> (rpc child context | None, envelope message bytes | None):
-        the trace + deadline stamping the Python client does in
-        start_call, for the native send entry points (both ride the same
-        envelope message field; rpc/deadline.py)."""
+        the trace + deadline + tenant stamping the Python client does in
+        start_call, for the native send entry points (all three ride the
+        same envelope message field; rpc/deadline.py,
+        tenant/identity.py)."""
         from tpu3fs.analytics import spans as _spans
         from tpu3fs.rpc import deadline as _dl
+        from tpu3fs.tenant import identity as _tid
 
         ctx = _spans.current_trace()
         rpc_ctx = ctx.child() if ctx is not None else None
-        msg = _dl.encode_envelope(
-            rpc_ctx.to_wire() if rpc_ctx is not None else "",
-            _dl.current_deadline())
+        msg = _tid.append_wire(
+            _dl.encode_envelope(
+                rpc_ctx.to_wire() if rpc_ctx is not None else "",
+                _dl.current_deadline()),
+            _tid.current_tenant())
         return rpc_ctx, (msg.encode() if msg else None)
 
     @staticmethod

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
 from tpu3fs.analytics import spans as _spans
 from tpu3fs.rpc import deadline as _deadline
+from tpu3fs.tenant import identity as _tenant_id
 from tpu3fs.rpc.serde import (
     _read_uvarint,
     _write_uvarint,
@@ -401,6 +402,35 @@ class RpcServer:
                 pkt, Code.DEADLINE_EXCEEDED,
                 f"deadline passed {time.time() - dl:.3f}s before "
                 f"{service.name}.{mdef.name} admission"), None
+        # TENANT resolution + quota admission (tenant/quota.py): every
+        # envelope resolves an owner (explicit u1.* token or "default"),
+        # and methods the enforcement table classifies bytes/iops charge
+        # the owner's buckets HERE, before request decode — a tenant over
+        # its quota answers the retryable TENANT_THROTTLED with a
+        # retry-after hint, same shape as an OVERLOADED class shed.
+        # Services that run their own internal admission (storage) are
+        # exempt at this level exactly like class admission.
+        tenant = (_tenant_id.decode_tenant(pkt.message)
+                  if pkt.message else None)
+        tname = tenant or _tenant_id.DEFAULT_TENANT
+        if pkt.service_id not in self._admission_exempt:
+            from tpu3fs.qos.core import format_retry_after
+            from tpu3fs.tenant import enforcement as _tenf
+            from tpu3fs.tenant.quota import registry as _treg
+
+            kind = _tenf.enforcement_of(service.name, mdef.name)
+            if kind in (_tenf.BYTES, _tenf.IOPS):
+                nbytes = 0
+                if kind == _tenf.BYTES:
+                    nbytes = len(pkt.payload) + (
+                        sum(len(b) for b in bulk) if bulk else 0)
+                t_shed = _treg().try_admit(tname, nbytes=nbytes)
+                if t_shed is not None:
+                    return self._error_reply(
+                        pkt, Code.TENANT_THROTTLED,
+                        format_retry_after(
+                            t_shed, f"tenant {tname} over quota at "
+                                    f"{service.name}.{mdef.name}")), None
         # QoS admission BEFORE deserialization (shedding must stay cheap):
         # token bucket + concurrency cap keyed (service, method, traffic
         # class); sheds answer OVERLOADED with the retry-after hint in the
@@ -413,7 +443,7 @@ class RpcServer:
 
             tclass = class_from_flags(pkt.flags)
             lease, shed_ms = self._admission.try_admit(
-                service.name, mdef.name, tclass)
+                service.name, mdef.name, tclass, tenant=tname)
             if lease is None:
                 return self._error_reply(
                     pkt, Code.OVERLOADED,
@@ -454,7 +484,12 @@ class RpcServer:
             # (update-queue submit, nested RPCs) inherit and re-propagate
             dctx = (_deadline.deadline_scope(dl) if dl is not None
                     else contextlib.nullcontext())
-            with ctx, dctx, _spans.trace_scope(sctx) \
+            # the peer's TENANT scopes the handler the same way: storage
+            # internal admission, update-queue lanes and nested RPCs all
+            # see the owner the envelope carried (tenant/identity.py)
+            tctx = (_tenant_id.tenant_scope(tenant) if tenant is not None
+                    else contextlib.nullcontext())
+            with ctx, dctx, tctx, _spans.trace_scope(sctx) \
                     if sctx is not None else contextlib.nullcontext():
                 if mdef.bulk:
                     rsp, reply_iovs = mdef.handler(req, bulk)
@@ -474,7 +509,7 @@ class RpcServer:
         ts.server_run_end = time.monotonic()
         if sctx is not None:
             self._trace_dispatch(sctx, service, mdef, ts, status,
-                                 tclass)
+                                 tclass, tname)
         return MessagePacket(
             uuid=pkt.uuid,
             service_id=pkt.service_id,
@@ -488,11 +523,12 @@ class RpcServer:
 
     @staticmethod
     def _trace_dispatch(sctx, service, mdef, ts: Timestamps, status: int,
-                        tclass) -> None:
+                        tclass, tenant: str = "") -> None:
         """Emit the server-side spans of one dispatch: the admission-wait
         stage (receive -> handler start: queueing + admission + request
-        decode) and the dispatch op span, then flush-or-drop (slow-op
-        capture applies even to unsampled traces)."""
+        decode) and the dispatch op span — tagged with the envelope's
+        tenant so trace-top can group by owner — then flush-or-drop
+        (slow-op capture applies even to unsampled traces)."""
         dur = ts.server_run_end - ts.server_receive
         wall_end = time.time()
         _spans.add_span(
@@ -501,7 +537,8 @@ class RpcServer:
         _spans.tracer().finish_op(
             sctx, f"rpc.{service.name}.{mdef.name}", wall_end - dur, dur,
             code=status if status != int(Code.OK) else 0,
-            tclass=tclass.name.lower() if tclass is not None else "")
+            tclass=tclass.name.lower() if tclass is not None else "",
+            tenant=tenant)
 
     @staticmethod
     def _error_reply(pkt: MessagePacket, code: Code, msg: str) -> MessagePacket:
@@ -653,11 +690,14 @@ class RpcClient:
             flags=FLAG_IS_REQ | class_to_flags(current_class()),
             status=int(Code.OK),
             payload=serialize(req, req_type or type(req)),
-            # trace context + absolute deadline compose in the message
-            # field (version-tolerant both ways; rpc/deadline.py)
-            message=_deadline.encode_envelope(
-                rpc_ctx.to_wire() if rpc_ctx is not None else "",
-                _deadline.current_deadline()),
+            # trace context + absolute deadline + tenant id compose in
+            # the message field (version-tolerant all three ways;
+            # rpc/deadline.py, tenant/identity.py)
+            message=_tenant_id.append_wire(
+                _deadline.encode_envelope(
+                    rpc_ctx.to_wire() if rpc_ctx is not None else "",
+                    _deadline.current_deadline()),
+                _tenant_id.current_tenant()),
         )
         # client-side fault plane hook: the send boundary (drop rules
         # surface as the peer-closed transport error retry ladders know)

@@ -790,20 +790,29 @@ class StorageService:
             return True
         return False
 
-    def _admit_write(self, req, cost: float = 1.0):
-        """Admission for writes keyed ("storage", "write", class).
-        FOREGROUND chain-internal hops (from_target != 0) are exempt: the
-        head already charged the op and staged it, so a mid-chain shed
-        would only waste the client's whole retry. BACKGROUND classes
-        (resync/EC-rebuild/migration/GC) are checked wherever they enter
-        — including chain-internal recovery installs — because that is
-        precisely the traffic an operator rate-caps (`resync.rate`) and
-        the senders self-throttle on the shed (resync.py, ec_resync.py).
-        -> (lease|None, retry_after_ms|None)."""
+    def _admit_write(self, req, cost: float = 1.0,
+                     nbytes: Optional[int] = None):
+        """Admission for writes keyed ("storage", "write", class), PLUS
+        the tenant quota gate (tpu3fs/tenant): client-entry foreground
+        writes charge the ambient tenant's iops/bytes buckets (and the
+        kvcache resident gate for KVCACHE-class writes) before the class
+        buckets — a tenant over ITS quota sheds TENANT_THROTTLED while
+        the class stays open for its peers.
+
+        FOREGROUND chain-internal hops (from_target != 0) are exempt
+        from BOTH: the head already charged the op and staged it, so a
+        mid-chain shed would only waste the client's whole retry.
+        BACKGROUND classes (resync/EC-rebuild/migration/GC) are class-
+        checked wherever they enter — that is precisely the traffic an
+        operator rate-caps (`resync.rate`) and the senders self-throttle
+        on the shed — but never tenant-charged: recovery is the system's
+        own work (tenant/quota.py).
+        -> (lease|None, retry_after_ms|None, shed code)."""
         if self._qos is None:
-            return None, None
+            return None, None, Code.OVERLOADED
         from tpu3fs.qos.core import (
             BACKGROUND_CLASSES,
+            TrafficClass,
             current_class,
             infer_write_class,
         )
@@ -813,8 +822,24 @@ class StorageService:
             tclass = infer_write_class(req)
         if getattr(req, "from_target", 0) \
                 and tclass not in BACKGROUND_CLASSES:
-            return None, None
-        return self._qos.try_admit("storage", "write", tclass, cost)
+            return None, None, Code.OVERLOADED
+        tenant = None
+        if not getattr(req, "from_target", 0) \
+                and tclass not in BACKGROUND_CLASSES:
+            from tpu3fs.tenant.identity import resolved_tenant
+            from tpu3fs.tenant.quota import registry as _treg
+
+            tenant = resolved_tenant()
+            if nbytes is None:
+                nbytes = len(getattr(req, "data", b"") or b"")
+            t_shed = _treg().try_admit(
+                tenant, ops=cost, nbytes=int(nbytes),
+                kv_charge=(tclass == TrafficClass.KVCACHE))
+            if t_shed is not None:
+                return None, t_shed, Code.TENANT_THROTTLED
+        lease, shed_ms = self._qos.try_admit("storage", "write", tclass,
+                                             cost, tenant=tenant)
+        return lease, shed_ms, Code.OVERLOADED
 
     def _write_impl(self, req: WriteReq) -> UpdateReply:
         if self.stopped:
@@ -822,10 +847,10 @@ class StorageService:
         if not req.from_target and self._deadline_expired():
             return UpdateReply(Code.DEADLINE_EXCEEDED,
                                message="deadline passed at write admission")
-        lease, shed_ms = self._admit_write(req)
+        lease, shed_ms, shed_code = self._admit_write(req)
         if shed_ms is not None:
             return UpdateReply(
-                Code.OVERLOADED,
+                shed_code,
                 message=f"retry_after_ms={shed_ms} (write admission)",
                 retry_after_ms=shed_ms)
         try:
@@ -874,10 +899,10 @@ class StorageService:
             )
         # background recovery installs (resync full-replaces) are
         # admission-checked; foreground chain hops pass free
-        lease, shed_ms = self._admit_write(req)
+        lease, shed_ms, shed_code = self._admit_write(req)
         if shed_ms is not None:
             return UpdateReply(
-                Code.OVERLOADED,
+                shed_code,
                 message=f"retry_after_ms={shed_ms} (write admission)",
                 retry_after_ms=shed_ms)
         try:
@@ -1220,10 +1245,10 @@ class StorageService:
         if req.phase != 2:
             # phase-2 commits are never shed: the shard is already staged
             # and a shed here would strand the two-phase stripe write
-            lease, shed_ms = self._admit_write(req)
+            lease, shed_ms, shed_code = self._admit_write(req)
             if shed_ms is not None:
                 return UpdateReply(
-                    Code.OVERLOADED,
+                    shed_code,
                     message=f"retry_after_ms={shed_ms} (shard admission)",
                     retry_after_ms=shed_ms)
         if lease is not None:
@@ -1301,16 +1326,32 @@ class StorageService:
 
     # -- batched IO (one request carries many ops; ref BatchReadReq
     # StorageOperator.cc:82-231, batchWrite StorageClientImpl.cc:1771) -------
-    def _admit_read(self, default_class, cost: float = 1.0):
-        """-> (lease|None, retry_after_ms|None): admission for the read
-        path keyed ("storage", "read", class). No QoS manager = admitted
-        free (legacy behavior)."""
+    def _admit_read(self, default_class, cost: float = 1.0,
+                    nbytes: int = 0):
+        """-> (lease|None, retry_after_ms|None, shed code): admission for
+        the read path keyed ("storage", "read", class), preceded by the
+        tenant quota gate for non-background classes (the requested byte
+        count charges the tenant's bytes/s bucket — a flooding reader
+        sheds TENANT_THROTTLED while its class stays open for peers).
+        No QoS manager = admitted free (legacy behavior)."""
         if self._qos is None:
-            return None, None
-        from tpu3fs.qos.core import current_class
+            return None, None, Code.OVERLOADED
+        from tpu3fs.qos.core import BACKGROUND_CLASSES, current_class
 
         tclass = current_class(default_class)
-        return self._qos.try_admit("storage", "read", tclass, cost)
+        tenant = None
+        if tclass not in BACKGROUND_CLASSES:
+            from tpu3fs.tenant.identity import resolved_tenant
+            from tpu3fs.tenant.quota import registry as _treg
+
+            tenant = resolved_tenant()
+            t_shed = _treg().try_admit(tenant, ops=cost,
+                                       nbytes=int(nbytes))
+            if t_shed is not None:
+                return None, t_shed, Code.TENANT_THROTTLED
+        lease, shed_ms = self._qos.try_admit("storage", "read", tclass,
+                                             cost, tenant=tenant)
+        return lease, shed_ms, Code.OVERLOADED
 
     def batch_read(self, reqs: List[ReadReq], *,
                    views: bool = False) -> List[ReadReply]:
@@ -1328,11 +1369,12 @@ class StorageService:
 
         if self._deadline_expired():
             return [ReadReply(Code.DEADLINE_EXCEEDED) for _ in reqs]
-        lease, shed_ms = self._admit_read(TrafficClass.FG_READ,
-                                          cost=max(1, len(reqs)))
+        lease, shed_ms, shed_code = self._admit_read(
+            TrafficClass.FG_READ, cost=max(1, len(reqs)),
+            nbytes=sum(max(0, r.length) for r in reqs))
         if shed_ms is not None:
             self._read_rec.failed.add(len(reqs))
-            return [ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
+            return [ReadReply(shed_code, retry_after_ms=shed_ms)
                     for _ in reqs]
         try:
             return self._batch_read_impl(reqs, views=views)
@@ -1419,10 +1461,12 @@ class StorageService:
                 message=f"head target {head.target_id} not local")
                 for _ in range(n)]
         target = self._targets[head.target_id]
-        lease, shed_ms = self._admit_write(reqs[0], cost=n)
+        lease, shed_ms, shed_code = self._admit_write(
+            reqs[0], cost=n,
+            nbytes=sum(len(r.data or b"") for r in reqs))
         if shed_ms is not None:
             return [UpdateReply(
-                Code.OVERLOADED,
+                shed_code,
                 message=f"retry_after_ms={shed_ms} (write admission)",
                 retry_after_ms=shed_ms) for _ in range(n)]
         try:
@@ -1515,10 +1559,12 @@ class StorageService:
         target = self._targets[mine.target_id]
         # background recovery installs are admission-checked here too
         # (foreground chain hops pass free — see _admit_write)
-        lease, shed_ms = self._admit_write(reqs[0], cost=n)
+        lease, shed_ms, shed_code = self._admit_write(
+            reqs[0], cost=n,
+            nbytes=sum(len(r.data or b"") for r in reqs))
         if shed_ms is not None:
             return [UpdateReply(
-                Code.OVERLOADED,
+                shed_code,
                 message=f"retry_after_ms={shed_ms} (write admission)",
                 retry_after_ms=shed_ms) for _ in range(n)]
         if lease is not None:
@@ -2022,9 +2068,9 @@ class StorageService:
         from stale replicas."""
         from tpu3fs.qos.core import TrafficClass
 
-        lease, shed_ms = self._admit_read(TrafficClass.EC_REBUILD)
+        lease, shed_ms, shed_code = self._admit_read(TrafficClass.EC_REBUILD)
         if shed_ms is not None:
-            return ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
+            return ReadReply(shed_code, retry_after_ms=shed_ms)
         try:
             return self._read_rebuild_impl(req)
         finally:
@@ -2058,10 +2104,10 @@ class StorageService:
         meters recovery traffic accurately."""
         from tpu3fs.qos.core import TrafficClass
 
-        lease, shed_ms = self._admit_read(TrafficClass.EC_REBUILD,
-                                          cost=max(1, len(reqs)))
+        lease, shed_ms, shed_code = self._admit_read(
+            TrafficClass.EC_REBUILD, cost=max(1, len(reqs)))
         if shed_ms is not None:
-            return [ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
+            return [ReadReply(shed_code, retry_after_ms=shed_ms)
                     for _ in reqs]
         try:
             return [self._read_rebuild_impl(r) for r in reqs]
@@ -2074,9 +2120,10 @@ class StorageService:
 
         if self._deadline_expired():
             return ReadReply(Code.DEADLINE_EXCEEDED)
-        lease, shed_ms = self._admit_read(TrafficClass.FG_READ)
+        lease, shed_ms, shed_code = self._admit_read(
+            TrafficClass.FG_READ, nbytes=max(0, req.length))
         if shed_ms is not None:
-            return ReadReply(Code.OVERLOADED, retry_after_ms=shed_ms)
+            return ReadReply(shed_code, retry_after_ms=shed_ms)
         try:
             inject("storage.read", node=self.node_id)
             target_id = self._resolve_read_target(req)

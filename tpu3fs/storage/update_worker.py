@@ -45,12 +45,14 @@ from tpu3fs.analytics import spans as _spans
 from tpu3fs.qos.core import TrafficClass, format_retry_after
 from tpu3fs.qos.scheduler import WeightedFairQueue, WfqPolicy
 from tpu3fs.rpc import deadline as _deadline
+from tpu3fs.tenant import identity as _tenant_id
 from tpu3fs.utils.result import Code
 
 
 class _Job:
     __slots__ = ("reqs", "replies", "done", "make_reply", "tclass",
-                 "cost", "enq_ts", "sub_ts", "trace", "deadline")
+                 "cost", "enq_ts", "sub_ts", "trace", "deadline",
+                 "tenant")
 
     def __init__(self, reqs, make_reply, tclass):
         self.reqs = reqs
@@ -67,8 +69,17 @@ class _Job:
         # ambient context): checked again at ROUND START so work whose
         # caller gave up while it queued is shed, never executed
         self.deadline = _deadline.current_deadline()
+        # the submitter's tenant picks the WFQ lane inside the class
+        # (tpu3fs/tenant): two fg tenants share the class by weight
+        self.tenant = _tenant_id.resolved_tenant()
         self.replies: Optional[list] = None
         self.done = threading.Event()
+
+
+def _tenant_registry():
+    from tpu3fs.tenant.quota import registry
+
+    return registry()
 
 
 def _failure_replies(job: _Job, code: Code, msg: str,
@@ -164,7 +175,7 @@ class UpdateWorker:
                 # OVERLOADED + retry-after hint (the client ladder backs
                 # off for the hinted interval and retries), the QoS shape
                 # of the reference's bounded per-disk queue behavior
-                shed = self._q.try_push(job, tclass)
+                shed = self._q.try_push(job, tclass, job.tenant)
                 if shed is not None:
                     return _shed_replies(job, shed)
                 job.enq_ts = time.monotonic()
@@ -232,7 +243,11 @@ class UpdateWorker:
             policy = self._q.policy
             for job in round_jobs:
                 if job.enq_ts:
-                    policy.record_wait(job.tclass, now - job.enq_ts)
+                    wait = now - job.enq_ts
+                    policy.record_wait(job.tclass, wait)
+                    # per-tenant queue-wait attribution: the "who waited"
+                    # axis of the fairness claim (tenant.queue_wait_us)
+                    _tenant_registry().record_queue_wait(job.tenant, wait)
             return round_jobs
 
     def _run_round(self, round_jobs: List[_Job]) -> None:
@@ -273,7 +288,13 @@ class UpdateWorker:
                                 time.time() - wait, wait)
         err = None
         try:
-            with _spans.round_scope(traces):
+            # the round executes under the FIRST job's tenant (the rule
+            # round_scope already applies to traces): chain-forward RPCs
+            # issued from the worker thread re-propagate an owner instead
+            # of degrading to "default" — receivers exempt chain-internal
+            # hops from quota anyway, so this only affects attribution
+            with _spans.round_scope(traces), \
+                    _tenant_id.tenant_scope(round_jobs[0].tenant):
                 outs = self._runner(reqs)
         except Exception as e:  # runner bug: report, don't wedge
             import logging

@@ -1,0 +1,26 @@
+"""Multi-tenant fairness: tenant identity on every RPC, nested
+per-tenant weighted-fair queuing inside each traffic class, distributed
+token-bucket quotas, and per-tenant attribution (docs/tenancy.md).
+
+- ``identity``: the ContextVar + envelope carriage of the tenant id;
+- ``quota``: the hot-configurable quota table + per-tenant buckets,
+  enforced at admission with the retryable ``Code.TENANT_THROTTLED``;
+- ``enforcement``: the static per-method enforcement classification
+  checked by tools/check_rpc_registry.py (check 6).
+"""
+
+from tpu3fs.tenant.identity import (  # noqa: F401
+    DEFAULT_TENANT,
+    current_tenant,
+    decode_tenant,
+    resolved_tenant,
+    tenant_scope,
+    valid_tenant,
+)
+from tpu3fs.tenant.quota import (  # noqa: F401
+    TenantConfig,
+    TenantQuota,
+    TenantRegistry,
+    apply_tenant_config,
+    registry,
+)

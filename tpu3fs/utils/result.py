@@ -128,6 +128,13 @@ class Code(enum.IntEnum):
     #                          consecutive-failure budget: producers must
     #                          stop buffering (tier.py error budget)
 
+    # tenant subsystem 11xx (tpu3fs/tenant)
+    TENANT_THROTTLED = 1100  # the op's TENANT exceeded its quota (bytes/s,
+    #                          IOPS or kvcache resident budget): retryable,
+    #                          carries a retry-after hint like OVERLOADED —
+    #                          but it names WHO was over, not that the
+    #                          server was full (docs/tenancy.md)
+
 
 #: Codes on which a client-side retry ladder may re-issue the request.
 RETRYABLE_CODES = frozenset(
@@ -162,6 +169,10 @@ RETRYABLE_CODES = frozenset(
         # breaker fail-fast: the peer is suspected sick — refresh routing
         # and retry (the half-open probe re-tests the peer independently)
         Code.PEER_UNHEALTHY,
+        # tenant quota shed: the server is telling this TENANT to come
+        # back after its bucket refills (retry-after hint, like
+        # OVERLOADED; a well-behaved client ladder waits it out)
+        Code.TENANT_THROTTLED,
     }
 )
 
