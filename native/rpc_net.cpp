@@ -970,6 +970,41 @@ struct QosBucket {
   }
 };
 
+// per-TENANT gate for ops served WITHOUT entering Python (the native
+// read fast path): the C mirror of tpu3fs/tenant/quota.py's per-tenant
+// iops/bytes buckets. The iops axis pre-charges (refundable on a Python
+// fallback, where tenant/quota.py charges the op again); the bytes axis
+// is availability-checked before serving and charged AFTER (the served
+// byte count is only known then) — debt drains at the configured rate,
+// throttling subsequent ops, which is the standard post-charge model.
+constexpr int64_t kTenantThrottled = 1100;  // Code.TENANT_THROTTLED
+
+struct TenantGate {
+  QosBucket iops;
+  QosBucket bytes;
+
+  // availability probe for the post-charged bytes axis: 0 when tokens
+  // are positive (or unlimited), else suggested retry-after ms
+  int64_t bytes_blocked_ms(int64_t fallback_ms) {
+    std::lock_guard<std::mutex> g(bytes.mu);
+    if (bytes.rate <= 0.0) return 0;
+    double now = mono_now();
+    if (now > bytes.last_s)
+      bytes.tokens =
+          std::min(bytes.burst, bytes.tokens + (now - bytes.last_s) * bytes.rate);
+    bytes.last_s = now;
+    if (bytes.tokens > 0.0) return 0;
+    int64_t ms = static_cast<int64_t>(-bytes.tokens / bytes.rate * 1000.0) + 1;
+    return std::max(fallback_ms, ms);
+  }
+
+  // post-serve charge: may push the bytes axis into debt
+  void charge_bytes(double cost) {
+    std::lock_guard<std::mutex> g(bytes.mu);
+    if (bytes.rate > 0.0) bytes.tokens -= cost;
+  }
+};
+
 struct QosState {
   std::mutex mu;  // guards the map shape; buckets lock themselves
   std::map<int64_t, std::unique_ptr<QosBucket>> buckets;
@@ -979,7 +1014,18 @@ struct QosState {
   // ((flags >> 8) & 0xF; 0 = untagged). Installed from QosConfig's
   // per-class sections by tpu3fs/rpc/native_net.py.
   std::map<int64_t, std::unique_ptr<QosBucket>> class_buckets;
+  // exact-name tenant gates installed from the [tenants] quota table by
+  // tpu3fs/rpc/native_net.py (hot pushes re-sync via the registry's
+  // reload hook). Unconfigured tenants pass free here — Python's
+  // lazily-minted default-quota buckets cover them on the fallback path,
+  // and a shared default bucket in C would mis-attribute one tenant's
+  // flood to every unknown peer.
+  std::map<std::string, std::unique_ptr<TenantGate>> tenant_gates;
+  // envelope class codes exempt from tenant gating (background/recovery
+  // classes: system work is never tenant-charged, tenant/quota.py)
+  std::atomic<uint64_t> tenant_exempt_mask{0};
   std::atomic<uint64_t> shed{0};
+  std::atomic<uint64_t> tenant_shed{0};
   int64_t retry_after_ms = 50;
 
   QosBucket* find(int64_t service_id) {
@@ -993,7 +1039,47 @@ struct QosState {
     auto it = class_buckets.find((service_id << 8) | (class_code & 0xF));
     return it == class_buckets.end() ? nullptr : it->second.get();
   }
+
+  TenantGate* find_tenant(const std::string& name) {
+    if (name.empty()) return nullptr;
+    std::lock_guard<std::mutex> g(mu);
+    auto it = tenant_gates.find(name);
+    return it == tenant_gates.end() ? nullptr : it->second.get();
+  }
 };
+
+// parse the u1.<tenant> token off a request envelope message — the C
+// mirror of tenant/identity.py decode_tenant: skip the 4 trace fields
+// when the message is traced, then step over 2-field tokens until u1.
+// Returns "" (-> gate skipped, "default" semantics) on absent/malformed.
+std::string parse_tenant(const std::string& msg) {
+  if (msg.empty() || msg.find("u1") == std::string::npos) return "";
+  std::vector<std::string> parts;
+  size_t start = 0;
+  while (true) {
+    size_t dot = msg.find('.', start);
+    if (dot == std::string::npos) {
+      parts.push_back(msg.substr(start));
+      break;
+    }
+    parts.push_back(msg.substr(start, dot - start));
+    start = dot + 1;
+  }
+  size_t idx = (!parts.empty() && parts[0] == "t1") ? 4 : 0;
+  while (idx + 1 < parts.size()) {
+    if (parts[idx] == "u1") {
+      const std::string& name = parts[idx + 1];
+      if (name.empty() || name.size() > 64) return "";
+      for (char c : name)
+        if (!((c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') ||
+              c == '_' || c == '-'))
+          return "";
+      return name;
+    }
+    idx += 2;
+  }
+  return "";
+}
 
 struct Server {
   int listen_fd = -1;
@@ -1112,6 +1198,49 @@ void worker_main(Server* s) {
           continue;
         }
       }
+      // per-TENANT gate (the ROADMAP carried follow-up: reads served
+      // below Python bypassed tenant buckets). The envelope's u1.* token
+      // names the owner; background classes are exempt (system work);
+      // the iops take is REFUNDED on a Python fallback because the
+      // Python read admission charges the op again.
+      TenantGate* tg = nullptr;
+      uint64_t class_code = uint64_t((req.flags >> 8) & 0xF);
+      if ((s->qos.tenant_exempt_mask.load() & (1ull << class_code)) == 0) {
+        std::string tname = parse_tenant(req.message);
+        tg = s->qos.find_tenant(tname.empty() ? "default" : tname);
+      }
+      if (tg != nullptr) {
+        int64_t tra = tg->iops.try_take(s->qos.retry_after_ms);
+        if (tra == 0) {
+          int64_t bra = tg->bytes_blocked_ms(s->qos.retry_after_ms);
+          if (bra > 0) {
+            tg->iops.put_back();
+            tra = bra;
+          }
+        }
+        if (tra > 0) {
+          if (cb != nullptr) cb->put_back();
+          s->qos.tenant_shed.fetch_add(1);
+          rsp.status = kTenantThrottled;
+          rsp.message = "retry_after_ms=" + std::to_string(tra) +
+                        " (native tenant gate)";
+          rsp.ts[5] = mono_now();
+          std::string envq = encode_packet(rsp);
+          uint64_t totalq = envq.size();
+          uint8_t hdrq[4] = {uint8_t(totalq >> 24), uint8_t(totalq >> 16),
+                             uint8_t(totalq >> 8), uint8_t(totalq)};
+          struct iovec iovq[2] = {
+              {hdrq, 4},
+              {const_cast<char*>(envq.data()), envq.size()},
+          };
+          std::lock_guard<std::mutex> g(job.conn->write_mu);
+          if (!job.conn->closed.load() &&
+              !send_iovs(job.conn->fd, iovq, 2, kServerDrainTimeoutMs)) {
+            server_close_conn(s, job.conn);
+          }
+          continue;
+        }
+      }
       FpReadOut fpo;
       bool handled = false;
       try {
@@ -1123,6 +1252,11 @@ void worker_main(Server* s) {
         handled = false;
       }
       if (handled) {
+        // post-serve charge of the bytes axis (size known only now);
+        // debt throttles the tenant's NEXT ops at the gate above
+        if (tg != nullptr)
+          tg->charge_bytes(double(fpo.reply_bulk ? fpo.bulk_bytes()
+                                                 : fpo.payload.size()));
         rsp.status = OK;
         rsp.payload = std::move(fpo.payload);
         if (fpo.reply_bulk) rsp.flags |= kFlagBulk;
@@ -1153,6 +1287,7 @@ void worker_main(Server* s) {
         continue;
       }
       if (cb != nullptr) cb->put_back();
+      if (tg != nullptr) tg->iops.put_back();  // Python charges it again
       s->fastpath.fallbacks.fetch_add(1);
     }
     // native write fast path: the chain-internal batchUpdate hop against
@@ -1846,6 +1981,59 @@ void tpu3fs_rpc_qos_clear(void* srv) {
 uint64_t tpu3fs_rpc_qos_shed_count(void* srv) {
   Server* s = static_cast<Server*>(srv);
   return s == nullptr ? 0 : s->qos.shed.load();
+}
+
+// ---- per-tenant fast-path gate configuration (see TenantGate above) --------
+// Installed from the [tenants] quota table by tpu3fs/rpc/native_net.py
+// (re-synced on hot pushes via TenantRegistry.add_reload_hook). Rates
+// <= 0 = unlimited on that axis, matching tenant/quota.py.
+
+void tpu3fs_rpc_tenant_set(void* srv, const char* tenant, double iops_rate,
+                           double iops_burst, double bytes_rate,
+                           double bytes_burst) {
+  Server* s = static_cast<Server*>(srv);
+  if (s == nullptr || tenant == nullptr) return;
+  std::lock_guard<std::mutex> g(s->qos.mu);
+  auto& slot = s->qos.tenant_gates[std::string(tenant)];
+  if (!slot) slot = std::make_unique<TenantGate>();
+  {
+    std::lock_guard<std::mutex> bg(slot->iops.mu);
+    slot->iops.rate = iops_rate;
+    slot->iops.burst = std::max(1.0, iops_burst);
+    slot->iops.tokens = slot->iops.burst;
+    slot->iops.last_s = mono_now();
+  }
+  {
+    std::lock_guard<std::mutex> bg(slot->bytes.mu);
+    slot->bytes.rate = bytes_rate;
+    slot->bytes.burst = std::max(1.0, bytes_burst);
+    slot->bytes.tokens = slot->bytes.burst;
+    slot->bytes.last_s = mono_now();
+  }
+}
+
+void tpu3fs_rpc_tenant_clear(void* srv) {
+  Server* s = static_cast<Server*>(srv);
+  if (s == nullptr) return;
+  // disable rather than erase (same lifetime rule as qos_clear): a
+  // worker may hold a gate pointer from find_tenant while this runs
+  std::lock_guard<std::mutex> g(s->qos.mu);
+  for (auto& kv : s->qos.tenant_gates) {
+    std::lock_guard<std::mutex> ig(kv.second->iops.mu);
+    kv.second->iops.rate = 0.0;
+    std::lock_guard<std::mutex> bg(kv.second->bytes.mu);
+    kv.second->bytes.rate = 0.0;
+  }
+}
+
+void tpu3fs_rpc_tenant_exempt_classes(void* srv, uint64_t mask) {
+  Server* s = static_cast<Server*>(srv);
+  if (s != nullptr) s->qos.tenant_exempt_mask.store(mask);
+}
+
+uint64_t tpu3fs_rpc_tenant_shed_count(void* srv) {
+  Server* s = static_cast<Server*>(srv);
+  return s == nullptr ? 0 : s->qos.tenant_shed.load();
 }
 
 void tpu3fs_rpc_fastpath_stats(void* srv, uint64_t* hits,

@@ -36,9 +36,10 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x3F5B10;
+constexpr uint32_t kVersion = 2;  // ring ABI v2 (docs/usrbio_abi.md)
 constexpr size_t kHdrSize = 64;
-constexpr size_t kSqeSize = 48;  // <QQQiIQIi
-constexpr size_t kCqeSize = 24;  // <qQQ
+constexpr size_t kSqeSize = 224;  // <QQQQQiIHHQIHH156s (v2 extended SQE)
+constexpr size_t kCqeSize = 24;   // <qQQ
 constexpr uint32_t kSqeFlagRead = 1;
 
 double now_s() {
@@ -102,6 +103,12 @@ struct Ring {
     memset(shm.base, 0, shm.size);
     memcpy(shm.base, &kMagic, 4);
     memcpy(shm.base + 4, &n, 4);
+    // v2 header trailer: version + owner pid (offsets 40/44) — the
+    // agent-side reaper collects rings whose stamped owner died
+    uint32_t version = kVersion;
+    uint32_t owner = uint32_t(getpid());
+    memcpy(shm.base + 40, &version, 4);
+    memcpy(shm.base + 44, &owner, 4);
     sq_sem = sem_open(("/" + ring_name + "-sq").c_str(), O_CREAT, 0644, 0);
     cq_sem = sem_open(("/" + ring_name + "-cq").c_str(), O_CREAT, 0644, 0);
     return sq_sem != SEM_FAILED && cq_sem != SEM_FAILED;
@@ -115,13 +122,14 @@ struct Ring {
     size_t slot = size_t(tail % entries);
     uint8_t* sqe = shm.base + kHdrSize + slot * kSqeSize;
     uint32_t flags = read ? kSqeFlagRead : 0;
+    memset(sqe, 0, kSqeSize);  // rpc/rsp/token fields zero for file ops
     memcpy(sqe + 0, &iov_off, 8);
     memcpy(sqe + 8, &len, 8);
     memcpy(sqe + 16, &file_off, 8);
-    memcpy(sqe + 24, &fd, 4);
-    memcpy(sqe + 28, &flags, 4);
-    memcpy(sqe + 32, &userdata, 8);
-    memcpy(sqe + 40, &iov_id, 4);
+    memcpy(sqe + 40, &fd, 4);
+    memcpy(sqe + 44, &flags, 4);
+    memcpy(sqe + 52, &userdata, 8);
+    memcpy(sqe + 60, &iov_id, 4);
     store(16, tail + 1);
     return int(slot);
   }

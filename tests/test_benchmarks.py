@@ -38,23 +38,19 @@ class TestStorageBench:
 
 class TestUsrbioBench:
     def test_small_run(self):
-        row = usrbio_bench(bs=8192, iodepth=8, file_mb=1, batches=2,
-                           chunk_size=65536)
-        assert row["ios"] == 16
-        assert row["value"] > 0
-
-    def test_file_equals_bs(self):
-        row = usrbio_bench(bs=65536, iodepth=2, file_mb=1, batches=1,
-                           chunk_size=65536)
-        assert row["ios"] == 2
-
-    def test_bad_bs_rejected(self):
-        import pytest
-
-        with pytest.raises(ValueError):
-            usrbio_bench(bs=2 << 20, file_mb=1)
-        with pytest.raises(ValueError):
-            usrbio_bench(bs=196608, file_mb=1)
+        # tiny in-process A/B: both transports produce data, every
+        # metric row carries ring + sock samples and a speedup
+        rows = usrbio_bench(chunk_kb=64, batch=4, reps=1, single_ops=2,
+                            iov_mb=16, inproc=True)
+        names = {r["metric"] for r in rows}
+        assert names == {"usrbio_batch_read", "usrbio_batch_write",
+                         "usrbio_wire_read", "usrbio_wire_write",
+                         "usrbio_single_read_us",
+                         "usrbio_single_write_us"}
+        for r in rows:
+            assert r["ring"] > 0 and r["sock"] > 0
+            assert len(r["samples_ring"]) == 1
+            assert r["speedup"] > 0
 
 
 class TestRebuildBench:

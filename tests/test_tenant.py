@@ -641,3 +641,183 @@ class TestEnforcementTable:
 
         for svc, name in CLASSIFICATION:
             assert enforcement_of(svc, name) is not None, (svc, name)
+
+
+# -- native C fast-path tenant gate (ROADMAP carried follow-up) ---------------
+
+
+class TestNativeTenantGate:
+    """Reads served below Python (the C read fast path) used to bypass
+    tenant buckets entirely (class gates applied). The C-side TenantGate
+    mirrors the [tenants] table: iops pre-charge with Python-fallback
+    refund, bytes post-charge with debt."""
+
+    def _boot(self, tmp_path):
+        from tpu3fs.kv.mem import MemKVEngine
+        from tpu3fs.mgmtd.service import Mgmtd
+        from tpu3fs.mgmtd.types import LocalTargetState, NodeType
+        from tpu3fs.rpc.native_net import NativeRpcClient, NativeRpcServer
+        from tpu3fs.rpc.services import (
+            MgmtdRpcClient,
+            RpcMessenger,
+            bind_mgmtd_service,
+            bind_storage_service,
+        )
+        from tpu3fs.storage.craq import StorageService
+        from tpu3fs.storage.target import StorageTarget
+
+        mgmtd = Mgmtd(1, MemKVEngine())
+        mgmtd.extend_lease()
+        mgmtd_server = NativeRpcServer()
+        bind_mgmtd_service(mgmtd_server, mgmtd)
+        mgmtd_server.start()
+        client = NativeRpcClient()
+        mcli = MgmtdRpcClient(mgmtd_server.address, client)
+        svc = StorageService(10, mcli.refresh_routing)
+        svc.set_messenger(RpcMessenger(mcli.refresh_routing, client))
+        target = StorageTarget(1000, 710_001, engine="native",
+                               path=str(tmp_path / "t"), chunk_size=4096)
+        svc.add_target(target)
+        server = NativeRpcServer()
+        bind_storage_service(server, svc)
+        server.start()
+        mgmtd.register_node(10, NodeType.STORAGE, host=server.host,
+                            port=server.port)
+        mgmtd.create_target(1000, node_id=10)
+        mgmtd.upload_chain(710_001, [1000])
+        mgmtd.upload_chain_table(1, [710_001])
+        mgmtd.heartbeat(10, 1, {1000: LocalTargetState.UPTODATE})
+        if not hasattr(server._lib, "tpu3fs_rpc_tenant_set"):
+            client.close()
+            server.stop()
+            mgmtd_server.stop()
+            pytest.skip("stale libtpu3fs_rpc.so: no tenant gate")
+        return mgmtd_server, server, client, mcli, svc
+
+    def test_fastpath_sheds_tenant_throttled(self, tmp_path):
+        from tpu3fs.client.storage_client import (
+            ReadReq,
+            RetryOptions,
+            StorageClient,
+        )
+        from tpu3fs.rpc.services import RpcMessenger
+        from tpu3fs.storage.native_fastpath import sync_read_fastpath
+        from tpu3fs.storage.types import ChunkId
+
+        mgmtd_server, server, client, mcli, svc = self._boot(tmp_path)
+        try:
+            sc = StorageClient(
+                "tg-test", mcli.refresh_routing,
+                RpcMessenger(mcli.refresh_routing, client),
+                retry=RetryOptions(max_retries=0, backoff_base_s=0.001))
+            assert sc.write_chunk(710_001, ChunkId(5, 1), 0, b"x" * 4096,
+                                  chunk_size=4096).ok
+            # install admission AFTER the write so the storage-internal
+            # write path stays out of the picture; then configure a tight
+            # iops quota for alice — the registry reload hook pushes it
+            # into the C gate
+            server.set_admission(AdmissionController(QosConfig()))
+            assert sync_read_fastpath(server, svc) == 1
+            registry().configure("tenant=alice,iops=2,burst_s=1")
+            reqs = [ReadReq(710_001, ChunkId(5, 1), 0, -1, 1000)]
+            shed0 = server.tenant_shed_count()
+            with tenant_scope("alice"):
+                replies = [sc.batch_read(reqs)[0] for _ in range(10)]
+            assert server.tenant_shed_count() > shed0, \
+                "tenant flood never reached the native tenant gate"
+            throttled = [r for r in replies if r.code ==
+                         Code.TENANT_THROTTLED]
+            assert throttled, [r.code for r in replies]
+            assert any(r.retry_after_ms > 0 for r in throttled)
+            # untenanted (default, unconfigured) traffic is untouched
+            assert all(sc.batch_read(reqs)[0].ok for _ in range(4))
+            # BACKGROUND classes are never tenant-charged: alice's own
+            # recovery reads pass the dry bucket
+            with tenant_scope("alice"), tagged(TrafficClass.RESYNC):
+                assert sc.batch_read(reqs)[0].ok
+            # quota lifted: alice recovers immediately
+            registry().clear()
+            with tenant_scope("alice"):
+                assert all(sc.batch_read(reqs)[0].ok for _ in range(6))
+        finally:
+            client.close()
+            server.stop()
+            mgmtd_server.stop()
+
+    def test_bytes_debt_throttles_next_ops(self, tmp_path):
+        from tpu3fs.client.storage_client import (
+            ReadReq,
+            RetryOptions,
+            StorageClient,
+        )
+        from tpu3fs.rpc.services import RpcMessenger
+        from tpu3fs.storage.native_fastpath import sync_read_fastpath
+        from tpu3fs.storage.types import ChunkId
+
+        mgmtd_server, server, client, mcli, svc = self._boot(tmp_path)
+        try:
+            sc = StorageClient(
+                "tb-test", mcli.refresh_routing,
+                RpcMessenger(mcli.refresh_routing, client),
+                retry=RetryOptions(max_retries=0, backoff_base_s=0.001))
+            assert sc.write_chunk(710_001, ChunkId(6, 1), 0, b"y" * 4096,
+                                  chunk_size=4096).ok
+            server.set_admission(AdmissionController(QosConfig()))
+            assert sync_read_fastpath(server, svc) == 1
+            # 100 B/s with a ~100 B burst: the FIRST 4 KiB read is served
+            # (availability check passes on a positive bucket) and drives
+            # the bucket deep into debt; the next read sheds
+            registry().configure("tenant=bob,bytes_per_s=100,burst_s=1")
+            reqs = [ReadReq(710_001, ChunkId(6, 1), 0, -1, 1000)]
+            with tenant_scope("bob"):
+                first = sc.batch_read(reqs)[0]
+                second = sc.batch_read(reqs)[0]
+            assert first.ok
+            assert second.code == Code.TENANT_THROTTLED
+        finally:
+            client.close()
+            server.stop()
+            mgmtd_server.stop()
+
+    def test_python_fallback_refunds_iops_take(self, tmp_path):
+        """With the fast-path registry EMPTY every read falls back to the
+        Python dispatch: the C gate's pre-charge must be refunded, so a
+        tight C-side-only quota (installed directly, no Python buckets)
+        never sheds anything."""
+        from tpu3fs.client.storage_client import (
+            ReadReq,
+            RetryOptions,
+            StorageClient,
+        )
+        from tpu3fs.rpc.services import RpcMessenger
+        from tpu3fs.storage.native_fastpath import sync_read_fastpath
+        from tpu3fs.storage.types import ChunkId
+
+        mgmtd_server, server, client, mcli, svc = self._boot(tmp_path)
+        try:
+            sc = StorageClient(
+                "tr-test", mcli.refresh_routing,
+                RpcMessenger(mcli.refresh_routing, client),
+                retry=RetryOptions(max_retries=0, backoff_base_s=0.001))
+            assert sc.write_chunk(710_001, ChunkId(7, 1), 0, b"z" * 4096,
+                                  chunk_size=4096).ok
+            server.set_admission(AdmissionController(QosConfig()))
+            # C gate installed directly (2 ops of burst, trickle refill);
+            # the PYTHON registry stays permissive on purpose
+            server._lib.tpu3fs_rpc_tenant_set(
+                server._srv, b"carol", 0.001, 2.0, 0.0, 1.0)
+            reqs = [ReadReq(710_001, ChunkId(7, 1), 0, -1, 1000)]
+            # registry empty -> every read falls back -> refund: far more
+            # reads than the burst all succeed
+            with tenant_scope("carol"):
+                assert all(sc.batch_read(reqs)[0].ok for _ in range(10))
+            assert server.tenant_shed_count() == 0
+            # now register the fast path: the same budget sheds quickly
+            assert sync_read_fastpath(server, svc) == 1
+            with tenant_scope("carol"):
+                replies = [sc.batch_read(reqs)[0] for _ in range(6)]
+            assert any(r.code == Code.TENANT_THROTTLED for r in replies)
+        finally:
+            client.close()
+            server.stop()
+            mgmtd_server.stop()

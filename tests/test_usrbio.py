@@ -7,8 +7,10 @@ import numpy as np
 import pytest
 
 from tpu3fs.fabric import Fabric, SystemSetupConfig
+from tpu3fs.rpc import deadline as dl
+from tpu3fs.tenant import tenant_scope
 from tpu3fs.usrbio import Iov, IoRing, UsrbioAgent, UsrbioClient
-from tpu3fs.utils.result import Code
+from tpu3fs.utils.result import Code, FsError
 
 
 @pytest.fixture
@@ -247,3 +249,487 @@ class TestReadInto:
         buf2 = bytearray(len(payload))
         n2 = fio2.read_into(inode2, 0, len(payload), memoryview(buf2))
         assert n2 == len(payload) and bytes(buf2) == payload
+
+
+# -- ring ABI v2 --------------------------------------------------------------
+
+
+class TestRingAbiV2:
+    def test_counter_wraparound(self):
+        """Counters are monotonic; slots wrap at entries. Several times
+        around the ring, nothing aliases."""
+        ring = IoRing(4, create=True)
+        try:
+            for round_no in range(5):
+                for k in range(4):
+                    assert ring.prep_io(0, 1, 0, 1, read=True,
+                                        userdata=round_no * 10 + k) >= 0
+                sqes = ring.drain_sqes()
+                assert [s.userdata for s in sqes] == [
+                    round_no * 10 + k for k in range(4)]
+                for s in sqes:
+                    ring.push_cqe(1, s.userdata)
+                got = sorted(ud for _, ud in ring.reap())
+                assert got == [round_no * 10 + k for k in range(4)]
+        finally:
+            ring.close()
+
+    def test_token_and_class_flags_roundtrip(self):
+        from tpu3fs.qos.core import TrafficClass, class_from_flags, \
+            class_to_flags
+
+        ring = IoRing(8, create=True)
+        try:
+            tok = "t1.0123456789abcdef.fedcba9876543210.1.d1.abc123." \
+                  "u1.alice"
+            ring.prep_io(0, 100, 0, 5, read=True, token=tok,
+                         class_flags=class_to_flags(TrafficClass.KVCACHE))
+            sqe = ring.drain_sqes()[0]
+            assert sqe.token == tok
+            assert class_from_flags(sqe.flags) == TrafficClass.KVCACHE
+            from tpu3fs.rpc.deadline import decode_deadline
+            from tpu3fs.tenant.identity import decode_tenant
+
+            assert decode_tenant(sqe.token) == "alice"
+            assert decode_deadline(sqe.token) is not None
+        finally:
+            ring.close()
+
+    def test_oversized_token_refused(self):
+        ring = IoRing(8, create=True)
+        try:
+            with pytest.raises(FsError) as ei:
+                ring.prep_io(0, 1, 0, 1, read=True, token="u1." + "x" * 200)
+            assert ei.value.code == Code.USRBIO_BAD_IOV
+        finally:
+            ring.close()
+
+    def test_rpc_sqe_roundtrip(self):
+        ring = IoRing(8, create=True)
+        try:
+            slot = ring.prep_rpc(3, 11, 256, 1024, 2048, 8192,
+                                 userdata=7, token="u1.bob", bulk=True)
+            assert slot == 0
+            sqe = ring.drain_sqes()[0]
+            assert sqe.is_rpc and sqe.has_bulk and not sqe.is_read
+            assert (sqe.service_id, sqe.method_id) == (3, 11)
+            assert sqe.iov_offset == 256 and sqe.length == 1024
+            assert sqe.rsp_offset == 2048 and sqe.rsp_capacity == 8192
+            assert sqe.token == "u1.bob"
+        finally:
+            ring.close()
+
+    def test_torn_header_detected(self):
+        import struct as _struct
+
+        ring = IoRing(8, create=True)
+        try:
+            ring.buf[0:4] = _struct.pack("<I", 0xDEAD)  # tear the magic
+            with pytest.raises(FsError) as ei:
+                ring.drain_sqes()
+            assert ei.value.code == Code.USRBIO_TORN_RING
+        finally:
+            ring.buf[0:4] = _struct.pack("<I", 0x3F5B10)
+            ring.close()
+
+    def test_open_refuses_wrong_version(self):
+        import struct as _struct
+
+        ring = IoRing(8, create=True)
+        try:
+            ring.buf[40:44] = _struct.pack("<I", 1)  # claim ABI v1
+            with pytest.raises(FsError) as ei:
+                IoRing(8, name=ring.name, create=False)
+            assert ei.value.code == Code.USRBIO_TORN_RING
+        finally:
+            ring.close()
+
+    def test_owner_pid_stamped_and_reaped(self):
+        import os
+        import struct as _struct
+
+        from tpu3fs.usrbio.ring import reap_stale_shm
+
+        ring = IoRing(8, create=True)
+        name = ring.name
+        assert ring.owner_pid == os.getpid()
+        # a LIVE owner is never reaped
+        assert name not in reap_stale_shm()
+        # forge a dead owner (a child that already exited)
+        pid = os.fork()
+        if pid == 0:
+            os._exit(0)
+        os.waitpid(pid, 0)
+        ring.buf[44:48] = _struct.pack("<I", pid)
+        removed = reap_stale_shm()
+        assert name in removed
+        assert not os.path.exists(ring.path)
+        # keep= protects a registration even with a dead owner
+        ring.close()
+
+    def test_orphan_iov_age_reap(self):
+        import os
+        import time as _time
+
+        from tpu3fs.usrbio.ring import reap_stale_shm
+
+        iov = Iov(4096, create=True)
+        old = _time.time() - 7200
+        os.utime(iov.path, (old, old))
+        # protected while registered
+        assert iov.name not in reap_stale_shm(keep={iov.name})
+        assert os.path.exists(iov.path)
+        removed = reap_stale_shm()
+        assert iov.name in removed
+        iov.close()
+
+    def test_unlink_on_close_default(self):
+        import os
+
+        iov = Iov(4096, create=True)
+        ring = IoRing(8, create=True)
+        ipath, rpath = iov.path, ring.path
+        # a mapper (create=False) closing must NOT unlink
+        mapped = Iov(4096, name=iov.name, create=False)
+        mapped.close()
+        assert os.path.exists(ipath)
+        iov.close()
+        ring.close()
+        assert not os.path.exists(ipath)
+        assert not os.path.exists(rpath)
+
+
+# -- the RPC ring transport against a live socket cluster ---------------------
+
+
+@pytest.fixture
+def ring_cluster():
+    """mgmtd + 2 storage nodes over real TCP, each hosting the USRBIO
+    control service + ring agent, with the full storage-internal QoS +
+    tenant admission stack installed (storage_main shape)."""
+    from tpu3fs.kv import MemKVEngine
+    from tpu3fs.mgmtd.service import Mgmtd
+    from tpu3fs.mgmtd.types import LocalTargetState, NodeType
+    from tpu3fs.qos.core import QosConfig
+    from tpu3fs.qos.manager import QosManager
+    from tpu3fs.rpc.net import RpcClient, RpcServer
+    from tpu3fs.rpc.services import (
+        MgmtdRpcClient,
+        RpcMessenger,
+        bind_mgmtd_service,
+        bind_storage_service,
+    )
+    from tpu3fs.storage.craq import StorageService
+    from tpu3fs.storage.target import StorageTarget
+    from tpu3fs.usrbio.server import UsrbioRpcHost, bind_usrbio_service
+
+    kv = MemKVEngine()
+    mgmtd = Mgmtd(1, kv)
+    mgmtd.extend_lease()
+    mgmtd_server = RpcServer()
+    bind_mgmtd_service(mgmtd_server, mgmtd)
+    mgmtd_server.start()
+    servers = [mgmtd_server]
+    hosts = []
+    services = {}
+    chain_id = 910_001
+    shared = RpcClient()
+    for node_id, target_id in zip([10, 11], [1000, 1001]):
+        mcli = MgmtdRpcClient(mgmtd_server.address, shared)
+        svc = StorageService(node_id, mcli.refresh_routing)
+        svc.set_messenger(RpcMessenger(mcli.refresh_routing, shared))
+        svc.add_target(StorageTarget(target_id, chain_id, chunk_size=4096))
+        svc.set_qos(QosManager(QosConfig(),
+                               tags={"node": str(node_id)}))
+        server = RpcServer()
+        bind_storage_service(server, svc)
+        host = UsrbioRpcHost(server)
+        bind_usrbio_service(server, host)
+        server.start()
+        hosts.append(host)
+        mgmtd.register_node(node_id, NodeType.STORAGE,
+                            host=server.host, port=server.port)
+        mgmtd.create_target(target_id, node_id=node_id)
+        services[node_id] = svc
+        servers.append(server)
+    mgmtd.upload_chain(chain_id, [1000, 1001])
+    mgmtd.upload_chain_table(1, [chain_id])
+    mgmtd.heartbeat(10, 1, {1000: LocalTargetState.UPTODATE})
+    mgmtd.heartbeat(11, 1, {1001: LocalTargetState.UPTODATE})
+    from tpu3fs.tenant.quota import registry as treg
+
+    treg().clear()
+    yield {
+        "mgmtd": mgmtd,
+        "mgmtd_addr": mgmtd_server.address,
+        "chain_id": chain_id,
+        "client": shared,
+        "services": services,
+        "hosts": hosts,
+    }
+    treg().clear()
+    for h in hosts:
+        h.stop()
+    for svc in services.values():
+        # chain-forward messengers grew rings of their own: unlink now,
+        # not at interpreter exit (tier-1 runs hundreds of tests)
+        close = getattr(getattr(svc, "_messenger", None), "close_rings",
+                        None)
+        if close is not None:
+            close()
+    for s in servers:
+        s.stop()
+
+
+def _mk_client(cluster, cid="rc"):
+    from tpu3fs.client.storage_client import RetryOptions, StorageClient
+    from tpu3fs.rpc.services import MgmtdRpcClient, RpcMessenger
+
+    mcli = MgmtdRpcClient(cluster["mgmtd_addr"], cluster["client"])
+    messenger = RpcMessenger(mcli.refresh_routing, cluster["client"])
+    sc = StorageClient(cid, mcli.refresh_routing, messenger,
+                       retry=RetryOptions(max_retries=0,
+                                          backoff_base_s=0.001))
+    return sc, messenger
+
+
+class TestRingTransport:
+    def test_ring_selected_and_io_equivalence(self, ring_cluster):
+        from tpu3fs.client.storage_client import ReadReq
+        from tpu3fs.storage.types import ChunkId
+
+        sc, messenger = _mk_client(ring_cluster)
+        chain = ring_cluster["chain_id"]
+        writes = [(chain, ChunkId(1, i), 0, bytes([i + 1]) * 700)
+                  for i in range(8)]
+        assert all(r.ok for r in sc.batch_write(writes, chunk_size=4096))
+        # a ring was established to the head node (same host by proof)
+        rings = {k: v for k, v in messenger._usrbio_rings.items()
+                 if v is not None}
+        assert rings, "no USRBIO ring established on a same-host cluster"
+        got = sc.batch_read([ReadReq(chain, ChunkId(1, i), 0, -1)
+                             for i in range(8)])
+        assert [bytes(r.data) for r in got] == [
+            bytes([i + 1]) * 700 for i in range(8)]
+        # equivalence against a sockets-only client
+        import os as _os
+
+        _os.environ["TPU3FS_USRBIO"] = "0"
+        try:
+            sc2, m2 = _mk_client(ring_cluster, "rc-sock")
+            got2 = sc2.batch_read([ReadReq(chain, ChunkId(1, i), 0, -1)
+                                   for i in range(8)])
+            assert [bytes(r.data) for r in got2] == \
+                [bytes(r.data) for r in got]
+            assert not m2._usrbio_rings
+            sc2.close()
+        finally:
+            del _os.environ["TPU3FS_USRBIO"]
+        sc.close()
+
+    def test_large_payload_and_single_ops(self, ring_cluster):
+        from tpu3fs.storage.types import ChunkId
+
+        sc, messenger = _mk_client(ring_cluster)
+        chain = ring_cluster["chain_id"]
+        blob = bytes(range(256)) * 16  # one chunk exactly
+        assert sc.write_chunk(chain, ChunkId(3, 0), 0, blob,
+                              chunk_size=4096).ok
+        r = sc.read_chunk(chain, ChunkId(3, 0))
+        assert r.ok and bytes(r.data) == blob
+        sc.close()
+
+    def test_tenant_flood_sheds_through_ring(self, ring_cluster):
+        from tpu3fs.client.storage_client import ReadReq
+        from tpu3fs.storage.types import ChunkId
+        from tpu3fs.tenant.quota import registry as treg
+
+        sc, messenger = _mk_client(ring_cluster)
+        chain = ring_cluster["chain_id"]
+        assert sc.write_chunk(chain, ChunkId(4, 0), 0, b"q" * 2000,
+                              chunk_size=4096).ok
+        treg().configure("tenant=flood,iops=2,burst_s=1")
+        try:
+            reqs = [ReadReq(chain, ChunkId(4, 0), 0, -1)]
+            with tenant_scope("flood"):
+                replies = [sc.batch_read(reqs)[0] for _ in range(12)]
+            shed = [r for r in replies if r.code == Code.TENANT_THROTTLED]
+            assert shed, [r.code for r in replies]
+            # the retry-after hint survives the ring (honored by ladders)
+            assert all(r.retry_after_ms > 0 for r in shed)
+            # the ring really was the transport (still established)
+            assert any(v is not None
+                       for v in messenger._usrbio_rings.values())
+            # other tenants keep reading
+            assert sc.batch_read(reqs)[0].ok
+        finally:
+            treg().clear()
+        sc.close()
+
+    def test_qos_class_shed_through_ring(self, ring_cluster):
+        from tpu3fs.client.storage_client import ReadReq
+        from tpu3fs.qos.core import QosConfig, TrafficClass, tagged
+        from tpu3fs.storage.types import ChunkId
+
+        sc, messenger = _mk_client(ring_cluster)
+        chain = ring_cluster["chain_id"]
+        assert sc.write_chunk(chain, ChunkId(5, 0), 0, b"c" * 512,
+                              chunk_size=4096).ok
+        # choke the RESYNC class on every node's shared admission
+        for svc in ring_cluster["services"].values():
+            svc.qos.config.resync.rate = 0.001
+            svc.qos.config.resync.burst = 1.0
+            svc.qos.admission.reload()
+        reqs = [ReadReq(chain, ChunkId(5, 0), 0, -1)]
+        with tagged(TrafficClass.RESYNC):
+            replies = [sc.batch_read(reqs)[0] for _ in range(8)]
+        assert any(r.code == Code.OVERLOADED for r in replies), \
+            "class bits never reached admission through the ring SQE"
+        # foreground unaffected
+        assert sc.batch_read(reqs)[0].ok
+        sc.close()
+
+    def test_deadline_shed_at_ring_dequeue(self, ring_cluster):
+        import time as _time
+
+        from tpu3fs.client.storage_client import ReadReq
+        from tpu3fs.storage.types import ChunkId
+
+        sc, messenger = _mk_client(ring_cluster)
+        chain = ring_cluster["chain_id"]
+        assert sc.write_chunk(chain, ChunkId(6, 0), 0, b"d" * 128,
+                              chunk_size=4096).ok
+        # establish the ring first
+        assert sc.read_chunk(chain, ChunkId(6, 0)).ok
+        node_id = next(k for k, v in messenger._usrbio_rings.items()
+                       if v is not None)
+        with dl.deadline_scope(_time.time() - 0.5):
+            with pytest.raises(FsError) as ei:
+                messenger(node_id, "batch_read",
+                          [ReadReq(chain, ChunkId(6, 0), 0, -1)])
+        assert ei.value.code == Code.DEADLINE_EXCEEDED
+        sc.close()
+
+    def test_fallback_when_host_stops(self, ring_cluster):
+        from tpu3fs.client.storage_client import ReadReq
+        from tpu3fs.storage.types import ChunkId
+
+        sc, messenger = _mk_client(ring_cluster)
+        chain = ring_cluster["chain_id"]
+        assert sc.write_chunk(chain, ChunkId(7, 0), 0, b"f" * 900,
+                              chunk_size=4096).ok
+        assert sc.read_chunk(chain, ChunkId(7, 0)).ok
+        assert any(v is not None
+                   for v in messenger._usrbio_rings.values())
+        # kill the agents under the client: reads must keep succeeding
+        # (socket fallback), never surface a USRBIO error
+        for h in ring_cluster["hosts"]:
+            h.stop()
+        for _ in range(3):
+            got = sc.batch_read([ReadReq(chain, ChunkId(7, 0), 0, -1)])
+            assert got[0].ok and bytes(got[0].data) == b"f" * 900
+        sc.close()
+
+
+# -- cross-process rings over real fork ---------------------------------------
+
+
+def _fork_child_io(addr, chain, q):
+    """Runs in a forked child: establish a ring of its own and do IO."""
+    try:
+        from tpu3fs.client.storage_client import RetryOptions, StorageClient
+        from tpu3fs.rpc.net import RpcClient
+        from tpu3fs.rpc.services import MgmtdRpcClient, RpcMessenger
+        from tpu3fs.storage.types import ChunkId
+
+        mcli = MgmtdRpcClient(addr, RpcClient())
+        m = RpcMessenger(mcli.refresh_routing)
+        sc = StorageClient("forked", mcli.refresh_routing, m,
+                           retry=RetryOptions(max_retries=0,
+                                              backoff_base_s=0.001))
+        ok = sc.write_chunk(chain, ChunkId(9, 0), 0, b"forked-bytes" * 50,
+                            chunk_size=4096).ok
+        used_ring = any(v is not None for v in m._usrbio_rings.values())
+        r = sc.read_chunk(chain, ChunkId(9, 0))
+        q.put((bool(ok), bool(used_ring), bytes(r.data)))
+        sc.close()
+    except Exception as e:  # surface the child's failure to the parent
+        q.put(("err", repr(e), b""))
+
+
+def _fork_child_crash(addr, chain, q):
+    """Establish a ring, report its shm names, then die WITHOUT cleanup
+    (os._exit skips atexit) — the leak the agent reaper must collect."""
+    import os
+
+    from tpu3fs.client.storage_client import RetryOptions, StorageClient
+    from tpu3fs.rpc.net import RpcClient
+    from tpu3fs.rpc.services import MgmtdRpcClient, RpcMessenger
+    from tpu3fs.storage.types import ChunkId
+
+    mcli = MgmtdRpcClient(addr, RpcClient())
+    m = RpcMessenger(mcli.refresh_routing)
+    sc = StorageClient("crasher", mcli.refresh_routing, m,
+                       retry=RetryOptions(max_retries=0,
+                                          backoff_base_s=0.001))
+    sc.read_chunk(chain, ChunkId(9, 0))
+    names = []
+    for ring in m._usrbio_rings.values():
+        if ring is not None:
+            names.append(ring.ring.name)
+            names.append(ring.iov.name)
+    q.put(names)
+    # flush the queue's feeder thread BEFORE the un-clean exit: os._exit
+    # must kill the atexit cleanup, not the message to the parent
+    q.close()
+    q.join_thread()
+    os._exit(1)
+
+
+class TestRingCrossProcessFork:
+    def test_forked_client_rides_its_own_ring(self, ring_cluster):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        p = ctx.Process(target=_fork_child_io,
+                        args=(ring_cluster["mgmtd_addr"],
+                              ring_cluster["chain_id"], q))
+        p.start()
+        ok, used_ring, data = q.get(timeout=60)
+        p.join(30)
+        assert ok is True, (ok, used_ring, data)
+        assert used_ring, "forked client never established a ring"
+        assert data == b"forked-bytes" * 50
+        # the parent sees the child's bytes through its own transport
+        from tpu3fs.storage.types import ChunkId
+
+        sc, _m = _mk_client(ring_cluster, "parent")
+        got = sc.read_chunk(ring_cluster["chain_id"], ChunkId(9, 0))
+        assert bytes(got.data) == b"forked-bytes" * 50
+        sc.close()
+
+    def test_reaper_collects_crashed_client(self, ring_cluster):
+        import multiprocessing as mp
+        import os
+
+        from tpu3fs.usrbio.ring import SHM_DIR
+
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        p = ctx.Process(target=_fork_child_crash,
+                        args=(ring_cluster["mgmtd_addr"],
+                              ring_cluster["chain_id"], q))
+        p.start()
+        names = q.get(timeout=60)
+        p.join(30)
+        assert p.exitcode == 1
+        assert names, "child never established a ring"
+        leaked = [n for n in names
+                  if os.path.exists(os.path.join(SHM_DIR, n))]
+        assert leaked, "crash did not leak (atexit ran?) — test is moot"
+        for host in ring_cluster["hosts"]:
+            host.reap_pass(iov_max_age_s=3600.0)
+        for n in names:
+            assert not os.path.exists(os.path.join(SHM_DIR, n)), \
+                f"reaper left {n}"

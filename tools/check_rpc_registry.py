@@ -99,8 +99,11 @@ def _bind_all() -> List[_Registry]:
     stub = _Stub()
     out: List[_Registry] = []
 
+    from tpu3fs.usrbio.server import bind_usrbio_service
+
     storage = _Registry("storage_main")
     bind_storage_service(storage, stub)
+    bind_usrbio_service(storage, stub)
     bind_core_service(storage)
     out.append(storage)
 
@@ -350,6 +353,114 @@ def check_tenancy(registries: List[_Registry]) -> List[str]:
     return errors
 
 
+# -- usrbio ring path --------------------------------------------------------
+
+#: handler-ish attribute names that would constitute a dispatch bypass if
+#: the ring agent called them directly instead of going through
+#: dispatch_packet (the storage data plane + registry internals)
+_RING_BYPASS_CALLS = frozenset({
+    "read", "batch_read", "write", "batch_write", "write_shard",
+    "batch_write_shard", "batch_update", "update", "read_rebuild",
+    "batch_read_rebuild", "handler",
+})
+
+
+def check_usrbio_ring(registries: List[_Registry]) -> List[str]:
+    """Check 7 — the shm ring path can never grow an admission bypass:
+
+    a. every (service id, method id) in the ring allowlist
+       (``tpu3fs/usrbio/transport.py`` RING_METHODS) is bound by the
+       storage binary under exactly the advertised names, and carries the
+       full classification triple — QoS (default_class_for), idempotency
+       and tenant enforcement;
+    b. statically (AST), ``tpu3fs/usrbio/server.py`` dispatches through
+       ``tpu3fs.rpc.net.dispatch_packet`` and NEVER calls a service
+       handler or storage data-plane method directly, nor touches a
+       method table's ``.handler``/``.methods`` to get around it;
+    c. the socket transports route through the same entry, so "shared"
+       stays true from both sides: RpcServer._dispatch delegates to
+       dispatch_packet.
+    """
+    import ast
+    import inspect
+
+    from tpu3fs.rpc.idempotency import classify
+    from tpu3fs.tenant.enforcement import enforcement_of
+    from tpu3fs.usrbio.transport import RING_METHODS
+
+    errors: List[str] = []
+    storage = next((r for r in registries if r.name == "storage_main"),
+                   None)
+    if storage is None:
+        return ["check_usrbio_ring: no storage_main registry"]
+    for (sid, mid), (svc_name, m_name) in sorted(RING_METHODS.items()):
+        service = storage.services.get(sid)
+        if service is None:
+            errors.append(
+                f"RING_METHODS names service id {sid} which storage_main "
+                "does not bind")
+            continue
+        mdef = service.methods.get(mid)
+        if mdef is None or service.name != svc_name or mdef.name != m_name:
+            errors.append(
+                f"RING_METHODS ({sid},{mid}) -> {svc_name}.{m_name} does "
+                f"not match the bound table "
+                f"({service.name}.{mdef.name if mdef else '?'})")
+            continue
+        tclass = default_class_for(m_name)
+        if not isinstance(tclass, TrafficClass) or tclass not in CLASS_ATTRS:
+            errors.append(f"ring method {svc_name}.{m_name}: no QoS "
+                          "classification")
+        if classify(svc_name, m_name) is None:
+            errors.append(f"ring method {svc_name}.{m_name}: no "
+                          "idempotency classification")
+        if enforcement_of(svc_name, m_name) is None:
+            errors.append(f"ring method {svc_name}.{m_name}: no tenant "
+                          "enforcement classification")
+    # (b) static no-bypass guard over the agent module
+    import tpu3fs.usrbio.server as _usrbio_server
+
+    src = inspect.getsource(_usrbio_server)
+    tree = ast.parse(src)
+    dispatch_calls = 0
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Name):
+            name = fn.id
+        elif isinstance(fn, ast.Attribute):
+            name = fn.attr
+        if name == "dispatch_packet":
+            dispatch_calls += 1
+        elif name in _RING_BYPASS_CALLS:
+            errors.append(
+                f"usrbio/server.py calls {name}() directly at line "
+                f"{node.lineno} — the ring agent must dispatch ONLY "
+                "through rpc.net.dispatch_packet")
+    if dispatch_calls == 0:
+        errors.append("usrbio/server.py never calls dispatch_packet — "
+                      "the ring agent lost the shared admission entry")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in ("_dispatch",
+                                                             "methods"):
+            errors.append(
+                f"usrbio/server.py touches .{node.attr} at line "
+                f"{node.lineno} — method-table introspection can bypass "
+                "admission")
+    if "StorageService" in src:
+        errors.append("usrbio/server.py references StorageService — the "
+                      "agent must not know service internals")
+    # (c) the socket dispatch delegates to the same entry
+    from tpu3fs.rpc.net import RpcServer
+
+    if "dispatch_packet(" not in inspect.getsource(RpcServer._dispatch):
+        errors.append("RpcServer._dispatch no longer delegates to "
+                      "dispatch_packet — the shared entry forked")
+    return errors
+
+
 # -- driver ------------------------------------------------------------------
 
 def run_checks() -> Tuple[List[str], List[str]]:
@@ -363,6 +474,7 @@ def run_checks() -> Tuple[List[str], List[str]]:
         return errors + [str(e)], []
     errors.extend(check_idempotency(registries))
     errors.extend(check_tenancy(registries))
+    errors.extend(check_usrbio_ring(registries))
 
     # cross-binary id reuse (informational)
     by_id: Dict[int, set] = {}

@@ -69,6 +69,12 @@ class StorageAppConfig(Config):
     trace = TraceConfig
     collector = ConfigItem("", hot=True)          # host:port; "" = off
     monitor_push_period_s = ConfigItem(5.0, hot=True)
+    # USRBIO shared-memory data plane (tpu3fs/usrbio): co-located clients
+    # register shm rings through the Usrbio control service and the data
+    # path rides them instead of sockets. 0 disables hosting entirely.
+    usrbio = ConfigItem(1)
+    usrbio_reap_interval_s = ConfigItem(60.0, hot=True)
+    usrbio_iov_max_age_s = ConfigItem(3600.0, hot=True)
 
 
 class StorageApp(TwoPhaseApplication):
@@ -78,6 +84,7 @@ class StorageApp(TwoPhaseApplication):
         super().__init__(argv)
         self.service: Optional[StorageService] = None
         self._trace = None
+        self._usrbio_host = None
 
     def default_config(self) -> Config:
         return StorageAppConfig()
@@ -107,8 +114,21 @@ class StorageApp(TwoPhaseApplication):
             self._trace = StructuredTraceLog("storage-event", trace_dir)
             self.service.set_trace_log(self._trace)
         bind_storage_service(server, self.service)
+        # USRBIO shm data plane: co-located clients register rings via
+        # the control service; their RPCs then dispatch through the SAME
+        # admission entry as socket frames (tpu3fs/usrbio/server.py)
+        if self.config.get("usrbio"):
+            from tpu3fs.usrbio.server import (
+                UsrbioRpcHost,
+                bind_usrbio_service,
+            )
+
+            self._usrbio_host = UsrbioRpcHost(server)
+            bind_usrbio_service(server, self._usrbio_host)
 
     def after_stop(self) -> None:
+        if self._usrbio_host is not None:
+            self._usrbio_host.stop()
         if self._trace is not None:
             # the writer buffers flush_rows rows; a restart must not lose
             # the tail of the trace
@@ -175,6 +195,17 @@ class StorageApp(TwoPhaseApplication):
         self.spawn(self._punch_hole_loop, "punch-hole")
         # always spawned so dump_interval_s can be hot-enabled from 0
         self.spawn(self._dump_loop, "dump-chunkmeta")
+        if self._usrbio_host is not None:
+            self.spawn(self._usrbio_reap_loop, "usrbio-reap")
+
+    def _usrbio_reap_loop(self) -> None:
+        while not self._stop.wait(
+                self.config.get("usrbio_reap_interval_s")):
+            try:
+                self._usrbio_host.reap_pass(
+                    iov_max_age_s=self.config.get("usrbio_iov_max_age_s"))
+            except Exception:
+                pass
 
     def _target_scan_loop(self) -> None:
         while not self._stop.wait(self.config.get("target_scan_interval_s")):

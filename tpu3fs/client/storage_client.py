@@ -190,6 +190,16 @@ class StorageClient:
             fin.detach()
         if pool is not None:
             pool.shutdown(wait=False)
+        # USRBIO shm rings ride the messenger (rpc/services.py): an
+        # orderly client close deregisters them with the serving process
+        # and unlinks the client-owned segments now, not at interpreter
+        # exit (the atexit/reaper backstops cover unclean paths)
+        close_rings = getattr(self._messenger, "close_rings", None)
+        if close_rings is not None:
+            try:
+                close_rings()
+            except Exception:
+                pass
 
     # -- internals ----------------------------------------------------------
     def _fan_out(self, fn: Callable, items: List) -> None:
@@ -427,7 +437,13 @@ class StorageClient:
             if e.code in (Code.RPC_CONNECT_FAILED, Code.RPC_PEER_CLOSED,
                           Code.RPC_TIMEOUT, Code.PEER_UNHEALTHY):
                 self._health.observe(node_id, 0.0, ok=False)
-            return ReadReply(e.code)
+            # envelope-level sheds (native gates, dispatch admission)
+            # carry their retry-after only in the message: keep it in the
+            # typed field so ladders wait it out instead of hammering
+            from tpu3fs.qos.core import retry_after_ms_of
+
+            return ReadReply(e.code, retry_after_ms=retry_after_ms_of(
+                e.status.message))
         self._health.observe(node_id, time.monotonic() - t0, ok=True)
         return reply
 

@@ -90,6 +90,10 @@ CLASSIFICATION: Dict[Tuple[str, str], str] = {
     ("Mgmtd", "setConfig"): MUTATING,
     ("Mgmtd", "getConfig"): IDEMPOTENT,
     ("Mgmtd", "tick"): MUTATING,
+    # -- Usrbio (shm-ring control plane; the DATA rides StorageSerde) -----
+    ("Usrbio", "usrbioHandshake"): IDEMPOTENT,
+    ("Usrbio", "usrbioRegister"): MUTATING,    # spawns a ring worker
+    ("Usrbio", "usrbioDeregister"): MUTATING,
     # -- Core -------------------------------------------------------------
     ("Core", "echo"): IDEMPOTENT,
     ("Core", "renderConfig"): IDEMPOTENT,

@@ -177,6 +177,15 @@ def _load_lib():
             lib.tpu3fs_rpc_qos_clear.argtypes = [ctypes.c_void_p]
             lib.tpu3fs_rpc_qos_shed_count.restype = ctypes.c_uint64
             lib.tpu3fs_rpc_qos_shed_count.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "tpu3fs_rpc_tenant_set"):  # stale .so: no gate
+            lib.tpu3fs_rpc_tenant_set.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double,
+                ctypes.c_double, ctypes.c_double, ctypes.c_double]
+            lib.tpu3fs_rpc_tenant_clear.argtypes = [ctypes.c_void_p]
+            lib.tpu3fs_rpc_tenant_exempt_classes.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64]
+            lib.tpu3fs_rpc_tenant_shed_count.restype = ctypes.c_uint64
+            lib.tpu3fs_rpc_tenant_shed_count.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -268,6 +277,22 @@ class NativeRpcServer:
         if admission is not None:
             admission.add_reload_hook(lambda _adm: self._sync_native_qos())
         self._sync_native_qos()
+        # the per-TENANT fast-path gate mirrors the [tenants] quota table
+        # the same way (hot pushes re-sync via the registry's reload
+        # hook); weakref so the process-global registry never pins a
+        # stopped test server
+        import weakref
+
+        from tpu3fs.tenant.quota import registry as _treg
+
+        wself = weakref.ref(self)
+
+        def _tenant_hook(_reg):
+            s = wself()
+            if s is not None:
+                s._sync_native_tenants()
+
+        _treg().add_reload_hook(_tenant_hook)
 
     def _sync_native_qos(self) -> None:
         if (self._srv is None or self._admission is None
@@ -307,6 +332,45 @@ class NativeRpcServer:
                                             "tpu3fs_rpc_qos_shed_count"):
             return 0
         return int(self._lib.tpu3fs_rpc_qos_shed_count(self._srv))
+
+    def _sync_native_tenants(self) -> None:
+        """Install the [tenants] quota table into the C-side per-tenant
+        fast-path gate (native/rpc_net.cpp TenantGate): exact-name rows
+        only — unconfigured tenants pass free in C and are charged by
+        Python's lazily-minted default-quota buckets on the fallback
+        path. Background classes are exempt via a wire-code mask, and a
+        fast-path fallback refunds the C iops take (Python charges the
+        op again), so no op ever pays a tenant bucket twice."""
+        if (self._srv is None
+                or not hasattr(self._lib, "tpu3fs_rpc_tenant_set")):
+            return
+        from tpu3fs.rpc.services import STORAGE_SERVICE_ID
+
+        if STORAGE_SERVICE_ID not in self._services:
+            return  # only storage serves reads below Python
+        from tpu3fs.qos.core import BACKGROUND_CLASSES
+        from tpu3fs.tenant.quota import registry as _treg
+
+        reg = _treg()
+        mask = 0
+        for tc in BACKGROUND_CLASSES:
+            mask |= 1 << (int(tc) + 1)
+        self._lib.tpu3fs_rpc_tenant_exempt_classes(self._srv, mask)
+        self._lib.tpu3fs_rpc_tenant_clear(self._srv)
+        if not reg.enabled:
+            return
+        for name, q in reg.table_snapshot().items():
+            self._lib.tpu3fs_rpc_tenant_set(
+                self._srv, name.encode(),
+                float(q.iops), max(1.0, q.iops * q.burst_s),
+                float(q.bytes_per_s),
+                max(1.0, q.bytes_per_s * q.burst_s))
+
+    def tenant_shed_count(self) -> int:
+        if self._srv is None or not hasattr(
+                self._lib, "tpu3fs_rpc_tenant_shed_count"):
+            return 0
+        return int(self._lib.tpu3fs_rpc_tenant_shed_count(self._srv))
 
     def start(self) -> None:
         self._started = True

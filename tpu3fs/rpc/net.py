@@ -283,6 +283,211 @@ class ServiceDef:
             method_id, name, req_type, rsp_type, handler, bulk)
 
 
+def encode_envelope_message(rpc_ctx=None) -> str:
+    """Compose the request envelope's message field — trace context,
+    absolute deadline and tenant id as dot-separated version-tolerant
+    tokens (``t1.*``/``d1.*``/``u1.*``), all from the calling context.
+    ONE encoder for every client-side transport (socket start_call, the
+    USRBIO ring transport), so the wire form can never fork."""
+    return _tenant_id.append_wire(
+        _deadline.encode_envelope(
+            rpc_ctx.to_wire() if rpc_ctx is not None else "",
+            _deadline.current_deadline()),
+        _tenant_id.current_tenant())
+
+
+def _error_reply(pkt: MessagePacket, code: Code, msg: str) -> MessagePacket:
+    return MessagePacket(
+        uuid=pkt.uuid, service_id=pkt.service_id, method_id=pkt.method_id,
+        flags=0, status=int(code), payload=b"", message=msg,
+        timestamps=pkt.timestamps,
+    )
+
+
+def _trace_dispatch(sctx, service, mdef, ts: Timestamps, status: int,
+                    tclass, tenant: str = "") -> None:
+    """Emit the server-side spans of one dispatch: the admission-wait
+    stage (receive -> handler start: queueing + admission + request
+    decode) and the dispatch op span — tagged with the envelope's
+    tenant so trace-top can group by owner — then flush-or-drop
+    (slow-op capture applies even to unsampled traces)."""
+    dur = ts.server_run_end - ts.server_receive
+    wall_end = time.time()
+    _spans.add_span(
+        sctx, "rpc.server", "admission_wait",
+        wall_end - dur, ts.server_run_start - ts.server_receive)
+    _spans.tracer().finish_op(
+        sctx, f"rpc.{service.name}.{mdef.name}", wall_end - dur, dur,
+        code=status if status != int(Code.OK) else 0,
+        tclass=tclass.name.lower() if tclass is not None else "",
+        tenant=tenant)
+
+
+def dispatch_packet(server, pkt: MessagePacket, bulk=None):
+    """THE local dispatch + admission entry: fault plane, deadline shed,
+    tenant quota charge, QoS class admission, request decode, context
+    scoping (class/deadline/tenant/trace) around the handler, reply
+    build — for any transport that delivers MessagePackets into this
+    process. ``server`` is anything exposing ``_services``, ``_admission``
+    and ``_admission_exempt`` (RpcServer, NativeRpcServer, and the USRBIO
+    ring agent hand in the server they serve for).
+
+    -> (reply packet, reply bulk iovs | None)."""
+    ts = pkt.timestamps
+    ts.server_dequeue = time.monotonic()
+    service = server._services.get(pkt.service_id)
+    if service is None:
+        return _error_reply(pkt, Code.RPC_SERVICE_NOT_FOUND,
+                            str(pkt.service_id)), None
+    mdef = service.methods.get(pkt.method_id)
+    if mdef is None:
+        return _error_reply(pkt, Code.RPC_METHOD_NOT_FOUND,
+                            f"{service.name}.{pkt.method_id}"), None
+    if bulk is not None and not mdef.bulk:
+        return _error_reply(
+            pkt, Code.RPC_BAD_REQUEST,
+            f"{service.name}.{mdef.name} is not bulk-capable"), None
+    # cluster fault plane: the server-side dispatch boundary
+    # (utils/fault_injection.py). `drop` rules raise ConnectionError,
+    # which _serve_conn turns into a torn connection — the realistic
+    # shape of a half-dead peer.
+    from tpu3fs.utils.fault_injection import plane as _fault_plane
+
+    try:
+        _fault_plane().fire(
+            f"rpc.dispatch.{service.name}.{mdef.name}")
+    except FsError as e:
+        return _error_reply(pkt, e.code, e.status.message), None
+    # DEADLINE admission shed (before QoS and before request decode —
+    # expired work must never reach the engine stage, and shedding it
+    # must cost less than anything downstream): an envelope whose
+    # absolute deadline passed answers the retryable DEADLINE_EXCEEDED
+    dl = _deadline.decode_deadline(pkt.message) if pkt.message else None
+    if dl is not None and time.time() > dl:
+        _deadline.record_shed("admission")
+        return _error_reply(
+            pkt, Code.DEADLINE_EXCEEDED,
+            f"deadline passed {time.time() - dl:.3f}s before "
+            f"{service.name}.{mdef.name} admission"), None
+    # TENANT resolution + quota admission (tenant/quota.py): every
+    # envelope resolves an owner (explicit u1.* token or "default"),
+    # and methods the enforcement table classifies bytes/iops charge
+    # the owner's buckets HERE, before request decode — a tenant over
+    # its quota answers the retryable TENANT_THROTTLED with a
+    # retry-after hint, same shape as an OVERLOADED class shed.
+    # Services that run their own internal admission (storage) are
+    # exempt at this level exactly like class admission.
+    tenant = (_tenant_id.decode_tenant(pkt.message)
+              if pkt.message else None)
+    tname = tenant or _tenant_id.DEFAULT_TENANT
+    if pkt.service_id not in server._admission_exempt:
+        from tpu3fs.qos.core import format_retry_after
+        from tpu3fs.tenant import enforcement as _tenf
+        from tpu3fs.tenant.quota import registry as _treg
+
+        kind = _tenf.enforcement_of(service.name, mdef.name)
+        if kind in (_tenf.BYTES, _tenf.IOPS):
+            nbytes = 0
+            if kind == _tenf.BYTES:
+                nbytes = len(pkt.payload) + (
+                    sum(len(b) for b in bulk) if bulk else 0)
+            t_shed = _treg().try_admit(tname, nbytes=nbytes)
+            if t_shed is not None:
+                return _error_reply(
+                    pkt, Code.TENANT_THROTTLED,
+                    format_retry_after(
+                        t_shed, f"tenant {tname} over quota at "
+                                f"{service.name}.{mdef.name}")), None
+    # QoS admission BEFORE deserialization (shedding must stay cheap):
+    # token bucket + concurrency cap keyed (service, method, traffic
+    # class); sheds answer OVERLOADED with the retry-after hint in the
+    # envelope message (qos/core.py)
+    lease = None
+    tclass = None
+    if server._admission is not None \
+            and pkt.service_id not in server._admission_exempt:
+        from tpu3fs.qos.core import class_from_flags, format_retry_after
+
+        tclass = class_from_flags(pkt.flags)
+        lease, shed_ms = server._admission.try_admit(
+            service.name, mdef.name, tclass, tenant=tname)
+        if lease is None:
+            return _error_reply(
+                pkt, Code.OVERLOADED,
+                format_retry_after(shed_ms,
+                                   f"{service.name}.{mdef.name}")), None
+    try:
+        req = deserialize(pkt.payload, mdef.req_type)
+    except Exception as e:  # malformed payload
+        if lease is not None:
+            lease.release()
+        return _error_reply(pkt, Code.RPC_BAD_REQUEST, repr(e)), None
+    # distributed tracing: a traced peer stamps its context into the
+    # request envelope's message field (version-tolerant: untraced
+    # servers — and every pre-tracing decoder — parse and ignore it);
+    # with a tracer but no inbound context this server head-samples.
+    # Scoped via ContextVar so service internals (update workers,
+    # chain forwards, pool fan-outs) inherit and extend the trace.
+    sctx = None
+    if _spans.tracer().enabled:
+        in_ctx = _spans.decode_wire(pkt.message) if pkt.message else None
+        sctx = (in_ctx.child() if in_ctx is not None
+                else _spans.tracer().start_trace())
+    ts.server_run_start = time.monotonic()
+    reply_iovs = None
+    try:
+        # restore the client's traffic class around the handler so
+        # service internals (update-worker scheduling, read gates)
+        # see the tag the peer carried in the envelope
+        import contextlib
+
+        from tpu3fs.qos.core import class_from_flags, tagged
+
+        if tclass is None:
+            tclass = class_from_flags(pkt.flags)
+        ctx = (tagged(tclass) if tclass is not None
+               else contextlib.nullcontext())
+        # the peer's deadline scopes the handler: service internals
+        # (update-queue submit, nested RPCs) inherit and re-propagate
+        dctx = (_deadline.deadline_scope(dl) if dl is not None
+                else contextlib.nullcontext())
+        # the peer's TENANT scopes the handler the same way: storage
+        # internal admission, update-queue lanes and nested RPCs all
+        # see the owner the envelope carried (tenant/identity.py)
+        tctx = (_tenant_id.tenant_scope(tenant) if tenant is not None
+                else contextlib.nullcontext())
+        with ctx, dctx, tctx, _spans.trace_scope(sctx) \
+                if sctx is not None else contextlib.nullcontext():
+            if mdef.bulk:
+                rsp, reply_iovs = mdef.handler(req, bulk)
+            else:
+                rsp = mdef.handler(req)
+        payload = serialize(rsp, mdef.rsp_type)
+        status, message = int(Code.OK), ""
+    except FsError as e:
+        payload, status, message = b"", int(e.code), e.status.message
+        reply_iovs = None
+    except Exception as e:  # handler bug: surface as INTERNAL
+        payload, status, message = b"", int(Code.INTERNAL), repr(e)
+        reply_iovs = None
+    finally:
+        if lease is not None:
+            lease.release()
+    ts.server_run_end = time.monotonic()
+    if sctx is not None:
+        _trace_dispatch(sctx, service, mdef, ts, status, tclass, tname)
+    return MessagePacket(
+        uuid=pkt.uuid,
+        service_id=pkt.service_id,
+        method_id=pkt.method_id,
+        flags=0,
+        status=status,
+        payload=payload,
+        message=message,
+        timestamps=ts,
+    ), reply_iovs
+
+
 class RpcServer:
     """Threaded TCP server dispatching packets to registered services
     (ref net::Server + ServiceGroup)."""
@@ -365,188 +570,17 @@ class RpcServer:
                 pass
 
     def _dispatch(self, pkt: MessagePacket, bulk=None):
-        """-> (reply packet, reply bulk iovs | None)."""
-        ts = pkt.timestamps
-        ts.server_dequeue = time.monotonic()
-        service = self._services.get(pkt.service_id)
-        if service is None:
-            return self._error_reply(pkt, Code.RPC_SERVICE_NOT_FOUND,
-                                     str(pkt.service_id)), None
-        mdef = service.methods.get(pkt.method_id)
-        if mdef is None:
-            return self._error_reply(pkt, Code.RPC_METHOD_NOT_FOUND,
-                                     f"{service.name}.{pkt.method_id}"), None
-        if bulk is not None and not mdef.bulk:
-            return self._error_reply(
-                pkt, Code.RPC_BAD_REQUEST,
-                f"{service.name}.{mdef.name} is not bulk-capable"), None
-        # cluster fault plane: the server-side dispatch boundary
-        # (utils/fault_injection.py). `drop` rules raise ConnectionError,
-        # which _serve_conn turns into a torn connection — the realistic
-        # shape of a half-dead peer.
-        from tpu3fs.utils.fault_injection import plane as _fault_plane
-
-        try:
-            _fault_plane().fire(
-                f"rpc.dispatch.{service.name}.{mdef.name}")
-        except FsError as e:
-            return self._error_reply(pkt, e.code, e.status.message), None
-        # DEADLINE admission shed (before QoS and before request decode —
-        # expired work must never reach the engine stage, and shedding it
-        # must cost less than anything downstream): an envelope whose
-        # absolute deadline passed answers the retryable DEADLINE_EXCEEDED
-        dl = _deadline.decode_deadline(pkt.message) if pkt.message else None
-        if dl is not None and time.time() > dl:
-            _deadline.record_shed("admission")
-            return self._error_reply(
-                pkt, Code.DEADLINE_EXCEEDED,
-                f"deadline passed {time.time() - dl:.3f}s before "
-                f"{service.name}.{mdef.name} admission"), None
-        # TENANT resolution + quota admission (tenant/quota.py): every
-        # envelope resolves an owner (explicit u1.* token or "default"),
-        # and methods the enforcement table classifies bytes/iops charge
-        # the owner's buckets HERE, before request decode — a tenant over
-        # its quota answers the retryable TENANT_THROTTLED with a
-        # retry-after hint, same shape as an OVERLOADED class shed.
-        # Services that run their own internal admission (storage) are
-        # exempt at this level exactly like class admission.
-        tenant = (_tenant_id.decode_tenant(pkt.message)
-                  if pkt.message else None)
-        tname = tenant or _tenant_id.DEFAULT_TENANT
-        if pkt.service_id not in self._admission_exempt:
-            from tpu3fs.qos.core import format_retry_after
-            from tpu3fs.tenant import enforcement as _tenf
-            from tpu3fs.tenant.quota import registry as _treg
-
-            kind = _tenf.enforcement_of(service.name, mdef.name)
-            if kind in (_tenf.BYTES, _tenf.IOPS):
-                nbytes = 0
-                if kind == _tenf.BYTES:
-                    nbytes = len(pkt.payload) + (
-                        sum(len(b) for b in bulk) if bulk else 0)
-                t_shed = _treg().try_admit(tname, nbytes=nbytes)
-                if t_shed is not None:
-                    return self._error_reply(
-                        pkt, Code.TENANT_THROTTLED,
-                        format_retry_after(
-                            t_shed, f"tenant {tname} over quota at "
-                                    f"{service.name}.{mdef.name}")), None
-        # QoS admission BEFORE deserialization (shedding must stay cheap):
-        # token bucket + concurrency cap keyed (service, method, traffic
-        # class); sheds answer OVERLOADED with the retry-after hint in the
-        # envelope message (qos/core.py)
-        lease = None
-        tclass = None
-        if self._admission is not None \
-                and pkt.service_id not in self._admission_exempt:
-            from tpu3fs.qos.core import class_from_flags, format_retry_after
-
-            tclass = class_from_flags(pkt.flags)
-            lease, shed_ms = self._admission.try_admit(
-                service.name, mdef.name, tclass, tenant=tname)
-            if lease is None:
-                return self._error_reply(
-                    pkt, Code.OVERLOADED,
-                    format_retry_after(shed_ms,
-                                       f"{service.name}.{mdef.name}")), None
-        try:
-            req = deserialize(pkt.payload, mdef.req_type)
-        except Exception as e:  # malformed payload
-            if lease is not None:
-                lease.release()
-            return self._error_reply(pkt, Code.RPC_BAD_REQUEST, repr(e)), None
-        # distributed tracing: a traced peer stamps its context into the
-        # request envelope's message field (version-tolerant: untraced
-        # servers — and every pre-tracing decoder — parse and ignore it);
-        # with a tracer but no inbound context this server head-samples.
-        # Scoped via ContextVar so service internals (update workers,
-        # chain forwards, pool fan-outs) inherit and extend the trace.
-        sctx = None
-        if _spans.tracer().enabled:
-            in_ctx = _spans.decode_wire(pkt.message) if pkt.message else None
-            sctx = (in_ctx.child() if in_ctx is not None
-                    else _spans.tracer().start_trace())
-        ts.server_run_start = time.monotonic()
-        reply_iovs = None
-        try:
-            # restore the client's traffic class around the handler so
-            # service internals (update-worker scheduling, read gates)
-            # see the tag the peer carried in the envelope
-            import contextlib
-
-            from tpu3fs.qos.core import class_from_flags, tagged
-
-            if tclass is None:
-                tclass = class_from_flags(pkt.flags)
-            ctx = (tagged(tclass) if tclass is not None
-                   else contextlib.nullcontext())
-            # the peer's deadline scopes the handler: service internals
-            # (update-queue submit, nested RPCs) inherit and re-propagate
-            dctx = (_deadline.deadline_scope(dl) if dl is not None
-                    else contextlib.nullcontext())
-            # the peer's TENANT scopes the handler the same way: storage
-            # internal admission, update-queue lanes and nested RPCs all
-            # see the owner the envelope carried (tenant/identity.py)
-            tctx = (_tenant_id.tenant_scope(tenant) if tenant is not None
-                    else contextlib.nullcontext())
-            with ctx, dctx, tctx, _spans.trace_scope(sctx) \
-                    if sctx is not None else contextlib.nullcontext():
-                if mdef.bulk:
-                    rsp, reply_iovs = mdef.handler(req, bulk)
-                else:
-                    rsp = mdef.handler(req)
-            payload = serialize(rsp, mdef.rsp_type)
-            status, message = int(Code.OK), ""
-        except FsError as e:
-            payload, status, message = b"", int(e.code), e.status.message
-            reply_iovs = None
-        except Exception as e:  # handler bug: surface as INTERNAL
-            payload, status, message = b"", int(Code.INTERNAL), repr(e)
-            reply_iovs = None
-        finally:
-            if lease is not None:
-                lease.release()
-        ts.server_run_end = time.monotonic()
-        if sctx is not None:
-            self._trace_dispatch(sctx, service, mdef, ts, status,
-                                 tclass, tname)
-        return MessagePacket(
-            uuid=pkt.uuid,
-            service_id=pkt.service_id,
-            method_id=pkt.method_id,
-            flags=0,
-            status=status,
-            payload=payload,
-            message=message,
-            timestamps=ts,
-        ), reply_iovs
-
-    @staticmethod
-    def _trace_dispatch(sctx, service, mdef, ts: Timestamps, status: int,
-                        tclass, tenant: str = "") -> None:
-        """Emit the server-side spans of one dispatch: the admission-wait
-        stage (receive -> handler start: queueing + admission + request
-        decode) and the dispatch op span — tagged with the envelope's
-        tenant so trace-top can group by owner — then flush-or-drop
-        (slow-op capture applies even to unsampled traces)."""
-        dur = ts.server_run_end - ts.server_receive
-        wall_end = time.time()
-        _spans.add_span(
-            sctx, "rpc.server", "admission_wait",
-            wall_end - dur, ts.server_run_start - ts.server_receive)
-        _spans.tracer().finish_op(
-            sctx, f"rpc.{service.name}.{mdef.name}", wall_end - dur, dur,
-            code=status if status != int(Code.OK) else 0,
-            tclass=tclass.name.lower() if tclass is not None else "",
-            tenant=tenant)
+        """-> (reply packet, reply bulk iovs | None). Thin wrapper over the
+        transport-agnostic ``dispatch_packet`` — the SHARED admission entry
+        every local transport (socket threads here, the USRBIO shm ring
+        agent in tpu3fs/usrbio/server.py) must route through, so no
+        transport can grow a path around deadline/tenant/QoS enforcement
+        (tools/check_rpc_registry.py check 7 pins this statically)."""
+        return dispatch_packet(self, pkt, bulk)
 
     @staticmethod
     def _error_reply(pkt: MessagePacket, code: Code, msg: str) -> MessagePacket:
-        return MessagePacket(
-            uuid=pkt.uuid, service_id=pkt.service_id, method_id=pkt.method_id,
-            flags=0, status=int(code), payload=b"", message=msg,
-            timestamps=pkt.timestamps,
-        )
+        return _error_reply(pkt, code, msg)
 
     def stop(self) -> None:
         self._running = False
@@ -693,11 +727,7 @@ class RpcClient:
             # trace context + absolute deadline + tenant id compose in
             # the message field (version-tolerant all three ways;
             # rpc/deadline.py, tenant/identity.py)
-            message=_tenant_id.append_wire(
-                _deadline.encode_envelope(
-                    rpc_ctx.to_wire() if rpc_ctx is not None else "",
-                    _deadline.current_deadline()),
-                _tenant_id.current_tenant()),
+            message=encode_envelope_message(rpc_ctx),
         )
         # client-side fault plane hook: the send boundary (drop rules
         # surface as the peer-closed transport error retry ladders know)

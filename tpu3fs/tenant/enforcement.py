@@ -87,6 +87,12 @@ ENFORCEMENT: Dict[Tuple[str, str], str] = {
     ("MetaSerde", "batchClose"): IOPS,
     ("MetaSerde", "batchSetAttr"): IOPS,
     ("MetaSerde", "batchCreate"): IOPS,
+    # -- Usrbio ring registration: control plane (the data plane rides
+    #    StorageSerde methods, which keep their bytes/iops classification
+    #    and are charged at ring dequeue through dispatch_packet) --------
+    ("Usrbio", "usrbioHandshake"): EXEMPT,
+    ("Usrbio", "usrbioRegister"): EXEMPT,
+    ("Usrbio", "usrbioDeregister"): EXEMPT,
     # -- Mgmtd / Core / Kv / internals: control plane ---------------------
     ("Mgmtd", "heartbeat"): EXEMPT,
     ("Mgmtd", "getRoutingInfo"): EXEMPT,

@@ -199,6 +199,24 @@ class TenantRegistry:
         # process-lifetime totals (tests/drives; monitor counters reset
         # every collection window, these never do)
         self._totals: Dict[str, Dict[str, float]] = {}
+        # reload hooks: fired after every configure() so mirrors of the
+        # quota table (the native transport's C-side tenant gate,
+        # rpc/native_net.py) re-sync on hot pushes — the same discipline
+        # as AdmissionController.add_reload_hook
+        self._reload_hooks: list = []
+
+    def add_reload_hook(self, fn) -> None:
+        self._reload_hooks.append(fn)
+        try:
+            fn(self)
+        except Exception:
+            pass
+
+    def table_snapshot(self) -> Dict[str, TenantQuota]:
+        """The configured rows (copy) — mirrors push exactly what an
+        operator configured, never the lazily-minted per-tenant state."""
+        with self._lock:
+            return dict(self._table)
 
     # -- configuration ----------------------------------------------------
     def configure(self, spec: str, *, enabled: bool = True,
@@ -216,6 +234,11 @@ class TenantRegistry:
                 q = table.get(tenant, self._default)
                 rate = q.bytes_per_s if axis == "bytes" else q.iops
                 b.configure(rate, max(1.0, rate * q.burst_s))
+        for fn in list(self._reload_hooks):
+            try:
+                fn(self)
+            except Exception:
+                pass
 
     def clear(self) -> None:
         """Tests/drives: back to the permissive boot state."""

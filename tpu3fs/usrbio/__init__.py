@@ -1,3 +1,18 @@
-from tpu3fs.usrbio.ring import Iov, IoRing, Sqe, Cqe  # noqa: F401
+from tpu3fs.usrbio.ring import (  # noqa: F401
+    Cqe,
+    Iov,
+    IoRing,
+    Sqe,
+    reap_stale_shm,
+)
 from tpu3fs.usrbio.api import UsrbioClient  # noqa: F401
 from tpu3fs.usrbio.agent import UsrbioAgent  # noqa: F401
+from tpu3fs.usrbio.transport import (  # noqa: F401
+    RING_METHODS,
+    USRBIO_SERVICE_ID,
+    RingClient,
+)
+from tpu3fs.usrbio.server import (  # noqa: F401
+    UsrbioRpcHost,
+    bind_usrbio_service,
+)

@@ -20,8 +20,40 @@ from typing import Dict, List, Optional
 from tpu3fs.client.file_io import FileIoClient
 from tpu3fs.meta.store import MetaStore, OpenFlags
 from tpu3fs.meta.types import Inode
-from tpu3fs.usrbio.ring import Iov, IoRing
+from tpu3fs.usrbio.ring import Iov, IoRing, reap_stale_shm
 from tpu3fs.utils.result import Code, FsError, Status
+
+
+def _sqe_scopes(sqe):
+    """The SQE-borne request context, scoped like an inbound RPC envelope:
+    QoS class from the flag bits (same positions as the wire envelope),
+    trace/deadline/tenant from the token field's ``t1.*``/``d1.*``/``u1.*``
+    string — so IO the agent issues on a client's behalf is admitted,
+    attributed and shed exactly as if the client had spoken sockets."""
+    import contextlib
+
+    from tpu3fs.analytics import spans as _spans
+    from tpu3fs.qos.core import class_from_flags, tagged
+    from tpu3fs.rpc import deadline as _deadline
+    from tpu3fs.tenant import identity as _tenant_id
+
+    stack = contextlib.ExitStack()
+    tclass = class_from_flags(sqe.flags)
+    if tclass is not None:
+        stack.enter_context(tagged(tclass))
+    tok = sqe.token
+    if tok:
+        dl = _deadline.decode_deadline(tok)
+        if dl is not None:
+            stack.enter_context(_deadline.deadline_scope(dl))
+        tenant = _tenant_id.decode_tenant(tok)
+        if tenant is not None:
+            stack.enter_context(_tenant_id.tenant_scope(tenant))
+        if _spans.tracer().enabled:
+            in_ctx = _spans.decode_wire(tok)
+            if in_ctx is not None:
+                stack.enter_context(_spans.trace_scope(in_ctx.child()))
+    return stack
 
 
 class _RingState:
@@ -132,11 +164,13 @@ class UsrbioAgent:
                 if not state.running:
                     return
                 for sqe in ring.drain_sqes():
-                    with self._io_limiter:
+                    with self._io_limiter, _sqe_scopes(sqe):
                         result = self._process_sqe(state, sqe)
                     ring.push_cqe(result, sqe.userdata)
-        except ValueError:
-            # ring mmap closed under us during deregistration: exit quietly
+        except (ValueError, FsError):
+            # ring mmap closed under us during deregistration (ValueError)
+            # or the header tore (USRBIO_TORN_RING): exit quietly — the
+            # reaper owns cleanup of torn/abandoned segments
             return
         finally:
             if state.close_on_exit:
@@ -177,6 +211,17 @@ class UsrbioAgent:
             # transport/storage faults must surface as a CQE error, never
             # kill the ring worker (clients would block forever)
             return -int(Code.INTERNAL)
+
+    def reap_stale(self, *, iov_max_age_s: float = 3600.0) -> list:
+        """Reaper pass over /dev/shm: unlink rings whose stamped owner pid
+        is dead and orphan iov buffers nothing live references — the crash
+        half of the shm lifecycle (the creating side unlinks on orderly
+        close). Live registrations served by this agent are protected."""
+        with self._lock:
+            keep = set(self._rings)
+            for state in self._rings.values():
+                keep.update(v.name for v in state.iovs)
+        return reap_stale_shm(keep=keep, iov_max_age_s=iov_max_age_s)
 
     def stop(self) -> None:
         for name in list(self._rings):

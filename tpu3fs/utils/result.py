@@ -135,6 +135,24 @@ class Code(enum.IntEnum):
     #                          but it names WHO was over, not that the
     #                          server was full (docs/tenancy.md)
 
+    # usrbio shared-memory data plane 12xx (tpu3fs/usrbio)
+    USRBIO_RING_FULL = 1200       # SQ has `entries` unreaped ops in flight;
+    #                               the client waits or falls back to sockets
+    USRBIO_BAD_IOV = 1201         # SQE region escapes the registered iov /
+    #                               token field overflow / unregistered iov id
+    USRBIO_AGENT_GONE = 1202      # no completion within the ring deadline or
+    #                               registration dropped: the serving process
+    #                               is gone — re-handshake or use sockets
+    USRBIO_TORN_RING = 1203       # ring header failed magic/version check:
+    #                               the segment is torn or foreign — neither
+    #                               side may trust its counters
+    USRBIO_REPLY_OVERFLOW = 1204  # the reply did not fit the SQE's reply
+    #                               region; retry with a larger region or
+    #                               fall back to sockets
+    USRBIO_UNSUPPORTED = 1205     # SQE names a (service, method) outside the
+    #                               ring allowlist (usrbio/transport.py
+    #                               RING_METHODS) — never dispatched
+
 
 #: Codes on which a client-side retry ladder may re-issue the request.
 RETRYABLE_CODES = frozenset(
