@@ -399,6 +399,89 @@ class TestRingAbiV2:
         assert not os.path.exists(rpath)
 
 
+class TestShmHardening:
+    """The register() path maps client-named segments inside the storage
+    process: names must stay path components, symlinks must not be
+    followed, and claimed sizes must match the file on disk."""
+
+    def test_traversal_and_bad_prefix_names_rejected(self):
+        for bad in ("../../../etc/passwd", "tpu3fs-iov-../x",
+                    "tpu3fs-iov-a/b", "not-ours-abc"):
+            with pytest.raises(FsError) as ei:
+                Iov(4096, name=bad, create=False)
+            assert ei.value.code == Code.USRBIO_BAD_IOV
+        with pytest.raises(FsError):
+            IoRing(8, name="tpu3fs-ior-..", create=False)
+
+    def test_register_rejects_traversal_names(self):
+        from tpu3fs.usrbio.server import UsrbioRpcHost
+        from tpu3fs.usrbio.transport import UsrbioRegisterReq
+
+        host = UsrbioRpcHost(server=None)
+        try:
+            nonce = host._nonce
+            rsp = host.register(UsrbioRegisterReq(
+                ring_name="tpu3fs-ior-../../etc/cron.d/x",
+                iov_name="tpu3fs-iov-ok1", entries=8, iov_size=4096,
+                nonce=nonce))
+            assert not rsp.ok and "bad shm segment name" in rsp.message
+            rsp = host.register(UsrbioRegisterReq(
+                ring_name="tpu3fs-ior-ok1",
+                iov_name="../../etc/shadow", entries=8, iov_size=4096,
+                nonce=nonce))
+            assert not rsp.ok and "bad shm segment name" in rsp.message
+        finally:
+            host.stop()
+
+    def test_symlinked_segment_refused(self, tmp_path):
+        import os
+        import uuid as _uuid
+
+        from tpu3fs.usrbio.ring import SHM_DIR
+
+        target = tmp_path / "victim"
+        target.write_bytes(b"\0" * 8192)
+        name = f"tpu3fs-iov-{_uuid.uuid4().hex[:12]}"
+        link = os.path.join(SHM_DIR, name)
+        os.symlink(target, link)
+        try:
+            with pytest.raises(OSError):
+                Iov(4096, name=name, create=False)
+        finally:
+            os.unlink(link)
+
+    def test_undersized_segment_refused(self):
+        iov = Iov(4096, create=True)
+        try:
+            # claiming more than the file holds must fail up front, not
+            # SIGBUS the mapping process on first touch past EOF
+            with pytest.raises(FsError) as ei:
+                Iov(1 << 20, name=iov.name, create=False)
+            assert ei.value.code == Code.USRBIO_BAD_IOV
+            with pytest.raises(FsError):
+                IoRing(8, name=iov.name, create=False)  # way undersized
+        finally:
+            iov.close(unlink=True)
+
+    def test_live_v2_ring_never_age_reaped(self):
+        import os
+        import time as _time
+
+        from tpu3fs.usrbio.ring import reap_stale_shm
+
+        ring = IoRing(8, create=True)
+        try:
+            old = _time.time() - 7200
+            os.utime(ring.path, (old, old))
+            # owner (this process) is alive: age alone must not reap a
+            # v2 ring — mmap writes never update tmpfs mtime, so a busy
+            # ring can look arbitrarily old
+            assert ring.name not in reap_stale_shm(iov_max_age_s=3600)
+            assert os.path.exists(ring.path)
+        finally:
+            ring.close(unlink=True)
+
+
 # -- the RPC ring transport against a live socket cluster ---------------------
 
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import mmap
+import re
 import stat
 import struct
 import time
@@ -81,22 +82,60 @@ def _shm_name_prefixes() -> Tuple[str, str]:
     return "tpu3fs-iov-", "tpu3fs-ior-"
 
 
+_NAME_RE = re.compile(r"^[A-Za-z0-9_-]+$")
+
+
+def validate_shm_name(name: str, prefix: str) -> None:
+    """Segment names are path COMPONENTS, never paths. Client-supplied
+    names reach ``os.path.join(SHM_DIR, name)`` in the mapping process
+    (the storage agent), so a '/' — let alone '../' — would let a client
+    steer the agent into opening an arbitrary path O_RDWR."""
+    if not name.startswith(prefix) or not _NAME_RE.match(name):
+        raise FsError(Status(
+            Code.USRBIO_BAD_IOV,
+            f"bad shm segment name {name!r} "
+            f"(want {prefix}[A-Za-z0-9_-]+)"))
+
+
+def _map_shm(path: str, size: int, *, create: bool) -> mmap.mmap:
+    """Open + mmap a /dev/shm segment. O_NOFOLLOW refuses a symlink
+    planted under the expected name; on map (create=False) the fd is
+    fstat'd so a non-regular file or a segment smaller than the claimed
+    size is rejected up front — mmap past EOF succeeds on Linux and then
+    SIGBUSes the mapping process on first touch, a one-request kill of
+    whoever trusted the claimed size."""
+    flags = os.O_RDWR | getattr(os, "O_NOFOLLOW", 0) \
+        | (os.O_CREAT if create else 0)
+    fd = os.open(path, flags, 0o600)
+    try:
+        if create:
+            os.ftruncate(fd, size)
+        else:
+            st = os.fstat(fd)
+            if not stat.S_ISREG(st.st_mode):
+                raise FsError(Status(
+                    Code.USRBIO_BAD_IOV,
+                    f"shm segment {path}: not a regular file"))
+            if st.st_size < size:
+                raise FsError(Status(
+                    Code.USRBIO_BAD_IOV,
+                    f"shm segment {path}: {st.st_size}B on disk "
+                    f"< claimed {size}B"))
+        return mmap.mmap(fd, size)
+    finally:
+        os.close(fd)
+
+
 class Iov:
     """A registered shared-memory buffer (ref hf3fs_iov)."""
 
     def __init__(self, size: int, name: Optional[str] = None, create: bool = True):
         self.name = name or f"tpu3fs-iov-{uuid.uuid4().hex[:12]}"
+        validate_shm_name(self.name, "tpu3fs-iov-")
         self.size = size
         self.path = os.path.join(SHM_DIR, self.name)
         self._created = bool(create)
-        flags = os.O_RDWR | (os.O_CREAT if create else 0)
-        fd = os.open(self.path, flags, 0o600)
-        try:
-            if create:
-                os.ftruncate(fd, size)
-            self.buf = mmap.mmap(fd, size)
-        finally:
-            os.close(fd)
+        self.buf = _map_shm(self.path, size, create=create)
 
     def write(self, offset: int, data: bytes) -> None:
         self.buf[offset : offset + len(data)] = data
@@ -151,6 +190,7 @@ class IoRing:
     ):
         assert entries > 0 and (entries & (entries - 1)) == 0, "entries: power of 2"
         self.name = name or f"tpu3fs-ior-{uuid.uuid4().hex[:12]}"
+        validate_shm_name(self.name, "tpu3fs-ior-")
         self.entries = entries
         self.for_read = for_read
         self.io_depth = io_depth
@@ -158,14 +198,7 @@ class IoRing:
         self.path = os.path.join(SHM_DIR, self.name)
         self._created = bool(create)
         size = HDR_SIZE + entries * (SQE_SIZE + CQE_SIZE)
-        flags = os.O_RDWR | (os.O_CREAT if create else 0)
-        fd = os.open(self.path, flags, 0o600)
-        try:
-            if create:
-                os.ftruncate(fd, size)
-            self.buf = mmap.mmap(fd, size)
-        finally:
-            os.close(fd)
+        self.buf = _map_shm(self.path, size, create=create)
         self._sq_base = HDR_SIZE
         self._cq_base = HDR_SIZE + entries * SQE_SIZE
         if create:
@@ -439,9 +472,16 @@ def reap_stale_shm(*, keep: Optional[set] = None,
                 continue
             if magic != MAGIC:
                 continue  # not ours despite the name
-            # v1 rings carry no pid: only age can reap them
-            dead = (version >= VERSION and not _pid_alive(owner))
-            if not dead:
+            if version >= VERSION:
+                # v2+ rings stamp their owner pid: liveness is the ONLY
+                # reap signal. No age fallback — mmap writes never touch
+                # tmpfs mtime, so a busy ring looks "old" forever, and
+                # with several storage processes per host one node's
+                # reaper must not unlink another node's live clients.
+                if _pid_alive(owner):
+                    continue
+            else:
+                # v1 rings carry no pid: only age can reap them
                 try:
                     if now - os.stat(path).st_mtime <= iov_max_age_s:
                         continue

@@ -34,7 +34,13 @@ from tpu3fs.rpc.net import (
     ServiceDef,
     dispatch_packet,
 )
-from tpu3fs.usrbio.ring import SHM_DIR, Iov, IoRing, reap_stale_shm
+from tpu3fs.usrbio.ring import (
+    SHM_DIR,
+    Iov,
+    IoRing,
+    reap_stale_shm,
+    validate_shm_name,
+)
 from tpu3fs.usrbio.transport import (
     HANDSHAKE_PREFIX,
     RING_METHODS,
@@ -103,6 +109,16 @@ class UsrbioRpcHost:
             # the client could not read our /dev/shm: different host (or
             # a stale nonce from before a restart) — sockets it is
             return UsrbioRegisterRsp(False, "nonce mismatch: not same-host")
+        try:
+            # names come from the client and are joined under /dev/shm in
+            # THIS process: prefix + charset gating here (and O_NOFOLLOW +
+            # fstat inside Iov/IoRing) is what keeps a hostile co-located
+            # client from steering the storage process into mapping an
+            # arbitrary file read-write
+            validate_shm_name(req.iov_name, "tpu3fs-iov-")
+            validate_shm_name(req.ring_name, "tpu3fs-ior-")
+        except FsError as e:
+            return UsrbioRegisterRsp(False, str(e))
         try:
             iov = Iov(req.iov_size, name=req.iov_name, create=False)
         except (OSError, FsError) as e:

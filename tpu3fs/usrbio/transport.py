@@ -470,7 +470,14 @@ class RingClient:
                 rpc_ctx, "rpc.client.ring", time.time() - total, total,
                 code=status if status != int(Code.OK) else 0)
         if status != int(Code.OK):
-            raise FsError(Status(Code(status), message))
+            try:
+                code = Code(status)
+            except ValueError:
+                # version skew: a newer server's code outside our enum
+                # must still surface as an FsError, not a ValueError that
+                # escapes the messenger's error handling
+                code = Code.INTERNAL
+            raise FsError(Status(code, message))
         rsp = deserialize(payload, pending.rsp_type)
         return rsp, bulk
 
@@ -509,11 +516,19 @@ class RingClient:
                         f"no completion in {self._call_timeout}s"))
                 self.ring.complete_sem.wait(timeout=min(0.2, remaining))
                 cqes = self.ring.reap()
-            except FsError:
+            except (FsError, ValueError, OSError) as e:
+                # _reaping MUST clear on ANY reaper failure — a ValueError
+                # from the mmap closing under us (close() racing in-flight
+                # calls) would otherwise leave every other waiter spinning
+                # to its full call timeout with nobody reaping
                 with self._cv:
                     self._reaping = False
                     self._cv.notify_all()
-                raise
+                if isinstance(e, FsError):
+                    raise
+                raise FsError(Status(
+                    Code.USRBIO_AGENT_GONE,
+                    f"ring torn down while waiting: {e}"))
             with self._cv:
                 self._reaping = False
                 if cqes:
