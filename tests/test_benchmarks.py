@@ -179,6 +179,23 @@ class TestTraceBench:
         assert by["trace_span_files"]["value"] >= 1
 
 
+class TestSloBench:
+    """benchmarks/slo_bench fast-mode smoke: both collector modes run
+    over real sockets, samples actually reach the aggregator, and the
+    detection-latency phase fires."""
+
+    def test_small_run(self, tmp_path):
+        from benchmarks.slo_bench import run as slo_bench
+
+        res = slo_bench(chunks=8, size=32 << 10, batch=4, rounds=1,
+                        out=str(tmp_path / "bs.json"))
+        by = {r["metric"]: r for r in res["rows"]}
+        assert by["slo_write_agg_off"]["value"] > 0
+        assert by["slo_write_agg_slo_on"]["value"] > 0
+        assert by["slo_agg_ingested"]["value"] > 0
+        assert 0 < by["slo_detect_latency_ms"]["value"] < 5000
+
+
 class TestNorthstarBench:
     """BASELINE.md headline workloads at test sizes: each phase must
     produce its e2e_* field and verify its own data integrity."""

@@ -1,0 +1,750 @@
+"""SLO engine + windowed aggregation + flight recorder tests
+(tpu3fs/monitor/{agg,slo,flight}.py; docs/slo.md)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpu3fs.monitor.agg import FixedDigest, WindowedAggregator
+from tpu3fs.monitor.collector import (
+    Ack,
+    AggQueryReq,
+    AggQueryRsp,
+    BufferedCollectorSink,
+    CollectorService,
+    SampleBatch,
+    bind_collector_service,
+)
+from tpu3fs.monitor.flight import FlightRecorder
+from tpu3fs.monitor.recorder import MemorySink, Sample, SqliteSink
+from tpu3fs.monitor.slo import (
+    SloEngine,
+    SloGate,
+    SloGateError,
+    parse_slo_spec,
+)
+from tpu3fs.rpc.net import RpcClient, RpcServer
+
+
+def dist_sample(name, ts, value, tags=None):
+    """A single-value distribution summary (what a reservoir recorder
+    ships for one observation)."""
+    return Sample(name, ts, tags or {}, value=value, count=1, min=value,
+                  max=value, mean=value, p50=value, p90=value, p99=value)
+
+
+class TestFixedDigest:
+    def test_quantiles_track_numpy(self):
+        rng = np.random.default_rng(7)
+        vals = rng.lognormal(mean=8.0, sigma=1.5, size=4000)
+        d = FixedDigest()
+        for v in vals:
+            d.add(float(v))
+        for q in (0.5, 0.9, 0.99):
+            want = float(np.percentile(vals, q * 100))
+            got = d.quantile(q)
+            # log-bucket growth 1.18 bounds relative error ~±9% + rank
+            # error at the tail
+            assert abs(got - want) / want < 0.2, (q, got, want)
+
+    def test_merge_equals_combined(self):
+        a, b, both = FixedDigest(), FixedDigest(), FixedDigest()
+        for i, v in enumerate(range(1, 2001)):
+            (a if i % 2 else b).add(float(v))
+            both.add(float(v))
+        a.merge(b)
+        for q in (0.5, 0.9, 0.99):
+            assert a.quantile(q) == both.quantile(q)
+
+    def test_summary_spread_keeps_mass(self):
+        d = FixedDigest()
+        d.add_summary(100, 10.0, 50.0, 90.0, 99.0, 200.0)
+        assert d.total == pytest.approx(100.0)
+        assert 20.0 < d.quantile(0.5) < 80.0
+
+
+class TestWindowedAggregator:
+    def test_percentiles_vs_brute_force_over_raw_samples(self):
+        """The satellite acceptance: aggQuery percentiles match a
+        brute-force computation over the same raw samples."""
+        rng = np.random.default_rng(3)
+        vals = rng.uniform(50.0, 50_000.0, 800)
+        now = time.time()
+        agg = WindowedAggregator(bucket_s=1.0, slots=400)
+        agg.ingest([dist_sample("storage.read.latency_us",
+                                now - i * 0.1, float(v), {"node": "1"})
+                    for i, v in enumerate(vals)])
+        (row,) = agg.query("storage.read.latency_us", {}, 120,
+                           until=now)
+        assert row.count == 800
+        for attr, q in (("p50", 50), ("p90", 90), ("p99", 99)):
+            want = float(np.percentile(vals, q))
+            got = getattr(row, attr)
+            assert abs(got - want) / want < 0.15, (attr, got, want)
+        assert row.vmin == pytest.approx(float(vals.min()))
+        assert row.vmax == pytest.approx(float(vals.max()))
+
+    def test_counter_rate_and_gauge_last(self):
+        now = time.time()
+        agg = WindowedAggregator(bucket_s=1.0, slots=100)
+        # counter deltas: 10 ops/s over 20s
+        agg.ingest([Sample("qos.admitted", now - i, {"class": "fg"},
+                           value=10.0, count=10) for i in range(20)])
+        # gauge: last-write-wins by ts
+        agg.ingest([Sample("memory.rss_kb", now - 5, {}, value=111.0,
+                           count=1),
+                    Sample("memory.rss_kb", now - 1, {}, value=222.0,
+                           count=1)])
+        (c,) = agg.query("qos.admitted", {}, 20, until=now)
+        assert c.rate == pytest.approx(10.0, rel=0.15)
+        (g,) = agg.query("memory.rss_kb", {}, 60, until=now)
+        assert g.last == 222.0
+        # window restriction: only the newest 5s of counter samples
+        (c5,) = agg.query("qos.admitted", {}, 5, until=now)
+        assert c5.vsum < c.vsum
+
+    def test_tag_filter_and_prefix(self):
+        now = time.time()
+        agg = WindowedAggregator()
+        agg.ingest([Sample("tenant.bytes", now, {"tenant": "a"},
+                           value=1.0, count=1),
+                    Sample("tenant.bytes", now, {"tenant": "b"},
+                           value=2.0, count=1),
+                    Sample("tenant.shed", now, {"tenant": "a"},
+                           value=3.0, count=3)])
+        rows = agg.query("tenant.bytes", {"tenant": "a"}, 60, until=now)
+        assert len(rows) == 1 and rows[0].vsum == 1.0
+        rows = agg.query("tenant.", {}, 60, until=now, prefix=True)
+        assert len(rows) == 3
+
+    def test_ring_retention_expires_old_slots(self):
+        now = time.time()
+        agg = WindowedAggregator(bucket_s=1.0, slots=10)
+        ser_samples = [Sample("x.y", now - 100 + i, {}, value=1.0,
+                              count=1) for i in range(100)]
+        agg.ingest(ser_samples)
+        # only the last ~10 slots survive
+        (row,) = agg.query("x.y", {}, 1000, until=now)
+        assert row.count <= 10
+        assert agg.stats()["slots"] <= 10
+
+    def test_series_cap_bounds_memory(self):
+        now = time.time()
+        agg = WindowedAggregator(max_series=5)
+        agg.ingest([Sample("m.n", now, {"node": str(i)}, value=1.0,
+                           count=1) for i in range(20)])
+        st = agg.stats()
+        assert st["series"] == 5 and st["dropped_series"] == 15
+
+
+class TestSpecParse:
+    def test_good_spec(self):
+        rules = parse_slo_spec(
+            "rule=a,metric=x.y,agg=p99,max=5,fast_s=5,slow_s=20,"
+            "for_s=2,severity=critical,node=101;"
+            "rule=b,metric=x.y,absent_s=30")
+        assert rules["a"].max_bound == 5.0
+        assert rules["a"].tags == {"node": "101"}
+        assert rules["a"].severity == "critical"
+        assert rules["b"].absent_s == 30.0
+
+    @pytest.mark.parametrize("bad", [
+        "rule=a,metric=x.y",                      # no bound
+        "rule=a,metric=bad-name,max=1",           # bad metric
+        "rule=Bad!,metric=x.y,max=1",             # bad rule name
+        "rule=a,metric=x.y,agg=nope,max=1",       # bad agg
+        "rule=a,metric=x.y,max=1,fast_s=10,slow_s=5",  # slow < fast
+        "rule=a,metric=x.y,max=1;rule=a,metric=x.y,max=2",  # dup
+        "rule=a,metric=x.y,max=1,bogus=2",        # unknown field
+        "rule=a,metric=x.y,max=1,severity=wat",   # bad severity
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+    def test_config_checker_rejects_bad_spec_atomically(self):
+        from tpu3fs.monitor.slo import SloConfig
+
+        cfg = SloConfig()
+        cfg.set("spec", "rule=a,metric=x.y,max=1")
+        with pytest.raises(ValueError):
+            cfg.set("spec", "rule=a,metric=x.y")  # no bound
+        assert cfg.spec == "rule=a,metric=x.y,max=1"
+
+
+class _Clock:
+    def __init__(self, t0=None):
+        self.t = t0 if t0 is not None else time.time()
+
+    def __call__(self):
+        return self.t
+
+
+class TestAlertStateMachine:
+    """Synthetic sample feed through a real aggregator + fake clock."""
+
+    def _setup(self, spec, bucket_s=1.0):
+        clock = _Clock()
+        agg = WindowedAggregator(bucket_s=bucket_s, slots=600)
+        eng = SloEngine(agg, now_fn=clock)
+        eng.configure(spec)
+        return clock, agg, eng
+
+    def _feed(self, agg, clock, value, name="storage.read.latency_us"):
+        agg.ingest([dist_sample(name, clock.t, value, {"node": "1"})])
+
+    def test_pending_then_firing_then_resolved(self):
+        clock, agg, eng = self._setup(
+            "rule=lat,metric=storage.read.latency_us,agg=p99,max=1000,"
+            "fast_s=5,slow_s=20,for_s=2")
+        # healthy traffic
+        for _ in range(5):
+            self._feed(agg, clock, 100.0)
+            clock.t += 1
+        st = eng.evaluate()["lat"]
+        assert st.state == "ok"
+        # breach: pending first (for_s=2 gates firing)
+        self._feed(agg, clock, 50_000.0)
+        st = eng.evaluate()["lat"]
+        assert st.state == "pending"
+        clock.t += 3
+        self._feed(agg, clock, 50_000.0)
+        st = eng.evaluate()["lat"]
+        assert st.state == "firing" and st.fired_count == 1
+        assert "node=1" in st.message  # breach NAMES the offender
+        # recovery: fast window clean after 6s, slow window still holds
+        # the breach => stays firing (flap suppression)
+        clock.t += 6
+        self._feed(agg, clock, 100.0)
+        st = eng.evaluate()["lat"]
+        assert st.state == "firing"
+        # slow window (20s) clears => resolved
+        clock.t += 21
+        self._feed(agg, clock, 100.0)
+        st = eng.evaluate()["lat"]
+        assert st.state == "ok"
+        kinds = [t.transition for t in eng.transitions]
+        assert kinds == ["pending", "firing", "resolved"]
+
+    def test_for_s_zero_fires_immediately(self):
+        clock, agg, eng = self._setup(
+            "rule=lat,metric=storage.read.latency_us,agg=p99,max=1000,"
+            "fast_s=5,slow_s=10")
+        self._feed(agg, clock, 99_999.0)
+        st = eng.evaluate()["lat"]
+        assert st.state == "firing"
+        kinds = [t.transition for t in eng.transitions]
+        assert kinds == ["pending", "firing"]
+
+    def test_pending_clears_without_firing(self):
+        clock, agg, eng = self._setup(
+            "rule=lat,metric=storage.read.latency_us,agg=p99,max=1000,"
+            "fast_s=3,slow_s=10,for_s=5")
+        self._feed(agg, clock, 99_999.0)
+        assert eng.evaluate()["lat"].state == "pending"
+        clock.t += 4  # breach ages out of the 3s fast window
+        self._feed(agg, clock, 10.0)
+        st = eng.evaluate()["lat"]
+        assert st.state == "ok" and st.fired_count == 0
+        assert [t.transition for t in eng.transitions] == \
+            ["pending", "cleared"]
+
+    def test_no_data_is_not_a_breach_for_bound_rules(self):
+        clock, _agg, eng = self._setup(
+            "rule=lat,metric=storage.read.latency_us,agg=p99,max=1000")
+        assert eng.evaluate()["lat"].state == "ok"
+        clock.t += 1000
+        assert eng.evaluate()["lat"].state == "ok"
+
+    def test_absence_rule_grace_fire_resolve(self):
+        clock, agg, eng = self._setup(
+            "rule=alive,metric=memory.rss_kb,absent_s=10,fast_s=5,"
+            "slow_s=10")
+        # grace: freshly armed, nothing ever reported — no fire yet
+        assert eng.evaluate()["alive"].state == "ok"
+        clock.t += 5
+        self._feed(agg, clock, 123.0, name="memory.rss_kb")
+        assert eng.evaluate()["alive"].state == "ok"
+        # silence past absent_s fires
+        clock.t += 11
+        st = eng.evaluate()["alive"]
+        assert st.state == "firing"
+        # samples return => resolves
+        self._feed(agg, clock, 123.0, name="memory.rss_kb")
+        assert eng.evaluate()["alive"].state == "ok"
+        # grace also covers the armed-but-never-reported boot window
+        clock2, _agg2, eng2 = self._setup(
+            "rule=alive,metric=memory.rss_kb,absent_s=10")
+        clock2.t += 11
+        assert eng2.evaluate()["alive"].state == "firing"
+
+    def test_verdict_severity_ladder(self):
+        clock, agg, eng = self._setup(
+            "rule=deg,metric=a.b,agg=rate,max=1,fast_s=5,slow_s=10;"
+            "rule=crit,metric=c.d,agg=rate,max=1,fast_s=5,slow_s=10,"
+            "severity=critical")
+        assert eng.health()[0] == "OK"
+        agg.ingest([Sample("a.b", clock.t, {}, value=100.0, count=100)])
+        eng.evaluate()
+        verdict, firing = eng.health()
+        assert verdict == "DEGRADED" and [s.rule for s in firing] == \
+            ["deg"]
+        agg.ingest([Sample("c.d", clock.t, {}, value=100.0, count=100)])
+        eng.evaluate()
+        assert eng.health()[0] == "CRITICAL"
+
+    def test_reconfigure_keeps_state_of_same_named_rules(self):
+        clock, agg, eng = self._setup(
+            "rule=lat,metric=storage.read.latency_us,agg=p99,max=1000,"
+            "fast_s=5,slow_s=10")
+        self._feed(agg, clock, 99_999.0)
+        assert eng.evaluate()["lat"].state == "firing"
+        eng.configure(
+            "rule=lat,metric=storage.read.latency_us,agg=p99,max=900,"
+            "fast_s=5,slow_s=10;rule=other,metric=x.y,agg=rate,max=1")
+        snap = eng.snapshot()
+        assert snap["lat"].state == "firing"  # retune != resolve
+        assert snap["other"].state == "ok"
+
+    def test_firing_callback_fires_once_per_transition(self):
+        clock, agg, eng = self._setup(
+            "rule=lat,metric=storage.read.latency_us,agg=p99,max=1000,"
+            "fast_s=5,slow_s=10")
+        hits = []
+        eng.add_firing_callback(lambda st: hits.append(st.rule))
+        self._feed(agg, clock, 99_999.0)
+        eng.evaluate()
+        eng.evaluate()  # still firing: no second callback
+        assert hits == ["lat"]
+
+
+class TestCollectorRpc:
+    def _boot(self, spec="", sink=None):
+        agg = WindowedAggregator(bucket_s=1.0, slots=300)
+        eng = SloEngine(agg)
+        if spec:
+            eng.configure(spec)
+        svc = CollectorService(sink or MemorySink(), aggregator=agg,
+                               slo=eng)
+        srv = RpcServer()
+        bind_collector_service(srv, svc)
+        srv.start()
+        return srv, svc, agg, eng
+
+    def test_agg_query_over_rpc(self):
+        srv, svc, _agg, _eng = self._boot()
+        try:
+            now = time.time()
+            svc.write(SampleBatch([
+                dist_sample("kv.op.latency_us", now, 500.0,
+                            {"node": "2"})]))
+            rsp = RpcClient().call(
+                srv.address, 5, 3,
+                AggQueryReq(name="kv.op.latency_us", window_s=60),
+                AggQueryRsp)
+            assert len(rsp.rows) == 1
+            row = rsp.rows[0]
+            assert row.tags == {"node": "2"} and row.count == 1
+            assert row.p99 == pytest.approx(500.0, rel=0.15)
+        finally:
+            srv.stop()
+
+    def test_slo_gate_pass_fail_and_wait(self):
+        srv, svc, _agg, _eng = self._boot(
+            "rule=shed,metric=qos.shed,agg=rate,max=1,fast_s=10,"
+            "slow_s=20")
+        try:
+            gate = SloGate(f"127.0.0.1:{srv.port}")
+            assert "OK" in gate.assert_ok()
+            svc.write(SampleBatch([Sample(
+                "qos.shed", time.time(), {"class": "fg"}, value=100.0,
+                count=100)]))
+            gate.wait_verdict("DEGRADED", timeout=5, poll_s=0.1)
+            with pytest.raises(SloGateError) as ei:
+                gate.assert_ok()
+            assert "shed" in str(ei.value)
+            # rule subset: an unrelated rule filter passes
+            assert gate.check(rules=["nope"])[0]
+        finally:
+            srv.stop()
+
+    def test_firing_bumps_dump_epoch_on_ack(self):
+        srv, svc, _agg, _eng = self._boot(
+            "rule=shed,metric=qos.shed,agg=rate,max=1,fast_s=10,"
+            "slow_s=20")
+        try:
+            ack = svc.write(SampleBatch([Sample(
+                "x.y", time.time(), {}, value=1.0, count=1)]))
+            assert ack.dump_epoch == 0
+            svc.write(SampleBatch([Sample(
+                "qos.shed", time.time(), {}, value=100.0, count=100)]))
+            svc.slo_status(type("R", (), {"evaluate": True})())
+            ack = svc.write(SampleBatch([Sample(
+                "x.y", time.time(), {}, value=1.0, count=1)]))
+            assert ack.dump_epoch == 1
+        finally:
+            srv.stop()
+
+    def test_sink_dump_callback_baselines_then_fires(self):
+        srv, svc, _agg, _eng = self._boot()
+        try:
+            sink = BufferedCollectorSink(srv.address)
+            dumps = []
+            sink.on_dump(dumps.append)
+            svc._dump_epoch = 3  # pre-existing breaches
+            sink.write([Sample("a.b", time.time(), {}, value=1.0,
+                               count=1)])
+            assert dumps == []  # first ack only baselines
+            svc.request_flight_dump()
+            sink.write([Sample("a.b", time.time(), {}, value=1.0,
+                               count=1)])
+            assert len(dumps) == 1 and "4" in dumps[0]
+            sink.write([Sample("a.b", time.time(), {}, value=1.0,
+                               count=1)])
+            assert len(dumps) == 1  # same epoch: no re-dump
+        finally:
+            srv.stop()
+
+    def test_old_collector_without_agg_falls_back_raw_in_cli(self,
+                                                            tmp_path):
+        """An OLD collector (methods 1-2 only): admin_cli top falls
+        back to the raw-sample scan."""
+        from tpu3fs.cli import AdminCli
+        from tpu3fs.monitor.collector import (
+            COLLECTOR_SERVICE_ID,
+            QueryReq,
+        )
+        from tpu3fs.rpc.net import ServiceDef
+
+        svc = CollectorService(SqliteSink(str(tmp_path / "m.db")))
+        srv = RpcServer()
+        s = ServiceDef(COLLECTOR_SERVICE_ID, "MonitorCollector")
+        s.method(1, "write", SampleBatch, Ack, svc.write)
+        s.method(2, "query", QueryReq, SampleBatch, svc.query)
+        srv.add_service(s)
+        srv.start()
+        try:
+            svc.write(SampleBatch([Sample(
+                "qos.admitted", time.time(),
+                {"class": "fg_write", "node": "9"}, value=10.0,
+                count=10)]))
+            out = AdminCli(None).run(
+                f"top --collector 127.0.0.1:{srv.port} --window 60")
+            assert "fg_write" in out and "raw samples" in out
+        finally:
+            srv.stop()
+
+    def test_top_prefers_agg_rollups(self):
+        from tpu3fs.cli import AdminCli
+
+        srv, svc, _agg, _eng = self._boot()
+        try:
+            svc.write(SampleBatch([Sample(
+                "qos.admitted", time.time(),
+                {"class": "fg_write", "node": "9"}, value=10.0,
+                count=10)]))
+            out = AdminCli(None).run(
+                f"top --collector 127.0.0.1:{srv.port} --window 60")
+            assert "fg_write" in out and "aggQuery rollups" in out
+        finally:
+            srv.stop()
+
+
+class TestOutageReplay:
+    def test_bounded_drop_then_restart_replays_in_order(self):
+        """Satellite: collector outage -> bounded drop of the OLDEST ->
+        restart -> backlog replays oldest-first before new samples."""
+        mem = MemorySink()
+        svc = CollectorService(mem)
+        srv = RpcServer()
+        bind_collector_service(srv, svc)
+        srv.start()
+        port = srv.port
+        sink = BufferedCollectorSink(("127.0.0.1", port),
+                                     cap_samples=50)
+        mk = lambda i: Sample("r.s", float(i), {}, value=float(i),
+                              count=1)
+        sink.write([mk(0)])
+        assert sink.backlog() == 0 and sink.backoff == 1.0
+        srv.stop()
+        # outage: every write raises, buffer bounded, backoff grows
+        for base in range(1, 81, 20):
+            with pytest.raises(Exception):
+                sink.write([mk(i) for i in range(base, base + 20)])
+        assert sink.backlog() == 50  # 80 buffered, 30 oldest dropped
+        assert sink.backoff > 1.0
+        with sink.dropped._lock:
+            assert sink.dropped._value == 30
+        # restart on the SAME port; next write drains backlog in order
+        srv2 = RpcServer(port=port)
+        bind_collector_service(srv2, svc)
+        srv2.start()
+        try:
+            sink.write([mk(100)])
+            assert sink.backlog() == 0 and sink.backoff == 1.0
+            svc.flush()
+            got = [s.ts for s in mem.samples]
+            # sample 0 (delivered pre-outage), then the surviving
+            # newest window in ORDER, then the post-restart sample
+            # (which itself pushed the full buffer over cap, evicting
+            # one more oldest: 31)
+            assert got == [0.0] + [float(i) for i in range(32, 81)] \
+                + [100.0]
+        finally:
+            srv2.stop()
+
+    def test_backoff_capped_and_reset(self):
+        sink = BufferedCollectorSink(("127.0.0.1", 1))  # nothing there
+        mk = Sample("r.s", 0.0, {}, value=1.0, count=1)
+        for _ in range(10):
+            with pytest.raises(Exception):
+                sink.write([mk])
+        assert sink.backoff == BufferedCollectorSink.BACKOFF_CAP
+        sink._fails = 0
+        assert sink.backoff == 1.0
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_and_dump_roundtrip(self, tmp_path):
+        fl = FlightRecorder(ring_events=32)
+        fl.configure(service="stor", node=7,
+                     dump_dir=str(tmp_path / "fl"))
+        for i in range(100):
+            fl.record("alert", rule=f"r{i}", transition="firing",
+                      ts=float(i))
+        assert len(fl.snapshot()) == 32  # bounded by construction
+        path = fl.dump(reason="test")
+        assert os.path.basename(path).startswith("flight-stor-7-")
+        rows = [json.loads(line) for line in open(path)]
+        assert rows[0]["kind"] == "meta"
+        assert rows[0]["reason"] == "test" and rows[0]["events"] == 32
+        assert rows[1]["rule"] == "r68"  # oldest surviving
+        assert fl.dumps == 1
+
+    def test_no_dir_means_no_dump_unless_explicit(self, tmp_path):
+        fl = FlightRecorder()
+        fl.record("config", ok=True)
+        assert fl.dump(reason="x") == ""
+        p = str(tmp_path / "explicit.jsonl")
+        assert fl.dump(p, reason="x") == p
+        assert os.path.exists(p)
+
+    def test_tracer_slow_hook_feeds_span_ring(self, tmp_path):
+        from tpu3fs.analytics import spans
+
+        fl = FlightRecorder()
+        old = spans._TRACER
+        spans._TRACER = spans.Tracer()
+        try:
+            t = spans._TRACER
+            t.configure(service="cli", node=0,
+                        directory=str(tmp_path / "tr"),
+                        sample_rate=0.0, slow_op_ms=1)
+            t.add_slow_hook(fl.record_spans)
+            t.add_slow_hook(fl.record_spans)  # idempotent
+            assert len(t._slow_hooks) == 1
+            with spans.root_span("client.slow_op"):
+                with spans.span("client.slow_op", "stage"):
+                    time.sleep(0.01)
+            rows = [r for r in fl.snapshot() if r["kind"] == "span"]
+            ops = {r["op"] for r in rows}
+            assert "client.slow_op" in ops
+            assert any(r["stage"] == "stage" for r in rows)
+            # fast ops stay OUT of the black box
+            fl2 = FlightRecorder()
+            t.add_slow_hook(fl2.record_spans)
+            t.slow_op_us = 10_000_000.0
+            with spans.root_span("client.fast_op"):
+                pass
+            assert not fl2.snapshot()
+        finally:
+            spans._TRACER = old
+
+    def test_sample_sink_and_memoization(self):
+        fl = FlightRecorder()
+        assert fl.sample_sink() is fl.sample_sink()
+        fl.sample_sink().write([Sample("a.b", 1.0, {"node": "1"},
+                                       value=2.0, count=2)])
+        (row,) = fl.snapshot()
+        assert row["kind"] == "sample" and row["name"] == "a.b"
+
+    def test_flight_show_merges_processes(self, tmp_path):
+        from tpu3fs.analytics import assemble
+        from tpu3fs.cli import AdminCli
+
+        a = FlightRecorder()
+        a.configure(service="storage", node=101,
+                    dump_dir=str(tmp_path))
+        a.record("span", trace_id="t1", span_id="s1", parent_id="",
+                 op="client.batch_read", stage="", ts=10.0,
+                 dur_us=120000.0, service="client", node=0)
+        a.record("alert", ts=11.0, rule="read_p99",
+                 transition="firing", value=5.0, message="p99 high")
+        a.dump(reason="slo breach: read_p99")
+        b = FlightRecorder()
+        b.configure(service="storage", node=102,
+                    dump_dir=str(tmp_path))
+        b.record("span", trace_id="t1", span_id="s2", parent_id="s1",
+                 op="rpc.Storage.batchRead", stage="", ts=10.01,
+                 dur_us=110000.0, service="storage", node=102)
+        b.record("config", ts=9.0, ok=True, source="mgmtd-heartbeat",
+                 version=4)
+        b.dump(reason="signal 15")
+        rows = assemble.load_flight([str(tmp_path)])
+        assert [r["kind"] for r in rows if r["kind"] != "meta"] \
+            == ["config", "span", "span", "alert"]  # ts-merged
+        out = AdminCli(None).run(f"flight-show --dir {tmp_path}")
+        assert "2 dump(s)" in out
+        assert "ALERT read_p99 -> firing" in out
+        assert "CONFIG applied" in out
+        # the cross-process trace joined: server span nests under the
+        # client op via the PR 8 machinery
+        assert "client.batch_read" in out
+        assert "rpc.Storage.batchRead" in out
+
+    def test_core_flight_dump_rpc(self, tmp_path):
+        from tpu3fs.monitor import flight as flight_mod
+        from tpu3fs.rpc.services import (
+            CORE_SERVICE_ID,
+            FlightDumpReq,
+            FlightDumpRsp,
+            bind_core_service,
+        )
+
+        old = flight_mod._FLIGHT
+        flight_mod._FLIGHT = FlightRecorder()
+        try:
+            flight_mod._FLIGHT.configure(service="kv", node=3)
+            flight_mod._FLIGHT.record("config", ok=True, source="test")
+            srv = RpcServer()
+            bind_core_service(srv)
+            srv.start()
+            try:
+                p = str(tmp_path / "dump.jsonl")
+                rsp = RpcClient().call(
+                    srv.address, CORE_SERVICE_ID, 7,
+                    FlightDumpReq(path=p), FlightDumpRsp)
+                assert rsp.path == p and rsp.events == 1
+                assert os.path.exists(p)
+                # no dir, no path: ring reported, nothing written
+                rsp = RpcClient().call(
+                    srv.address, CORE_SERVICE_ID, 7,
+                    FlightDumpReq(), FlightDumpRsp)
+                assert rsp.path == "" and rsp.events == 1
+            finally:
+                srv.stop()
+        finally:
+            flight_mod._FLIGHT = old
+
+
+class TestSqliteRetention:
+    def test_age_compaction_and_gauge(self, tmp_path):
+        db = SqliteSink(str(tmp_path / "m.db"))
+        now = time.time()
+        old = [Sample("a.b", now - 5000, {}, value=1.0, count=1)
+               for _ in range(200)]
+        new = [Sample("a.b", now, {}, value=2.0, count=1)
+               for _ in range(10)]
+        db.write(old + new)
+        assert db.db_bytes() > 0
+        removed = db.compact(retention_s=3600)
+        assert removed == 200
+        left = db.query("a.b", limit=1000)
+        assert len(left) == 10 and all(s.value == 2.0 for s in left)
+
+    def test_size_cap_drops_oldest(self, tmp_path):
+        db = SqliteSink(str(tmp_path / "m.db"))
+        now = time.time()
+        db.write([Sample("a.b", now - 1000 + i, {"node": "1"},
+                         value=float(i), count=1)
+                  for i in range(20000)])
+        before = db.db_bytes()
+        removed = db.compact(max_bytes=before // 4)
+        assert removed > 0
+        assert db.db_bytes() < before
+        left = db.query("a.b", limit=100000)
+        # the newest rows survive
+        assert max(s.value for s in left) == 19999.0
+
+    def test_monitor_app_self_gauges_registered(self, tmp_path):
+        """The collector binary wires monitor.retained_bytes /
+        ingest_rate / agg_* into its MemoryMonitor sources."""
+        from tpu3fs.bin.monitor_main import MonitorApp
+
+        app = MonitorApp(
+            ["--port", "0", "--node-id", "77",
+             f"--config.out_path={tmp_path}/m.db", "--sink", "sqlite"])
+        app.run(block=False)
+        try:
+            app.collector.write(SampleBatch([Sample(
+                "q.r", time.time(), {}, value=1.0, count=1)]))
+            vals = app.memory_monitor.poll_once()
+            for name in ("monitor.retained_bytes", "monitor.agg_series",
+                         "monitor.agg_bytes", "monitor.ingest_rate"):
+                assert name in vals, (name, sorted(vals))
+            assert vals["monitor.agg_series"] >= 1.0
+        finally:
+            app.stop()
+            app._shutdown()
+
+
+class TestMonitorAppSloLoop:
+    def test_hot_pushed_rules_evaluate_and_answer_status(self, tmp_path):
+        """End to end inside the collector binary: hot-push [slo] via
+        the core RPC (the one-phase push path admin_cli slo set uses),
+        feed breaching samples over the collector RPC, watch the eval
+        loop fire the rule and sloStatus answer DEGRADED."""
+        from tpu3fs.bin.monitor_main import MonitorApp
+        from tpu3fs.cli import AdminCli
+        from tpu3fs.monitor.collector import CollectorSink
+
+        app = MonitorApp(
+            ["--port", "0", "--node-id", "78",
+             f"--config.out_path={tmp_path}/m.db", "--sink", "sqlite",
+             "--config.slo.eval_period_s=0.1",
+             "--config.monitor_push_period_s=0.2"])
+        app.run(block=False)
+        try:
+            port = app.server.port
+            cli = AdminCli(None)
+            out = cli.run(
+                f"slo set --collector 127.0.0.1:{port} --spec "
+                f"\"rule=shed,metric=qos.shed,agg=rate,max=1,"
+                f"fast_s=10,slow_s=20\"")
+            assert "pushed 1 slo rule" in out
+            assert "shed" in app.slo_engine.rules
+            out = cli.run(f"health --collector 127.0.0.1:{port}")
+            assert out.startswith("OK")
+            CollectorSink(("127.0.0.1", port)).write([Sample(
+                "qos.shed", time.time(), {"class": "fg", "node": "4"},
+                value=500.0, count=500)])
+            gate = SloGate(f"127.0.0.1:{port}")
+            gate.wait_verdict("DEGRADED", timeout=5, poll_s=0.1)
+            out = cli.run(f"health --collector 127.0.0.1:{port}")
+            assert out.startswith("DEGRADED") and "shed" in out
+            out = cli.run(f"alerts --collector 127.0.0.1:{port}")
+            assert "firing" in out
+            out = cli.run(f"slo-show --collector 127.0.0.1:{port}")
+            assert "node=4" in out  # offender named
+            # the collector drinks its own telemetry: transition
+            # samples land in its own aggregator on the next collect
+            # tick (push period 0.2s in this app)
+            deadline = time.time() + 5
+            rows = []
+            while time.time() < deadline and not rows:
+                rows = app.aggregator.query("slo.alert_firing", {},
+                                            120, prefix=True)
+                time.sleep(0.05)
+            assert rows and rows[0].vsum >= 1.0
+            # clear: rules gone, verdict OK
+            out = cli.run(f"slo clear --collector 127.0.0.1:{port}")
+            assert "pushed 0 slo rule" in out
+            assert not app.slo_engine.rules
+            assert cli.run(
+                f"health --collector 127.0.0.1:{port}").startswith("OK")
+        finally:
+            app.stop()
+            app._shutdown()

@@ -17,6 +17,14 @@ construction, then enforces the observability contract
 4. TAG VOCABULARY — literal tag dicts only use keys from the fixed
    vocabulary (service, class, tenant, chain, node, kind, target): the
    collector's group-bys and admin_cli top's joins key on these.
+5. SLO RULE REFERENCES — every metric name referenced by an ``[slo]``
+   rule in any shipped/default config (the
+   ``slo.DEFAULT_CLUSTER_SPEC`` constant plus every ``[slo] spec``
+   found in repo TOML files) must resolve to a declared recorder name
+   (LatencyRecorder families expand to ``.succeeded``/``.failed``/
+   ``.latency_us``; the ``memory.*`` proc gauges come from
+   ``monitor/memory._FIELDS``). A typo'd rule must fail HERE,
+   statically — not ship and silently never fire.
 
 Dynamic names (f-strings, variables) are only allowed in the whitelisted
 infrastructure files that build recorders ON BEHALF of callers
@@ -153,6 +161,71 @@ def doc_table_names() -> List[str]:
     return names
 
 
+def slo_spec_sources() -> List[Tuple[str, str]]:
+    """-> [(label, spec)] of every shipped/default [slo] rule spec: the
+    engine's DEFAULT_CLUSTER_SPEC plus any [slo] section in repo TOML
+    files (deploy configs, examples)."""
+    out: List[Tuple[str, str]] = []
+    from tpu3fs.monitor.slo import DEFAULT_CLUSTER_SPEC
+
+    out.append(("tpu3fs.monitor.slo.DEFAULT_CLUSTER_SPEC",
+                DEFAULT_CLUSTER_SPEC))
+    try:
+        import tomllib  # py311+
+    except ImportError:
+        try:
+            import tomli as tomllib  # py310 backport
+        except ImportError:
+            tomllib = None
+    if tomllib is not None:
+        for dirpath, dirnames, filenames in os.walk(REPO):
+            dirnames[:] = [d for d in dirnames
+                           if d not in (".git", "__pycache__",
+                                        ".claude", "node_modules")]
+            for fn in sorted(filenames):
+                if not fn.endswith(".toml"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    with open(path, "rb") as f:
+                        data = tomllib.load(f)
+                except Exception:
+                    continue
+                spec = (data.get("slo") or {}).get("spec", "")
+                if spec:
+                    out.append((os.path.relpath(path, REPO), spec))
+    return out
+
+
+def check_slo_specs(decls: List[Tuple[str, str, int, str]]) -> List[str]:
+    """Check 5: every [slo]-rule metric resolves to a declared
+    recorder name."""
+    from tpu3fs.monitor.memory import _FIELDS
+    from tpu3fs.monitor.slo import parse_slo_spec
+
+    known = set(_FIELDS.values())
+    for name, _rel, _lineno, kind in decls:
+        known.add(name)
+        if kind == "LatencyRecorder":
+            for suffix in (".succeeded", ".failed", ".latency_us"):
+                known.add(name + suffix)
+    errors: List[str] = []
+    for label, spec in slo_spec_sources():
+        try:
+            rules = parse_slo_spec(spec)
+        except ValueError as e:
+            errors.append(f"{label}: unparsable [slo] spec: {e}")
+            continue
+        for rule in rules.values():
+            if rule.metric not in known:
+                errors.append(
+                    f"{label}: slo rule {rule.name!r} references "
+                    f"metric {rule.metric!r}, which no recorder "
+                    "declares (typo'd rules must fail statically, "
+                    "not silently never fire)")
+    return errors
+
+
 def run_checks() -> Tuple[List[str], List[str]]:
     decls, errors = collect_declarations()
     notes: List[str] = []
@@ -193,8 +266,12 @@ def run_checks() -> Tuple[List[str], List[str]]:
     for name in sorted(dupes):
         errors.append(f"docs/observability.md lists {name!r} twice")
 
+    # 5. shipped/default [slo] rules reference only declared metrics
+    errors.extend(check_slo_specs(decls))
+
     notes.append(f"{len(decls)} recorder declarations, "
-                 f"{len(sites)} distinct names, {len(doc)} doc rows")
+                 f"{len(sites)} distinct names, {len(doc)} doc rows, "
+                 f"{len(slo_spec_sources())} slo spec source(s)")
     return errors, notes
 
 

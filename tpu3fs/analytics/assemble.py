@@ -134,6 +134,95 @@ def assemble_traces(rows: Sequence[dict]) -> Dict[str, TraceTree]:
     return {tid: TraceTree(tid, trows) for tid, trows in groups.items()}
 
 
+# -- flight-recorder dumps (monitor/flight.py black boxes) --------------------
+
+
+def flight_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/dirs into flight dump files (recursive)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(glob.glob(os.path.join(p, "**", "flight-*.jsonl"),
+                                 recursive=True))
+        elif os.path.exists(p):
+            out.append(p)
+    return sorted(set(out))
+
+
+def load_flight(paths: Iterable[str]) -> List[dict]:
+    """Load N processes' flight dumps into one ts-sorted timeline. Each
+    row keeps its dump's identity (``_service``/``_node``/``_dump``
+    from the file's leading meta row), so a merged view still attributes
+    every event to its black box."""
+    import json
+
+    rows: List[dict] = []
+    for path in flight_files(paths):
+        meta = {"service": "?", "node": 0}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if row.get("kind") == "meta":
+                    meta = row
+                row.setdefault("_service", meta.get("service", "?"))
+                row.setdefault("_node", meta.get("node", 0))
+                row["_dump"] = os.path.basename(path)
+                rows.append(row)
+    rows.sort(key=lambda r: r.get("ts", 0.0))
+    return rows
+
+
+def format_flight(rows: Sequence[dict], *, spans: int = 3,
+                  events: int = 40) -> str:
+    """Merged black-box view: the dump inventory, the event timeline
+    (alerts, config pushes, dump reasons), and the slowest cross-process
+    span trees rebuilt from the dumps' span rows through the PR 8 trace
+    machinery (trace ids join across processes)."""
+    if not rows:
+        return "no flight dumps found"
+    lines: List[str] = []
+    metas = [r for r in rows if r.get("kind") == "meta"]
+    lines.append(f"flight view: {len(metas)} dump(s), {len(rows)} rows")
+    for m in metas:
+        lines.append(
+            f"  {m.get('_dump')}: {m.get('service')}:{m.get('node')} "
+            f"pid {m.get('pid')} reason={m.get('reason')!r} "
+            f"events={m.get('events')}")
+    timeline = [r for r in rows
+                if r.get("kind") in ("alert", "config")]
+    if timeline:
+        lines.append("timeline (alerts + config pushes):")
+        for r in timeline[-events:]:
+            who = f"{r.get('_service')}:{r.get('_node')}"
+            if r.get("kind") == "alert":
+                lines.append(
+                    f"  {r.get('ts', 0.0):.3f} [{who}] ALERT "
+                    f"{r.get('rule')} -> {r.get('transition')} "
+                    f"({r.get('message', '')})")
+            else:
+                ok = "applied" if r.get("ok") else "REJECTED"
+                lines.append(
+                    f"  {r.get('ts', 0.0):.3f} [{who}] CONFIG {ok} "
+                    f"(source={r.get('source')}"
+                    + (f", v{r['version']}" if "version" in r else "")
+                    + ")")
+    span_rows = [r for r in rows if r.get("kind") == "span"]
+    if span_rows:
+        trees = assemble_traces(span_rows)
+        ranked = top_traces(trees, spans)
+        lines.append(f"slow-op traces ({len(trees)} in the dumps, "
+                     f"slowest {len(ranked)}):")
+        for tree in ranked:
+            lines.append(format_trace(tree))
+    return "\n".join(lines)
+
+
 def _fmt_row(r: dict) -> str:
     name = r.get("op", "?")
     if r.get("stage"):

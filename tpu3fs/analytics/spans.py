@@ -192,6 +192,10 @@ class Tracer:
         self._log = None
         self._log_dir = None
         self._lock = threading.Lock()
+        # slow-op hooks (the flight recorder's black-box feed): called
+        # with the op's accumulated events whenever an op crosses the
+        # slow threshold, independent of the sampling decision
+        self._slow_hooks: List = []
 
     def configure(self, *, service: Optional[str] = None,
                   node: Optional[int] = None,
@@ -236,6 +240,12 @@ class Tracer:
 
         _apply()
         cfg.add_callback(_apply)
+
+    def add_slow_hook(self, fn) -> None:
+        """Register fn(events) to run on every slow-op flush (idempotent
+        for the same callable — N apps in one process hook once)."""
+        if fn not in self._slow_hooks:
+            self._slow_hooks.append(fn)
 
     def flush(self) -> None:
         log = self._log
@@ -298,6 +308,12 @@ class Tracer:
         self.end_op(ctx, op, ts, dur_s, code=code, nbytes=nbytes,
                     tclass=tclass, tenant=tenant)
         is_slow = ctx.slow or dur_s * 1e6 >= self.slow_op_us
+        if is_slow and self._slow_hooks:
+            for hook in self._slow_hooks:
+                try:
+                    hook(list(ctx.events))
+                except Exception:
+                    pass  # a black-box feed must never fail the op
         if ctx.sampled or is_slow:
             self._flush_events(ctx.events, is_slow and not ctx.sampled)
         ctx.events.clear()

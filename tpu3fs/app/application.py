@@ -109,6 +109,7 @@ class ApplicationBase:
             level=self.flag("log_level", "INFO"),
         )
         self._init_tracing()
+        self._init_flight()
         xlog("INFO", "%s node %d starting (pid %d)",
              type(self).__name__, self.info.node_id, self.info.pid)
 
@@ -141,6 +142,37 @@ class ApplicationBase:
             # bounded visibility lag for live trace consumers (the
             # assembler, trace-show): flush the columnar buffer on a tick
             self.spawn_periodic("trace-flush", 2.0, tracer().flush)
+
+    def _init_flight(self) -> None:
+        """Arm the per-process flight recorder (monitor/flight.py): a
+        bounded black-box ring of recent slow-op spans, samples, config
+        pushes and alerts, dumped on SLO breach / fatal signal /
+        ``admin_cli flight-dump``. The ring is ALWAYS on (bounded by
+        construction); dumps to disk need a configured ``flight.dir``
+        (``--flight-dir`` for binaries run by hand)."""
+        from tpu3fs.analytics.spans import tracer
+        from tpu3fs.monitor.flight import (
+            FlightConfig,
+            apply_flight_config,
+            flight,
+        )
+        from tpu3fs.monitor.recorder import Monitor
+
+        service = type(self).__name__.replace("App", "").lower() or "proc"
+        fcfg = getattr(self.config, "flight", None)
+        if isinstance(fcfg, FlightConfig):
+            if self.flag("flight_dir"):
+                fcfg.set("dir", self.flag("flight_dir"))
+            apply_flight_config(fcfg, service=service,
+                                node=self.info.node_id)
+        else:
+            flight().configure(service=service, node=self.info.node_id,
+                               dump_dir=self.flag("flight_dir") or None)
+        # feeds: slow-op spans off the tracer's flush hook, recent
+        # samples off a Monitor ring sink (the collector keeps the
+        # full-fidelity copy; the black box keeps what fits)
+        tracer().add_slow_hook(flight().record_spans)
+        Monitor.default().add_sink(flight().sample_sink())
 
     def init_server(self) -> None:
         port = int(self.flag("port", "0"))
@@ -217,14 +249,34 @@ class ApplicationBase:
              self.info.node_id, self.info.hostname, self.info.port)
 
     def _install_signal_handlers(self) -> None:
-        """SIGTERM/SIGINT -> graceful stop (unmount, close sessions). Only
-        possible from the main thread; in-process tests skip this."""
+        """SIGTERM/SIGINT -> flight dump + graceful stop (unmount, close
+        sessions); SIGUSR2 -> flight dump WITHOUT stopping (the live
+        "show me your black box" poke). Only possible from the main
+        thread; in-process tests skip this."""
         import signal
 
         if threading.current_thread() is not threading.main_thread():
             return
+
+        def _fatal(signum, _frame):
+            self._flight_dump(f"signal {signum}")
+            self.stop()
+
         for sig in (signal.SIGTERM, signal.SIGINT):
-            signal.signal(sig, lambda *_: self.stop())
+            signal.signal(sig, _fatal)
+        signal.signal(
+            signal.SIGUSR2,
+            lambda *_: self._flight_dump("SIGUSR2"))
+
+    def _flight_dump(self, reason: str) -> str:
+        """Dump the process black box if a dump dir is configured."""
+        from tpu3fs.monitor.flight import flight
+
+        try:
+            return flight().dump(reason=reason)
+        except Exception as e:
+            xlog("WARN", "flight dump failed: %r", e)
+            return ""
 
     def run(self, *, block: bool = True) -> "ApplicationBase":
         self.init_common_components()
@@ -248,7 +300,15 @@ class ApplicationBase:
         ``monitor_push_period_s`` (hot) or ``--monitor-period``. With no
         address the loop still collects (recorders reset each window) but
         ships nothing. Outages buffer bounded with drop-counting
-        (monitor.collector.BufferedCollectorSink)."""
+        (monitor.collector.BufferedCollectorSink).
+
+        DE-SYNCHRONIZED: each tick jitters ±20% (N binaries configured
+        with the same period must not wake and hammer the collector in
+        lockstep) and multiplies by the sink's backoff (2x per
+        consecutive failed drain, capped 8x) so a dead collector's
+        return isn't a thundering herd. A push Ack whose dump_epoch
+        grew triggers the local flight-recorder dump (the SLO-breach
+        black-box broadcast)."""
         from tpu3fs.monitor.collector import BufferedCollectorSink
         from tpu3fs.monitor.recorder import Monitor
 
@@ -259,13 +319,18 @@ class ApplicationBase:
         def period() -> float:
             p = getattr(self.config, "monitor_push_period_s", None)
             if p is not None:
-                return float(p)
-            return float(self.flag("monitor_period", "5") or 5)
+                base = float(p)
+            else:
+                base = float(self.flag("monitor_period", "5") or 5)
+            return base * self.monitor_sink.backoff
 
         self.monitor_sink = BufferedCollectorSink(addr)
+        self.monitor_sink.on_dump(
+            lambda reason: self._flight_dump(reason))
         monitor = Monitor.default()
         monitor.add_sink(self.monitor_sink)
-        self.spawn_periodic("monitor-push", period, monitor.collect)
+        self.spawn_periodic("monitor-push", period, monitor.collect,
+                            jitter=0.2)
 
     def _start_memory_monitor(self, interval_s: float = 30.0) -> None:
         """Periodic process-memory gauges (ref src/memory counters), plus
@@ -334,13 +399,14 @@ class ApplicationBase:
         t.start()
         self._threads.append(t)
 
-    def spawn_periodic(self, name: str, interval_s, fn):
+    def spawn_periodic(self, name: str, interval_s, fn, *,
+                       jitter: float = 0.1):
         """Named periodic background task (ref BackgroundRunner.h), tied
         to the app's stop(): interval_s may be a zero-arg callable so
         hot-updated config intervals take effect on the next tick."""
         from tpu3fs.utils.executor import PeriodicRunner
 
-        r = PeriodicRunner(name, interval_s, fn)
+        r = PeriodicRunner(name, interval_s, fn, jitter=jitter)
         r.start()
         self._runners.append(r)
         if r._thread is not None:
@@ -446,14 +512,21 @@ class TwoPhaseApplication(ApplicationBase):
             from tpu3fs.rpc.services import _flatten
             from tpu3fs.utils.config import tomllib
 
+            from tpu3fs.monitor.flight import flight
+
             try:
                 self.config.hot_update(_flatten(tomllib.loads(content)))
                 self._config_version = version
                 xlog("INFO", "node %d applied config v%d",
                      self.info.node_id, version)
+                flight().record("config", version=version, ok=True,
+                                source="mgmtd-heartbeat",
+                                nbytes=len(content))
             except Exception as e:
                 xlog("ERR", "node %d config push v%d rejected: %r",
                      self.info.node_id, version, e)
+                flight().record("config", version=version, ok=False,
+                                source="mgmtd-heartbeat", error=repr(e))
 
     def heartbeat_once(self) -> bool:
         try:

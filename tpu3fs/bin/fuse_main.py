@@ -20,6 +20,7 @@ from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import MetaRpcClient, RpcMessenger
 from tpu3fs.usrbio.agent import UsrbioAgent
 from tpu3fs.analytics.spans import TraceConfig
+from tpu3fs.monitor.flight import FlightConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.utils.logging import xlog
 
@@ -28,6 +29,9 @@ class FuseAppConfig(Config):
     # observability: distributed tracing + monitor sample push
     # (tpu3fs/analytics/spans.py; both hot-configured)
     trace = TraceConfig
+    # flight recorder (monitor/flight.py): bounded in-process black box
+    # dumped on SLO breach / fatal signal / admin_cli flight-dump
+    flight = FlightConfig
     collector = ConfigItem("", hot=True)   # host:port; "" = off
     monitor_push_period_s = ConfigItem(5.0, hot=True)
     mountpoint = ConfigItem("")

@@ -21,6 +21,7 @@ from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import RpcMessenger, bind_meta_service
 from tpu3fs.analytics.spans import TraceConfig
+from tpu3fs.monitor.flight import FlightConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.qos.core import QosConfig
 from tpu3fs.utils.fault_injection import FaultPlaneConfig
@@ -39,6 +40,9 @@ class MetaAppConfig(Config):
     # observability: distributed tracing + monitor sample push
     # (tpu3fs/analytics/spans.py; both hot-configured)
     trace = TraceConfig
+    # flight recorder (monitor/flight.py): bounded in-process black box
+    # dumped on SLO breach / fatal signal / admin_cli flight-dump
+    flight = FlightConfig
     collector = ConfigItem("", hot=True)   # host:port; "" = off
     monitor_push_period_s = ConfigItem(5.0, hot=True)
     chunk_size = ConfigItem(1 << 20)

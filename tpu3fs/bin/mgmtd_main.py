@@ -19,6 +19,7 @@ from tpu3fs.mgmtd.types import NodeType
 from tpu3fs.rpc.net import RpcServer
 from tpu3fs.rpc.services import bind_mgmtd_admin, bind_mgmtd_service
 from tpu3fs.analytics.spans import TraceConfig
+from tpu3fs.monitor.flight import FlightConfig
 from tpu3fs.utils.config import Config, ConfigItem
 from tpu3fs.qos.core import QosConfig
 from tpu3fs.utils.fault_injection import FaultPlaneConfig
@@ -37,6 +38,9 @@ class MgmtdAppConfig(Config):
     # observability: distributed tracing + monitor sample push
     # (tpu3fs/analytics/spans.py; both hot-configured)
     trace = TraceConfig
+    # flight recorder (monitor/flight.py): bounded in-process black box
+    # dumped on SLO breach / fatal signal / admin_cli flight-dump
+    flight = FlightConfig
     collector = ConfigItem("", hot=True)   # host:port; "" = off
     monitor_push_period_s = ConfigItem(5.0, hot=True)
     lease_length_s = ConfigItem(60.0, hot=True)

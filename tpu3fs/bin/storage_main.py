@@ -17,6 +17,7 @@ import sys
 from typing import Dict, List, Optional
 
 from tpu3fs.analytics.spans import TraceConfig
+from tpu3fs.monitor.flight import FlightConfig
 from tpu3fs.app.application import TwoPhaseApplication
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType
 from tpu3fs.qos.core import QosConfig
@@ -67,6 +68,9 @@ class StorageAppConfig(Config):
     # distributed request tracing (tpu3fs/analytics/spans.py) + monitor
     # sample push to monitor_collector — both hot-configured
     trace = TraceConfig
+    # flight recorder (monitor/flight.py): bounded in-process black box
+    # dumped on SLO breach / fatal signal / admin_cli flight-dump
+    flight = FlightConfig
     collector = ConfigItem("", hot=True)          # host:port; "" = off
     monitor_push_period_s = ConfigItem(5.0, hot=True)
     # USRBIO shared-memory data plane (tpu3fs/usrbio): co-located clients

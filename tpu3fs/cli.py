@@ -480,7 +480,59 @@ class AdminCli:
         except KeyboardInterrupt:
             return out
 
+    @staticmethod
+    def _agg_rows(coll: str, window: float, prefix: str = ""):
+        """Windowed rollups from the collector's aggQuery RPC — the
+        cheap path `top`/`tenant-top` prefer (one pre-aggregated row
+        per series instead of a raw-sample scan, and the SAME rollups
+        the SLO engine judges). Returns None when the collector is too
+        old to know the method (raw-scan fallback)."""
+        from tpu3fs.monitor.collector import (
+            AggQueryReq,
+            AggQueryRsp,
+            COLLECTOR_SERVICE_ID,
+        )
+        from tpu3fs.rpc.net import RpcClient
+
+        host, port = coll.rsplit(":", 1)
+        try:
+            rsp = RpcClient().call(
+                (host, int(port)), COLLECTOR_SERVICE_ID, 3,
+                AggQueryReq(name=prefix, prefix=True, window_s=window),
+                AggQueryRsp)
+        except FsError:
+            return None  # old collector: no aggQuery
+        return rsp.rows
+
     def _top_once(self, coll: str, window: float) -> str:
+        rows = self._agg_rows(coll, window)
+        if rows:  # old collector (None) or no rollups: raw-scan fallback
+            return self._top_from_agg(rows, window)
+        return self._top_once_raw(coll, window)
+
+    def _top_from_agg(self, rows, window: float) -> str:
+        def is_gauge(name: str) -> bool:
+            return self._is_gauge_name(name)
+
+        counters: Dict[tuple, float] = {}
+        gauges: Dict[tuple, tuple] = {}
+        nsamples = 0
+        for r in rows:
+            if r.count == 0 and not r.last_ts:
+                continue
+            nsamples += r.count
+            key = (r.name, r.tags.get("class", ""),
+                   r.tags.get("node", ""))
+            if is_gauge(r.name):
+                cur = gauges.get(key)
+                if cur is None or r.last_ts >= cur[0]:
+                    gauges[key] = (r.last_ts, r.last)
+            elif r.count:
+                counters[key] = counters.get(key, 0.0) + r.vsum
+        return self._render_top(counters, gauges, window, nsamples,
+                                source="aggQuery rollups")
+
+    def _top_once_raw(self, coll: str, window: float) -> str:
         import json as _json
         import time as _time
 
@@ -496,32 +548,42 @@ class AdminCli:
         rsp = RpcClient().call(
             (host, int(port)), COLLECTOR_SERVICE_ID, 2,
             QueryReq(since=since, limit=100000), SampleBatch)
-        def is_gauge(name: str) -> bool:
-            # ValueRecorder names (last-value semantics): the memory
-            # observability set + the pre-existing gauge families.
-            # Everything else reports per-window deltas (counters).
-            return name.startswith(("mem.", "memory.", "mgmtd.",
-                                    "storage.disk_info",
-                                    "storage.allocate")) \
-                or name in ("kvcache.dirty_bytes", "kvcache.host_bytes",
-                            "kvcache.leases", "dataload.buffered_bytes",
-                            "qos.queue_depth", "ec.rebuild_mibps",
-                            "ec.encode_gibps", "tenant.kvcache_bytes")
-
         counters: Dict[tuple, float] = {}
         gauges: Dict[tuple, tuple] = {}
         for s in rsp.samples:
             tags = s.tags if isinstance(s.tags, dict) else _json.loads(
                 s.tags or "{}")
             key = (s.name, tags.get("class", ""), tags.get("node", ""))
-            if is_gauge(s.name):
+            if self._is_gauge_name(s.name):
                 cur = gauges.get(key)
                 if cur is None or s.ts >= cur[0]:
                     gauges[key] = (s.ts, s.value)
             else:
                 counters[key] = counters.get(key, 0.0) + s.value
+        return self._render_top(counters, gauges, window,
+                                len(rsp.samples), source="raw samples")
+
+    @staticmethod
+    def _is_gauge_name(name: str) -> bool:
+        # ValueRecorder names (last-value semantics): the memory
+        # observability set + the pre-existing gauge families.
+        # Everything else reports per-window deltas (counters).
+        return name.startswith(("mem.", "memory.", "mgmtd.", "monitor.agg",
+                                "monitor.retained", "monitor.ingest",
+                                "slo.rules_firing", "slo.health",
+                                "storage.disk_info",
+                                "storage.allocate")) \
+            or name in ("kvcache.dirty_bytes", "kvcache.host_bytes",
+                        "kvcache.leases", "dataload.buffered_bytes",
+                        "qos.queue_depth", "ec.rebuild_mibps",
+                        "ec.encode_gibps", "tenant.kvcache_bytes",
+                        "usrbio.agent_depth")
+
+    def _render_top(self, counters: Dict[tuple, float],
+                    gauges: Dict[tuple, tuple], window: float,
+                    nsamples: int, *, source: str) -> str:
         lines = [f"cluster top  (window {window:.0f}s, "
-                 f"{len(rsp.samples)} samples)"]
+                 f"{nsamples} samples, {source})"]
         qos = [(k, v) for k, v in counters.items()
                if k[0] in ("qos.admitted", "qos.shed")]
         if qos:
@@ -611,33 +673,55 @@ class AdminCli:
             return ("usage: tenant-top --collector <host:port> "
                     "[--window SEC]")
         window = float(self._flag(args, "--window", 60))
-        host, port = coll.rsplit(":", 1)
-        rsp = RpcClient().call(
-            (host, int(port)), COLLECTOR_SERVICE_ID, 2,
-            QueryReq(name_prefix="tenant.", since=_time.time() - window,
-                     limit=100000), SampleBatch)
         counters: Dict[tuple, float] = {}
         waits: Dict[str, float] = {}
         kv: Dict[str, tuple] = {}
-        for s in rsp.samples:
-            tags = s.tags if isinstance(s.tags, dict) else _json.loads(
-                s.tags or "{}")
-            tenant = tags.get("tenant", "-")
-            if s.name == "tenant.queue_wait_us":
-                waits[tenant] = max(waits.get(tenant, 0.0), s.p99)
-            elif s.name == "tenant.kvcache_bytes":
-                cur = kv.get(tenant)
-                if cur is None or s.ts >= cur[0]:
-                    kv[tenant] = (s.ts, s.value)
-            else:
-                key = (s.name, tenant, tags.get("kind", ""))
-                counters[key] = counters.get(key, 0.0) + s.value
+        nsamples = 0
+        agg_rows = self._agg_rows(coll, window, prefix="tenant.")
+        if agg_rows:  # empty/None: raw-scan fallback below
+            # preferred path: the collector's windowed rollups (exactly
+            # what the SLO engine judges; no raw-row scan)
+            for r in agg_rows:
+                if r.count == 0:
+                    continue
+                nsamples += r.count
+                tenant = r.tags.get("tenant", "-")
+                if r.name == "tenant.queue_wait_us":
+                    waits[tenant] = max(waits.get(tenant, 0.0), r.p99)
+                elif r.name == "tenant.kvcache_bytes":
+                    cur = kv.get(tenant)
+                    if cur is None or r.last_ts >= cur[0]:
+                        kv[tenant] = (r.last_ts, r.last)
+                else:
+                    key = (r.name, tenant, r.tags.get("kind", ""))
+                    counters[key] = counters.get(key, 0.0) + r.vsum
+        else:  # old collector: raw-sample scan fallback
+            host, port = coll.rsplit(":", 1)
+            rsp = RpcClient().call(
+                (host, int(port)), COLLECTOR_SERVICE_ID, 2,
+                QueryReq(name_prefix="tenant.",
+                         since=_time.time() - window,
+                         limit=100000), SampleBatch)
+            nsamples = len(rsp.samples)
+            for s in rsp.samples:
+                tags = s.tags if isinstance(s.tags, dict) else _json.loads(
+                    s.tags or "{}")
+                tenant = tags.get("tenant", "-")
+                if s.name == "tenant.queue_wait_us":
+                    waits[tenant] = max(waits.get(tenant, 0.0), s.p99)
+                elif s.name == "tenant.kvcache_bytes":
+                    cur = kv.get(tenant)
+                    if cur is None or s.ts >= cur[0]:
+                        kv[tenant] = (s.ts, s.value)
+                else:
+                    key = (s.name, tenant, tags.get("kind", ""))
+                    counters[key] = counters.get(key, 0.0) + s.value
         tenants = sorted({k[1] for k in counters}
                          | set(waits) | set(kv))
         if not tenants:
             return f"no tenant samples in the last {window:.0f}s"
         lines = [f"tenant top  (window {window:.0f}s, "
-                 f"{len(rsp.samples)} samples)",
+                 f"{nsamples} samples)",
                  f"  {'TENANT':<16} {'ADMIT/s':>9} {'SHED/s':>8} "
                  f"{'by-kind':<26} {'GiB/s':>8} {'QWAITp99':>10} "
                  f"{'KV_RES':>10}"]
@@ -657,6 +741,149 @@ class AdminCli:
                 f"{shed_total / window:>8.1f} {by_kind:<26} "
                 f"{gib:>8.4f} {wait_ms:>9.2f}ms {kres:>10}")
         return "\n".join(lines)
+
+    # -- SLO engine + flight recorder (tpu3fs/monitor/slo.py, flight.py;
+    # docs/slo.md) -----------------------------------------------------------
+    def _collector_flag(self, args: List[str]) -> str:
+        coll = self._flag(args, "--collector") or (
+            args[0] if args and not args[0].startswith("--")
+            and ":" in args[0] else None)
+        if not coll:
+            raise ValueError("--collector <host:port> is required")
+        return coll
+
+    def _slo_status(self, coll: str):
+        from tpu3fs.monitor.slo import SloGate
+
+        return SloGate(coll).status()
+
+    def cmd_slo(self, args: List[str]) -> str:
+        """SLO rule engine control (monitor/slo.py):
+        slo show --collector HOST:PORT — rules + live states
+        slo set --collector HOST:PORT --spec "rule=...;..." — validate,
+                then hot-push the [slo] section through the collector's
+                core hotUpdateConfig RPC (the collector boots one-phase;
+                --spec default pushes slo.DEFAULT_CLUSTER_SPEC)
+        slo clear --collector HOST:PORT — push an empty rule set"""
+        from tpu3fs.monitor.slo import DEFAULT_CLUSTER_SPEC, parse_slo_spec
+
+        if not args:
+            return "usage: slo show|set|clear --collector host:port ..."
+        sub, rest = args[0], args[1:]
+        if sub in ("set", "clear"):
+            spec = "" if sub == "clear" else self._flag(rest, "--spec", "")
+            if spec == "default":
+                spec = DEFAULT_CLUSTER_SPEC
+            rules = parse_slo_spec(spec)  # validate BEFORE pushing
+            coll = self._collector_flag(rest)
+            from tpu3fs.rpc.net import RpcClient
+            from tpu3fs.rpc.services import (
+                CORE_SERVICE_ID,
+                Empty,
+                StrReply,
+            )
+
+            content = self._merge_section_toml("", "slo", {"spec": spec})
+            host, port = coll.rsplit(":", 1)
+            RpcClient().call((host, int(port)), CORE_SERVICE_ID, 3,
+                             StrReply(content), Empty)
+            return (f"pushed {len(rules)} slo rule(s) to collector "
+                    f"{coll} (engine reconfigured live; same-named "
+                    f"rules keep their alert state)")
+        if sub == "show":
+            return self.cmd_slo_show(rest)
+        return "usage: slo show|set|clear --collector host:port ..."
+
+    def cmd_slo_show(self, args: List[str]) -> str:
+        """slo-show --collector HOST:PORT: every rule with its condition,
+        alert state and last observed value."""
+        rsp = self._slo_status(self._collector_flag(args))
+        if not rsp.rules:
+            return f"verdict {rsp.verdict}: no slo rules configured"
+        lines = [f"verdict {rsp.verdict}"
+                 + (f"  (firing: {', '.join(rsp.firing)})"
+                    if rsp.firing else ""),
+                 f"{'RULE':<18} {'SEV':<9} {'STATE':<8} {'VALUE':>12} "
+                 f"{'FIRED':>5}  CONDITION"]
+        for r in rsp.rules:
+            lines.append(
+                f"{r.rule:<18} {r.severity:<9} {r.state:<8} "
+                f"{r.value:>12.6g} {r.fired_count:>5}  {r.bound}"
+                + (f"  [{r.message}]" if r.message and r.state != "ok"
+                   else ""))
+        return "\n".join(lines)
+
+    def cmd_alerts(self, args: List[str]) -> str:
+        """alerts --collector HOST:PORT: firing rules + the recent
+        alert state-machine transitions (newest last)."""
+        rsp = self._slo_status(self._collector_flag(args))
+        lines = [f"verdict {rsp.verdict}: "
+                 f"{len(rsp.firing)} firing"
+                 + (f" ({', '.join(rsp.firing)})" if rsp.firing else "")]
+        for t in rsp.transitions:
+            lines.append(f"  {t.ts:.3f} {t.rule} -> {t.transition} "
+                         f"value={t.value:g}"
+                         + (f" ({t.message})" if t.message else ""))
+        if len(lines) == 1:
+            lines.append("  (no transitions recorded)")
+        return "\n".join(lines)
+
+    def cmd_health(self, args: List[str]) -> str:
+        """health --collector HOST:PORT: the single cluster verdict —
+        OK / DEGRADED / CRITICAL, naming the firing rules."""
+        rsp = self._slo_status(self._collector_flag(args))
+        if rsp.verdict == "OK":
+            return f"OK ({len(rsp.rules)} rules clean)"
+        firing = [r for r in rsp.rules if r.state == "firing"]
+        detail = "; ".join(
+            f"{r.rule}: {r.message or r.bound}" for r in firing)
+        return f"{rsp.verdict}: {detail}"
+
+    def cmd_flight_dump(self, args: List[str]) -> str:
+        """Dump a process's flight-recorder black box to disk:
+        flight-dump --addr HOST:PORT [--path P] — any service binary,
+                    via its core flightDump RPC
+        flight-dump --local [--path P] — THIS process's ring"""
+        path = self._flag(args, "--path", "")
+        if "--local" in args:
+            from tpu3fs.monitor.flight import flight
+
+            out = flight().dump(path or None, reason="admin_cli")
+            return (f"dumped {len(flight().snapshot())} events to {out}"
+                    if out else "no flight dir configured (use --path)")
+        addr = self._flag(args, "--addr") or (
+            args[0] if args and not args[0].startswith("--") else None)
+        if not addr:
+            return ("usage: flight-dump (--addr <host:port> | --local) "
+                    "[--path P]")
+        from tpu3fs.rpc.net import RpcClient
+        from tpu3fs.rpc.services import (
+            CORE_SERVICE_ID,
+            FlightDumpReq,
+            FlightDumpRsp,
+        )
+
+        host, port = addr.rsplit(":", 1)
+        rsp = RpcClient().call((host, int(port)), CORE_SERVICE_ID, 7,
+                               FlightDumpReq(path=path), FlightDumpRsp)
+        if not rsp.path:
+            return (f"{addr}: ring holds {rsp.events} events but no "
+                    "flight dir is configured (pass --path)")
+        return f"{addr}: dumped {rsp.events} events to {rsp.path}"
+
+    def cmd_flight_show(self, args: List[str]) -> str:
+        """flight-show --dir D[,D...]: merge N processes' flight dumps
+        into one timeline (alerts, config pushes) + the slowest
+        cross-process span trees rebuilt from the dumped slow-op
+        spans."""
+        from tpu3fs.analytics import assemble
+
+        spec = self._flag(args, "--dir") or (
+            args[0] if args and not args[0].startswith("--") else None)
+        if not spec:
+            return "usage: flight-show --dir <dump-dir[,dump-dir...]>"
+        rows = assemble.load_flight(spec.split(","))
+        return assemble.format_flight(rows)
 
     def cmd_ec_status(self, args: List[str]) -> str:
         """Per-EC-chain health: shard -> target/state map, degraded

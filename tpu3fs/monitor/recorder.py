@@ -201,7 +201,8 @@ class Monitor:
             self._recorders.append(weakref.ref(rec))
 
     def add_sink(self, sink) -> None:
-        self._sinks.append(sink)
+        if sink not in self._sinks:  # re-registration must not double-write
+            self._sinks.append(sink)
 
     def collect(self) -> List[Sample]:
         now = time.time()
@@ -310,6 +311,77 @@ class SqliteSink:
         with self._lock, self._connect() as db:
             db.executemany(
                 "INSERT INTO samples VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows)
+
+    def db_bytes(self) -> int:
+        """On-disk footprint (main db + WAL), the retained-bytes gauge."""
+        import os
+
+        total = 0
+        for suffix in ("", "-wal", "-shm"):
+            try:
+                total += os.path.getsize(self._path + suffix)
+            except OSError:
+                pass
+        return total
+
+    def compact(self, retention_s: float = 0.0,
+                max_bytes: int = 0) -> int:
+        """Age/size-capped retention pass: drop raw rows older than the
+        retention horizon (they're already rolled up in the collector's
+        windowed aggregator), then, while the db still exceeds
+        ``max_bytes``, drop the oldest remaining rows in slices. Returns
+        rows removed. 0 on either knob disables that axis."""
+        import time as _time
+
+        removed = 0
+        with self._lock, self._connect() as db:
+            if retention_s and retention_s > 0:
+                cur = db.execute("DELETE FROM samples WHERE ts < ?",
+                                 (_time.time() - retention_s,))
+                removed += cur.rowcount
+        if removed:
+            self._reclaim()
+        if max_bytes and max_bytes > 0:
+            # size cap: estimate the over-budget row fraction, delete
+            # that many OLDEST rows, reclaim, re-check — bounded passes
+            # so a misconfigured tiny cap can't loop forever
+            for _ in range(6):
+                cur_bytes = self.db_bytes()
+                if cur_bytes <= max_bytes:
+                    break
+                with self._lock, self._connect() as db:
+                    n = db.execute(
+                        "SELECT COUNT(*) FROM samples").fetchone()[0]
+                    if n == 0:
+                        break
+                    frac = 1.0 - max_bytes / cur_bytes
+                    k = min(n, max(n // 8, int(n * frac)))
+                    row = db.execute(
+                        "SELECT ts FROM samples ORDER BY ts LIMIT 1"
+                        " OFFSET ?", (k,)).fetchone()
+                    if row is None:
+                        c = db.execute("DELETE FROM samples")
+                    else:
+                        c = db.execute(
+                            "DELETE FROM samples WHERE ts < ?",
+                            (row[0],))
+                    removed += c.rowcount
+                    if c.rowcount == 0:
+                        break
+                self._reclaim()
+        return removed
+
+    def _reclaim(self) -> None:
+        """DELETE leaves pages free inside the file; checkpoint + VACUUM
+        so the retained-bytes gauge (and the disk) actually shrink."""
+        with self._lock:
+            db = self._connect()
+            try:
+                db.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+                db.isolation_level = None  # VACUUM needs autocommit
+                db.execute("VACUUM")
+            finally:
+                db.close()
 
     def query(self, name_prefix: str = "", since: float = 0.0,
               until: float = 0.0, limit: int = 1000) -> List[Sample]:

@@ -101,6 +101,7 @@ CLASSIFICATION: Dict[Tuple[str, str], str] = {
     ("Core", "shutdown"): MUTATING,
     ("Core", "getConfig"): IDEMPOTENT,
     ("Core", "getLastConfigUpdateRecord"): IDEMPOTENT,
+    ("Core", "flightDump"): MUTATING,   # writes a dump file per call
     # -- Kv ---------------------------------------------------------------
     ("Kv", "snapshot"): MUTATING,   # allocates a read-snapshot lease
     ("Kv", "get"): IDEMPOTENT,
@@ -116,6 +117,10 @@ CLASSIFICATION: Dict[Tuple[str, str], str] = {
     # -- MonitorCollector -------------------------------------------------
     ("MonitorCollector", "write"): MUTATING,   # double-counts samples
     ("MonitorCollector", "query"): IDEMPOTENT,
+    ("MonitorCollector", "aggQuery"): IDEMPOTENT,
+    # sloStatus may run an evaluation pass, but evaluation is a pure
+    # function of (rules, aggregates, clock) — replaying it is safe
+    ("MonitorCollector", "sloStatus"): IDEMPOTENT,
     # -- SimpleExample ----------------------------------------------------
     ("SimpleExample", "write"): MUTATING,
     ("SimpleExample", "read"): IDEMPOTENT,

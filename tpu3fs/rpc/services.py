@@ -146,6 +146,17 @@ class EchoRsp:
 
 
 @dataclass
+class FlightDumpReq:
+    path: str = ""      # "" = the process's configured flight.dir
+
+
+@dataclass
+class FlightDumpRsp:
+    path: str = ""      # "" = no dir configured, nothing written
+    events: int = 0     # ring occupancy at dump time
+
+
+@dataclass
 class HeartbeatReq:
     node_id: int
     hb_version: int
@@ -1801,14 +1812,19 @@ def bind_core_service(server: RpcServer, *, config=None, on_shutdown=None) -> No
         if config is not None:
             # config.py's shim: stdlib tomllib on 3.11+, tomli on 3.10
             from tpu3fs.utils.config import tomllib
+            from tpu3fs.monitor.flight import flight
 
             last_update["seq"] += 1
             last_update["time"] = _time.time()
             try:
                 config.hot_update(_flatten(tomllib.loads(req.value)))
                 last_update["ok"], last_update["detail"] = True, ""
+                flight().record("config", ok=True, source="core-rpc",
+                                nbytes=len(req.value))
             except Exception as e:
                 last_update["ok"], last_update["detail"] = False, str(e)
+                flight().record("config", ok=False, source="core-rpc",
+                                error=repr(e))
                 raise
         return Empty()
 
@@ -1829,7 +1845,18 @@ def bind_core_service(server: RpcServer, *, config=None, on_shutdown=None) -> No
             on_shutdown()
         return Empty()
 
+    # flight recorder: dump THIS process's black box to disk on demand
+    # (admin_cli flight-dump; the SLO-breach path rides the collector
+    # Ack dump-epoch instead — see monitor/flight.py)
+    def flight_dump(req: FlightDumpReq) -> FlightDumpRsp:
+        from tpu3fs.monitor.flight import flight
+
+        fl = flight()
+        path = fl.dump(req.path or None, reason="flightDump rpc")
+        return FlightDumpRsp(path=path, events=len(fl.snapshot()))
+
     s.method(4, "shutdown", Empty, Empty, shutdown)
+    s.method(7, "flightDump", FlightDumpReq, FlightDumpRsp, flight_dump)
     server.add_service(s)
 
 

@@ -1377,7 +1377,17 @@ class StorageService:
             return [ReadReply(shed_code, retry_after_ms=shed_ms)
                     for _ in reqs]
         try:
-            return self._batch_read_impl(reqs, views=views)
+            # the batch path is THE served read path (PR 3) — its wall
+            # must land in storage.read.latency_us like single reads,
+            # or the SLO engine (and trace-top) judge a path nobody
+            # runs. One distribution record per op of the batch: each
+            # op genuinely experienced the batch's wall.
+            t0 = time.perf_counter()
+            out = self._batch_read_impl(reqs, views=views)
+            dt_us = (time.perf_counter() - t0) * 1e6
+            for _ in reqs:
+                self._read_rec.latency.record(dt_us)
+            return out
         finally:
             if lease is not None:
                 lease.release()

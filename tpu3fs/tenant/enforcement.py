@@ -109,6 +109,7 @@ ENFORCEMENT: Dict[Tuple[str, str], str] = {
     ("Core", "shutdown"): EXEMPT,
     ("Core", "getConfig"): EXEMPT,
     ("Core", "getLastConfigUpdateRecord"): EXEMPT,
+    ("Core", "flightDump"): EXEMPT,
     ("Kv", "snapshot"): EXEMPT,
     ("Kv", "get"): EXEMPT,
     ("Kv", "getRange"): EXEMPT,
@@ -121,6 +122,8 @@ ENFORCEMENT: Dict[Tuple[str, str], str] = {
     ("KvRepl", "reconfig"): EXEMPT,
     ("MonitorCollector", "write"): EXEMPT,   # every binary's own push loop
     ("MonitorCollector", "query"): EXEMPT,
+    ("MonitorCollector", "aggQuery"): EXEMPT,   # operator/SLO surface
+    ("MonitorCollector", "sloStatus"): EXEMPT,
     # -- SimpleExample ----------------------------------------------------
     ("SimpleExample", "write"): BYTES,
     ("SimpleExample", "read"): BYTES,
