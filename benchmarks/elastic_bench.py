@@ -1,0 +1,213 @@
+"""elastic_bench: foreground latency under live cluster reshaping.
+
+The elasticity acceptance shape (ISSUE 13): one in-process fabric under a
+paced foreground writer+reader, measured through three segments —
+
+- STEADY: baseline fg latency distribution, no reshaping;
+- REBALANCE: a node joins and a MigrationWorker executes the planner's
+  minimal diff live (full-chunk copies under the ``migration`` QoS class,
+  which schedules behind foreground at the class's WFQ share) while the
+  fg load keeps running — fg p99 during vs steady is THE number;
+- DRAIN: a node is drained to zero chains (cli-equivalent plan+apply),
+  wall-clocked, with every oracle byte re-verified after.
+
+Prints ONE JSON line (bench.py conventions):
+  {"metric": "elastic_fg_p99_ratio", "value": <rebalance p99/steady p99>,
+   "steady_p99_ms": ..., "rebalance_p99_ms": ..., "drain_wall_s": ...,
+   "migration_gibps": ..., "moves": ..., "drain_moves": ...,
+   "bytes_moved": ..., "verified_chunks": ...}
+
+Acceptance (BENCH_ELASTIC.json): fg p99 during rebalance <= 3x steady on
+this GIL-shared single-host harness, zero lost/corrupt bytes after the
+drain, drained node at zero chains.
+
+Usage: python -m benchmarks.elastic_bench [--seconds 4] [--chains 8]
+           [--chunks 96] [--size 65536] [--json-out BENCH_ELASTIC.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from typing import Dict, List
+
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.migration import MigrationWorker
+from tpu3fs.placement import TopologyDelta, check_plan, plan_rebalance
+from tpu3fs.qos.core import QosConfig
+from tpu3fs.storage.types import ChunkId
+
+
+def _pct(xs: List[float], p: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+
+class _FgLoad:
+    """Paced foreground writer+reader; per-segment latency capture."""
+
+    def __init__(self, fab: Fabric, chains: List[int], size: int):
+        self._client = fab.storage_client()
+        self._chains = chains
+        self._payload = b"\xa5" * size
+        self._size = size
+        self._stop = threading.Event()
+        self._segment = "warmup"
+        self._lat: Dict[str, List[float]] = {}
+        self._seq = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def segment(self, name: str):
+        self._segment = name
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()
+
+    def p99_ms(self, name: str) -> float:
+        return _pct(self._lat.get(name, []), 0.99) * 1e3
+
+    def ops(self, name: str) -> int:
+        return len(self._lat.get(name, []))
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._seq += 1
+            chain = self._chains[self._seq % len(self._chains)]
+            cid = ChunkId(7_000_000, self._seq % 64)
+            t0 = time.perf_counter()
+            w = self._client.write_chunk(chain, cid, 0, self._payload,
+                                         chunk_size=self._size)
+            r = self._client.read_chunk(chain, cid)
+            dt = time.perf_counter() - t0
+            if w.ok and r.ok:
+                self._lat.setdefault(self._segment, []).append(dt)
+            time.sleep(0.002)  # paced: the victim rhythm, not a flood
+
+
+def _drive_jobs(fab: Fabric, worker: MigrationWorker,
+                budget_s: float = 120.0) -> float:
+    """Run worker + elasticity ticks until all jobs settle; -> wall s."""
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        worker.run_once()
+        fab.elastic_tick(resync=False)
+        if not any(j.active for j in fab.mgmtd.migration_list()):
+            return time.perf_counter() - t0
+        time.sleep(0.01)
+    raise TimeoutError("migration jobs did not settle in budget")
+
+
+def run_bench(*, seconds: float = 4.0, nodes: int = 3, chains: int = 8,
+              replicas: int = 2, chunks: int = 96, size: int = 65536) -> dict:
+    fab = Fabric(SystemSetupConfig(
+        num_storage_nodes=nodes, num_chains=chains, num_replicas=replicas,
+        chunk_size=size, qos=QosConfig()))
+    try:
+        client = fab.storage_client()
+        oracle = {}
+        for c, chain in enumerate(fab.chain_ids):
+            for i in range(chunks):
+                data = bytes([(c * 31 + i) % 251 + 1]) * size
+                assert client.write_chunk(chain, ChunkId(9000 + c, i), 0,
+                                          data, chunk_size=size).ok
+                oracle[(chain, 9000 + c, i)] = data
+
+        load = _FgLoad(fab, fab.chain_ids, size)
+        load.start()
+        seg = max(seconds / 2, 0.5)
+        time.sleep(min(0.3, seg / 4))  # warmup
+        load.segment("steady")
+        time.sleep(seg)
+
+        # REBALANCE: join a node live under load
+        nid = fab.add_storage_node()
+        delta = TopologyDelta.from_routing(fab.routing())
+        plan = plan_rebalance(fab.routing(), delta)
+        assert check_plan(fab.routing(), plan, delta) == []
+        fab.mgmtd.migration_submit([m.spec() for m in plan.moves])
+        worker = MigrationWorker(fab.mgmtd, fab.storage_client(),
+                                 worker_id="bench-w", batch_chunks=4)
+        load.segment("rebalance")
+        t0 = time.perf_counter()
+        _drive_jobs(fab, worker)
+        rebalance_wall = time.perf_counter() - t0
+        bytes_moved = sum(j.copied_bytes
+                          for j in fab.mgmtd.migration_list())
+        load.segment("post")
+        time.sleep(min(0.3, seg / 4))
+        load.stop()
+
+        # DRAIN: empty the first node, wall-clocked (no fg timing needed)
+        drained = sorted(fab.nodes)[0]
+        fab.mgmtd.set_node_tags(drained, {"draining": "1"})
+        delta2 = TopologyDelta.from_routing(fab.routing())
+        plan2 = plan_rebalance(fab.routing(), delta2)
+        assert check_plan(fab.routing(), plan2, delta2) == []
+        fab.mgmtd.migration_submit([m.spec() for m in plan2.moves])
+        drain_wall = _drive_jobs(fab, worker)
+        hosting = [t for t in fab.routing().targets.values()
+                   if t.chain_id and t.node_id == drained]
+        assert hosting == [], f"node {drained} still hosts {len(hosting)}"
+        drain_bytes = sum(j.copied_bytes
+                          for j in fab.mgmtd.migration_list()) - bytes_moved
+
+        # byte-verify the oracle: zero lost/corrupt bytes through both
+        verifier = fab.storage_client()
+        for (chain, fid, i), data in oracle.items():
+            rep = verifier.read_chunk(chain, ChunkId(fid, i))
+            assert rep.ok and bytes(rep.data) == data, (chain, fid, i)
+
+        steady = load.p99_ms("steady")
+        rebal = load.p99_ms("rebalance")
+        moved_total = bytes_moved + drain_bytes
+        gibps = (bytes_moved / max(rebalance_wall, 1e-9)) / (1 << 30)
+        return {
+            "metric": "elastic_fg_p99_ratio",
+            "value": round(rebal / steady, 3) if steady else 0.0,
+            "steady_p99_ms": round(steady, 3),
+            "rebalance_p99_ms": round(rebal, 3),
+            "steady_ops": load.ops("steady"),
+            "rebalance_ops": load.ops("rebalance"),
+            "rebalance_wall_s": round(rebalance_wall, 3),
+            "drain_wall_s": round(drain_wall, 3),
+            "migration_gibps": round(gibps, 4),
+            "moves": len(plan.moves),
+            "drain_moves": len(plan2.moves),
+            "bytes_moved": moved_total,
+            "verified_chunks": len(oracle),
+        }
+    finally:
+        fab.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=4.0)
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--chains", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--chunks", type=int, default=96)
+    ap.add_argument("--size", type=int, default=65536)
+    ap.add_argument("--json-out", default="")
+    args = ap.parse_args()
+    row = run_bench(seconds=args.seconds, nodes=args.nodes,
+                    chains=args.chains, replicas=args.replicas,
+                    chunks=args.chunks, size=args.size)
+    line = json.dumps(row)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

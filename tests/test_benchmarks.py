@@ -269,3 +269,22 @@ class TestEcBench:
         r = by["ec_rebuild_3_1"]
         assert r["installed"] >= 6
         assert r["sources_spread_ok"]
+
+
+class TestElasticBench:
+    """benchmarks/elastic_bench fast-mode smoke: join-rebalance under a
+    live fg load, drain-to-zero, byte verification — the measured claims
+    live in BENCH_ELASTIC.json."""
+
+    def test_small_run(self):
+        from benchmarks.elastic_bench import run_bench
+
+        row = run_bench(seconds=1.0, nodes=3, chains=2, replicas=2,
+                        chunks=4, size=4096)
+        assert row["moves"] >= 1 and row["drain_moves"] >= 1
+        assert row["bytes_moved"] > 0
+        assert row["verified_chunks"] == 8  # every oracle byte re-read
+        assert row["steady_ops"] > 0 and row["rebalance_ops"] > 0
+        assert row["drain_wall_s"] > 0
+        # no latency acceptance at smoke scale; BENCH_ELASTIC.json
+        # carries the measured fg-p99-under-rebalance claim

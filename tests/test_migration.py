@@ -1,0 +1,299 @@
+"""MigrationWorker lifecycle on the in-process fabric: CR copy moves,
+quorum preservation at every intermediate step, crash-resume (worker and
+destination), EC shard-swap rebuild moves, drain via the CLI, and the
+trash-route retirement pass (ISSUE 13 crash matrix)."""
+
+import pytest
+
+from tpu3fs.cli import AdminCli
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.migration import (
+    JobPhase,
+    MigrationWorker,
+    MoveSpec,
+)
+from tpu3fs.mgmtd.types import PublicTargetState
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils.result import Code
+
+
+def _write_oracle(fab, per_chain=4, size=512, tag=0):
+    client = fab.storage_client()
+    oracle = {}
+    for c, chain in enumerate(fab.chain_ids):
+        for i in range(per_chain):
+            data = bytes([(tag + c * 16 + i) % 256]) * size
+            r = client.write_chunk(chain, ChunkId(100 + c, i), 0, data,
+                                   chunk_size=4096)
+            assert r.ok, (chain, i, r)
+            oracle[(chain, 100 + c, i)] = data
+    return oracle
+
+
+def _verify_oracle(fab, oracle):
+    client = fab.storage_client()
+    for (chain, fid, i), data in oracle.items():
+        rep = client.read_chunk(chain, ChunkId(fid, i))
+        assert rep.ok, (chain, fid, i, rep.code)
+        assert bytes(rep.data) == data, (chain, fid, i)
+
+
+def _worker(fab, wid="w1", **kw):
+    return MigrationWorker(fab.mgmtd, fab.storage_client(),
+                           worker_id=wid, **kw)
+
+
+class TestCrMove:
+    def test_join_move_end_to_end_worker_copies(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=3,
+                                       num_replicas=2, chunk_size=4096))
+        oracle = _write_oracle(fab)
+        nid = fab.add_storage_node()
+        cid = fab.chain_ids[0]
+        out = fab.routing().chains[cid].targets[0].target_id
+        fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=cid, out_target=out, dst_node=nid)])
+        w = _worker(fab)
+        # resync=False: the WORKER moves every byte (migration class)
+        done = w.run_until_idle(
+            tick=lambda: fab.elastic_tick(resync=False), rounds=60)
+        assert done == 1
+        job = fab.mgmtd.migration_list()[0]
+        assert job.phase == JobPhase.DONE
+        assert job.copied_chunks == 4 and job.copied_bytes == 4 * 512
+        chain = fab.routing().chains[cid]
+        ids = [t.target_id for t in chain.targets]
+        assert out not in ids and job.new_target in ids
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in chain.targets)
+        _verify_oracle(fab, oracle)
+
+    def test_quorum_never_dips_and_fg_writes_land_mid_move(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=2,
+                                       num_replicas=2, chunk_size=4096))
+        _write_oracle(fab)
+        nid = fab.add_storage_node()
+        cid = fab.chain_ids[0]
+        out = fab.routing().chains[cid].targets[0].target_id
+        fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=cid, out_target=out, dst_node=nid)])
+        w = _worker(fab)
+        client = fab.storage_client()
+        late = {}
+        for round_no in range(40):
+            w.run_once()
+            fab.elastic_tick(resync=False)
+            # invariant: at EVERY intermediate step each chain keeps at
+            # least its nominal serving width (the old member stays until
+            # the new one serves)
+            for chain in fab.routing().chains.values():
+                serving = sum(1 for t in chain.targets
+                              if t.public_state == PublicTargetState.SERVING)
+                assert serving >= 2, (round_no, chain.chain_id, serving)
+            # foreground writes keep landing THROUGH the move
+            data = bytes([round_no % 256]) * 64
+            r = client.write_chunk(cid, ChunkId(200, round_no), 0, data,
+                                   chunk_size=4096)
+            assert r.ok, (round_no, r.code)
+            late[round_no] = data
+            if not any(j.active for j in fab.mgmtd.migration_list()):
+                break
+        assert fab.mgmtd.migration_list()[0].phase == JobPhase.DONE
+        fab.retire_unassigned_targets()
+        c2 = fab.storage_client()
+        for i, data in late.items():
+            rep = c2.read_chunk(cid, ChunkId(200, i))
+            assert rep.ok and bytes(rep.data) == data
+
+    def test_pure_capacity_add(self):
+        """out_target=0 widens the chain (replication bump) — no cutover."""
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=2, num_chains=1,
+                                       num_replicas=2, chunk_size=4096))
+        oracle = _write_oracle(fab, per_chain=3)
+        nid = fab.add_storage_node()
+        cid = fab.chain_ids[0]
+        fab.mgmtd.migration_submit([MoveSpec(chain_id=cid, dst_node=nid)])
+        w = _worker(fab)
+        assert w.run_until_idle(
+            tick=lambda: fab.elastic_tick(resync=False), rounds=60) == 1
+        chain = fab.routing().chains[cid]
+        assert len(chain.targets) == 3
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in chain.targets)
+        _verify_oracle(fab, oracle)
+
+
+class TestCrashResume:
+    def test_worker_killed_mid_plan_second_worker_resumes(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=2,
+                                       num_replicas=2, chunk_size=4096))
+        oracle = _write_oracle(fab, per_chain=6)
+        nid = fab.add_storage_node()
+        specs = []
+        for cid in fab.chain_ids:
+            out = fab.routing().chains[cid].targets[0].target_id
+            specs.append(MoveSpec(chain_id=cid, out_target=out,
+                                  dst_node=nid))
+        fab.mgmtd.migration_submit(specs)
+        w1 = _worker(fab, "w1", batch_chunks=2, lease_s=20)
+        # advance PARTWAY: prepare + a couple of copy batches, then "die"
+        for _ in range(4):
+            w1.run_once()
+            fab.elastic_tick(resync=False)
+        mid = {j.job_id: JobPhase(j.phase)
+               for j in fab.mgmtd.migration_list()}
+        assert any(p in (JobPhase.PREPARED, JobPhase.COPYING, JobPhase.SYNCED)
+                   for p in mid.values())
+        # w1 vanishes (SIGKILL analogue): claims lapse after lease_s
+        fab.clock.advance(21)
+        w2 = _worker(fab, "w2", batch_chunks=2, lease_s=20)
+        done = w2.run_until_idle(
+            tick=lambda: fab.elastic_tick(resync=False), rounds=80)
+        assert done == len(fab.chain_ids)
+        for chain in fab.routing().chains.values():
+            assert all(t.public_state == PublicTargetState.SERVING
+                       for t in chain.targets)
+        _verify_oracle(fab, oracle)
+        # a zombie w1 waking up cannot clobber w2's finished jobs
+        jobs = fab.mgmtd.migration_list()
+        w1.run_once()
+        assert [(j.job_id, j.phase) for j in fab.mgmtd.migration_list()] \
+            == [(j.job_id, j.phase) for j in jobs]
+
+    def test_destination_node_killed_mid_copy(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=1,
+                                       num_replicas=2, chunk_size=4096,
+                                       heartbeat_timeout_s=30))
+        oracle = _write_oracle(fab, per_chain=6)
+        nid = fab.add_storage_node()
+        cid = fab.chain_ids[0]
+        out = fab.routing().chains[cid].targets[0].target_id
+        fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=cid, out_target=out, dst_node=nid)])
+        w = _worker(fab, batch_chunks=2)
+        # reach COPYING (destination syncing, some chunks landed)
+        for _ in range(3):
+            w.run_once()
+            fab.elastic_tick(resync=False)
+        assert JobPhase(fab.mgmtd.migration_list()[0].phase) in (
+            JobPhase.COPYING, JobPhase.SYNCED)
+        # SIGKILL the destination mid-copy
+        fab.fail_node(nid)
+        for _ in range(3):   # worker parks: transport errors, no crash
+            w.run_once()
+            fab.tick()
+        job = fab.mgmtd.migration_list()[0]
+        assert job.active
+        # bring it back: recovery ladder re-runs, job converges
+        fab.restart_node(nid)
+        done = w.run_until_idle(
+            tick=lambda: fab.elastic_tick(resync=False), rounds=80)
+        assert done == 1
+        chain = fab.routing().chains[cid]
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in chain.targets)
+        _verify_oracle(fab, oracle)
+
+
+class TestEcMove:
+    def test_shard_swap_rebuild_end_to_end(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=4, num_chains=2,
+                                       ec_k=2, ec_m=1, chunk_size=1 << 12))
+        client = fab.storage_client()
+        cid = fab.chain_ids[0]
+        stripes = {}
+        for i in range(4):
+            data = bytes([i + 1]) * (1 << 12)
+            replies = client.write_stripes(cid, [(ChunkId(300, i), data)],
+                                           chunk_size=1 << 12)
+            assert all(r.ok for r in replies)
+            stripes[i] = data
+        nid = fab.add_storage_node()
+        out = fab.routing().chains[cid].preferred_order[1]
+        slot = 1
+        fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=cid, out_target=out, dst_node=nid)])
+        w = _worker(fab)
+        # EC rebuild runs storage-side: elastic_tick with resync=True
+        done = w.run_until_idle(
+            tick=lambda: fab.elastic_tick(resync=True), rounds=80)
+        assert done == 1
+        chain = fab.routing().chains[cid]
+        new_target = chain.preferred_order[slot]
+        assert new_target != out
+        assert fab.routing().targets[new_target].node_id == nid
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in chain.targets)
+        # byte-exact stripes INCLUDING the rebuilt shard
+        c2 = fab.storage_client()
+        for i, data in stripes.items():
+            rep = c2.read_stripe(cid, ChunkId(300, i), chunk_size=1 << 12)
+            assert rep.ok and bytes(rep.data) == data
+
+
+class TestDrainCli:
+    def test_drain_to_zero_chains(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=4, num_chains=4,
+                                       num_replicas=2, chunk_size=4096))
+        oracle = _write_oracle(fab)
+        cli = AdminCli(fab)
+        out = cli.run("drain --node 10 --apply")
+        assert "submitted jobs" in out, out
+        w = _worker(fab)
+        w.run_until_idle(tick=lambda: fab.elastic_tick(resync=False),
+                         rounds=120)
+        ri = fab.routing()
+        hosting = [t for t in ri.targets.values()
+                   if t.chain_id and t.node_id == 10]
+        assert hosting == []
+        fab.retire_unassigned_targets()
+        assert fab.nodes[10].service.targets() == []
+        _verify_oracle(fab, oracle)
+        status = cli.run("migrate-status")
+        assert "DONE" in status and "PENDING" not in status
+
+    def test_drain_refused_below_quorum_rolls_back(self):
+        # 2 nodes, 2 replicas: draining one leaves no destination —
+        # every chain's replacement has nowhere to go
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=2, num_chains=2,
+                                       num_replicas=2, chunk_size=4096))
+        cli = AdminCli(fab)
+        out = cli.run("drain --node 10 --apply")
+        # planner defers every chain (no eligible destination): nothing
+        # submitted, and the draining flag must not stay armed
+        assert "submitted jobs" not in out
+        assert not fab.routing().nodes[10].tags.get("draining")
+
+    def test_drain_refused_when_chain_degraded(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=2,
+                                       ec_k=2, ec_m=1, chunk_size=1 << 12))
+        fab.add_storage_node()
+        # degrade chain 0 (kill a member's node), then drain another node
+        cid = fab.chain_ids[0]
+        victim_node = fab.routing().node_of_target(
+            fab.routing().chains[cid].targets[0].target_id).node_id
+        fab.fail_node(victim_node)
+        other = fab.routing().targets[
+            fab.routing().chains[cid].targets[1].target_id].node_id
+        cli = AdminCli(fab)
+        out = cli.run(f"drain --node {other} --apply")
+        assert "refused" in out and "ROLLED BACK" in out
+        assert not fab.routing().nodes[other].tags.get("draining")
+
+
+class TestRetirePass:
+    def test_unassigned_target_dropped_and_closed(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=1,
+                                       num_replicas=2, chunk_size=4096))
+        _write_oracle(fab, per_chain=2)
+        nid = fab.add_storage_node()
+        cid = fab.chain_ids[0]
+        out = fab.routing().chains[cid].targets[0].target_id
+        out_node = fab.routing().targets[out].node_id
+        fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=cid, out_target=out, dst_node=nid)])
+        w = _worker(fab)
+        w.run_until_idle(tick=lambda: fab.elastic_tick(resync=False),
+                         rounds=60)
+        # elastic_tick already retired it (chain_id=0 in routing)
+        assert fab.nodes[out_node].service.target(out) is None

@@ -568,9 +568,9 @@ class TestBackgroundSelfThrottle:
         assert calls[-1] - calls[0] >= 0.03
 
     def test_migration_pauses_not_fails_on_overload(self):
+        from tpu3fs.client.storage_client import StorageClient
         from tpu3fs.migration.service import JobState, MigrationService
-        from tpu3fs.storage.craq import ReadReply, UpdateReply
-        from tpu3fs.storage.types import ChunkMeta
+        from tpu3fs.storage.craq import UpdateReply
 
         fab = Fabric(SystemSetupConfig(num_storage_nodes=2, num_chains=2,
                                        num_replicas=1, chunk_size=4096))
@@ -582,12 +582,17 @@ class TestBackgroundSelfThrottle:
         real_send = fab.send
 
         def flaky_send(node_id, method, payload):
-            if method == "write" and overloads["n"] > 0:
+            # shed the first write attempts on BOTH the batched path and
+            # the client ladder's single-op fallback
+            if method in ("batch_write", "write") and overloads["n"] > 0:
                 overloads["n"] -= 1
-                return UpdateReply(Code.OVERLOADED, retry_after_ms=10)
+                reply = UpdateReply(Code.OVERLOADED, retry_after_ms=10)
+                return [reply] * len(payload) \
+                    if method == "batch_write" else reply
             return real_send(node_id, method, payload)
 
-        svc = MigrationService(fab.routing, flaky_send)
+        svc = MigrationService(
+            StorageClient("mig-test", fab.routing, flaky_send))
         job_id = svc.start_job(src, dst)
         job = svc.run_job(job_id, batch=8, max_steps=20)
         assert job.state == JobState.DONE

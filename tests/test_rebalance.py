@@ -1,0 +1,335 @@
+"""Rebalance planner invariants + live chain-mutation/job-store coverage
+(ISSUE 13): minimal-diff, quorum preservation, λ tolerance after
+join/drain/dead for CR and EC tables, and solver check_solution parity
+with the reference's validation rules."""
+
+import numpy as np
+import pytest
+
+from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.migration.types import JobPhase, MoveSpec
+from tpu3fs.placement import (
+    PlacementProblem,
+    TopologyDelta,
+    check_plan,
+    check_solution,
+    incidence_of_routing,
+    plan_rebalance,
+    solve_placement,
+)
+from tpu3fs.placement.solver import peer_recovery_traffic
+from tpu3fs.utils.result import Code, FsError
+
+
+def _cr_fabric(nodes=4, chains=8, replicas=2):
+    return Fabric(SystemSetupConfig(
+        num_storage_nodes=nodes, num_chains=chains, num_replicas=replicas))
+
+
+def _ec_fabric(nodes=4, chains=4, k=2, m=1):
+    return Fabric(SystemSetupConfig(
+        num_storage_nodes=nodes, num_chains=chains, ec_k=k, ec_m=m,
+        chunk_size=1 << 12))
+
+
+def _lambda_max(routing, node_ids):
+    M = incidence_of_routing(routing, node_ids)
+    C = M.T.astype(int) @ M.astype(int)
+    np.fill_diagonal(C, 0)
+    return int(C.max()) if C.size else 0
+
+
+class TestPlannerMinimality:
+    def test_noop_delta_empty_plan(self):
+        fab = _cr_fabric()
+        plan = plan_rebalance(fab.routing(), TopologyDelta())
+        assert plan.empty and not plan.deferred_chains
+
+    def test_derived_noop_delta_empty_plan(self):
+        # nothing joined/draining/dead => from_routing derives a no-op
+        fab = _cr_fabric()
+        delta = TopologyDelta.from_routing(fab.routing())
+        assert delta.empty
+        assert plan_rebalance(fab.routing(), delta).empty
+
+    @pytest.mark.parametrize("nodes,chains,replicas", [
+        (4, 8, 2), (3, 6, 3), (5, 10, 2),
+    ])
+    def test_join_one_node_move_bound(self, nodes, chains, replicas):
+        """Joining 1 node to an N-node balanced table moves at most
+        ceil(total_targets/(N+1)) + slack chains (acceptance bound)."""
+        fab = _cr_fabric(nodes, chains, replicas)
+        nid = fab.add_storage_node()
+        delta = TopologyDelta.from_routing(fab.routing())
+        assert delta.joined == [nid]
+        plan = plan_rebalance(fab.routing(), delta)
+        total = chains * replicas
+        bound = -(-total // (nodes + 1)) + 1  # ceil + slack
+        assert 0 < len(plan.moves) <= bound, \
+            f"{len(plan.moves)} moves > bound {bound}"
+        # every move lands on the joined node, one per chain
+        assert all(m.dst_node == nid for m in plan.moves)
+        assert len({m.chain_id for m in plan.moves}) == len(plan.moves)
+        # the joined node ends at its fair share
+        assert plan.after.per_node[nid] == total // (nodes + 1)
+
+    def test_drain_empties_node_exactly(self):
+        fab = _cr_fabric(4, 8, 2)
+        fab.mgmtd.set_node_tags(10, {"draining": "1"})
+        delta = TopologyDelta.from_routing(fab.routing())
+        assert delta.draining == [10]
+        before = plan_rebalance(fab.routing(), TopologyDelta()).before
+        on_node = before.per_node.get(10, 0)
+        plan = plan_rebalance(fab.routing(), delta)
+        # exactly the drained node's memberships move, nothing else
+        assert len(plan.moves) == on_node
+        assert all(m.src_node == 10 for m in plan.moves)
+        assert plan.after.per_node.get(10, 0) == 0
+
+    def test_dead_node_recovery_plan(self):
+        fab = _cr_fabric(4, 8, 2)
+        fab.fail_node(11)
+        delta = TopologyDelta.from_routing(fab.routing())
+        assert delta.dead == [11]
+        plan = plan_rebalance(fab.routing(), delta)
+        assert all(m.src_node == 11 for m in plan.moves)
+        assert plan.after.per_node.get(11, 0) == 0
+        # replacements spread, never stacking two members of one chain
+        for mv in plan.moves:
+            chain = fab.routing().chains[mv.chain_id]
+            nodes = {fab.routing().targets[t.target_id].node_id
+                     for t in chain.targets if t.target_id != mv.out_target}
+            assert mv.dst_node not in nodes
+
+
+class TestPlannerLambdaTolerance:
+    def _assert_tolerance(self, routing, delta):
+        plan = plan_rebalance(routing, delta)
+        tol = max(plan.before.lambda_max, plan.after.lambda_lower_bound + 1)
+        assert plan.after.lambda_max <= tol, \
+            (plan.after.lambda_max, tol, plan.moves)
+        return plan
+
+    def test_cr_join_drain_dead(self):
+        fab = _cr_fabric(5, 10, 2)
+        nid = fab.add_storage_node()
+        self._assert_tolerance(fab.routing(), TopologyDelta(joined=[nid]))
+        self._assert_tolerance(fab.routing(),
+                               TopologyDelta(joined=[nid], draining=[10]))
+        self._assert_tolerance(fab.routing(),
+                               TopologyDelta(joined=[nid], dead=[11]))
+
+    def test_ec_join_drain_dead(self):
+        fab = _ec_fabric(5, 5, 2, 1)
+        nid = fab.add_storage_node()
+        plan = self._assert_tolerance(fab.routing(),
+                                      TopologyDelta(joined=[nid]))
+        assert all(m.is_ec for m in plan.moves)
+        # EC recovery factor rides the stats: k+m-1 survivors stream
+        assert plan.after.recovery_traffic_factor == 2
+        self._assert_tolerance(fab.routing(), TopologyDelta(draining=[10]))
+        self._assert_tolerance(fab.routing(), TopologyDelta(dead=[11]))
+
+
+class TestQuorumPreflight:
+    def test_cr_plan_ok_when_source_survives(self):
+        fab = _cr_fabric(4, 4, 2)
+        nid = fab.add_storage_node()
+        delta = TopologyDelta(joined=[nid])
+        plan = plan_rebalance(fab.routing(), delta)
+        assert check_plan(fab.routing(), plan, delta) == []
+
+    def test_cr_dead_both_replicas_refused(self):
+        fab = _cr_fabric(4, 4, 2)
+        # kill BOTH nodes of chain 0's replicas: no surviving source
+        chain = fab.routing().chains[fab.chain_ids[0]]
+        nodes = [fab.routing().targets[t.target_id].node_id
+                 for t in chain.targets]
+        for n in set(nodes):
+            fab.fail_node(n)
+        delta = TopologyDelta.from_routing(fab.routing())
+        plan = plan_rebalance(fab.routing(), delta)
+        problems = check_plan(fab.routing(), plan, delta)
+        assert any(str(fab.chain_ids[0]) in p and "source" in p
+                   for p in problems)
+
+    def test_ec_degraded_swap_refused(self):
+        fab = _ec_fabric(5, 3, 2, 1)
+        # degrade one member of chain 0, then plan to move ANOTHER member
+        chain = fab.routing().chains[fab.chain_ids[0]]
+        victim = chain.targets[0]
+        node = fab.routing().node_of_target(victim.target_id)
+        fab.fail_node(node.node_id)
+        delta = TopologyDelta.from_routing(fab.routing())
+        # drain a DIFFERENT node hosting a chain-0 member
+        other = fab.routing().targets[chain.targets[1].target_id].node_id
+        delta.draining.append(other)
+        plan = plan_rebalance(fab.routing(), delta)
+        problems = check_plan(fab.routing(), plan, delta)
+        assert any("k-quorum" in p for p in problems)
+
+
+class TestMgmtdChainMutation:
+    def test_add_then_drop_idempotent(self):
+        fab = _cr_fabric(3, 2, 2)
+        cid = fab.chain_ids[0]
+        ver0 = fab.routing().chains[cid].chain_version
+        fab.mgmtd.add_chain_target(cid, 5000, 12)
+        ver1 = fab.routing().chains[cid].chain_version
+        assert ver1 == ver0 + 1
+        fab.mgmtd.add_chain_target(cid, 5000, 12)  # no-op
+        assert fab.routing().chains[cid].chain_version == ver1
+        assert fab.routing().targets[5000].chain_id == cid
+        # the WAITING member is not part of the serving/writer set yet
+        chain = fab.routing().chains[cid]
+        assert 5000 in chain.preferred_order
+        fab.mgmtd.drop_chain_target(cid, 5000, min_serving=2)
+        chain = fab.routing().chains[cid]
+        assert all(t.target_id != 5000 for t in chain.targets)
+        assert 5000 not in chain.preferred_order
+        assert fab.routing().targets[5000].chain_id == 0
+        ver2 = chain.chain_version
+        fab.mgmtd.drop_chain_target(cid, 5000, min_serving=2)  # no-op
+        assert fab.routing().chains[cid].chain_version == ver2
+
+    def test_drop_quorum_refusal(self):
+        fab = _cr_fabric(3, 2, 2)
+        cid = fab.chain_ids[0]
+        serving = fab.routing().chains[cid].targets[0].target_id
+        with pytest.raises(FsError) as ei:
+            fab.mgmtd.drop_chain_target(cid, serving, min_serving=2)
+        assert ei.value.code == Code.MIGRATION_QUORUM
+
+    def test_ec_swap_takes_shard_slot(self):
+        fab = _ec_fabric(4, 2, 2, 1)
+        cid = fab.chain_ids[0]
+        chain = fab.routing().chains[cid]
+        old = chain.preferred_order[1]
+        slot = chain.preferred_order.index(old)
+        fab.mgmtd.add_chain_target(cid, 7000, 13, replace_of=old)
+        chain = fab.routing().chains[cid]
+        assert chain.preferred_order[slot] == 7000
+        assert all(t.target_id != old for t in chain.targets)
+        assert fab.routing().targets[old].chain_id == 0
+        # the swap consumed the spare unit: a second swap must refuse
+        with pytest.raises(FsError) as ei:
+            fab.mgmtd.add_chain_target(
+                cid, 7001, 13, replace_of=chain.preferred_order[0])
+        assert ei.value.code == Code.MIGRATION_QUORUM
+
+    def test_node_tags_merge_and_clear(self):
+        fab = _cr_fabric(3, 2, 2)
+        fab.mgmtd.set_node_tags(10, {"draining": "1", "rack": "r1"})
+        assert fab.routing().nodes[10].tags == {"draining": "1",
+                                                "rack": "r1"}
+        fab.mgmtd.set_node_tags(10, {"draining": ""})
+        assert fab.routing().nodes[10].tags == {"rack": "r1"}
+
+
+class TestJobStore:
+    def test_submit_conflict_on_active_chain(self):
+        fab = _cr_fabric(3, 2, 2)
+        cid = fab.chain_ids[0]
+        fab.mgmtd.migration_submit([MoveSpec(chain_id=cid, dst_node=12)])
+        with pytest.raises(FsError) as ei:
+            fab.mgmtd.migration_submit([MoveSpec(chain_id=cid, dst_node=11)])
+        assert ei.value.code == Code.MIGRATION_CONFLICT
+
+    def test_allocates_fresh_target_ids(self):
+        fab = _cr_fabric(3, 2, 2)
+        ids = fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=c, dst_node=12) for c in fab.chain_ids])
+        jobs = {j.job_id: j for j in fab.mgmtd.migration_list()}
+        new = [jobs[i].new_target for i in ids]
+        assert len(set(new)) == len(new)
+        assert all(t not in fab.routing().targets for t in new)
+
+    def test_claim_lease_and_takeover(self):
+        fab = _cr_fabric(3, 2, 2)
+        cid = fab.chain_ids[0]
+        (jid,) = fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=cid, dst_node=12)])
+        got = fab.mgmtd.migration_claim("w1", lease_s=30)
+        assert [j.job_id for j in got] == [jid]
+        # live claim: another worker gets nothing, cannot report
+        assert fab.mgmtd.migration_claim("w2", lease_s=30) == []
+        with pytest.raises(FsError) as ei:
+            fab.mgmtd.migration_report(jid, "w2", phase=JobPhase.PREPARED)
+        assert ei.value.code == Code.MIGRATION_CONFLICT
+        # lapse the lease: takeover succeeds (the crash-resume path)
+        fab.clock.advance(31)
+        got2 = fab.mgmtd.migration_claim("w2", lease_s=30)
+        assert [j.job_id for j in got2] == [jid]
+        job = fab.mgmtd.migration_report(jid, "w2", phase=JobPhase.PREPARED)
+        assert job.phase == JobPhase.PREPARED
+
+    def test_phase_moves_forward_only(self):
+        fab = _cr_fabric(3, 2, 2)
+        (jid,) = fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=fab.chain_ids[0], dst_node=12)])
+        fab.mgmtd.migration_claim("w1")
+        fab.mgmtd.migration_report(jid, "w1", phase=JobPhase.COPYING)
+        job = fab.mgmtd.migration_report(jid, "w1",
+                                         phase=JobPhase.PREPARED)
+        assert job.phase == JobPhase.COPYING  # re-report of a passed phase
+
+    def test_jobs_survive_mgmtd_restart(self):
+        from tpu3fs.mgmtd.service import Mgmtd, MgmtdConfig
+
+        fab = _cr_fabric(3, 2, 2)
+        (jid,) = fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=fab.chain_ids[0], dst_node=12)])
+        fab.mgmtd.migration_claim("w1")
+        fab.mgmtd.migration_report(jid, "w1", phase=JobPhase.PREPARED,
+                                   copied_chunks=3)
+        # a NEW mgmtd over the same KV (restart/failover) serves the jobs
+        m2 = Mgmtd(fab.MGMTD_NODE_ID, fab.kv,
+                   MgmtdConfig(), clock=fab.clock)
+        m2.extend_lease()
+        jobs = m2.migration_list()
+        assert len(jobs) == 1 and jobs[0].job_id == jid
+        assert jobs[0].phase == JobPhase.PREPARED
+        assert jobs[0].copied_chunks == 3
+
+
+class TestSolverParity:
+    """check_solution parity with the reference's validation rules: the
+    λ-balance bound AND the chain-table-type-weighted peer recovery
+    traffic (CR streams one copy, EC streams k+m-1 shards)."""
+
+    def test_cr_peer_traffic_validation_bites(self):
+        p = PlacementProblem(num_nodes=6, group_size=3, targets_per_node=3)
+        M = solve_placement(p, steps=300, seed=4)
+        assert check_solution(M, p)
+        worst = max(float(peer_recovery_traffic(M, p, n).max())
+                    for n in range(p.num_nodes))
+        assert check_solution(M, p, max_peer_traffic=worst)
+        assert not check_solution(M, p, max_peer_traffic=worst - 0.01)
+
+    def test_ec_traffic_factor_scales(self):
+        cr = PlacementProblem(num_nodes=6, group_size=3, targets_per_node=3,
+                              chain_table_type="CR")
+        ec = PlacementProblem(num_nodes=6, group_size=3, targets_per_node=3,
+                              chain_table_type="EC")
+        assert cr.recovery_traffic_factor == 1
+        assert ec.recovery_traffic_factor == 2
+        M = solve_placement(ec, steps=300, seed=5)
+        assert check_solution(M, ec)
+        worst_ec = max(float(peer_recovery_traffic(M, ec, n).max())
+                       for n in range(6))
+        worst_cr = max(float(peer_recovery_traffic(M, cr, n).max())
+                       for n in range(6))
+        assert worst_ec == pytest.approx(2 * worst_cr)
+        # the balanced ceiling property the reference optimizes for
+        assert worst_ec <= ec.max_recovery_traffic_on_peer + 1
+
+    def test_live_table_through_solver_validators(self):
+        """incidence_of_routing bridges the LIVE cluster into the same
+        validators the solver uses (structure checks only — a fabric
+        table is round-robin, not annealed)."""
+        fab = _cr_fabric(4, 8, 2)
+        nodes = sorted(n for n in fab.nodes)
+        M = incidence_of_routing(fab.routing(), nodes)
+        assert M.shape == (8, 4)
+        assert (M.sum(axis=1) == 2).all()     # every chain has 2 replicas
+        assert M.sum() == 16

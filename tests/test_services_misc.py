@@ -88,7 +88,7 @@ class TestMigration:
         fabric = Fabric(SystemSetupConfig(num_chains=2))
         src, dst = fabric.chain_ids
         client = self._write_chunks(fabric, src, file_id=7, n=5)
-        svc = MigrationService(fabric.routing, fabric.send)
+        svc = MigrationService(fabric.storage_client())
         job_id = svc.start_job(src, dst)
         job = svc.run_job(job_id, batch=2)
         assert job.state == JobState.DONE
@@ -101,7 +101,7 @@ class TestMigration:
     def test_stop_and_list(self):
         fabric = Fabric(SystemSetupConfig(num_chains=2))
         src, dst = fabric.chain_ids
-        svc = MigrationService(fabric.routing, fabric.send)
+        svc = MigrationService(fabric.storage_client())
         job_id = svc.start_job(src, dst)
         assert svc.stop_job(job_id)
         assert not svc.stop_job(job_id)  # already stopped
@@ -111,7 +111,7 @@ class TestMigration:
 
     def test_same_chain_rejected(self):
         fabric = Fabric(SystemSetupConfig(num_chains=1))
-        svc = MigrationService(fabric.routing, fabric.send)
+        svc = MigrationService(fabric.storage_client())
         with pytest.raises(ValueError):
             svc.start_job(fabric.chain_ids[0], fabric.chain_ids[0])
 
@@ -119,7 +119,7 @@ class TestMigration:
         fabric = Fabric(SystemSetupConfig(num_chains=2))
         src, dst = fabric.chain_ids
         self._write_chunks(fabric, src, file_id=9, n=3)
-        svc = MigrationService(fabric.routing, fabric.send)
+        svc = MigrationService(fabric.storage_client())
         job_id = svc.start_job(src, 999999)  # nonexistent dst chain
         svc.step(job_id)
         job = svc.job(job_id)
@@ -281,7 +281,7 @@ class TestReviewRegressions:
         client = fab.storage_client()
         client.write_chunk(dst, ChunkId(7, 0), 0, b"B" * 128)  # stale dst
         client.write_chunk(src, ChunkId(7, 0), 0, b"A" * 32)
-        svc = MigrationService(fab.routing, fab.send)
+        svc = MigrationService(fab.storage_client())
         job = svc.run_job(svc.start_job(src, dst))
         assert job.state == JobState.DONE
         reply = client.read_chunk(dst, ChunkId(7, 0))

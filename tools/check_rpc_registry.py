@@ -34,6 +34,12 @@ and verifies, per deployment unit:
    services) can never classify ``exempt`` and silently dodge quota
    enforcement.
 
+7. USRBIO RING PATH — see check_usrbio_ring;
+8. MIGRATION RESUME SAFETY — every RPC the crash-resumed migration
+   worker blindly re-executes (``RESUME_REEXECUTED_METHODS`` in
+   tpu3fs/migration/service.py) is bound, classified, and either
+   idempotent or documented replay-safe in ``REPLAY_SAFE_MUTATIONS``.
+
 Cross-binary service-id reuse (Kv and MonitorCollector both use 5) is
 reported as a note, not a failure — they never share a process.
 
@@ -461,6 +467,59 @@ def check_usrbio_ring(registries: List[_Registry]) -> List[str]:
     return errors
 
 
+# -- migration resume safety -------------------------------------------------
+
+def check_migration_resume(registries: List[_Registry]) -> List[str]:
+    """Check 8 — crash-resume can never silently double-apply:
+
+    the migration worker (tpu3fs/migration/service.py) re-executes its
+    current phase FROM THE TOP after a SIGKILL/restart, so every RPC it
+    issues on that path — declared in its ``RESUME_REEXECUTED_METHODS``
+    registry — must be (a) actually bound by some binary, (b) classified
+    in the idempotency table, and (c) either IDEMPOTENT or listed in
+    ``REPLAY_SAFE_MUTATIONS`` with the mechanism that makes serial
+    replay converge. A new worker step calling an unclassified or
+    non-replay-safe mutation fails tier-1, not a 3am resume."""
+    from tpu3fs.migration.service import RESUME_REEXECUTED_METHODS
+    from tpu3fs.rpc.idempotency import (
+        CLASSIFICATION,
+        IDEMPOTENT,
+        REPLAY_SAFE_MUTATIONS,
+    )
+
+    errors: List[str] = []
+    bound = set()
+    for reg in registries:
+        for service in reg.services.values():
+            for m in service.methods.values():
+                bound.add((service.name, m.name))
+    if not RESUME_REEXECUTED_METHODS:
+        errors.append("migration RESUME_REEXECUTED_METHODS is empty — the "
+                      "worker declares no resume surface; check 8 is dead")
+    for key in sorted(RESUME_REEXECUTED_METHODS):
+        svc, name = key
+        if key not in bound:
+            errors.append(
+                f"migration resume re-executes {svc}.{name}, which no "
+                "binary binds (stale resume registry)")
+        kind = CLASSIFICATION.get(key)
+        if kind is None:
+            errors.append(
+                f"migration resume re-executes unclassified {svc}.{name} "
+                "(add to tpu3fs/rpc/idempotency.py)")
+        elif kind != IDEMPOTENT and key not in REPLAY_SAFE_MUTATIONS:
+            errors.append(
+                f"migration resume re-executes MUTATING {svc}.{name} with "
+                "no REPLAY_SAFE_MUTATIONS entry — a crash-restart would "
+                "double-apply it (document the dedupe mechanism or stop "
+                "re-executing it)")
+    for key in sorted(set(REPLAY_SAFE_MUTATIONS) - bound):
+        errors.append(
+            f"REPLAY_SAFE_MUTATIONS lists unbound {key[0]}.{key[1]} "
+            "(stale row)")
+    return errors
+
+
 # -- driver ------------------------------------------------------------------
 
 def run_checks() -> Tuple[List[str], List[str]]:
@@ -475,6 +534,7 @@ def run_checks() -> Tuple[List[str], List[str]]:
     errors.extend(check_idempotency(registries))
     errors.extend(check_tenancy(registries))
     errors.extend(check_usrbio_ring(registries))
+    errors.extend(check_migration_resume(registries))
 
     # cross-binary id reuse (informational)
     by_id: Dict[int, set] = {}

@@ -553,6 +553,20 @@ class TwoPhaseApplication(ApplicationBase):
             return True
         except Exception as e:
             xlog("WARN", "node %d heartbeat failed: %r", self.info.node_id, e)
+            # STALE-VERSION FAST-FORWARD: a restarted node begins at
+            # hb_version 1 while mgmtd remembers its pre-crash counter —
+            # without this it would burn one rejected beat per missing
+            # version (a SIGKILLed migration destination took ~17s to
+            # re-join). The refusal message carries the expected floor
+            # ("<ours> < <mgmtd's>"): jump past it and re-join next beat.
+            from tpu3fs.utils.result import Code as _Code
+
+            if getattr(e, "code", None) == _Code.MGMTD_STALE_HEARTBEAT:
+                try:
+                    floor = int(str(e).rstrip("')\"").split("<")[-1])
+                    self._hb_version = max(self._hb_version, floor)
+                except (ValueError, IndexError):
+                    pass
             # a reachable mgmtd that refuses (e.g. standby during the dead
             # primary's residual lease) still proves the FLEET is there:
             # count a successful routing read as contact so T/2 suicide

@@ -79,6 +79,9 @@ class StorageAppConfig(Config):
     usrbio = ConfigItem(1)
     usrbio_reap_interval_s = ConfigItem(60.0, hot=True)
     usrbio_iov_max_age_s = ConfigItem(3600.0, hot=True)
+    # elasticity: close + trash-route local targets whose routing
+    # assignment was taken away by a migration cutover (docs/placement.md)
+    retire_targets = ConfigItem(1, hot=True)
 
 
 class StorageApp(TwoPhaseApplication):
@@ -147,10 +150,56 @@ class StorageApp(TwoPhaseApplication):
         os.makedirs(path, exist_ok=True)
         return path
 
+    def retire_targets(self, routing) -> int:
+        """Close + trash-route local targets routing no longer assigns
+        here (a migration cutover detached them: chain_id 0, or the
+        membership moved to another node). The DATA is not destroyed —
+        a disk-backed target directory is renamed into
+        ``<data_dir>/trash/`` with a timestamp so an operator can still
+        recover from a mistaken plan; mem engines just release."""
+        import time as _time
+
+        retired = 0
+        for target in self.service.targets():
+            info = routing.targets.get(target.target_id)
+            if info is None:
+                continue  # unknown to routing: never reap on ignorance
+            if info.chain_id and info.node_id == self.info.node_id:
+                continue
+            dropped = self.service.drop_target(target.target_id)
+            if dropped is None:
+                continue
+            try:
+                dropped.engine.close()
+            except Exception:
+                pass
+            path = self._target_path(target.target_id, info.disk_index) \
+                if self.config.get("data_dir") else None
+            if path and os.path.isdir(path):
+                trash = os.path.join(self.config.get("data_dir"), "trash")
+                os.makedirs(trash, exist_ok=True)
+                dst = os.path.join(
+                    trash, f"target{target.target_id}-{int(_time.time())}")
+                try:
+                    os.rename(path, dst)
+                except OSError:
+                    pass
+            retired += 1
+            xlog("INFO", "node %d retired target %d (trash-routed)",
+                 self.info.node_id, target.target_id)
+        if retired:
+            from tpu3fs.migration.service import record_retired_target
+
+            record_retired_target(retired)
+        return retired
+
     def scan_targets(self) -> int:
         """Open targets routing assigns to this node (ref StorageTargets
-        create/load at startup + admin create-target afterwards)."""
+        create/load at startup + admin create-target afterwards); retire
+        the ones routing took away (migration cutover)."""
         routing = self.mgmtd_client.refresh_routing()
+        if self.config.get("retire_targets"):
+            self.retire_targets(routing)
         added = 0
         for info in routing.targets.values():
             if info.node_id != self.info.node_id:

@@ -1222,10 +1222,124 @@ class AdminCli:
         if self._migration_svc is None:
             from tpu3fs.migration import MigrationService
 
-            self._migration_svc = MigrationService(
-                self.fab.routing, self.fab.send
-            )
+            self._migration_svc = MigrationService(self.fab.storage_client())
         return self._migration_svc
+
+    # -- elasticity: placement planning / rebalance / drain ------------------
+    def _topology_delta(self, args: List[str]):
+        from tpu3fs.placement import TopologyDelta
+
+        def ids(flag):
+            raw = self._flag(args, flag)
+            return [int(x) for x in raw.split(",")] if raw else []
+
+        join, drain, dead = ids("--join"), ids("--drain"), ids("--dead")
+        if join or drain or dead:
+            return TopologyDelta(joined=join, draining=drain, dead=dead)
+        return TopologyDelta.from_routing(self.fab.routing())
+
+    @staticmethod
+    def _render_plan(plan, delta) -> List[str]:
+        lines = [
+            f"delta: join={delta.joined} drain={delta.draining} "
+            f"dead={delta.dead}",
+            f"moves: {len(plan.moves)}"
+            + (f" (+{len(plan.deferred_chains)} chains deferred to a "
+               "later wave)" if plan.deferred_chains else ""),
+        ]
+        for mv in plan.moves:
+            kind = "EC" if mv.is_ec else "CR"
+            lines.append(
+                f"  chain {mv.chain_id} [{kind}]: target {mv.out_target} "
+                f"node {mv.src_node} -> node {mv.dst_node}")
+        b, a = plan.before, plan.after
+        lines.append(
+            f"lambda: {b.lambda_max} -> {a.lambda_max} "
+            f"(lower bound {a.lambda_lower_bound}); recovery traffic "
+            f"factor {a.recovery_traffic_factor} => worst peer "
+            f"{b.lambda_max * b.recovery_traffic_factor} -> "
+            f"{a.lambda_max * a.recovery_traffic_factor} units")
+        lines.append("chains/node after: " + " ".join(
+            f"{n}:{c}" for n, c in sorted(plan.after.per_node.items())))
+        return lines
+
+    def cmd_placement_plan(self, args: List[str]) -> str:
+        """Preview the incremental rebalance diff + predicted λ/traffic:
+        placement-plan [--join N,..] [--drain N,..] [--dead N,..]
+        (no flags = delta derived from routing tags/heartbeats)."""
+        from tpu3fs.placement import check_plan, plan_rebalance
+
+        delta = self._topology_delta(args)
+        plan = plan_rebalance(self.fab.routing(), delta)
+        lines = self._render_plan(plan, delta)
+        problems = check_plan(self.fab.routing(), plan, delta)
+        for p in problems:
+            lines.append(f"QUORUM PROBLEM: {p}")
+        return "\n".join(lines)
+
+    def cmd_rebalance(self, args: List[str]) -> str:
+        """Plan and (with --apply) submit migration jobs for the current
+        topology delta: rebalance [--apply] [--join/--drain/--dead N,..]."""
+        from tpu3fs.placement import check_plan, plan_rebalance
+
+        delta = self._topology_delta(args)
+        routing = self.fab.routing()
+        plan = plan_rebalance(routing, delta)
+        lines = self._render_plan(plan, delta)
+        problems = check_plan(routing, plan, delta)
+        if problems:
+            return "\n".join(lines + [f"QUORUM PROBLEM: {p}"
+                                      for p in problems]
+                             + ["refused: plan violates quorum"])
+        if plan.empty:
+            return "\n".join(lines + ["nothing to do"])
+        if "--apply" not in args:
+            return "\n".join(lines + ["(preview; re-run with --apply)"])
+        ids = self.fab.mgmtd.migration_submit(
+            [mv.spec() for mv in plan.moves])
+        return "\n".join(lines + [f"submitted jobs: {ids}"])
+
+    def cmd_drain(self, args: List[str]) -> str:
+        """Mark a node draining and plan its evacuation; --apply submits:
+        drain --node N [--apply] [--undo]. Refuses when any chain would
+        drop below its write-quorum (check_plan)."""
+        from tpu3fs.placement import DRAINING_TAG
+
+        node = int(self._flag(args, "--node"))
+        if "--undo" in args:
+            self.fab.mgmtd.set_node_tags(node, {DRAINING_TAG: ""})
+            return f"node {node} draining flag cleared"
+        self.fab.mgmtd.set_node_tags(node, {DRAINING_TAG: "1"})
+        out = self.cmd_rebalance(args)
+        if "--apply" not in args:
+            # preview must not leave the drain armed
+            self.fab.mgmtd.set_node_tags(node, {DRAINING_TAG: ""})
+            return out
+        if "submitted jobs" not in out:
+            # refused (quorum) or undeliverable (no eligible destination
+            # for some chain): do not leave a drain half-armed
+            self.fab.mgmtd.set_node_tags(node, {DRAINING_TAG: ""})
+            return out + f"\ndrain of node {node} refused, ROLLED BACK"
+        return out
+
+    def cmd_migrate_status(self, args: List[str]) -> str:
+        """Cluster migration jobs from the mgmtd KV (crash-safe state)."""
+        jobs = self.fab.mgmtd.migration_list()
+        if not jobs:
+            return "(no jobs)"
+        lines = ["JOB  CHAIN    PHASE     OUT->NEW (node)      "
+                 "COPIED              WORKER"]
+        for j in jobs:
+            from tpu3fs.migration import JobPhase
+
+            lines.append(
+                f"{j.job_id:<4} {j.chain_id:<8} "
+                f"{JobPhase(j.phase).name:<9} "
+                f"{j.out_target}->{j.new_target} (n{j.dst_node})"
+                f"{'':<6} {j.copied_chunks} chunks/"
+                f"{j.copied_bytes}B{'':<4} {j.worker}"
+                + (f"  ERR={j.error}" if j.error else ""))
+        return "\n".join(lines)
 
     def cmd_migrate_start(self, args: List[str]) -> str:
         svc = self._migration()

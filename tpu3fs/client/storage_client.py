@@ -314,11 +314,13 @@ class StorageClient:
         data: bytes,
         *,
         chunk_size: int = 1 << 20,
+        full_replace: bool = False,
     ) -> UpdateReply:
         """Write with the full retry ladder; exactly-once via channel identity."""
         with self._op_scope():
             return self._write_chunk_op(chain_id, chunk_id, offset, data,
-                                        chunk_size=chunk_size)
+                                        chunk_size=chunk_size,
+                                        full_replace=full_replace)
 
     def _write_chunk_op(
         self,
@@ -328,6 +330,7 @@ class StorageClient:
         data: bytes,
         *,
         chunk_size: int = 1 << 20,
+        full_replace: bool = False,
     ) -> UpdateReply:
         try:
             if self._chain(chain_id).is_ec:
@@ -368,6 +371,7 @@ class StorageClient:
                     client_id=self.client_id,
                     channel_id=channel,
                     seqnum=seq,
+                    full_replace=full_replace,
                 )
                 try:
                     reply = self._messenger(node.node_id, "write", req)
@@ -732,6 +736,7 @@ class StorageClient:
         *,
         chunk_size: int = 1 << 20,
         op_crcs: Optional[List[Optional[int]]] = None,
+        full_replace: bool = False,
     ) -> List[UpdateReply]:
         """Traced entry: see _batch_write_op. The root span is the
         client-observed latency the trace assembler's stage coverage is
@@ -742,7 +747,8 @@ class StorageClient:
                 "client.batch_write",
                 nbytes=sum(len(w[3]) for w in writes)), self._op_scope():
             return self._batch_write_op(writes, chunk_size=chunk_size,
-                                        op_crcs=op_crcs)
+                                        op_crcs=op_crcs,
+                                        full_replace=full_replace)
 
     def _batch_write_op(
         self,
@@ -750,6 +756,7 @@ class StorageClient:
         *,
         chunk_size: int = 1 << 20,
         op_crcs: Optional[List[Optional[int]]] = None,
+        full_replace: bool = False,
     ) -> List[UpdateReply]:
         """Batched CRAQ writes: (chain_id, chunk_id, offset, data) ops are
         grouped by head node and issued as ONE BatchWrite per node (ref
@@ -798,6 +805,7 @@ class StorageClient:
                     client_id=self.client_id,
                     channel_id=ch,
                     seqnum=seq,
+                    full_replace=full_replace,
                     trusted_crc=(op_crcs[i] if trusted
                                  and op_crcs[i] is not None else -1),
                 )
@@ -843,7 +851,8 @@ class StorageClient:
             if r is None or (not r.ok and r.code != Code.INVALID_ARG):
                 chain_id, chunk_id, offset, data = writes[i]
                 replies[i] = self.write_chunk(
-                    chain_id, chunk_id, offset, data, chunk_size=chunk_size)
+                    chain_id, chunk_id, offset, data, chunk_size=chunk_size,
+                    full_replace=full_replace)
         return replies  # type: ignore[return-value]
 
     # -- EC stripes (TPU data plane; added capability, BASELINE.json) ---------
@@ -1713,6 +1722,45 @@ class StorageClient:
             total.used += si.used
             total.chunk_count += si.chunk_count
         return total
+
+    # -- maintenance plane (migration worker / admin sweeps) ------------------
+    def dump_chunkmeta(self, node_id: int, target_id: int):
+        """A target's full chunk-metadata inventory (committed + pending):
+        the diff primitive of every copy/verify sweep. Plain messenger
+        pass-through — breaker/fault-plane guards apply."""
+        return self._messenger(node_id, "dump_chunkmeta", target_id)
+
+    def sync_done(self, node_id: int, target_id: int) -> None:
+        """Declare a syncing target caught up (it reports UPTODATE on its
+        next heartbeat and mgmtd promotes it SERVING)."""
+        self._messenger(node_id, "sync_done", target_id)
+
+    def remove_target_chunk(self, node_id: int, target_id: int,
+                            chunk_id: ChunkId) -> bool:
+        return bool(self._messenger(node_id, "remove_chunk",
+                                    (target_id, chunk_id)))
+
+    def batch_sync_write(self, node_id: int,
+                         reqs: List[WriteReq]) -> List[UpdateReply]:
+        """Batched full-chunk-replace installs addressed at ONE node's
+        syncing chain member (WriteReq.from_target names the predecessor,
+        so the server resolves the receiving target; update_ver pins the
+        source's committed version — a racing foreground write that
+        already moved the chunk past it dedupes as CHUNK_STALE_UPDATE).
+        Rides the striped pipelined batch_update fan-out on socket
+        messengers; one direct batch_update otherwise. Transport errors
+        come back as per-op replies — the caller's round loop retries."""
+        if not reqs:
+            return []
+        pipelined = getattr(self._messenger, "batch_write_pipelined", None)
+        if pipelined is not None and getattr(
+                self._messenger, "write_pipelined", True):
+            return pipelined([(node_id, reqs)], method="batch_update")[0]
+        try:
+            return list(self._messenger(node_id, "batch_update", reqs))
+        except FsError as e:
+            return [UpdateReply(e.code, message=e.status.message)
+                    for _ in reqs]
 
     def query_last_chunk(self, chain_id: int, file_id: int) -> Tuple[int, int]:
         """Last (chunk index, byte length) of a file on one chain — the

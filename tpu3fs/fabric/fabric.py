@@ -261,6 +261,100 @@ class Fabric:
         si = self.storage_client().space_info()
         return si.capacity, si.used
 
+    # -- elasticity (cluster reshaping; docs/placement.md) -------------------
+    def add_storage_node(self, node_id: Optional[int] = None) -> int:
+        """Join an empty storage node to the live cluster (the in-process
+        analogue of booting another storage_main): registered, heartbeat-
+        connected, zero targets — exactly what the rebalance planner
+        treats as a JOIN delta."""
+        if node_id is None:
+            node_id = max(self.nodes) + 1
+        service = StorageService(node_id, self.routing, self.send)
+        if self.cfg.qos is not None:
+            from tpu3fs.qos.manager import QosManager
+
+            service.set_qos(QosManager(
+                self.cfg.qos, tags={"node": str(node_id)}))
+        self.nodes[node_id] = _Node(node_id, service)
+        self.mgmtd.register_node(node_id, NodeType.STORAGE)
+        self.heartbeat_all()
+        return node_id
+
+    def open_assigned_targets(self) -> int:
+        """The in-process mirror of storage_main.scan_targets: open any
+        routing-assigned target a live node does not serve yet (migration
+        PREPARE assigns them). Fresh targets on a chain past v1 report
+        ONLINE and ride the WAITING→SYNCING recovery ladder."""
+        routing = self.routing()
+        is_ec = self.cfg.ec_k > 0
+        if is_ec:
+            from tpu3fs.ops.stripe import shard_size_of
+
+            chunk_size = shard_size_of(self.cfg.chunk_size, self.cfg.ec_k)
+        else:
+            chunk_size = self.cfg.chunk_size
+        opened = 0
+        for info in routing.targets.values():
+            node = self.nodes.get(info.node_id)
+            if node is None or not node.alive or not info.chain_id:
+                continue
+            if node.service.target(info.target_id) is not None:
+                continue
+            tpath = None
+            if self.cfg.engine != "mem" and self.cfg.engine_dir:
+                tpath = tempfile.mkdtemp(
+                    prefix=f"t{info.target_id}-", dir=self.cfg.engine_dir)
+                self._engine_dirs.append(tpath)
+            target = StorageTarget(
+                info.target_id, info.chain_id, engine=self.cfg.engine,
+                path=tpath, chunk_size=chunk_size)
+            chain = routing.chains.get(info.chain_id)
+            if chain is not None and chain.chain_version > 1:
+                target.local_state = LocalTargetState.ONLINE
+            node.service.add_target(target)
+            opened += 1
+        return opened
+
+    def retire_unassigned_targets(self) -> int:
+        """The in-process mirror of storage_main's retirement pass: drop
+        local targets routing no longer assigns here (migration cutover
+        detached them — chain_id 0)."""
+        retired = 0
+        routing = self.routing()
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            for target in node.service.targets():
+                info = routing.targets.get(target.target_id)
+                if info is None or info.chain_id == 0 \
+                        or info.node_id != node.node_id:
+                    dropped = node.service.drop_target(target.target_id)
+                    if dropped is not None:
+                        try:
+                            dropped.engine.close()
+                        except Exception:
+                            pass
+                        retired += 1
+        return retired
+
+    def elastic_tick(self, *, resync: bool = True) -> None:
+        """One full elasticity round: open new assignments, heartbeat,
+        run the chain updater, run resync/rebuild workers, retire
+        detached targets — what the live cluster's loops do continuously.
+        ``resync=False`` leaves the copying entirely to a migration
+        worker (tests proving the worker moves the bytes)."""
+        from tpu3fs.storage.ec_resync import EcResyncWorker
+
+        self.open_assigned_targets()
+        self.tick()
+        if resync:
+            for node in self.nodes.values():
+                if node.alive:
+                    ResyncWorker(node.service, self.send).run_once()
+                    EcResyncWorker(node.service, self.send).run_once()
+        self.tick()
+        self.retire_unassigned_targets()
+
     # -- cluster life -------------------------------------------------------
     def heartbeat_all(self) -> None:
         for node in self.nodes.values():

@@ -40,6 +40,7 @@ class KeyPrefix(bytes, enum.Enum):
     IDEMPOTENT = b"IDEM"     # cached op results for client retries
     CONFIG = b"CONF"         # per-node-type config blobs
     TARGET_INFO = b"TGIF"    # target infos
+    MIGRATION = b"MGJB"      # migration job records (+ b"MGJC" id counter)
 
 
 def make_key(prefix: KeyPrefix, *parts: bytes) -> bytes:

@@ -41,6 +41,11 @@ from tpu3fs.utils.result import Code, FsError, Status
 
 _LEASE_KEY = KeyPrefix.LEASE.value + b"primary"
 _ROUTING_VER_KEY = b"RTVR"
+_MIGRATION_SEQ_KEY = b"MGJC"
+
+
+def _migration_key(job_id: int) -> bytes:
+    return KeyPrefix.MIGRATION.value + struct.pack(">Q", job_id)
 
 
 def _node_key(node_id: int) -> bytes:
@@ -299,6 +304,298 @@ class Mgmtd:
         ver = with_transaction(self._engine, op)
         self._routing.chain_tables[table_id] = tbl
         self._routing.version = ver
+
+    # -- live chain mutation (elasticity; ref src/mgmtd updateChain admin) ---
+    def add_chain_target(self, chain_id: int, target_id: int, node_id: int,
+                         *, disk_index: int = 0, replace_of: int = 0) -> None:
+        """Join ``target_id`` (created on ``node_id``) to a LIVE chain.
+
+        CR chains: the new member is APPENDED as WAITING/OFFLINE — the
+        hosting node discovers it via routing, opens it ONLINE, and the
+        chain state machine runs the ordinary WAITING→SYNCING→SERVING
+        recovery ladder while every existing member keeps serving (the
+        old member a migration job later drops stays readable the whole
+        time).
+
+        EC chains: members hold DIFFERENT shards, so a join must take
+        over a specific shard position — ``replace_of`` names the member
+        whose ``preferred_order`` slot the new target inherits; the old
+        member leaves the chain atomically in the same version bump and
+        the new shard is decode-rebuilt from the k+m-1 survivors
+        (storage/ec_resync.py). Refused (MIGRATION_QUORUM) when any
+        OTHER member is not SERVING — the swap may only spend the one
+        redundancy unit the chain actually has spare.
+
+        Idempotent: re-executing after a worker crash (the target is
+        already a member) is a no-op."""
+        chain = self._routing.chains.get(chain_id)
+        if chain is None:
+            raise FsError(Status(Code.MGMTD_CHAIN_NOT_FOUND, str(chain_id)))
+        if any(t.target_id == target_id for t in chain.targets):
+            return  # resumed worker re-executing a committed PREPARE
+        from tpu3fs.mgmtd.types import ChainTarget
+
+        new_member = ChainTarget(target_id, PublicTargetState.WAITING,
+                                 LocalTargetState.OFFLINE)
+        targets = [replace(t) for t in chain.targets]
+        order = list(chain.preferred_order)
+        dropped_info: Optional[TargetInfo] = None
+        if chain.is_ec:
+            if replace_of not in order:
+                raise FsError(Status(
+                    Code.INVALID_ARG,
+                    f"EC join needs replace_of naming a member of chain "
+                    f"{chain_id} (got {replace_of})"))
+            others = [t for t in targets if t.target_id != replace_of]
+            if any(t.public_state != PublicTargetState.SERVING
+                   for t in others):
+                raise FsError(Status(
+                    Code.MIGRATION_QUORUM,
+                    f"EC chain {chain_id} already degraded: swapping "
+                    f"{replace_of} would spend a second redundancy unit"))
+            order[order.index(replace_of)] = target_id
+            targets = others + [new_member]
+            old = self._routing.targets.get(replace_of)
+            if old is not None:
+                dropped_info = replace(old)
+                dropped_info.chain_id = 0
+                dropped_info.public_state = PublicTargetState.OFFLINE
+        else:
+            targets.append(new_member)
+            order.append(target_id)
+        new_chain = replace(chain, targets=targets, preferred_order=order,
+                            chain_version=chain.chain_version + 1)
+        info = TargetInfo(target_id, node_id=node_id, disk_index=disk_index,
+                          chain_id=chain_id,
+                          public_state=PublicTargetState.WAITING,
+                          local_state=LocalTargetState.OFFLINE)
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, self._clock())
+            txn.set(_chain_key(chain_id), serialize(new_chain))
+            txn.set(_target_key(target_id), serialize(info))
+            if dropped_info is not None:
+                txn.set(_target_key(dropped_info.target_id),
+                        serialize(dropped_info))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        self._routing.chains[chain_id] = new_chain
+        self._routing.targets[target_id] = info
+        if dropped_info is not None:
+            self._routing.targets[dropped_info.target_id] = dropped_info
+        self._routing.version = ver
+
+    def drop_chain_target(self, chain_id: int, target_id: int,
+                          *, min_serving: int = 1) -> None:
+        """Remove a member from a live chain (migration cutover / dead-
+        member retirement). Refused (MIGRATION_QUORUM) when the chain
+        would keep fewer than ``min_serving`` SERVING members — the
+        caller passes the chain's nominal width so a cutover can never
+        under-replicate, and ``1`` for emergency pruning. The detached
+        target's info stays in routing with chain_id=0/OFFLINE so the
+        hosting node's target scan retires (trash-routes) its data.
+
+        Idempotent: dropping a non-member is a no-op."""
+        chain = self._routing.chains.get(chain_id)
+        if chain is None:
+            raise FsError(Status(Code.MGMTD_CHAIN_NOT_FOUND, str(chain_id)))
+        if all(t.target_id != target_id for t in chain.targets):
+            return  # resumed worker re-executing a committed cutover
+        remaining = [replace(t) for t in chain.targets
+                     if t.target_id != target_id]
+        serving_after = sum(
+            1 for t in remaining
+            if t.public_state == PublicTargetState.SERVING)
+        if serving_after < min_serving:
+            raise FsError(Status(
+                Code.MIGRATION_QUORUM,
+                f"dropping {target_id} leaves chain {chain_id} with "
+                f"{serving_after} serving < quorum {min_serving}"))
+        order = [t for t in chain.preferred_order if t != target_id]
+        new_chain = replace(chain, targets=remaining, preferred_order=order,
+                            chain_version=chain.chain_version + 1)
+        info = self._routing.targets.get(target_id)
+        info = replace(info) if info is not None else TargetInfo(target_id)
+        info.chain_id = 0
+        info.public_state = PublicTargetState.OFFLINE
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, self._clock())
+            txn.set(_chain_key(chain_id), serialize(new_chain))
+            txn.set(_target_key(target_id), serialize(info))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        self._routing.chains[chain_id] = new_chain
+        self._routing.targets[target_id] = info
+        self._routing.version = ver
+
+    def set_node_tags(self, node_id: int, tags: Dict[str, str]) -> None:
+        """Merge operator tags onto a node record (empty value deletes a
+        key). ``draining=1`` is how an operator marks a node for the
+        rebalance planner to empty; tags persist and ride routing so
+        every planner invocation — any client, any time — sees them."""
+        node = self._routing.nodes.get(node_id)
+        if node is None:
+            raise FsError(Status(Code.MGMTD_NODE_NOT_FOUND, str(node_id)))
+        merged = dict(node.tags)
+        for k, v in tags.items():
+            if v == "":
+                merged.pop(k, None)
+            else:
+                merged[k] = v
+        staged = replace(node, tags=merged)
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, self._clock())
+            txn.set(_node_key(node_id), serialize(staged))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        self._routing.nodes[node_id] = staged
+        self._routing.version = ver
+
+    # -- migration job store (crash-safe; ref src/migration job service) -----
+    # Jobs live ONLY in the KV — no in-memory cache — so a failed-over
+    # primary serves them unchanged and every mutation is one atomic,
+    # lease-validated transaction.
+
+    def _next_target_id(self) -> int:
+        return max(self._routing.targets, default=999) + 1
+
+    def migration_submit(self, specs: List["MoveSpec"]) -> List[int]:
+        """Persist one job per spec; allocates job ids (and fresh target
+        ids for specs that left new_target=0). Refuses (MIGRATION_CONFLICT)
+        when an ACTIVE job already reshapes one of the chains — a chain
+        migrates one membership at a time, which is what keeps the
+        quorum invariant local to a single job."""
+        from tpu3fs.migration.types import MigrationJob
+
+        now = self._clock()
+        active_chains = {j.chain_id for j in self.migration_list()
+                         if j.active}
+        staged: List[MigrationJob] = []
+        seen_chains = set()
+        next_tid = self._next_target_id()
+        for spec in specs:
+            chain = self._routing.chains.get(spec.chain_id)
+            if chain is None:
+                raise FsError(Status(Code.MGMTD_CHAIN_NOT_FOUND,
+                                     str(spec.chain_id)))
+            if spec.chain_id in active_chains or spec.chain_id in seen_chains:
+                raise FsError(Status(
+                    Code.MIGRATION_CONFLICT,
+                    f"chain {spec.chain_id} already has an active job"))
+            seen_chains.add(spec.chain_id)
+            new_target = spec.new_target
+            if not new_target:
+                new_target = next_tid
+                next_tid += 1
+            staged.append(MigrationJob(
+                job_id=0, chain_id=spec.chain_id,
+                out_target=spec.out_target, new_target=new_target,
+                dst_node=spec.dst_node, is_ec=chain.is_ec,
+                submitted_at=now, updated_at=now))
+
+        def op(txn: ITransaction) -> List[int]:
+            self._ensure_primary_in_txn(txn, now)
+            raw = txn.get(_MIGRATION_SEQ_KEY)
+            seq = int(raw) if raw else 0
+            ids = []
+            for job in staged:
+                seq += 1
+                job.job_id = seq
+                txn.set(_migration_key(seq), serialize(job))
+                ids.append(seq)
+            txn.set(_MIGRATION_SEQ_KEY, str(seq).encode())
+            return ids
+
+        return with_transaction(self._engine, op)
+
+    def migration_list(self) -> List["MigrationJob"]:
+        from tpu3fs.migration.types import MigrationJob
+
+        def op(txn: ITransaction) -> List[MigrationJob]:
+            return [deserialize(pair.value, MigrationJob)
+                    for pair in txn.get_range(
+                        KeyPrefix.MIGRATION.value,
+                        KeyPrefix.MIGRATION.value + b"\xff" * 9,
+                        snapshot=True)]
+
+        return with_transaction(self._engine, op, read_only=True)
+
+    def migration_claim(self, worker: str, *, max_jobs: int = 4,
+                        lease_s: float = 30.0) -> List["MigrationJob"]:
+        """Hand up to ``max_jobs`` runnable jobs to ``worker`` (CAS in one
+        txn). A job is claimable when active and unowned — or when its
+        claim LAPSED (the owning worker died mid-plan; resume is just the
+        next claim). Renewal is claiming a job you already own."""
+        now = self._clock()
+
+        def op(txn: ITransaction) -> List:
+            from tpu3fs.migration.types import MigrationJob
+
+            self._ensure_primary_in_txn(txn, now)
+            out = []
+            for pair in txn.get_range(
+                    KeyPrefix.MIGRATION.value,
+                    KeyPrefix.MIGRATION.value + b"\xff" * 9):
+                job = deserialize(pair.value, MigrationJob)
+                if not job.active:
+                    continue
+                if job.worker not in ("", worker) and now < job.claim_expire:
+                    continue
+                job.worker = worker
+                job.claim_expire = now + lease_s
+                job.updated_at = now
+                txn.set(pair.key, serialize(job))
+                out.append(job)
+                if len(out) >= max_jobs:
+                    break
+            return out
+
+        return with_transaction(self._engine, op)
+
+    def migration_report(self, job_id: int, worker: str, *,
+                         phase: Optional[int] = None,
+                         copied_chunks: int = 0, copied_bytes: int = 0,
+                         error: str = "",
+                         lease_s: float = 30.0) -> "MigrationJob":
+        """Persist a phase transition / progress heartbeat. Only the claim
+        owner may report (MIGRATION_CONFLICT otherwise — a SIGKILLed
+        worker that wakes up after its lease lapsed and was re-claimed
+        cannot clobber the successor's progress). Phases only move
+        FORWARD: an idempotent re-report of an already-passed phase is a
+        no-op, which is what makes blind re-execution after a crash safe."""
+        now = self._clock()
+
+        def op(txn: ITransaction):
+            from tpu3fs.migration.types import JobPhase, MigrationJob
+
+            self._ensure_primary_in_txn(txn, now)
+            raw = txn.get(_migration_key(job_id))
+            if raw is None:
+                raise FsError(Status(Code.MIGRATION_JOB_NOT_FOUND,
+                                     str(job_id)))
+            job = deserialize(raw, MigrationJob)
+            if job.worker != worker and now < job.claim_expire:
+                raise FsError(Status(
+                    Code.MIGRATION_CONFLICT,
+                    f"job {job_id} claimed by {job.worker!r}"))
+            job.worker = worker
+            job.claim_expire = now + lease_s
+            if phase is not None and int(phase) > int(job.phase):
+                job.phase = JobPhase(int(phase))
+            job.copied_chunks += int(copied_chunks)
+            job.copied_bytes += int(copied_bytes)
+            if error:
+                job.error = error
+            job.updated_at = now
+            txn.set(_migration_key(job_id), serialize(job))
+            return job
+
+        return with_transaction(self._engine, op)
 
     # -- registration & heartbeat -------------------------------------------
     def register_node(

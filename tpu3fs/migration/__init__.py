@@ -1,3 +1,10 @@
-from tpu3fs.migration.service import Job, JobState, MigrationService
+from tpu3fs.migration.service import (
+    Job,
+    JobState,
+    MigrationService,
+    MigrationWorker,
+)
+from tpu3fs.migration.types import JobPhase, MigrationJob, MoveSpec
 
-__all__ = ["Job", "JobState", "MigrationService"]
+__all__ = ["Job", "JobState", "MigrationService", "MigrationWorker",
+           "JobPhase", "MigrationJob", "MoveSpec"]

@@ -1,31 +1,88 @@
-"""Migration service: chain-to-chain data movement with job control.
+"""Migration: chain-to-chain copies AND the mgmtd-coordinated worker
+that executes placement moves crash-safely.
 
-The reference ships a migration service skeleton (src/migration/main.cpp,
-src/migration/service/Service.h:8-23 — start/stop/list jobs over RPC,
-src/fbs/migration job schemas). Here the skeleton is filled in with a real
-executor: a job copies every committed chunk from a source chain onto a
-destination chain through the ordinary CRAQ write path, so migrated data is
-fully replicated/versioned on arrival and readers never see partial state.
+Two layers, both riding the ordinary batched data plane through
+``StorageClient`` (pipelining, hedging, deadlines, breaker guards and the
+BACKGROUND-class tenant exemption come for free — the pre-PR-3 version
+spoke raw ``Messenger`` single-ops):
 
-Jobs run in explicit `step()` batches (driven by a background loop in the
-service binary, or synchronously in tests), mirroring the reference's
-pull-based job workers.
+- ``MigrationService`` — the reference's job service surface
+  (src/migration/service/Service.h start/stop/list): copy every committed
+  chunk of one chain onto another, batched, under the ``migration`` QoS
+  class. Local registry, synchronous ``step()`` batches.
+
+- ``MigrationWorker`` — the cluster-elasticity executor. Jobs are
+  ``MigrationJob`` records persisted in the mgmtd KV
+  (mgmtd.migration_submit/claim/report); each job replaces ONE chain
+  membership and advances through the phase ladder
+  PENDING → PREPARED → COPYING → SYNCED → CUTOVER → DONE where every
+  transition is one atomic mgmtd transaction and every phase handler is
+  idempotent re-execution — SIGKILL the worker (or the destination node)
+  at ANY point, restart, and the next claim resumes from the last
+  committed phase (docs/placement.md "crash matrix"). CR chains are
+  filled by the worker itself: batched committed reads off the chain +
+  batched full-replace installs addressed at the syncing member; EC
+  chains swap the shard slot at PREPARE and the storage nodes'
+  EcResyncWorker decode-rebuilds the new shard (the recovery traffic the
+  placement solver's λ-balance spreads), with the worker monitoring and
+  cutting over.
 """
 
 from __future__ import annotations
 
 import enum
 import itertools
+import os
 import threading
+import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from tpu3fs.storage.craq import Messenger, ReadReq, WriteReq
+from tpu3fs.migration.types import JobPhase, MigrationJob
+from tpu3fs.mgmtd.types import PublicTargetState
+from tpu3fs.storage.craq import ReadReq, WriteReq
 from tpu3fs.storage.types import ChunkId
 from tpu3fs.utils.result import Code, FsError, err
 
 MIGRATION_SERVICE_ID = 400
 
+#: Every RPC the crash-resumed worker blindly RE-EXECUTES when it
+#: re-enters a phase from the top. check_rpc_registry check 8 proves each
+#: is bound and either idempotent or documented replay-safe
+#: (rpc/idempotency.py REPLAY_SAFE_MUTATIONS) — extending the worker with
+#: a new mutation forces you to document why its replay converges.
+RESUME_REEXECUTED_METHODS = frozenset({
+    ("Mgmtd", "getRoutingInfo"),
+    ("Mgmtd", "addChainTarget"),
+    ("Mgmtd", "dropChainTarget"),
+    ("Mgmtd", "migrationClaim"),
+    ("Mgmtd", "migrationReport"),
+    ("StorageSerde", "dumpChunkMeta"),
+    ("StorageSerde", "batchRead"),
+    ("StorageSerde", "batchUpdate"),
+    ("StorageSerde", "syncDone"),
+})
+
+# -- recorders (single declaration site; docs/observability.md) --------------
+from tpu3fs.monitor.recorder import CounterRecorder, ValueRecorder
+
+_rec_copied_chunks = CounterRecorder("migration.copied_chunks")
+_rec_copied_bytes = CounterRecorder("migration.copied_bytes")
+_rec_jobs_done = CounterRecorder("migration.jobs_done")
+_rec_retired_targets = CounterRecorder("migration.retired_targets")
+_rec_active = ValueRecorder("migration.active_jobs")
+
+
+def record_retired_target(n: int = 1) -> None:
+    """Storage-node hook: a target whose routing assignment vanished was
+    closed + trash-routed (bin/storage_main.py scan_targets)."""
+    _rec_retired_targets.add(n)
+
+
+# ---------------------------------------------------------------------------
+# chain-to-chain copy service (ref src/migration/service/Service.h)
+# ---------------------------------------------------------------------------
 
 class JobState(enum.IntEnum):
     PENDING = 0
@@ -50,11 +107,10 @@ class Job:
 
 
 class MigrationService:
-    """Job registry + chunk-copy executor over the storage messenger."""
+    """Job registry + batched chunk-copy executor over a StorageClient."""
 
-    def __init__(self, routing_provider: Callable, messenger: Messenger):
-        self._routing = routing_provider
-        self._send = messenger
+    def __init__(self, client):
+        self._client = client
         self._jobs: Dict[int, Job] = {}
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
@@ -88,98 +144,101 @@ class MigrationService:
 
     # -- executor -----------------------------------------------------------
     def _head_target(self, chain_id: int):
-        routing = self._routing()
+        routing = self._client._routing()
         chain = routing.chains.get(chain_id)
         if chain is None:
             raise err(Code.CHAIN_NOT_FOUND, f"chain {chain_id}")
         head = chain.head()
         if head is None:
             raise err(Code.TARGET_OFFLINE, f"chain {chain_id} has no serving head")
-        info = routing.targets.get(head.target_id)
-        if info is None:
+        node = routing.node_of_target(head.target_id)
+        if node is None:
             raise err(Code.TARGET_NOT_FOUND,
                       f"target {head.target_id} not in routing info")
-        return head.target_id, info.node_id, chain
+        return head.target_id, node.node_id
 
     def _scan(self, job: Job) -> None:
-        target_id, node_id, _ = self._head_target(job.src_chain)
-        metas = self._send(node_id, "dump_chunkmeta", target_id)
+        target_id, node_id = self._head_target(job.src_chain)
+        metas = self._client.dump_chunkmeta(node_id, target_id)
         job._queue = [m.chunk_id.to_bytes() for m in metas if m.committed_ver > 0]
         job.total = len(job._queue)
         job._scanned = True
 
     def step(self, job_id: int, batch: int = 64) -> int:
-        """Copy up to `batch` chunks; returns number copied this step.
-        Traffic is tagged MIGRATION (tpu3fs/qos) so destination update
-        workers schedule it behind foreground IO; an OVERLOADED shed
-        pauses the job for the server's retry-after hint and leaves it
-        RUNNING — migration self-throttles under pressure instead of
-        failing or hammering."""
-        from tpu3fs.qos.core import TrafficClass, retry_after_ms_of, tagged
+        """Copy up to `batch` chunks as ONE batched read + ONE batched
+        full-replace write; returns chunks copied this step. Traffic is
+        tagged MIGRATION (tpu3fs/qos) so destination update workers
+        schedule it behind foreground IO; an OVERLOADED shed pauses the
+        job for the server's retry-after hint and leaves it RUNNING —
+        migration self-throttles under pressure instead of failing or
+        hammering."""
+        from tpu3fs.qos.core import TrafficClass, tagged
 
         job = self.job(job_id)
         if job is None or job.state != JobState.RUNNING:
             return 0
         with tagged(TrafficClass.MIGRATION):
-            return self._step_tagged(job, batch, retry_after_ms_of)
+            return self._step_tagged(job, batch)
 
-    def _step_tagged(self, job: Job, batch: int, retry_after_ms_of) -> int:
+    def _step_tagged(self, job: Job, batch: int) -> int:
         try:
             if not job._scanned:
                 self._scan(job)
-            src_target, src_node, src_chain = self._head_target(job.src_chain)
-            _, dst_node, dst_chain = self._head_target(job.dst_chain)
-            copied = 0
-            while job._queue and copied < batch:
-                with self._lock:
-                    if job.state != JobState.RUNNING:
-                        return copied  # concurrent stop_job wins
-                raw = job._queue.pop()
-                chunk_id = ChunkId.from_bytes(raw)
-                rd = self._send(src_node, "read", ReadReq(
-                    chain_id=job.src_chain, chunk_id=chunk_id,
-                    target_id=src_target))
-                if rd.code == Code.OVERLOADED:
-                    job._queue.append(raw)  # keep the chunk for next step
-                    self._throttle(rd, retry_after_ms_of)
-                    return copied
-                if not rd.ok:
-                    raise err(rd.code, f"read {chunk_id} failed")
-                # full_replace: install the copy as the chunk's entire
-                # committed content — a plain CRAQ write would merge with any
-                # pre-existing destination chunk (COW overlay) and corrupt it
-                wr = self._send(dst_node, "write", WriteReq(
-                    chain_id=job.dst_chain,
-                    chain_ver=dst_chain.chain_version,
-                    chunk_id=chunk_id, offset=0, data=rd.data,
-                    chunk_size=0,  # 0 = destination target's configured size
-                    client_id=f"migration-{job.job_id}",
-                    full_replace=True))
-                if wr.code == Code.OVERLOADED:
-                    job._queue.append(raw)
-                    self._throttle(wr, retry_after_ms_of)
-                    return copied
-                if not wr.ok:
-                    raise err(wr.code, f"write {chunk_id} failed")
-                copied += 1
-                job.copied += 1
-            if not job._queue:
+            self._head_target(job.dst_chain)  # dst must be routable
+            with self._lock:
+                if job.state != JobState.RUNNING:
+                    return 0  # concurrent stop_job wins
+                raws = job._queue[-batch:]
+            if not raws:
                 with self._lock:
                     if job.state == JobState.RUNNING:
                         job.state = JobState.DONE
+                return 0
+            ids = [ChunkId.from_bytes(raw) for raw in raws]
+            reads = self._client.batch_read(
+                [ReadReq(job.src_chain, cid, 0, -1) for cid in ids])
+            writes, widx = [], []
+            shed_hint = 0
+            for i, rd in enumerate(reads):
+                if rd.code in (Code.OVERLOADED, Code.TENANT_THROTTLED):
+                    shed_hint = max(shed_hint, rd.retry_after_ms or 10)
+                    continue
+                if not rd.ok:
+                    raise err(rd.code, f"read {ids[i]} failed")
+                # full_replace: install the copy as the chunk's entire
+                # committed content — a plain CRAQ write would merge with
+                # any pre-existing destination chunk (COW overlay) and
+                # corrupt it. chunk_size=0 = destination target's size.
+                writes.append((job.dst_chain, ids[i], 0, rd.data))
+                widx.append(i)
+            replies = self._client.batch_write(
+                writes, chunk_size=0, full_replace=True) if writes else []
+            copied = 0
+            done_raws = []
+            for k, wr in enumerate(replies):
+                i = widx[k]
+                if wr.code in (Code.OVERLOADED, Code.TENANT_THROTTLED):
+                    shed_hint = max(shed_hint, wr.retry_after_ms or 10)
+                    continue
+                if not wr.ok:
+                    raise err(wr.code, f"write {ids[i]} failed")
+                copied += 1
+                done_raws.append(raws[i])
+                _rec_copied_chunks.add(1)
+                _rec_copied_bytes.add(len(writes[k][3]))
+            with self._lock:
+                done = set(done_raws)
+                job._queue = [r for r in job._queue if r not in done]
+                job.copied += copied
+                if not job._queue and job.state == JobState.RUNNING:
+                    job.state = JobState.DONE
+            if shed_hint:
+                time.sleep(max(shed_hint, 10) / 1000.0)
             return copied
         except FsError as e:
             job.state = JobState.FAILED
             job.error = str(e)
             return 0
-
-    @staticmethod
-    def _throttle(reply, retry_after_ms_of) -> None:
-        import time
-
-        hint = (getattr(reply, "retry_after_ms", 0)
-                or retry_after_ms_of(getattr(reply, "message", "") or ""))
-        time.sleep(max(hint, 10) / 1000.0)
 
     def run_job(self, job_id: int, batch: int = 64, max_steps: int = 10_000) -> Job:
         """Drive one job to completion (or failure/stop)."""
@@ -189,3 +248,268 @@ class MigrationService:
             if job is None or job.state != JobState.RUNNING:
                 break
         return self.job(job_id)
+
+
+# ---------------------------------------------------------------------------
+# mgmtd-coordinated elasticity worker
+# ---------------------------------------------------------------------------
+
+class MigrationWorker:
+    """Claims ``MigrationJob``s from mgmtd and executes them phase by
+    phase. Stateless between rounds: ALL durable state is the mgmtd job
+    record plus the cluster itself, so any worker instance (including a
+    restart after SIGKILL) continues any job. ``mgmtd`` is an in-process
+    ``Mgmtd`` or an ``MgmtdAdminRpcClient`` — same surface."""
+
+    def __init__(self, mgmtd, client, *, worker_id: str = "",
+                 batch_chunks: int = 64, lease_s: float = 30.0,
+                 max_jobs: int = 4,
+                 clock: Callable[[], float] = time.monotonic):
+        self._mgmtd = mgmtd
+        self._client = client
+        self.worker_id = worker_id or f"mig-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self._batch = batch_chunks
+        self._lease_s = lease_s
+        self._max_jobs = max_jobs
+        self._clock = clock
+
+    # -- driver --------------------------------------------------------------
+    def run_once(self) -> int:
+        """Claim runnable jobs and advance each by one bounded step.
+        Returns the number of jobs that made progress. Transport errors
+        (mgmtd failover, dead destination) leave jobs claimed-but-parked;
+        the next round — or the next worker after our lease lapses —
+        retries."""
+        from tpu3fs.qos.core import TrafficClass, tagged
+
+        try:
+            jobs = self._mgmtd.migration_claim(
+                self.worker_id, max_jobs=self._max_jobs,
+                lease_s=self._lease_s)
+        except FsError:
+            return 0
+        # one job per chain at a time is the mgmtd submit invariant;
+        # claims arrive id-ordered so waves execute in plan order
+        _rec_active.set(len(jobs))
+        advanced = 0
+        with tagged(TrafficClass.MIGRATION):
+            for job in jobs:
+                try:
+                    if self.step(job):
+                        advanced += 1
+                except FsError as e:
+                    if e.code in (Code.MIGRATION_CONFLICT,
+                                  Code.MIGRATION_JOB_NOT_FOUND):
+                        continue  # another worker took over
+                    if e.code in (Code.MGMTD_CHAIN_NOT_FOUND,
+                                  Code.INVALID_ARG):
+                        self._report(job, phase=JobPhase.FAILED,
+                                     error=str(e))
+                        continue
+                    # transient (transport, shed, quorum wait): park,
+                    # record the reason, retry next round
+                    self._report(job, error=str(e))
+        return advanced
+
+    def run_until_idle(self, *, rounds: int = 200,
+                       tick: Optional[Callable[[], None]] = None,
+                       sleep_s: float = 0.0) -> int:
+        """Test/CLI driver: rounds until no active jobs remain. ``tick``
+        runs the mgmtd background pass between rounds (fabric clusters
+        have no tick loop of their own)."""
+        done = 0
+        for _ in range(rounds):
+            self.run_once()
+            if tick is not None:
+                tick()
+            jobs = self._mgmtd.migration_list()
+            if not any(j.active for j in jobs):
+                return sum(1 for j in jobs
+                           if JobPhase(j.phase) == JobPhase.DONE)
+            if sleep_s:
+                time.sleep(sleep_s)
+        raise TimeoutError("migration jobs did not converge")
+
+    # -- one phase step -------------------------------------------------------
+    def step(self, job: MigrationJob) -> bool:
+        """Advance ``job`` by at most one phase transition (plus one copy
+        round). True = progress was made."""
+        phase = JobPhase(job.phase)
+        if phase == JobPhase.PENDING:
+            return self._step_prepare(job)
+        if phase == JobPhase.PREPARED:
+            return self._step_wait_syncing(job)
+        if phase == JobPhase.COPYING:
+            return self._step_copy(job)
+        if phase == JobPhase.SYNCED:
+            return self._step_cutover(job)
+        if phase == JobPhase.CUTOVER:
+            self._report(job, phase=JobPhase.DONE)
+            _rec_jobs_done.add(1)
+            return True
+        return False
+
+    # -- phase handlers (each idempotent under re-execution) ------------------
+    def _routing(self):
+        invalidate = getattr(self._client, "_routing_invalidate", None)
+        if invalidate is not None:
+            invalidate()
+        return self._client._routing()
+
+    def _chain(self, routing, job: MigrationJob):
+        chain = routing.chains.get(job.chain_id)
+        if chain is None:
+            raise err(Code.MGMTD_CHAIN_NOT_FOUND, str(job.chain_id))
+        return chain
+
+    def _member(self, chain, target_id: int):
+        return next((t for t in chain.targets if t.target_id == target_id),
+                    None)
+
+    def _step_prepare(self, job: MigrationJob) -> bool:
+        # re-execution safe: already-a-member is a mgmtd-side no-op
+        self._mgmtd.add_chain_target(
+            job.chain_id, job.new_target, job.dst_node,
+            replace_of=(job.out_target if job.is_ec else 0))
+        self._report(job, phase=JobPhase.PREPARED)
+        return True
+
+    def _step_wait_syncing(self, job: MigrationJob) -> bool:
+        routing = self._routing()
+        chain = self._chain(routing, job)
+        member = self._member(chain, job.new_target)
+        if member is None:
+            # routing lag after a failover: re-prepare (idempotent)
+            return self._step_prepare(job)
+        if member.public_state == PublicTargetState.SERVING:
+            self._report(job, phase=JobPhase.SYNCED)
+            return True
+        if member.public_state == PublicTargetState.SYNCING:
+            self._report(job, phase=JobPhase.COPYING)
+            return True
+        return False  # WAITING/OFFLINE: node hasn't opened it yet
+
+    def _step_copy(self, job: MigrationJob) -> bool:
+        routing = self._routing()
+        chain = self._chain(routing, job)
+        member = self._member(chain, job.new_target)
+        if member is None:
+            return self._step_prepare(job)
+        if member.public_state == PublicTargetState.SERVING:
+            self._report(job, phase=JobPhase.SYNCED)
+            return True
+        if member.public_state != PublicTargetState.SYNCING:
+            return False  # destination bounced: wait for re-promotion
+        if job.is_ec:
+            # the shard is decode-rebuilt by the chain's EcResyncWorker
+            # (storage-side, EC_REBUILD class); we only monitor
+            return False
+        return self._copy_round(job, routing, chain)
+
+    def _copy_round(self, job: MigrationJob, routing, chain) -> bool:
+        """One bounded CR copy round: diff the destination against the
+        serving head, ship one batch of full-replace installs, declare
+        sync-done when the diff is empty. Every piece re-runs safely:
+        reads are idempotent, installs dedupe by version, sync-done is a
+        no-op repeat."""
+        head = chain.head()
+        if head is None:
+            return False  # no serving source: nothing safe to copy from
+        head_node = routing.node_of_target(head.target_id)
+        writers = chain.writer_chain()
+        my_idx = next((i for i, t in enumerate(writers)
+                       if t.target_id == job.new_target), None)
+        if head_node is None or my_idx is None or my_idx == 0:
+            return False
+        pred = writers[my_idx - 1].target_id
+        src = [m for m in self._client.dump_chunkmeta(
+            head_node.node_id, head.target_id) if m.committed_ver > 0]
+        have = {m.chunk_id: m for m in self._client.dump_chunkmeta(
+            job.dst_node, job.new_target)}
+        todo = []
+        for m in src:
+            mine = have.get(m.chunk_id)
+            if (mine is not None and mine.committed_ver >= m.committed_ver
+                    and (mine.committed_ver > m.committed_ver
+                         or mine.checksum.value == m.checksum.value)):
+                continue
+            todo.append(m)
+        if not todo:
+            self._client.sync_done(job.dst_node, job.new_target)
+            self._report(job, phase=JobPhase.SYNCED)
+            return True
+        batch = todo[:self._batch]
+        reads = self._client.batch_read(
+            [ReadReq(job.chain_id, m.chunk_id, 0, -1) for m in batch])
+        reqs, sizes = [], []
+        hint = 0
+        for m, rd in zip(batch, reads):
+            if not rd.ok:
+                hint = max(hint, rd.retry_after_ms)
+                continue  # re-diffed next round
+            reqs.append(WriteReq(
+                chain_id=job.chain_id,
+                chain_ver=chain.chain_version,
+                chunk_id=m.chunk_id,
+                offset=0,
+                data=rd.data,
+                chunk_size=0,   # destination target's configured size
+                client_id=f"migration-{job.job_id}",
+                update_ver=rd.commit_ver,
+                full_replace=True,
+                from_target=pred,
+            ))
+            sizes.append(len(rd.data))
+        replies = self._client.batch_sync_write(job.dst_node, reqs)
+        copied = nbytes = 0
+        for sz, wr in zip(sizes, replies):
+            if wr.code in (Code.OVERLOADED, Code.TENANT_THROTTLED):
+                hint = max(hint, wr.retry_after_ms or 10)
+                continue
+            if wr.ok:
+                copied += 1
+                nbytes += sz
+        if copied:
+            _rec_copied_chunks.add(copied)
+            _rec_copied_bytes.add(nbytes)
+            self._report(job, copied_chunks=copied, copied_bytes=nbytes)
+        if hint:
+            # the destination shed us: self-throttle for its hint — the
+            # migration class is exactly the traffic QoS exists to pace
+            time.sleep(max(hint, 10) / 1000.0)
+        return copied > 0
+
+    def _step_cutover(self, job: MigrationJob) -> bool:
+        routing = self._routing()
+        chain = self._chain(routing, job)
+        member = self._member(chain, job.new_target)
+        if member is None or member.public_state != PublicTargetState.SERVING:
+            if member is not None \
+                    and member.public_state == PublicTargetState.SYNCING \
+                    and not job.is_ec:
+                # destination bounced after sync-done: top the copy back up
+                self._copy_round(job, routing, chain)
+            return False
+        if job.out_target and self._member(chain, job.out_target) is not None:
+            # the old member stayed readable until HERE — the new replica
+            # serves; quorum floor = the chain's nominal width (every
+            # remaining member must be serving for the drop to land)
+            self._mgmtd.drop_chain_target(
+                job.chain_id, job.out_target,
+                min_serving=len(chain.targets) - 1)
+        self._report(job, phase=JobPhase.CUTOVER)
+        return True
+
+    def _report(self, job: MigrationJob, *, phase: Optional[JobPhase] = None,
+                copied_chunks: int = 0, copied_bytes: int = 0,
+                error: str = "") -> None:
+        try:
+            self._mgmtd.migration_report(
+                job.job_id, self.worker_id, phase=phase,
+                copied_chunks=copied_chunks, copied_bytes=copied_bytes,
+                error=error, lease_s=self._lease_s)
+        except FsError as e:
+            if e.code in (Code.MIGRATION_CONFLICT,
+                          Code.MIGRATION_JOB_NOT_FOUND):
+                raise
+            # mgmtd hiccup: the phase re-executes next round (safe)

@@ -1,0 +1,73 @@
+"""Migration job schema: the crash-safe unit of cluster reshaping.
+
+One ``MigrationJob`` moves ONE chain membership: replace ``out_target``
+(a member leaving a draining/dead node) with ``new_target`` on
+``dst_node``. Jobs are persisted in the mgmtd KV (``KeyPrefix.MIGRATION``,
+mirroring the reference's src/migration job service whose state rides the
+cluster store) so a SIGKILLed worker — or a failed-over mgmtd — resumes
+exactly where the last phase transition committed. Every phase handler
+is idempotent re-execution (docs/placement.md "crash matrix").
+
+The phase ladder is strictly monotonic; a job can only move forward (or
+to FAILED/CANCELLED). ``phase_order`` gaps are deliberate room for
+future intermediate states without renumbering persisted jobs.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class JobPhase(enum.IntEnum):
+    PENDING = 0     # submitted; chain untouched
+    PREPARED = 10   # chain mutated: new target joined (CR) / swapped (EC)
+    COPYING = 20    # full-chunk copy onto the syncing target in progress
+    SYNCED = 30     # sync-done sent; waiting for mgmtd promotion
+    CUTOVER = 40    # new target SERVING; old member dropped from the chain
+    DONE = 50       # old target's chunks retired (trash-routed)
+    FAILED = 90
+    CANCELLED = 91
+
+    @property
+    def active(self) -> bool:
+        return self < JobPhase.DONE
+
+    @property
+    def terminal(self) -> bool:
+        return not self.active
+
+
+@dataclass
+class MoveSpec:
+    """One planned chain-membership replacement (placement/rebalance.py
+    emits these; ``migrationSubmit`` turns them into jobs)."""
+
+    chain_id: int
+    out_target: int = 0     # member leaving (0 = pure capacity add)
+    dst_node: int = 0
+    new_target: int = 0     # 0 = mgmtd allocates a fresh target id
+
+
+@dataclass
+class MigrationJob:
+    job_id: int
+    chain_id: int
+    out_target: int = 0
+    new_target: int = 0
+    dst_node: int = 0
+    is_ec: bool = False
+    phase: JobPhase = JobPhase.PENDING
+    # claim lease: a worker owns the job until claim_expire; a crashed
+    # worker's claim lapses and any worker re-claims (resume)
+    worker: str = ""
+    claim_expire: float = 0.0
+    copied_chunks: int = 0
+    copied_bytes: int = 0
+    error: str = ""
+    submitted_at: float = 0.0
+    updated_at: float = 0.0
+
+    @property
+    def active(self) -> bool:
+        return JobPhase(self.phase).active

@@ -1,3 +1,12 @@
+from tpu3fs.placement.rebalance import (  # noqa: F401
+    DRAINING_TAG,
+    PlannedMove,
+    RebalancePlan,
+    TopologyDelta,
+    check_plan,
+    incidence_of_routing,
+    plan_rebalance,
+)
 from tpu3fs.placement.solver import (  # noqa: F401
     PlacementProblem,
     check_solution,

@@ -128,8 +128,8 @@ def solve_placement(
                          if target_lambda is not None else traffic_tgt)
     tgt = target_lambda if target_lambda is not None else problem.lambda_lower_bound
     best_max, best_ssq = _score_np(M)
-    if best_max <= tgt:
-        return M
+    if best_max <= tgt or b < 2:
+        return M  # already optimal, or a single group has no swap moves
 
     P = proposals_per_step
 
@@ -144,24 +144,32 @@ def solve_placement(
     rng = np.random.default_rng(seed)
     temperature = 1.0
     for _step in range(steps):
-        # propose P swap moves: (group g, member out, member in) exchanged
-        # with another group g2 that has `in` but not `out` — preserving both
-        # row and column sums
+        # propose P swap moves FULLY VECTORIZED: for each proposal pick
+        # two distinct groups (g1, g2) and exchange one member a ∈ g1∖g2
+        # with one c ∈ g2∖g1 — preserving both row sums (k) and column
+        # sums (r). Member selection is a weighted argmax over the
+        # difference masks; proposals whose groups have no exchangeable
+        # members (identical membership) fall back to the current table
+        # and simply score as no-ops.
         cand = np.repeat(M[None, :, :], P, axis=0)
-        for p in range(P):
-            for _try in range(8):
-                g1, g2 = rng.integers(0, b, 2)
-                if g1 == g2:
-                    continue
-                in_g1 = np.nonzero(cand[p, g1] & ~cand[p, g2])[0]
-                in_g2 = np.nonzero(cand[p, g2] & ~cand[p, g1])[0]
-                if len(in_g1) == 0 or len(in_g2) == 0:
-                    continue
-                a = int(rng.choice(in_g1))
-                c = int(rng.choice(in_g2))
-                cand[p, g1, a], cand[p, g1, c] = 0, 1
-                cand[p, g2, c], cand[p, g2, a] = 0, 1
-                break
+        g1 = rng.integers(0, b, P)
+        g2 = (g1 + rng.integers(1, b, P)) % b   # distinct by construction
+        rows1 = M[g1].astype(bool)              # (P, v)
+        rows2 = M[g2].astype(bool)
+        only1 = rows1 & ~rows2
+        only2 = rows2 & ~rows1
+        valid = only1.any(axis=1) & only2.any(axis=1)
+        # random member pick inside each mask: argmax of uniform noise
+        # restricted to the mask (masked-out entries score -1)
+        noise_a = np.where(only1, rng.random((P, v)), -1.0)
+        noise_c = np.where(only2, rng.random((P, v)), -1.0)
+        a = noise_a.argmax(axis=1)
+        c = noise_c.argmax(axis=1)
+        pi = np.nonzero(valid)[0]
+        cand[pi, g1[pi], a[pi]] = 0
+        cand[pi, g1[pi], c[pi]] = 1
+        cand[pi, g2[pi], c[pi]] = 0
+        cand[pi, g2[pi], a[pi]] = 1
         maxs, ssqs = jax.device_get(score_batch(jnp.asarray(cand)))
         order = np.lexsort((ssqs, maxs))
         bi = order[0]

@@ -90,6 +90,17 @@ CLASSIFICATION: Dict[Tuple[str, str], str] = {
     ("Mgmtd", "setConfig"): MUTATING,
     ("Mgmtd", "getConfig"): IDEMPOTENT,
     ("Mgmtd", "tick"): MUTATING,
+    # elasticity / migration control plane (docs/placement.md). The
+    # chain mutations and job reports are MUTATING for hedging purposes
+    # but REPLAY-SAFE by construction (see REPLAY_SAFE_MUTATIONS below):
+    # the crash-resumed migration worker re-executes them blindly.
+    ("Mgmtd", "addChainTarget"): MUTATING,
+    ("Mgmtd", "dropChainTarget"): MUTATING,
+    ("Mgmtd", "setNodeTags"): MUTATING,
+    ("Mgmtd", "migrationSubmit"): MUTATING,
+    ("Mgmtd", "migrationList"): IDEMPOTENT,
+    ("Mgmtd", "migrationClaim"): MUTATING,
+    ("Mgmtd", "migrationReport"): MUTATING,
     # -- Usrbio (shm-ring control plane; the DATA rides StorageSerde) -----
     ("Usrbio", "usrbioHandshake"): IDEMPOTENT,
     ("Usrbio", "usrbioRegister"): MUTATING,    # spawns a ring worker
@@ -132,6 +143,34 @@ CLASSIFICATION: Dict[Tuple[str, str], str] = {
 HEDGE_SAFE_MESSENGER_METHODS: Dict[str, Tuple[str, str]] = {
     "read": ("StorageSerde", "read"),
     "batch_read": ("StorageSerde", "batchRead"),
+}
+
+#: MUTATING methods whose blind RE-EXECUTION (not hedging — serial
+#: replay after a crash, same arguments) converges instead of
+#: double-applying, each with the mechanism that makes it so. The
+#: crash-resumed migration worker re-runs its current phase from the
+#: top, so every mutation it issues must appear here or classify
+#: idempotent — check_rpc_registry check 8 enforces exactly that
+#: against migration/service.py's RESUME_REEXECUTED_METHODS.
+REPLAY_SAFE_MUTATIONS: Dict[Tuple[str, str], str] = {
+    ("StorageSerde", "update"): "version-guarded: a full-replace at an "
+        "already-committed update_ver answers CHUNK_STALE_UPDATE -> OK",
+    ("StorageSerde", "batchUpdate"): "same per-op stale-update dedupe as "
+        "update",
+    ("StorageSerde", "batchWrite"): "exactly-once per (client, channel, "
+        "seqnum): replays answer from the channel table",
+    ("StorageSerde", "syncDone"): "sets local_state UPTODATE; repeat is "
+        "a no-op",
+    ("StorageSerde", "removeChunk"): "removing an absent chunk returns "
+        "false, changes nothing",
+    ("Mgmtd", "addChainTarget"): "already-a-member is a committed "
+        "PREPARE: explicit no-op",
+    ("Mgmtd", "dropChainTarget"): "already-dropped is a committed "
+        "CUTOVER: explicit no-op",
+    ("Mgmtd", "migrationClaim"): "claim lease CAS: re-claiming your own "
+        "(or a lapsed) claim just renews it",
+    ("Mgmtd", "migrationReport"): "phases only move forward; re-reporting "
+        "a passed phase is a no-op",
 }
 
 

@@ -28,6 +28,7 @@ from tpu3fs.meta.store import (
 from tpu3fs.meta.types import DirEntry, Inode, Layout
 from tpu3fs.mgmtd.service import HeartbeatReply, Mgmtd
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType, RoutingInfo
+from tpu3fs.migration.types import MigrationJob, MoveSpec
 from tpu3fs.rpc.net import RpcClient, RpcServer, ServiceDef
 from tpu3fs.storage.craq import (
     ReadReply,
@@ -1899,6 +1900,61 @@ class UploadChainTableReq:
 
 
 @dataclass
+class AddChainTargetReq:
+    chain_id: int
+    target_id: int
+    node_id: int
+    disk_index: int = 0
+    replace_of: int = 0   # EC: member whose shard slot the target takes
+
+
+@dataclass
+class DropChainTargetReq:
+    chain_id: int
+    target_id: int
+    min_serving: int = 1  # quorum floor the chain must keep after the drop
+
+
+@dataclass
+class SetNodeTagsReq:
+    node_id: int
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class MigrationSubmitReq:
+    specs: List[MoveSpec] = field(default_factory=list)
+
+
+@dataclass
+class MigrationIdsRsp:
+    job_ids: List[int] = field(default_factory=list)
+
+
+@dataclass
+class MigrationJobsRsp:
+    jobs: List[MigrationJob] = field(default_factory=list)
+
+
+@dataclass
+class MigrationClaimReq:
+    worker: str
+    max_jobs: int = 4
+    lease_s: float = 30.0
+
+
+@dataclass
+class MigrationReportReq:
+    job_id: int
+    worker: str
+    phase: int = -1        # -1 = progress/renewal only, no transition
+    copied_chunks: int = 0
+    copied_bytes: int = 0
+    error: str = ""
+    lease_s: float = 30.0
+
+
+@dataclass
 class SetConfigReq:
     node_type: int
     content: str = ""
@@ -1943,6 +1999,41 @@ def bind_mgmtd_admin(service: "ServiceDef", mgmtd: Mgmtd) -> None:
         mgmtd.tick()
         return IntReply(mgmtd.get_routing_info().version)
 
+    # -- elasticity: live chain mutation + crash-safe migration jobs -------
+    def add_chain_target(req: AddChainTargetReq) -> Empty:
+        mgmtd.add_chain_target(req.chain_id, req.target_id, req.node_id,
+                               disk_index=req.disk_index,
+                               replace_of=req.replace_of)
+        return Empty()
+
+    def drop_chain_target(req: DropChainTargetReq) -> Empty:
+        mgmtd.drop_chain_target(req.chain_id, req.target_id,
+                                min_serving=req.min_serving)
+        return Empty()
+
+    def set_node_tags(req: SetNodeTagsReq) -> Empty:
+        mgmtd.set_node_tags(req.node_id, req.tags)
+        return Empty()
+
+    def migration_submit(req: MigrationSubmitReq) -> MigrationIdsRsp:
+        return MigrationIdsRsp(mgmtd.migration_submit(req.specs))
+
+    def migration_list(_r: Empty) -> MigrationJobsRsp:
+        return MigrationJobsRsp(mgmtd.migration_list())
+
+    def migration_claim(req: MigrationClaimReq) -> MigrationJobsRsp:
+        return MigrationJobsRsp(mgmtd.migration_claim(
+            req.worker, max_jobs=req.max_jobs, lease_s=req.lease_s))
+
+    def migration_report(req: MigrationReportReq) -> MigrationJobsRsp:
+        job = mgmtd.migration_report(
+            req.job_id, req.worker,
+            phase=(req.phase if req.phase >= 0 else None),
+            copied_chunks=req.copied_chunks,
+            copied_bytes=req.copied_bytes,
+            error=req.error, lease_s=req.lease_s)
+        return MigrationJobsRsp([job])
+
     service.method(4, "createTarget", CreateTargetReq, Empty, create_target)
     service.method(5, "uploadChain", UploadChainReq, Empty, upload_chain)
     service.method(6, "uploadChainTable", UploadChainTableReq, Empty,
@@ -1950,6 +2041,19 @@ def bind_mgmtd_admin(service: "ServiceDef", mgmtd: Mgmtd) -> None:
     service.method(7, "setConfig", SetConfigReq, IntReply, set_config)
     service.method(8, "getConfig", GetConfigReq, ConfigRsp, get_config)
     service.method(9, "tick", Empty, IntReply, tick)
+    service.method(10, "addChainTarget", AddChainTargetReq, Empty,
+                   add_chain_target)
+    service.method(11, "dropChainTarget", DropChainTargetReq, Empty,
+                   drop_chain_target)
+    service.method(12, "setNodeTags", SetNodeTagsReq, Empty, set_node_tags)
+    service.method(13, "migrationSubmit", MigrationSubmitReq,
+                   MigrationIdsRsp, migration_submit)
+    service.method(14, "migrationList", Empty, MigrationJobsRsp,
+                   migration_list)
+    service.method(15, "migrationClaim", MigrationClaimReq,
+                   MigrationJobsRsp, migration_claim)
+    service.method(16, "migrationReport", MigrationReportReq,
+                   MigrationJobsRsp, migration_report)
 
 
 class MgmtdAdminRpcClient(MgmtdRpcClient):
@@ -1981,6 +2085,43 @@ class MgmtdAdminRpcClient(MgmtdRpcClient):
 
     def tick(self) -> int:
         return self._call(9, Empty(), IntReply).value
+
+    # -- elasticity (same names/signatures as the in-process Mgmtd) -------
+    def add_chain_target(self, chain_id: int, target_id: int, node_id: int,
+                         *, disk_index: int = 0, replace_of: int = 0) -> None:
+        self._call(10, AddChainTargetReq(chain_id, target_id, node_id,
+                                         disk_index, replace_of), Empty)
+
+    def drop_chain_target(self, chain_id: int, target_id: int,
+                          *, min_serving: int = 1) -> None:
+        self._call(11, DropChainTargetReq(chain_id, target_id, min_serving),
+                   Empty)
+
+    def set_node_tags(self, node_id: int, tags: Dict[str, str]) -> None:
+        self._call(12, SetNodeTagsReq(node_id, dict(tags)), Empty)
+
+    def migration_submit(self, specs: List[MoveSpec]) -> List[int]:
+        return self._call(13, MigrationSubmitReq(list(specs)),
+                          MigrationIdsRsp).job_ids
+
+    def migration_list(self) -> List[MigrationJob]:
+        return self._call(14, Empty(), MigrationJobsRsp).jobs
+
+    def migration_claim(self, worker: str, *, max_jobs: int = 4,
+                        lease_s: float = 30.0) -> List[MigrationJob]:
+        return self._call(15, MigrationClaimReq(worker, max_jobs, lease_s),
+                          MigrationJobsRsp).jobs
+
+    def migration_report(self, job_id: int, worker: str, *,
+                         phase=None, copied_chunks: int = 0,
+                         copied_bytes: int = 0, error: str = "",
+                         lease_s: float = 30.0) -> MigrationJob:
+        rsp = self._call(16, MigrationReportReq(
+            job_id, worker,
+            phase=(-1 if phase is None else int(phase)),
+            copied_chunks=copied_chunks, copied_bytes=copied_bytes,
+            error=error, lease_s=lease_s), MigrationJobsRsp)
+        return rsp.jobs[0]
 
     def get_routing_info(self, known_version: int = -1):
         if known_version >= 0:

@@ -602,6 +602,16 @@ class StorageService:
     def targets(self) -> List[StorageTarget]:
         return list(self._targets.values())
 
+    def drop_target(self, target_id: int) -> Optional[StorageTarget]:
+        """Detach a target this node no longer serves (migration cutover
+        retired it from routing). The object is returned so the caller
+        can close/trash-route its engine; in-flight ops racing the drop
+        fail TARGET_NOT_FOUND like any routing miss and retry elsewhere."""
+        target = self._targets.pop(target_id, None)
+        if target is not None:
+            self._invalidate_fastpath(target_id)
+        return target
+
     def set_messenger(self, messenger: Messenger) -> None:
         self._messenger = messenger
 

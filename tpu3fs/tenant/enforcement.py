@@ -103,6 +103,17 @@ ENFORCEMENT: Dict[Tuple[str, str], str] = {
     ("Mgmtd", "setConfig"): EXEMPT,
     ("Mgmtd", "getConfig"): EXEMPT,
     ("Mgmtd", "tick"): EXEMPT,
+    # elasticity / migration control plane: operator + worker traffic;
+    # the DATA the workers move is charged/classified where it flows
+    # (StorageSerde methods under the migration/ec_rebuild classes,
+    # which are BACKGROUND — system work, never tenant-charged)
+    ("Mgmtd", "addChainTarget"): EXEMPT,
+    ("Mgmtd", "dropChainTarget"): EXEMPT,
+    ("Mgmtd", "setNodeTags"): EXEMPT,
+    ("Mgmtd", "migrationSubmit"): EXEMPT,
+    ("Mgmtd", "migrationList"): EXEMPT,
+    ("Mgmtd", "migrationClaim"): EXEMPT,
+    ("Mgmtd", "migrationReport"): EXEMPT,
     ("Core", "echo"): EXEMPT,
     ("Core", "renderConfig"): EXEMPT,
     ("Core", "hotUpdateConfig"): EXEMPT,

@@ -153,6 +153,15 @@ class Code(enum.IntEnum):
     #                               ring allowlist (usrbio/transport.py
     #                               RING_METHODS) — never dispatched
 
+    # migration / elasticity subsystem 13xx (tpu3fs/migration, placement)
+    MIGRATION_QUORUM = 1300       # chain mutation refused: it would drop the
+    #                               chain below its serving write-quorum
+    #                               mid-plan (docs/placement.md invariants)
+    MIGRATION_CONFLICT = 1301     # an ACTIVE job already reshapes this
+    #                               chain / the claim belongs to another
+    #                               live worker
+    MIGRATION_JOB_NOT_FOUND = 1302
+
 
 #: Codes on which a client-side retry ladder may re-issue the request.
 RETRYABLE_CODES = frozenset(
