@@ -599,3 +599,27 @@ class TestEcFirstClassWrites:
         assert fio.read(inode, 0, len(data)) == bytes(shadow)
         assert fio.storage._ec_rmw_fallback._value >= 1
         fab.close()
+
+
+class TestEcPartialWriteErrorPath:
+    def test_failed_rmw_read_raises_fserror_with_message(self):
+        """A failed stripe read inside the partial-EC RMW ladder must
+        surface as FsError(code, message), not AttributeError — failed
+        ReadReplies carry no message field (found by the production-day
+        soak: an archive write failing inside a fault window crashed the
+        client instead of raising the real error)."""
+        from tpu3fs.storage.craq import ReadReply
+        from tpu3fs.utils.result import FsError
+
+        fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=4, num_chains=1, chunk_size=1 << 14,
+            ec_k=3, ec_m=1))
+        fio = fab.file_client()
+        sc = fio.storage
+        sc.write_stripe_rmw = lambda *a, **k: None   # force the ladder
+        sc.read_stripe = lambda *a, **k: ReadReply(Code.TARGET_OFFLINE)
+        inode = fab.meta.create("/ecf").inode
+        with pytest.raises(FsError) as ei:
+            fio.write(inode, 8, b"x" * 64)
+        assert ei.value.code == Code.TARGET_OFFLINE
+        assert "stripe RMW read" in ei.value.status.message

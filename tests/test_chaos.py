@@ -1,0 +1,344 @@
+"""The chaos subsystem (tpu3fs/chaos/, ISSUE 14): schedule determinism,
+the invariant checker registry, the in-fabric search + shrink loop, the
+planted-bug catch, and the tests/chaos_seeds/ regression corpus replay.
+
+The corpus replay at the bottom is the ratchet: every violation the
+search ever found ships as a seed file and replays here forever —
+without its planted bug it must run green (the regression direction),
+with the bug armed the checkers must still catch it (the detector
+direction)."""
+
+import json
+import time
+
+import pytest
+
+from tpu3fs.chaos import bugs
+from tpu3fs.chaos.invariants import (
+    ChaosContext,
+    Violation,
+    checker_names,
+    format_report,
+    run_checkers,
+)
+from tpu3fs.chaos.schedule import (
+    FAULT_POINTS,
+    KINDS,
+    ChaosEvent,
+    Schedule,
+    ScheduleSpec,
+    generate_schedule,
+)
+from tpu3fs.chaos.search import (
+    FabricRunner,
+    load_corpus,
+    replay_seed,
+    run_schedule,
+    save_seed,
+    search_violations,
+    shrink_schedule,
+)
+from tpu3fs.utils.fault_injection import FaultPlane, parse_spec, plane
+
+SMALL = ScheduleSpec(steps=20, events=6, storage_nodes=3, num_chains=2,
+                     num_replicas=2)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane_and_bugs():
+    yield
+    plane().clear()
+    bugs.disarm()
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_byte_identical(self):
+        for seed in range(8):
+            a = generate_schedule(seed, SMALL).to_json()
+            b = generate_schedule(seed, SMALL).to_json()
+            assert a == b, f"seed {seed} not byte-identical"
+
+    def test_different_seeds_differ(self):
+        blobs = {generate_schedule(s, SMALL).to_json() for s in range(16)}
+        assert len(blobs) > 8  # collisions possible in theory, not en masse
+
+    def test_json_round_trip(self):
+        s = generate_schedule(5, SMALL)
+        again = Schedule.from_json(s.to_json())
+        assert again.to_json() == s.to_json()
+        assert [e.kind for e in again.events] == [e.kind for e in s.events]
+
+    def test_generated_specs_parse_and_points_resolve(self):
+        from tools.check_fault_points import fire_points, resolves
+
+        static, dynamic, _ = fire_points()
+        for seed in range(20):
+            sched = generate_schedule(seed, SMALL)
+            sched.validate()
+            for e in sched.events:
+                if e.kind != "fault_set":
+                    continue
+                for rule in parse_spec(e.args["spec"]):
+                    assert resolves(rule.point, static, dynamic), rule.point
+
+    def test_fault_points_menu_matches_grammar(self):
+        for p in FAULT_POINTS:
+            assert parse_spec(f"point={p}")[0].point == p
+
+    def test_validate_rejects_garbage(self):
+        for bad in (
+            ChaosEvent(0, "explode", {}),
+            ChaosEvent(0, "fault_set", {"spec": "point=x,kind=bogus"}),
+            ChaosEvent(0, "kill", {"role": "toaster", "idx": 0}),
+            ChaosEvent(0, "config_push", {"section": "dns", "spec": ""}),
+        ):
+            with pytest.raises(ValueError):
+                Schedule(0, SMALL, [bad]).validate()
+
+    def test_prefix_is_a_prefix(self):
+        s = generate_schedule(1, SMALL)
+        p = s.prefix(2)
+        assert p.events == s.events[:2] and p.seed == s.seed
+
+
+class TestCheckerRegistry:
+    def test_catalogue_names(self):
+        assert {"crc_oracle", "replica_versions", "stripe_versions",
+                "exactly_once", "ckpt_atomicity", "dataload_resume",
+                "bounded_memory"} <= set(checker_names())
+
+    def test_every_checker_individually_reported(self):
+        outcomes = run_checkers(ChaosContext())
+        assert [o.checker for o in outcomes] == checker_names()
+        assert all(o.status == "skipped" for o in outcomes)
+        text = format_report(outcomes)
+        for name in checker_names():
+            assert name in text
+
+    def test_crc_oracle_catches_corruption(self):
+        from tpu3fs.ops.crc32c import crc32c
+
+        good, evil = b"x" * 16, b"y" * 16
+        ctx = ChaosContext(
+            read_chunk=lambda c, f, i: evil,
+            oracle={(1, 2, 3): {crc32c(good)}})
+        (out,) = [o for o in run_checkers(ctx, ["crc_oracle"])]
+        assert out.status == "violated"
+        ctx.read_chunk = lambda c, f, i: good
+        (out,) = run_checkers(ctx, ["crc_oracle"])
+        assert out.status == "passed"
+
+    def test_crc_oracle_admissible_suffix(self):
+        """An unacknowledged write's payload stays admissible until the
+        next ack collapses the set."""
+        from tpu3fs.ops.crc32c import crc32c
+
+        acked, unacked = b"a" * 8, b"b" * 8
+        ctx = ChaosContext(
+            read_chunk=lambda c, f, i: unacked,
+            oracle={(1, 1, 1): {crc32c(acked), crc32c(unacked)}})
+        (out,) = run_checkers(ctx, ["crc_oracle"])
+        assert out.status == "passed"
+
+    def test_crc_oracle_lost_chunk(self):
+        ctx = ChaosContext(read_chunk=lambda c, f, i: None,
+                           oracle={(1, 1, 1): {123}})
+        (out,) = run_checkers(ctx, ["crc_oracle"])
+        assert out.status == "violated"
+        assert "acknowledged content" in out.violations[0].detail
+
+    def test_bounded_memory(self):
+        ctx = ChaosContext(memory_gauges={
+            "kvcache.host_bytes": (lambda: 10.0, 100.0),
+            "dataload.buffered_bytes": (lambda: 500.0, 100.0),
+        })
+        (out,) = run_checkers(ctx, ["bounded_memory"])
+        assert out.status == "violated"
+        assert "dataload.buffered_bytes" in out.violations[0].detail
+
+    def test_dataload_resume_divergence(self):
+        ctx = ChaosContext(resume_replay=lambda: ([1, 2, 3], [1, 2, 3]))
+        (out,) = run_checkers(ctx, ["dataload_resume"])
+        assert out.status == "passed"
+        ctx.resume_replay = lambda: ([1, 2, 3], [1, 9, 3])
+        (out,) = run_checkers(ctx, ["dataload_resume"])
+        assert out.status == "violated"
+        assert "position 1" in out.violations[0].detail
+
+    def test_checker_crash_is_a_violation(self):
+        def boom(c, f, i):
+            raise RuntimeError("checker io died")
+
+        ctx = ChaosContext(read_chunk=boom, oracle={(1, 1, 1): {1}})
+        (out,) = run_checkers(ctx, ["crc_oracle"])
+        assert out.status == "violated"
+        assert "raised" in out.violations[0].detail
+
+
+class TestPlantedBugs:
+    def test_unknown_bug_refused(self):
+        with pytest.raises(ValueError):
+            bugs.arm("not_a_bug")
+
+    def test_fire_needs_arm_and_crash_window(self):
+        assert not bugs.bug_fire("commit_skip")
+        bugs.arm("commit_skip")
+        assert not bugs.bug_fire("commit_skip")  # plane idle: no window
+        plane().configure("point=storage.read,kind=delay_ms,arg=0")
+        assert bugs.bug_fire("commit_skip")
+        plane().clear()
+        assert not bugs.bug_fire("commit_skip")
+
+
+class TestFaultsFiredRecorder:
+    def test_per_rule_counts_and_tags(self):
+        pl = FaultPlane()
+        pl.configure("point=p.a,kind=delay_ms,arg=0;"
+                     "point=p.b,kind=error,times=1")
+        pl.fire("p.a")
+        pl.fire("p.a.sub")
+        with pytest.raises(Exception):
+            pl.fire("p.b")
+        recs = {k: r for k, r in pl._recs.items()}
+        assert set(recs) == {("delay_ms", "p.a"), ("error", "p.b")}
+        for (kind, point), rec in recs.items():
+            assert rec.name == "faults.fired"
+            assert rec.tags == {"kind": kind, "point": point}
+        samples = recs[("delay_ms", "p.a")].collect(time.time())
+        assert samples and samples[0].value == 2.0
+
+    def test_fault_show_reports_per_rule_fires(self):
+        from tpu3fs.cli import AdminCli
+
+        plane().configure("point=storage.read,kind=delay_ms,arg=0")
+        try:
+            # fire through the real hook
+            from tpu3fs.utils.fault_injection import inject
+
+            inject("storage.read", node=1)
+            out = AdminCli(None).run("fault local --spec ''")  # reset
+            plane().configure("point=storage.read,kind=delay_ms,arg=0")
+            inject("storage.read", node=1)
+            out = AdminCli(None).run("fault show")
+            assert "point=storage.read" in out and "fired=1" in out
+        finally:
+            plane().clear()
+
+
+class TestRunnerAndSearch:
+    def test_clean_tree_small_search_green(self):
+        report, tried = search_violations(SMALL, base_seed=100, max_seeds=3)
+        assert report is None and tried == 3
+
+    def test_run_report_shape(self):
+        r = run_schedule(generate_schedule(0, SMALL))
+        assert r.writes > 0 and r.reads > 0
+        assert r.events_applied + r.events_skipped == len(r.schedule.events)
+        assert [o.checker for o in r.outcomes] == checker_names()
+        assert not r.violated
+
+    def test_directed_events_apply(self):
+        spec = ScheduleSpec(steps=10, events=0, storage_nodes=3,
+                            num_chains=2, num_replicas=2,
+                            allow_elastic=True)
+        sched = Schedule(0, spec, [
+            ChaosEvent(1, "fault_set",
+                       {"spec": "point=storage.read,kind=delay_ms,arg=1",
+                        "seed": 1, "node_idx": 0}),
+            ChaosEvent(2, "kill", {"role": "storage", "idx": 0}),
+            ChaosEvent(3, "restart", {"role": "storage", "idx": 0}),
+            ChaosEvent(4, "config_push",
+                       {"section": "qos", "spec": "resync.queue_share=0.5"}),
+            ChaosEvent(5, "config_push",
+                       {"section": "tenants",
+                        "spec": "tenant=t0,weight=4,bytes_per_s=8388608"}),
+            ChaosEvent(6, "join", {}),
+            ChaosEvent(7, "fault_clear", {}),
+            ChaosEvent(8, "kill", {"role": "meta", "idx": 0}),  # no meta
+        ])
+        sched.validate()
+        r = run_schedule(sched)
+        assert r.events_applied == 7, r.summary()
+        assert r.events_skipped == 1  # the meta kill: nothing to kill
+        assert not r.violated, r.summary()
+
+    def test_ec_schedule_exercises_stripe_checker(self):
+        spec = ScheduleSpec(steps=12, events=3, storage_nodes=4,
+                            num_chains=1, num_replicas=1, ec_k=2, ec_m=1,
+                            allow_kill=False)
+        r = run_schedule(generate_schedule(2, spec))
+        byname = {o.checker: o for o in r.outcomes}
+        assert byname["stripe_versions"].status == "passed", r.summary()
+        assert byname["crc_oracle"].status == "passed", r.summary()
+
+    def test_planted_bug_found_shrunk_and_replayed(self):
+        """The acceptance loop: a re-introduced known bug is caught
+        within a bounded seed budget, shrunk to a minimal prefix, and
+        the shrunk schedule replays to the same verdict."""
+        bugs.arm("commit_skip")
+        report, tried = search_violations(SMALL, base_seed=0, max_seeds=16)
+        assert report is not None, "bug not found within 16 seeds"
+        assert tried <= 16
+        assert "replica_versions" in report.violated_checkers \
+            or "crc_oracle" in report.violated_checkers
+        shrunk, replays = shrink_schedule(report.schedule)
+        assert len(shrunk.events) <= len(report.schedule.events)
+        again = run_schedule(shrunk)
+        assert again.violated_checkers == \
+            run_schedule(shrunk).violated_checkers  # deterministic
+        assert again.violated
+        # minimality: one event fewer no longer violates
+        if shrunk.events:
+            smaller = shrunk.prefix(len(shrunk.events) - 1)
+            assert not run_schedule(smaller).violated
+        bugs.disarm()
+        assert not run_schedule(shrunk).violated, \
+            "shrunk schedule must be green on the fixed tree"
+
+    def test_save_and_replay_round_trip(self, tmp_path):
+        bugs.arm("commit_skip")
+        report, _ = search_violations(SMALL, base_seed=0, max_seeds=16)
+        shrunk, _ = shrink_schedule(report.schedule)
+        expect = run_schedule(shrunk).violated_checkers
+        bugs.disarm()
+        path = save_seed("roundtrip", shrunk, bug="commit_skip",
+                         expect=expect, note="test", root=str(tmp_path))
+        r, obj = replay_seed(path, with_bug=True)
+        assert set(obj["expect"]) <= set(r.violated_checkers)
+        r2, _ = replay_seed(path, with_bug=False)
+        assert not r2.violated
+
+
+class TestCorpusReplay:
+    """tests/chaos_seeds/*.json — the shipped regression corpus."""
+
+    def test_corpus_is_not_empty(self):
+        assert load_corpus(), "the chaos_seeds corpus must ship seeds"
+
+    @pytest.mark.parametrize("path", load_corpus(),
+                             ids=lambda p: p.rsplit("/", 1)[-1])
+    def test_seed_green_on_current_tree(self, path):
+        report, obj = replay_seed(path, with_bug=False)
+        assert not report.violated, (
+            f"corpus seed {path} violates on the CURRENT tree:\n"
+            + report.summary())
+
+    @pytest.mark.parametrize("path", load_corpus(),
+                             ids=lambda p: p.rsplit("/", 1)[-1])
+    def test_seed_still_caught_with_bug(self, path):
+        with open(path) as f:
+            obj = json.load(f)
+        if not obj.get("bug"):
+            pytest.skip("no planted bug recorded for this seed")
+        report, _ = replay_seed(path, with_bug=True)
+        assert set(obj["expect"]) <= set(report.violated_checkers), (
+            f"checkers no longer catch {obj['bug']}:\n" + report.summary())
+
+    def test_corpus_files_are_canonical(self):
+        for path in load_corpus():
+            with open(path) as f:
+                text = f.read()
+            obj = json.loads(text)
+            assert text == json.dumps(obj, sort_keys=True, indent=1) + "\n", \
+                f"{path} not canonically formatted"
+            Schedule.from_json(json.dumps(obj["schedule"])).validate()

@@ -1,0 +1,67 @@
+"""tools/check_fault_points wired into tier-1: every fault point named
+anywhere in the repo (specs, chaos schedules, drive scripts, docs
+examples, the chaos generator's menu) must resolve to a real injection
+site — a typo'd point injects nothing, silently."""
+
+from tools.check_fault_points import (
+    fire_points,
+    main,
+    resolves,
+    run_checks,
+    spec_points,
+)
+
+
+class TestClean:
+    def test_run_checks_clean(self):
+        errors, notes = run_checks()
+        assert errors == []
+        assert notes
+
+    def test_main_exit_zero(self, capsys):
+        assert main() == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_known_sites_found(self):
+        static, dynamic, errors = fire_points()
+        assert errors == []
+        # the storage engine points and both transport boundaries
+        assert {"storage.read", "storage.update",
+                "storage.write_shard"} <= static
+        assert any(d.startswith("rpc.dispatch") for d in dynamic)
+        assert any(d.startswith("rpc.send") for d in dynamic)
+
+    def test_generator_menu_is_checked(self):
+        # the chaos generator's FAULT_POINTS menu is a spec source: a
+        # point added there without an injection site fails the check
+        wheres = [w for w, _ in spec_points()]
+        assert any("FAULT_POINTS" in w for w in wheres)
+
+
+class TestResolution:
+    def test_static_prefix_semantics(self):
+        static = {"storage.read", "storage.update"}
+        assert resolves("storage.read", static, set())
+        assert resolves("storage", static, set())      # prefix of a point
+        assert not resolves("storage.reap", static, set())
+        assert not resolves("storge.read", static, set())   # the typo case
+
+    def test_dynamic_prefix_semantics(self):
+        dynamic = {"rpc.send.", "rpc.dispatch."}
+        # a rule narrower than the dynamic prefix can still fire
+        assert resolves("rpc.send.StorageSerde", set(), dynamic)
+        # and one broader than it obviously can
+        assert resolves("rpc", set(), dynamic)
+        assert not resolves("rpc.sent", set(), dynamic)
+
+    def test_typod_spec_would_fail(self, tmp_path, monkeypatch):
+        """Mutation: drop a file with a bogus point into a scanned dir
+        and the check must go red."""
+        import tools.check_fault_points as mod
+
+        bad = tmp_path / "bad_spec.py"
+        bad.write_text('SPEC = "point=storge.read,kind=error"\n')  # fault-ok
+        monkeypatch.setattr(mod, "SPEC_DIRS", (str(tmp_path),))
+        monkeypatch.setattr(mod, "REPO", "/")
+        errors, _ = run_checks()
+        assert any("storge.read" in e for e in errors)

@@ -297,3 +297,131 @@ class TestRetirePass:
                          rounds=60)
         # elastic_tick already retired it (chain_id=0 in routing)
         assert fab.nodes[out_node].service.target(out) is None
+
+
+class TestAutoReplan:
+    """The worker's auto re-plan loop (ISSUE 14 satellite): a chain with
+    TWO members on draining nodes takes one planner wave per member —
+    with auto_replan the worker submits the follow-up wave itself."""
+
+    @staticmethod
+    def _drain_two(fab):
+        """Tag nodes 10 and 11 draining and submit the OPERATOR's first
+        wave (one replacement per chain; multi-failure chains deferred)."""
+        from tpu3fs.placement import (
+            DRAINING_TAG,
+            TopologyDelta,
+            check_plan,
+            plan_rebalance,
+        )
+
+        for n in (10, 11):
+            fab.mgmtd.set_node_tags(n, {DRAINING_TAG: "1"})
+        routing = fab.routing()
+        delta = TopologyDelta(draining=[10, 11])
+        plan = plan_rebalance(routing, delta)
+        assert not plan.empty and not check_plan(routing, plan, delta)
+        assert plan.deferred_chains, "fixture must have a 2-loss chain"
+        fab.mgmtd.migration_submit([mv.spec() for mv in plan.moves])
+        return plan
+
+    def test_two_member_drain_converges_unattended(self):
+        # round-robin layout: chain 1's two replicas land on nodes
+        # (10, 11) — both draining at once, the multi-failure shape
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=4, num_chains=4,
+                                       num_replicas=2, chunk_size=4096))
+        oracle = _write_oracle(fab)
+        wave1 = self._drain_two(fab)
+        w = _worker(fab, auto_replan=True)
+        w.run_until_idle(tick=lambda: fab.elastic_tick(resync=False),
+                         rounds=200)
+        ri = fab.routing()
+        for node in (10, 11):
+            hosting = [t for t in ri.targets.values()
+                       if t.chain_id and t.node_id == node]
+            assert hosting == [], (node, hosting)
+        from tpu3fs.mgmtd.types import PublicTargetState
+
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for c in ri.chains.values() for t in c.targets)
+        _verify_oracle(fab, oracle)
+        # and the worker really did submit a follow-up wave: more jobs
+        # than the operator's first plan
+        jobs = fab.mgmtd.migration_list()
+        assert all(j.phase == JobPhase.DONE for j in jobs)
+        assert len(jobs) > len(wave1.moves)
+
+    def test_disabled_worker_stops_after_one_wave(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=4, num_chains=4,
+                                       num_replicas=2, chunk_size=4096))
+        _write_oracle(fab)
+        plan = self._drain_two(fab)
+        w = _worker(fab, auto_replan=False)
+        w.run_until_idle(tick=lambda: fab.elastic_tick(resync=False),
+                         rounds=200)
+        # first wave done, deferred chain still hosted on a draining node
+        ri = fab.routing()
+        left = [t for t in ri.targets.values()
+                if t.chain_id and t.node_id in (10, 11)]
+        assert left, "one-wave worker should leave the deferred member"
+        assert len(fab.mgmtd.migration_list()) == len(plan.moves)
+
+    def test_never_initiates_without_operator_jobs(self):
+        from tpu3fs.placement import DRAINING_TAG
+
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=2,
+                                       num_replicas=2, chunk_size=4096))
+        fab.mgmtd.set_node_tags(10, {DRAINING_TAG: "1"})
+        w = _worker(fab, auto_replan=True)
+        assert w.maybe_replan() == 0
+        w.run_once()
+        assert fab.mgmtd.migration_list() == []
+
+    def test_replan_uses_joined_node_as_destination(self):
+        """The production-day shape: a node that hosted, was evacuated,
+        and now sits EMPTY ("joined" in the derived delta) is the only
+        legal home for a draining member (3 replicas over 3 hosting
+        nodes). The auto re-plan must use it as a destination —
+        fill_joined=False means destinations only, no fill moves."""
+        from tpu3fs.placement import DRAINING_TAG
+
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=1,
+                                       num_replicas=3, chunk_size=4096))
+        oracle = _write_oracle(fab)
+        nid = fab.add_storage_node()
+        cid = fab.chain_ids[0]
+        w = _worker(fab, auto_replan=True)
+
+        def settle():
+            w.run_until_idle(tick=lambda: fab.elastic_tick(resync=False),
+                             rounds=200)
+
+        def member_on(node):
+            return next(t.target_id for t in fab.routing().chains[cid].targets
+                        if fab.routing().targets[t.target_id].node_id == node)
+
+        # bounce a member through nid and back: nid ends EMPTY but job
+        # records exist (the worker's operator-initiated gate is open)
+        fab.mgmtd.migration_submit([MoveSpec(
+            chain_id=cid, out_target=member_on(12), dst_node=nid)])
+        settle()
+        fab.mgmtd.migration_submit([MoveSpec(
+            chain_id=cid, out_target=member_on(nid), dst_node=12)])
+        settle()
+        fab.retire_unassigned_targets()
+        # now drain 10: members {10,11,12}, hosting-minus-leaving is
+        # {11,12} (both already members) — ONLY the joined empty nid
+        # can take the replacement
+        fab.mgmtd.set_node_tags(10, {DRAINING_TAG: "1"})
+        assert w.maybe_replan() > 0
+        settle()
+        ri = fab.routing()
+        hosting = [t for t in ri.targets.values()
+                   if t.chain_id and t.node_id == 10]
+        assert hosting == [], hosting
+        members = {ri.targets[t.target_id].node_id
+                   for t in ri.chains[cid].targets}
+        assert members == {11, 12, nid}
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in ri.chains[cid].targets)
+        _verify_oracle(fab, oracle)

@@ -333,3 +333,35 @@ class TestSolverParity:
         assert M.shape == (8, 4)
         assert (M.sum(axis=1) == 2).all()     # every chain has 2 replicas
         assert M.sum() == 16
+
+
+class TestFillJoinedFlag:
+    """plan_rebalance(fill_joined=False): joined nodes stay eligible as
+    EVACUATION destinations but never attract fill moves (the migration
+    worker's auto re-plan mode)."""
+
+    def test_pure_join_plans_nothing(self):
+        fab = _cr_fabric()
+        nid = fab.add_storage_node()
+        delta = TopologyDelta(joined=[nid])
+        plan = plan_rebalance(fab.routing(), delta, fill_joined=False)
+        assert plan.empty
+        # default behavior unchanged: the fill phase still plans moves
+        assert not plan_rebalance(fab.routing(), delta).empty
+
+    def test_joined_node_is_an_evacuation_destination(self):
+        """3 nodes, 3 replicas: draining one member leaves NO destination
+        among hosting nodes — only the freshly joined empty node can
+        take the replacement. The production-day drive hit exactly this
+        (an evacuated-then-restarted node was the one legal home for a
+        draining EC shard)."""
+        fab = _cr_fabric(nodes=3, chains=4, replicas=3)
+        nid = fab.add_storage_node()
+        delta = TopologyDelta(joined=[nid], draining=[10])
+        plan = plan_rebalance(fab.routing(), delta, fill_joined=False)
+        assert not plan.empty and not plan.deferred_chains
+        assert all(mv.dst_node == nid for mv in plan.moves)
+        # without the joined node there is nowhere to go: all deferred
+        plan2 = plan_rebalance(fab.routing(), TopologyDelta(draining=[10]),
+                               fill_joined=False)
+        assert plan2.empty and plan2.deferred_chains

@@ -475,8 +475,9 @@ class TestFaultInjectionSeeding:
 
 class TestFaultPlane:
     def test_parse_validates(self):
-        rules = parse_spec("point=a.b,kind=delay_ms,arg=5,prob=0.5,"
-                           "times=3,node=7; point=c")
+        rules = parse_spec("point=a.b,kind=delay_ms,arg=5,prob=0.5,"  # fault-ok
+                           "times=3,node=7; point=c")  # fault-ok
+
         assert len(rules) == 2
         assert rules[0].kind == "delay_ms" and rules[0].node == 7
         assert rules[1].kind == "error" and rules[1].prob == 1.0

@@ -15,8 +15,9 @@ construction, then enforces the observability contract
    table (and the table carries no stale names), so the doc IS the
    registry;
 4. TAG VOCABULARY — literal tag dicts only use keys from the fixed
-   vocabulary (service, class, tenant, chain, node, kind, target): the
-   collector's group-bys and admin_cli top's joins key on these.
+   vocabulary (service, class, tenant, chain, node, kind, point,
+   target): the collector's group-bys and admin_cli top's joins key on
+   these.
 5. SLO RULE REFERENCES — every metric name referenced by an ``[slo]``
    rule in any shipped/default config (the
    ``slo.DEFAULT_CLUSTER_SPEC`` constant plus every ``[slo] spec``
@@ -54,7 +55,7 @@ RECORDER_CLASSES = {"CounterRecorder", "ValueRecorder",
                     "DistributionRecorder", "LatencyRecorder"}
 
 #: the fixed tag-key vocabulary (docs/observability.md)
-TAG_VOCAB = {"service", "class", "tenant", "chain", "node", "kind",
+TAG_VOCAB = {"service", "class", "tenant", "chain", "node", "kind", "point",
              "target"}
 
 #: files allowed to construct recorders with NON-LITERAL names (they
@@ -155,7 +156,11 @@ def doc_table_names() -> List[str]:
                 continue
             if not in_section:
                 continue
-            m = re.match(r"^\|\s*`([a-z0-9_.]+)`\s*\|", line)
+            # an optional `{tag,tag}` suffix documents a tagged family
+            # (e.g. `faults.fired{kind,point}`): tags are annotation,
+            # the metric NAME is what round-trips with the declarations
+            m = re.match(r"^\|\s*`([a-z0-9_.]+)(?:\{[a-z0-9_,]+\})?`\s*\|",
+                         line)
             if m:
                 names.append(m.group(1))
     return names

@@ -32,6 +32,10 @@ class MigrationAppConfig(Config):
     batch_chunks = ConfigItem(64, hot=True)
     claim_lease_s = ConfigItem(15.0, hot=True)
     max_jobs = ConfigItem(4, hot=True)
+    # auto re-plan: when every job settled but draining/dead nodes still
+    # host chains (multi-failure chains take one wave per member), the
+    # worker submits the next wave itself — drains converge unattended
+    auto_replan = ConfigItem(True, hot=True)
     qos = QosConfig
     faults = FaultPlaneConfig
     tenants = TenantConfig
@@ -72,7 +76,8 @@ class MigrationApp(TwoPhaseApplication):
             worker_id=f"mig-{self.info.node_id}",
             batch_chunks=self.config.get("batch_chunks"),
             lease_s=self.config.get("claim_lease_s"),
-            max_jobs=self.config.get("max_jobs"))
+            max_jobs=self.config.get("max_jobs"),
+            auto_replan=self.config.get("auto_replan"))
         self.spawn(self._work_loop, "migration-work")
 
     def _work_loop(self) -> None:
@@ -81,6 +86,7 @@ class MigrationApp(TwoPhaseApplication):
                 self.worker._lease_s = self.config.get("claim_lease_s")
                 self.worker._batch = self.config.get("batch_chunks")
                 self.worker._max_jobs = self.config.get("max_jobs")
+                self.worker._auto_replan = self.config.get("auto_replan")
                 advanced = self.worker.run_once()
                 if advanced:
                     xlog("INFO", "migration worker advanced %d job(s)",

@@ -1,0 +1,73 @@
+"""TEST-ONLY planted-bug registry.
+
+The chaos search (tpu3fs/chaos/search.py) must demonstrably FIND bugs,
+not just run green — so known-fixed bugs can be re-introduced behind a
+flag here and the search proven to catch them within a bounded seed
+budget (ISSUE 14 acceptance; the shrunk schedule then ships in
+``tests/chaos_seeds/``).
+
+Armed via ``arm()``/``disarm()`` or the ``TPU3FS_CHAOS_BUG`` env var
+(comma-separated names, read once at import). Production code guards its
+hook sites with ``bug_fire(name)`` which is two attribute loads and a
+set-membership test when nothing is armed — and bugs only FIRE while the
+cluster fault plane has rules configured (the "crash window"): a planted
+bug needs a chaos schedule to trigger it, which is exactly what makes
+the search a search.
+
+Known bugs:
+
+- ``commit_skip`` — the PR-2-era crash-window shape: a chain-internal
+  hop ACKs a batch update upstream without durably committing it
+  locally (storage/craq.py). The head commits and acks the client; the
+  replica silently stays at the old committed version. Caught by the
+  ``replica_versions`` invariant checker (and by ``crc_oracle`` when a
+  read lands on the stale replica).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Set
+
+_lock = threading.Lock()
+_armed: Set[str] = set(
+    n.strip() for n in os.environ.get("TPU3FS_CHAOS_BUG", "").split(",")
+    if n.strip()
+)
+
+#: names production hook sites are allowed to ask about (a typo'd
+#: arm()/hook pair must fail loudly, not silently never fire)
+KNOWN_BUGS = frozenset({"commit_skip"})
+
+
+def arm(name: str) -> None:
+    if name not in KNOWN_BUGS:
+        raise ValueError(f"unknown planted bug {name!r} "
+                         f"(known: {sorted(KNOWN_BUGS)})")
+    with _lock:
+        _armed.add(name)
+
+
+def disarm(name: str = "") -> None:
+    """Disarm one bug (or all, with no argument)."""
+    with _lock:
+        if name:
+            _armed.discard(name)
+        else:
+            _armed.clear()
+
+
+def armed(name: str) -> bool:
+    return name in _armed
+
+
+def bug_fire(name: str) -> bool:
+    """The production hook: True iff ``name`` is armed AND the cluster
+    fault plane currently has rules configured (the crash window). Near
+    zero cost disarmed."""
+    if name not in _armed:
+        return False
+    from tpu3fs.utils.fault_injection import plane
+
+    return plane().active

@@ -1,0 +1,518 @@
+"""Chaos search: run seeded schedules against an in-process fabric,
+hunt invariant violations, shrink what's found, grow the regression
+corpus.
+
+The loop (docs/chaos.md):
+
+1. ``search_violations`` draws schedules from consecutive seeds
+   (``generate_schedule`` — recorded, hence replayable) and runs each
+   with a ``FabricRunner``: a fresh single-process Fabric, a seeded
+   sequential workload (tenant-tagged writes/reads with a CRC oracle),
+   the schedule's events applied at their step marks, then a quiesce
+   (clear faults, restart dead nodes, resync, settle migrations) and
+   the invariant checker registry (chaos/invariants.py).
+2. A violating schedule is SHRUNK to its minimal event prefix
+   (``shrink_schedule`` — linear scan, smallest k whose prefix still
+   violates; replays are deterministic so the scan is sound).
+3. ``save_seed`` writes the shrunk schedule + expected verdict to
+   ``tests/chaos_seeds/`` where tier-1 replays it forever after
+   (tests/test_chaos.py) — every violation ever found stays fixed.
+
+Determinism: the workload RNG derives from the schedule seed, clients
+run sequentially with zero-backoff retries, and the fault plane's RNG
+reseeds on every ``fault_set`` — one seed, one outcome.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tpu3fs.chaos import bugs
+from tpu3fs.chaos.invariants import (
+    ChaosContext,
+    CheckOutcome,
+    Violation,
+    format_report,
+    run_checkers,
+)
+from tpu3fs.chaos.schedule import (
+    ChaosEvent,
+    Schedule,
+    ScheduleSpec,
+    generate_schedule,
+    record_event_applied,
+)
+from tpu3fs.monitor.recorder import CounterRecorder
+from tpu3fs.ops.crc32c import crc32c
+from tpu3fs.utils.fault_injection import plane
+
+PAYLOAD_LEN = 64
+FILE_ID_BASE = 7700
+NUM_CHUNKS = 3
+
+CORPUS_VERSION = 1
+
+# -- recorders (single declaration site; docs/observability.md) --------------
+_rec_runs = CounterRecorder("chaos.runs")
+
+
+@dataclass
+class RunReport:
+    schedule: Schedule
+    outcomes: List[CheckOutcome] = field(default_factory=list)
+    events_applied: int = 0
+    events_skipped: int = 0
+    writes: int = 0
+    acked: int = 0
+    reads: int = 0
+
+    @property
+    def violations(self) -> List[Violation]:
+        return [v for o in self.outcomes for v in o.violations]
+
+    @property
+    def violated(self) -> bool:
+        return bool(self.violations)
+
+    @property
+    def violated_checkers(self) -> List[str]:
+        return sorted({o.checker for o in self.outcomes
+                       if o.status == "violated"})
+
+    def summary(self) -> str:
+        head = (f"seed {self.schedule.seed}: "
+                f"{self.events_applied} event(s) applied "
+                f"({self.events_skipped} skipped), {self.writes} writes "
+                f"({self.acked} acked), {self.reads} reads")
+        return head + "\n" + format_report(self.outcomes)
+
+
+class FabricRunner:
+    """Execute ONE schedule against ONE fresh fabric. Sequential and
+    seeded throughout — running the same schedule twice produces the
+    same verdict (tested)."""
+
+    def __init__(self, schedule: Schedule, *,
+                 ops_per_step: int = 3,
+                 checkers: Optional[List[str]] = None):
+        self.schedule = schedule
+        self.ops_per_step = ops_per_step
+        self.checkers = checkers
+        self._live_violations: List[Violation] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def run(self) -> RunReport:
+        from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+        from tpu3fs.client.storage_client import RetryOptions
+        from tpu3fs.qos.core import QosConfig
+
+        spec = self.schedule.spec
+        _rec_runs.add(1)
+        self.fab = Fabric(SystemSetupConfig(
+            num_storage_nodes=spec.storage_nodes,
+            num_chains=spec.num_chains,
+            num_replicas=spec.num_replicas,
+            ec_k=spec.ec_k, ec_m=spec.ec_m,
+            chunk_size=1 << 16,
+            heartbeat_timeout_s=60.0,
+            qos=QosConfig(),
+        ))
+        self.base_nodes = sorted(self.fab.nodes)
+        self.rng = random.Random(self.schedule.seed ^ 0x5EED)
+        fast = RetryOptions(max_retries=6, backoff_base_s=0.0,
+                            backoff_max_s=0.0)
+        self.clients = [self.fab.storage_client(retry=fast)
+                        for _ in range(2)]
+        self.tag = 0
+        self.is_ec = spec.ec_k > 0
+        self.chains = list(self.fab.chain_ids)
+        # oracle[(chain, fid, idx)] -> admissible CRC set; sent crcs for
+        # torn-read detection; logical write counts for exactly-once
+        self.oracle: Dict[Tuple[int, int, int], set] = {}
+        self.sent: Dict[Tuple[int, int, int], set] = {}
+        self.writes_issued: Dict[Tuple[int, int, int], int] = {}
+        self._worker = None
+        self._tenants_touched = False
+        report = RunReport(self.schedule)
+        by_step: Dict[int, List[ChaosEvent]] = {}
+        for e in self.schedule.events:
+            by_step.setdefault(e.step, []).append(e)
+        try:
+            for step in range(spec.steps):
+                for event in by_step.get(step, ()):
+                    if self._apply_event(event):
+                        report.events_applied += 1
+                        record_event_applied()
+                    else:
+                        report.events_skipped += 1
+                for _ in range(self.ops_per_step):
+                    self._workload_op(report)
+                self._background_tick()
+            self._quiesce()
+            ctx = self._context()
+            report.outcomes = run_checkers(ctx, self.checkers)
+            if self._live_violations:
+                for o in report.outcomes:
+                    if o.checker == "crc_oracle":
+                        o.violations.extend(self._live_violations)
+                        o.status = "violated"
+        finally:
+            plane().clear()
+            if self._tenants_touched:
+                from tpu3fs.tenant.quota import registry
+
+                try:
+                    registry().configure("")
+                except Exception:
+                    pass
+            self.fab.close()
+        return report
+
+    # -- events --------------------------------------------------------------
+    def _apply_event(self, e: ChaosEvent) -> bool:
+        """True = applied; False = not applicable here (e.g. a meta-role
+        kill on a fabric with no meta process) — counted, never silent."""
+        if e.kind == "fault_set":
+            spec = e.args.get("spec", "")
+            idx = int(e.args.get("node_idx", -1))
+            if idx >= 0 and self.base_nodes:
+                node = self.base_nodes[idx % len(self.base_nodes)]
+                spec = ";".join(f"{entry},node={node}"
+                                for entry in spec.split(";") if entry)
+            plane().configure(spec, int(e.args.get("seed", 0)))
+            return True
+        if e.kind == "fault_clear":
+            plane().clear()
+            return True
+        if e.kind == "kill":
+            if e.args.get("role") != "storage":
+                return False
+            alive = [n for n in self.fab.nodes.values() if n.alive]
+            if len(alive) <= 1:
+                return False
+            node = alive[int(e.args.get("idx", 0)) % len(alive)]
+            self.fab.fail_node(node.node_id)
+            return True
+        if e.kind == "restart":
+            if e.args.get("role") != "storage":
+                return False
+            dead = [n for n in self.fab.nodes.values() if not n.alive]
+            if not dead:
+                return False
+            node = dead[int(e.args.get("idx", 0)) % len(dead)]
+            self.fab.restart_node(node.node_id)
+            self._safe_resync(rounds=4)
+            return True
+        if e.kind == "join":
+            if not self.schedule.spec.allow_elastic:
+                return False
+            nid = self.fab.add_storage_node()
+            return self._submit_plan(joined=[nid])
+        if e.kind == "drain":
+            if not self.schedule.spec.allow_elastic:
+                return False
+            from tpu3fs.placement.rebalance import DRAINING_TAG
+
+            alive = [n for n in self.fab.nodes.values() if n.alive]
+            if len(alive) <= self.schedule.spec.num_replicas:
+                return False
+            node = alive[int(e.args.get("idx", 0)) % len(alive)]
+            self.fab.mgmtd.set_node_tags(node.node_id, {DRAINING_TAG: "1"})
+            if not self._submit_plan(draining=[node.node_id]):
+                self.fab.mgmtd.set_node_tags(node.node_id,
+                                             {DRAINING_TAG: ""})
+                return False
+            return True
+        if e.kind == "config_push":
+            return self._apply_config_push(e.args)
+        raise ValueError(f"unknown event kind {e.kind!r}")
+
+    def _submit_plan(self, *, joined=None, draining=None) -> bool:
+        from tpu3fs.placement.rebalance import (
+            TopologyDelta,
+            check_plan,
+            plan_rebalance,
+        )
+        from tpu3fs.utils.result import FsError
+
+        routing = self.fab.routing()
+        delta = TopologyDelta(joined=joined or [], draining=draining or [])
+        plan = plan_rebalance(routing, delta)
+        if plan.empty or check_plan(routing, plan, delta):
+            return False
+        try:
+            self.fab.mgmtd.migration_submit([mv.spec() for mv in plan.moves])
+        except FsError:
+            return False  # conflicting active jobs: planner wave pending
+        return True
+
+    def _apply_config_push(self, args: Dict) -> bool:
+        section, spec = args.get("section"), args.get("spec", "")
+        if section == "qos":
+            key, _, value = spec.partition("=")
+            self.fab.cfg.qos.set(key.strip(), float(value))
+            return True
+        if section == "tenants":
+            from tpu3fs.tenant.quota import registry
+
+            registry().configure(spec)
+            self._tenants_touched = True
+            return True
+        # slo: judged by a collector process; the in-fabric runner hosts
+        # none, so the push has nothing to land on
+        return False
+
+    def _background_tick(self) -> None:
+        """What a real cluster's loops do between workload ops: migration
+        worker rounds + elastic open/retire/heartbeat when jobs exist."""
+        from tpu3fs.utils.result import FsError
+
+        try:
+            jobs = self.fab.mgmtd.migration_list()
+        except FsError:
+            return
+        if not any(j.active for j in jobs):
+            return
+        if self._worker is None:
+            from tpu3fs.migration.service import MigrationWorker
+
+            self._worker = MigrationWorker(
+                self.fab.mgmtd, self.fab.storage_client(),
+                worker_id="chaos-worker", batch_chunks=16)
+        try:
+            self.fab.elastic_tick()
+            self._worker.run_once()
+        except (FsError, ConnectionError):
+            pass  # transient mid-chaos; quiesce settles the rest
+
+    def _safe_resync(self, rounds: int = 4) -> None:
+        """Resync under an armed fault window: failures are weather, not
+        verdicts — the quiesce re-runs it with the plane cleared."""
+        from tpu3fs.utils.result import FsError
+
+        try:
+            self.fab.resync_all(rounds=rounds)
+        except (FsError, ConnectionError):
+            pass
+
+    # -- workload ------------------------------------------------------------
+    def _key(self, chain_pos: int, idx: int) -> Tuple[int, int, int]:
+        return (self.chains[chain_pos], FILE_ID_BASE + chain_pos, idx)
+
+    def _payload(self) -> bytes:
+        self.tag += 1
+        return f"w{self.tag:06d}".encode().ljust(PAYLOAD_LEN, b".")
+
+    def _workload_op(self, report: RunReport) -> None:
+        from tpu3fs.storage.types import ChunkId
+        from tpu3fs.tenant import tenant_scope
+
+        do_write = self.rng.random() < 0.6
+        pos = self.rng.randrange(len(self.chains))
+        idx = self.rng.randrange(NUM_CHUNKS)
+        chain, fid, _ = self._key(pos, idx)
+        key = (chain, fid, idx)
+        client = self.clients[self.rng.randrange(len(self.clients))]
+        tenant = f"t{self.rng.randrange(2)}"
+        with tenant_scope(tenant):
+            if do_write:
+                data = self._payload()
+                crc = crc32c(data)
+                self.sent.setdefault(key, set()).add(crc)
+                self.writes_issued[key] = self.writes_issued.get(key, 0) + 1
+                report.writes += 1
+                try:
+                    if self.is_ec:
+                        rep = client.write_stripe(
+                            chain, ChunkId(fid, idx), data,
+                            chunk_size=1 << 16)
+                    else:
+                        rep = client.write_chunk(
+                            chain, ChunkId(fid, idx), 0, data,
+                            chunk_size=PAYLOAD_LEN)
+                    ok = rep.ok
+                except Exception:
+                    ok = False
+                if ok:
+                    report.acked += 1
+                    self.oracle[key] = {crc}
+                else:
+                    # unknown outcome: the write may have landed anywhere
+                    # down the chain — admissible until superseded
+                    self.oracle.setdefault(key, set()).add(crc)
+            else:
+                report.reads += 1
+                try:
+                    if self.is_ec:
+                        rep = client.read_stripe(
+                            chain, ChunkId(fid, idx), 0, PAYLOAD_LEN,
+                            chunk_size=1 << 16)
+                    else:
+                        rep = client.read_chunk(chain, ChunkId(fid, idx))
+                    ok, data = rep.ok, rep.data
+                except Exception:
+                    ok = False
+                if ok and key in self.sent and len(data) == PAYLOAD_LEN:
+                    if crc32c(bytes(data)) not in self.sent[key]:
+                        self._live_violations.append(Violation(
+                            "crc_oracle",
+                            f"mid-run read of {key} returned bytes no "
+                            f"client ever wrote (torn read)"))
+
+    # -- quiesce + verdict ----------------------------------------------------
+    def _quiesce(self) -> None:
+        from tpu3fs.placement.rebalance import DRAINING_TAG
+        from tpu3fs.utils.result import FsError
+
+        plane().clear()
+        for node in self.fab.nodes.values():
+            if not node.alive:
+                self.fab.restart_node(node.node_id)
+        # settle any migrations the schedule kicked off, then clear drains
+        for _ in range(60):
+            try:
+                jobs = self.fab.mgmtd.migration_list()
+            except FsError:
+                break
+            if not any(j.active for j in jobs):
+                break
+            self._background_tick()
+        routing = self.fab.routing()
+        for node in routing.nodes.values():
+            if node.tags.get(DRAINING_TAG):
+                self.fab.mgmtd.set_node_tags(node.node_id,
+                                             {DRAINING_TAG: ""})
+        self.fab.resync_all(rounds=8)
+
+    def _read_chunk(self, chain: int, fid: int, idx: int):
+        from tpu3fs.storage.types import ChunkId
+
+        client = self.clients[0]
+        try:
+            if self.is_ec:
+                # the written payload region only: the oracle CRCs cover
+                # PAYLOAD_LEN bytes, not the stripe's zero padding
+                rep = client.read_stripe(chain, ChunkId(fid, idx), 0,
+                                         PAYLOAD_LEN, chunk_size=1 << 16)
+            else:
+                rep = client.read_chunk(chain, ChunkId(fid, idx))
+        except Exception:
+            return None
+        if not rep.ok:
+            return None
+        return bytes(rep.data)
+
+    def _context(self) -> ChaosContext:
+        return ChaosContext(
+            read_chunk=self._read_chunk,
+            oracle=self.oracle,
+            writes_issued=self.writes_issued,
+            routing=self.fab.routing,
+            dump_chunkmeta=lambda node, tid: self.fab.send(
+                node, "dump_chunkmeta", tid),
+        )
+
+
+# -- search + shrink ----------------------------------------------------------
+
+def run_schedule(schedule: Schedule, **kw) -> RunReport:
+    return FabricRunner(schedule, **kw).run()
+
+
+def search_violations(
+    spec: Optional[ScheduleSpec] = None,
+    *,
+    base_seed: int = 0,
+    max_seeds: int = 32,
+    **kw,
+) -> Tuple[Optional[RunReport], int]:
+    """Run schedules for seeds base_seed..base_seed+max_seeds-1; return
+    (first violating report, seeds tried). (None, max_seeds) = clean."""
+    spec = spec or ScheduleSpec()
+    for i in range(max_seeds):
+        seed = base_seed + i
+        report = run_schedule(generate_schedule(seed, spec), **kw)
+        if report.violated:
+            return report, i + 1
+    return None, max_seeds
+
+
+def shrink_schedule(schedule: Schedule, **kw) -> Tuple[Schedule, int]:
+    """-> (minimal violating prefix, replays spent). Linear scan from
+    the empty prefix up: the first k whose prefix violates IS minimal
+    (replays are deterministic). The input must violate; the full
+    schedule is the fallback."""
+    replays = 0
+    for k in range(len(schedule.events) + 1):
+        candidate = schedule.prefix(k)
+        replays += 1
+        if run_schedule(candidate, **kw).violated:
+            return candidate, replays
+    return schedule, replays
+
+
+# -- the regression corpus ----------------------------------------------------
+
+def corpus_dir(root: Optional[str] = None) -> str:
+    if root:
+        return root
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "tests", "chaos_seeds")
+
+
+def save_seed(name: str, schedule: Schedule, *,
+              bug: str = "", expect: Optional[List[str]] = None,
+              note: str = "", root: Optional[str] = None) -> str:
+    """Write one corpus entry; returns its path. ``bug`` names a
+    chaos/bugs.py planted bug the replayer arms first (empty = the
+    schedule violates on the CURRENT tree — which should never ship);
+    ``expect`` lists the checkers that must fire with the bug armed."""
+    obj = {
+        "version": CORPUS_VERSION,
+        "bug": bug,
+        "expect": sorted(expect or []),
+        "note": note,
+        "schedule": json.loads(schedule.to_json()),
+    }
+    d = corpus_dir(root)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump(obj, f, sort_keys=True, indent=1)
+        f.write("\n")
+    return path
+
+
+def load_corpus(root: Optional[str] = None) -> List[str]:
+    d = corpus_dir(root)
+    if not os.path.isdir(d):
+        return []
+    return sorted(os.path.join(d, n) for n in os.listdir(d)
+                  if n.endswith(".json"))
+
+
+def replay_seed(path: str, *, with_bug: bool = True,
+                **kw) -> Tuple[RunReport, Dict]:
+    """Replay one corpus entry. with_bug=True arms the entry's planted
+    bug (proving the checkers still catch it); with_bug=False replays
+    on the current tree (proving the once-violating schedule now runs
+    green — the regression direction tier-1 cares about)."""
+    with open(path) as f:
+        obj = json.load(f)
+    if obj.get("version") != CORPUS_VERSION:
+        raise ValueError(f"{path}: unsupported corpus version")
+    schedule = Schedule.from_json(json.dumps(obj["schedule"]))
+    bug = obj.get("bug", "")
+    try:
+        if bug and with_bug:
+            bugs.arm(bug)
+        report = run_schedule(schedule, **kw)
+    finally:
+        if bug and with_bug:
+            bugs.disarm(bug)
+    return report, obj

@@ -1019,7 +1019,11 @@ class AdminCli:
                   the node type's pushed config (heartbeats deliver it,
                   every node of that type arms the rules live)
         fault clear [--node-type storage] — push an empty spec
-        fault show [--node-type storage] — pushed spec + local plane
+        fault show [--node-type storage] [--collector H:P [--window S]]
+                  — pushed spec + local plane with PER-RULE fire counts;
+                  --collector adds the cluster-wide faults.fired rollup
+                  (every node's firings by kind+point), so a chaos soak
+                  can assert its schedule actually fired
         fault local --spec ... [--seed N] — arm THIS process's plane"""
         from tpu3fs.utils.fault_injection import parse_spec, plane
 
@@ -1035,8 +1039,26 @@ class AdminCli:
         if sub == "show":
             lines = []
             for r in plane().snapshot():
-                lines.append(f"local rule: {r}")
+                lines.append(f"local rule: point={r['point']} "
+                             f"kind={r['kind']} fired={r['fired']}"
+                             + (f"/{r['times']}" if r['times'] >= 0 else ""))
             lines.append(f"local fired total: {plane().fired_total}")
+            coll = self._flag(rest, "--collector", "")
+            if coll:
+                window = float(self._flag(rest, "--window", 120.0))
+                rows = self._agg_rows(coll, window, prefix="faults.fired")
+                fired = {}
+                for row in rows or []:
+                    key = (row.tags.get("kind", "?"),
+                           row.tags.get("point", "?"))
+                    fired[key] = fired.get(key, 0.0) + row.vsum
+                if fired:
+                    lines.append(f"cluster faults.fired (last {window:g}s):")
+                    for (kind, point), n in sorted(fired.items()):
+                        lines.append(f"  {point:<28} {kind:<10} {int(n)}")
+                else:
+                    lines.append(
+                        f"cluster faults.fired (last {window:g}s): none")
             nt = self._node_type_flag(rest)
             try:
                 blob = self.fab.mgmtd.get_config(nt)

@@ -308,7 +308,17 @@ class FileIoClient:
             base = bytearray(chunk_size)
             next_ver = 0
         else:
-            return cur
+            # normalize: callers raise FsError(code, MESSAGE) off write
+            # replies — a raw failed ReadReply has no message field
+            # (surfaced by the production-day soak: an archive write
+            # failing inside a fault window crashed on reply.message
+            # instead of raising the real error)
+            from tpu3fs.storage.craq import UpdateReply
+
+            return UpdateReply(
+                cur.code,
+                message=f"stripe RMW read of {cid} failed",
+            )
         base[in_off : in_off + len(part)] = part
         # trim stripe padding back to the logical extent so shard lengths
         # (and hence the file length from query_last_chunk) stay precise

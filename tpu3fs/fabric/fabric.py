@@ -201,6 +201,21 @@ class Fabric:
         node = self.nodes.get(node_id)
         if node is None or not node.alive:
             raise FsError(Status(Code.RPC_CONNECT_FAILED, f"node {node_id} down"))
+        # cluster fault plane: the in-fabric analogue of the transports'
+        # send/dispatch boundaries, so chaos schedules with rpc.* rules
+        # (chaos/schedule.py) exercise transport faults in-process too;
+        # drop rules surface as the torn-connection error the retry
+        # ladders know
+        from tpu3fs.utils.fault_injection import plane as _fault_plane
+
+        pl = _fault_plane()
+        if pl.active:
+            try:
+                pl.fire(f"rpc.send.Fabric.{method}", node=node_id)
+                pl.fire(f"rpc.dispatch.Fabric.{method}", node=node_id)
+            except ConnectionError as e:
+                raise FsError(Status(Code.RPC_PEER_CLOSED,
+                                     f"node {node_id}: {e}"))
         svc = node.service
         if method == "write":
             return svc.write(payload)

@@ -58,6 +58,10 @@ RESUME_REEXECUTED_METHODS = frozenset({
     ("Mgmtd", "dropChainTarget"),
     ("Mgmtd", "migrationClaim"),
     ("Mgmtd", "migrationReport"),
+    # the auto re-plan loop (maybe_replan): list is read-only, submit is
+    # conflict-refused per chain and re-derived from live routing
+    ("Mgmtd", "migrationList"),
+    ("Mgmtd", "migrationSubmit"),
     ("StorageSerde", "dumpChunkMeta"),
     ("StorageSerde", "batchRead"),
     ("StorageSerde", "batchUpdate"),
@@ -263,7 +267,7 @@ class MigrationWorker:
 
     def __init__(self, mgmtd, client, *, worker_id: str = "",
                  batch_chunks: int = 64, lease_s: float = 30.0,
-                 max_jobs: int = 4,
+                 max_jobs: int = 4, auto_replan: bool = False,
                  clock: Callable[[], float] = time.monotonic):
         self._mgmtd = mgmtd
         self._client = client
@@ -271,6 +275,7 @@ class MigrationWorker:
         self._batch = batch_chunks
         self._lease_s = lease_s
         self._max_jobs = max_jobs
+        self._auto_replan = auto_replan
         self._clock = clock
 
     # -- driver --------------------------------------------------------------
@@ -309,7 +314,51 @@ class MigrationWorker:
                     # transient (transport, shed, quorum wait): park,
                     # record the reason, retry next round
                     self._report(job, error=str(e))
+        if self._auto_replan:
+            self.maybe_replan()
         return advanced
+
+    def maybe_replan(self) -> int:
+        """Auto re-plan for multi-failure chains: the planner evacuates
+        at most ONE member per chain per wave (its quorum invariant is
+        local to a single job), so a chain with TWO members on leaving
+        nodes previously took one operator wave per member. When every
+        submitted job has settled but draining/dead nodes still host
+        chain members, submit the next replacement wave ourselves —
+        the operator's drain converges unattended. Conservative by
+        construction: only fires after at least one operator-submitted
+        job exists (the worker never initiates evacuation), never
+        auto-FILLS joined nodes (``fill_joined=False`` — joined nodes
+        stay eligible as evacuation DESTINATIONS, which matters when an
+        evacuated-then-restarted empty node is the only legal home for
+        a leaving member, but capacity rebalancing stays an operator
+        decision), and a quorum-unsafe or conflicting plan just waits
+        for the next round. Returns jobs submitted."""
+        from tpu3fs.placement.rebalance import (
+            TopologyDelta,
+            check_plan,
+            plan_rebalance,
+        )
+
+        try:
+            jobs = self._mgmtd.migration_list()
+        except FsError:
+            return 0
+        if not jobs or any(j.active for j in jobs):
+            return 0
+        routing = self._routing()
+        delta = TopologyDelta.from_routing(routing)
+        if not (delta.draining or delta.dead):
+            return 0
+        plan = plan_rebalance(routing, delta, fill_joined=False)
+        if plan.empty or check_plan(routing, plan, delta):
+            return 0
+        try:
+            ids = self._mgmtd.migration_submit(
+                [mv.spec() for mv in plan.moves])
+        except FsError:
+            return 0  # raced a peer worker: its wave wins
+        return len(ids)
 
     def run_until_idle(self, *, rounds: int = 200,
                        tick: Optional[Callable[[], None]] = None,

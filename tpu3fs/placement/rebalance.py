@@ -172,10 +172,18 @@ def plan_rebalance(
     delta: Optional[TopologyDelta] = None,
     *,
     chain_ids: Optional[List[int]] = None,
+    fill_joined: bool = True,
 ) -> RebalancePlan:
     """-> minimal ordered move list for ``delta`` (derived from routing
     tags/heartbeats when not given). Pure function of its inputs — safe
-    to call for preview (admin_cli placement-plan) and again for apply."""
+    to call for preview (admin_cli placement-plan) and again for apply.
+
+    ``fill_joined=False`` skips the fair-share FILL phase: joined nodes
+    still count as eligible EVACUATION destinations (an empty restarted
+    node is often the only place a leaving member can go), but no moves
+    are planned purely to give them load — the migration worker's auto
+    re-plan uses this so capacity rebalancing stays an operator
+    decision."""
     delta = delta or TopologyDelta.from_routing(routing)
     chain_ids = chain_ids or sorted(routing.chains)
     chains = {cid: routing.chains[cid] for cid in chain_ids
@@ -269,7 +277,7 @@ def plan_rebalance(
 
     # 2) FILL joined nodes to their fair share — and not one chain more
     total = int(loads.sum())
-    fair = total // max(len(final_nodes), 1)
+    fair = (total // max(len(final_nodes), 1)) if fill_joined else 0
     moved_chains = {m.chain_id for m in plan.moves}
     for _ in range(total):
         under = [n for n in delta.joined

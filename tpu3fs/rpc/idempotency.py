@@ -171,6 +171,11 @@ REPLAY_SAFE_MUTATIONS: Dict[Tuple[str, str], str] = {
         "(or a lapsed) claim just renews it",
     ("Mgmtd", "migrationReport"): "phases only move forward; re-reporting "
         "a passed phase is a no-op",
+    ("Mgmtd", "migrationSubmit"): "one active job per chain: a replayed "
+        "submit for a chain already being reshaped answers "
+        "MIGRATION_CONFLICT; the auto re-plan loop re-derives its plan "
+        "from live routing, so an already-evacuated node yields an "
+        "empty plan (no-op)",
 }
 
 

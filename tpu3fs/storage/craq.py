@@ -1050,6 +1050,15 @@ class StorageService:
                             ),
                         )
                 # suffix acked (or we are tail): commit (ref doCommit :611-631)
+                from tpu3fs.chaos.bugs import bug_fire
+
+                if req.from_target != 0 and bug_fire("commit_skip"):
+                    # PLANTED BUG (test-only; chaos/bugs.py): ack without
+                    # committing — the crash-window shape the chaos
+                    # search must catch (replica divergence)
+                    return UpdateReply(
+                        Code.OK, update_ver=update_ver,
+                        commit_ver=update_ver, checksum=our_sum)
                 meta = engine.commit(req.chunk_id, update_ver, chain_ver)
                 if tctx is not None:
                     now = time.perf_counter()
@@ -1770,6 +1779,19 @@ class StorageService:
                     else:
                         commit_items.append((reqs[i].chunk_id, ver))
                         commit_slots.append((i, ver, cs))
+                if commit_items:
+                    from tpu3fs.chaos.bugs import bug_fire
+
+                    if reqs[0].from_target != 0 and bug_fire("commit_skip"):
+                        # PLANTED BUG (test-only; chaos/bugs.py): a
+                        # chain-internal hop acks upstream without
+                        # committing — the crash-window shape the chaos
+                        # search must catch (replica divergence)
+                        for i, ver, cs in commit_slots:
+                            replies[i] = UpdateReply(
+                                Code.OK, update_ver=ver, commit_ver=ver,
+                                checksum=cs)
+                        commit_items = []
                 if commit_items:
                     t0 = time.perf_counter()
                     commit_res = engine.batch_commit(commit_items, chain_ver)

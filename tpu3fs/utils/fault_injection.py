@@ -178,7 +178,11 @@ class FaultPlane:
         self._rules: List[FaultRule] = []
         self._rng = random.Random(0)
         self._fired_total = 0
-        self._rec = None  # lazy: fault.fired counter
+        # lazy per-(kind, rule point) faults.fired counters — tagged by
+        # the RULE's point prefix (bounded cardinality: one per
+        # configured rule), so a soak can assert its schedule actually
+        # fired instead of a typo'd spec injecting nothing, silently
+        self._recs: dict = {}
 
     def configure(self, spec: str, seed: int = 0) -> None:
         """Install a new rule set (atomic: a bad spec raises and leaves
@@ -231,7 +235,7 @@ class FaultPlane:
                     continue
                 r.fired += 1
                 self._fired_total += 1
-                self._count_fired()
+                self._count_fired(r)
                 if r.kind == "delay_ms":
                     delay_ms += r.arg
                 elif r.kind == "drop":
@@ -248,13 +252,15 @@ class FaultPlane:
         if boom is not None:
             raise boom
 
-    def _count_fired(self) -> None:
-        rec = self._rec
+    def _count_fired(self, rule: FaultRule) -> None:
+        rec = self._recs.get((rule.kind, rule.point))
         if rec is None:
             from tpu3fs.monitor.recorder import CounterRecorder
 
-            rec = CounterRecorder("fault.fired")
-            self._rec = rec
+            rec = CounterRecorder("faults.fired",
+                                  tags={"kind": rule.kind,
+                                        "point": rule.point})
+            self._recs[(rule.kind, rule.point)] = rec
         rec.add()
 
 
