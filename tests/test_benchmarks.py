@@ -261,6 +261,11 @@ class TestEcBench:
         rows = run_bench(k=3, m=1, stripes=6, size=1 << 16, fast=True)
         by = {r["metric"]: r for r in rows}
         assert by["ec_encode_host_3_1"]["value"] > 0
+        ce = by["ec_chain_encode_2_2"]
+        assert ce["value"] > 0 and ce["cr_equal_overhead_gibps"] > 0
+        # the offload IS the point: zero client encode CPU in chain mode
+        assert ce["client_encode_cpu_s_per_gib"]["chain"] == 0.0
+        assert ce["client_encode_cpu_s_per_gib"]["client"] > 0
         w = by["ec_write_fused_3_1"]
         assert w["value"] > 0 and w["baseline_encode_then_write"] > 0
         assert by["ec_substripe_rmw_3_1"]["value"] > 0

@@ -271,6 +271,32 @@ class TestRunnerAndSearch:
         assert byname["stripe_versions"].status == "passed", r.summary()
         assert byname["crc_oracle"].status == "passed", r.summary()
 
+    def test_train_workload_fills_ckpt_and_dataload_checkers(self):
+        """spec.train_workload runs the mini training tenant so
+        ckpt_atomicity and dataload_resume JUDGE the search run (they
+        used to only judge the soak) — passed, never skipped."""
+        spec = ScheduleSpec(steps=12, events=2, storage_nodes=3,
+                            num_chains=2, num_replicas=2,
+                            allow_kill=False, train_workload=True)
+        r = run_schedule(generate_schedule(3, spec))
+        assert not r.violated, r.summary()
+        byname = {o.checker: o.status for o in r.outcomes}
+        assert byname["ckpt_atomicity"] == "passed", r.summary()
+        assert byname["dataload_resume"] == "passed", r.summary()
+
+    def test_chain_encode_schedule_green_and_bug_caught(self):
+        """spec.ec_chain_encode routes the EC workload through the
+        pipelined chain encode; the clean tree stays green, and the
+        planted chain_parity_skip hop bug is caught by the corpus
+        schedule (the full search->shrink loop produced
+        tests/chaos_seeds/chain_parity_skip_hop.json)."""
+        spec = ScheduleSpec(steps=12, events=2, storage_nodes=3,
+                            num_chains=2, num_replicas=2, ec_k=2, ec_m=1,
+                            ec_chain_encode=True, allow_kill=False)
+        r = run_schedule(generate_schedule(4, spec))
+        assert not r.violated, r.summary()
+        assert r.acked > 0
+
     def test_planted_bug_found_shrunk_and_replayed(self):
         """The acceptance loop: a re-introduced known bug is caught
         within a bounded seed budget, shrunk to a minimal prefix, and

@@ -231,6 +231,96 @@ class TestEcMove:
             assert rep.ok and bytes(rep.data) == data
 
 
+class TestEcDirectCopy:
+    """EC drain direct copy: with the outgoing member alive, the rebuild
+    moves the new shard with ONE target-addressed read per stripe off
+    the swap leftover (1/k the bytes of a decode) — decode stays the
+    dead-outgoing fallback, and the worker releases the leftover at
+    cutover so the retire scan reaps it."""
+
+    def _setup(self, stripes=6):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=4, num_chains=2,
+                                       ec_k=2, ec_m=1, chunk_size=1 << 12))
+        client = fab.storage_client()
+        cid = fab.chain_ids[0]
+        data_of = {}
+        for i in range(stripes):
+            data = bytes([i + 1]) * (1 << 12)
+            assert all(r.ok for r in client.write_stripes(
+                cid, [(ChunkId(300, i), data)], chunk_size=1 << 12))
+            data_of[i] = data
+        nid = fab.add_storage_node()
+        out = fab.routing().chains[cid].preferred_order[1]
+        fab.mgmtd.migration_submit(
+            [MoveSpec(chain_id=cid, out_target=out, dst_node=nid)])
+        return fab, cid, out, nid, data_of
+
+    def _drive(self, fab, rounds=40):
+        """Worker + per-node EcResyncWorkers, returning the aggregated
+        recovery-read sources so tests can assert WHERE bytes came from."""
+        from tpu3fs.storage.ec_resync import EcResyncWorker
+
+        w = _worker(fab, batch_chunks=16)
+        workers = {}
+
+        def tick():
+            fab.open_assigned_targets()
+            fab.tick()
+            for n, node in fab.nodes.items():
+                if node.alive:
+                    workers.setdefault(
+                        n, EcResyncWorker(node.service, fab.send)
+                    ).run_once()
+            fab.tick()
+
+        for _ in range(rounds):
+            if w.run_once() == 0 and not any(
+                    j.active for j in fab.mgmtd.migration_list()):
+                break
+            tick()
+        sources = {}
+        for wk in workers.values():
+            for t, c in wk.last_stats["read_sources"].items():
+                sources[t] = sources.get(t, 0) + c
+        return sources
+
+    def test_alive_outgoing_moves_one_read_per_stripe(self):
+        fab, cid, out, nid, data_of = self._setup()
+        sources = self._drive(fab)
+        # every stripe came off the leftover: ONE read each, and NO
+        # survivor (decode) reads at all
+        assert sources == {out: len(data_of)}, sources
+        # cutover released the leftover (chain_id 0) -> retire reaps it
+        ri = fab.routing()
+        assert ri.targets[out].chain_id == 0
+        out_node = ri.targets[out].node_id
+        fab.retire_unassigned_targets()
+        assert all(t.target_id != out
+                   for t in fab.nodes[out_node].service.targets())
+        c2 = fab.storage_client()
+        for i, data in data_of.items():
+            rep = c2.read_stripe(cid, ChunkId(300, i), chunk_size=1 << 12)
+            assert rep.ok and bytes(rep.data) == data
+        fab.close()
+
+    def test_dead_outgoing_falls_back_to_decode(self):
+        fab, cid, out, nid, data_of = self._setup()
+        out_node = fab.routing().targets[out].node_id
+        fab.fail_node(out_node)
+        sources = self._drive(fab, rounds=60)
+        # the leftover was unreachable: recovery decoded from survivors
+        assert sources.get(out, 0) == 0, sources
+        assert sum(sources.values()) >= len(data_of), sources
+        chain = fab.routing().chains[cid]
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in chain.targets)
+        c2 = fab.storage_client()
+        for i, data in data_of.items():
+            rep = c2.read_stripe(cid, ChunkId(300, i), chunk_size=1 << 12)
+            assert rep.ok and bytes(rep.data) == data
+        fab.close()
+
+
 class TestDrainCli:
     def test_drain_to_zero_chains(self):
         fab = Fabric(SystemSetupConfig(num_storage_nodes=4, num_chains=4,

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from tpu3fs.fabric.fabric import Fabric, SystemSetupConfig
+from tpu3fs.mgmtd.types import PublicTargetState
 from tpu3fs.migration.types import JobPhase, MoveSpec
 from tpu3fs.placement import (
     PlacementProblem,
@@ -210,12 +211,23 @@ class TestMgmtdChainMutation:
         chain = fab.routing().chains[cid]
         assert chain.preferred_order[slot] == 7000
         assert all(t.target_id != old for t in chain.targets)
-        assert fab.routing().targets[old].chain_id == 0
+        # the outgoing member is detached from the chain but KEPT alive
+        # in routing (chain_id intact, public OFFLINE) — the drain
+        # direct-copy window; the node's retire scan must not reap it yet
+        out_info = fab.routing().targets[old]
+        assert out_info.chain_id == cid
+        assert out_info.public_state == PublicTargetState.OFFLINE
         # the swap consumed the spare unit: a second swap must refuse
         with pytest.raises(FsError) as ei:
             fab.mgmtd.add_chain_target(
                 cid, 7001, 13, replace_of=chain.preferred_order[0])
         assert ei.value.code == Code.MIGRATION_QUORUM
+        # cutover RELEASE: dropping the (non-member) outgoing target
+        # detaches it to chain_id 0 so the retire scan reaps it;
+        # idempotent on repeat
+        for _ in range(2):
+            fab.mgmtd.drop_chain_target(cid, old)
+            assert fab.routing().targets[old].chain_id == 0
 
     def test_node_tags_merge_and_clear(self):
         fab = _cr_fabric(3, 2, 2)

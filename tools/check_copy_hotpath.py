@@ -49,7 +49,10 @@ HOT_PATH: List[Tuple[str, List[str]]] = [
      ["_batch_read_impl",
       # write path: batched stage/forward/commit pipeline + the streaming
       # chain forward (the received views are re-gathered onward)
-      "_handle_batch_update", "_forward_batch", "_make_forward_req"]),
+      "_handle_batch_update", "_forward_batch", "_make_forward_req",
+      # pipelined chain encode: the hop must forward accumulator ROWS as
+      # memoryviews and install via the shared validated path
+      "chain_encode", "_chain_encode_hop"]),
     ("tpu3fs/storage/engine.py", ["batch_read_views"]),
     ("tpu3fs/storage/native_engine.py",
      ["batch_read_views",
@@ -64,10 +67,16 @@ HOT_PATH: List[Tuple[str, List[str]]] = [
       # EC data plane: batched shard fetch, clean/degraded stripe
       # assembly (the degraded fill), delta-parity sub-stripe RMW
       "_issue_wire_reads", "_plan_stripe_read", "_stripe_clean",
-      "_stripe_degraded", "_finish_stripe_reads", "_write_stripe_rmw"]),
+      "_stripe_degraded", "_finish_stripe_reads", "_write_stripe_rmw",
+      # chain-encode planning: raw data shards go out as VIEWS of the
+      # caller's stripe bytes (the whole client-CPU offload story)
+      "_write_stripes_chain"]),
     # EC kernels: XOR-scheduled host encode + delta-parity column apply
-    ("tpu3fs/ops/rs.py", ["encode_np", "delta_parity_host"]),
-    ("tpu3fs/ops/stripe.py", ["encode_parity", "delta_parity"]),
+    # + the chain-encode hop accumulate (in-place XOR, no staging copies)
+    ("tpu3fs/ops/rs.py", ["encode_np", "delta_parity_host",
+                          "gf_accumulate"]),
+    ("tpu3fs/ops/stripe.py", ["encode_parity", "delta_parity",
+                              "hop_accumulate"]),
     # EC rebuild: batched recovery gather + batched shard install
     ("tpu3fs/storage/ec_resync.py",
      ["_gather_batched", "_install_batch", "_rebuild_batch"]),

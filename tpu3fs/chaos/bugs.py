@@ -22,6 +22,15 @@ Known bugs:
   replica silently stays at the old committed version. Caught by the
   ``replica_versions`` invariant checker (and by ``crc_oracle`` when a
   read lands on the stale replica).
+
+- ``chain_parity_skip`` — the chain-encode hop bug shape: a data hop of
+  the pipelined chain encode installs its shard but forwards the parity
+  accumulator UNCHANGED — contribution AND partial-CRC composition both
+  dropped (the realistic "forgot to accumulate" bug), so the tail's
+  validated install passes and consistently-WRONG parity commits
+  cleanly. Invisible to clean reads (data shards only); caught by
+  ``crc_oracle`` the moment a kill forces a degraded decode through the
+  bad parity (or a rebuild re-materializes a data shard from it).
 """
 
 from __future__ import annotations
@@ -38,7 +47,7 @@ _armed: Set[str] = set(
 
 #: names production hook sites are allowed to ask about (a typo'd
 #: arm()/hook pair must fail loudly, not silently never fire)
-KNOWN_BUGS = frozenset({"commit_skip"})
+KNOWN_BUGS = frozenset({"commit_skip", "chain_parity_skip"})
 
 
 def arm(name: str) -> None:

@@ -69,6 +69,7 @@ FAULT_POINTS = (
     "storage.read",
     "storage.update",
     "storage.write_shard",
+    "storage.chain_encode",
     "rpc.dispatch",
     "rpc.send",
 )
@@ -121,6 +122,12 @@ class ScheduleSpec:
     num_replicas: int = 2
     ec_k: int = 0                    # >0: EC(k,m) fabric instead of CR
     ec_m: int = 0
+    # EC writes ride the pipelined chain encode (TPU3FS_EC_CHAIN_ENCODE
+    # scoped around the run) instead of the client-side encode
+    ec_chain_encode: bool = False
+    # run the training sidecar (mini ckpt saves + dataload cursor) so
+    # the ckpt_atomicity / dataload_resume checkers judge the run too
+    train_workload: bool = False
     allow_kill: bool = True
     allow_elastic: bool = False      # join/drain events (need a worker)
     allow_config_push: bool = True

@@ -112,6 +112,11 @@ class FabricRunner:
 
         spec = self.schedule.spec
         _rec_runs.add(1)
+        # EC chain-encode lever scoped to this run: write_stripes reads
+        # it per call, so the restore in `finally` is airtight
+        env_prev = os.environ.get("TPU3FS_EC_CHAIN_ENCODE")
+        if spec.ec_chain_encode:
+            os.environ["TPU3FS_EC_CHAIN_ENCODE"] = "1"
         self.fab = Fabric(SystemSetupConfig(
             num_storage_nodes=spec.storage_nodes,
             num_chains=spec.num_chains,
@@ -137,6 +142,9 @@ class FabricRunner:
         self.writes_issued: Dict[Tuple[int, int, int], int] = {}
         self._worker = None
         self._tenants_touched = False
+        self._train = None
+        if spec.train_workload:
+            self._train_setup()
         report = RunReport(self.schedule)
         by_step: Dict[int, List[ChaosEvent]] = {}
         for e in self.schedule.events:
@@ -151,6 +159,7 @@ class FabricRunner:
                         report.events_skipped += 1
                 for _ in range(self.ops_per_step):
                     self._workload_op(report)
+                self._train_tick(step)
                 self._background_tick()
             self._quiesce()
             ctx = self._context()
@@ -162,6 +171,16 @@ class FabricRunner:
                         o.status = "violated"
         finally:
             plane().clear()
+            if spec.ec_chain_encode:
+                if env_prev is None:
+                    os.environ.pop("TPU3FS_EC_CHAIN_ENCODE", None)
+                else:
+                    os.environ["TPU3FS_EC_CHAIN_ENCODE"] = env_prev
+            if self._train is not None:
+                try:
+                    self._train["loader"].close()
+                except Exception:
+                    pass
             if self._tenants_touched:
                 from tpu3fs.tenant.quota import registry
 
@@ -326,7 +345,13 @@ class FabricRunner:
                 self.writes_issued[key] = self.writes_issued.get(key, 0) + 1
                 report.writes += 1
                 try:
-                    if self.is_ec:
+                    if self.is_ec and self.schedule.spec.ec_chain_encode:
+                        # the batched entry is the one that plans the
+                        # chain-encode relay (lever scoped by run())
+                        rep = client.write_stripes(
+                            chain, [(ChunkId(fid, idx), data)],
+                            chunk_size=1 << 16)[0]
+                    elif self.is_ec:
                         rep = client.write_stripe(
                             chain, ChunkId(fid, idx), data,
                             chunk_size=1 << 16)
@@ -362,6 +387,98 @@ class FabricRunner:
                             "crc_oracle",
                             f"mid-run read of {key} returned bytes no "
                             f"client ever wrote (torn read)"))
+
+    # -- training sidecar (ckpt + dataload checkers in the SEARCH) ------------
+    def _train_setup(self) -> None:
+        """A miniature training tenant riding the chaos run: a packed
+        dataset, a live DataLoader, and mid-run ckpt saves that compose
+        the loader cursor — so ``ckpt_atomicity`` and
+        ``dataload_resume`` judge every search run, not just the soak.
+        All sizes tiny (ms per run); everything derives from the
+        schedule seed, keeping replays byte-deterministic."""
+        import numpy as np
+
+        from tpu3fs.ckpt import CheckpointManager
+        from tpu3fs.dataload import (
+            DataLoader,
+            LoaderConfig,
+            PackedDataset,
+            pack_records,
+        )
+
+        meta, fio = self.fab.meta, self.fab.file_client()
+        meta.mkdirs("/chaos", recursive=True)
+        rng = np.random.default_rng(self.schedule.seed ^ 0x7EA1)
+        recs = [rng.integers(0, 256, 64, dtype=np.uint8).tobytes()
+                for _ in range(24)]
+        pack_records(meta, fio, "/chaos/train.rec", recs)
+        ds = PackedDataset(meta, fio, ["/chaos/train.rec"])
+        cfg = dict(global_batch=4, seed=7, depth=1, workers=1, epochs=1)
+        # reference pass BEFORE any event fires: the exact sequence a
+        # resumed run must continue
+        with DataLoader(ds, LoaderConfig(**cfg)) as ref:
+            expected = [list(map(int, b.ids)) for b in ref]
+        self._train = {
+            "mgr": CheckpointManager(meta, fio, root="/chaos/ckpt",
+                                     client_id="chaos-ckpt"),
+            "ds": ds, "cfg": cfg, "expected": expected,
+            "loader": DataLoader(ds, LoaderConfig(**cfg)),
+            "consumed": 0, "acked": [], "saved_consumed": {},
+        }
+        self._train["it"] = iter(self._train["loader"])
+
+    def _train_tick(self, step: int) -> None:
+        """At two deterministic step marks: consume one batch, then save
+        a ckpt composing the loader cursor. Failures mid-chaos are
+        weather — an UNACKED save carries no atomicity obligation."""
+        tr = self._train
+        if tr is None:
+            return
+        steps = self.schedule.spec.steps
+        if step not in {max(1, steps // 3), max(2, (2 * steps) // 3)}:
+            return
+        import numpy as np
+
+        try:
+            next(tr["it"])
+            tr["consumed"] += 1
+        except StopIteration:
+            pass
+        except Exception:
+            return  # fetch failed under the fault plane: skip this mark
+        st = tr["loader"].state()
+        tree = {"w": np.full((8, 8), float(step), dtype=np.float32),
+                "dl": st.to_leaf()}
+        try:
+            tr["mgr"].save(tree, step)
+        except Exception:
+            return  # unacked: the checker only judges acked saves
+        tr["acked"].append(step)
+        tr["saved_consumed"][step] = tr["consumed"]
+
+    def _train_list_raw(self):
+        try:
+            return [e.name for e in self.fab.meta.list_dir("/chaos/ckpt")]
+        except Exception:
+            return []
+
+    def _train_resume_replay(self):
+        """Restore the newest acked ckpt's cursor into a FRESH loader
+        and hand (expected remaining, resumed) to the checker."""
+        from tpu3fs.dataload import DataLoader, DataloadState, LoaderConfig
+
+        tr = self._train
+        mgr = tr["mgr"]
+        acked_visible = [s for s in tr["acked"] if s in mgr.steps()]
+        if not acked_visible:
+            return [], []  # chaos prevented every save: nothing to judge
+        s = max(acked_visible)
+        tree = mgr.restore(s)
+        st = DataloadState.from_leaf(tree["dl"])
+        with DataLoader(tr["ds"], LoaderConfig(**tr["cfg"]),
+                        state=st) as lo:
+            resumed = [list(map(int, b.ids)) for b in lo]
+        return tr["expected"][tr["saved_consumed"][s]:], resumed
 
     # -- quiesce + verdict ----------------------------------------------------
     def _quiesce(self) -> None:
@@ -407,6 +524,19 @@ class FabricRunner:
         return bytes(rep.data)
 
     def _context(self) -> ChaosContext:
+        train = {}
+        if self._train is not None:
+            # stop the live loader's fetcher before the verdict reads
+            try:
+                self._train["loader"].close()
+            except Exception:
+                pass
+            train = dict(
+                ckpt_manager=self._train["mgr"],
+                ckpt_acked_steps=list(self._train["acked"]),
+                ckpt_list_raw=self._train_list_raw,
+                resume_replay=self._train_resume_replay,
+            )
         return ChaosContext(
             read_chunk=self._read_chunk,
             oracle=self.oracle,
@@ -414,6 +544,7 @@ class FabricRunner:
             routing=self.fab.routing,
             dump_chunkmeta=lambda node, tid: self.fab.send(
                 node, "dump_chunkmeta", tid),
+            **train,
         )
 
 

@@ -49,6 +49,17 @@ def ec_logical_ver(encoded: int) -> int:
         else encoded
 
 
+def _chain_encode_enabled() -> bool:
+    """A/B lever for the pipelined chain encode (docs/ec.md): EC stripe
+    batches ship RAW data shards down the encode-ordered chain and the
+    hops accumulate the parity — the client's encode CPU drops to ~zero.
+    Off by default (the client-side XOR-scheduled encode is the proven
+    baseline); read per call so tests/benches/drives flip it live."""
+    import os
+
+    return os.environ.get("TPU3FS_EC_CHAIN_ENCODE", "0") == "1"
+
+
 def _hint_ms(reply) -> int:
     """Server retry-after hint of a shed reply: the typed field when the
     reply carries one, else parsed from the envelope message."""
@@ -159,6 +170,15 @@ class StorageClient:
         self._ec_parity_rmw = CounterRecorder("ec.parity_rmw")
         self._ec_rmw_fallback = CounterRecorder("ec.parity_rmw_fallback")
         self._ec_encode_gibps = ValueRecorder("ec.encode_gibps")
+        # pipelined chain encode (TPU3FS_EC_CHAIN_ENCODE=1): stripes
+        # staged through the chain relay vs stripes that fell back to the
+        # client-side encode ladder
+        self._ec_chain_stripes = CounterRecorder("ec.chain_encode_stripes")
+        self._ec_chain_fallback = CounterRecorder("ec.chain_encode_fallback")
+        # cumulative client-side encode CPU (seconds inside encode_parity
+        # on the write path) — the offload the chain encode exists to
+        # deliver; read by benchmarks/ec_bench.py, not a wire metric
+        self.encode_cpu_s = 0.0
         # gray-failure defenses (docs/robustness.md): per-peer health —
         # the socket messenger shares its registry (its breaker also
         # fail-fasts writes); in-process messengers get a client-local one
@@ -879,7 +899,9 @@ class StorageClient:
         k, m = chain.ec_k, chain.ec_m
         S = shard_size_of(chunk_size, k)
         codec = get_codec(k, m, S)
+        t_enc = time.monotonic()
         shards, crcs = codec.encode_stripe(data)
+        self.encode_cpu_s += time.monotonic() - t_enc
         ver = update_ver or self._ec_next_ver(0)
         last: Optional[UpdateReply] = None
         done: set = set()     # shard indices STAGED at `ver`
@@ -934,9 +956,16 @@ class StorageClient:
                 if reply.ok:
                     acked += 1
                     done.add(j)
-                elif reply.code == Code.CHUNK_STALE_UPDATE:
-                    # a newer stripe version exists: re-write the whole
-                    # stripe above it (whole-stripe versioning, fresh nonce)
+                elif reply.code in (Code.CHUNK_STALE_UPDATE,
+                                    Code.CHUNK_ADVANCE_UPDATE):
+                    # STALE: a newer COMMITTED stripe exists — re-write
+                    # above it (whole-stripe versioning, fresh nonce).
+                    # ADVANCE: an ABANDONED pending (e.g. an aborted
+                    # chain-encode relay or a crashed writer) sits above
+                    # our version with the same logical number — bumping
+                    # the logical version clears it (staging displaces
+                    # older pendings), where retrying the same ver would
+                    # wedge forever on the orphan.
                     bump_to = max(
                         bump_to,
                         self._ec_next_ver(max(reply.commit_ver, ver)))
@@ -1088,19 +1117,6 @@ class StorageClient:
         B = len(items)
         if B == 0:
             return []
-        buf = np.zeros((B, k, S), dtype=np.uint8)  # copy-ok: device encode input
-        for b, (_, data) in enumerate(items):
-            flat = np.frombuffer(data, dtype=np.uint8)
-            buf[b].reshape(-1)[: flat.size] = flat
-        # parity-only encode: data-shard payloads below are slices of the
-        # caller's bytes, so materializing a concatenated (B, k+m, S)
-        # array would be a multi-MiB copy per batch for nothing
-        t_enc = time.monotonic()
-        parity, crcs = codec.encode_parity(buf)
-        dt_enc = time.monotonic() - t_enc
-        if dt_enc > 0:
-            self._ec_encode_gibps.set(B * k * S / dt_enc / (1 << 30))
-
         routing = self._routing()
         # one-RPC version probe: max committed over probed shards is the
         # floor for this batch's stripe versions (a later shard write may
@@ -1118,6 +1134,28 @@ class StorageClient:
                             for st in stats]
                 except FsError:
                     pass  # probe is an optimization; conflicts still ladder
+        if _chain_encode_enabled():
+            # pipelined chain encode: ship RAW data shards down the
+            # encode-ordered chain — the hops compute the parity
+            # (docs/ec.md "Pipelined chain encode"); None = plan not
+            # viable / relay aborted before staging -> client encode
+            out = self._write_stripes_chain(chain, routing, items, vers,
+                                            S, chunk_size)
+            if out is not None:
+                return out
+        buf = np.zeros((B, k, S), dtype=np.uint8)  # copy-ok: device encode input
+        for b, (_, data) in enumerate(items):
+            flat = np.frombuffer(data, dtype=np.uint8)
+            buf[b].reshape(-1)[: flat.size] = flat
+        # parity-only encode: data-shard payloads below are slices of the
+        # caller's bytes, so materializing a concatenated (B, k+m, S)
+        # array would be a multi-MiB copy per batch for nothing
+        t_enc = time.monotonic()
+        parity, crcs = codec.encode_parity(buf)
+        dt_enc = time.monotonic() - t_enc
+        self.encode_cpu_s += dt_enc
+        if dt_enc > 0:
+            self._ec_encode_gibps.set(B * k * S / dt_enc / (1 << 30))
         by_node: Dict[int, List[Tuple[int, ShardWriteReq]]] = defaultdict(list)
         acked = [0] * B
         hard: List[Optional[UpdateReply]] = [None] * B
@@ -1187,6 +1225,118 @@ class StorageClient:
                 # conflict or partial: the single-stripe ladder re-probes
                 out.append(self.write_stripe(
                     chain_id, cid, data, chunk_size=chunk_size,
+                    update_ver=vers[b]))
+        return out
+
+    def _write_stripes_chain(
+        self,
+        chain: ChainInfo,
+        routing: RoutingInfo,
+        items: List[Tuple[ChunkId, bytes]],
+        vers: List[int],
+        S: int,
+        chunk_size: int,
+    ) -> Optional[List[UpdateReply]]:
+        """Stage a stripe batch through the PIPELINED CHAIN ENCODE: one
+        chain_encode RPC to shard 0's node carries the RAW data shards
+        (parity frames empty — the hops accumulate them), then the same
+        phase-2 commit round as the client-encode path. Returns None when
+        the plan is not viable (a shard target non-writable/unroutable,
+        m = 0, or the relay failed before staging anything) — the caller
+        runs the client-side encode. Per-stripe relay failures fall to
+        the write_stripe ladder, which IS the client-side encode."""
+        k, m = chain.ec_k, chain.ec_m
+        if m < 1:
+            return None
+        targets, nodes = [], []
+        for j in range(k + m):
+            t = chain.target_of_shard(j)
+            if t is None or not t.public_state.can_write:
+                return None  # a relay needs EVERY hop writable
+            node = routing.node_of_target(t.target_id)
+            if node is None:
+                return None
+            targets.append(t)
+            nodes.append(node)
+        B = len(items)
+        width = k + m
+        reqs: List[ShardWriteReq] = []
+        for b, (cid, data) in enumerate(items):
+            for j in range(width):
+                # data shards: trimmed VIEWS of the caller's stripe bytes
+                # (the bulk frame gathers them — no slice copies); crc -1
+                # = "no client CRC": raw data shards install under the
+                # CR-write trust model (the hop engine's staging CRC
+                # stands), parity frames start empty and accumulate CRCs
+                # hop by hop
+                payload = (memoryview(data)[j * S : (j + 1) * S]
+                           if j < k else b"")
+                reqs.append(ShardWriteReq(
+                    chain_id=chain.chain_id,
+                    chain_ver=chain.chain_version,
+                    target_id=targets[j].target_id,
+                    chunk_id=cid,
+                    data=payload,
+                    crc=-1,
+                    update_ver=vers[b],
+                    chunk_size=S,
+                    logical_len=len(data),
+                    phase=1,  # STAGE: committed stripe survives a failure
+                ))
+            del cid, data
+        try:
+            replies = self._messenger(nodes[0].node_id, "chain_encode",
+                                      reqs)
+        except FsError:
+            # relay unreachable (old server, dead head, ring trouble):
+            # nothing staged — the client-encode path takes the batch
+            self._ec_chain_fallback.add(B)
+            return None
+        if not isinstance(replies, list) or len(replies) != len(reqs):
+            self._ec_chain_fallback.add(B)
+            return None
+        staged = [True] * B
+        for i, rep in enumerate(replies):
+            if rep is None or not rep.ok:
+                staged[i // width] = False
+        # phase-2 commits for fully-staged stripes: direct per-node
+        # fan-out (no relay — commits carry no payload), the SAME commit
+        # round and strict all-(k+m) rule as the client-encode path, so
+        # the whole-stripe-version invariant is untouched
+        commit_by_node: Dict[int, List[Tuple[int, ShardWriteReq]]] = (
+            defaultdict(list))
+        for b, (cid, data) in enumerate(items):
+            if not staged[b]:
+                continue
+            for j in range(width):
+                commit_by_node[nodes[j].node_id].append((b, ShardWriteReq(
+                    chain_id=chain.chain_id,
+                    chain_ver=chain.chain_version,
+                    target_id=targets[j].target_id,
+                    chunk_id=cid,
+                    data=b"",
+                    crc=0,
+                    update_ver=vers[b],
+                    chunk_size=S,
+                    logical_len=len(data),
+                    phase=2,
+                )))
+        committed = [0] * B
+        for b, reply in self._send_shard_batches(commit_by_node):
+            if reply.ok:
+                committed[b] += 1
+        out: List[UpdateReply] = []
+        for b, (cid, data) in enumerate(items):
+            if staged[b] and committed[b] == width:
+                self._ec_chain_stripes.add()
+                out.append(UpdateReply(
+                    Code.OK, update_ver=vers[b], commit_ver=vers[b]))
+            else:
+                # aborted mid-chain / version conflict / partial commit:
+                # the single-stripe CLIENT-ENCODE ladder converges it
+                self._ec_chain_fallback.add()
+                out.append(self.write_stripe(
+                    chain.chain_id, cid, data, chunk_size=chunk_size,
                     update_ver=vers[b]))
         return out
 
@@ -1739,6 +1889,35 @@ class StorageClient:
                             chunk_id: ChunkId) -> bool:
         return bool(self._messenger(node_id, "remove_chunk",
                                     (target_id, chunk_id)))
+
+    def batch_read_rebuild(self, node_id: int,
+                           reqs: List[ReadReq]) -> List[ReadReply]:
+        """Batched rebuild-tier reads addressed at ONE node's targets,
+        bypassing the public-state gate (chain_id 0 = target-addressed
+        out-of-chain read: the EC drain direct copy reads the detached
+        outgoing member). Transport errors come back as per-op replies."""
+        if not reqs:
+            return []
+        try:
+            return list(self._messenger(node_id, "batch_read_rebuild",
+                                        reqs))
+        except FsError as e:
+            return [ReadReply(e.code) for _ in reqs]
+
+    def batch_write_shard(self, node_id: int,
+                          reqs: List[ShardWriteReq]) -> List[UpdateReply]:
+        """Batched EC shard installs addressed at ONE node (the rebuild/
+        direct-copy install leg). Version-deduped server-side: a shard
+        already committed at (or past) the request's stripe version
+        answers OK / CHUNK_STALE_UPDATE instead of double-applying."""
+        if not reqs:
+            return []
+        try:
+            return list(self._messenger(node_id, "batch_write_shard",
+                                        reqs))
+        except FsError as e:
+            return [UpdateReply(e.code, message=e.status.message)
+                    for _ in reqs]
 
     def batch_sync_write(self, node_id: int,
                          reqs: List[WriteReq]) -> List[UpdateReply]:

@@ -239,6 +239,8 @@ class Fabric:
             return svc.stat_chunks(*payload)
         if method == "batch_write_shard":
             return svc.batch_write_shard(payload)
+        if method == "chain_encode":
+            return svc.chain_encode(payload)
         if method == "dump_chunkmeta":
             return svc.dump_chunkmeta(payload)
         if method == "dump_pending_chunkmeta":

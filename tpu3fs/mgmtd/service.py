@@ -358,7 +358,12 @@ class Mgmtd:
             old = self._routing.targets.get(replace_of)
             if old is not None:
                 dropped_info = replace(old)
-                dropped_info.chain_id = 0
+                # KEEP chain_id: the swapped-out member leaves the chain
+                # but must survive the hosting node's retirement scan
+                # (which reaps chain_id 0) until the migration worker
+                # releases it at cutover — that window is the EC drain
+                # DIRECT-COPY path (the worker reads the outgoing shard
+                # target-addressed, 1/k the bytes of a decode rebuild)
                 dropped_info.public_state = PublicTargetState.OFFLINE
         else:
             targets.append(new_member)
@@ -401,7 +406,29 @@ class Mgmtd:
         if chain is None:
             raise FsError(Status(Code.MGMTD_CHAIN_NOT_FOUND, str(chain_id)))
         if all(t.target_id != target_id for t in chain.targets):
-            return  # resumed worker re-executing a committed cutover
+            # not a member: a resumed worker re-executing a committed
+            # cutover (no-op), or the RELEASE of an EC swap's outgoing
+            # member — detached from the chain at PREPARE but kept alive
+            # in routing (chain_id intact) for the drain direct-copy
+            # window; cutover detaches it to chain_id 0 / OFFLINE so the
+            # hosting node's scan retires (trash-routes) it. No quorum
+            # gate: the release changes no chain membership.
+            info = self._routing.targets.get(target_id)
+            if info is None or info.chain_id != chain_id:
+                return
+            released = replace(info)
+            released.chain_id = 0
+            released.public_state = PublicTargetState.OFFLINE
+
+            def release_op(txn: ITransaction) -> int:
+                self._ensure_primary_in_txn(txn, self._clock())
+                txn.set(_target_key(target_id), serialize(released))
+                return self._bump_routing_in_txn(txn)
+
+            ver = with_transaction(self._engine, release_op)
+            self._routing.targets[target_id] = released
+            self._routing.version = ver
+            return
         remaining = [replace(t) for t in chain.targets
                      if t.target_id != target_id]
         serving_after = sum(

@@ -41,7 +41,7 @@ from typing import Callable, Dict, List, Optional
 
 from tpu3fs.migration.types import JobPhase, MigrationJob
 from tpu3fs.mgmtd.types import PublicTargetState
-from tpu3fs.storage.craq import ReadReq, WriteReq
+from tpu3fs.storage.craq import ReadReq, ShardWriteReq, WriteReq
 from tpu3fs.storage.types import ChunkId
 from tpu3fs.utils.result import Code, FsError, err
 
@@ -66,6 +66,9 @@ RESUME_REEXECUTED_METHODS = frozenset({
     ("StorageSerde", "batchRead"),
     ("StorageSerde", "batchUpdate"),
     ("StorageSerde", "syncDone"),
+    # the EC drain direct-copy round (_ec_copy_round)
+    ("StorageSerde", "batchReadRebuild"),
+    ("StorageSerde", "batchWriteShard"),
 })
 
 # -- recorders (single declaration site; docs/observability.md) --------------
@@ -450,9 +453,11 @@ class MigrationWorker:
         if member.public_state != PublicTargetState.SYNCING:
             return False  # destination bounced: wait for re-promotion
         if job.is_ec:
-            # the shard is decode-rebuilt by the chain's EcResyncWorker
-            # (storage-side, EC_REBUILD class); we only monitor
-            return False
+            # DIRECT shard copy from the outgoing member while it is
+            # still alive (1/k the bytes of a decode rebuild); the
+            # chain's EcResyncWorker stays the dead-outgoing-target
+            # fallback AND the verifier/promoter either way
+            return self._ec_copy_round(job, routing, chain)
         return self._copy_round(job, routing, chain)
 
     def _copy_round(self, job: MigrationJob, routing, chain) -> bool:
@@ -528,6 +533,84 @@ class MigrationWorker:
             time.sleep(max(hint, 10) / 1000.0)
         return copied > 0
 
+    def _ec_copy_round(self, job: MigrationJob, routing, chain) -> bool:
+        """One bounded EC DIRECT-COPY round: the outgoing member a swap
+        detached from the chain (routing keeps its TargetInfo at
+        chain_id 0 until the hosting node retires it) holds EXACTLY the
+        shard the new member needs — read it target-addressed
+        (batch_read_rebuild with chain_id 0) and install it on the
+        destination at the source's committed stripe version, moving 1/k
+        the bytes a decode rebuild reads. Every piece re-runs safely:
+        reads are idempotent, installs version-dedupe, and ANY failure
+        (outgoing node dead, target already retired, raced writes) just
+        returns False — the chain's EcResyncWorker decode-rebuilds
+        whatever this round didn't land and remains the sole promoter,
+        so correctness never depends on the fast path."""
+        from tpu3fs.ops.stripe import aligned_shard_size
+
+        if not job.out_target:
+            return False
+        out_info = routing.targets.get(job.out_target)
+        out_node = (routing.nodes.get(out_info.node_id)
+                    if out_info is not None else None)
+        if out_info is None or out_node is None:
+            return False  # outgoing member gone: decode rebuild recovers
+        try:
+            src = [m for m in self._client.dump_chunkmeta(
+                out_info.node_id, job.out_target) if m.committed_ver > 0]
+            have = {m.chunk_id.to_bytes(): m
+                    for m in self._client.dump_chunkmeta(
+                        job.dst_node, job.new_target)}
+        except FsError:
+            return False  # unreachable/retired: decode rebuild recovers
+        todo = []
+        for m in src:
+            if m.length == 0:
+                continue  # empty tail shards: the rebuilder's business
+            mine = have.get(m.chunk_id.to_bytes())
+            if mine is not None and mine.committed_ver >= m.committed_ver:
+                continue
+            todo.append(m)
+        if not todo:
+            return False  # nothing left to fast-copy; rebuilder promotes
+        batch = todo[:self._batch]
+        reads = self._client.batch_read_rebuild(out_info.node_id, [
+            ReadReq(0, m.chunk_id, 0, -1, job.out_target) for m in batch])
+        reqs, sizes = [], []
+        for m, rd in zip(batch, reads):
+            if not rd.ok or rd.commit_ver != m.committed_ver:
+                continue  # raced/refused: re-diffed next round
+            reqs.append(ShardWriteReq(
+                chain_id=job.chain_id,
+                chain_ver=chain.chain_version,
+                target_id=job.new_target,
+                chunk_id=m.chunk_id,
+                data=rd.data,
+                crc=rd.checksum.value,
+                update_ver=rd.commit_ver,
+                chunk_size=aligned_shard_size(len(rd.data)),
+                logical_len=rd.logical_len,
+                phase=0,   # proven content installs committed in one step
+            ))
+            sizes.append(len(rd.data))
+        replies = self._client.batch_write_shard(job.dst_node, reqs)
+        copied = nbytes = 0
+        hint = 0
+        for sz, wr in zip(sizes, replies):
+            if wr.code in (Code.OVERLOADED, Code.TENANT_THROTTLED):
+                hint = max(hint, wr.retry_after_ms or 10)
+                continue
+            if wr.ok:
+                copied += 1
+                nbytes += sz
+        if copied:
+            _rec_copied_chunks.add(copied)
+            _rec_copied_bytes.add(nbytes)
+            self._report(job, copied_chunks=copied, copied_bytes=nbytes)
+        if hint:
+            time.sleep(max(hint, 10) / 1000.0)
+        return copied > 0
+
     def _step_cutover(self, job: MigrationJob) -> bool:
         routing = self._routing()
         chain = self._chain(routing, job)
@@ -546,6 +629,12 @@ class MigrationWorker:
             self._mgmtd.drop_chain_target(
                 job.chain_id, job.out_target,
                 min_serving=len(chain.targets) - 1)
+        elif job.out_target and job.is_ec:
+            # EC swap: the outgoing member left the chain at PREPARE but
+            # routing kept it alive for the direct-copy window — RELEASE
+            # it now (detach to chain_id 0) so the hosting node's scan
+            # retires its data; idempotent under re-execution
+            self._mgmtd.drop_chain_target(job.chain_id, job.out_target)
         self._report(job, phase=JobPhase.CUTOVER)
         return True
 

@@ -155,6 +155,33 @@ def crc32c_combine(crc_a: int, crc_b: int, len_b: int) -> int:
     return shifted ^ crc_b
 
 
+@functools.lru_cache(maxsize=64)
+def crc32c_zeros(length: int) -> int:
+    """CRC32C of ``length`` zero bytes, cached per length.
+
+    The XOR-composition identity (crc32c_xor) needs it once per distinct
+    shard size per process; the direct computation through the native
+    kernel is a one-time sub-millisecond cost, so no matrix shortcut."""
+    if length == 0:
+        return 0
+    return crc32c(b"\x00" * length)
+
+
+def crc32c_xor(crc_a: int, crc_b: int, length: int) -> int:
+    """CRC of A ^ B for equal-``length`` buffers given their CRCs.
+
+    CRC32C with init/xorout 0xFFFFFFFF is AFFINE over GF(2):
+    crc(X) = L(X) ^ f(length) with L linear in the message bits, so
+    crc(A^B) = crc(A) ^ crc(B) ^ crc(zeros(length)) — the f terms of A
+    and B cancel and one survives via the zero buffer. This is the
+    per-hop partial-CRC composition of the pipelined chain encode: a hop
+    CRCs only its coefficient-scaled contribution and composes, and the
+    final composed value equals the CRC of the fully-accumulated parity
+    row iff every hop's XORed bytes matched its CRC'd bytes — the
+    engine's validated install then proves the whole relay end to end."""
+    return crc_a ^ crc_b ^ crc32c_zeros(length)
+
+
 @functools.lru_cache(maxsize=16)
 def _block_matrix(blk: int) -> np.ndarray:
     """B^T, shape (8*blk, 32): message bits of a blk-byte block -> raw register.

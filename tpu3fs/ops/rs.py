@@ -243,6 +243,27 @@ class RSCode:
                 out[..., i, :] = GF.MUL_TABLE[c][d]
         return out
 
+    def gf_accumulate(self, j: int, data: np.ndarray,
+                      acc: np.ndarray) -> np.ndarray:
+        """The pipelined-chain-encode hop primitive: XOR data shard j's
+        coefficient-scaled contribution into the in-flight parity
+        accumulator IN PLACE and return the contribution rows.
+
+        ``data`` is (..., S) uint8 (the hop's raw shard bytes, zero-padded
+        to the shard size); ``acc`` is (..., m, S) uint8 and is updated to
+        ``acc ^ C[:, j] * data``. Accumulating over j = 0..k-1 yields
+        exactly ``encode`` (RapidRAID-style in-chain encoding: parity
+        builds hop by hop as the data streams down the chain, arxiv
+        1207.6744; the per-hop kernel is the cached coefficient column
+        applied through the XOR-program-optimized LUT/native path of
+        delta_parity_host, arxiv 2108.02692). The returned (..., m, S)
+        contribution is what the hop CRCs for the partial-CRC composition
+        (ops.crc32c.crc32c_xor) — returning it costs nothing: it had to
+        be materialized to XOR anyway."""
+        contrib = self.delta_parity_host(j, data)
+        np.bitwise_xor(acc, contrib, out=acc)
+        return contrib
+
     # -- decode ------------------------------------------------------------
     def _reconstruct_matrix(
         self, present: Tuple[int, ...], lost: Tuple[int, ...]

@@ -168,6 +168,31 @@ class StripeCodec:
         assert d.shape[-1] == self.shard_size, (d.shape, self.shard_size)
         return self.rs.delta_parity_host(j, d)
 
+    def hop_accumulate(self, j: int, payloads, acc: np.ndarray) -> np.ndarray:
+        """One chain-encode hop over a stripe batch: XOR data shard j's
+        coefficient-scaled contribution into the in-flight parity
+        accumulators and return the contribution CRCs.
+
+        ``payloads`` is a length-B sequence of the hop's raw (trimmed)
+        shard-j bytes — one per stripe of the batch; ``acc`` is the
+        (B, m, S) uint8 accumulator frame riding the chain forward,
+        updated IN PLACE. Returns (B, m) uint32 CRC32Cs of the
+        contribution rows for the per-hop partial-CRC composition
+        (crc32c_xor): the tail's validated install then checks the whole
+        relay, not just the last wire crossing. Host kernels only — this
+        runs inside storage hops (the serving-path policy of _use_host)."""
+        B = len(payloads)
+        assert acc.shape == (B, self.m, self.shard_size), (acc.shape, B)
+        d = np.zeros((B, self.shard_size), dtype=np.uint8)  # copy-ok: pad to S
+        for b, p in enumerate(payloads):
+            flat = np.frombuffer(p, dtype=np.uint8)
+            d[b, : flat.size] = flat
+        contrib = self.rs.gf_accumulate(j, d, acc)
+        return crc32c_batch_host(
+            np.ascontiguousarray(contrib).reshape(B * self.m,
+                                                  self.shard_size)
+        ).reshape(B, self.m)
+
     def encode_stripe(self, chunk: bytes) -> Tuple[np.ndarray, np.ndarray]:
         """One chunk (<= k*S bytes, zero-padded) -> ((k+m, S), (k+m,))."""
         buf = np.zeros((self.k, self.shard_size), dtype=np.uint8)

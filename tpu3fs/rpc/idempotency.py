@@ -54,6 +54,7 @@ CLASSIFICATION: Dict[Tuple[str, str], str] = {
     ("StorageSerde", "readRebuild"): IDEMPOTENT,
     ("StorageSerde", "dumpPendingChunkMeta"): IDEMPOTENT,
     ("StorageSerde", "batchReadRebuild"): IDEMPOTENT,
+    ("StorageSerde", "chainEncodeWrite"): MUTATING,
     # -- MetaSerde --------------------------------------------------------
     ("MetaSerde", "statFs"): IDEMPOTENT,
     ("MetaSerde", "stat"): IDEMPOTENT,
@@ -163,6 +164,10 @@ REPLAY_SAFE_MUTATIONS: Dict[Tuple[str, str], str] = {
         "a no-op",
     ("StorageSerde", "removeChunk"): "removing an absent chunk returns "
         "false, changes nothing",
+    ("StorageSerde", "batchWriteShard"): "stripe-version dedupe: an "
+        "install at an already-committed version answers OK (same "
+        "content) or CHUNK_STALE_UPDATE (superseded) — never "
+        "double-applies (craq._triage_shard_install)",
     ("Mgmtd", "addChainTarget"): "already-a-member is a committed "
         "PREPARE: explicit no-op",
     ("Mgmtd", "dropChainTarget"): "already-dropped is a committed "

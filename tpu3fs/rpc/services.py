@@ -307,6 +307,11 @@ def bind_storage_service(server: RpcServer, svc: StorageService) -> None:
     # fan-in (one RPC per surviving peer per stripe batch)
     s.method(21, "batchReadRebuild", BatchReadReq, BatchReadRsp,
              lambda r: BatchReadRsp(svc.batch_read_rebuild(r.reqs)))
+    # pipelined chain encode: one hop of the in-chain EC encoder (raw
+    # data shards + in-flight parity accumulator frames ride the bulk
+    # section; craq.StorageService.chain_encode)
+    s.method(22, "chainEncodeWrite", BatchShardWriteReq, BatchWriteRsp,
+             _batch_write(svc.chain_encode), bulk=True)
     server.add_service(s)
 
 
@@ -414,7 +419,7 @@ class RpcMessenger:
     _RING_CAPABLE = {
         "read": 3, "write": 1, "update": 2, "write_shard": 13,
         "batch_read": 11, "batch_write": 12, "batch_write_shard": 14,
-        "batch_update": 15, "batch_read_rebuild": 21,
+        "batch_update": 15, "batch_read_rebuild": 21, "chain_encode": 22,
     }
 
     def _ring_for(self, node_id: int):
@@ -586,7 +591,8 @@ class RpcMessenger:
                                bulk_iovs=[payload.data],
                                rsp_data_est=256)
             return rsp
-        if method in ("batch_write", "batch_write_shard", "batch_update"):
+        if method in ("batch_write", "batch_write_shard", "batch_update",
+                      "chain_encode"):
             mid, req_cls = self._WRITE_METHODS[method]
             ctrl = req_cls([replace(op, data=b"") for op in payload])
             rsp, _ = ring.call(sid, mid, ctrl, BatchWriteRsp,
@@ -789,6 +795,7 @@ class RpcMessenger:
         "batch_write": (12, BatchWriteReq),
         "batch_write_shard": (14, BatchShardWriteReq),
         "batch_update": (15, BatchWriteReq),
+        "chain_encode": (22, BatchShardWriteReq),
     }
 
     def batch_write_pipelined(self, groups, method: str = "batch_write"):
@@ -997,6 +1004,8 @@ class RpcMessenger:
             return self._batch_write(addr, 14, payload, BatchShardWriteReq)
         if method == "batch_update":
             return self._batch_write(addr, 15, payload, BatchWriteReq)
+        if method == "chain_encode":
+            return self._batch_write(addr, 22, payload, BatchShardWriteReq)
         if method == "stat_chunks":
             rsp = c.call(addr, sid, 16, StatChunksReq(*payload), StatChunksRsp)
             return [tuple(t) for t in rsp.stats]

@@ -447,11 +447,15 @@ class StorageService:
         self._stopped = False
         # per-op latency/success metrics (ref monitor::OperationRecorder
         # usage throughout StorageOperator.cc:87,89,139)
-        from tpu3fs.monitor.recorder import LatencyRecorder
+        from tpu3fs.monitor.recorder import CounterRecorder, LatencyRecorder
 
         tags = {"node": str(node_id)}
         self._write_rec = LatencyRecorder("storage.write", tags)
         self._read_rec = LatencyRecorder("storage.read", tags)
+        # pipelined chain encode (chain_encode): hops this node ran and
+        # parity bytes it accumulated into the in-flight frames
+        self._ce_hops = CounterRecorder("ec.chain_encode_hops", tags)
+        self._ce_bytes = CounterRecorder("ec.chain_encode_bytes", tags)
         # structured write-path trace (ref StorageOperator.h:36 —
         # analytics::StructuredTraceLog<StorageEventTrace>); None = off
         self._trace = None
@@ -1310,8 +1314,11 @@ class StorageService:
                 # shard bytes; the engine computes the content CRC during
                 # staging anyway and refuses on mismatch — one checksum
                 # pass server-side instead of a separate padded pre-check.
-                # phase 1 STAGES only (pending); phase 0 installs committed
-                # in one step (rebuild writes of proven content).
+                # crc < 0 = chain-encode raw data shard (the client never
+                # computed one — CR-write trust model: the engine's own
+                # staging CRC becomes the shard's checksum). phase 1
+                # STAGES only (pending); phase 0 installs committed in
+                # one step (rebuild writes of proven content).
                 meta = engine.update(
                     req.chunk_id,
                     req.update_ver,
@@ -1326,7 +1333,7 @@ class StorageService:
                     # by queryLastChunk and rebuild-trim instead of
                     # zero-stripping (round-2 weak #8)
                     aux=req.logical_len,
-                    expected_crc=crc,
+                    expected_crc=crc if crc >= 0 else None,
                 )
                 return UpdateReply(
                     Code.OK,
@@ -2028,7 +2035,10 @@ class StorageService:
                     stage_replace=r.phase == 1,
                     chunk_size=r.chunk_size,
                     aux=r.logical_len,
-                    expected_crc=crc,
+                    # crc < 0 = chain-encode raw data shard: install
+                    # unvalidated (the engine's staging CRC stands, the
+                    # CR-write trust model)
+                    expected_crc=crc if crc >= 0 else None,
                 ))
                 op_idx.append(i)
             # commits of staged versions: one engine crossing too
@@ -2061,6 +2071,291 @@ class StorageService:
         finally:
             for key in reversed(keys):
                 self._locks.release(key)
+        return replies
+
+    # -- pipelined chain encode (the chain IS the encoder) --------------------
+    # RapidRAID-style in-chain erasure encoding (arxiv 1207.6744): the
+    # client ships RAW data shards down the encode-ordered chain (shard
+    # 0's target first); each data hop installs its shard AND XORs its
+    # coefficient-scaled contribution into m parity accumulator frames
+    # riding the forward (ops.rs.gf_accumulate — the per-hop kernel of
+    # arxiv 2108.02692's XOR program optimization), overlapped with the
+    # local engine stage exactly like the CR overlap forward; the m
+    # parity hops at the tail receive fully-accumulated parity with a
+    # hop-composed CRC (ops.crc32c.crc32c_xor) feeding the validated-
+    # install path. Staging only: the client runs the SAME phase-2
+    # commit round as the client-encode path, so the whole-stripe-
+    # version invariant and the degraded/rebuild machinery are
+    # untouched. ANY structural surprise (old chain version, SYNCING
+    # successor, unroutable hop) aborts with a per-req error and the
+    # client retries via the client-side encode ladder — staged pendings
+    # left behind are displaced by the retry like any partial stage.
+
+    def chain_encode(self, reqs: List[ShardWriteReq]) -> List[UpdateReply]:
+        """One HOP of the pipelined chain encode: install the contiguous
+        local front of the per-stripe shard sequence, accumulate parity
+        contributions for local DATA shards, forward the rest (with the
+        updated accumulator frames) to the successor hop in ONE RPC."""
+        n = len(reqs)
+        if n == 0:
+            return []
+        if self.stopped:
+            return [UpdateReply(Code.RPC_PEER_CLOSED, message="node stopped")
+                    for _ in range(n)]
+        # wire-exposed: mixed-chain batches split per chain
+        if any(r.chain_id != reqs[0].chain_id for r in reqs):
+            replies: List[Optional[UpdateReply]] = [None] * n
+            groups: Dict[int, List[int]] = {}
+            for i, r in enumerate(reqs):
+                groups.setdefault(r.chain_id, []).append(i)
+            for _, idxs in groups.items():
+                for i, out in zip(idxs, self.chain_encode(
+                        [reqs[i] for i in idxs])):
+                    replies[i] = out
+            return replies
+
+        def _abort(code: Code, msg: str) -> List[UpdateReply]:
+            return [UpdateReply(code, message=msg) for _ in range(n)]
+
+        try:
+            inject("storage.chain_encode", node=self.node_id)
+            chain = self._chain(reqs[0].chain_id)
+        except FsError as e:
+            return _abort(e.code, e.status.message)
+        k, m = chain.ec_k, chain.ec_m
+        if not chain.is_ec or m < 1:
+            return _abort(Code.INVALID_ARG,
+                          "chain_encode needs an EC(k, m>=1) chain")
+        if any(r.chain_ver != chain.chain_version for r in reqs):
+            return _abort(Code.CHAIN_VERSION_MISMATCH,
+                          f"hop at chain version {chain.chain_version}")
+        shard_of: List[int] = []
+        for r in reqs:
+            j = chain.shard_index(r.target_id)
+            if j < 0:
+                return _abort(Code.TARGET_NOT_FOUND,
+                              f"target {r.target_id} not in chain")
+            shard_of.append(j)
+        # per-stripe grouping; every stripe must carry one req per
+        # remaining shard j0..k+m-1 with ONE shard size (the client
+        # builds uniform batches — anything else is a protocol error)
+        stripes: Dict[bytes, List[int]] = {}
+        order: List[bytes] = []
+        for i, r in enumerate(reqs):
+            key = r.chunk_id.to_bytes()
+            if key not in stripes:
+                order.append(key)
+            stripes.setdefault(key, []).append(i)
+        j0 = min(shard_of)
+        S = reqs[0].chunk_size
+        for key in order:
+            idxs = sorted(stripes[key], key=lambda i: shard_of[i])
+            stripes[key] = idxs
+            if [shard_of[i] for i in idxs] != list(range(j0, k + m)) \
+                    or any(reqs[i].chunk_size != S for i in idxs):
+                return _abort(Code.INVALID_ARG,
+                              "malformed chain-encode batch")
+        # local FRONT: contiguous shards from j0 hosted here — this hop
+        # installs them; everything after forwards to the successor
+        front = 0
+        while j0 + front < k + m:
+            t = chain.target_of_shard(j0 + front)
+            if t is None or t.target_id not in self._targets:
+                break
+            front += 1
+        if front == 0:
+            return _abort(Code.TARGET_NOT_FOUND, "chain-encode hop misrouted")
+        # head-entry admission (j0 == 0): deadline + tenant/class charges
+        # for the whole batch, exactly like a batched head write; chain-
+        # internal hops pass free (the head already charged the op, and a
+        # mid-chain shed would only waste the client's whole retry)
+        lease = None
+        if j0 == 0:
+            if self._deadline_expired():
+                return _abort(Code.DEADLINE_EXCEEDED,
+                              "deadline passed at chain-encode admission")
+            lease, shed_ms, shed_code = self._admit_write(
+                reqs[0], cost=n,
+                nbytes=sum(len(r.data or b"") for r in reqs))
+            if shed_ms is not None:
+                return [UpdateReply(
+                    shed_code,
+                    message=f"retry_after_ms={shed_ms} "
+                            f"(chain-encode admission)",
+                    retry_after_ms=shed_ms) for _ in range(n)]
+        try:
+            return self._chain_encode_hop(
+                chain, list(reqs), shard_of, stripes, order, j0, front, S)
+        finally:
+            if lease is not None:
+                lease.release()
+
+    def _chain_encode_hop(self, chain: ChainInfo, reqs: List[ShardWriteReq],
+                          shard_of: List[int], stripes: Dict[bytes, List[int]],
+                          order: List[bytes], j0: int, front: int,
+                          S: int) -> List[UpdateReply]:
+        """The validated hop body (see chain_encode): accumulate, forward
+        (overlapped with the local engine stage on socket transports),
+        stage the local front, merge replies."""
+        import numpy as np
+
+        from tpu3fs.chaos.bugs import bug_fire
+        from tpu3fs.ops.crc32c import crc32c_xor, crc32c_zeros
+        from tpu3fs.ops.stripe import get_codec
+
+        k, m = chain.ec_k, chain.ec_m
+        n = len(reqs)
+        B = len(order)
+        replies: List[Optional[UpdateReply]] = [None] * n
+        tctx = _spans.current_trace()
+        t_acc = time.perf_counter()
+        data_front = [j0 + d for d in range(front) if j0 + d < k]
+        accumulated = 0
+        if data_front:
+            # parity accumulator frames: (B, m, S) OWNED arrays built
+            # from the in-flight payloads — only data hops own them
+            # (they mutate); pure parity hops forward/install the
+            # received views untouched, no frame copies. An EMPTY row is
+            # the head's uninitialized frame: zeros, seeded with the
+            # zero-buffer CRC so the XOR composition law needs no
+            # special first-hop case.
+            codec = get_codec(k, m, S)
+            acc = np.zeros((B, m, S), dtype=np.uint8)  # copy-ok: owned accumulator
+            pcrc = [[0] * m for _ in range(B)]
+            zc = crc32c_zeros(S)
+            for b, key in enumerate(order):
+                idxs = stripes[key]
+                for i_p in range(m):
+                    r = reqs[idxs[k - j0 + i_p]]
+                    nb = len(r.data or b"")
+                    if nb == 0:
+                        pcrc[b][i_p] = zc
+                    elif nb == S:
+                        acc[b, i_p] = np.frombuffer(r.data, dtype=np.uint8)
+                        pcrc[b][i_p] = r.crc
+                    else:
+                        return [UpdateReply(
+                            Code.INVALID_ARG,
+                            message="torn accumulator frame")
+                            for _ in range(n)]
+            # accumulate the LOCAL data shards' contributions — batched
+            # per shard across all stripes of the request: one native
+            # pass per shard through the cached coefficient column
+            for j in data_front:
+                if bug_fire("chain_parity_skip"):
+                    # PLANTED BUG (test-only; chaos/bugs.py): this hop
+                    # installs its shard but forwards the accumulator
+                    # UNCHANGED — consistently-wrong parity installs
+                    # cleanly at the tail (composed CRC matches the
+                    # un-accumulated bytes) and only a degraded read or
+                    # rebuild exposes it
+                    continue
+                d = j - j0
+                payloads = [reqs[stripes[key][d]].data for key in order]
+                crcs = codec.hop_accumulate(j, payloads, acc)
+                for b in range(B):
+                    row = pcrc[b]
+                    for i_p in range(m):
+                        row[i_p] = crc32c_xor(row[i_p],
+                                              int(crcs[b, i_p]), S)
+                accumulated += B * m * S
+        dt_acc = time.perf_counter() - t_acc
+        if accumulated:
+            # refresh the in-flight parity reqs: memoryviews over the
+            # owned accumulator rows (the bulk frame gathers them; the
+            # local engine copies on install) + the composed CRCs
+            for b, key in enumerate(order):
+                idxs = stripes[key]
+                for i_p in range(m):
+                    i = idxs[k - j0 + i_p]
+                    reqs[i] = replace(reqs[i], data=acc[b, i_p].data,
+                                      crc=int(pcrc[b][i_p]))
+        # split: local front installs vs the forward set
+        local_i: List[int] = []
+        fwd_i: List[int] = []
+        for key in order:
+            idxs = stripes[key]
+            local_i.extend(idxs[:front])
+            fwd_i.extend(idxs[front:])
+        overlap = None
+        fwd_err: Optional[UpdateReply] = None
+        fwd_replies = None
+        if fwd_i:
+            nxt = chain.target_of_shard(j0 + front)
+            node = (self._routing().node_of_target(nxt.target_id)
+                    if nxt is not None else None)
+            if nxt is None or node is None or self._messenger is None:
+                fwd_err = UpdateReply(
+                    Code.NO_SUCCESSOR,
+                    message="no route to chain-encode successor")
+            elif not nxt.public_state.can_write:
+                # SYNCING/OFFLINE successor: abort — the client-encode
+                # fallback ladder skips non-writable shards; a relay
+                # cannot (its contribution would be lost)
+                fwd_err = UpdateReply(
+                    Code.TARGET_OFFLINE,
+                    message=f"chain-encode successor {nxt.target_id} "
+                            f"not writable")
+            else:
+                freqs = [reqs[i] for i in fwd_i]
+
+                def _fwd(_node=node.node_id, _freqs=freqs):
+                    return self._messenger(_node, "chain_encode", _freqs)
+
+                if (not _inproc_messenger(self._messenger)
+                        and _overlap_enabled()
+                        and sum(len(r.data or b"") for r in freqs)
+                        >= _overlap_min_bytes()):
+                    # stream the remaining shards + updated accumulators
+                    # to the successor WHILE the local engine stages —
+                    # the chain pipelines: hop latency ~ max(stage, relay)
+                    overlap = _OverlapForward(_fwd)
+                else:
+                    try:
+                        fwd_replies = _fwd()
+                    except FsError as e:
+                        fwd_err = UpdateReply(e.code,
+                                              message=e.status.message)
+        # local installs: the shared validated-install path (triage,
+        # sorted locks, one engine crossing per target) — identical
+        # semantics to client-addressed stage writes
+        t_stage = time.perf_counter()
+        by_target: Dict[int, List[int]] = {}
+        for i in local_i:
+            by_target.setdefault(reqs[i].target_id, []).append(i)
+        for tid, idxs in by_target.items():
+            outs = self._batch_write_shard_target(
+                tid, [reqs[i] for i in idxs])
+            for i, out in zip(idxs, outs):
+                replies[i] = out
+        dt_stage = time.perf_counter() - t_stage
+        if overlap is not None:
+            try:
+                fwd_replies, _needs_seq = overlap.join()
+            except FsError as e:
+                fwd_err = UpdateReply(e.code, message=e.status.message)
+        if fwd_i:
+            if isinstance(fwd_replies, list) \
+                    and len(fwd_replies) == len(fwd_i):
+                for i, out in zip(fwd_i, fwd_replies):
+                    replies[i] = out
+            else:
+                err = fwd_err or UpdateReply(
+                    Code.ENGINE_ERROR, message="malformed chain-encode reply")
+                for i in fwd_i:
+                    replies[i] = err
+        self._ce_hops.add(1)
+        if accumulated:
+            self._ce_bytes.add(accumulated)
+        if tctx is not None:
+            now = time.time()
+            _spans.add_span(tctx, "ec.chain_encode", "accumulate",
+                            now - dt_acc - dt_stage, dt_acc,
+                            nbytes=accumulated)
+            _spans.add_span(tctx, "ec.chain_encode", "stage",
+                            now - dt_stage, dt_stage,
+                            nbytes=sum(len(reqs[i].data or b"")
+                                       for i in local_i))
         return replies
 
     # -- reads (apportioned; ref batchRead :82-231) ---------------------------
@@ -2125,7 +2420,15 @@ class StorageService:
                 if self.stopped:
                     raise _err(Code.RPC_PEER_CLOSED, "node stopped")
                 target = self._targets.get(req.target_id)
-                if target is None or target.chain_id != req.chain_id:
+                # chain_id 0 = explicit TARGET-ADDRESSED read of an
+                # out-of-chain-but-alive local target (EC drain direct
+                # copy: the migration worker reads the outgoing member's
+                # shard — detached from routing, not yet retired — so a
+                # drain moves 1/k the bytes of a decode rebuild). Same
+                # safety argument as the in-chain bypass: the caller
+                # proves usability via version agreement + CRC.
+                if target is None or (req.chain_id != 0
+                                      and target.chain_id != req.chain_id):
                     raise _err(Code.TARGET_NOT_FOUND, str(req.target_id))
                 self._check_target_serving(target)
                 data, ver, crc, aux = target.engine.read_verified(

@@ -290,6 +290,8 @@ class EcResyncWorker:
             return 0
         committed, failed = self._roll_forward(
             routing, chain, {key: cids[key] for key in vers}, vers)
+        committed += self._repair_decode(
+            routing, chain, {key: cids[key] for key in vers}, vers)
         # memoize ONLY a truly fruitless sweep (nothing eligible AND no
         # failed attempts): a transiently-failed commit must retry next
         # round — its pending signature is unchanged, so memoizing it
@@ -369,6 +371,140 @@ class EcResyncWorker:
                     failed += 1
                     continue
         return committed, failed
+
+    def _repair_decode(self, routing: RoutingInfo, chain: ChainInfo,
+                       stripes: Dict[bytes, ChunkId],
+                       vers: Dict[bytes, Dict[int, tuple]]) -> int:
+        """The DECODE twin of the pending roll-forward: repair stripes
+        whose straggler shard lost its pending to a displacing (failed)
+        later write.
+
+        A committed k-quorum at version v proves the stripe's content
+        (whole-stripe versioning + writer nonces: equal encoded version
+        means one writer's consistent encode), so a shard still
+        committed BELOW v with no pending at v is reconstructed from the
+        quorum and installed at v (validated one-step install). Without
+        this, the state {k shards committed at v, straggler's pending
+        displaced} is permanently version-forked — _roll_forward's
+        serving-coverage guard rightly refuses it, no client retries it
+        (the write was already abandoned), and sub-stripe reads of the
+        stale shard would be torn. Found by the chaos search once the
+        chain-encode relay made partial stage states common. -> shards
+        repaired."""
+        import numpy as np
+
+        from tpu3fs.ops.stripe import (
+            aligned_shard_size,
+            get_codec,
+            trim_rebuilt_shard,
+        )
+
+        k, m = chain.ec_k, chain.ec_m
+        fixed = 0
+        for key, shard_vers in vers.items():
+            cid = stripes.get(key)
+            if cid is None:
+                continue
+            by_cv: Dict[int, set] = {}
+            for j, (cv, _pv) in shard_vers.items():
+                if cv > 0:
+                    by_cv.setdefault(cv, set()).add(j)
+            if not by_cv:
+                continue
+            v = max(by_cv)
+            holders = by_cv[v]
+            if len(holders) < k:
+                continue
+            stale = [j for j, (cv, pv) in shard_vers.items()
+                     if cv < v and pv != v]
+            if not stale:
+                continue  # pendings present: _roll_forward's business
+            datas: Dict[int, bytes] = {}
+            aux = 0
+            ok = True
+            for j in sorted(holders):
+                rs = self._read_shard(routing, chain, j, cid)
+                if rs is None or rs[0].commit_ver != v:
+                    ok = False  # raced/unreachable: next round retries
+                    break
+                datas[j] = bytes(rs[0].data)
+                aux = max(aux, rs[0].logical_len)
+            if not ok:
+                continue
+            S = aligned_shard_size(max(len(b) for b in datas.values())
+                                   if datas else 0)
+            if S == 0:
+                continue
+            present = sorted(datas)[:k]
+            codec = get_codec(k, m, S)
+            surv = np.stack([
+                np.frombuffer(datas[j].ljust(S, b"\x00"), dtype=np.uint8)
+                for j in present])[None]
+            lens = {jj: len(b) for jj, b in datas.items() if jj < k}
+            for j in stale:
+                raw = codec.reconstruct_batch(present, (j,), surv)[0, 0] \
+                    .tobytes()
+                if aux and j < k:
+                    extent = min(max(aux - j * S, 0), S)
+                    payload = raw[:extent]
+                elif j >= k:
+                    payload = raw
+                else:
+                    payload = trim_rebuilt_shard(raw, j, lens, k, S)
+                t = chain.target_of_shard(j)
+                pn = (routing.node_of_target(t.target_id)
+                      if t is not None else None)
+                if pn is None:
+                    continue
+                try:
+                    r = self._messenger(pn.node_id, "write_shard",
+                                        ShardWriteReq(
+                                            chain_id=chain.chain_id,
+                                            chain_ver=chain.chain_version,
+                                            target_id=t.target_id,
+                                            chunk_id=cid,
+                                            data=payload,
+                                            crc=codec.crc_host(payload),
+                                            update_ver=v,
+                                            chunk_size=S,
+                                            logical_len=aux,
+                                            phase=0,
+                                        ))
+                    if r.ok:
+                        fixed += 1
+                except FsError:
+                    continue
+        return fixed
+
+    def _swap_leftover(self, routing: RoutingInfo, chain: ChainInfo,
+                       target_id: int):
+        """The EC swap's OUTGOING member, when it can serve a DIRECT copy
+        of the recovering target's shard: mgmtd keeps a swapped-out
+        member's TargetInfo alive (chain_id intact, off the member list)
+        until the migration worker releases it at cutover — exactly the
+        drain direct-copy window. -> (leftover target id, node id) or
+        None.
+
+        Slot-safety guard: the leftover's shard position is not recorded
+        anywhere, so it is only usable when the chain has EXACTLY ONE
+        non-SERVING member — the swap refuses on a degraded chain, so
+        the single recovering slot must be the one the leftover held.
+        Any ambiguity (second degraded member, several leftovers,
+        unroutable node) falls back to the decode rebuild."""
+        non_serving = [t.target_id for t in chain.targets
+                       if t.public_state != PublicTargetState.SERVING]
+        if non_serving != [target_id]:
+            return None
+        members = {t.target_id for t in chain.targets}
+        cands = [info for info in routing.targets.values()
+                 if info.chain_id == chain.chain_id
+                 and info.target_id not in members]
+        if len(cands) != 1:
+            return None
+        node = routing.nodes.get(cands[0].node_id)
+        if node is None:
+            return None
+        return cands[0].target_id, node.node_id
 
     def _read_shard(self, routing: RoutingInfo, chain: ChainInfo, j: int,
                     chunk_id: ChunkId):
@@ -459,20 +595,38 @@ class EcResyncWorker:
         return (cid, ver, shards, aligned_shard_size(S), logical), False
 
     def _gather_batched(self, routing: RoutingInfo, chain: ChainInfo,
-                        chunk_ids: List[ChunkId], lost_shard: int):
-        """-> (rows, skip_cids, fallback_cids): the PARALLEL gather.
-        Versions probe as ONE stat_chunks per peer (no payload), the k
-        survivors of each stripe are chosen by ROTATING over that
+                        chunk_ids: List[ChunkId], lost_shard: int,
+                        leftover=None):
+        """-> (rows, skip_cids, fallback_cids, direct_rows): the PARALLEL
+        gather. Versions probe as ONE stat_chunks per peer (no payload),
+        the k survivors of each stripe are chosen by ROTATING over that
         version's holders — source-disjoint scheduling, so recovery
         reads spread over ALL surviving peers instead of hammering the
         lowest-indexed shards — and the reads issue as ONE
         batch_read_rebuild per peer node. Safety guards mirror
         _gather_serial (safe-version ceiling, own-shard vote, k-quorum);
         stripes the stats cannot prove or whose reads raced a writer
-        fall back to the serial gather."""
+        fall back to the serial gather.
+
+        ``leftover`` = (target id, node id) of a swap's outgoing member
+        (_swap_leftover): a stripe whose leftover copy sits at the
+        PROVEN version reads that ONE shard direct (1/k the recovery
+        bytes of a decode) — direct_rows carries
+        (cid, ver, payload, crc, S, logical); any mismatch (a write
+        landed after the swap froze the leftover) decodes as usual."""
         from tpu3fs.ops.stripe import aligned_shard_size
 
         k, m = chain.ec_k, chain.ec_m
+        lo_stats = None
+        if leftover is not None:
+            try:
+                lo_stats = self._messenger(
+                    leftover[1], "stat_chunks", (leftover[0],
+                                                 list(chunk_ids)))
+                if len(lo_stats) != len(chunk_ids):
+                    lo_stats = None
+            except FsError:
+                lo_stats = None
         stats: Dict[int, list] = {}
         safe: Dict[int, bool] = {}
         route: Dict[int, tuple] = {}
@@ -494,7 +648,8 @@ class EcResyncWorker:
             safe[j] = t.public_state.can_read
             route[j] = (t.target_id, pn.node_id)
         if sum(1 for j in stats if j != lost_shard) < k:
-            return [], [], list(chunk_ids)  # stats too thin: serial decides
+            # stats too thin: serial decides
+            return [], [], list(chunk_ids), []
         plans: List[dict] = []
         skip_cids: List[ChunkId] = []
         fallback: List[ChunkId] = []
@@ -537,6 +692,22 @@ class EcResyncWorker:
             S_work = max(lens.get((ver, j), 0) for j in by_ver[ver])
             if S_work == 0:
                 continue  # all-empty stripe: nothing to rebuild
+            if lo_stats is not None and lo_stats[idx][0] == ver:
+                # DIRECT COPY: the swap's outgoing member still holds
+                # this stripe's shard at the PROVEN version (the swap
+                # froze it; no write has landed since) — ONE
+                # target-addressed read instead of k survivor reads + a
+                # decode. Slot safety: _swap_leftover's one-non-serving
+                # guard; byte safety: version match + validated install.
+                pi = len(plans)
+                plans.append({"cid": cid, "ver": ver,
+                              "S": aligned_shard_size(S_work),
+                              "logical": aux_by_ver.get(ver, 0),
+                              "shards": {}, "want": 1, "bad": False,
+                              "direct": True, "payload": None, "crc": 0})
+                reads.setdefault(leftover[1], []).append((pi, -1, ReadReq(
+                    chain.chain_id, cid, 0, -1, leftover[0])))
+                continue
             rot = idx % len(holders)
             chosen = [holders[(rot + t) % len(holders)] for t in range(k)]
             pi = len(plans)
@@ -559,18 +730,32 @@ class EcResyncWorker:
                 if r is None or not r.ok or r.commit_ver != plan["ver"]:
                     plan["bad"] = True  # raced/failed: serial decides
                     continue
-                plan["shards"][j] = bytes(r.data)  # copy-ok: decode input
-                src = route[j][0]
+                if plan.get("direct"):
+                    plan["payload"] = bytes(r.data)  # copy-ok: install input
+                    plan["crc"] = r.checksum.value
+                    src = leftover[0]
+                else:
+                    plan["shards"][j] = bytes(r.data)  # copy-ok: decode input
+                    src = route[j][0]
                 sources = self._round_stats["read_sources"]
                 sources[src] = sources.get(src, 0) + 1
         rows = []
+        direct_rows = []
         for plan in plans:
+            if plan.get("direct"):
+                if plan["bad"] or plan["payload"] is None:
+                    fallback.append(plan["cid"])  # dead/raced: decode
+                else:
+                    direct_rows.append(
+                        (plan["cid"], plan["ver"], plan["payload"],
+                         plan["crc"], plan["S"], plan["logical"]))
+                continue
             if plan["bad"] or len(plan["shards"]) < plan["want"]:
                 fallback.append(plan["cid"])
                 continue
             rows.append((plan["cid"], plan["ver"], plan["shards"],
                          plan["S"], plan["logical"]))
-        return rows, skip_cids, fallback
+        return rows, skip_cids, fallback, direct_rows
 
     def _install_batch(self, node_id: int,
                        reqs: List[ShardWriteReq]) -> List[object]:
@@ -628,8 +813,9 @@ class EcResyncWorker:
             return 1 if (required is None
                          or cid.to_bytes() in required) else 0
 
-        gathered, skip_cids, fb_cids = self._gather_batched(
-            routing, chain, chunk_ids, lost_shard)
+        leftover = self._swap_leftover(routing, chain, target_id)
+        gathered, skip_cids, fb_cids, direct_rows = self._gather_batched(
+            routing, chain, chunk_ids, lost_shard, leftover)
         skipped = sum(_skip(cid) for cid in skip_cids)
         for cid in fb_cids:
             row, skip = self._gather_serial(routing, chain, cid, lost_shard)
@@ -637,7 +823,7 @@ class EcResyncWorker:
                 gathered.append(row)
             elif skip:
                 skipped += _skip(cid)
-        if not gathered:
+        if not gathered and not direct_rows:
             return 0, skipped
         # group stripes by (survivor index set, working size) so each group
         # is ONE batched device decode
@@ -647,6 +833,21 @@ class EcResyncWorker:
             groups.setdefault((present, S), []).append(i)
         installs: List[ShardWriteReq] = []
         install_cids: List[ChunkId] = []
+        # direct-copied shards (the drain fast path): stored-trimmed
+        # bytes straight off the outgoing member — no decode, no re-trim
+        for cid, ver, payload, crc, S, logical in direct_rows:
+            installs.append(ShardWriteReq(
+                chain_id=chain.chain_id,
+                chain_ver=chain.chain_version,
+                target_id=target_id,
+                chunk_id=cid,
+                data=payload,
+                crc=crc,
+                update_ver=ver,
+                chunk_size=S,
+                logical_len=logical,
+            ))
+            install_cids.append(cid)
         for (present, S), idxs in groups.items():
             codec = get_codec(k, m, S)
             surv = np.stack([

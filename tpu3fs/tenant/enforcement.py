@@ -61,6 +61,9 @@ ENFORCEMENT: Dict[Tuple[str, str], str] = {
     ("StorageSerde", "readRebuild"): BYTES,
     ("StorageSerde", "dumpPendingChunkMeta"): EXEMPT,
     ("StorageSerde", "batchReadRebuild"): BYTES,
+    # chain-encode: the head hop charges the whole batch; chain-internal
+    # hops pass free like update/batchUpdate (charged at entry)
+    ("StorageSerde", "chainEncodeWrite"): BYTES,
     # -- MetaSerde (enforced at RPC dispatch: iops buckets) ---------------
     ("MetaSerde", "statFs"): IOPS,
     ("MetaSerde", "stat"): IOPS,

@@ -63,6 +63,7 @@ RING_METHODS: Dict[Tuple[int, int], Tuple[str, str]] = {
     (3, 14): ("StorageSerde", "batchWriteShard"),
     (3, 15): ("StorageSerde", "batchUpdate"),
     (3, 21): ("StorageSerde", "batchReadRebuild"),
+    (3, 22): ("StorageSerde", "chainEncodeWrite"),
 }
 
 _U32 = struct.Struct("<I")
