@@ -321,6 +321,37 @@ class TestRunnerAndSearch:
         assert not run_schedule(shrunk).violated, \
             "shrunk schedule must be green on the fixed tree"
 
+    def test_serving_sidecar_green_and_stale_bug_caught(self):
+        """spec.kv_serving rides the fleet-serving sidecar (two
+        FleetKVCaches peer-filling over loopback, an out-of-band GC
+        racing them): the clean tree stays green — every GC'd block
+        surfaces as a MISS — and the planted peer_fill_stale bug
+        (zeros-as-KV through a stale cached inode) is found by the
+        seeded search within a bounded budget and shrinks (the loop
+        that produced tests/chaos_seeds/peer_fill_stale_serve_through
+        .json)."""
+        spec = ScheduleSpec(steps=12, events=4, storage_nodes=3,
+                            num_chains=2, num_replicas=2,
+                            kv_serving=True, allow_kill=False,
+                            allow_elastic=False,
+                            allow_config_push=False)
+        r = run_schedule(generate_schedule(0, spec))
+        byname = {o.checker: o.status for o in r.outcomes}
+        assert byname["kvcache_stale"] == "passed", r.summary()
+        bugs.arm("peer_fill_stale")
+        try:
+            report, tried = search_violations(spec, base_seed=0,
+                                              max_seeds=8)
+            assert report is not None, "bug not found within 8 seeds"
+            assert "kvcache_stale" in report.violated_checkers
+            shrunk, _ = shrink_schedule(report.schedule)
+            assert len(shrunk.events) <= len(report.schedule.events)
+            assert run_schedule(shrunk).violated
+        finally:
+            bugs.disarm()
+        assert not run_schedule(shrunk).violated, \
+            "shrunk serving schedule must be green on the fixed tree"
+
     def test_save_and_replay_round_trip(self, tmp_path):
         bugs.arm("commit_skip")
         report, _ = search_violations(SMALL, base_seed=0, max_seeds=16)

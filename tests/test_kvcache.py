@@ -482,12 +482,19 @@ class TestPrefixBlocks:
         fab, base = cache
         puts = []
         real_put = base.put
+        real_batch_put = base.batch_put
 
         def spy(key, value):
             puts.append(key)
             return real_put(key, value)
 
+        def batch_spy(items):
+            items = list(items)
+            puts.extend(key for key, _ in items)
+            return real_batch_put(items)
+
         base.put = spy
+        base.batch_put = batch_spy  # the drain path (append_blocks >1)
         store = PrefixBlockStore(base, block_tokens=self.BT)
         toks_a = list(range(4 * self.BT))
         assert store.append_blocks(toks_a, self._pages(4)) == 4
@@ -782,6 +789,43 @@ class TestBatchPutCreateFanIn:
         assert calls["create"] == 0
         for i in range(12):
             assert c.get(f"bk{i}") == bytes([i]) * 500
+
+    def test_append_blocks_drain_is_one_meta_batch(self, cache):
+        """PR 16 carried follow-up: a PrefixBlockStore.append_blocks
+        drain routes through KVCacheClient.batch_put — exactly ONE
+        batch_create for the whole drain and zero per-block serial
+        meta.create round trips (the last serial-create path)."""
+        fab, c = cache
+        calls = {"create": 0, "batch_create": 0}
+        real_create = fab.meta.create
+        real_batch_create = fab.meta.batch_create
+
+        def spy_create(*a, **kw):
+            calls["create"] += 1
+            return real_create(*a, **kw)
+
+        def spy_batch_create(items, *a, **kw):
+            calls["batch_create"] += 1
+            return real_batch_create(items, *a, **kw)
+
+        store = PrefixBlockStore(c, block_tokens=4)
+        tokens = list(range(16))  # 4 full blocks
+        blocks = [np.full((2, 2, 4, 8), i, dtype=np.float16)
+                  for i in range(4)]
+        fab.meta.create = spy_create
+        fab.meta.batch_create = spy_batch_create
+        try:
+            wrote = store.append_blocks(tokens, blocks)
+        finally:
+            fab.meta.create = real_create
+            fab.meta.batch_create = real_batch_create
+        assert wrote == 4
+        assert calls["batch_create"] == 1
+        assert calls["create"] == 0
+        out = store.get_blocks(tokens)
+        assert len(out) == 4
+        for i, arr in enumerate(out):
+            np.testing.assert_array_equal(arr, blocks[i])
 
     def test_batch_put_failed_create_raises_and_closes(self, cache):
         fab, c = cache

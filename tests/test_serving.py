@@ -1,0 +1,502 @@
+"""Fleet KVCache serving (tpu3fs/serving): peer directory + rendezvous
+selection, single-flight at both scopes, the hedged peer-fill ladder
+(straggler demotion, breaker gating), shared-block refcounted eviction,
+tenant-aware peer admission, and the mgmtd-published serving directory.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu3fs.fabric import Fabric, SystemSetupConfig
+from tpu3fs.kv import MemKVEngine
+from tpu3fs.kvcache import KVCacheClient
+from tpu3fs.mgmtd import Mgmtd
+from tpu3fs.mgmtd.types import ServingEndpoint
+from tpu3fs.serving import (
+    FillClaims,
+    FleetKVCache,
+    PeerDirectory,
+    ServingHost,
+    SingleFlight,
+)
+from tpu3fs.serving.service import (
+    FillClaimReq,
+    FillReleaseReq,
+    PeerReadReq,
+    ServingLoadReq,
+)
+from tpu3fs.utils.result import Code, FsError, Status
+
+
+# -- harness ------------------------------------------------------------------
+
+class _LoopbackPeers:
+    """ServingPeerClient surface dispatching straight into in-process
+    ServingHosts — the fleet ladder without sockets (the real transport
+    is exercised by the drive script / bench over real processes)."""
+
+    def __init__(self):
+        self.hosts = {}
+        self.peer_read_calls = 0
+        self._mu = threading.Lock()
+
+    def peer_read(self, ep, keys, *, serve_through=True, est_bytes=0,
+                  deadline_s=None):
+        with self._mu:
+            self.peer_read_calls += 1
+        host = self.hosts[ep.node_id]
+        if deadline_s is not None and host.straggle_ms / 1e3 > deadline_s:
+            # what the real transports do (socket timeout / ring-wait
+            # abandonment): give up AT the deadline, not at the straggle
+            time.sleep(deadline_s)
+            raise FsError(Status(Code.RPC_TIMEOUT, "peer deadline expired"))
+        return host.peer_read(
+            PeerReadReq(keys=list(keys), serve_through=serve_through))
+
+    def fill_claim(self, ep, key, owner, ttl_ms=2000):
+        return self.hosts[ep.node_id].fill_claim(
+            FillClaimReq(key=key, owner=owner, ttl_ms=ttl_ms))
+
+    def fill_release(self, ep, key, owner):
+        return self.hosts[ep.node_id].fill_release(
+            FillReleaseReq(key=key, owner=owner))
+
+    def close(self):
+        self.hosts.clear()
+
+
+def _routing(endpoints):
+    class _R:
+        serving = endpoints
+    return _R
+
+
+@pytest.fixture
+def fab():
+    return Fabric(SystemSetupConfig(num_storage_nodes=2, num_chains=4,
+                                    num_replicas=2, chunk_size=4096))
+
+
+def _fleet_pair(fab, *, straggle_ms=0.0, health=None, **kw):
+    """Two FleetKVCaches over one fabric, peer-reachable via loopback;
+    node 1 optionally straggles its peerRead (the bench's knob too)."""
+    endpoints = {1: ServingEndpoint(node_id=1),
+                 2: ServingEndpoint(node_id=2)}
+    peers = _LoopbackPeers()
+    fleets = {}
+    for nid in (1, 2):
+        kv = KVCacheClient(fab.meta, fab.file_client(),
+                           client_id=f"srv{nid}", inode_cache=64)
+        fl = FleetKVCache(kv, node_id=nid, routing=_routing(endpoints),
+                          peer_client=peers, health=health,
+                          write_through=True, **kw)
+        peers.hosts[nid] = ServingHost(
+            fl, nid, claims=fl.claims,
+            straggle_ms=(straggle_ms if nid == 1 else 0.0))
+        fleets[nid] = fl
+    return fleets, peers
+
+
+# -- single-flight (in-process scope) ----------------------------------------
+
+class TestSingleFlight:
+    def test_concurrent_callers_collapse_to_one_leader(self):
+        sf = SingleFlight()
+        calls = {"n": 0}
+        release = threading.Event()
+
+        def fn():
+            calls["n"] += 1
+            release.wait(5)
+            return "filled"
+
+        results = []
+        res_mu = threading.Lock()
+
+        def run():
+            r = sf.do("k", fn, 10.0)
+            with res_mu:
+                results.append(r)
+
+        threads = [threading.Thread(target=run) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for _ in range(200):
+            if calls["n"]:
+                break
+            time.sleep(0.005)
+        time.sleep(0.05)  # let the remaining callers reach the wait
+        release.set()
+        for t in threads:
+            t.join()
+        assert calls["n"] == 1
+        assert [r[0] for r in results] == ["filled"] * 6
+        assert [r[1] for r in results].count(True) == 1  # one leader
+
+    def test_leader_exception_fails_every_waiter_once(self):
+        sf = SingleFlight()
+        calls = {"n": 0}
+        release = threading.Event()
+
+        def fn():
+            calls["n"] += 1
+            release.wait(5)
+            raise FsError.__new__(FsError) from None
+
+        outcomes = []
+        mu = threading.Lock()
+
+        def run():
+            try:
+                sf.do("k", fn, 10.0)
+                got = "ok"
+            except FsError:
+                got = "err"
+            with mu:
+                outcomes.append(got)
+
+        threads = [threading.Thread(target=run) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for _ in range(200):
+            if calls["n"]:
+                break
+            time.sleep(0.005)
+        time.sleep(0.05)
+        release.set()
+        for t in threads:
+            t.join()
+        assert calls["n"] == 1  # the failure was NOT retried K times
+        assert outcomes == ["err"] * 3
+
+    def test_waiter_timeout_self_serves(self):
+        sf = SingleFlight()
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow():
+            started.set()
+            release.wait(5)
+            return "slow"
+
+        t = threading.Thread(target=lambda: sf.do("k", slow, 10.0))
+        t.start()
+        assert started.wait(2)
+        # liveness beats dedup: a waiter past its patience fills itself
+        r, leader = sf.do("k", lambda: "fast", timeout_s=0.05)
+        assert (r, leader) == ("fast", False)
+        release.set()
+        t.join()
+
+
+class TestFillClaims:
+    def test_grant_deny_renew_expire_release(self):
+        t = [0.0]
+        fc = FillClaims(ttl_ms=1000, clock=lambda: t[0])
+        assert fc.claim("k", 1) == (True, 1)
+        assert fc.claim("k", 2) == (False, 1)   # held by 1
+        assert fc.claim("k", 1) == (True, 1)    # own re-claim renews
+        assert fc.held() == 1
+        t[0] = 1.5                               # past the TTL
+        assert fc.held() == 0
+        assert fc.claim("k", 2) == (True, 2)    # expired claim is free
+        assert not fc.release("k", 1)           # not the holder
+        assert fc.release("k", 2)
+        fc.claim("dead", 3)
+        t[0] = 9.0
+        assert fc.prune() == 1
+
+
+# -- peer directory -----------------------------------------------------------
+
+class _Health:
+    """Stub health registry: a fixed deny-set, everything else healthy."""
+
+    def __init__(self, deny=()):
+        self.deny = set(deny)
+
+    def allow(self, peer):
+        return peer not in self.deny
+
+    def suspect(self, peer):
+        return False
+
+    def observe(self, peer, latency_s, ok=True):
+        pass
+
+    def ewma_s(self, peer):
+        return 0.0
+
+
+class TestPeerDirectory:
+    def _eps(self, n):
+        return {i: ServingEndpoint(node_id=i) for i in range(1, n + 1)}
+
+    def test_endpoints_exclude_self(self):
+        d = PeerDirectory(_routing(self._eps(3)), 2)
+        assert sorted(ep.node_id for ep in d.endpoints()) == [1, 3]
+
+    def test_every_process_ranks_the_same_claim_home(self):
+        eps = self._eps(4)
+        d1 = PeerDirectory(_routing(eps), 1)
+        d2 = PeerDirectory(_routing(eps), 2)
+        for i in range(50):
+            key = f"blk/{i}"
+            assert d1.claim_home(key) == d2.claim_home(key)
+
+    def test_rendezvous_spreads_ownership(self):
+        d = PeerDirectory(_routing(self._eps(4)), 99)
+        owners = {d.pick(f"blk/{i}")[0].node_id for i in range(200)}
+        assert owners == {1, 2, 3, 4}
+
+    def test_breaker_open_peer_is_skipped_as_a_demotion(self):
+        eps = self._eps(2)
+        d = PeerDirectory(_routing(eps), 99, health=_Health(deny={1, 2}))
+        assert d.pick("k") == (None, True)       # all peers gated -> storage
+        d2 = PeerDirectory(_routing(eps), 99, health=_Health())
+        ep, demoted = d2.pick("k")
+        assert ep is not None and not demoted
+        # gate exactly the best-ranked owner: next-ranked + demoted flag
+        d3 = PeerDirectory(_routing(eps), 99,
+                           health=_Health(deny={ep.node_id}))
+        ep3, demoted3 = d3.pick("k")
+        assert demoted3 and ep3.node_id != ep.node_id
+
+    def test_empty_directory_goes_to_storage(self):
+        d = PeerDirectory(_routing({}), 1)
+        assert d.pick("k") == (None, False)
+        assert d.claim_home("k") == 1            # self is the only filler
+
+
+# -- mgmtd-published directory ------------------------------------------------
+
+class TestServingDirectoryMgmtd:
+    def _m(self):
+        eng = MemKVEngine()
+        m = Mgmtd(1, eng)
+        m.extend_lease()
+        return eng, m
+
+    def test_register_publishes_and_renewal_is_version_silent(self):
+        _, m = self._m()
+        v0 = m.get_routing_info().version
+        m.serving_register(7, "h1", 9001, ttl_s=30.0, now=1000.0)
+        ri = m.get_routing_info()
+        assert ri.serving[7].host == "h1" and ri.serving[7].port == 9001
+        assert ri.version > v0
+        v1 = ri.version
+        m.serving_register(7, "h1", 9001, ttl_s=30.0, now=1001.0)
+        assert m.get_routing_info().version == v1   # pure renewal: silent
+        m.serving_register(7, "h1", 9002, ttl_s=30.0, now=1002.0)
+        assert m.get_routing_info().version > v1    # endpoint moved: bump
+
+    def test_ttl_expiry_prunes_and_unregister_removes(self):
+        _, m = self._m()
+        m.serving_register(7, "h1", 9001, ttl_s=1.0, now=1000.0)
+        # the next register's prune pass sees 7's lease lapsed
+        m.serving_register(8, "h2", 9002, ttl_s=30.0, now=1002.5)
+        ri = m.get_routing_info()
+        assert 7 not in ri.serving and 8 in ri.serving
+        v = ri.version
+        m.serving_unregister(8)
+        ri = m.get_routing_info()
+        assert 8 not in ri.serving and ri.version > v
+        m.serving_unregister(8)                     # idempotent, no bump
+        assert m.get_routing_info().version == ri.version
+
+    def test_directory_survives_mgmtd_restart(self):
+        eng, m = self._m()
+        m.serving_register(7, "h1", 9001, ttl_s=3600.0,
+                           now=time.time())
+        m2 = Mgmtd(2, eng)                          # reload from KV
+        ri = m2.get_routing_info()
+        assert ri.serving[7].host == "h1" and ri.serving[7].port == 9001
+
+
+# -- the fleet fill ladder ----------------------------------------------------
+
+class TestFleetFill:
+    def test_peer_fill_hits_peer_host_tier(self, fab):
+        fleets, peers = _fleet_pair(fab)
+        blob = b"kv" * 2048
+        fleets[1].put("blk/a", blob)
+        assert fleets[2].get("blk/a") == blob
+        c = fleets[2].counters()
+        assert c["peer_hits"] == 1 and c["storage_fills"] == 0
+        assert c["peer_bytes"] == len(blob)
+        # the peer observed exactly one peerRead
+        assert peers.hosts[1].peer_reads == 1
+
+    def test_straggling_peer_demotes_to_storage_within_hedge_budget(
+            self, fab):
+        fleets, _ = _fleet_pair(fab, straggle_ms=300.0)
+        blob = b"s" * 4096
+        fleets[1].put("blk/slow", blob)
+        t0 = time.monotonic()
+        got = fleets[2].get("blk/slow")
+        dt = time.monotonic() - t0
+        assert got == blob
+        # the 300ms straggler never gates the read: the storage backup
+        # armed at the hedge delay (5ms floor) and won long before it
+        assert dt < 0.25, f"straggler gated the read for {dt * 1e3:.0f}ms"
+        c = fleets[2].counters()
+        assert c["demotions"] >= 1
+        assert c["storage_fills"] == 1 and c["peer_hits"] == 0
+
+    def test_breaker_open_peer_never_selected(self, fab):
+        fleets, peers = _fleet_pair(fab, health=_Health(deny={1}))
+        blob = b"b" * 2048
+        fleets[1].put("blk/gated", blob)
+        got = fleets[2].get("blk/gated")
+        assert got == blob
+        # instant demotion: zero peerRead attempts at the gated peer,
+        # counted as a demotion, filled from storage
+        assert peers.peer_read_calls == 0
+        c = fleets[2].counters()
+        assert c["demotions"] == 1 and c["storage_fills"] == 1
+        assert c["peer_hits"] == 0 and c["peer_misses"] == 0
+
+    def test_singleflight_collapses_k_misses_to_one_storage_fill(self, fab):
+        # no peers registered: every miss takes the claimed storage path
+        kv = KVCacheClient(fab.meta, fab.file_client(), client_id="solo")
+        fleet = FleetKVCache(kv, node_id=1, routing=_routing({}),
+                             peer_client=_LoopbackPeers(),
+                             write_through=True)
+        seed = KVCacheClient(fab.meta, fab.file_client(), client_id="seed")
+        blob = b"v" * 4096
+        seed.put("blk/viral", blob)
+
+        fills = {"n": 0}
+        mu = threading.Lock()
+        real_get = kv.get
+
+        def counted_get(key):
+            with mu:
+                fills["n"] += 1
+            time.sleep(0.2)  # hold the fill open so all waiters pile up
+            return real_get(key)
+
+        kv.get = counted_get
+        K = 8
+        barrier = threading.Barrier(K)
+        results = []
+
+        def run():
+            barrier.wait()
+            v = fleet.get("blk/viral")
+            with mu:
+                results.append(v)
+
+        threads = [threading.Thread(target=run) for _ in range(K)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == [blob] * K
+        assert fills["n"] == 1                      # ONE storage RPC
+        c = fleet.counters()
+        assert c["storage_fills"] == 1
+        assert c["coalesced"] == K - 1
+        assert fleet.claims.held() == 0             # claim released
+
+    def test_refcounted_eviction_prefers_unshared_blocks(self, fab):
+        kv = KVCacheClient(fab.meta, fab.file_client(), client_id="rc")
+        fleet = FleetKVCache(kv, node_id=1, routing=_routing({}),
+                             peer_client=_LoopbackPeers(),
+                             write_through=True, capacity_bytes=900)
+        v = b"x" * 200
+        for key in ("sh0", "sh1", "un0", "un1"):
+            fleet.put(key, v)
+        # two live decode chains reference the shared prefix blocks
+        fleet.note_chain(["sh0", "sh1"])
+        fleet.note_chain(["sh0", "sh1"])
+        fleet.put("new", v)                          # forces one eviction
+        tier = fleet.tier
+        # the LRU-oldest entries are the SHARED ones — eviction skipped
+        # them and took the unshared un0 instead
+        assert tier.contains("sh0") and tier.contains("sh1")
+        assert not tier.contains("un0")
+        assert tier.contains("un1") and tier.contains("new")
+        # chains released: sharing protection lapses, plain LRU resumes
+        fleet.release_chain(["sh0", "sh1"])
+        fleet.release_chain(["sh0", "sh1"])
+        fleet.put("new2", v)
+        assert not tier.contains("sh0")
+
+    def test_stale_peer_block_is_miss_never_zeros(self, fab):
+        """A GC'd entry under a cached inode must surface as a MISS
+        (KVCACHE_STALE re-probe), never ship as zeros-as-KV — the
+        invariant the peer_fill_stale chaos seed replays end to end."""
+        fleets, peers = _fleet_pair(fab)
+        blob = b"live-kv" * 512
+        fleets[1].put("blk/gone", blob)
+        fleets[1].tier.clear()                       # host-tier miss
+        gc = KVCacheClient(fab.meta, fab.file_client(), client_id="gc")
+        gc.remove("blk/gone")
+        fab.run_gc()                                 # reclaim the chunks
+        rsp = peers.hosts[1].peer_read(PeerReadReq(keys=["blk/gone"]))
+        assert rsp.found == [False] and rsp.blobs == [b""]
+        assert rsp.stale == 1
+        assert peers.hosts[1].stale_detected == 1
+        assert fleets[2].get("blk/gone") is None     # miss, not zeros
+
+    def test_peer_filled_bytes_charged_to_requester_tenant(self, fab):
+        """No quota laundering: a block arriving from a peer's RAM is
+        charged to the REQUESTING tenant; refusal surfaces as
+        TENANT_THROTTLED and the bytes never enter the tier."""
+        from tpu3fs.tenant.quota import registry
+
+        endpoints = {1: ServingEndpoint(node_id=1),
+                     2: ServingEndpoint(node_id=2)}
+        peers = _LoopbackPeers()
+        kv1 = KVCacheClient(fab.meta, fab.file_client(), client_id="tq1")
+        f1 = FleetKVCache(kv1, node_id=1, routing=_routing(endpoints),
+                          peer_client=peers, write_through=True)
+        peers.hosts[1] = ServingHost(f1, 1, claims=f1.claims)
+        kv2 = KVCacheClient(fab.meta, fab.file_client(), client_id="tq2",
+                            tenant="tq")
+        f2 = FleetKVCache(kv2, node_id=2, routing=_routing(endpoints),
+                          peer_client=peers, write_through=True)
+        peers.hosts[2] = ServingHost(f2, 2, claims=f2.claims)
+        f1.put("blk/q", b"q" * 8192)
+        registry().configure("tenant=tq,weight=1,bytes_per_s=1")
+        try:
+            with pytest.raises(FsError) as ei:
+                f2.get("blk/q")
+            assert ei.value.code == Code.TENANT_THROTTLED
+            assert "retry_after_ms" in str(ei.value)
+            assert f2.counters()["throttled"] == 1
+            assert not f2.tier.contains("blk/q")     # bytes NOT admitted
+        finally:
+            registry().clear()
+
+
+# -- serving host: stats + in-process load legs -------------------------------
+
+class TestServingHostSurface:
+    def test_load_leg_and_stats_report_fleet_counters(self, fab):
+        fleets, peers = _fleet_pair(fab)
+        host = peers.hosts[1]
+        keys = [f"load/{i}" for i in range(8)]
+        put = host.load(ServingLoadReq(op="put", keys=keys, value_bytes=256,
+                                       concurrency=4))
+        assert put.ops == 8 and put.errors == 0 and put.nbytes == 8 * 256
+        got = host.load(ServingLoadReq(op="get", keys=keys, concurrency=4,
+                                       drop_host=True))
+        assert got.ops == 8 and got.hits == 8 and got.errors == 0
+        # every get was a host-tier miss resolved through the fleet
+        # ladder (peer 2 is empty): misses + storage fills, no hits
+        assert got.peer_misses + got.demotions >= 1
+        assert got.storage_fills >= 1
+        assert len(got.lat_us) == 8
+        st = host.stats()
+        assert st.node_id == 1
+        assert st.host_entries >= 8
+        assert st.storage_fills >= 1
+
+    def test_load_rejects_unknown_op(self, fab):
+        fleets, peers = _fleet_pair(fab)
+        with pytest.raises(FsError):
+            peers.hosts[1].load(ServingLoadReq(op="scan", keys=["k"]))

@@ -139,6 +139,16 @@ def _bind_all() -> List[_Registry]:
     bind_core_service(example)
     out.append(example)
 
+    # fleet KVCache serving binary: Serving + Usrbio (co-located peer
+    # fills ride shm rings into the Serving table) + Core
+    from tpu3fs.serving.service import bind_serving_service
+
+    serving = _Registry("serving_main")
+    bind_serving_service(serving, stub)
+    bind_usrbio_service(serving, stub)
+    bind_core_service(serving)
+    out.append(serving)
+
     # standalone-table consistency: plain kvd binds the same Kv schema
     plain_kv = _Registry("kv_main(plain)")
     bind_kv_service(plain_kv, stub)
@@ -314,7 +324,7 @@ def check_idempotency(registries: List[_Registry]) -> List[str]:
 #: services whose methods ARE the data plane: a foreground-classified
 #: method here must charge tenant quotas (bytes/iops), never exempt
 _DATA_PLANE_SERVICES = frozenset({"StorageSerde", "MetaSerde",
-                                  "SimpleExample"})
+                                  "SimpleExample", "Serving"})
 
 
 def check_tenancy(registries: List[_Registry]) -> List[str]:
@@ -375,10 +385,13 @@ def check_usrbio_ring(registries: List[_Registry]) -> List[str]:
     """Check 7 — the shm ring path can never grow an admission bypass:
 
     a. every (service id, method id) in the ring allowlist
-       (``tpu3fs/usrbio/transport.py`` RING_METHODS) is bound by the
-       storage binary under exactly the advertised names, and carries the
-       full classification triple — QoS (default_class_for), idempotency
-       and tenant enforcement;
+       (``tpu3fs/usrbio/transport.py`` RING_METHODS) is bound — under
+       exactly the advertised names — by at least one binary that ALSO
+       binds the Usrbio control plane (a ring agent only dispatches into
+       its own process's tables: storage_main carries the StorageSerde
+       rows, serving_main the Serving rows), and carries the full
+       classification triple — QoS (default_class_for), idempotency and
+       tenant enforcement;
     b. statically (AST), ``tpu3fs/usrbio/server.py`` dispatches through
        ``tpu3fs.rpc.net.dispatch_packet`` and NEVER calls a service
        handler or storage data-plane method directly, nor touches a
@@ -395,23 +408,36 @@ def check_usrbio_ring(registries: List[_Registry]) -> List[str]:
     from tpu3fs.usrbio.transport import RING_METHODS
 
     errors: List[str] = []
-    storage = next((r for r in registries if r.name == "storage_main"),
-                   None)
-    if storage is None:
-        return ["check_usrbio_ring: no storage_main registry"]
+    # a ring agent dispatches into its OWN process's tables, so a
+    # RING_METHODS row is backed only by a binary that binds BOTH the
+    # Usrbio control plane and the row's service
+    ring_hosts = [r for r in registries
+                  if any(s.name == "Usrbio" for s in r.services.values())]
+    if not ring_hosts:
+        return ["check_usrbio_ring: no binary binds the Usrbio service"]
     for (sid, mid), (svc_name, m_name) in sorted(RING_METHODS.items()):
-        service = storage.services.get(sid)
-        if service is None:
-            errors.append(
-                f"RING_METHODS names service id {sid} which storage_main "
-                "does not bind")
-            continue
-        mdef = service.methods.get(mid)
-        if mdef is None or service.name != svc_name or mdef.name != m_name:
-            errors.append(
-                f"RING_METHODS ({sid},{mid}) -> {svc_name}.{m_name} does "
-                f"not match the bound table "
-                f"({service.name}.{mdef.name if mdef else '?'})")
+        mdef = None
+        bound_as = None
+        for reg in ring_hosts:
+            service = reg.services.get(sid)
+            if service is None:
+                continue
+            cand = service.methods.get(mid)
+            bound_as = (service.name, cand.name if cand else "?")
+            if cand is not None and service.name == svc_name \
+                    and cand.name == m_name:
+                mdef = cand
+                break
+        if mdef is None:
+            if bound_as is None:
+                errors.append(
+                    f"RING_METHODS names service id {sid} which no "
+                    "Usrbio-binding binary binds")
+            else:
+                errors.append(
+                    f"RING_METHODS ({sid},{mid}) -> {svc_name}.{m_name} "
+                    f"does not match any Usrbio-binding binary's table "
+                    f"(found {bound_as[0]}.{bound_as[1]})")
             continue
         tclass = default_class_for(m_name)
         if not isinstance(tclass, TrafficClass) or tclass not in CLASS_ATTRS:

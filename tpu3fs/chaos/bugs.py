@@ -31,6 +31,17 @@ Known bugs:
   cleanly. Invisible to clean reads (data shards only); caught by
   ``crc_oracle`` the moment a kill forces a degraded decode through the
   bad parity (or a rebuild re-materializes a data shard from it).
+
+- ``peer_fill_stale`` — the serving-tier staleness bug shape: a peer's
+  serve-through path (tpu3fs/serving/service.py _serve_through) answers
+  ``peerRead`` with the raw cached-inode read WITHOUT the zero-hole
+  staleness check — a block whose entry the GC already evicted reads
+  back as an all-zero hole through the stale inode and ships to the
+  requester as KV content (zeros-as-KV). The correct path detects the
+  hole, invalidates, and re-probes meta (KVCACHE_STALE semantics: a
+  stale block must surface as a MISS, never as fabricated bytes).
+  Caught by the ``kvcache_stale`` checker on the serving sidecar's
+  read records.
 """
 
 from __future__ import annotations
@@ -47,7 +58,9 @@ _armed: Set[str] = set(
 
 #: names production hook sites are allowed to ask about (a typo'd
 #: arm()/hook pair must fail loudly, not silently never fire)
-KNOWN_BUGS = frozenset({"commit_skip", "chain_parity_skip"})
+KNOWN_BUGS = frozenset({
+    "commit_skip", "chain_parity_skip", "peer_fill_stale",
+})
 
 
 def arm(name: str) -> None:

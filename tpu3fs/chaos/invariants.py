@@ -36,6 +36,11 @@ Catalogue (docs/chaos.md):
                       exact recorded remaining sample sequence.
 ``bounded_memory``    every registered memory gauge is below its bound
                       (leaks under chaos show up here, not in prod).
+``kvcache_stale``     serving-tier staleness: a fleet KVCache get never
+                      returns bytes no client ever put for that key — a
+                      peer serving a GC'd block must surface as a MISS
+                      (the KVCACHE_STALE re-probe), never as zeros-as-KV
+                      (the planted ``peer_fill_stale`` bug's shape).
 """
 
 from __future__ import annotations
@@ -93,6 +98,10 @@ class ChaosContext:
     # memory gauges: name -> (value_fn, bound)
     memory_gauges: Dict[str, Tuple[Callable[[], float], float]] = field(
         default_factory=dict)
+    # serving sidecar read records: (key, admissible crc32c set, got
+    # bytes | None) per fleet-cache get issued against a GC race
+    serving_reads: List[Tuple[str, set, Optional[bytes]]] = field(
+        default_factory=list)
 
 
 _REGISTRY: Dict[str, Callable[[ChaosContext], Optional[List[Violation]]]] = {}
@@ -328,6 +337,27 @@ def _check_bounded_memory(ctx: ChaosContext):
             bad.append(Violation(
                 "bounded_memory",
                 f"gauge {name} = {value:g} exceeds bound {bound:g}"))
+    return bad
+
+
+@register("kvcache_stale")
+def _check_kvcache_stale(ctx: ChaosContext):
+    if not ctx.serving_reads:
+        return None
+    bad: List[Violation] = []
+    for key, admissible, got in ctx.serving_reads:
+        if got is None:
+            continue  # staleness surfaced as a miss: the correct re-probe
+        crc = _crc32c(got)
+        if crc in admissible:
+            continue
+        kind = ("zeros-as-KV" if not any(bytes(got))
+                else "foreign bytes")
+        bad.append(Violation(
+            "kvcache_stale",
+            f"serving get of {key!r} returned {kind} no client ever put "
+            f"— a peer served a GC'd block without the staleness "
+            f"re-probe (must surface as KVCACHE_STALE/miss)"))
     return bad
 
 

@@ -128,6 +128,10 @@ class ScheduleSpec:
     # run the training sidecar (mini ckpt saves + dataload cursor) so
     # the ckpt_atomicity / dataload_resume checkers judge the run too
     train_workload: bool = False
+    # run the fleet-serving sidecar (two FleetKVCache processes peer-
+    # filling over a loopback transport, with an out-of-band GC racing
+    # them) so the kvcache_stale checker judges the run too
+    kv_serving: bool = False
     allow_kill: bool = True
     allow_elastic: bool = False      # join/drain events (need a worker)
     allow_config_push: bool = True

@@ -143,8 +143,11 @@ class FabricRunner:
         self._worker = None
         self._tenants_touched = False
         self._train = None
+        self._serving = None
         if spec.train_workload:
             self._train_setup()
+        if spec.kv_serving:
+            self._serving_setup()
         report = RunReport(self.schedule)
         by_step: Dict[int, List[ChaosEvent]] = {}
         for e in self.schedule.events:
@@ -160,6 +163,7 @@ class FabricRunner:
                 for _ in range(self.ops_per_step):
                     self._workload_op(report)
                 self._train_tick(step)
+                self._serving_tick(step)
                 self._background_tick()
             self._quiesce()
             ctx = self._context()
@@ -181,6 +185,12 @@ class FabricRunner:
                     self._train["loader"].close()
                 except Exception:
                     pass
+            if self._serving is not None:
+                for fleet in self._serving["fleets"].values():
+                    try:
+                        fleet.close(flush=False)
+                    except Exception:
+                        pass
             if self._tenants_touched:
                 from tpu3fs.tenant.quota import registry
 
@@ -480,6 +490,81 @@ class FabricRunner:
             resumed = [list(map(int, b.ids)) for b in lo]
         return tr["expected"][tr["saved_consumed"][s]:], resumed
 
+    # -- serving sidecar (kvcache_stale checker in the SEARCH) ----------------
+    def _serving_setup(self) -> None:
+        """Two fleet KVCache 'processes' riding the chaos run over a
+        loopback peer transport, with an out-of-band GC racing their
+        peer fills — the serve-through staleness hazard, deterministic:
+        peer A writes + warms its cached inode, A's host tier is
+        evicted, the GC removes the entry, then B's miss peer-fills
+        from A. The correct path detects the zero-hole and re-probes
+        (B sees a miss); the planted ``peer_fill_stale`` bug ships the
+        hole as KV bytes and the ``kvcache_stale`` checker fires."""
+        from tpu3fs.client.hedging import HedgeController
+        from tpu3fs.kvcache.cache import KVCacheClient
+        from tpu3fs.mgmtd.types import ServingEndpoint
+        from tpu3fs.serving.fleet import FleetKVCache
+        from tpu3fs.serving.service import ServingHost
+
+        root = "/chaos/kv"
+        node_ids = (101, 102)
+        endpoints = {nid: ServingEndpoint(node_id=nid)
+                     for nid in node_ids}
+
+        class _Routing:
+            serving = endpoints
+
+        peers = _LoopbackPeers()
+        fleets = {}
+        for nid in node_ids:
+            kv = KVCacheClient(
+                self.fab.meta, self.fab.file_client(), root=root,
+                client_id=f"chaos-serve-{nid}", inode_cache=64)
+            fleet = FleetKVCache(
+                kv, node_id=nid, routing=_Routing, peer_client=peers,
+                hedge=HedgeController(), capacity_bytes=1 << 20,
+                write_through=True)
+            peers.hosts[nid] = ServingHost(fleet, nid,
+                                           claims=fleet.claims)
+            fleets[nid] = fleet
+        # the GC analog: a SEPARATE client (its own inode cache), so
+        # removing an entry leaves A's cached inode stale — the race
+        gc_kv = KVCacheClient(self.fab.meta, self.fab.file_client(),
+                              root=root, client_id="chaos-serve-gc")
+        self._serving = {"fleets": fleets, "gc": gc_kv,
+                         "reads": [], "n": 0}
+
+    def _serving_tick(self, step: int) -> None:
+        """Every other step: one full put -> evict -> GC -> peer-fill
+        round. Failures mid-chaos are weather (the fault plane may be
+        chewing the very RPCs the sidecar rides) — only a COMPLETED get
+        is recorded for the checker."""
+        sv = self._serving
+        if sv is None or step % 2 == 0:
+            return
+        from tpu3fs.utils.result import FsError
+
+        sv["n"] += 1
+        key = f"srv-{self.schedule.seed & 0xFFFF:04x}-{sv['n']:03d}"
+        payload = f"kv{sv['n']:06d}".encode().ljust(64, b"#")
+        a, b = sv["fleets"][101], sv["fleets"][102]
+        try:
+            a.put(key, payload)       # write-through + warms A's inode
+        except (FsError, ConnectionError):
+            return
+        admissible = {crc32c(payload)}
+        a.tier.clear()                # host-tier capacity eviction
+        sv["gc"].remove(key)          # the GC wins the race...
+        try:
+            self.fab.run_gc()         # ...and reclaims the chunks: A's
+        except (FsError, ConnectionError):  # cached inode now reads a
+            return                    # zero hole
+        try:
+            got = b.get(key)          # miss -> peer fill from A
+        except (FsError, ConnectionError):
+            return
+        sv["reads"].append((key, admissible, got))
+
     # -- quiesce + verdict ----------------------------------------------------
     def _quiesce(self) -> None:
         from tpu3fs.placement.rebalance import DRAINING_TAG
@@ -544,8 +629,42 @@ class FabricRunner:
             routing=self.fab.routing,
             dump_chunkmeta=lambda node, tid: self.fab.send(
                 node, "dump_chunkmeta", tid),
+            serving_reads=(self._serving["reads"]
+                           if self._serving is not None else []),
             **train,
         )
+
+
+class _LoopbackPeers:
+    """In-process peer transport for the serving sidecar: the
+    ServingPeerClient surface (fleet.py calls it) dispatched straight
+    into the other fleet's ServingHost — no sockets, so one seeded
+    thread of control and byte-deterministic replays."""
+
+    def __init__(self):
+        self.hosts: Dict[int, object] = {}
+
+    def peer_read(self, ep, keys, *, serve_through=True, est_bytes=0,
+                  deadline_s=None):  # loopback: nothing ever straggles
+        from tpu3fs.serving.service import PeerReadReq
+
+        return self.hosts[ep.node_id].peer_read(PeerReadReq(
+            keys=list(keys), serve_through=serve_through))
+
+    def fill_claim(self, ep, key, owner, ttl_ms=2000):
+        from tpu3fs.serving.service import FillClaimReq
+
+        return self.hosts[ep.node_id].fill_claim(FillClaimReq(
+            key=key, owner=owner, ttl_ms=ttl_ms))
+
+    def fill_release(self, ep, key, owner):
+        from tpu3fs.serving.service import FillReleaseReq
+
+        return self.hosts[ep.node_id].fill_release(FillReleaseReq(
+            key=key, owner=owner))
+
+    def close(self) -> None:
+        self.hosts.clear()
 
 
 # -- search + shrink ----------------------------------------------------------

@@ -1719,6 +1719,41 @@ class AdminCli:
             out += f"; capacity pass removed {cap_removed}"
         return out
 
+    def cmd_serving(self, args: List[str]) -> str:
+        """serving [--stats]: the mgmtd serving directory (fleet KVCache
+        peer endpoints, docs/serving.md); --stats also calls each live
+        endpoint's servingStats — host-tier residency + the peer-fill
+        protocol's outcome counters."""
+        ri = self.fab.routing()
+        serving = getattr(ri, "serving", {}) or {}
+        if not serving:
+            return "serving directory: empty"
+        lines = [f"serving directory ({len(serving)} endpoints, "
+                 f"routing v{ri.version}):"]
+        stats = "--stats" in args
+        peers = None
+        if stats:
+            from tpu3fs.rpc.net import RpcClient
+            from tpu3fs.serving.service import ServingPeerClient
+
+            peers = ServingPeerClient(RpcClient(), usrbio=False)
+        for node_id, ep in sorted(serving.items()):
+            line = (f"  node {node_id:<5} {ep.host}:{ep.port} "
+                    f"ttl={ep.ttl_s:.0f}s")
+            if peers is not None:
+                try:
+                    s = peers.stats(ep)
+                    line += (f" host={s.host_entries}e/{s.host_bytes}B "
+                             f"peer_hits={s.peer_hits} "
+                             f"peer_misses={s.peer_misses} "
+                             f"storage_fills={s.storage_fills} "
+                             f"coalesced={s.coalesced} "
+                             f"demotions={s.demotions} stale={s.stale_detected}")
+                except FsError as e:
+                    line += f" unreachable ({e.code.name})"
+            lines.append(line)
+        return "\n".join(lines)
+
     def cmd_ckpt_rm(self, args: List[str]) -> str:
         """ckpt-rm STEP [--root /ckpt] [--keep SECONDS]: evict one step
         through the trash subsystem (recoverable until expiry)."""

@@ -28,10 +28,82 @@ Accounting: hedge.sent / hedge.win (backup answered first) / hedge.loss
 from __future__ import annotations
 
 import contextvars
+import os
+import queue
 import threading
 from typing import Callable, Optional, Tuple
 
 from tpu3fs.monitor.recorder import CounterRecorder
+
+
+class _RunnerPool:
+    """Persistent daemon runners for hedge attempts. A thread PER attempt
+    is wrong for a hot read path: in a process with a live server + bench
+    threads, a freshly spawned thread's first scheduling quantum costs
+    multiple milliseconds (measured 3-8x the whole RPC), which lands
+    directly on every hedged read's critical path. Runners are daemon
+    threads (a wedged thunk must never block interpreter exit — same
+    contract as the old per-call daemon threads), spawned on demand up to
+    a cap; past the cap attempts queue, which only happens when that many
+    thunks are already wedged."""
+
+    def __init__(self, max_workers: int = 64):
+        self._q: "queue.SimpleQueue[Callable[[], None]]" = \
+            queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._threads = 0
+        self._idle = 0
+        self._max = int(max_workers)
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            spawn = self._idle == 0 and self._threads < self._max
+            if spawn:
+                self._threads += 1
+        if spawn:
+            threading.Thread(target=self._loop, daemon=True,
+                             name="hedge-runner").start()
+        self._q.put(fn)
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                self._idle += 1
+            fn = self._q.get()
+            with self._lock:
+                self._idle -= 1
+            try:
+                fn()
+            except BaseException:
+                pass  # _runner already wraps; belt + braces
+
+
+_pool: Optional[_RunnerPool] = None
+_pool_lock = threading.Lock()
+
+
+def _reset_pool_after_fork() -> None:
+    """fork() carries the pool singleton's thread/idle COUNTERS into the
+    child but not its runner THREADS: submit() would then see idle
+    runners that do not exist and queue thunks nobody drains (every
+    hedged call in the forked child times out). Start the child from a
+    fresh pool — and a fresh lock, in case the parent forked while a
+    sibling thread held it."""
+    global _pool, _pool_lock
+    _pool = None
+    _pool_lock = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reset_pool_after_fork)
+
+
+def _runners() -> _RunnerPool:
+    global _pool
+    if _pool is None:
+        with _pool_lock:
+            if _pool is None:
+                _pool = _RunnerPool()
+    return _pool
 
 
 class HedgeController:
@@ -127,9 +199,10 @@ def run_hedged(primary: Callable[[], object],
     return the first good reply (or the last reply when none is good).
 
     -> (reply, hedged, backup_won). Both thunks run inside a snapshot of
-    the calling context (QoS class, trace, deadline ride along). Thunks
-    must RETURN replies, never raise — callers wrap transport errors into
-    reply objects (their normal pattern)."""
+    the calling context (QoS class, trace, deadline ride along) on the
+    persistent runner pool (see _RunnerPool). Thunks must RETURN replies,
+    never raise — callers wrap transport errors into reply objects (their
+    normal pattern)."""
     controller.note_primary()
     replies: list = [None, None]
     done = [False, False]
@@ -148,8 +221,7 @@ def run_hedged(primary: Callable[[], object],
             done[idx] = True
             cond.notify_all()
 
-    threading.Thread(target=_runner, args=(0, primary), daemon=True,
-                     name="hedge-primary").start()
+    _runners().submit(lambda: _runner(0, primary))
 
     def _winner(expect_backup: bool):
         """First finished-and-good index, else None."""
@@ -168,8 +240,7 @@ def run_hedged(primary: Callable[[], object],
             if isinstance(r, BaseException):
                 raise r
             return r, False, False
-    threading.Thread(target=_runner, args=(1, backup), daemon=True,
-                     name="hedge-backup").start()
+    _runners().submit(lambda: _runner(1, backup))
     with cond:
         cond.wait_for(lambda: _winner(True) is not None
                       or (done[0] and done[1]),

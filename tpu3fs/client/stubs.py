@@ -121,6 +121,17 @@ class StubFactory:
         return MetaRpcClient([self.meta_addr], self.rpc_client(),
                              token=token)
 
+    def serving_peer_client(self, **kw):
+        """Serving peerRead/fillClaim stub (tpu3fs/serving/service.py) —
+        shares the factory's pooled RPC client like every other stub;
+        pass ``usrbio=False`` to force sockets for non-co-located use."""
+        if self.transport == "inmem":
+            raise FsError(Status(Code.INVALID_ARG,
+                                 "inmem mode has no serving peers"))
+        from tpu3fs.serving.service import ServingPeerClient
+
+        return ServingPeerClient(self.rpc_client(), **kw)
+
     def close(self) -> None:
         if self._rpc is not None:
             self._rpc.close()

@@ -41,6 +41,7 @@ class KeyPrefix(bytes, enum.Enum):
     CONFIG = b"CONF"         # per-node-type config blobs
     TARGET_INFO = b"TGIF"    # target infos
     MIGRATION = b"MGJB"      # migration job records (+ b"MGJC" id counter)
+    SERVING = b"SRVE"        # KVCache serving endpoints (peer directory)
 
 
 def make_key(prefix: KeyPrefix, *parts: bytes) -> bytes:

@@ -124,17 +124,32 @@ class PrefixBlockStore:
                        f"{len(kv_blocks)} blocks at {start_block} but the "
                        f"sequence only chains {len(keys)} full blocks")
         present = self._cache.batch_contains(want)
-        stored = 0
-        for key, arr, hit in zip(want, kv_blocks, present):
-            if hit:
-                continue
-            raw = encode_array(arr)
+        items = [(key, encode_array(arr))
+                 for key, arr, hit in zip(want, kv_blocks, present)
+                 if not hit]
+        if not items:
+            return 0
+        # drain as ONE batched put (KVCacheClient.batch_put: one
+        # batch_create + one striped batch write + one batch_close for
+        # the whole drain) — the last per-block serial-create path
+        # (ROADMAP carried follow-up; regression-pinned in
+        # tests/test_kvcache.py)
+        batched = getattr(self._cache, "batch_put", None)
+        if batched is not None and len(items) > 1:
             if write_through is None:
-                self._cache.put(key, raw)
+                batched(items)
             else:
-                self._cache.put(key, raw, write_through=write_through)
-            stored += 1
-        return stored
+                try:
+                    batched(items, write_through=write_through)
+                except TypeError:  # fs-tier cache: always through
+                    batched(items)
+        else:
+            for key, raw in items:
+                if write_through is None:
+                    self._cache.put(key, raw)
+                else:
+                    self._cache.put(key, raw, write_through=write_through)
+        return len(items)
 
     # -- reads --------------------------------------------------------------
     def get_blocks(self, token_ids: Sequence[int], *,

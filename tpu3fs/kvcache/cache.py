@@ -355,6 +355,26 @@ class KVCacheClient:
                 self._touch([path], time.time(), inode_ids=[inode.id])
             return data
 
+    def get_cached(self, key: str) -> Optional[bytes]:
+        """Read ONLY via an already-cached inode — zero metadata round
+        trips, None when the inode is not cached. The serving host's
+        serve-through path (tpu3fs/serving/service.py): a peer asking for
+        a block this process recently wrote can be answered for one
+        storage read with no meta traffic. The caller MUST staleness-check
+        the payload (layout.zero_hole) — a GC'd entry reads back as an
+        all-zero hole through a cached inode."""
+        inode = self._cached_inode(key)
+        if inode is None:
+            return None
+        with tagged(TrafficClass.KVCACHE), self._tenant_ctx():
+            try:
+                data = self._fio.read(inode, 0, inode.length)
+            except FsError:
+                self.invalidate(key)
+                return None
+            self._read_bytes.add(len(data))
+            return data
+
     def batch_get(self, keys: Sequence[str]) -> List[Optional[bytes]]:
         """Stat all keys, then read every hit as ONE node-grouped chunk
         batch (StorageClient.batch_read underneath) and refresh every

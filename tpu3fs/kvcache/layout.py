@@ -77,6 +77,18 @@ def decode_array(raw) -> np.ndarray:
     return np.frombuffer(raw, dtype=dtype, offset=off).reshape(shape)
 
 
+def zero_hole(raw) -> bool:
+    """True when a read came back as the all-zero hole a GC'd entry
+    leaves behind — the cheap staleness probe for servers that relay raw
+    entry bytes without decoding them (the serving host's serve-through
+    path validates HERE before shipping to a peer: serving zeros-as-KV
+    across the fleet is the one unforgivable outcome)."""
+    if len(raw) < _HEADER.size:
+        return False
+    name, _, magic = _HEADER.unpack_from(raw, 0)
+    return magic == 0 and name == b"\0" * 8
+
+
 # -- lease encoding ----------------------------------------------------------
 
 def encode_lease(expire_ts: float, owner: str) -> bytes:

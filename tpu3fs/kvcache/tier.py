@@ -35,10 +35,23 @@ from tpu3fs.utils.result import Code, FsError, Status
 
 
 class HostTier:
-    """Thread-safe bounded-bytes LRU of value buffers."""
+    """Thread-safe bounded-bytes LRU of value buffers.
 
-    def __init__(self, capacity_bytes: int):
+    With a ``refcount_of`` callable installed (the serving fleet's
+    shared-block refcounts, tpu3fs/serving/fleet.py), eviction prefers
+    UNSHARED entries: a viral shared prefix (many live decode chains
+    reference its blocks) should outlive the unshared tail blocks of a
+    single finished request, whatever pure recency says. The scan is
+    bounded (``evict_scan``) so eviction stays O(1)-ish; when every
+    scanned entry is shared, plain LRU applies — capacity wins over
+    sharing, never the reverse."""
+
+    def __init__(self, capacity_bytes: int, *, evict_scan: int = 8):
         self.capacity_bytes = int(capacity_bytes)
+        self.evict_scan = max(1, int(evict_scan))
+        #: optional key -> live-chain refcount (entries with count > 1
+        #: are "shared"); installed by FleetKVCache
+        self.refcount_of = None
         self._mu = threading.Lock()
         self._entries: "OrderedDict[str, bytes]" = OrderedDict()
         self._bytes = 0
@@ -69,10 +82,28 @@ class HostTier:
             self._entries[key] = value
             self._bytes += n
             while self._bytes > self.capacity_bytes and self._entries:
-                _, v = self._entries.popitem(last=False)
+                v = self._evict_one_locked()
                 self._bytes -= len(v)
                 evicted += 1
         return evicted
+
+    def _evict_one_locked(self) -> bytes:
+        """Pop one victim (value returned for byte accounting): the first
+        UNSHARED entry within the scan window from the LRU end, else the
+        plain LRU head."""
+        rc = self.refcount_of
+        if rc is not None:
+            for i, key in enumerate(self._entries):
+                if i >= self.evict_scan:
+                    break
+                try:
+                    shared = rc(key) > 1
+                except Exception:
+                    shared = False
+                if not shared:
+                    return self._entries.pop(key)
+        _, v = self._entries.popitem(last=False)
+        return v
 
     def remove(self, key: str) -> bool:
         with self._mu:
@@ -166,7 +197,7 @@ class TieredKVCache:
             self._host_hits.add()
             return v
         self._host_misses.add()
-        v = self._fs.get(key)
+        v = self._miss_fill(key)
         if v is not None:
             self._fill(key, v)
         return v
@@ -185,12 +216,23 @@ class TieredKVCache:
                 missing.append(i)
         if missing:
             self._host_misses.add(len(missing))
-            got = self._fs.batch_get([keys[i] for i in missing])
+            got = self._miss_fill_batch([keys[i] for i in missing])
             for i, blob in zip(missing, got):
                 out[i] = blob
                 if blob is not None:
                     self._fill(keys[i], blob)
         return out
+
+    # -- miss path (the serving fleet's interposition point) ----------------
+    def _miss_fill(self, key: str) -> Optional[bytes]:
+        """Resolve ONE host-tier miss from below. The base class goes
+        straight to the fs tier; FleetKVCache (tpu3fs/serving/fleet.py)
+        overrides this with single-flight -> peer host tier -> storage."""
+        return self._fs.get(key)
+
+    def _miss_fill_batch(self, keys: Sequence[str]) -> List[Optional[bytes]]:
+        """Batch analogue of ``_miss_fill`` (same override point)."""
+        return self._fs.batch_get(keys)
 
     def _fill(self, key: str, value) -> None:
         self._fill_bytes.add(len(value))
@@ -232,6 +274,36 @@ class TieredKVCache:
             self._cond.notify_all()
         self._evictions.add(self.tier.put(key, value))
         self._note_host()
+
+    def batch_put(self, items, write_through: Optional[bool] = None) -> None:
+        """Store many (key, value) entries in one drain: write-through
+        rides ``KVCacheClient.batch_put`` (ONE batch_create + ONE striped
+        batch write + ONE batch_close for the whole drain — never N serial
+        create round trips); write-back lands everything in the dirty
+        buffer and lets the flusher drain it batched the same way."""
+        items = list(items)
+        if not items:
+            return
+        wt = self.write_through if write_through is None else write_through
+        if wt:
+            batched = getattr(self._fs, "batch_put", None)
+            if batched is not None and len(items) > 1:
+                batched(items)
+            else:
+                for key, value in items:
+                    self._fs.put(key, value)
+            for key, value in items:
+                self._evictions.add(self.tier.put(key, value))
+            self._note_host()
+            return
+        for key, value in items:
+            self.put(key, value, write_through=False)
+
+    def peek(self, key: str) -> Optional[bytes]:
+        """Local-only read (tier + dirty buffer): the serving host's
+        peerRead answers from here — a peer miss must never recurse into
+        THIS process's storage-fill path."""
+        return self._local(key)
 
     def remove(self, key: str) -> bool:
         """Drops the local copies and the fs entry. Racing an in-flight

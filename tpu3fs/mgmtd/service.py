@@ -34,6 +34,7 @@ from tpu3fs.mgmtd.types import (
     NodeType,
     PublicTargetState,
     RoutingInfo,
+    ServingEndpoint,
     TargetInfo,
 )
 from tpu3fs.rpc.serde import deserialize, serialize
@@ -66,6 +67,10 @@ def _target_key(target_id: int) -> bytes:
 
 def _config_key(node_type: NodeType) -> bytes:
     return KeyPrefix.CONFIG.value + struct.pack(">B", int(node_type))
+
+
+def _serving_key(node_id: int) -> bytes:
+    return KeyPrefix.SERVING.value + struct.pack(">Q", node_id)
 
 
 @dataclass
@@ -145,6 +150,12 @@ class Mgmtd:
             ):
                 info = deserialize(pair.value, TargetInfo)
                 routing.targets[info.target_id] = info
+            for pair in txn.get_range(
+                KeyPrefix.SERVING.value, KeyPrefix.SERVING.value + b"\xff" * 9,
+                snapshot=True,
+            ):
+                ep = deserialize(pair.value, ServingEndpoint)
+                routing.serving[ep.node_id] = ep
             configs = {}
             for pair in txn.get_range(
                 KeyPrefix.CONFIG.value, KeyPrefix.CONFIG.value + b"\xff" * 2,
@@ -644,6 +655,66 @@ class Mgmtd:
         self._routing.nodes[node_id] = info
         self._routing.version = ver
 
+    # -- KVCache serving endpoints (tpu3fs/serving peer directory) ----------
+    def serving_register(self, node_id: int, host: str, port: int,
+                         ttl_s: float = 30.0,
+                         now: Optional[float] = None) -> None:
+        """Publish (or TTL-renew) a process's peerRead endpoint in routing.
+        Persisted like node infos so a primary restart keeps the directory;
+        the routing version bumps only when membership or placement
+        actually changes — pure renewals stay version-silent so clients'
+        known-version polls keep answering 'unchanged'."""
+        now = self._clock() if now is None else now
+        ep = ServingEndpoint(node_id=node_id, host=host, port=port,
+                             registered_at=now, ttl_s=max(1.0, float(ttl_s)))
+        old = self._routing.serving.get(node_id)
+        renewal = (old is not None and old.host == host
+                   and old.port == port)
+
+        def op(txn: ITransaction):
+            self._ensure_holder_in_txn(txn)
+            txn.set(_serving_key(node_id), serialize(ep))
+            if renewal:
+                return self._routing.version
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        self._routing.serving[node_id] = ep
+        self._routing.version = ver
+        self._prune_serving(now)
+
+    def serving_unregister(self, node_id: int) -> None:
+        def op(txn: ITransaction):
+            self._ensure_holder_in_txn(txn)
+            txn.clear(_serving_key(node_id))
+            if node_id in self._routing.serving:
+                return self._bump_routing_in_txn(txn)
+            return self._routing.version
+
+        ver = with_transaction(self._engine, op)
+        self._routing.serving.pop(node_id, None)
+        self._routing.version = ver
+
+    def _prune_serving(self, now: Optional[float] = None) -> List[int]:
+        """Drop endpoints whose TTL lapsed (a crashed serving process
+        stops renewing); runs on every register and every tick."""
+        now = self._clock() if now is None else now
+        expired = [ep.node_id for ep in self._routing.serving.values()
+                   if now - ep.registered_at > ep.ttl_s]
+        if not expired:
+            return expired
+
+        def op(txn: ITransaction):
+            for node_id in expired:
+                txn.clear(_serving_key(node_id))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        for node_id in expired:
+            self._routing.serving.pop(node_id, None)
+        self._routing.version = ver
+        return expired
+
     def heartbeat(
         self,
         node_id: int,
@@ -838,6 +909,10 @@ class Mgmtd:
             for node in self._routing.nodes.values():
                 node.last_heartbeat = max(node.last_heartbeat, now)
         self.check_heartbeats(now)
+        try:
+            self._prune_serving(now)
+        except FsError:
+            pass  # deposed mid-tick: the new primary prunes
         self.update_chains(now)
         self.check_newborn_chains()
         self.persist_target_infos()

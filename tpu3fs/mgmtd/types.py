@@ -138,6 +138,22 @@ class NodeInfo:
 
 
 @dataclass
+class ServingEndpoint:
+    """One process's KVCache serving endpoint (tpu3fs/serving): where
+    peers reach its peerRead service, published through RoutingInfo like
+    chain tables so discovery is gossip-light — every routing refresh IS
+    the peer directory. TTL-leased: an endpoint that stops re-registering
+    is pruned by the mgmtd tick (a crashed serving process must fall out
+    of peer selection even before breakers open)."""
+
+    node_id: int
+    host: str = ""
+    port: int = 0
+    registered_at: float = 0.0
+    ttl_s: float = 30.0
+
+
+@dataclass
 class LeaseInfo:
     """Primary election record (ref MgmtdLeaseInfo.h:9-22); mutated only via
     KV compare-and-set inside a transaction (MgmtdStore::extendLease)."""
@@ -158,6 +174,10 @@ class RoutingInfo:
     chain_tables: Dict[int, ChainTable] = field(default_factory=dict)
     chains: Dict[int, ChainInfo] = field(default_factory=dict)
     targets: Dict[int, TargetInfo] = field(default_factory=dict)
+    # KVCache serving endpoints (tpu3fs/serving peer directory) — trailing
+    # field on purpose: serde decoders default missing trailing fields, so
+    # pre-serving peers interop (rpc/serde.py evolution rule)
+    serving: Dict[int, ServingEndpoint] = field(default_factory=dict)
 
     def chain_of_target(self, target_id: int) -> Optional[ChainInfo]:
         info = self.targets.get(target_id)

@@ -143,8 +143,28 @@ def class_from_flags(flags: int) -> Optional[TrafficClass]:
         return None  # newer peer with classes we don't know: untagged
 
 
+#: explicit per-method classes consulted BEFORE the name heuristics:
+#: the serving fleet's peer-fill RPCs are KVCACHE traffic whatever their
+#: names suggest ("peerRead" must not admission-key as FG_READ — it
+#: competes in the kvcache share, like the storage reads it replaces),
+#: and its control surface is CONTROL ("servingStats" contains "stat").
+#: check_rpc_registry resolves every bound method through here.
+METHOD_CLASS_OVERRIDES: Dict[str, TrafficClass] = {
+    "peerRead": TrafficClass.KVCACHE,
+    "fillClaim": TrafficClass.KVCACHE,
+    "fillRelease": TrafficClass.KVCACHE,
+    "servingStats": TrafficClass.CONTROL,
+    "servingLoad": TrafficClass.KVCACHE,
+    "servingRegister": TrafficClass.CONTROL,
+    "servingUnregister": TrafficClass.CONTROL,
+}
+
+
 def default_class_for(method_name: str) -> TrafficClass:
     """Fallback classification for untagged RPCs by method name."""
+    override = METHOD_CLASS_OVERRIDES.get(method_name)
+    if override is not None:
+        return override
     name = method_name.lower()
     if "read" in name or "query" in name or "stat" in name:
         return TrafficClass.FG_READ

@@ -102,6 +102,12 @@ CLASSIFICATION: Dict[Tuple[str, str], str] = {
     ("Mgmtd", "migrationList"): IDEMPOTENT,
     ("Mgmtd", "migrationClaim"): MUTATING,
     ("Mgmtd", "migrationReport"): MUTATING,
+    # serving-endpoint directory (tpu3fs/serving): TTL-leased rows in
+    # RoutingInfo.serving; registration renewal is replay-safe by
+    # construction (same host/port re-register is version-silent) but
+    # classifies MUTATING like registerNode
+    ("Mgmtd", "servingRegister"): MUTATING,
+    ("Mgmtd", "servingUnregister"): MUTATING,
     # -- Usrbio (shm-ring control plane; the DATA rides StorageSerde) -----
     ("Usrbio", "usrbioHandshake"): IDEMPOTENT,
     ("Usrbio", "usrbioRegister"): MUTATING,    # spawns a ring worker
@@ -136,6 +142,15 @@ CLASSIFICATION: Dict[Tuple[str, str], str] = {
     # -- SimpleExample ----------------------------------------------------
     ("SimpleExample", "write"): MUTATING,
     ("SimpleExample", "read"): IDEMPOTENT,
+    # -- Serving (fleet KVCache peer-fill, tpu3fs/serving) ----------------
+    # peerRead is a committed-state read of a peer's host tier (and its
+    # serve-through is a plain storage read) — hedge-safe, and the fleet
+    # fill path DOES hedge it against the storage fill.
+    ("Serving", "peerRead"): IDEMPOTENT,
+    ("Serving", "fillClaim"): MUTATING,     # takes/renews a fill lease
+    ("Serving", "fillRelease"): MUTATING,
+    ("Serving", "servingStats"): IDEMPOTENT,
+    ("Serving", "servingLoad"): MUTATING,   # runs a workload leg
 }
 
 #: messenger-level method names the hedging client may back up with a

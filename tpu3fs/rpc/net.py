@@ -665,10 +665,11 @@ class RpcClient:
         rsp_type: Type,
         *,
         req_type: Optional[Type] = None,
+        timeout_s: Optional[float] = None,
     ) -> Any:
         """Raises FsError carrying the remote (or transport) status code."""
         rsp, _ = self.call_bulk(addr, service_id, method_id, req, rsp_type,
-                                req_type=req_type)
+                                req_type=req_type, timeout_s=timeout_s)
         return rsp
 
     def call_bulk(
@@ -681,12 +682,14 @@ class RpcClient:
         *,
         req_type: Optional[Type] = None,
         bulk_iovs=None,
+        timeout_s: Optional[float] = None,
     ):
         """call() with bulk riders both ways -> (rsp, reply_segments|None).
         Request `bulk_iovs` buffers are gathered into the socket without
         copies; reply segments are memoryviews over one receive buffer."""
         pending = self.start_call(addr, service_id, method_id, req, rsp_type,
-                                  req_type=req_type, bulk_iovs=bulk_iovs)
+                                  req_type=req_type, bulk_iovs=bulk_iovs,
+                                  timeout_s=timeout_s)
         return self.finish_call(pending)
 
     def start_call(
@@ -699,6 +702,7 @@ class RpcClient:
         *,
         req_type: Optional[Type] = None,
         bulk_iovs=None,
+        timeout_s: Optional[float] = None,
     ):
         """Issue the request NOW on an exclusively-leased pooled connection
         and return a pending handle for finish_call. Starting many calls
@@ -739,6 +743,11 @@ class RpcClient:
             raise FsError(Status(Code.RPC_PEER_CLOSED, f"{addr}: {e}"))
         pkt.timestamps.client_build = time.monotonic()
         conn = self._get_conn(addr)
+        if timeout_s is not None:
+            # per-call deadline: bounds every socket op of this exchange
+            # (a timeout drops the connection — the stream is mid-reply
+            # and unrecoverable); finish_call restores the pool default
+            conn.sock.settimeout(timeout_s)
         # the connection must not return to the pool until the stream is
         # known to be in sync (uuid validated in finish_call) — releasing
         # earlier would let another thread claim a connection we may still
@@ -790,6 +799,8 @@ class RpcClient:
             if reply.uuid != pkt.uuid:
                 self._drop_conn(addr, conn)
                 raise FsError(Status(Code.RPC_PEER_CLOSED, "uuid mismatch"))
+            # undo any per-call deadline before the conn rejoins the pool
+            conn.sock.settimeout(self._call_timeout)
         finally:
             if conn.lock.locked():
                 conn.lock.release()

@@ -183,6 +183,22 @@ class RegisterNodeReq:
     port: int = 0
 
 
+@dataclass
+class ServingRegisterReq:
+    """Publish/renew a KVCache serving endpoint (tpu3fs/serving) in the
+    routing snapshot's peer directory."""
+
+    node_id: int
+    host: str = ""
+    port: int = 0
+    ttl_s: float = 30.0
+
+
+@dataclass
+class ServingUnregisterReq:
+    node_id: int
+
+
 # -- storage ----------------------------------------------------------------
 #
 # Data-path methods are bulk-capable: chunk payloads ride the frame's bulk
@@ -1036,9 +1052,24 @@ def bind_mgmtd_service(server: RpcServer, mgmtd: Mgmtd) -> ServiceDef:
         )
         return Empty()
 
+    def serving_register(req: ServingRegisterReq) -> Empty:
+        mgmtd.serving_register(req.node_id, req.host, req.port,
+                               ttl_s=req.ttl_s)
+        return Empty()
+
+    def serving_unregister(req: ServingUnregisterReq) -> Empty:
+        mgmtd.serving_unregister(req.node_id)
+        return Empty()
+
     s.method(1, "heartbeat", HeartbeatReq, HeartbeatReply, heartbeat)
     s.method(2, "getRoutingInfo", RoutingReq, RoutingRsp, routing)
     s.method(3, "registerNode", RegisterNodeReq, Empty, register)
+    # 4-16 are the admin half (bind_mgmtd_admin); serving-directory ops
+    # are ForClient-role like registerNode, so they live here
+    s.method(17, "servingRegister", ServingRegisterReq, Empty,
+             serving_register)
+    s.method(18, "servingUnregister", ServingUnregisterReq, Empty,
+             serving_unregister)
     server.add_service(s)
     return s
 
@@ -1111,6 +1142,14 @@ class MgmtdRpcClient:
                       host: str = "", port: int = 0) -> None:
         self._call(3, RegisterNodeReq(node_id, int(node_type), host, port),
                    Empty)
+
+    def serving_register(self, node_id: int, host: str, port: int,
+                         ttl_s: float = 30.0) -> None:
+        self._call(17, ServingRegisterReq(node_id, host, port, ttl_s),
+                   Empty)
+
+    def serving_unregister(self, node_id: int) -> None:
+        self._call(18, ServingUnregisterReq(node_id), Empty)
 
     def heartbeat(
         self, node_id: int, hb_version: int,

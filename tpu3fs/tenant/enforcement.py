@@ -117,6 +117,8 @@ ENFORCEMENT: Dict[Tuple[str, str], str] = {
     ("Mgmtd", "migrationList"): EXEMPT,
     ("Mgmtd", "migrationClaim"): EXEMPT,
     ("Mgmtd", "migrationReport"): EXEMPT,
+    ("Mgmtd", "servingRegister"): EXEMPT,
+    ("Mgmtd", "servingUnregister"): EXEMPT,
     ("Core", "echo"): EXEMPT,
     ("Core", "renderConfig"): EXEMPT,
     ("Core", "hotUpdateConfig"): EXEMPT,
@@ -141,6 +143,19 @@ ENFORCEMENT: Dict[Tuple[str, str], str] = {
     # -- SimpleExample ----------------------------------------------------
     ("SimpleExample", "write"): BYTES,
     ("SimpleExample", "read"): BYTES,
+    # -- Serving (fleet KVCache peer-fill, tpu3fs/serving) ----------------
+    # peerRead dispatch charges IOPS only: the REQUESTER charges the
+    # peer-filled payload bytes against its own tenant with the true
+    # size (FleetKVCache._admit_peer_bytes, ops+bytes+resident gate), so
+    # every byte is charged exactly once and a peer fill can never
+    # launder a tenant's bytes through another process's quota.
+    ("Serving", "peerRead"): IOPS,
+    ("Serving", "fillClaim"): EXEMPT,       # fill-intent lease, tiny frames
+    ("Serving", "fillRelease"): EXEMPT,
+    ("Serving", "servingStats"): EXEMPT,
+    # bench/driver workload surface: the cache ops it runs charge
+    # through the normal kvcache client paths underneath
+    ("Serving", "servingLoad"): EXEMPT,
 }
 
 

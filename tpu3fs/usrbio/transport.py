@@ -64,6 +64,10 @@ RING_METHODS: Dict[Tuple[int, int], Tuple[str, str]] = {
     (3, 15): ("StorageSerde", "batchUpdate"),
     (3, 21): ("StorageSerde", "batchReadRebuild"),
     (3, 22): ("StorageSerde", "chainEncodeWrite"),
+    # fleet serving data plane: co-located peer fills skip the loopback
+    # stack (tpu3fs/serving — the serving binary binds Usrbio too, so
+    # its agent dispatches peerRead into its own Serving table)
+    (7, 1): ("Serving", "peerRead"),
 }
 
 _U32 = struct.Struct("<I")
@@ -366,6 +370,12 @@ class RingClient:
         self._sq_lock = threading.Lock()
         self._cv = threading.Condition()
         self._done: Dict[int, int] = {}
+        #: ops whose caller gave up at a per-call deadline while the op
+        #: was still in flight: userdata -> ((req_off, req_size),
+        #: (rsp_off, rsp_cap)). The agent may yet read the request and
+        #: WILL write the reply region, so both regions stay allocated
+        #: until the late CQE is reaped (freed at publish, reply dropped).
+        self._abandoned: Dict[int, tuple] = {}
         self._reaping = False
         self._next_ud = 0
         self._call_timeout = call_timeout
@@ -435,17 +445,25 @@ class RingClient:
                         rpc_ctx, t0, nbytes)
 
     # -- collect -------------------------------------------------------------
-    def finish(self, pending: _Pending):
+    def finish(self, pending: _Pending, *,
+               deadline_s: Optional[float] = None):
         """-> (rsp, reply bulk segment views | None). Reply segments alias
         this client's registered shm; their region recycles when the last
-        view dies (retainers must copy, same contract as sockets)."""
+        view dies (retainers must copy, same contract as sockets).
+
+        ``deadline_s`` bounds the wait: past it the call raises
+        RPC_TIMEOUT and the op is ABANDONED — its arena regions move to
+        ``_abandoned`` and are reclaimed when the late CQE lands, never
+        freed under an agent that may still be reading/writing them."""
         from tpu3fs.analytics import spans as _spans
 
         t_wait = time.monotonic()
         try:
-            result = self._await(pending.userdata)
-        finally:
-            self._arena.free(pending.req_off, pending.req_size)
+            result = self._await(pending.userdata, deadline_s=deadline_s)
+        except FsError as e:
+            self._give_up(pending, e)
+            raise
+        self._arena.free(pending.req_off, pending.req_size)
         rpc_ctx = pending.rpc_ctx
         if result < 0:
             self._arena.free(pending.rsp_off, pending.rsp_cap)
@@ -483,16 +501,43 @@ class RingClient:
         return rsp, bulk
 
     def call(self, service_id: int, method_id: int, req, rsp_type, *,
-             req_type=None, bulk_iovs=None, rsp_data_est: int = 0):
+             req_type=None, bulk_iovs=None, rsp_data_est: int = 0,
+             deadline_s: Optional[float] = None):
         return self.finish(self.start(
             service_id, method_id, req, rsp_type, req_type=req_type,
-            bulk_iovs=bulk_iovs, rsp_data_est=rsp_data_est))
+            bulk_iovs=bulk_iovs, rsp_data_est=rsp_data_est),
+            deadline_s=deadline_s)
 
-    def _await(self, ud: int) -> int:
+    def _give_up(self, pending: _Pending, e: FsError) -> None:
+        """Arena bookkeeping for a finish() that raised out of _await. A
+        per-call deadline expiry (RPC_TIMEOUT) abandons the in-flight op:
+        region ownership moves to the publish path. Any other failure
+        keeps the old contract (free the request; the ring is dying)."""
+        if e.code != Code.RPC_TIMEOUT:
+            self._arena.free(pending.req_off, pending.req_size)
+            return
+        with self._cv:
+            if pending.userdata in self._done:
+                # completed inside the give-up window: drop the late
+                # reply and reclaim both regions immediately
+                self._done.pop(pending.userdata)
+                self._arena.free(pending.req_off, pending.req_size)
+                self._arena.free(pending.rsp_off, pending.rsp_cap)
+            else:
+                self._abandoned[pending.userdata] = (
+                    (pending.req_off, pending.req_size),
+                    (pending.rsp_off, pending.rsp_cap))
+
+    def _await(self, ud: int, *, deadline_s: Optional[float] = None) -> int:
         """Wait for `ud`'s CQE. Many threads may wait concurrently: one of
         them at a time plays reaper (semaphore wait + reap + publish),
-        the rest sleep on the condition."""
-        deadline = time.monotonic() + self._call_timeout
+        the rest sleep on the condition. A caller ``deadline_s`` raises
+        RPC_TIMEOUT (the op stays in flight — finish() abandons it);
+        the default call timeout raises USRBIO_AGENT_GONE as before."""
+        timeout = self._call_timeout if deadline_s is None else deadline_s
+        code = (Code.USRBIO_AGENT_GONE if deadline_s is None
+                else Code.RPC_TIMEOUT)
+        deadline = time.monotonic() + timeout
         while True:
             with self._cv:
                 while True:
@@ -504,17 +549,17 @@ class RingClient:
                     if not self._reaping:
                         self._reaping = True
                         break
-                    if not self._cv.wait(timeout=0.2) \
+                    left = deadline - time.monotonic()
+                    if not self._cv.wait(
+                            timeout=min(0.2, max(0.001, left))) \
                             and time.monotonic() > deadline:
                         raise FsError(Status(
-                            Code.USRBIO_AGENT_GONE,
-                            f"no completion in {self._call_timeout}s"))
+                            code, f"no completion in {timeout}s"))
             try:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise FsError(Status(
-                        Code.USRBIO_AGENT_GONE,
-                        f"no completion in {self._call_timeout}s"))
+                        code, f"no completion in {timeout}s"))
                 self.ring.complete_sem.wait(timeout=min(0.2, remaining))
                 cqes = self.ring.reap()
             except (FsError, ValueError, OSError) as e:
@@ -534,7 +579,13 @@ class RingClient:
                 self._reaping = False
                 if cqes:
                     for result, u in cqes:
-                        self._done[u] = result
+                        regions = self._abandoned.pop(u, None)
+                        if regions is not None:
+                            # the caller left at its deadline: reclaim
+                            for off, size in regions:
+                                self._arena.free(off, size)
+                        else:
+                            self._done[u] = result
                 self._cv.notify_all()
 
     def close(self) -> None:
