@@ -352,6 +352,36 @@ class TestRunnerAndSearch:
         assert not run_schedule(shrunk).violated, \
             "shrunk serving schedule must be green on the fixed tree"
 
+    def test_metashard_sidecar_green_and_orphan_bug_caught(self):
+        """spec.meta_shard rides the metashard sidecar (cross-partition
+        two-phase renames, the resolver racing a recycled src name under
+        an armed fault plane): the clean tree stays green — the inode
+        guard protects every recreated name — and the planted
+        rename_orphan_intent bug (the unguarded roll-forward) is found
+        by the seeded search within a bounded budget and shrinks (the
+        loop that produced tests/chaos_seeds/rename_orphan_intent_seed6
+        .json)."""
+        spec = ScheduleSpec(steps=20, events=6, storage_nodes=2,
+                            num_chains=1, meta_shard=True,
+                            allow_kill=False, allow_config_push=False,
+                            fault_prob_min=0.5)
+        r = run_schedule(generate_schedule(3, spec))
+        byname = {o.checker: o.status for o in r.outcomes}
+        assert byname["meta_intents"] == "passed", r.summary()
+        bugs.arm("rename_orphan_intent")
+        try:
+            report, tried = search_violations(spec, base_seed=0,
+                                              max_seeds=12)
+            assert report is not None, "bug not found within 12 seeds"
+            assert "meta_intents" in report.violated_checkers
+            shrunk, _ = shrink_schedule(report.schedule)
+            assert len(shrunk.events) <= len(report.schedule.events)
+            assert run_schedule(shrunk).violated
+        finally:
+            bugs.disarm()
+        assert not run_schedule(shrunk).violated, \
+            "shrunk metashard schedule must be green on the fixed tree"
+
     def test_save_and_replay_round_trip(self, tmp_path):
         bugs.arm("commit_skip")
         report, _ = search_violations(SMALL, base_seed=0, max_seeds=16)

@@ -55,6 +55,48 @@ class TestKVCacheClient:
         assert [out[i] == blobs[k] for i, k in enumerate(blobs)] == [True] * 4
         assert out[4] is None and out[5] is None
 
+    def test_batch_put_batches_dir_creates(self, cache):
+        """The drain's directory fan-in: batch_put issues ONE batch_mkdirs
+        round trip for all uncached parents (fanned per meta partition by
+        a routed client) and ZERO per-item mkdirs — round-trip accounting
+        for the meta-bound half of the write-back flush."""
+        fab, c = cache
+        meta = fab.meta
+        mk_calls, bm_calls = [], []
+        real_mkdirs, real_bm = meta.mkdirs, meta.batch_mkdirs
+
+        def spy_mkdirs(*a, **kw):
+            mk_calls.append(a)
+            return real_mkdirs(*a, **kw)
+
+        def spy_bm(paths, *a, **kw):
+            bm_calls.append(len(list(paths)))
+            return real_bm(paths, *a, **kw)
+
+        meta.mkdirs, meta.batch_mkdirs = spy_mkdirs, spy_bm
+        try:
+            items = [(f"bm{i}/l{j}", bytes([i]) * 256)
+                     for i in range(8) for j in range(2)]
+            c.batch_put(items)
+        finally:
+            meta.mkdirs, meta.batch_mkdirs = real_mkdirs, real_bm
+        from tpu3fs.kvcache.layout import shard_path
+        nparents = len({shard_path(c.root, k).rsplit("/", 1)[0]
+                        for k, _ in items})
+        assert bm_calls == [nparents]
+        assert mk_calls == []          # no per-item round trips
+        # a second drain over the SAME keys skips the RPC entirely
+        meta.batch_mkdirs = spy_bm
+        try:
+            c.batch_put([(k, b"z" * 64) for k, _ in items[:8]])
+        finally:
+            meta.batch_mkdirs = real_bm
+        assert bm_calls == [nparents]  # parents cached: no new call
+        for k, v in items[8:]:
+            assert c.get(k) == v
+        for k, _ in items[:8]:
+            assert c.get(k) == b"z" * 64
+
     def test_array_roundtrip_bf16_like(self, cache):
         _, c = cache
         # decoder-layer KV block: [2(kv), heads, tokens, head_dim] f16

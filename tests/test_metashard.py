@@ -1,0 +1,573 @@
+"""Partitioned metadata plane tests (tpu3fs/metashard + the routing
+surfaces it touches): partition math, the ownership fence, the two-phase
+cross-partition rename/hardlink crash matrix, the planted
+rename_orphan_intent bug both ways, client partition routing with
+per-partition batch fan-out, mgmtd partition assignment, tenant binding
+through the meta auth layer, and the admin CLI's meta-partitions view
+(docs/metashard.md, docs/tenancy.md)."""
+
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from tpu3fs.chaos import bugs
+from tpu3fs.core.user import UserStore
+from tpu3fs.kv import MemKVEngine
+from tpu3fs.meta.store import ROOT_USER, ChainAllocator
+from tpu3fs.metashard import metrics as ms_metrics
+from tpu3fs.metashard.partition import (
+    DEFAULT_PARTITIONS,
+    parent_dir,
+    partition_of_dir,
+    partition_of_inode,
+    partition_of_path,
+    partition_tag,
+)
+from tpu3fs.metashard.store import ShardedMetaStore
+from tpu3fs.metashard.twophase import list_intents, list_prepares
+from tpu3fs.mgmtd import Mgmtd, MgmtdConfig, NodeType
+from tpu3fs.mgmtd.types import MetaPartition
+from tpu3fs.rpc.net import RpcServer
+from tpu3fs.rpc.services import MetaRpcClient, bind_meta_service
+from tpu3fs.tenant import tenant_scope
+from tpu3fs.utils.fault_injection import plane
+from tpu3fs.utils.result import Code, FsError
+
+NPARTS = 4
+
+
+def sharded(engine=None, **kw):
+    return ShardedMetaStore(engine or MemKVEngine(),
+                            ChainAllocator(1, [901, 902]),
+                            nparts=NPARTS, **kw)
+
+
+def two_dirs(store):
+    """Two directories whose contents hash to DIFFERENT partitions."""
+    a = "/pa"
+    pa = store.pid_of_dir(a)
+    b = next(f"/pb{i}" for i in range(64)
+             if store.pid_of_dir(f"/pb{i}") != pa)
+    store.mkdirs(a, ROOT_USER, recursive=True)
+    store.mkdirs(b, ROOT_USER, recursive=True)
+    return a, b
+
+
+class TestPartitionMath:
+    def test_stable_and_in_range(self):
+        for nparts in (1, 4, DEFAULT_PARTITIONS):
+            for path in ("/a", "/a/b/c", "/x/../a/b", "//a//b/"):
+                p = partition_of_path(path, nparts)
+                assert 0 <= p < nparts
+                assert p == partition_of_path(path, nparts)  # pure
+
+    def test_siblings_share_parent_partition(self):
+        # every name under one dir -> one partition (one owner serializes
+        # racing mutations of the same dirent)
+        assert (partition_of_path("/d/x", NPARTS)
+                == partition_of_path("/d/y", NPARTS)
+                == partition_of_dir("/d", NPARTS))
+        assert parent_dir("/d/x") == "/d"
+        assert parent_dir("/top") == "/"
+
+    def test_normalization_agrees(self):
+        assert (partition_of_path("/a/./b//c", NPARTS)
+                == partition_of_path("/a/b/c", NPARTS))
+        assert (partition_of_path("/a/up/../b", NPARTS)
+                == partition_of_path("/a/b", NPARTS))
+
+    def test_partition_tag_roundtrip(self):
+        for pid in range(NPARTS):
+            ino = partition_tag(pid) | 12345
+            assert partition_of_inode(ino, NPARTS) == pid
+        # legacy (untagged) ids still route deterministically
+        assert partition_of_inode(7, NPARTS) == 7 % NPARTS
+
+    def test_create_allocates_pid_tagged_inode(self):
+        st = sharded()
+        a, b = two_dirs(st)
+        for d in (a, b):
+            ino = st.create(f"{d}/f", ROOT_USER).inode
+            assert st.pid_of_inode(ino.id) == st.pid_of_dir(d)
+
+
+class TestOwnershipFence:
+    def test_unowned_partition_fenced_retryable(self):
+        eng = MemKVEngine()
+        seed = sharded(eng)
+        a, b = two_dirs(seed)
+        pa = seed.pid_of_dir(a)
+        st = sharded(eng, owner_view=lambda: {pa})
+        st.create(f"{a}/ok", ROOT_USER)  # owned: passes
+        before = ms_metrics.wrong_partition._value
+        with pytest.raises(FsError) as ei:
+            st.create(f"{b}/nope", ROOT_USER)
+        assert ei.value.code == Code.META_WRONG_PARTITION
+        assert ei.value.status.retryable()
+        assert ms_metrics.wrong_partition._value == before + 1
+
+    def test_no_owner_view_owns_everything(self):
+        st = sharded()
+        a, b = two_dirs(st)
+        assert st.owned_partitions() is None
+        st.create(f"{a}/x", ROOT_USER)
+        st.create(f"{b}/y", ROOT_USER)
+
+    def test_load_accounting_drains(self):
+        st = sharded()
+        a, _ = two_dirs(st)
+        st.snapshot_loads()
+        st.create(f"{a}/f", ROOT_USER)
+        st.stat(f"{a}/f", ROOT_USER)
+        loads = st.snapshot_loads()
+        assert loads.get(st.pid_of_dir(a), 0) >= 2
+        assert st.snapshot_loads() == {}  # drained
+
+
+def no_dangling(st):
+    return not list_intents(st.engine) and not list_prepares(st.engine)
+
+
+def crash_rename(st, src, dst, phase):
+    """Drive a cross-partition rename into a coordinator crash at one
+    phase boundary via the process fault plane."""
+    plane().configure(f"point=meta.twophase.{phase},kind=error,times=1")
+    try:
+        with pytest.raises(FsError):
+            st.rename(src, dst, ROOT_USER)
+    finally:
+        plane().clear()
+
+
+class TestTwoPhaseCrashMatrix:
+    @pytest.fixture
+    def st(self):
+        return sharded()
+
+    def test_clean_cross_partition_rename(self, st):
+        a, b = two_dirs(st)
+        ino = st.create(f"{a}/f", ROOT_USER).inode.id
+        assert st.pid_of_path(f"{a}/f") != st.pid_of_path(f"{b}/g")
+        st.rename(f"{a}/f", f"{b}/g", ROOT_USER)
+        assert st.stat(f"{b}/g", ROOT_USER).id == ino
+        with pytest.raises(FsError):
+            st.stat(f"{a}/f", ROOT_USER)
+        assert no_dangling(st)
+
+    def test_crash_after_intent_aborts(self, st):
+        a, b = two_dirs(st)
+        ino = st.create(f"{a}/f", ROOT_USER).inode.id
+        crash_rename(st, f"{a}/f", f"{b}/g", "intent")
+        assert len(list_intents(st.engine)) == 1
+        assert st.resolve_intents(force=True) == 1
+        # intent-only: abort -- src keeps its name, dst never appears
+        assert st.stat(f"{a}/f", ROOT_USER).id == ino
+        with pytest.raises(FsError):
+            st.stat(f"{b}/g", ROOT_USER)
+        assert no_dangling(st)
+
+    def test_crash_after_prepare_rolls_forward(self, st):
+        a, b = two_dirs(st)
+        ino = st.create(f"{a}/f", ROOT_USER).inode.id
+        crash_rename(st, f"{a}/f", f"{b}/g", "prepared")
+        assert len(list_prepares(st.engine)) == 1
+        assert st.resolve_intents(force=True) >= 1
+        # prepared: the dst dirent is durable -- roll forward
+        assert st.stat(f"{b}/g", ROOT_USER).id == ino
+        with pytest.raises(FsError):
+            st.stat(f"{a}/f", ROOT_USER)
+        assert no_dangling(st)
+
+    def test_crash_after_commit_clears_litter(self, st):
+        a, b = two_dirs(st)
+        ino = st.create(f"{a}/f", ROOT_USER).inode.id
+        crash_rename(st, f"{a}/f", f"{b}/g", "committed")
+        # committed: the namespace already moved; only the prepare
+        # record is litter
+        assert not list_intents(st.engine)
+        assert len(list_prepares(st.engine)) == 1
+        assert st.stat(f"{b}/g", ROOT_USER).id == ino
+        assert st.resolve_intents(force=True) == 1
+        assert no_dangling(st)
+
+    def test_resolver_is_idempotent(self, st):
+        a, b = two_dirs(st)
+        st.create(f"{a}/f", ROOT_USER)
+        crash_rename(st, f"{a}/f", f"{b}/g", "prepared")
+        assert st.resolve_intents(force=True) >= 1
+        assert st.resolve_intents(force=True) == 0
+        assert no_dangling(st)
+
+    def test_deadline_gates_live_coordinator(self, st):
+        # without force, an unexpired intent is the live coordinator's
+        # business -- the resolver must leave it alone
+        a, b = two_dirs(st)
+        st.create(f"{a}/f", ROOT_USER)
+        crash_rename(st, f"{a}/f", f"{b}/g", "prepared")
+        assert st.resolve_intents() == 0  # deadline not passed
+        assert st.resolve_intents(force=True) >= 1
+
+
+class TestPlantedOrphanBug:
+    def test_guard_spares_recycled_name_and_bug_orphans_it(self):
+        st = sharded()
+        a, b = two_dirs(st)
+        src, dst = f"{a}/f", f"{b}/g"
+        old = st.create(src, ROOT_USER).inode.id
+        crash_rename(st, src, dst, "prepared")
+        # recycle the src name before the resolver runs -- a fresh inode
+        # now lives at (src_parent, src_name)
+        st.remove(src, ROOT_USER)
+        fresh = st.create(src, ROOT_USER).inode.id
+        assert fresh != old
+        # guarded roll-forward: the recreated name survives
+        assert st.resolve_intents(force=True) >= 1
+        assert st.stat(src, ROOT_USER).id == fresh
+        assert st.stat(dst, ROOT_USER).id == old
+        # replant the crash and run the resolver with the planted bug:
+        # the unguarded replay clears the recreated name (orphaned inode)
+        crash_rename(st, src, f"{b}/g2", "prepared")
+        st.remove(src, ROOT_USER)
+        fresh2 = st.create(src, ROOT_USER).inode.id
+        plane().configure("point=never.fires,kind=error")  # fault-ok: only arms the plane
+        bugs.arm("rename_orphan_intent")
+        try:
+            assert st.resolve_intents(force=True) >= 1
+        finally:
+            bugs.disarm()
+            plane().clear()
+        with pytest.raises(FsError):
+            st.stat(src, ROOT_USER)  # fresh2 orphaned by the bug
+        assert st.stat(f"{b}/g2", ROOT_USER).id == fresh
+        assert fresh2 != fresh
+
+
+class TestCrossPartitionHardlink:
+    def test_hardlink_bumps_nlink_across_partitions(self):
+        st = sharded()
+        a, b = two_dirs(st)
+        src, dst = f"{a}/f", f"{b}/lnk"
+        ino = st.create(src, ROOT_USER).inode.id
+        assert st.pid_of_path(src) != st.pid_of_path(dst)
+        got = st.hard_link(src, dst, ROOT_USER)
+        assert got.id == ino and got.nlink == 2
+        assert st.stat(dst, ROOT_USER).id == ino
+        assert no_dangling(st)
+
+    def test_hardlink_crash_after_intent_undoes_nlink(self):
+        st = sharded()
+        a, b = two_dirs(st)
+        src, dst = f"{a}/f", f"{b}/lnk"
+        st.create(src, ROOT_USER)
+        plane().configure("point=meta.twophase.prepared,kind=error,times=1")
+        try:
+            with pytest.raises(FsError):
+                st.hard_link(src, dst, ROOT_USER)
+        finally:
+            plane().clear()
+        assert st.resolve_intents(force=True) >= 1
+        # rolled forward (prepare was durable): both names, nlink 2 -- or
+        # the abort path undid the bump; either way zero dangling records
+        # and the src name intact
+        assert st.stat(src, ROOT_USER).nlink in (1, 2)
+        assert no_dangling(st)
+
+
+class FakeMgmtd:
+    """routing()/refresh_routing()/invalidate_routing() shim: a partition
+    table the test mutates to simulate staleness + refresh."""
+
+    def __init__(self, table):
+        self.table = dict(table)      # pid -> (host, port) or None
+        self.on_refresh = None
+        self.refreshes = 0
+
+    def routing(self):
+        return self
+
+    def meta_owner(self, pid):
+        addr = self.table.get(pid)
+        if addr is None:
+            return None
+        return SimpleNamespace(host=addr[0], port=addr[1])
+
+    def invalidate_routing(self):
+        pass
+
+    def refresh_routing(self):
+        self.refreshes += 1
+        if self.on_refresh is not None:
+            self.on_refresh(self)
+
+
+@pytest.fixture
+def split_cluster():
+    """Two meta servers over ONE shared KV, each owning half the
+    partitions -- the metashard deployment shape, in-process."""
+    eng = MemKVEngine()
+    seed = sharded(eng)
+    a, b = two_dirs(seed)
+    pa, pb = seed.pid_of_dir(a), seed.pid_of_dir(b)
+    own_a = {p for p in range(NPARTS) if p % 2 == pa % 2}
+    if pb in own_a:  # force a and b onto different servers
+        own_a = {pa}
+    own_b = set(range(NPARTS)) - own_a
+    servers = {}
+    for name, view in (("A", own_a), ("B", own_b)):
+        st = sharded(eng, owner_view=lambda v=view: v)
+        srv = RpcServer()
+        bind_meta_service(srv, st)
+        srv.start()
+        servers[name] = (srv, st)
+    yield SimpleNamespace(dirs=(a, b), pids=(pa, pb),
+                          owners={**{p: "A" for p in own_a},
+                                  **{p: "B" for p in own_b}},
+                          servers=servers)
+    for srv, _ in servers.values():
+        srv.stop()
+
+
+class TestMetaRpcRouting:
+    def addr(self, cl, name):
+        return cl.servers[name][0].address
+
+    def table(self, cl):
+        return {p: self.addr(cl, n) for p, n in cl.owners.items()}
+
+    def test_owner_first_routing(self, split_cluster):
+        cl = split_cluster
+        a, b = cl.dirs
+        # ladder knows ONLY server A; the table routes b's partition to
+        # its owner B -- success proves the owner-first path was taken
+        mc = MetaRpcClient([self.addr(cl, "A")],
+                           mgmtd=FakeMgmtd(self.table(cl)), nparts=NPARTS)
+        ino = mc.create(f"{b}/f1").inode
+        assert partition_of_inode(ino.id, NPARTS) == cl.pids[1]
+        assert mc.stat(f"{b}/f1").id == ino.id
+
+    def test_stale_table_refresh_redirect(self, split_cluster):
+        cl = split_cluster
+        _, b = cl.dirs
+        pb = cl.pids[1]
+        stale = dict(self.table(cl))
+        wrong = self.addr(cl, "A") if cl.owners[pb] == "B" \
+            else self.addr(cl, "B")
+        stale[pb] = wrong  # points at the NON-owner
+        fm = FakeMgmtd(stale)
+        good = self.table(cl)
+
+        def fix(m):
+            m.table = dict(good)
+        fm.on_refresh = fix
+        # ladder also only knows the wrong server: the op can only
+        # succeed by refreshing the table and retrying the new owner
+        mc = MetaRpcClient([wrong], mgmtd=fm, nparts=NPARTS)
+        mc.create(f"{b}/f2")
+        assert fm.refreshes >= 1
+
+    def test_ladder_converges_without_table(self, split_cluster):
+        cl = split_cluster
+        _, b = cl.dirs
+        # empty table: owner unknown -- non-owners answer retryable
+        # WRONG_PARTITION and the failover ladder walks to the owner
+        mc = MetaRpcClient([self.addr(cl, "A"), self.addr(cl, "B")],
+                           mgmtd=FakeMgmtd({}), nparts=NPARTS)
+        ino = mc.create(f"{b}/f3").inode
+        assert mc.stat(f"{b}/f3").id == ino.id
+
+    def test_batch_fans_per_partition_and_merges_in_order(
+            self, split_cluster):
+        cl = split_cluster
+        a, b = cl.dirs
+        mc = MetaRpcClient([self.addr(cl, "A"), self.addr(cl, "B")],
+                           mgmtd=FakeMgmtd(self.table(cl)), nparts=NPARTS)
+        for _, st in cl.servers.values():
+            st.snapshot_loads()
+        paths = [f"{a}/d0", f"{b}/d1", f"{a}/d2", f"{b}/d3"]
+        out = mc.batch_mkdirs(paths)
+        assert len(out) == len(paths)
+        for path, ino in zip(paths, out):
+            # merged back in request order: each inode carries the tag of
+            # ITS path's partition
+            assert (partition_of_inode(ino.id, NPARTS)
+                    == partition_of_path(path, NPARTS))
+        # both servers did work (the batch really fanned out)
+        for name, (_, st) in cl.servers.items():
+            assert st.snapshot_loads(), f"server {name} saw no ops"
+
+    def test_by_inode_op_routes_on_id_tag(self, split_cluster):
+        cl = split_cluster
+        _, b = cl.dirs
+        mc = MetaRpcClient([self.addr(cl, "A")],
+                           mgmtd=FakeMgmtd(self.table(cl)), nparts=NPARTS)
+        r = mc.create(f"{b}/f4")
+        got = mc.batch_stat([r.inode.id])
+        assert got[0] is not None and got[0].id == r.inode.id
+
+
+class TestTenantBinding:
+    @pytest.fixture
+    def bound(self):
+        def build(mode):
+            users = UserStore(MemKVEngine())
+            rec = users.add_user(1000, "alice", tenant="acme")
+            st = sharded()
+            srv = RpcServer()
+            bind_meta_service(srv, st, user_store=users, acl_ttl_s=0.0,
+                              tenant_mode=mode)
+            srv.start()
+            mc = MetaRpcClient([srv.address], token=rec.token)
+            return srv, mc
+        made = []
+
+        def make(mode):
+            srv, mc = build(mode)
+            made.append(srv)
+            return mc
+        yield make
+        for srv in made:
+            srv.stop()
+
+    def test_enforce_rejects_foreign_tenant(self, bound):
+        mc = bound("enforce")
+        with tenant_scope("acme"):
+            mc.mkdirs("/t1")  # declared == bound: passes
+        mc.mkdirs("/t2")      # untenanted request: passes
+        with tenant_scope("rival"), pytest.raises(FsError) as ei:
+            mc.mkdirs("/t3")
+        assert ei.value.code == Code.META_NO_PERMISSION
+
+    def test_permissive_counts_through(self, bound):
+        mc = bound("permissive")
+        before = ms_metrics.tenant_mismatch._value
+        with tenant_scope("rival"):
+            mc.mkdirs("/t4")  # compat mode: allowed, but counted
+        assert ms_metrics.tenant_mismatch._value == before + 1
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestMgmtdPartitionAssignment:
+    @pytest.fixture
+    def cluster(self):
+        eng = MemKVEngine()
+        clock = FakeClock()
+        m = Mgmtd(1, eng, MgmtdConfig(lease_length_s=60,
+                                      heartbeat_timeout_s=60,
+                                      meta_partitions=NPARTS), clock=clock)
+        m.extend_lease()
+        return m, eng, clock
+
+    def parts(self, m):
+        return m.get_routing_info().meta_partitions
+
+    def test_lazy_creation_on_first_meta_node(self, cluster):
+        m, _, _ = cluster
+        assert not self.parts(m)
+        m.tick()
+        assert not self.parts(m)  # no META node yet: no table
+        m.register_node(21, NodeType.META, "h", 9021)
+        m.heartbeat(21, 1)
+        m.tick()
+        table = self.parts(m)
+        assert sorted(table) == list(range(NPARTS))
+        assert all(r.node_id == 21 and r.epoch >= 1
+                   for r in table.values())
+
+    def test_join_rebalances_within_one(self, cluster):
+        m, _, _ = cluster
+        m.register_node(21, NodeType.META, "h", 9021)
+        m.heartbeat(21, 1)
+        m.tick()
+        before = {p: r.epoch for p, r in self.parts(m).items()}
+        m.register_node(22, NodeType.META, "h", 9022)
+        m.heartbeat(22, 1)
+        m.tick()
+        table = self.parts(m)
+        owned = {21: 0, 22: 0}
+        for r in table.values():
+            owned[r.node_id] += 1
+        assert abs(owned[21] - owned[22]) <= 1
+        # every MOVED row bumped its epoch; retained rows did not churn
+        for p, r in table.items():
+            assert r.epoch == before[p] + (1 if r.node_id == 22 else 0)
+
+    def test_death_moves_partitions_to_survivor(self, cluster):
+        m, _, clock = cluster
+        for i, nid in enumerate((21, 22)):
+            m.register_node(nid, NodeType.META, "h", 9021 + i)
+            m.heartbeat(nid, 1)
+        m.tick()
+        clock.t += 61
+        m.heartbeat(22, 2)  # 21 goes silent past T
+        m.tick()
+        table = self.parts(m)
+        assert all(r.node_id == 22 for r in table.values())
+        assert m.get_routing_info().meta_owner(0).node_id == 22
+
+    def test_heartbeat_load_report_lands_on_rows(self, cluster):
+        m, _, _ = cluster
+        m.register_node(21, NodeType.META, "h", 9021)
+        m.heartbeat(21, 1)
+        m.tick()
+        m.heartbeat(21, 2, meta_loads={0: 12.5, 1: 3.0})
+        table = self.parts(m)
+        assert table[0].load == 12.5 and table[1].load == 3.0
+
+    def test_table_persists_across_primary_failover(self, cluster):
+        m, eng, clock = cluster
+        m.register_node(21, NodeType.META, "h", 9021)
+        m.heartbeat(21, 1)
+        m.tick()
+        want = {p: (r.node_id, r.epoch) for p, r in self.parts(m).items()}
+        clock.t += 61
+        m2 = Mgmtd(2, eng, clock=clock)
+        m2.extend_lease()
+        got = {p: (r.node_id, r.epoch)
+               for p, r in m2.get_routing_info().meta_partitions.items()}
+        assert got == want
+
+
+class TestAdminCliMetaPartitions:
+    def cli(self, table):
+        from tpu3fs.cli import AdminCli
+
+        ri = SimpleNamespace(meta_partitions=table)
+        return AdminCli(SimpleNamespace(routing=lambda: ri))
+
+    def test_empty_table_says_legacy(self):
+        out = self.cli({}).run("meta-partitions")
+        assert "no meta partition table" in out
+
+    def test_rows_rendered(self):
+        table = {0: MetaPartition(0, node_id=21, epoch=2, load=3.5),
+                 1: MetaPartition(1, node_id=22, epoch=1, load=0.0)}
+        out = self.cli(table).run("meta-partitions")
+        lines = out.splitlines()
+        assert "PART" in lines[0] and "OWNER" in lines[0]
+        assert len(lines) == 3
+        assert "21" in lines[1] and "3.5" in lines[1]
+        assert "22" in lines[2]
+
+    def test_live_mgmtd_table_renders(self):
+        eng = MemKVEngine()
+        clock = FakeClock()
+        m = Mgmtd(1, eng, MgmtdConfig(lease_length_s=60,
+                                      heartbeat_timeout_s=60,
+                                      meta_partitions=NPARTS), clock=clock)
+        m.extend_lease()
+        m.register_node(21, NodeType.META, "h", 9021)
+        m.heartbeat(21, 1)
+        m.tick()
+        from tpu3fs.cli import AdminCli
+
+        out = AdminCli(SimpleNamespace(
+            routing=m.get_routing_info)).run("meta-partitions")
+        assert out.count("21") >= NPARTS

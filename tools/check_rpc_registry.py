@@ -39,6 +39,11 @@ and verifies, per deployment unit:
    worker blindly re-executes (``RESUME_REEXECUTED_METHODS`` in
    tpu3fs/migration/service.py) is bound, classified, and either
    idempotent or documented replay-safe in ``REPLAY_SAFE_MUTATIONS``.
+9. TWO-PHASE REPLAY SAFETY — every RPC the metashard crash resolver or
+   a retrying coordinator blindly re-drives (``TWOPHASE_REEXECUTED_
+   METHODS`` in tpu3fs/metashard/twophase.py) is held to the same
+   idempotent-or-replay-safe rule, and the ``meta.twophase.*``
+   coordinator-kill fault surface is registered with the chaos harness.
 
 Cross-binary service-id reuse (Kv and MonitorCollector both use 5) is
 reported as a note, not a failure — they never share a process.
@@ -546,6 +551,67 @@ def check_migration_resume(registries: List[_Registry]) -> List[str]:
     return errors
 
 
+def check_twophase_replay(registries: List[_Registry]) -> List[str]:
+    """Check 9 — two-phase meta mutations are idempotent-or-replay-safe:
+
+    the metashard crash resolver (tpu3fs/metashard/twophase.py) blindly
+    re-drives every dangling rename/hardlink after a coordinator death,
+    and coordinators re-send prepare/finish on retryable transport
+    errors — so every RPC on that path, declared in
+    ``TWOPHASE_REEXECUTED_METHODS``, must be (a) bound by some binary,
+    (b) classified in the idempotency table, and (c) either IDEMPOTENT
+    or documented in ``REPLAY_SAFE_MUTATIONS`` with the mechanism that
+    makes blind re-execution converge (the check-8 migration-resume rule
+    extended to the meta plane). Additionally the fault surface the
+    chaos harness kills coordinators at must exist: every
+    ``meta.twophase.*`` phase boundary registered in
+    chaos.schedule.FAULT_POINTS."""
+    from tpu3fs.metashard.twophase import TWOPHASE_REEXECUTED_METHODS
+    from tpu3fs.rpc.idempotency import (
+        CLASSIFICATION,
+        IDEMPOTENT,
+        REPLAY_SAFE_MUTATIONS,
+    )
+
+    errors: List[str] = []
+    bound = set()
+    for reg in registries:
+        for service in reg.services.values():
+            for m in service.methods.values():
+                bound.add((service.name, m.name))
+    if not TWOPHASE_REEXECUTED_METHODS:
+        errors.append("TWOPHASE_REEXECUTED_METHODS is empty — the "
+                      "two-phase plane declares no replay surface; "
+                      "check 9 is dead")
+    for key in sorted(TWOPHASE_REEXECUTED_METHODS):
+        svc, name = key
+        if key not in bound:
+            errors.append(
+                f"two-phase replay re-executes {svc}.{name}, which no "
+                "binary binds (stale replay registry)")
+        kind = CLASSIFICATION.get(key)
+        if kind is None:
+            errors.append(
+                f"two-phase replay re-executes unclassified {svc}.{name} "
+                "(add to tpu3fs/rpc/idempotency.py)")
+        elif kind != IDEMPOTENT and key not in REPLAY_SAFE_MUTATIONS:
+            errors.append(
+                f"two-phase replay re-executes MUTATING {svc}.{name} with "
+                "no REPLAY_SAFE_MUTATIONS entry — a crash-resolve would "
+                "double-apply it (document the guard mechanism or stop "
+                "re-executing it)")
+    try:
+        from tpu3fs.chaos.schedule import FAULT_POINTS
+    except ImportError:
+        FAULT_POINTS = ()
+    if not any(str(p).startswith("meta.twophase") for p in FAULT_POINTS):
+        errors.append(
+            "chaos FAULT_POINTS has no meta.twophase entry — the "
+            "coordinator-kill surface the crash matrix is proven at is "
+            "not searchable (add it to tpu3fs/chaos/schedule.py)")
+    return errors
+
+
 # -- driver ------------------------------------------------------------------
 
 def run_checks() -> Tuple[List[str], List[str]]:
@@ -561,6 +627,7 @@ def run_checks() -> Tuple[List[str], List[str]]:
     errors.extend(check_tenancy(registries))
     errors.extend(check_usrbio_ring(registries))
     errors.extend(check_migration_resume(registries))
+    errors.extend(check_twophase_replay(registries))
 
     # cross-binary id reuse (informational)
     by_id: Dict[int, set] = {}

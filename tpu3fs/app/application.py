@@ -507,6 +507,11 @@ class TwoPhaseApplication(ApplicationBase):
         """Storage services report per-target states; others report none."""
         return {}
 
+    def meta_partition_loads(self) -> Dict[int, float]:
+        """META services report per-partition op counts since the last
+        beat (tpu3fs/metashard load spreading); others report none."""
+        return {}
+
     def _apply_config_push(self, version: int, content: str) -> None:
         if version > self._config_version and content:
             from tpu3fs.rpc.services import _flatten
@@ -534,6 +539,7 @@ class TwoPhaseApplication(ApplicationBase):
             reply = self.mgmtd_client.heartbeat(
                 self.info.node_id, self._hb_version,
                 self.local_target_states(),
+                meta_loads=self.meta_partition_loads() or None,
             )
             self._last_mgmtd_contact = time.time()
             self._hb_fail_start = None

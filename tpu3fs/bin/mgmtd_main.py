@@ -46,6 +46,11 @@ class MgmtdAppConfig(Config):
     lease_length_s = ConfigItem(60.0, hot=True)
     heartbeat_timeout_s = ConfigItem(60.0, hot=True)
     tick_interval_s = ConfigItem(5.0, hot=True)
+    # metadata partition count (metashard/partition.py); 0 = no partition
+    # table — legacy any-op-anywhere meta servers. Cold: the table is
+    # created lazily on the first META heartbeat once a width is set,
+    # and the width is persisted with it.
+    meta_partitions = ConfigItem(0)
 
 
 class MgmtdApp(OnePhaseApplication):
@@ -73,6 +78,7 @@ class MgmtdApp(OnePhaseApplication):
         cfg = MgmtdConfig(
             lease_length_s=self.config.get("lease_length_s"),
             heartbeat_timeout_s=self.config.get("heartbeat_timeout_s"),
+            meta_partitions=int(self.config.get("meta_partitions")),
         )
         self.mgmtd = Mgmtd(self.info.node_id or 1, self.engine, cfg,
                            clock=self._clock_override or _time.time)

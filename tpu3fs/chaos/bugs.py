@@ -32,6 +32,17 @@ Known bugs:
   ``crc_oracle`` the moment a kill forces a degraded decode through the
   bad parity (or a rebuild re-materializes a data shard from it).
 
+- ``rename_orphan_intent`` — the two-phase meta bug shape: the crash
+  resolver (tpu3fs/metashard/twophase.py resolve_intents) rolls a
+  dangling rename intent FORWARD without the points-at-recorded-inode
+  guard on the src-dirent clear. A crashed coordinator leaves the
+  intent; meanwhile the src name is legitimately reused (remove +
+  create); the buggy replay then clears the NEW file's dirent — its
+  inode survives with no name (orphan) and the namespace silently
+  shrinks. Caught by the ``meta_intents`` invariant checker (post-storm
+  namespace audit: every live inode reachable, every intent resolved
+  exactly once).
+
 - ``peer_fill_stale`` — the serving-tier staleness bug shape: a peer's
   serve-through path (tpu3fs/serving/service.py _serve_through) answers
   ``peerRead`` with the raw cached-inode read WITHOUT the zero-hole
@@ -60,6 +71,7 @@ _armed: Set[str] = set(
 #: arm()/hook pair must fail loudly, not silently never fire)
 KNOWN_BUGS = frozenset({
     "commit_skip", "chain_parity_skip", "peer_fill_stale",
+    "rename_orphan_intent",
 })
 
 

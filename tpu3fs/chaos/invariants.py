@@ -41,6 +41,14 @@ Catalogue (docs/chaos.md):
                       peer serving a GC'd block must surface as a MISS
                       (the KVCACHE_STALE re-probe), never as zeros-as-KV
                       (the planted ``peer_fill_stale`` bug's shape).
+``meta_intents``      metadata two-phase convergence: after quiesce no
+                      intent/prepare record survives resolution, and
+                      every path the metashard sidecar's ACKED ops left
+                      in the namespace still resolves to its recorded
+                      inode — a stale rename intent replayed without
+                      the inode guard clears a recreated name and
+                      orphans a live file (the planted
+                      ``rename_orphan_intent`` bug's shape).
 """
 
 from __future__ import annotations
@@ -79,7 +87,9 @@ class ChaosContext:
     # read_chunk(chain_id, file_id, index) -> bytes | None (None = gone)
     read_chunk: Optional[Callable] = None
     # oracle[(chain, file_id, index)] -> admissible set of CRC32C values
-    # (last acked payload's crc, plus any unacknowledged successors)
+    # (last acked payload's crc, plus any unacknowledged successors; a
+    # None member marks a chunk with no acked write, whose absence is
+    # itself admissible)
     oracle: Dict[Tuple[int, int, int], set] = field(default_factory=dict)
     # logical writes issued per oracle chunk (exactly-once bound)
     writes_issued: Dict[Tuple[int, int, int], int] = field(
@@ -102,6 +112,10 @@ class ChaosContext:
     # bytes | None) per fleet-cache get issued against a GC race
     serving_reads: List[Tuple[str, set, Optional[bytes]]] = field(
         default_factory=list)
+    # metashard sidecar audit: () -> {"expected": {path: inode_id},
+    # "actual": {path: inode_id | None}, "dangling": int} after the
+    # quiesce-time forced resolution
+    meta_audit: Optional[Callable] = None
 
 
 _REGISTRY: Dict[str, Callable[[ChaosContext], Optional[List[Violation]]]] = {}
@@ -161,7 +175,10 @@ def _check_crc_oracle(ctx: ChaosContext):
         chain, fid, idx = key
         data = ctx.read_chunk(chain, fid, idx)
         if data is None:
-            if admissible:          # an acked write existed: loss
+            # a None member of the admissible set marks chunks that
+            # never had an ACKED write: every attempt failed with an
+            # unknown outcome, so absence is a legitimate state
+            if admissible and None not in admissible:
                 bad.append(Violation(
                     "crc_oracle",
                     f"chunk {chain}/{fid}/{idx} unreadable but has "
@@ -358,6 +375,35 @@ def _check_kvcache_stale(ctx: ChaosContext):
             f"serving get of {key!r} returned {kind} no client ever put "
             f"— a peer served a GC'd block without the staleness "
             f"re-probe (must surface as KVCACHE_STALE/miss)"))
+    return bad
+
+
+@register("meta_intents")
+def _check_meta_intents(ctx: ChaosContext):
+    if ctx.meta_audit is None:
+        return None
+    audit = ctx.meta_audit()
+    bad: List[Violation] = []
+    dangling = int(audit.get("dangling", 0))
+    if dangling:
+        bad.append(Violation(
+            "meta_intents",
+            f"{dangling} two-phase record(s) survived the quiesce "
+            f"resolution — an intent was never converged"))
+    actual = audit.get("actual", {})
+    for path, ino in sorted(audit.get("expected", {}).items()):
+        got = actual.get(path)
+        if got is None:
+            bad.append(Violation(
+                "meta_intents",
+                f"acked namespace entry {path} -> inode {ino} is gone — "
+                f"a replayed rename intent cleared a recreated name "
+                f"(orphaned inode)"))
+        elif got != ino:
+            bad.append(Violation(
+                "meta_intents",
+                f"{path} resolves to inode {got}, expected {ino} — a "
+                f"two-phase replay crossed namespaces"))
     return bad
 
 

@@ -72,6 +72,10 @@ FAULT_POINTS = (
     "storage.chain_encode",
     "rpc.dispatch",
     "rpc.send",
+    # two-phase meta coordinator phase boundaries (metashard/twophase.py
+    # .intent/.prepared/.committed) — the crash matrix docs/metashard.md
+    # proves is exactly the surface schedules must be able to hit
+    "meta.twophase",
 )
 
 #: fault kinds with the arg ranges the generator draws from
@@ -132,6 +136,10 @@ class ScheduleSpec:
     # filling over a loopback transport, with an out-of-band GC racing
     # them) so the kvcache_stale checker judges the run too
     kv_serving: bool = False
+    # run the metashard sidecar (a ShardedMetaStore doing cross-partition
+    # two-phase renames with src-name recycling racing the crash
+    # resolver) so the meta_intents checker judges the run too
+    meta_shard: bool = False
     allow_kill: bool = True
     allow_elastic: bool = False      # join/drain events (need a worker)
     allow_config_push: bool = True

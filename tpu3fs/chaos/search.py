@@ -144,10 +144,13 @@ class FabricRunner:
         self._tenants_touched = False
         self._train = None
         self._serving = None
+        self._meta = None
         if spec.train_workload:
             self._train_setup()
         if spec.kv_serving:
             self._serving_setup()
+        if spec.meta_shard:
+            self._metashard_setup()
         report = RunReport(self.schedule)
         by_step: Dict[int, List[ChaosEvent]] = {}
         for e in self.schedule.events:
@@ -164,6 +167,7 @@ class FabricRunner:
                     self._workload_op(report)
                 self._train_tick(step)
                 self._serving_tick(step)
+                self._metashard_tick(step)
                 self._background_tick()
             self._quiesce()
             ctx = self._context()
@@ -377,8 +381,11 @@ class FabricRunner:
                     self.oracle[key] = {crc}
                 else:
                     # unknown outcome: the write may have landed anywhere
-                    # down the chain — admissible until superseded
-                    self.oracle.setdefault(key, set()).add(crc)
+                    # down the chain — admissible until superseded. For a
+                    # chunk with NO acked write yet, absence is admissible
+                    # too (None sentinel): a failed create may have landed
+                    # nothing at all
+                    self.oracle.setdefault(key, {None}).add(crc)
             else:
                 report.reads += 1
                 try:
@@ -565,6 +572,101 @@ class FabricRunner:
             return
         sv["reads"].append((key, admissible, got))
 
+    # -- metashard sidecar (meta_intents checker in the SEARCH) ---------------
+    def _metashard_setup(self) -> None:
+        """A ShardedMetaStore riding the chaos run: every step creates a
+        file in one partition and two-phase renames it into another, so
+        the schedule's ``meta.twophase`` fault rules crash the
+        coordinator at real phase boundaries. A crashed rename gets its
+        src name legitimately recycled (remove + fresh create), then the
+        resolver runs while the plane is STILL ARMED — exactly the
+        window the planted ``rename_orphan_intent`` bug needs to clear
+        the recreated name. The ``meta_intents`` checker audits the
+        acked namespace after quiesce. Private in-memory KV; the only
+        nondeterminism (txn ids, timestamps) never reaches a verdict."""
+        from tpu3fs.kv.mem import MemKVEngine
+        from tpu3fs.meta.store import ROOT_USER, ChainAllocator
+        from tpu3fs.metashard.store import ShardedMetaStore
+
+        store = ShardedMetaStore(
+            MemKVEngine(), ChainAllocator(1, [901, 902]), nparts=4)
+        # two parent dirs on DIFFERENT partitions, so every rename
+        # between them crosses partitions (pure hash of the dir path —
+        # the probe loop is deterministic)
+        src_dir = "/ms/src"
+        base = store.pid_of_dir(src_dir)
+        dst_dir = next(f"/ms/dst{i}" for i in range(64)
+                       if store.pid_of_dir(f"/ms/dst{i}") != base)
+        store.mkdirs(src_dir, recursive=True)
+        store.mkdirs(dst_dir, recursive=True)
+        self._meta = {"store": store, "user": ROOT_USER,
+                      "src": src_dir, "dst": dst_dir,
+                      "expected": {}, "n": 0}
+
+    def _metashard_tick(self, step: int) -> None:
+        """One create -> cross-partition rename per step. A rename the
+        fault plane crashed mid-protocol drops its inode from the
+        expected map (the resolver decides its resting place) and its
+        src name is recycled with a NEW file; the forced resolver pass
+        then races that recycle."""
+        ms = self._meta
+        if ms is None:
+            return
+        from tpu3fs.utils.result import FsError
+
+        st, user = ms["store"], ms["user"]
+        ms["n"] += 1
+        n = ms["n"]
+        src = f"{ms['src']}/f{n:03d}"
+        dst = f"{ms['dst']}/g{n:03d}"
+        try:
+            ino = st.create(src, user).inode.id
+        except (FsError, ConnectionError):
+            return
+        ms["expected"][src] = ino
+        try:
+            st.rename(src, dst, user)
+        except (FsError, ConnectionError):
+            ms["expected"].pop(src, None)
+            try:
+                st.remove(src, user)
+            except (FsError, ConnectionError):
+                pass
+            try:
+                ms["expected"][src] = st.create(src, user).inode.id
+            except (FsError, ConnectionError):
+                pass
+        else:
+            del ms["expected"][src]
+            ms["expected"][dst] = ino
+        # force: a crashed coordinator's intents have no live driver
+        # here, and waiting out deadlines would stall the schedule
+        try:
+            st.resolve_intents(force=True)
+        except (FsError, ConnectionError):
+            pass
+
+    def _metashard_audit(self):
+        """The checker's input, computed AFTER quiesce: one honest
+        resolver pass (plane cleared — planted bugs can't fire), then
+        record count + a stat of every acked namespace entry."""
+        from tpu3fs.metashard.twophase import list_intents, list_prepares
+        from tpu3fs.utils.result import FsError
+
+        ms = self._meta
+        st, user = ms["store"], ms["user"]
+        st.resolve_intents(force=True)
+        dangling = (len(list_intents(st.engine))
+                    + len(list_prepares(st.engine)))
+        actual = {}
+        for path in ms["expected"]:
+            try:
+                actual[path] = st.stat(path, user).id
+            except FsError:
+                actual[path] = None
+        return {"expected": dict(ms["expected"]), "actual": actual,
+                "dangling": dangling}
+
     # -- quiesce + verdict ----------------------------------------------------
     def _quiesce(self) -> None:
         from tpu3fs.placement.rebalance import DRAINING_TAG
@@ -631,6 +733,8 @@ class FabricRunner:
                 node, "dump_chunkmeta", tid),
             serving_reads=(self._serving["reads"]
                            if self._serving is not None else []),
+            meta_audit=(self._metashard_audit
+                        if self._meta is not None else None),
             **train,
         )
 

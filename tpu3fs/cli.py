@@ -110,8 +110,26 @@ class AdminCli:
         ri = self.fab.routing()
         return (
             f"version {ri.version}: {len(ri.nodes)} nodes, "
-            f"{len(ri.chains)} chains, {len(ri.targets)} targets"
+            f"{len(ri.chains)} chains, {len(ri.targets)} targets, "
+            f"{len(getattr(ri, 'meta_partitions', {}) or {})} meta "
+            f"partitions"
         )
+
+    def cmd_meta_partitions(self, args: List[str]) -> str:
+        """meta-partitions — the partitioned metadata plane's ownership
+        table as mgmtd publishes it on RoutingInfo (docs/metashard.md):
+        partition id, owning META node, fencing epoch, and the owner's
+        last-reported per-partition load."""
+        ri = self.fab.routing()
+        parts = getattr(ri, "meta_partitions", None) or {}
+        if not parts:
+            return "no meta partition table published (legacy meta plane)"
+        lines = ["PART  OWNER  EPOCH  LOAD(ops/s)"]
+        for pid in sorted(parts):
+            row = parts[pid]
+            lines.append(f"{pid:<5} {row.node_id:<6} {row.epoch:<6} "
+                         f"{row.load:.1f}")
+        return "\n".join(lines)
 
     # -- topology ------------------------------------------------------------
     def cmd_create_target(self, args: List[str]) -> str:

@@ -48,6 +48,13 @@ class UserRecord:
     token: str = ""
     admin: bool = False
     root: bool = False
+    # tenant binding: the ONE tenant this user's bearer token may declare
+    # on the wire (``u1.<tenant>`` envelope token). "" = unbound — any
+    # declared tenant passes (legacy users / internal daemons). Trailing
+    # field on purpose: serde decoders default missing trailing fields,
+    # so records written before the binding existed stay readable
+    # (docs/tenancy.md "binding tenant ids to the user layer").
+    tenant: str = ""
 
     def as_user(self) -> User:
         return User(uid=self.uid, gid=self.gid,
@@ -66,11 +73,12 @@ class UserStore:
 
     def add_user(self, uid: int, name: str, *, gid: Optional[int] = None,
                  groups: Optional[List[int]] = None, admin: bool = False,
-                 root: bool = False, token: Optional[str] = None) -> UserRecord:
+                 root: bool = False, token: Optional[str] = None,
+                 tenant: str = "") -> UserRecord:
         rec = UserRecord(
             uid=uid, name=name, gid=uid if gid is None else gid,
             groups=list(groups or []), token=token or self.new_token(),
-            admin=admin, root=root,
+            admin=admin, root=root, tenant=tenant,
         )
 
         def op(txn: ITransaction) -> UserRecord:
@@ -125,6 +133,21 @@ class UserStore:
             txn.set(_user_key(uid), serialize(rec))
             txn.set(_token_key(token), struct.pack(">Q", uid))
             return token
+
+        return with_transaction(self._engine, op)
+
+    def set_tenant(self, uid: int, tenant: str) -> UserRecord:
+        """Bind (or clear, with "") the one tenant this user's token may
+        declare on the wire. Takes effect within the AclCache TTL."""
+
+        def op(txn: ITransaction) -> UserRecord:
+            raw = txn.get(_user_key(uid))
+            if raw is None:
+                raise _err(Code.META_NOT_FOUND, f"uid {uid}")
+            rec = deserialize(raw, UserRecord)
+            rec.tenant = tenant
+            txn.set(_user_key(uid), serialize(rec))
+            return rec
 
         return with_transaction(self._engine, op)
 

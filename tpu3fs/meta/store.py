@@ -372,40 +372,99 @@ class MetaStore:
         recursive: bool = False,
     ) -> Inode:
         def op(txn: ITransaction) -> Inode:
-            parts = self._split(path)
-            if not parts:
-                raise _err(Code.META_EXISTS, "/")
-            parent = self._load_inode(txn, ROOT_INODE_ID)
-            created: Optional[Inode] = None
-            for i, name in enumerate(parts):
-                last = i == len(parts) - 1
-                ent = self._load_dirent(txn, parent.id, name)
-                if ent is not None:
-                    child = self._load_inode(txn, ent.inode_id)
-                    if last:
-                        raise _err(Code.META_EXISTS, path)
-                    if not child.is_dir():
-                        raise _err(Code.META_NOT_DIRECTORY, name)
-                    parent = child
-                    continue
-                if not last and not recursive:
-                    raise _err(Code.META_NOT_FOUND, name)
-                self._check_dir_writable(parent, user)
-                child = Inode.new_dir(
-                    self._ids.allocate(), Acl(user.uid, user.gid, perm), parent.id
-                )
-                self._store_inode(txn, child)
-                self._store_dirent(
-                    txn, DirEntry(parent.id, name, child.id, InodeType.DIRECTORY)
-                )
-                parent = child
-                created = child
-            assert created is not None
-            return created
+            return self._mkdirs_in_txn(txn, path, user, perm,
+                                       recursive=recursive)
 
         result = with_transaction(self._engine, op)
         self._emit("mkdir", path, inode_id=result.id, uid=user.uid)
         return result
+
+    def _mkdirs_in_txn(
+        self,
+        txn: ITransaction,
+        path: str,
+        user: User,
+        perm: int,
+        *,
+        recursive: bool = False,
+        exist_ok: bool = False,
+    ) -> Inode:
+        """One mkdirs inside an already-open transaction — shared by
+        mkdirs() and batch_mkdirs(). All reads and permission checks
+        precede the first mutation, so a per-item FsError caught by the
+        batch leaves zero buffered writes for that item."""
+        parts = self._split(path)
+        if not parts:
+            raise _err(Code.META_EXISTS, "/")
+        parent = self._load_inode(txn, ROOT_INODE_ID)
+        created: Optional[Inode] = None
+        for i, name in enumerate(parts):
+            last = i == len(parts) - 1
+            ent = self._load_dirent(txn, parent.id, name)
+            if ent is not None:
+                child = self._load_inode(txn, ent.inode_id)
+                if last:
+                    if exist_ok and child is not None and child.is_dir():
+                        return child
+                    raise _err(Code.META_EXISTS, path)
+                if not child.is_dir():
+                    raise _err(Code.META_NOT_DIRECTORY, name)
+                parent = child
+                continue
+            if not last and not recursive:
+                raise _err(Code.META_NOT_FOUND, name)
+            self._check_dir_writable(parent, user)
+            child = Inode.new_dir(
+                self._ids.allocate(), Acl(user.uid, user.gid, perm), parent.id
+            )
+            self._store_inode(txn, child)
+            self._store_dirent(
+                txn, DirEntry(parent.id, name, child.id, InodeType.DIRECTORY)
+            )
+            parent = child
+            created = child
+        assert created is not None
+        return created
+
+    def batch_mkdirs(
+        self,
+        paths: List[str],
+        user: User = ROOT_USER,
+        perm: int = 0o755,
+        *,
+        recursive: bool = True,
+        exist_ok: bool = True,
+        txn_batch: int = 64,
+    ) -> List[object]:
+        """Ensure MANY directories in O(len/txn_batch) KV transactions
+        instead of one round trip per directory — the kvcache cold-drain
+        shape, where ``_ensure_dir`` used to pay one mkdirs RPC per
+        uncached shard directory. ``exist_ok`` returns the existing dir
+        inode instead of META_EXISTS (mkdir -p semantics). Each result is
+        an Inode or an FsError; per-item failures don't poison their
+        batch-mates, and a KV conflict retries the whole chunk via
+        with_transaction."""
+        results: List[object] = [None] * len(paths)
+        for base in range(0, len(paths), txn_batch):
+            chunk = list(enumerate(paths[base:base + txn_batch], start=base))
+
+            def op(txn: ITransaction, _chunk=chunk):
+                out = []
+                for i, p in _chunk:
+                    try:
+                        out.append((i, self._mkdirs_in_txn(
+                            txn, p, user, perm, recursive=recursive,
+                            exist_ok=exist_ok)))
+                    except FsError as e:
+                        out.append((i, e))
+                return out
+
+            for i, res in with_transaction(self._engine, op):
+                results[i] = res
+        for p, res in zip(paths, results):
+            if isinstance(res, Inode):
+                self._emit("mkdir", p, inode_id=res.id, uid=user.uid)
+        return results
 
     def _check_dir_writable(self, d: Inode, user: User) -> None:
         if not d.acl.check_user(user, PERM_W | PERM_X):

@@ -1,0 +1,36 @@
+"""Partitioned metadata plane: M stateless meta servers over the shared
+transactional KV (docs/metashard.md).
+
+- ``partition``: the pure routing math every party (client, server,
+  mgmtd, CLI) shares — directory-hash over the parent path for by-path
+  ops, partition-tagged inode ids for by-inode ops.
+- ``store``: ``ShardedMetaStore`` — ownership-fenced MetaStore facade
+  with per-partition inode allocation and the cross-partition two-phase
+  rename/hardlink coordinator.
+- ``twophase``: intent records, prepare/commit protocol and the
+  idempotent crash resolver.
+"""
+
+from tpu3fs.metashard.partition import (
+    DEFAULT_PARTITIONS,
+    partition_of_inode,
+    partition_of_path,
+    partition_tag,
+)
+from tpu3fs.metashard.store import ShardedMetaStore
+from tpu3fs.metashard.twophase import (
+    IntentRecord,
+    TwoPhaseCoordinator,
+    resolve_intents,
+)
+
+__all__ = [
+    "DEFAULT_PARTITIONS",
+    "partition_of_inode",
+    "partition_of_path",
+    "partition_tag",
+    "ShardedMetaStore",
+    "IntentRecord",
+    "TwoPhaseCoordinator",
+    "resolve_intents",
+]

@@ -29,6 +29,7 @@ from tpu3fs.mgmtd.types import (
     ChainTarget,
     LeaseInfo,
     LocalTargetState,
+    MetaPartition,
     NodeInfo,
     NodeStatus,
     NodeType,
@@ -73,6 +74,12 @@ def _serving_key(node_id: int) -> bytes:
     return KeyPrefix.SERVING.value + struct.pack(">Q", node_id)
 
 
+def _meta_part_key(partition_id: int) -> bytes:
+    # META_SERVER + "P": the persisted metadata partition table
+    # (tpu3fs/metashard) — one row per partition, like chain rows
+    return KeyPrefix.META_SERVER.value + b"P" + struct.pack(">H", partition_id)
+
+
 @dataclass
 class MgmtdConfig:
     lease_length_s: float = 60.0
@@ -80,6 +87,11 @@ class MgmtdConfig:
     # self-exit at T/2 without mgmtd contact (design_notes "Failure detection")
     heartbeat_timeout_s: float = 60.0
     new_chain_version_grace_s: float = 0.0
+    # metadata partition count (tpu3fs/metashard): the table is created
+    # lazily when the first META node connects; 0 = library default. The
+    # count is FIXED once the table exists (partition math is baked into
+    # issued inode ids), so changing this on a live cluster is ignored.
+    meta_partitions: int = 0
 
 
 @dataclass
@@ -156,6 +168,13 @@ class Mgmtd:
             ):
                 ep = deserialize(pair.value, ServingEndpoint)
                 routing.serving[ep.node_id] = ep
+            for pair in txn.get_range(
+                KeyPrefix.META_SERVER.value + b"P",
+                KeyPrefix.META_SERVER.value + b"P" + b"\xff" * 3,
+                snapshot=True,
+            ):
+                row = deserialize(pair.value, MetaPartition)
+                routing.meta_partitions[row.partition_id] = row
             configs = {}
             for pair in txn.get_range(
                 KeyPrefix.CONFIG.value, KeyPrefix.CONFIG.value + b"\xff" * 2,
@@ -721,6 +740,7 @@ class Mgmtd:
         hb_version: int,
         local_states: Optional[Dict[int, LocalTargetState]] = None,
         now: Optional[float] = None,
+        meta_loads: Optional[Dict[int, float]] = None,
     ) -> HeartbeatReply:
         """Versioned heartbeat; stale versions rejected
         (ref HeartbeatOperation.cc:36-134)."""
@@ -768,6 +788,14 @@ class Mgmtd:
                     for t in chain.targets:
                         if t.target_id == target_id:
                             t.local_state = ls
+        if meta_loads:
+            # ephemeral per-partition op-rate gauge (metashard): published
+            # on routing for the CLI/assigner, never persisted — a primary
+            # restart starts the gauges at zero like heartbeats
+            for pid, load in meta_loads.items():
+                row = self._routing.meta_partitions.get(pid)
+                if row is not None and row.node_id == node_id:
+                    row.load = float(load)
         blob = self._configs.get(node.type, ConfigBlob())
         return HeartbeatReply(
             routing_version=self._routing.version,
@@ -808,6 +836,81 @@ class Mgmtd:
                     # dead node's last heartbeat as UPTODATE
                     self._dirty_targets.add(t.target_id)
         return dead
+
+    # -- metadata partition assigner (tpu3fs/metashard) ----------------------
+    def update_meta_partitions(self, now: Optional[float] = None) -> int:
+        """Keep every metadata partition owned by an alive META node, like
+        update_chains keeps chains serving (docs/metashard.md): the table
+        is created lazily when the first META node connects; a dead
+        owner's partitions move to the least-loaded survivors (epoch
+        bump per move); a joining node pulls partitions until ownership
+        counts are balanced within one. Retained assignments never churn.
+        Persists changed rows + bumps the routing version in one
+        lease-validated transaction. Returns the number of moved rows."""
+        now = self._clock() if now is None else now
+        alive = sorted(
+            n.node_id for n in self._routing.nodes.values()
+            if n.type == NodeType.META
+            and n.status == NodeStatus.HEARTBEAT_CONNECTED)
+        if not alive and not self._routing.meta_partitions:
+            return 0
+        if not self._routing.meta_partitions:
+            # sharding is opt-in: no table unless the operator configured
+            # a width (legacy meta servers keep the any-op-anywhere shape)
+            nparts = self.config.meta_partitions
+            if not nparts:
+                return 0
+            table = {pid: MetaPartition(partition_id=pid)
+                     for pid in range(nparts)}
+        else:
+            # stage copies; memory is installed only after the txn commits
+            table = {pid: replace(row)
+                     for pid, row in self._routing.meta_partitions.items()}
+        if not alive:
+            # nobody left to own anything: keep the last assignment (the
+            # client ladder fails over; survivors pick the table back up)
+            return 0
+        owned = {nid: 0 for nid in alive}
+        for row in table.values():
+            if row.node_id in owned:
+                owned[row.node_id] += 1
+        changed = []
+        for pid in sorted(table):
+            row = table[pid]
+            if row.node_id in owned:
+                continue  # owner alive: never churn a retained assignment
+            nid = min(alive, key=lambda n: (owned[n], n))
+            owned[nid] += 1
+            row.node_id = nid
+            row.epoch += 1
+            row.load = 0.0
+            changed.append(row)
+        while True:  # join rebalance: drain the most-loaded one move at a time
+            hi = max(alive, key=lambda n: (owned[n], -n))
+            lo = min(alive, key=lambda n: (owned[n], n))
+            if owned[hi] - owned[lo] <= 1:
+                break
+            pid = min(p for p, r in table.items() if r.node_id == hi)
+            row = table[pid]
+            row.node_id = lo
+            row.epoch += 1
+            row.load = 0.0
+            owned[hi] -= 1
+            owned[lo] += 1
+            changed.append(row)
+        if not changed:
+            return 0
+
+        def op(txn: ITransaction) -> int:
+            self._ensure_primary_in_txn(txn, now)
+            for row in changed:
+                txn.set(_meta_part_key(row.partition_id), serialize(row))
+            return self._bump_routing_in_txn(txn)
+
+        ver = with_transaction(self._engine, op)
+        self._routing.meta_partitions = table
+        self._routing.version = ver
+        return len(changed)
 
     # -- chain updater (ref MgmtdChainsUpdater) ------------------------------
     def update_chains(self, now: Optional[float] = None) -> int:
@@ -913,6 +1016,10 @@ class Mgmtd:
             self._prune_serving(now)
         except FsError:
             pass  # deposed mid-tick: the new primary prunes
+        try:
+            self.update_meta_partitions(now)
+        except FsError:
+            pass  # deposed mid-tick: the new primary reassigns
         self.update_chains(now)
         self.check_newborn_chains()
         self.persist_target_infos()

@@ -154,6 +154,26 @@ class ServingEndpoint:
 
 
 @dataclass
+class MetaPartition:
+    """One metadata partition's assignment row (tpu3fs/metashard): the
+    namespace is split into a FIXED number of partitions (directory-hash
+    over the parent path for by-path ops; the partition id baked into the
+    high bits of every inode id for by-inode ops) and mgmtd assigns each
+    partition to exactly one live META node, publishing the table through
+    RoutingInfo like chain tables. ``epoch`` bumps on every ownership
+    change — a meta server fences ops against the epoch it loaded, so a
+    reassigned partition's old owner answers META_WRONG_PARTITION instead
+    of racing the new owner."""
+
+    partition_id: int
+    node_id: int = 0          # 0 = unassigned (no live meta node)
+    epoch: int = 0
+    # ops/s the owner reported for this partition on its last heartbeat
+    # (admin_cli meta-partitions' load column; informational only)
+    load: float = 0.0
+
+
+@dataclass
 class LeaseInfo:
     """Primary election record (ref MgmtdLeaseInfo.h:9-22); mutated only via
     KV compare-and-set inside a transaction (MgmtdStore::extendLease)."""
@@ -178,6 +198,18 @@ class RoutingInfo:
     # field on purpose: serde decoders default missing trailing fields, so
     # pre-serving peers interop (rpc/serde.py evolution rule)
     serving: Dict[int, ServingEndpoint] = field(default_factory=dict)
+    # metadata partition table (tpu3fs/metashard) — also trailing: decoders
+    # predating the metashard plane read an empty table and keep treating
+    # the meta plane as a single unpartitioned process
+    meta_partitions: Dict[int, MetaPartition] = field(default_factory=dict)
+
+    def meta_owner(self, partition_id: int) -> Optional[NodeInfo]:
+        """The NodeInfo currently owning one meta partition (None when
+        the table is empty or the partition is unassigned)."""
+        row = self.meta_partitions.get(partition_id)
+        if row is None or not row.node_id:
+            return None
+        return self.nodes.get(row.node_id)
 
     def chain_of_target(self, target_id: int) -> Optional[ChainInfo]:
         info = self.targets.get(target_id)

@@ -81,6 +81,13 @@ CLASSIFICATION: Dict[Tuple[str, str], str] = {
     ("MetaSerde", "batchClose"): MUTATING,
     ("MetaSerde", "batchSetAttr"): MUTATING,
     ("MetaSerde", "batchCreate"): MUTATING,
+    ("MetaSerde", "batchMkdirs"): MUTATING,
+    # two-phase participant plane (tpu3fs/metashard/twophase.py): all
+    # MUTATING for hedging purposes, all REPLAY-SAFE by construction —
+    # the crash resolver re-drives them blindly (check 9).
+    ("MetaSerde", "renamePrepare"): MUTATING,
+    ("MetaSerde", "renameFinish"): MUTATING,
+    ("MetaSerde", "renameResolve"): MUTATING,
     # -- Mgmtd ------------------------------------------------------------
     ("Mgmtd", "heartbeat"): MUTATING,   # versioned: replay rejected anyway
     ("Mgmtd", "getRoutingInfo"): IDEMPOTENT,
@@ -196,6 +203,17 @@ REPLAY_SAFE_MUTATIONS: Dict[Tuple[str, str], str] = {
         "MIGRATION_CONFLICT; the auto re-plan loop re-derives its plan "
         "from live routing, so an already-evacuated node yields an "
         "empty plan (no-op)",
+    # metashard two-phase plane (twophase.TWOPHASE_REEXECUTED_METHODS;
+    # check 9 holds each entry to this table or idempotent)
+    ("MetaSerde", "renamePrepare"): "prepare-record guard: the record is "
+        "written in the SAME txn as the effect, so a replayed prepare "
+        "sees the record and returns without re-applying",
+    ("MetaSerde", "renameFinish"): "clears the prepare record; an absent "
+        "record is an explicit no-op",
+    ("MetaSerde", "renameResolve"): "resolver mutations are guarded "
+        "(dirent cleared only while it still points at the intent's "
+        "inode; nlink undone only behind a live prepare record) — "
+        "re-resolving converges to the same state",
 }
 
 

@@ -26,6 +26,13 @@ from tpu3fs.meta.store import (
     User,
 )
 from tpu3fs.meta.types import DirEntry, Inode, Layout
+from tpu3fs.metashard.partition import (
+    DEFAULT_PARTITIONS,
+    partition_of_dir,
+    partition_of_inode,
+    partition_of_path,
+)
+from tpu3fs.metashard.twophase import IntentRecord
 from tpu3fs.mgmtd.service import HeartbeatReply, Mgmtd
 from tpu3fs.mgmtd.types import LocalTargetState, NodeType, RoutingInfo
 from tpu3fs.migration.types import MigrationJob, MoveSpec
@@ -40,6 +47,7 @@ from tpu3fs.storage.craq import (
 )
 from tpu3fs.storage.types import ChunkId, ChunkMeta, SpaceInfo
 from tpu3fs.utils.result import Code, FsError, Status
+from tpu3fs.utils.result import err as _err
 
 STORAGE_SERVICE_ID = 3     # ref fbs/storage/Service.h
 META_SERVICE_ID = 4        # ref fbs/meta/Service.h
@@ -162,6 +170,9 @@ class HeartbeatReq:
     node_id: int
     hb_version: int
     local_states: Dict[int, int] = field(default_factory=dict)
+    # per-partition op-rate gauge from META nodes (metashard) — trailing
+    # field: pre-metashard peers interop (rpc/serde.py evolution rule)
+    meta_loads: Dict[int, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -1040,7 +1051,8 @@ def bind_mgmtd_service(server: RpcServer, mgmtd: Mgmtd) -> ServiceDef:
 
     def heartbeat(req: HeartbeatReq) -> HeartbeatReply:
         states = {t: LocalTargetState(v) for t, v in req.local_states.items()}
-        return mgmtd.heartbeat(req.node_id, req.hb_version, states)
+        return mgmtd.heartbeat(req.node_id, req.hb_version, states,
+                               meta_loads=req.meta_loads or None)
 
     def routing(req: RoutingReq) -> RoutingRsp:
         ri = mgmtd.get_routing_info(req.known_version)
@@ -1154,10 +1166,12 @@ class MgmtdRpcClient:
     def heartbeat(
         self, node_id: int, hb_version: int,
         local_states: Optional[Dict[int, LocalTargetState]] = None,
+        meta_loads: Optional[Dict[int, float]] = None,
     ) -> HeartbeatReply:
         req = HeartbeatReq(
             node_id, hb_version,
             {t: int(v) for t, v in (local_states or {}).items()},
+            meta_loads=dict(meta_loads or {}),
         )
         return self._call(1, req, HeartbeatReply)
 
@@ -1441,6 +1455,58 @@ class BatchStatRsp:
 
 
 @dataclass
+class BatchMkdirsReq:
+    """Batched ensure-directory (mkdir -p semantics by default) — the
+    kvcache cold-drain shape: one RPC for every uncached shard dir
+    instead of one mkdirs round trip per directory."""
+
+    paths: List[str] = field(default_factory=list)
+    uid: int = 0
+    gid: int = 0
+    perm: int = 0o755
+    recursive: bool = True
+    exist_ok: bool = True
+    token: str = ""
+
+
+@dataclass
+class BatchMkdirsRsp:
+    # per-item inode-or-error, same shape as a batched close settle
+    results: List[BatchCloseRspItem] = field(default_factory=list)
+
+
+@dataclass
+class RenamePrepareReq:
+    """Phase B of a cross-partition rename/hardlink, sent by the
+    coordinator to the participant partition's owner
+    (tpu3fs/metashard/twophase.py). Idempotent per intent.txn_id."""
+
+    intent: "IntentRecord"
+    dst_path: str = ""
+    token: str = ""
+
+
+@dataclass
+class RenameFinishReq:
+    """Best-effort post-commit cleanup: clear the participant's prepare
+    record. Losing this RPC is harmless — the resolver clears orphan
+    prepare records whose intent is gone."""
+
+    txn_id: str = ""
+    token: str = ""
+
+
+@dataclass
+class RenameResolveReq:
+    """Admin/recovery surface: converge dangling two-phase records
+    (resolve_intents). ``force`` ignores intent deadlines — only for
+    quiesced clusters and tests."""
+
+    force: bool = False
+    token: str = ""
+
+
+@dataclass
 class StrReply:
     value: str = ""
 
@@ -1475,13 +1541,22 @@ class AuthRsp:
 
 
 def bind_meta_service(server: RpcServer, meta: MetaStore, *,
-                      user_store=None, acl_ttl_s: float = 5.0) -> None:
+                      user_store=None, acl_ttl_s: float = 5.0,
+                      tenant_mode: str = "enforce") -> None:
     """With a user_store, every op authenticates its bearer token through a
     TTL AclCache and the SERVER derives identity from the user record —
     claimed uid/gid in requests are ignored (ref UserStore + AclCache;
     MetaSerde has an authenticate method the same way). Without one,
     requests are trusted (single-tenant/dev mode, like the reference run
-    without token enforcement)."""
+    without token enforcement).
+
+    Tenant binding (docs/tenancy.md): when the authenticated user record
+    carries a nonempty ``tenant``, the wire-declared ``u1.*`` tenant must
+    match it. ``tenant_mode="enforce"`` rejects mismatches with
+    META_NO_PERMISSION; ``"permissive"`` (compat for old clients) only
+    counts them on ``meta.tenant_mismatch``. Unbound users and untenanted
+    requests always pass — enforcement bites only where an admin
+    explicitly bound a tenant."""
     s = ServiceDef(META_SERVICE_ID, "MetaSerde")
 
     acl_cache = None
@@ -1490,17 +1565,38 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
 
         acl_cache = AclCache(user_store, ttl_s=acl_ttl_s)
 
+    def _check_tenant(rec) -> None:
+        bound = getattr(rec, "tenant", "")
+        if not bound:
+            return
+        from tpu3fs.metashard import metrics as _ms_metrics
+        from tpu3fs.tenant import current_tenant
+
+        declared = current_tenant()
+        if declared is None or declared == bound:
+            return
+        _ms_metrics.tenant_mismatch.add()
+        if tenant_mode == "enforce":
+            raise _err(
+                Code.META_NO_PERMISSION,
+                f"tenant {declared!r} not bound to user {rec.name!r} "
+                f"(bound: {bound!r})")
+
+    def _auth(req):
+        rec = acl_cache.authenticate(getattr(req, "token", ""))
+        _check_tenant(rec)
+        return rec
+
     def u(req) -> User:
         if acl_cache is None:
             return User(req.uid, req.gid)
-        rec = acl_cache.authenticate(getattr(req, "token", ""))
-        return rec.as_user()
+        return _auth(req).as_user()
 
     def gate(req) -> None:
         """Session-scoped ops (statFs) carry no path identity but still
         require a valid bearer token in auth mode."""
         if acl_cache is not None:
-            acl_cache.authenticate(getattr(req, "token", ""))
+            _auth(req)
 
     def su(req) -> Optional[User]:
         """Resolved identity for session-scoped ops (sync/close/batchStat):
@@ -1508,7 +1604,7 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
         auth mode — so the store's PERM_W/PERM_R guards actually run."""
         if acl_cache is None:
             return None
-        return acl_cache.authenticate(getattr(req, "token", "")).as_user()
+        return _auth(req).as_user()
 
     def prune_session(req: PruneSessionReq) -> IntReply:
         if acl_cache is None:
@@ -1628,6 +1724,51 @@ def bind_meta_service(server: RpcServer, meta: MetaStore, *,
         return BatchCreateRsp(out)
 
     s.method(25, "batchCreate", BatchCreateReq, BatchCreateRsp, batch_create)
+
+    def batch_mkdirs(r: BatchMkdirsReq) -> BatchMkdirsRsp:
+        # directory fan-in for the kvcache drain: the per-item _ensure_dir
+        # mkdirs collapse into chunked transactions (MetaStore.batch_mkdirs)
+        out = []
+        for res in meta.batch_mkdirs(r.paths, u(r), perm=r.perm,
+                                     recursive=r.recursive,
+                                     exist_ok=r.exist_ok):
+            if isinstance(res, FsError):
+                out.append(BatchCloseRspItem(
+                    ok=False, code=int(res.code),
+                    message=res.status.message))
+            else:
+                out.append(BatchCloseRspItem(ok=True, inode=res))
+        return BatchMkdirsRsp(out)
+
+    s.method(26, "batchMkdirs", BatchMkdirsReq, BatchMkdirsRsp, batch_mkdirs)
+
+    # Two-phase participant plane (cross-partition rename/hardlink): bound
+    # only when the store is sharded. All three are replay-safe — prepare
+    # and finish are idempotent behind the prepare record, resolve converges
+    # (rpc/idempotency.py TWOPHASE rows; tools/check_rpc_registry.py check 9).
+    if hasattr(meta, "twophase_prepare"):
+        def rename_prepare(r: RenamePrepareReq) -> Empty:
+            meta.twophase_prepare(r.intent, r.dst_path, u(r))
+            return Empty()
+
+        def rename_finish(r: RenameFinishReq) -> Empty:
+            gate(r)
+            meta.twophase_finish(r.txn_id)
+            return Empty()
+
+        def rename_resolve(r: RenameResolveReq) -> IntReply:
+            if acl_cache is not None:
+                rec = _auth(r)
+                if not (rec.admin or rec.root):
+                    raise _err(Code.META_NO_PERMISSION,
+                               "renameResolve requires admin")
+            return IntReply(meta.resolve_intents(force=r.force))
+
+        s.method(27, "renamePrepare", RenamePrepareReq, Empty, rename_prepare)
+        s.method(28, "renameFinish", RenameFinishReq, Empty, rename_finish)
+        s.method(29, "renameResolve", RenameResolveReq, IntReply,
+                 rename_resolve)
+
     server.add_service(s)
 
 
@@ -1637,7 +1778,19 @@ def _open_rsp(res: OpenResult) -> OpenRsp:
 
 class MetaRpcClient:
     """Full meta API over RPC with server failover
-    (ref MetaClient.h:55-226 + ServerSelectionStrategy)."""
+    (ref MetaClient.h:55-226 + ServerSelectionStrategy).
+
+    With an ``mgmtd`` routing source (MgmtdRpcClient or anything with
+    routing()/refresh_routing()/invalidate_routing()), every op routes to
+    the OWNER of its metadata partition first (docs/metashard.md): by-path
+    ops hash the parent directory, by-inode ops read the id's partition
+    tag, and batched ops fan out per-partition in parallel, merging
+    per-item results back in request order. A META_WRONG_PARTITION answer
+    means the table is stale — refresh and retry the new owner, then fall
+    back to the failover ladder (non-owners keep answering retryable
+    WRONG_PARTITION, so the ladder converges on the owner regardless).
+    Without mgmtd the client behaves exactly as before: one server ladder,
+    one batch RPC."""
 
     def __init__(
         self,
@@ -1645,6 +1798,9 @@ class MetaRpcClient:
         client: Optional[RpcClient] = None,
         client_id: str = "",
         token: str = "",
+        *,
+        mgmtd=None,
+        nparts: int = DEFAULT_PARTITIONS,
     ):
         if not addrs:
             raise ValueError("need at least one meta server address")
@@ -1653,14 +1809,65 @@ class MetaRpcClient:
         self.client_id = client_id
         self.token = token
         self._cursor = 0
+        self._mgmtd = mgmtd
+        self.nparts = nparts
 
     def authenticate(self, token: Optional[str] = None) -> "AuthRsp":
         return self._call(18, AuthReq(self.token if token is None else token),
                           AuthRsp)
 
-    def _call(self, method_id: int, req, rsp_type):
+    # -- partition routing --------------------------------------------------
+
+    def _pid_path(self, path: str) -> Optional[int]:
+        return (partition_of_path(path, self.nparts)
+                if self._mgmtd is not None else None)
+
+    def _pid_dir(self, path: str) -> Optional[int]:
+        return (partition_of_dir(path, self.nparts)
+                if self._mgmtd is not None else None)
+
+    def _pid_inode(self, inode_id: int) -> Optional[int]:
+        return (partition_of_inode(inode_id, self.nparts)
+                if self._mgmtd is not None else None)
+
+    def _owner_addr(self, pid: int) -> Optional[Tuple[str, int]]:
+        try:
+            node = self._mgmtd.routing().meta_owner(pid)
+        except FsError:
+            return None  # mgmtd unreachable: the ladder still converges
+        if node is None or not node.host:
+            return None
+        return (node.host, node.port)
+
+    def _call(self, method_id: int, req, rsp_type, *, pid: Optional[int] = None):
         if self.token and hasattr(req, "token") and not req.token:
             req.token = self.token
+        if pid is not None and self._mgmtd is not None:
+            addr = self._owner_addr(pid)
+            if addr is not None:
+                try:
+                    return self._client.call(
+                        addr, META_SERVICE_ID, method_id, req, rsp_type)
+                except FsError as e:
+                    if not e.status.retryable():
+                        raise
+                    if e.status.code == Code.META_WRONG_PARTITION:
+                        # stale partition table: refresh, retry new owner
+                        try:
+                            self._mgmtd.invalidate_routing()
+                            self._mgmtd.refresh_routing()
+                        except FsError:
+                            pass
+                        addr2 = self._owner_addr(pid)
+                        if addr2 is not None and addr2 != addr:
+                            try:
+                                return self._client.call(
+                                    addr2, META_SERVICE_ID, method_id, req,
+                                    rsp_type)
+                            except FsError as e2:
+                                if not e2.status.retryable():
+                                    raise
+                    # fall through to the ladder
         last: Optional[FsError] = None
         for i in range(len(self._addrs)):
             addr = self._addrs[(self._cursor + i) % len(self._addrs)]
@@ -1676,6 +1883,33 @@ class MetaRpcClient:
         assert last is not None
         raise last
 
+    def _fan_batches(self, pids, items, call_one):
+        """Run one batch RPC per partition group (threads when >1 group),
+        merging per-item results back in request order. ``pids[i]`` may be
+        None (unrouted mode) — then everything goes out as one batch."""
+        items = list(items)
+        if not items:
+            return []
+        groups: Dict[Optional[int], List[Tuple[int, object]]] = {}
+        for i, (pid, it) in enumerate(zip(pids, items)):
+            groups.setdefault(pid, []).append((i, it))
+        if len(groups) == 1:
+            (pid, pairs), = groups.items()
+            return call_one(pid, [it for _, it in pairs])
+        out: List[object] = [None] * len(items)
+
+        def run(pid, pairs):
+            res = call_one(pid, [it for _, it in pairs])
+            for (i, _), r in zip(pairs, res):
+                out[i] = r
+
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(8, len(groups))) as ex:
+            for f in [ex.submit(run, pid, pairs)
+                      for pid, pairs in groups.items()]:
+                f.result()
+        return out
+
     # NOTE on `user=` below: in-process MetaStore callers pass an explicit
     # User; over RPC the server derives identity from the bearer token
     # (claimed uids are ignored in auth mode), so the kwarg is accepted
@@ -1683,28 +1917,32 @@ class MetaRpcClient:
     # dropped on the wire.
 
     def stat(self, path: str, user=None, *, follow: bool = True) -> Inode:
-        return self._call(2, PathReq(path, follow=follow), InodeRsp).inode
+        return self._call(2, PathReq(path, follow=follow), InodeRsp,
+                          pid=self._pid_path(path)).inode
 
     def create(self, path: str, **kw) -> OpenRsp:
         kw.pop("user", None)
         kw.setdefault("client_id", self.client_id)
-        return self._call(3, CreateReq(path, **kw), OpenRsp)
+        return self._call(3, CreateReq(path, **kw), OpenRsp,
+                          pid=self._pid_path(path))
 
     def mkdirs(self, path: str, user=None, perm: int = 0o755,
                *, recursive: bool = False) -> Inode:
         return self._call(4, MkdirsReq(path, perm=perm,
-                                       recursive=recursive), InodeRsp).inode
+                                       recursive=recursive), InodeRsp,
+                          pid=self._pid_path(path)).inode
 
     def remove(self, path: str, user=None, *, recursive: bool = False,
                request_id: str = "") -> None:
         self._call(7, RemoveReq(path, recursive=recursive,
-                                client_id=self.client_id, request_id=request_id), Empty)
+                                client_id=self.client_id, request_id=request_id), Empty,
+                   pid=self._pid_path(path))
 
     def open(self, path: str, flags: int = 1,
              client_id: Optional[str] = None) -> OpenRsp:
         return self._call(8, OpenReq(path, flags=flags,
                                      client_id=client_id or self.client_id),
-                          OpenRsp)
+                          OpenRsp, pid=self._pid_path(path))
 
     def close(self, inode_id: int, session_id: str,
               length_hint: Optional[int] = None,
@@ -1713,52 +1951,82 @@ class MetaRpcClient:
         w = -1 if wrote is None else int(wrote)
         return self._call(10, CloseReq(inode_id, session_id, hint,
                                        self.client_id, request_id, w),
-                          InodeRsp).inode
+                          InodeRsp, pid=self._pid_inode(inode_id)).inode
 
     def batch_create(self, items: List[BatchCreateItem],
                      user=None) -> List[object]:
         """Create many files in O(len/64) server transactions; each
         result is an OpenResult or an FsError (MetaStore parity — the
         kvcache flusher and the ckpt archiver drive either surface).
-        Items without a client_id inherit this client's."""
+        Items without a client_id inherit this client's. Routed mode fans
+        the batch per parent-dir partition in parallel."""
         items = list(items)
         for it in items:
             if not it.client_id:
                 it.client_id = self.client_id
-        rsp = self._call(25, BatchCreateReq(items), BatchCreateRsp)
-        out: List[object] = []
-        for r in rsp.results:
-            if r.ok:
-                out.append(OpenResult(r.inode, r.session_id))
-            else:
-                out.append(FsError(Status(Code(r.code), r.message)))
-        return out
+
+        def one(pid, sub):
+            rsp = self._call(25, BatchCreateReq(sub), BatchCreateRsp, pid=pid)
+            return [OpenResult(r.inode, r.session_id) if r.ok
+                    else FsError(Status(Code(r.code), r.message))
+                    for r in rsp.results]
+
+        return self._fan_batches(
+            [self._pid_path(it.path) for it in items], items, one)
 
     def batch_close(self, items: List[BatchCloseItem]) -> List[object]:
         """Settle many sessions in O(len/64) server transactions; each
         result is an Inode or an FsError (per-item failures don't poison
         batch-mates). Ref BatchOperation.cc:750."""
-        rsp = self._call(23, BatchCloseReq(items), BatchCloseRsp)
-        out: List[object] = []
-        for r in rsp.results:
-            if r.ok:
-                out.append(r.inode)
-            else:
-                out.append(FsError(Status(Code(r.code), r.message)))
-        return out
+        items = list(items)
+
+        def one(pid, sub):
+            rsp = self._call(23, BatchCloseReq(sub), BatchCloseRsp, pid=pid)
+            return [r.inode if r.ok
+                    else FsError(Status(Code(r.code), r.message))
+                    for r in rsp.results]
+
+        return self._fan_batches(
+            [self._pid_inode(it.inode_id) for it in items], items, one)
+
+    def batch_mkdirs(self, paths: List[str], user=None, perm: int = 0o755,
+                     *, recursive: bool = True,
+                     exist_ok: bool = True) -> List[object]:
+        """Make many directories in O(len/64) server transactions; each
+        result is an Inode or an FsError. The kvcache drain's _ensure_dir
+        fan-in (one RPC per partition instead of one per directory)."""
+        paths = list(paths)
+
+        def one(pid, sub):
+            rsp = self._call(
+                26, BatchMkdirsReq(sub, perm=perm, recursive=recursive,
+                                   exist_ok=exist_ok),
+                BatchMkdirsRsp, pid=pid)
+            return [r.inode if r.ok
+                    else FsError(Status(Code(r.code), r.message))
+                    for r in rsp.results]
+
+        return self._fan_batches(
+            [self._pid_path(p) for p in paths], paths, one)
 
     def symlink(self, path: str, target: str) -> Inode:
-        return self._call(5, SymlinkReq(path, target), InodeRsp).inode
+        return self._call(5, SymlinkReq(path, target), InodeRsp,
+                          pid=self._pid_path(path)).inode
 
     def hard_link(self, src: str, dst: str) -> Inode:
-        return self._call(6, HardLinkReq(src, dst), InodeRsp).inode
+        # dst's owner coordinates the cross-partition protocol
+        # (docs/metashard.md: the link lands on dst's partition)
+        return self._call(6, HardLinkReq(src, dst), InodeRsp,
+                          pid=self._pid_path(dst)).inode
 
     def sync(self, inode_id: int, length_hint: Optional[int] = None) -> Inode:
         hint = -1 if length_hint is None else length_hint
-        return self._call(9, SyncReq(inode_id, hint), InodeRsp).inode
+        return self._call(9, SyncReq(inode_id, hint), InodeRsp,
+                          pid=self._pid_inode(inode_id)).inode
 
     def truncate(self, path: str, length: int) -> Inode:
-        return self._call(13, TruncateReq(path, length), InodeRsp).inode
+        return self._call(13, TruncateReq(path, length), InodeRsp,
+                          pid=self._pid_path(path)).inode
 
     def set_attr(self, path: str, *, perm: Optional[int] = None,
                  uid: Optional[int] = None, gid: Optional[int] = None,
@@ -1774,33 +2042,50 @@ class MetaRpcClient:
             has_atime=atime is not None,
             has_mtime=mtime is not None,
         )
-        return self._call(15, req, InodeRsp).inode
+        return self._call(15, req, InodeRsp, pid=self._pid_path(path)).inode
 
     def batch_set_attr(self, paths: Optional[List[str]] = None, user=None,
                        *, inode_ids: Optional[List[int]] = None,
                        atime: Optional[float] = None,
                        mtime: Optional[float] = None) -> List[object]:
-        """Touch many inodes' times in one RPC, by path or walk-free by
-        inode id (MetaStore parity: each result is an Inode or an
-        FsError; per-item failures don't poison batch-mates)."""
-        req = BatchSetAttrReq(
-            list(paths or []), list(inode_ids or []),
-            atime=atime or 0.0, mtime=mtime or 0.0,
-            has_atime=atime is not None, has_mtime=mtime is not None)
-        rsp = self._call(24, req, BatchSetAttrRsp)
-        out: List[object] = []
-        for r in rsp.results:
-            if r.ok:
-                out.append(r.inode)
-            else:
-                out.append(FsError(Status(Code(r.code), r.message)))
-        return out
+        """Touch many inodes' times in one RPC (per partition), by path or
+        walk-free by inode id (MetaStore parity: each result is an Inode
+        or an FsError; per-item failures don't poison batch-mates)."""
+        kw = dict(atime=atime or 0.0, mtime=mtime or 0.0,
+                  has_atime=atime is not None, has_mtime=mtime is not None)
+
+        def unpack(rsp):
+            return [r.inode if r.ok
+                    else FsError(Status(Code(r.code), r.message))
+                    for r in rsp.results]
+
+        if inode_ids is not None:
+            def one(pid, sub):
+                return unpack(self._call(
+                    24, BatchSetAttrReq([], list(sub), **kw),
+                    BatchSetAttrRsp, pid=pid))
+
+            return self._fan_batches(
+                [self._pid_inode(i) for i in inode_ids], inode_ids, one)
+
+        def one(pid, sub):
+            return unpack(self._call(
+                24, BatchSetAttrReq(list(sub), [], **kw),
+                BatchSetAttrRsp, pid=pid))
+
+        return self._fan_batches(
+            [self._pid_path(p) for p in (paths or [])], paths or [], one)
 
     def prune_session(self, client_id: str) -> int:
         return self._call(16, PruneSessionReq(client_id), IntReply).value
 
     def batch_stat(self, inode_ids: List[int]) -> List[Optional[Inode]]:
-        return self._call(17, BatchStatReq(list(inode_ids)), BatchStatRsp).inodes
+        def one(pid, sub):
+            return self._call(17, BatchStatReq(list(sub)),
+                              BatchStatRsp, pid=pid).inodes
+
+        return self._fan_batches(
+            [self._pid_inode(i) for i in inode_ids], inode_ids, one)
 
     def batch_stat_by_path(self, paths: List[str]) -> List[Optional[Inode]]:
         """Missing/forbidden paths come back as None (MetaStore parity —
@@ -1815,11 +2100,14 @@ class MetaRpcClient:
         return out
 
     def rename(self, src: str, dst: str, user=None) -> None:
-        self._call(11, RenameReq(src, dst), Empty)
+        # src's owner coordinates (it clears the src dirent at commit);
+        # cross-partition dst lands via the renamePrepare participant RPC
+        self._call(11, RenameReq(src, dst), Empty, pid=self._pid_path(src))
 
     def list_dir(self, path: str, user=None, *, limit: int = 0,
                  prefix: str = "") -> List[DirEntry]:
-        return self._call(12, ListReq(path, limit=limit, prefix=prefix), ListRsp).entries
+        return self._call(12, ListReq(path, limit=limit, prefix=prefix), ListRsp,
+                          pid=self._pid_dir(path)).entries
 
     def stat_fs(self) -> StatFs:
         return self._call(1, StatFsReq(), StatFs)
@@ -1828,19 +2116,40 @@ class MetaRpcClient:
                   *, flags: int = 0) -> Inode:
         return self._call(
             19, XattrReq(path, name=name, value=value, flags=flags),
-            InodeRsp).inode
+            InodeRsp, pid=self._pid_path(path)).inode
 
     def get_xattr(self, path: str, name: str) -> bytes:
-        return self._call(20, XattrReq(path, name=name), XattrRsp).value
+        return self._call(20, XattrReq(path, name=name), XattrRsp,
+                          pid=self._pid_path(path)).value
 
     def list_xattrs(self, path: str) -> List[str]:
-        return self._call(21, XattrReq(path), XattrRsp).names
+        return self._call(21, XattrReq(path), XattrRsp,
+                          pid=self._pid_path(path)).names
 
     def remove_xattr(self, path: str, name: str) -> Inode:
-        return self._call(22, XattrReq(path, name=name), InodeRsp).inode
+        return self._call(22, XattrReq(path, name=name), InodeRsp,
+                          pid=self._pid_path(path)).inode
 
     def get_real_path(self, path: str) -> str:
-        return self._call(14, PathReq(path), StrReply).value
+        return self._call(14, PathReq(path), StrReply,
+                          pid=self._pid_path(path)).value
+
+    # -- two-phase participant plane (server-to-server; docs/metashard.md) --
+
+    def rename_prepare(self, pid: int, intent: "IntentRecord",
+                       dst_path: str = "") -> None:
+        """Apply one prepare on the participant owning partition ``pid``
+        (idempotent behind the prepare record — safe to re-send)."""
+        self._call(27, RenamePrepareReq(intent, dst_path), Empty, pid=pid)
+
+    def rename_finish(self, pid: int, txn_id: str) -> None:
+        """Best-effort prepare-record GC after commit (idempotent)."""
+        self._call(28, RenameFinishReq(txn_id), Empty, pid=pid)
+
+    def rename_resolve(self, *, force: bool = False) -> int:
+        """Drive the crash resolver on a server (admin in auth mode);
+        returns how many dangling intents it converged."""
+        return self._call(29, RenameResolveReq(force), IntReply).value
 
 
 # -- core (embedded in every server; ref CoreService) ------------------------

@@ -90,6 +90,13 @@ ENFORCEMENT: Dict[Tuple[str, str], str] = {
     ("MetaSerde", "batchClose"): IOPS,
     ("MetaSerde", "batchSetAttr"): IOPS,
     ("MetaSerde", "batchCreate"): IOPS,
+    ("MetaSerde", "batchMkdirs"): IOPS,
+    # two-phase participant plane (tpu3fs/metashard): server-to-server
+    # internals riding the coordinator's already-charged op — like chain
+    # forwarding, charging them again would double-bill the rename
+    ("MetaSerde", "renamePrepare"): EXEMPT,
+    ("MetaSerde", "renameFinish"): EXEMPT,
+    ("MetaSerde", "renameResolve"): EXEMPT,
     # -- Usrbio ring registration: control plane (the data plane rides
     #    StorageSerde methods, which keep their bytes/iops classification
     #    and are charged at ring dequeue through dispatch_packet) --------

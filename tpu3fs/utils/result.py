@@ -75,6 +75,14 @@ class Code(enum.IntEnum):
     META_INVALID_PATH = 412
     META_NOT_FILE = 413
     META_NO_XATTR = 414      # ENODATA, distinct from a missing path
+    META_WRONG_PARTITION = 415  # op routed to a meta server that does not
+    #                          own the partition (stale table / mid-
+    #                          reassignment): refresh routing and retry —
+    #                          correctness is never at stake, the shared
+    #                          KV serializes either way (docs/metashard.md)
+    META_TXN_EXPIRED = 416   # two-phase prepare refused: the intent's
+    #                          deadline passed (the resolver may already
+    #                          be aborting it) or it was never written
 
     # storage 5xx (update-code taxonomy, ref StorageOperator.cc:401-434)
     CHUNK_NOT_FOUND = 500
@@ -181,6 +189,9 @@ RETRYABLE_CODES = frozenset(
         Code.TARGET_OFFLINE,
         Code.SYNCING,
         Code.CLIENT_ROUTING_STALE,
+        # metashard ownership fence: the op reached a non-owner; a routing
+        # refresh re-routes it (MetaRpcClient refreshes before the retry)
+        Code.META_WRONG_PARTITION,
         Code.QUEUE_FULL,
         # QoS load shed: the server is telling the client to come back
         # after the carried retry-after hint (qos.retry_after_ms_of)
