@@ -24,6 +24,16 @@ Every mode writes through the full CRAQ chain (replicas=2 by default),
 so the numbers include replication: the chain forward re-ships every
 byte to the successor.
 
+The native transport runs the whole matrix TWICE in the same process —
+head=native (C++ serves the head write end to end: decode, engine
+install + CRC, chain forward, cross-check, commit, all GIL-free) and
+head=python (TPU3FS_NATIVE_WRITE=0, the serial dispatch path) — and
+emits their ratio as ``writepath_native_head_speedup``. Same cluster,
+same sockets, same payloads: the only variable is who serves the head.
+Rows record ``host_cpus``; on a single-core host the two heads
+time-share one CPU, so the GIL-free win cannot show there (the ratio
+row carries a note when that is the case).
+
 Usage:
   python -m benchmarks.write_bench [--chunks 64] [--size 1048576]
       [--batch 8] [--fast] [--out BENCH_WRITEPATH.json]
@@ -50,6 +60,16 @@ def _gibps(nbytes: int, dt: float) -> float:
 def _payloads(chunks: int, size: int):
     base = bytes(range(256)) * (size // 256)
     return [base[i:] + base[:i] for i in (0, 1, 2, 3)], base
+
+
+def _resync_fastpath(cluster) -> None:
+    # push the current TPU3FS_NATIVE_WRITE lever into every node's .so
+    # (the same scan the storage app runs); stands the native head up or
+    # down without touching the cluster
+    from tpu3fs.storage.native_fastpath import sync_read_fastpath
+
+    for server, svc in zip(cluster.servers[1:], cluster.services):
+        sync_read_fastpath(server, svc)
 
 
 def _bench_write_modes(cluster, *, chunks: int, size: int, batch: int,
@@ -160,7 +180,9 @@ def run(*, chunks: int = 64, size: int = 1 << 20, batch: int = 32,
     # see benchmarks/ckpt_bench.py): install copies land in recycled
     # warm extents instead of paying this host's first-touch page cost
     os.environ.setdefault("TPU3FS_MEM_PREALLOC_MB", "128")
+    host_cpus = os.cpu_count() or 1
     results = []
+    prev_lever = os.environ.get("TPU3FS_NATIVE_WRITE")
     for transport in transports:
         engine = "native" if transport == "native" else "mem"
         try:
@@ -172,29 +194,67 @@ def run(*, chunks: int = 64, size: int = 1 << 20, batch: int = 32,
                             "transport": transport, "error": repr(e)[:200]})
             print(json.dumps(results[-1]), flush=True)
             continue
+        # native transport: same-run A/B on WHO serves the head —
+        # C++ end-to-end vs python dispatch — same cluster, same sockets
+        heads = ("native", "python") if transport == "native" else (None,)
         try:
-            for row in _bench_write_modes(cluster, chunks=chunks, size=size,
-                                          batch=batch, transport=transport,
-                                          rounds=rounds):
-                row["chunk_size"] = size
-                row["engine"] = engine
-                row["replicas"] = replicas
-                results.append(row)
-                print(json.dumps(row), flush=True)
+            for head in heads:
+                if head is not None:
+                    os.environ["TPU3FS_NATIVE_WRITE"] = \
+                        "1" if head == "native" else "0"
+                    _resync_fastpath(cluster)
+                for row in _bench_write_modes(cluster, chunks=chunks,
+                                              size=size, batch=batch,
+                                              transport=transport,
+                                              rounds=rounds):
+                    row["chunk_size"] = size
+                    row["engine"] = engine
+                    row["replicas"] = replicas
+                    row["host_cpus"] = host_cpus
+                    if head is not None:
+                        row["head"] = head
+                    results.append(row)
+                    print(json.dumps(row), flush=True)
         finally:
             cluster.close()
+            if prev_lever is None:
+                os.environ.pop("TPU3FS_NATIVE_WRITE", None)
+            else:
+                os.environ["TPU3FS_NATIVE_WRITE"] = prev_lever
     # headline ratio per transport: striped pipelined vs the baseline
-    by = {(r["metric"], r["transport"]): r.get("value")
+    by = {(r["metric"], r["transport"], r.get("head")): r.get("value")
           for r in results if "value" in r}
     for transport in transports:
-        nopipe = by.get(("writepath_batch_nopipe", transport))
-        best = max(filter(None, (by.get(("writepath_batch", transport)),
-                                 by.get(("writepath_striped", transport)))),
-                   default=None)
-        if nopipe and best:
-            row = {"metric": "writepath_speedup_vs_nopipe",
-                   "transport": transport,
-                   "value": round(best / nopipe, 2), "unit": "x"}
+        for head in ("native", "python") if transport == "native" \
+                else (None,):
+            nopipe = by.get(("writepath_batch_nopipe", transport, head))
+            best = max(filter(None, (
+                by.get(("writepath_batch", transport, head)),
+                by.get(("writepath_striped", transport, head)))),
+                default=None)
+            if nopipe and best:
+                row = {"metric": "writepath_speedup_vs_nopipe",
+                       "transport": transport,
+                       "value": round(best / nopipe, 2), "unit": "x"}
+                if head is not None:
+                    row["head"] = head
+                results.append(row)
+                print(json.dumps(row), flush=True)
+    if "native" in transports:
+        nat = by.get(("writepath_batch", "native", "native"))
+        pyh = by.get(("writepath_batch", "native", "python"))
+        if nat and pyh:
+            row = {"metric": "writepath_native_head_speedup",
+                   "transport": "native",
+                   "value": round(nat / pyh, 2), "unit": "x",
+                   "host_cpus": host_cpus,
+                   "ab": "same run, same cluster: TPU3FS_NATIVE_WRITE "
+                         "1 vs 0 (C++ head serve vs python dispatch)"}
+            if host_cpus == 1:
+                row["note"] = ("single-core host: both heads time-share "
+                               "one CPU, so the GIL-free C++ head cannot "
+                               "show its parallel win here; rerun on a "
+                               "multi-core host")
             results.append(row)
             print(json.dumps(row), flush=True)
     return results

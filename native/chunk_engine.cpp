@@ -1488,7 +1488,7 @@ int ce_compact(void* h) {
 
 // ABI fingerprint scanned as raw bytes by the Python loader BEFORE dlopen;
 // bump in lockstep with native_engine._ABI_TAG on any layout change
-__attribute__((used)) const char kAbiTag[] = "TPU3FS_ENGINE_ABI_5";
+__attribute__((used)) const char kAbiTag[] = "TPU3FS_ENGINE_ABI_6";
 
 uint32_t ce_crc32c(const uint8_t* data, uint64_t n) { return crc32c(data, n); }
 uint32_t ce_crc32c_seed(const uint8_t* data, uint64_t n, uint32_t crc) {
@@ -1791,7 +1791,10 @@ int ce_crc32c_multi(const uint8_t* const* bufs, const uint64_t* lens,
 struct CUpOp {
   uint8_t key[kKeyLen];
   uint8_t flags;       // 1 = full_replace; 2 = validate expected_crc;
-                       // 4 = stage_replace (EC two-phase stage)
+                       // 4 = stage_replace (EC two-phase stage);
+                       // 8 = reject_create (near-full target: refuse ops
+                       //     that would mint a NEW chunk with E_NO_SPACE,
+                       //     mirroring the Python head's reject_create)
   uint8_t pad0[3];
   uint32_t offset;     // write offset within the chunk
   uint32_t data_len;
@@ -1843,6 +1846,10 @@ int ce_batch_update(void* h, uint64_t chain_ver, const uint8_t* blob,
     memcpy(k.b, op.key, kKeyLen);
     COpResult& r = res[i];
     r = COpResult{};
+    if ((op.flags & 8) && !(op.flags & 1) && e->lookup(k) == nullptr) {
+      r.rc = E_NO_SPACE;  // reject_create: no new chunks on a full target
+      continue;
+    }
     uint64_t ver = op.update_ver;
     uint32_t len = 0, crc = 0;
     r.rc = e->update(k, &ver, chain_ver, op_payload(blob, op), op.data_len,
@@ -1876,6 +1883,10 @@ int ce_batch_write(void* h, uint64_t chain_ver, const uint8_t* blob,
     memcpy(k.b, op.key, kKeyLen);
     COpResult& r = res[i];
     r = COpResult{};
+    if ((op.flags & 8) && !(op.flags & 1) && e->lookup(k) == nullptr) {
+      r.rc = E_NO_SPACE;  // reject_create: no new chunks on a full target
+      continue;
+    }
     uint64_t ver = op.update_ver;
     uint32_t len = 0, crc = 0;
     r.rc = e->update(k, &ver, chain_ver, op_payload(blob, op), op.data_len,

@@ -24,6 +24,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <list>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -31,6 +32,7 @@
 #include <set>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include <arpa/inet.h>
@@ -408,6 +410,28 @@ struct FpWriteChain {
   uint64_t chunk_size = 0;
 };
 
+// ce_batch_commit mirror (native/chunk_engine.cpp): commit staged versions
+typedef int (*fp_batch_commit_t)(void* h, uint64_t chain_ver,
+                                 const uint8_t* keys, const uint64_t* vers,
+                                 FpOpResult* res, int n);
+
+// head-side write registration: the local HEAD target of a fully-SERVING
+// replicated chain plus the socket route to its successor. Registered per
+// sync tick by tpu3fs/storage/native_fastpath.py under the same
+// eligibility rules the Python head would prove per-request (all members
+// SERVING, no EC, no in-process replicator, no armed write-path fault
+// rules); anything the registration cannot prove stays on the Python
+// dispatch.
+struct FpHeadChain {
+  void* engine = nullptr;
+  int64_t target_id = 0;       // the local head target
+  int64_t chain_ver = 0;
+  uint64_t chunk_size = 0;
+  bool reject_create = false;  // near-full target: creates must refuse
+  std::string succ_host;       // empty/0 = single-member chain (no forward)
+  int succ_port = 0;
+};
+
 // status codes the fast path can emit (tpu3fs/utils/result.py)
 enum FpCode : int64_t {
   FP_OK = 0,
@@ -439,8 +463,20 @@ struct FpState {
   std::map<int64_t, FpTarget> targets;
   fp_batch_write_t batch_write = nullptr;
   std::map<int64_t, FpWriteChain> write_chains;  // chain_id -> local tail
+  // head-side write path: stage (ce_batch_update) + commit
+  // (ce_batch_commit) around the chain forward, per registered head chain
+  fp_batch_write_t batch_stage = nullptr;
+  fp_batch_commit_t batch_commit = nullptr;
+  std::map<int64_t, FpHeadChain> head_chains;  // chain_id -> local head
   std::atomic<uint64_t> hits{0};
   std::atomic<uint64_t> fallbacks{0};
+  std::atomic<uint64_t> write_served{0};     // head writes served here
+  std::atomic<uint64_t> write_fallbacks{0};  // head writes handed to Python
+  std::atomic<uint64_t> forward_us{0};       // cumulative successor RTT
+  // planted chaos bug native_commit_skip_crc (tpu3fs/chaos/bugs.py): when
+  // armed the head commits + acks without verifying the successor's
+  // result — no status check, no checksum cross-check
+  std::atomic<bool> skip_crc{false};
   // readers currently inside an engine call: deregistration spins until
   // this drains so a caller may safely ce_close an engine after
   // del_target/clear returns (no use-after-free on in-flight reads)
@@ -686,18 +722,22 @@ struct FpWReq {
   uint32_t index = 0;
   int64_t offset = 0;
   int64_t chunk_size = 0;
+  std::string client_id;  // exactly-once identity (head fast path)
+  int64_t channel_id = 0;
+  int64_t seqnum = 0;
   int64_t update_ver = 0;
   bool full_replace = false;
   int64_t from_target = 0;
+  int64_t trusted_crc = -1;  // forwarded verbatim down the chain
 };
 
 // decode ONE WriteReq (13 fields; serde reflection order of
 // storage/craq.py WriteReq). Returns false on any shape mismatch OR a
 // non-empty inline data field (bulk mode keeps payloads out of the
-// envelope; inline payloads take the Python path). The trailing
-// trusted_crc is decoded and DISCARDED: it is only ever meaningful for
-// in-process forwards, and anything arriving over a socket must be
-// re-verified anyway.
+// envelope; inline payloads take the Python path). trusted_crc is
+// decoded but never TRUSTED here — the head fast path forwards it
+// verbatim so the successor sees the same bytes a Python head would
+// have forwarded; anything arriving over a socket is re-verified.
 bool fp_decode_write_one(const uint8_t* d, size_t len, size_t& pos,
                          FpWReq& r) {
   uint64_t nf;
@@ -715,18 +755,19 @@ bool fp_decode_write_one(const uint8_t* d, size_t len, size_t& pos,
   uint64_t data_len;
   if (!get_uvarint(d, len, pos, data_len) || data_len != 0) return false;
   if (!get_int(d, len, pos, r.chunk_size)) return false;
-  uint64_t sl;  // client_id (skipped); `sl > len - pos`, NOT `pos + sl >
-                // len` — the latter wraps for crafted huge varints (same
-                // guard as get_str above)
+  uint64_t sl;  // client_id; `sl > len - pos`, NOT `pos + sl > len` —
+                // the latter wraps for crafted huge varints (same guard
+                // as get_str above)
   if (!get_uvarint(d, len, pos, sl) || sl > len - pos) return false;
+  r.client_id.assign(reinterpret_cast<const char*>(d + pos), sl);
   pos += sl;
-  if (!get_int(d, len, pos, tmp)) return false;  // channel_id
-  if (!get_int(d, len, pos, tmp)) return false;  // seqnum
+  if (!get_int(d, len, pos, r.channel_id)) return false;
+  if (!get_int(d, len, pos, r.seqnum)) return false;
   if (!get_int(d, len, pos, r.update_ver)) return false;
   if (pos >= len) return false;
   r.full_replace = d[pos++] != 0;  // bool = one raw byte
   if (!get_int(d, len, pos, r.from_target)) return false;
-  if (!get_int(d, len, pos, tmp)) return false;  // trusted_crc (ignored)
+  if (!get_int(d, len, pos, r.trusted_crc)) return false;
   return true;
 }
 
@@ -770,7 +811,8 @@ bool fp_split_bulk(const std::string& bulk,
 }
 
 void fp_put_update_reply(std::string& buf, int64_t code, int64_t update_ver,
-                         int64_t commit_ver, uint32_t crc, uint32_t len) {
+                         int64_t commit_ver, uint32_t crc, uint32_t len,
+                         const char* msg = nullptr) {
   // UpdateReply{code, update_ver, commit_ver, checksum{value,length}, msg}
   put_uvarint(buf, 5);
   put_int(buf, code);
@@ -779,7 +821,13 @@ void fp_put_update_reply(std::string& buf, int64_t code, int64_t update_ver,
   put_uvarint(buf, 2);
   put_int(buf, int64_t(crc));
   put_int(buf, int64_t(len));
-  put_uvarint(buf, 0);  // empty message
+  if (msg == nullptr) {
+    put_uvarint(buf, 0);  // empty message
+  } else {
+    size_t mlen = strlen(msg);
+    put_uvarint(buf, mlen);
+    buf.append(msg, mlen);
+  }
 }
 
 constexpr int32_t kEngineStale = -3;  // chunk_engine E_STALE_UPDATE
@@ -883,6 +931,8 @@ constexpr int64_t kStorageServiceId = 3;
 constexpr int64_t kBatchReadMethodId = 11;
 constexpr int64_t kReadMethodId = 3;
 constexpr int64_t kBatchUpdateMethodId = 15;
+constexpr int64_t kWriteMethodId = 1;
+constexpr int64_t kBatchWriteMethodId = 12;
 
 // ---- server ---------------------------------------------------------------
 // handler v4: returns status; on success fills *rsp (malloc'd) + *rsp_len;
@@ -1081,6 +1131,170 @@ std::string parse_tenant(const std::string& msg) {
   return "";
 }
 
+// ---- exactly-once channel table (the C mirror of craq._ChannelTable) ------
+// ONE table serves both paths: the native head write path consults it
+// below the GIL, and the Python dispatch consults the same table through
+// the tpu3fs_rpc_chan_* exports (storage/native_fastpath.py swaps the
+// service's Python table for a wrapper), so a retry replayed across the
+// fast path / fallback boundary still dedupes. Semantics are verbatim
+// _ChannelTable: LRU capacity 1024 with a 60 s eviction grace (a slot
+// younger than the grace blocks eviction — the table may overshoot),
+// every hit refreshes recency BEFORE the seqnum comparison.
+struct ChanTable {
+  std::mutex mu;
+  size_t capacity = 1024;
+  double grace_s = 60.0;
+  struct Slot {
+    int64_t seq = 0;
+    std::string reply;  // encoded UpdateReply payload, replayed verbatim
+    double last_touch = 0.0;
+    std::list<std::string>::iterator pos;
+  };
+  std::list<std::string> order;  // LRU order: front = oldest
+  std::unordered_map<std::string, Slot> slots;
+
+  static std::string key_of(const std::string& client_id,
+                            int64_t channel_id) {
+    std::string k = client_id;
+    k.push_back('\0');
+    k += std::to_string(channel_id);
+    return k;
+  }
+
+  // -> 0 fresh (proceed), 1 cached duplicate (*out = stored reply), 2 stale
+  int check(const std::string& key, int64_t seq, std::string* out) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = slots.find(key);
+    if (it == slots.end()) return 0;
+    it->second.last_touch = mono_now();
+    order.splice(order.end(), order, it->second.pos);
+    if (seq == it->second.seq) {
+      if (out != nullptr) *out = it->second.reply;
+      return 1;
+    }
+    return seq < it->second.seq ? 2 : 0;
+  }
+
+  void store(const std::string& key, int64_t seq, const uint8_t* reply,
+             size_t len) {
+    std::lock_guard<std::mutex> g(mu);
+    double now = mono_now();
+    auto it = slots.find(key);
+    if (it == slots.end()) {
+      order.push_back(key);
+      it = slots.emplace(key, Slot{}).first;
+      it->second.pos = std::prev(order.end());
+    } else {
+      order.splice(order.end(), order, it->second.pos);
+    }
+    it->second.seq = seq;
+    it->second.reply.assign(reinterpret_cast<const char*>(reply), len);
+    it->second.last_touch = now;
+    while (slots.size() > capacity) {
+      auto oit = slots.find(order.front());
+      if (oit == slots.end()) {
+        order.pop_front();
+        continue;
+      }
+      if (now - oit->second.last_touch < grace_s) break;  // in-grace: keep
+      order.pop_front();
+      slots.erase(oit);
+    }
+  }
+
+  size_t prune_client(const std::string& client_id) {
+    std::string prefix = client_id;
+    prefix.push_back('\0');
+    std::lock_guard<std::mutex> g(mu);
+    size_t reaped = 0;
+    for (auto it = slots.begin(); it != slots.end();) {
+      if (it->first.compare(0, prefix.size(), prefix) == 0) {
+        order.erase(it->second.pos);
+        it = slots.erase(it);
+        ++reaped;
+      } else {
+        ++it;
+      }
+    }
+    return reaped;
+  }
+
+  size_t size() {
+    std::lock_guard<std::mutex> g(mu);
+    return slots.size();
+  }
+};
+
+// ---- per-chunk write interlock --------------------------------------------
+// The head fast path serializes stage -> forward -> commit per chunk the
+// way the Python head's per-chunk locks do. The Python write paths take
+// THESE locks too (through tpu3fs_rpc_chunk_lock, after their own Python
+// locks) whenever the native head path is registered, so a native-served
+// write and a fallback-served write to the same chunk can never
+// interleave between stage and commit. Acquisition is all-or-wait over
+// the caller's full (deduped) key set — no incremental holds, so lock
+// order cannot deadlock.
+struct ChunkLocks {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::set<std::string> held;  // 12-byte chunk keys
+
+  void lock_keys(const std::vector<std::string>& keys) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] {
+      for (const auto& k : keys)
+        if (held.count(k)) return false;
+      return true;
+    });
+    for (const auto& k : keys) held.insert(k);
+  }
+
+  void unlock_keys(const std::vector<std::string>& keys) {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (const auto& k : keys) held.erase(k);
+    }
+    cv.notify_all();
+  }
+};
+
+// the client entry points live further down this file; the forward pool
+// below reuses them for the head's successor hop (same C linkage)
+extern "C" void* tpu3fs_rpc_client_connect(const char* host, int port,
+                                           int connect_timeout_ms,
+                                           int call_timeout_ms);
+extern "C" void tpu3fs_rpc_client_close(void* cli);
+
+// ---- pooled successor connections (the head's chain-forward hop) ----------
+// take/put discipline: a worker takes the parked connection exclusively
+// for one send..recv round trip and parks it back on success; transport
+// trouble closes it (the next forward redials). Concurrent forwards to
+// the same successor simply dial extra connections; only one parks.
+struct FwdPool {
+  std::mutex mu;
+  std::map<std::string, void*> conns;  // "host:port" -> parked Client*
+
+  void* take(const std::string& addr) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = conns.find(addr);
+    if (it == conns.end()) return nullptr;
+    void* c = it->second;
+    conns.erase(it);
+    return c;
+  }
+
+  bool put(const std::string& addr, void* cli) {
+    std::lock_guard<std::mutex> g(mu);
+    if (conns.count(addr)) return false;  // slot taken: caller closes
+    conns[addr] = cli;
+    return true;
+  }
+
+  ~FwdPool() {
+    for (auto& kv : conns) tpu3fs_rpc_client_close(kv.second);
+  }
+};
+
 struct Server {
   int listen_fd = -1;
   int epoll_fd = -1;
@@ -1100,7 +1314,27 @@ struct Server {
 
   FpState fastpath;
   QosState qos;
+  ChanTable channels;
+  ChunkLocks chunk_locks;
+  FwdPool fwd_pool;
 };
+
+// outcome of a head-write fast-path attempt (definition follows the
+// client helpers it forwards through)
+enum FpWriteOutcome {
+  FPW_FALLBACK = 0,  // hand the frame to the Python dispatch untouched
+  FPW_SERVED = 1,    // out_payload holds the reply payload (envelope OK)
+  FPW_SHED = 2,      // out_status/out_msg carry a gate-shed envelope
+};
+// the definition lives in the helper namespace nested inside the
+// extern "C" block (it rides the client send/recv halves for the chain
+// forward), so this forward declaration must carry C language linkage
+// to name the same function
+extern "C" {
+FpWriteOutcome fp_try_head_write(Server* s, const Packet& req, bool single,
+                                 std::string& out_payload,
+                                 int64_t& out_status, std::string& out_msg);
+}
 
 void server_close_conn(Server* s, const std::shared_ptr<Conn>& c) {
   bool was = c->closed.exchange(true);
@@ -1289,6 +1523,45 @@ void worker_main(Server* s) {
       if (cb != nullptr) cb->put_back();
       if (tg != nullptr) tg->iops.put_back();  // Python charges it again
       s->fastpath.fallbacks.fetch_add(1);
+    }
+    // native HEAD write fast path: client-facing write/batchWrite against
+    // a registered local head — gate, exactly-once check, engine stage,
+    // chain forward, CRC cross-check, commit, all below the GIL. Any
+    // guard the C side can't prove hands the untouched frame to Python.
+    if (req.service_id == kStorageServiceId &&
+        (req.method_id == kWriteMethodId ||
+         req.method_id == kBatchWriteMethodId)) {
+      std::string fp_payload;
+      std::string fp_msg;
+      int64_t fp_status = OK;
+      FpWriteOutcome out = FPW_FALLBACK;
+      try {
+        out = fp_try_head_write(s, req, req.method_id == kWriteMethodId,
+                                fp_payload, fp_status, fp_msg);
+      } catch (...) {
+        out = FPW_FALLBACK;  // fall back; guards unwind locks/inflight
+      }
+      if (out != FPW_FALLBACK) {
+        rsp.status = out == FPW_SERVED ? OK : fp_status;
+        rsp.payload = std::move(fp_payload);
+        rsp.message = std::move(fp_msg);
+        rsp.ts[5] = mono_now();
+        std::string env2 = encode_packet(rsp);
+        uint64_t total2 = env2.size();
+        uint8_t hdr2[4] = {uint8_t(total2 >> 24), uint8_t(total2 >> 16),
+                           uint8_t(total2 >> 8), uint8_t(total2)};
+        struct iovec iov2[2] = {
+            {hdr2, 4},
+            {const_cast<char*>(env2.data()), env2.size()},
+        };
+        std::lock_guard<std::mutex> g(job.conn->write_mu);
+        if (!job.conn->closed.load() &&
+            !send_iovs(job.conn->fd, iov2, 2, kServerDrainTimeoutMs)) {
+          server_close_conn(s, job.conn);
+        }
+        continue;
+      }
+      s->fastpath.write_fallbacks.fetch_add(1);
     }
     // native write fast path: the chain-internal batchUpdate hop against
     // a registered tail target never enters Python either
@@ -1635,10 +1908,11 @@ void* tpu3fs_rpc_client_connect(const char* host, int port,
 }
 
 // ABI version marker: the Python loader rebuilds a stale .so whose symbols
-// predate the flags-carrying handler signature / pipelined client split
-// (a silent mismatch would corrupt the callback stack instead of failing
-// loud)
-int tpu3fs_rpc_abi_version() { return 4; }
+// predate the current surface (v5: the head-side native write path —
+// fastpath_install_head / head-chain registry / shared channel table /
+// chunk locks / fastpath_serve; a silent mismatch would corrupt the
+// callback stack instead of failing loud)
+int tpu3fs_rpc_abi_version() { return 5; }
 
 namespace {
 
@@ -1766,6 +2040,402 @@ int client_recv_locked(Client* c, int64_t* out_status, uint8_t** out_rsp,
   return 0;
 }
 
+// ---- head write fast path: gate / dedupe / stage / forward / commit -------
+
+// the successor hop dials with the same budget shape the Python
+// forwarder uses (conservative; a timeout falls back to Python, whose
+// retry ladder owns the slow-successor policy)
+constexpr int kFwdConnectTimeoutMs = 5000;
+constexpr int kFwdCallTimeoutMs = 30000;
+
+struct FpUpdRep {
+  int64_t code = 0;
+  int64_t update_ver = 0;
+  int64_t commit_ver = 0;
+  int64_t crc = 0;
+  int64_t crc_len = 0;
+};
+
+// decode one UpdateReply off a BatchWriteRsp (5-field native replies and
+// 6-field Python replies both appear on the wire; trailing-field rule)
+bool fp_decode_update_reply(const uint8_t* d, size_t len, size_t& pos,
+                            FpUpdRep& r) {
+  uint64_t nf;
+  if (!get_uvarint(d, len, pos, nf) || nf < 5 || nf > 6) return false;
+  if (!get_int(d, len, pos, r.code)) return false;
+  if (!get_int(d, len, pos, r.update_ver)) return false;
+  if (!get_int(d, len, pos, r.commit_ver)) return false;
+  uint64_t cf;
+  if (!get_uvarint(d, len, pos, cf) || cf != 2) return false;
+  if (!get_int(d, len, pos, r.crc)) return false;
+  if (!get_int(d, len, pos, r.crc_len)) return false;
+  uint64_t mlen;  // message: skipped (only the code/crc matter here)
+  if (!get_uvarint(d, len, pos, mlen) || mlen > len - pos) return false;
+  pos += mlen;
+  if (nf >= 6) {
+    int64_t ra;
+    if (!get_int(d, len, pos, ra)) return false;
+  }
+  return true;
+}
+
+// encode one forwarded WriteReq: the C mirror of craq._make_forward_req —
+// replace(req, from_target=<head>, update_ver=<staged>, chain_ver=<ours>),
+// every other field (identity, seqnum, trusted_crc) passed through
+// verbatim so the successor observes exactly what a Python head forwards.
+void fp_put_forward_req(std::string& buf, const FpWReq& r,
+                        uint64_t staged_ver, const FpHeadChain& hc) {
+  put_uvarint(buf, 13);
+  put_int(buf, r.chain_id);
+  put_int(buf, hc.chain_ver);
+  put_uvarint(buf, 2);  // ChunkId{file_id, index}
+  put_int(buf, int64_t(r.file_id));
+  put_int(buf, int64_t(r.index));
+  put_int(buf, r.offset);
+  put_uvarint(buf, 0);  // data: empty (the payload rides the bulk section)
+  put_int(buf, r.chunk_size);
+  put_uvarint(buf, r.client_id.size());
+  buf.append(r.client_id);
+  put_int(buf, r.channel_id);
+  put_int(buf, r.seqnum);
+  put_int(buf, int64_t(staged_ver));
+  buf.push_back(r.full_replace ? 1 : 0);
+  put_int(buf, hc.target_id);  // from_target: chain-internal marker
+  put_int(buf, r.trusted_crc);
+}
+
+// one chain-forward round trip to the successor: batchUpdate with the
+// staged versions, reusing a pooled connection when one is parked.
+// Returns 0 and fills `reps` (one per forwarded op, in order) on a clean
+// decode; negative on transport/shape trouble (-100 remote non-OK
+// envelope, -101 reply shape mismatch) — every non-zero return means
+// "fall back to Python", whose forwarder re-runs the idempotent hop.
+int fp_forward_to_successor(Server* s, const FpHeadChain& hc,
+                            const Packet& req,
+                            const std::vector<FpWReq>& ops,
+                            const std::vector<size_t>& fresh,
+                            const std::vector<std::pair<uint64_t, uint64_t>>& segs,
+                            const std::vector<FpOpResult>& staged,
+                            std::vector<FpUpdRep>& reps) {
+  std::string payload;
+  put_uvarint(payload, 1);  // BatchWriteReq field count
+  put_uvarint(payload, fresh.size());
+  std::vector<const uint8_t*> ptrs(fresh.size());
+  std::vector<size_t> lens(fresh.size());
+  const uint8_t* blob = reinterpret_cast<const uint8_t*>(req.bulk.data());
+  for (size_t j = 0; j < fresh.size(); j++) {
+    fp_put_forward_req(payload, ops[fresh[j]], staged[j].ver, hc);
+    ptrs[j] = blob + segs[fresh[j]].first;
+    lens[j] = size_t(segs[fresh[j]].second);
+  }
+  std::string addr = hc.succ_host + ":" + std::to_string(hc.succ_port);
+  void* cli = s->fwd_pool.take(addr);
+  if (cli == nullptr) {
+    cli = tpu3fs_rpc_client_connect(hc.succ_host.c_str(), hc.succ_port,
+                                    kFwdConnectTimeoutMs, kFwdCallTimeoutMs);
+    if (cli == nullptr) return -1;
+  }
+  Client* c = static_cast<Client*>(cli);
+  int64_t status = 0;
+  uint8_t* rsp = nullptr;
+  size_t rsp_len = 0;
+  int rc;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    rc = client_send_locked(c, kStorageServiceId, kBatchUpdateMethodId,
+                            req.flags & 0xF00, req.message.c_str(),
+                            reinterpret_cast<const uint8_t*>(payload.data()),
+                            payload.size(), ptrs.data(), lens.data(),
+                            int64_t(fresh.size()));
+    if (rc == 0)
+      rc = client_recv_locked(c, &status, &rsp, &rsp_len, nullptr, nullptr,
+                              nullptr, nullptr, nullptr);
+  }
+  if (rc != 0) {
+    tpu3fs_rpc_client_close(cli);  // transport trouble: never park it
+    return rc;
+  }
+  if (!s->fwd_pool.put(addr, cli)) tpu3fs_rpc_client_close(cli);
+  if (status != 0) {
+    if (rsp != nullptr) free(rsp);
+    return -100;  // remote shed/error envelope: Python owns the retry
+  }
+  size_t pos = 0;
+  uint64_t nfields = 0, count = 0;
+  bool ok = get_uvarint(rsp, rsp_len, pos, nfields) && nfields == 1 &&
+            get_uvarint(rsp, rsp_len, pos, count) && count == fresh.size();
+  if (ok) {
+    reps.resize(count);
+    for (uint64_t i = 0; ok && i < count; i++)
+      ok = fp_decode_update_reply(rsp, rsp_len, pos, reps[i]);
+  }
+  if (rsp != nullptr) free(rsp);
+  return ok ? 0 : -101;
+}
+
+// Serve a head-side write/batchWrite end-to-end without the GIL:
+// decode -> registry guards -> QoS/tenant gates -> exactly-once channel
+// check -> per-chunk locks -> engine stage (CRC32C inside ce_batch_update)
+// -> chain forward -> successor checksum cross-check -> commit -> encode.
+// ANY condition the C side can't prove returns FPW_FALLBACK with every
+// gate take refunded and no state mutated beyond idempotent stages — the
+// Python dispatch then serves the identical request from scratch.
+FpWriteOutcome fp_try_head_write(Server* s, const Packet& req, bool single,
+                                 std::string& out_payload,
+                                 int64_t& out_status, std::string& out_msg) {
+  FpState& fp = s->fastpath;
+  if (!req.has_bulk) return FPW_FALLBACK;  // inline payloads: Python path
+  uint64_t class_code = uint64_t((req.flags >> 8) & 0xF);
+  if (class_code == 10) return FPW_FALLBACK;  // KVCACHE: kv_charge is Python
+  // decode ops + bulk segments
+  std::vector<FpWReq> ops;
+  const uint8_t* d = reinterpret_cast<const uint8_t*>(req.payload.data());
+  if (single) {
+    size_t pos = 0;
+    FpWReq r;
+    if (!fp_decode_write_one(d, req.payload.size(), pos, r) ||
+        pos != req.payload.size())
+      return FPW_FALLBACK;
+    ops.push_back(std::move(r));
+  } else {
+    if (!fp_decode_write_reqs(d, req.payload.size(), ops))
+      return FPW_FALLBACK;
+  }
+  std::vector<std::pair<uint64_t, uint64_t>> segs;
+  if (!fp_split_bulk(req.bulk, segs) || segs.size() != ops.size())
+    return FPW_FALLBACK;
+  // registry snapshot + per-op guards (every guard mirrors a Python-path
+  // precondition the head would check; anything else falls back)
+  FpHeadChain hc;
+  fp_batch_write_t stage_fn;
+  fp_batch_commit_t commit_fn;
+  std::vector<std::array<uint8_t, 12>> keys(ops.size());
+  {
+    std::lock_guard<std::mutex> g(fp.mu);
+    stage_fn = fp.batch_stage;
+    commit_fn = fp.batch_commit;
+    if (stage_fn == nullptr || commit_fn == nullptr ||
+        fp.head_chains.empty())
+      return FPW_FALLBACK;
+    auto it = fp.head_chains.find(ops[0].chain_id);
+    if (it == fp.head_chains.end()) return FPW_FALLBACK;
+    hc = it->second;
+    std::set<std::array<uint8_t, 12>> seen;
+    for (size_t i = 0; i < ops.size(); i++) {
+      const FpWReq& r = ops[i];
+      if (r.chain_id != ops[0].chain_id) return FPW_FALLBACK;
+      if (r.chain_ver != hc.chain_ver) return FPW_FALLBACK;
+      // chain-internal hops (resync, forwarded), client-pinned versions
+      // and full replaces keep Python's richer semantics
+      if (r.from_target != 0 || r.update_ver != 0) return FPW_FALLBACK;
+      if (r.full_replace) return FPW_FALLBACK;
+      if (r.chunk_size != 0 && uint64_t(r.chunk_size) != hc.chunk_size)
+        return FPW_FALLBACK;
+      if (r.offset < 0 ||
+          uint64_t(r.offset) + segs[i].second > hc.chunk_size)
+        return FPW_FALLBACK;
+      if (segs[i].second == 0) return FPW_FALLBACK;  // zero-len: Python
+      std::array<uint8_t, 12>& key = keys[i];  // >QI big-endian
+      for (int b = 0; b < 8; b++)
+        key[b] = uint8_t(r.file_id >> (8 * (7 - b)));
+      for (int b = 0; b < 4; b++)
+        key[8 + b] = uint8_t(r.index >> (8 * (3 - b)));
+      if (!seen.insert(key).second)
+        return FPW_FALLBACK;  // same-chunk dups keep Python's ordered path
+    }
+    fp.inflight.fetch_add(1);
+  }
+  struct InflightGuard {
+    FpState& fp;
+    ~InflightGuard() { fp.inflight.fetch_sub(1); }
+  } guard{fp};
+  // admission gates, the cost shape of craq._admit_write: iops cost = op
+  // count, bytes = payload sum (post-charged). Fast-path-served writes
+  // never reach Python's AdmissionController, so the limits bind HERE;
+  // every later fallback refunds because Python charges the op again.
+  double cost = double(ops.size());
+  uint64_t nbytes = 0;
+  for (auto& sg : segs) nbytes += sg.second;
+  int64_t gate_code = int64_t(class_code);
+  if (gate_code == 0)  // untagged: infer like craq.infer_write_class
+    gate_code = ops[0].client_id.rfind("migration-", 0) == 0 ? 6 : 2;
+  QosBucket* cb = s->qos.find_class(kStorageServiceId, gate_code);
+  if (cb != nullptr) {
+    int64_t ra = cb->try_take(s->qos.retry_after_ms, cost);
+    if (ra > 0) {
+      s->qos.shed.fetch_add(1);
+      out_status = kOverloaded;
+      out_msg = "retry_after_ms=" + std::to_string(ra) +
+                " (native write gate)";
+      return FPW_SHED;
+    }
+  }
+  TenantGate* tg = nullptr;
+  if ((s->qos.tenant_exempt_mask.load() & (1ull << uint64_t(gate_code))) ==
+      0) {
+    std::string tname = parse_tenant(req.message);
+    tg = s->qos.find_tenant(tname.empty() ? "default" : tname);
+  }
+  if (tg != nullptr) {
+    int64_t tra = tg->iops.try_take(s->qos.retry_after_ms, cost);
+    if (tra == 0) {
+      int64_t bra = tg->bytes_blocked_ms(s->qos.retry_after_ms);
+      if (bra > 0) {
+        tg->iops.put_back(cost);
+        tra = bra;
+      }
+    }
+    if (tra > 0) {
+      if (cb != nullptr) cb->put_back(cost);
+      s->qos.tenant_shed.fetch_add(1);
+      out_status = kTenantThrottled;
+      out_msg = "retry_after_ms=" + std::to_string(tra) +
+                " (native tenant gate)";
+      return FPW_SHED;
+    }
+  }
+  auto refund = [&] {
+    if (cb != nullptr) cb->put_back(cost);
+    if (tg != nullptr) tg->iops.put_back(cost);
+  };
+  // exactly-once channel pre-check (the shared C mirror of the head's
+  // _ChannelTable): cached duplicates replay their stored reply, stale
+  // seqnums answer CHUNK_STALE_UPDATE, fresh ops proceed to the engine
+  std::vector<std::string> slots(ops.size());
+  std::vector<size_t> fresh;
+  for (size_t i = 0; i < ops.size(); i++) {
+    const FpWReq& r = ops[i];
+    if (r.client_id.empty() || r.channel_id == 0) {
+      fresh.push_back(i);
+      continue;
+    }
+    std::string ck = ChanTable::key_of(r.client_id, r.channel_id);
+    int crc_ = s->channels.check(ck, r.seqnum, &slots[i]);
+    if (crc_ == 1) continue;  // cached duplicate: slots[i] holds the reply
+    if (crc_ == 2) {
+      slots[i].clear();
+      fp_put_update_reply(slots[i], 502, 0, 0, 0, 0, "stale seqnum");
+      continue;
+    }
+    fresh.push_back(i);
+  }
+  if (!fresh.empty()) {
+    // per-chunk interlock shared with the Python write paths: stage ->
+    // forward -> commit is atomic per chunk across BOTH dispatch planes
+    std::vector<std::string> lock_keys;
+    lock_keys.reserve(fresh.size());
+    for (size_t j : fresh)
+      lock_keys.emplace_back(reinterpret_cast<const char*>(keys[j].data()),
+                             12);
+    s->chunk_locks.lock_keys(lock_keys);
+    struct UnlockGuard {
+      ChunkLocks& locks;
+      const std::vector<std::string>& keys;
+      ~UnlockGuard() { locks.unlock_keys(keys); }
+    } unlock{s->chunk_locks, lock_keys};
+    // stage on the head engine: ce_batch_update assigns committed+1,
+    // computes CRC32C, appends ONE WAL record — all under one mutex
+    const uint8_t* blob = reinterpret_cast<const uint8_t*>(req.bulk.data());
+    std::vector<FpUpOp> wops(fresh.size());
+    std::vector<FpOpResult> staged(fresh.size());
+    for (size_t j = 0; j < fresh.size(); j++) {
+      const FpWReq& r = ops[fresh[j]];
+      FpUpOp& o = wops[j];
+      memset(&o, 0, sizeof(o));
+      memcpy(o.key, keys[fresh[j]].data(), 12);
+      o.flags = hc.reject_create ? 8 : 0;  // near-full: no new chunks
+      o.offset = uint32_t(r.offset);
+      o.data_len = uint32_t(segs[fresh[j]].second);
+      o.chunk_size = uint32_t(hc.chunk_size);
+      o.data_off = segs[fresh[j]].first;
+      o.update_ver = 0;  // head assigns committed+1
+    }
+    if (stage_fn(hc.engine, uint64_t(hc.chain_ver), blob, wops.data(),
+                 staged.data(), int(fresh.size())) != 0) {
+      refund();
+      return FPW_FALLBACK;
+    }
+    for (auto& st : staged) {
+      if (st.rc != 0) {  // NO_SPACE/IO/...: Python re-runs & phrases it
+        refund();
+        return FPW_FALLBACK;
+      }
+    }
+    // chain forward + the successor checksum cross-check the Python head
+    // performs; the planted chaos bug native_commit_skip_crc turns this
+    // into a fire-and-forget hop (commit + ack with NO verification)
+    bool skip = fp.skip_crc.load();
+    if (hc.succ_port > 0) {
+      double t0 = mono_now();
+      std::vector<FpUpdRep> reps;
+      int frc = fp_forward_to_successor(s, hc, req, ops, fresh, segs,
+                                        staged, reps);
+      fp.forward_us.fetch_add(
+          uint64_t(std::max(0.0, (mono_now() - t0) * 1e6)));
+      if (!skip) {
+        if (frc != 0) {
+          refund();
+          return FPW_FALLBACK;  // stage is idempotent: Python re-runs
+        }
+        for (size_t j = 0; j < fresh.size(); j++) {
+          if (reps[j].code != 0 ||
+              uint32_t(reps[j].crc) != staged[j].crc) {
+            refund();
+            return FPW_FALLBACK;  // divergence: Python's mismatch path
+          }
+        }
+      }
+    }
+    // commit the staged versions (idempotent: a fallback re-run commits
+    // the same versions again harmlessly)
+    std::string ckeys;
+    ckeys.reserve(12 * fresh.size());
+    std::vector<uint64_t> cvers(fresh.size());
+    for (size_t j = 0; j < fresh.size(); j++) {
+      ckeys.append(reinterpret_cast<const char*>(keys[fresh[j]].data()), 12);
+      cvers[j] = staged[j].ver;
+    }
+    std::vector<FpOpResult> cres(fresh.size());
+    if (commit_fn(hc.engine, uint64_t(hc.chain_ver),
+                  reinterpret_cast<const uint8_t*>(ckeys.data()),
+                  cvers.data(), cres.data(), int(fresh.size())) != 0) {
+      refund();
+      return FPW_FALLBACK;
+    }
+    for (auto& cr : cres) {
+      if (cr.rc != 0) {
+        refund();
+        return FPW_FALLBACK;
+      }
+    }
+    // encode replies + record them in the shared exactly-once table
+    for (size_t j = 0; j < fresh.size(); j++) {
+      const FpWReq& r = ops[fresh[j]];
+      std::string& slot = slots[fresh[j]];
+      slot.clear();
+      fp_put_update_reply(slot, 0, int64_t(staged[j].ver),
+                          int64_t(cres[j].ver), staged[j].crc,
+                          staged[j].len);
+      if (!r.client_id.empty() && r.channel_id != 0)
+        s->channels.store(ChanTable::key_of(r.client_id, r.channel_id),
+                          r.seqnum,
+                          reinterpret_cast<const uint8_t*>(slot.data()),
+                          slot.size());
+    }
+  }
+  out_payload.clear();
+  if (single) {
+    out_payload = slots[0];
+  } else {
+    put_uvarint(out_payload, 1);  // BatchWriteRsp field count
+    put_uvarint(out_payload, ops.size());
+    for (auto& slot : slots) out_payload += slot;
+  }
+  if (tg != nullptr) tg->charge_bytes(double(nbytes));
+  fp.write_served.fetch_add(1);
+  return FPW_SERVED;
+}
+
 }  // namespace
 
 // returns 0 on transport success (out_status carries the remote status code);
@@ -1887,6 +2557,13 @@ void tpu3fs_rpc_fastpath_del_target(void* srv, int64_t target_id) {
       else
         ++it;
     }
+    for (auto it = s->fastpath.head_chains.begin();
+         it != s->fastpath.head_chains.end();) {
+      if (it->second.target_id == target_id)
+        it = s->fastpath.head_chains.erase(it);
+      else
+        ++it;
+    }
   }
   fp_drain(s->fastpath);
 }
@@ -1897,6 +2574,7 @@ void tpu3fs_rpc_fastpath_clear(void* srv) {
     std::lock_guard<std::mutex> g(s->fastpath.mu);
     s->fastpath.targets.clear();
     s->fastpath.write_chains.clear();
+    s->fastpath.head_chains.clear();
   }
   fp_drain(s->fastpath);
 }
@@ -2041,6 +2719,202 @@ void tpu3fs_rpc_fastpath_stats(void* srv, uint64_t* hits,
   auto* s = static_cast<Server*>(srv);
   if (hits != nullptr) *hits = s->fastpath.hits.load();
   if (fallbacks != nullptr) *fallbacks = s->fastpath.fallbacks.load();
+}
+
+// ---- head-side write fast path control (ABI v5) ---------------------------
+// Registered per sync tick by tpu3fs/storage/native_fastpath.py: the
+// engine stage/commit entry points plus, per eligible chain, the local
+// head target and the socket route to its successor.
+
+void tpu3fs_rpc_fastpath_install_head(void* srv, void* stage_fn,
+                                      void* commit_fn) {
+  auto* s = static_cast<Server*>(srv);
+  std::lock_guard<std::mutex> g(s->fastpath.mu);
+  s->fastpath.batch_stage = reinterpret_cast<fp_batch_write_t>(stage_fn);
+  s->fastpath.batch_commit = reinterpret_cast<fp_batch_commit_t>(commit_fn);
+}
+
+void tpu3fs_rpc_fastpath_set_head_chain(void* srv, int64_t chain_id,
+                                        void* engine, int64_t target_id,
+                                        int64_t chain_ver,
+                                        uint64_t chunk_size,
+                                        int reject_create,
+                                        const char* succ_host,
+                                        int succ_port) {
+  auto* s = static_cast<Server*>(srv);
+  std::lock_guard<std::mutex> g(s->fastpath.mu);
+  FpHeadChain hc;
+  hc.engine = engine;
+  hc.target_id = target_id;
+  hc.chain_ver = chain_ver;
+  hc.chunk_size = chunk_size;
+  hc.reject_create = reject_create != 0;
+  hc.succ_host = succ_host == nullptr ? "" : succ_host;
+  hc.succ_port = succ_port;
+  s->fastpath.head_chains[chain_id] = std::move(hc);
+}
+
+// planted chaos bug native_commit_skip_crc (tpu3fs/chaos/bugs.py): armed
+// per sync tick when the bug fires — the head commits + acks without
+// verifying the successor's result
+void tpu3fs_rpc_fastpath_skip_crc(void* srv, int enable) {
+  auto* s = static_cast<Server*>(srv);
+  s->fastpath.skip_crc.store(enable != 0);
+}
+
+void tpu3fs_rpc_fastpath_write_stats(void* srv, uint64_t* served,
+                                     uint64_t* fallbacks,
+                                     uint64_t* forward_us) {
+  auto* s = static_cast<Server*>(srv);
+  if (served != nullptr) *served = s->fastpath.write_served.load();
+  if (fallbacks != nullptr) *fallbacks = s->fastpath.write_fallbacks.load();
+  if (forward_us != nullptr) *forward_us = s->fastpath.forward_us.load();
+}
+
+// ---- shared exactly-once channel table (see ChanTable above) --------------
+// The Python head swaps its _ChannelTable for a wrapper over these when
+// the native write path is live, so duplicates dedupe across BOTH
+// dispatch planes. -> 0 fresh, 1 cached (*out_reply malloc'd), 2 stale.
+
+int tpu3fs_rpc_chan_check(void* srv, const char* client_id,
+                          int64_t channel_id, int64_t seqnum,
+                          uint8_t** out_reply, size_t* out_len) {
+  auto* s = static_cast<Server*>(srv);
+  if (out_reply != nullptr) *out_reply = nullptr;
+  if (out_len != nullptr) *out_len = 0;
+  if (client_id == nullptr || client_id[0] == 0 || channel_id == 0)
+    return 0;
+  std::string stored;
+  int rc = s->channels.check(ChanTable::key_of(client_id, channel_id),
+                             seqnum, &stored);
+  if (rc == 1 && out_reply != nullptr && out_len != nullptr) {
+    *out_reply = static_cast<uint8_t*>(malloc(stored.size() + 1));
+    memcpy(*out_reply, stored.data(), stored.size());
+    *out_len = stored.size();
+  }
+  return rc;
+}
+
+void tpu3fs_rpc_chan_store(void* srv, const char* client_id,
+                           int64_t channel_id, int64_t seqnum,
+                           const uint8_t* reply, size_t len) {
+  auto* s = static_cast<Server*>(srv);
+  if (client_id == nullptr || client_id[0] == 0 || channel_id == 0) return;
+  s->channels.store(ChanTable::key_of(client_id, channel_id), seqnum,
+                    reply, len);
+}
+
+uint64_t tpu3fs_rpc_chan_prune(void* srv, const char* client_id) {
+  auto* s = static_cast<Server*>(srv);
+  if (client_id == nullptr || client_id[0] == 0) return 0;
+  return uint64_t(s->channels.prune_client(client_id));
+}
+
+uint64_t tpu3fs_rpc_chan_len(void* srv) {
+  auto* s = static_cast<Server*>(srv);
+  return uint64_t(s->channels.size());
+}
+
+// ---- shared per-chunk write interlock (see ChunkLocks above) --------------
+// `keys` is n concatenated 12-byte chunk keys. The Python write paths
+// take these AFTER their own per-chunk locks whenever the native head
+// path is registered (the ctypes call releases the GIL, so blocking here
+// while a native worker holds the chunk is safe).
+
+void tpu3fs_rpc_chunk_lock(void* srv, const uint8_t* keys, int n) {
+  auto* s = static_cast<Server*>(srv);
+  std::vector<std::string> ks;
+  ks.reserve(size_t(n));
+  for (int i = 0; i < n; i++)
+    ks.emplace_back(reinterpret_cast<const char*>(keys + 12 * i), 12);
+  s->chunk_locks.lock_keys(ks);
+}
+
+void tpu3fs_rpc_chunk_unlock(void* srv, const uint8_t* keys, int n) {
+  auto* s = static_cast<Server*>(srv);
+  std::vector<std::string> ks;
+  ks.reserve(size_t(n));
+  for (int i = 0; i < n; i++)
+    ks.emplace_back(reinterpret_cast<const char*>(keys + 12 * i), 12);
+  s->chunk_locks.unlock_keys(ks);
+}
+
+// ---- out-of-loop serve entry (the USRBIO ring host) -----------------------
+// Lets a request that arrived OUTSIDE the socket loop (shm ring SQEs)
+// ride the same native write machinery: the Python ring host hands the
+// decoded frame fields here (the ctypes call releases the GIL for the
+// whole stage/forward/commit). Returns 1 when served (*out_status +
+// malloc'd *out_payload/*out_msg filled), 0 when the caller must run the
+// Python dispatch.
+int tpu3fs_rpc_fastpath_serve(void* srv, int64_t service_id,
+                              int64_t method_id, int64_t flags,
+                              const char* msg, const uint8_t* payload,
+                              size_t payload_len,
+                              const uint8_t* const* iov_ptrs,
+                              const size_t* iov_lens, int64_t n_iovs,
+                              int64_t* out_status, uint8_t** out_payload,
+                              size_t* out_len, char** out_msg) {
+  auto* s = static_cast<Server*>(srv);
+  *out_status = OK;
+  *out_payload = nullptr;
+  *out_len = 0;
+  *out_msg = nullptr;
+  if (service_id != kStorageServiceId) return 0;
+  bool head_write =
+      method_id == kWriteMethodId || method_id == kBatchWriteMethodId;
+  if (!head_write && method_id != kBatchUpdateMethodId) return 0;
+  Packet req;
+  req.service_id = service_id;
+  req.method_id = method_id;
+  req.flags = flags;
+  if (msg != nullptr) req.message = msg;
+  req.payload.assign(reinterpret_cast<const char*>(payload), payload_len);
+  req.has_bulk = n_iovs >= 0;
+  if (req.has_bulk) {  // rebuild the wire bulk section from the segments
+    std::string bulk;
+    put_uvarint(bulk, uint64_t(n_iovs));
+    for (int64_t i = 0; i < n_iovs; i++) put_uvarint(bulk, iov_lens[i]);
+    for (int64_t i = 0; i < n_iovs; i++)
+      bulk.append(reinterpret_cast<const char*>(iov_ptrs[i]), iov_lens[i]);
+    req.bulk = std::move(bulk);
+  }
+  std::string fp_payload;
+  std::string fp_msg;
+  int64_t fp_status = OK;
+  if (head_write) {
+    FpWriteOutcome out = FPW_FALLBACK;
+    try {
+      out = fp_try_head_write(s, req, method_id == kWriteMethodId,
+                              fp_payload, fp_status, fp_msg);
+    } catch (...) {
+      out = FPW_FALLBACK;
+    }
+    if (out == FPW_FALLBACK) {
+      s->fastpath.write_fallbacks.fetch_add(1);
+      return 0;
+    }
+    *out_status = out == FPW_SERVED ? OK : fp_status;
+  } else {
+    bool handled = false;
+    try {
+      handled = fp_try_batch_write(s->fastpath, req, fp_payload);
+    } catch (...) {
+      handled = false;
+    }
+    if (!handled) {
+      s->fastpath.fallbacks.fetch_add(1);
+      return 0;
+    }
+  }
+  *out_payload = static_cast<uint8_t*>(malloc(fp_payload.size() + 1));
+  memcpy(*out_payload, fp_payload.data(), fp_payload.size());
+  *out_len = fp_payload.size();
+  if (!fp_msg.empty()) {
+    *out_msg = static_cast<char*>(malloc(fp_msg.size() + 1));
+    memcpy(*out_msg, fp_msg.data(), fp_msg.size());
+    (*out_msg)[fp_msg.size()] = 0;
+  }
+  return 1;
 }
 
 }  // extern "C"

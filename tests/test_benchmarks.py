@@ -51,6 +51,9 @@ class TestUsrbioBench:
             assert r["ring"] > 0 and r["sock"] > 0
             assert len(r["samples_ring"]) == 1
             assert r["speedup"] > 0
+            # reruns on other hosts must be able to judge core-bound
+            # numbers: every row records the cores it ran on
+            assert r["host_cpus"] >= 1
 
 
 class TestRebuildBench:
@@ -159,7 +162,29 @@ class TestWriteBench:
                   "writepath_batch", "writepath_striped"):
             assert by[m]["value"] > 0, by
             assert by[m]["ops"] == 8, by
+            assert by[m]["host_cpus"] >= 1, by
         assert "writepath_speedup_vs_nopipe" in by
+
+    def test_native_head_ab_smoke(self):
+        """Native transport runs the matrix twice in the same run —
+        head=native (C++ end-to-end serve) vs head=python (the
+        TPU3FS_NATIVE_WRITE=0 serial lever) — and reports their ratio."""
+        import pytest
+
+        from benchmarks.write_bench import run as write_bench
+
+        rows = write_bench(chunks=4, size=16 << 10, batch=4, rounds=1,
+                           chains=2, replicas=2, transports=("native",))
+        if any(r["metric"] == "writepath_error" for r in rows):
+            pytest.skip("native toolchain unavailable")
+        by = {(r["metric"], r.get("head")): r for r in rows if "value" in r}
+        for head in ("native", "python"):
+            for m in ("writepath_single", "writepath_batch"):
+                assert by[(m, head)]["value"] > 0, by
+        ab = by[("writepath_native_head_speedup", None)]
+        assert ab["value"] > 0 and ab["host_cpus"] >= 1
+        if ab["host_cpus"] == 1:
+            assert "note" in ab  # core-bound caveat travels with the row
 
 
 class TestTraceBench:
@@ -263,6 +288,9 @@ class TestEcBench:
         assert by["ec_encode_host_3_1"]["value"] > 0
         ce = by["ec_chain_encode_2_2"]
         assert ce["value"] > 0 and ce["cr_equal_overhead_gibps"] > 0
+        # multi-core rerun gate travels with the row, alongside the cores
+        # the measurement actually had
+        assert ce["host_cpus"] >= 1 and "acceptance" in ce
         # the offload IS the point: zero client encode CPU in chain mode
         assert ce["client_encode_cpu_s_per_gib"]["chain"] == 0.0
         assert ce["client_encode_cpu_s_per_gib"]["client"] > 0

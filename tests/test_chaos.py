@@ -352,6 +352,45 @@ class TestRunnerAndSearch:
         assert not run_schedule(shrunk).violated, \
             "shrunk serving schedule must be green on the fixed tree"
 
+    def test_native_write_sidecar_green_and_skip_crc_bug_caught(self):
+        """spec.native_write rides a REAL 2-node native-socket chain
+        beside the fabric (the C++ head write path never runs in-fabric
+        — the fabric messenger is direct-call): the clean tree stays
+        green — every probe against manufactured replica divergence is
+        REFUSED by the successor cross-check — and the planted
+        native_commit_skip_crc bug (commit + ack with no verification)
+        is caught by the replica_crc checker. The schedule's one rule
+        sits on a NON-write point: the crash window bug_fire needs stays
+        open without standing the native head down (the corpus seed
+        tests/chaos_seeds/native_commit_skip_crc_head_ack.json)."""
+        spec = ScheduleSpec(steps=8, events=1, storage_nodes=3,
+                            num_chains=1, num_replicas=2,
+                            native_write=True, allow_kill=False,
+                            allow_elastic=False, allow_config_push=False)
+        sched = Schedule(31, spec, [ChaosEvent(0, "fault_set", {
+            "spec": "point=storage.read,kind=delay_ms,prob=1.0,arg=0",
+            "seed": 7, "node_idx": -1})])
+        sched.validate()
+        r = run_schedule(sched)
+        byname = {o.checker: o.status for o in r.outcomes}
+        if byname["replica_crc"] == "skipped":
+            pytest.skip("native sidecar unavailable (no .so)")
+        assert byname["replica_crc"] == "passed", r.summary()
+        bugs.arm("native_commit_skip_crc")
+        try:
+            r2 = run_schedule(sched)
+        finally:
+            bugs.disarm()
+        assert "replica_crc" in r2.violated_checkers, r2.summary()
+        # minimality: without the fault_set there is no crash window —
+        # the armed bug must NOT fire (bug_fire gates on plane().active)
+        bugs.arm("native_commit_skip_crc")
+        try:
+            r3 = run_schedule(sched.prefix(0))
+        finally:
+            bugs.disarm()
+        assert not r3.violated, r3.summary()
+
     def test_metashard_sidecar_green_and_orphan_bug_caught(self):
         """spec.meta_shard rides the metashard sidecar (cross-partition
         two-phase renames, the resolver racing a recycled src name under

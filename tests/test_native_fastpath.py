@@ -75,6 +75,22 @@ def _client_for(env):
         RpcMessenger(env["mcli"].refresh_routing, env["client"]))
 
 
+def test_loaded_so_abi_matches_bindings():
+    """Stale-.so guard: the library this process actually dlopen'd must
+    report the ABI the Python bindings were written against. The loader's
+    pre-dlopen probe rebuilds on mismatch, but a cached module object or
+    a probe/build race could still hand out an old ABI — and a stale .so
+    behind the v5 write-path bindings corrupts the callback stack, so
+    this has to hold in-process, not just at probe time."""
+    from tpu3fs.rpc import native_net
+
+    try:
+        lib = native_net._load_lib()
+    except Exception as e:
+        pytest.skip(f"native toolchain unavailable: {e!r}")
+    assert lib.tpu3fs_rpc_abi_version() == native_net._ABI_VERSION
+
+
 class TestNativeReadFastpath:
     def test_fastpath_hits_and_matches_python_dispatch(self, native_node):
         env = native_node
@@ -384,3 +400,269 @@ class TestNativeWriteFastpath:
         assert all(r.ok for r in replies)
         assert env["nodes"][11]["target"].engine.read(
             ChunkId(26, 0)) == b"q" * 100
+
+
+class TestNativeHeadWritePath:
+    """Client-entry write/batchWrite served end to end by the C++ head
+    (fp_try_head_write): decode, admission, exactly-once, engine stage,
+    chain forward, CRC cross-check, commit — all below the GIL. The
+    contract: byte-identical to the Python dispatch under the
+    TPU3FS_NATIVE_WRITE A/B lever, exactly-once intact across the
+    fast-path/fallback boundary, and the planted skip-crc chaos bug
+    observable only when armed."""
+
+    def _sync_all(self, env):
+        for n in env["nodes"].values():
+            sync_read_fastpath(n["server"], n["svc"])
+
+    def test_ab_lever_byte_identity_and_worker_bypass(self, native_chain,
+                                                      monkeypatch):
+        """The same payloads against disjoint chunks through each path:
+        field-identical replies, identical replica bytes + metadata — and
+        the native path must never enqueue a Python update-worker round
+        (that bypass IS the optimisation)."""
+        from tpu3fs.ops.crc32c import crc32c
+        from tpu3fs.storage import update_worker
+
+        env = native_chain
+        sc = _client_for(env)
+        self._sync_all(env)
+        head = env["nodes"][10]["server"]
+        payloads = {i: bytes([0x60 + i]) * (CHUNK - 13 * i)
+                    for i in range(1, 5)}
+        s0 = head.fastpath_write_stats()
+        r0 = update_worker.rounds_run()
+        fast = sc.batch_write(
+            [(CHAIN, ChunkId(30, i), 0, p) for i, p in payloads.items()],
+            chunk_size=CHUNK)
+        assert all(r.ok for r in fast), fast
+        assert head.fastpath_write_stats()[0] > s0[0], \
+            "head batchWrite must be served natively"
+        assert update_worker.rounds_run() == r0, \
+            "a natively served write must never run a Python worker round"
+        # the A/B lever: TPU3FS_NATIVE_WRITE=0 stands the head down at the
+        # next sync; the same writes then ride the Python dispatch
+        monkeypatch.setenv("TPU3FS_NATIVE_WRITE", "0")
+        self._sync_all(env)
+        s1 = head.fastpath_write_stats()
+        golden = sc.batch_write(
+            [(CHAIN, ChunkId(31, i), 0, p) for i, p in payloads.items()],
+            chunk_size=CHUNK)
+        assert all(r.ok for r in golden), golden
+        assert head.fastpath_write_stats()[0] == s1[0], \
+            "lever off: the head must not serve natively"
+        assert update_worker.rounds_run() > r0, \
+            "the Python head path runs through the update workers"
+        for f, g, p in zip(fast, golden, payloads.values()):
+            assert (f.code, f.update_ver, f.commit_ver, f.retry_after_ms) \
+                == (g.code, g.update_ver, g.commit_ver, g.retry_after_ms)
+            assert f.checksum.value == g.checksum.value == crc32c(p)
+            assert f.checksum.length == g.checksum.length == len(p)
+        for i, p in payloads.items():
+            for node_id in (10, 11):
+                eng = env["nodes"][node_id]["target"].engine
+                for fam in (30, 31):
+                    cid = ChunkId(fam, i)
+                    assert eng.read(cid) == p
+                    meta = eng.get_meta(cid)
+                    assert (meta.committed_ver, meta.pending_ver) == (1, 0)
+                    assert meta.checksum.value == crc32c(p)
+
+    def test_exactly_once_replay_across_path_swap(self, native_chain,
+                                                  monkeypatch):
+        """One channel table serves both paths: a retry replayed natively,
+        and then replayed AGAIN after the lever swaps the head to Python,
+        must splice back the stored reply — applied exactly once."""
+        from tpu3fs.rpc.services import RpcMessenger
+        from tpu3fs.storage.craq import WriteReq
+
+        env = native_chain
+        self._sync_all(env)
+        head = env["nodes"][10]["server"]
+        send = RpcMessenger(env["mcli"].refresh_routing, env["client"])
+        chain_ver = env["mcli"].refresh_routing().chains[CHAIN].chain_version
+        cid = ChunkId(32, 0)
+
+        def req(seq, data):
+            return WriteReq(
+                chain_id=CHAIN, chain_ver=chain_ver, chunk_id=cid,
+                offset=0, data=data, chunk_size=CHUNK,
+                client_id="xo-cli", channel_id=9, seqnum=seq)
+
+        s0 = head.fastpath_write_stats()
+        first = send(10, "write", req(1, b"once" * 100))
+        assert first.ok, first
+        assert head.fastpath_write_stats()[0] > s0[0], \
+            "single write must be served natively"
+        # same (client, channel, seqnum) replayed natively: stored reply
+        replay = send(10, "write", req(1, b"once" * 100))
+        assert (replay.code, replay.update_ver, replay.commit_ver,
+                replay.checksum.value) == (
+                    first.code, first.update_ver, first.commit_ver,
+                    first.checksum.value)
+        # an OLDER seqnum on the channel is refused, never applied
+        stale = send(10, "write", req(0, b"never"))
+        assert stale.code == Code.CHUNK_STALE_UPDATE
+        # swap the head to the Python dispatch: the C channel table is
+        # SHARED, so the same replays still dedupe across the boundary
+        monkeypatch.setenv("TPU3FS_NATIVE_WRITE", "0")
+        self._sync_all(env)
+        replay2 = send(10, "write", req(1, b"once" * 100))
+        assert (replay2.code, replay2.update_ver, replay2.commit_ver,
+                replay2.checksum.value) == (
+                    first.code, first.update_ver, first.commit_ver,
+                    first.checksum.value)
+        assert send(10, "write", req(0, b"never")).code == \
+            Code.CHUNK_STALE_UPDATE
+        # applied exactly once, end to end, on both replicas
+        for node_id in (10, 11):
+            eng = env["nodes"][node_id]["target"].engine
+            assert eng.read(cid) == b"once" * 100
+            assert eng.get_meta(cid).committed_ver == 1
+
+    def test_skip_crc_bug_commits_divergent_replicas(self, native_chain):
+        """Planted chaos bug native_commit_skip_crc (tpu3fs/chaos/bugs.py):
+        disarmed, replica divergence makes the native head REFUSE (fall
+        back) and the Python mismatch path spells it out; armed inside an
+        active fault plane, the head commits + acks with no verification
+        and the replicas' committed CRCs silently disagree."""
+        from tpu3fs.chaos import bugs
+        from tpu3fs.client.storage_client import RetryOptions, StorageClient
+        from tpu3fs.utils.fault_injection import plane
+
+        env = native_chain
+        sc = StorageClient(
+            "skipcrc-test", env["mcli"].refresh_routing,
+            RpcMessenger(env["mcli"].refresh_routing, env["client"]),
+            retry=RetryOptions(max_retries=0, backoff_base_s=0.001))
+        self._sync_all(env)
+        head = env["nodes"][10]["server"]
+        chain_ver = env["mcli"].refresh_routing().chains[CHAIN].chain_version
+        cid = ChunkId(33, 0)
+        assert sc.write_chunk(CHAIN, cid, 0, b"s" * 1000,
+                              chunk_size=CHUNK).ok
+        # manufacture divergence below the chain: both replicas committed
+        # at ver 2 with DIFFERENT bytes — the state an in-flight
+        # corruption leaves behind
+        for node_id, fill in ((10, b"H"), (11, b"T")):
+            eng = env["nodes"][node_id]["target"].engine
+            eng.update(cid, 2, chain_ver, fill * 1000, 0, chunk_size=CHUNK)
+            eng.commit(cid, 2, chain_ver)
+        # cross-check ON: staged CRCs disagree -> native falls back, the
+        # Python head answers CHUNK_CHECKSUM_MISMATCH — never a clean OK
+        s0 = head.fastpath_write_stats()
+        r = sc.write_chunk(CHAIN, cid, 100, b"x" * 50, chunk_size=CHUNK)
+        s1 = head.fastpath_write_stats()
+        assert s1[1] > s0[1], "divergence must fall back, not serve"
+        assert s1[0] == s0[0]
+        assert not r.ok and "successor" in r.message
+        # armed + plane active: a NON-write-point rule keeps the plane
+        # active WITHOUT standing the native head down (write-point rules
+        # disable native serving entirely — the C workers can't evaluate
+        # plane rules per request)
+        bugs.arm("native_commit_skip_crc")
+        plane().configure("point=storage.read,kind=delay_ms,arg=0")
+        try:
+            self._sync_all(env)
+            s2 = head.fastpath_write_stats()
+            r2 = sc.write_chunk(CHAIN, cid, 200, b"y" * 50,
+                                chunk_size=CHUNK)
+            assert r2.ok, r2
+            assert head.fastpath_write_stats()[0] > s2[0], \
+                "the bug must fire on the NATIVE path"
+            metas = {nid: env["nodes"][nid]["target"].engine.get_meta(cid)
+                     for nid in (10, 11)}
+            assert metas[10].committed_ver == metas[11].committed_ver == 3
+            assert metas[10].checksum.value != metas[11].checksum.value, \
+                "the skipped cross-check is what kept replicas converged"
+        finally:
+            bugs.disarm()
+            plane().clear()
+            self._sync_all(env)
+
+    def test_write_fault_rule_stands_head_down(self, native_chain):
+        """While the fault plane carries a rule that could fire on this
+        node's PYTHON write path, head serving stands down for the sync —
+        the chaos schedule must keep injecting into the path it armed."""
+        from tpu3fs.utils.fault_injection import plane
+
+        env = native_chain
+        sc = _client_for(env)
+        plane().configure("point=storage.update,kind=delay_ms,arg=0")
+        try:
+            self._sync_all(env)
+            head = env["nodes"][10]["server"]
+            s0 = head.fastpath_write_stats()
+            assert sc.write_chunk(CHAIN, ChunkId(34, 0), 0, b"d" * 100,
+                                  chunk_size=CHUNK).ok
+            assert head.fastpath_write_stats()[0] == s0[0], \
+                "armed write-point rule must disable native head serving"
+        finally:
+            plane().clear()
+        self._sync_all(env)
+        s1 = env["nodes"][10]["server"].fastpath_write_stats()
+        assert sc.write_chunk(CHAIN, ChunkId(34, 1), 0, b"d" * 100,
+                              chunk_size=CHUNK).ok
+        assert env["nodes"][10]["server"].fastpath_write_stats()[0] > s1[0]
+
+
+class TestNativeHeadWriteGates:
+    def test_tenant_throttle_rides_native_and_python_identically(
+            self, native_node, monkeypatch):
+        """TENANT_THROTTLED + typed retry_after_ms through the native head
+        gate, and the same hint through the Python dispatch under the A/B
+        lever (satellite: the hints must survive the path swap)."""
+        from tpu3fs.client.storage_client import RetryOptions, StorageClient
+        from tpu3fs.qos.core import AdmissionController, QosConfig
+        from tpu3fs.tenant import registry, tenant_scope
+
+        env = native_node
+        server, svc = env["server"], env["svc"]
+        if not hasattr(server._lib, "tpu3fs_rpc_tenant_set"):
+            pytest.skip("stale libtpu3fs_rpc.so: no tenant gate")
+        sc = StorageClient(
+            "wg-test", env["mcli"].refresh_routing,
+            RpcMessenger(env["mcli"].refresh_routing, env["client"]),
+            retry=RetryOptions(max_retries=0, backoff_base_s=0.001))
+        assert sc.write_chunk(CHAIN, ChunkId(40, 0), 0, b"x" * 512,
+                              chunk_size=CHUNK).ok
+        # admission installed AFTER the setup write; the registry reload
+        # hook pushes wg-alice's quota into the C gate
+        server.set_admission(AdmissionController(QosConfig()))
+        assert sync_read_fastpath(server, svc) == 1
+        try:
+            registry().configure("tenant=wg-alice,iops=2,burst_s=1")
+            s0 = server.fastpath_write_stats()
+            shed0 = server.tenant_shed_count()
+            with tenant_scope("wg-alice"):
+                native = [sc.batch_write(
+                    [(CHAIN, ChunkId(40, 1), 0, b"n" * 256)],
+                    chunk_size=CHUNK)[0] for _ in range(10)]
+            assert server.fastpath_write_stats()[0] > s0[0], \
+                "flood never reached the native head path"
+            assert server.tenant_shed_count() > shed0, \
+                "flood never reached the native tenant gate"
+            throttled = [r for r in native
+                         if r.code == Code.TENANT_THROTTLED]
+            assert throttled, [r.code for r in native]
+            assert all(r.retry_after_ms > 0 for r in throttled)
+            # the A/B lever: the same flood through the Python dispatch
+            # carries the same typed hint
+            monkeypatch.setenv("TPU3FS_NATIVE_WRITE", "0")
+            sync_read_fastpath(server, svc)
+            s1 = server.fastpath_write_stats()
+            with tenant_scope("wg-alice"):
+                pyth = [sc.batch_write(
+                    [(CHAIN, ChunkId(40, 2), 0, b"p" * 256)],
+                    chunk_size=CHUNK)[0] for _ in range(10)]
+            assert server.fastpath_write_stats()[0] == s1[0], \
+                "lever off: the head must not serve natively"
+            py_throttled = [r for r in pyth
+                            if r.code == Code.TENANT_THROTTLED]
+            assert py_throttled, [r.code for r in pyth]
+            assert all(r.retry_after_ms > 0 for r in py_throttled)
+            # untenanted (default, unconfigured) traffic is untouched
+            assert sc.write_chunk(CHAIN, ChunkId(40, 3), 0, b"z" * 64,
+                                  chunk_size=CHUNK).ok
+        finally:
+            registry().clear()

@@ -500,3 +500,38 @@ class TestServingHostSurface:
         fleets, peers = _fleet_pair(fab)
         with pytest.raises(FsError):
             peers.hosts[1].load(ServingLoadReq(op="scan", keys=["k"]))
+
+    def test_put_leg_batch_drains_through_one_batch_create(self, fab):
+        """--batch N applies to the PUT leg too: the drain routes through
+        cache.batch_put — one batch_create RPC per chunk of keys and ZERO
+        per-key serial meta.create round trips (the drain-path audit: a
+        batched put leg must never degrade to N create round trips)."""
+        fleets, peers = _fleet_pair(fab)
+        host = peers.hosts[1]
+        keys = [f"bload/{i}" for i in range(8)]
+        calls = {"create": 0, "batch_create": 0}
+        real_create = fab.meta.create
+        real_batch_create = fab.meta.batch_create
+
+        def spy_create(*a, **kw):
+            calls["create"] += 1
+            return real_create(*a, **kw)
+
+        def spy_batch_create(items, *a, **kw):
+            calls["batch_create"] += 1
+            return real_batch_create(items, *a, **kw)
+
+        fab.meta.create = spy_create
+        fab.meta.batch_create = spy_batch_create
+        try:
+            put = host.load(ServingLoadReq(
+                op="put", keys=keys, value_bytes=128, concurrency=2,
+                batch=4, write_through=True))
+        finally:
+            fab.meta.create = real_create
+            fab.meta.batch_create = real_batch_create
+        assert put.ops == 8 and put.errors == 0
+        assert calls["batch_create"] == 2, calls
+        assert calls["create"] == 0, calls
+        for k in keys:
+            assert fleets[1].get(k) == b"\xa5" * 128

@@ -44,6 +44,15 @@ and verifies, per deployment unit:
    METHODS`` in tpu3fs/metashard/twophase.py) is held to the same
    idempotent-or-replay-safe rule, and the ``meta.twophase.*``
    coordinator-kill fault surface is registered with the chaos harness.
+10. NATIVE FAST-PATH PARITY — every StorageSerde method the C++
+   transport may serve below Python (``NATIVE_SERVED_METHODS`` in
+   tpu3fs/storage/native_fastpath.py) is bound under EXACTLY the wire
+   method id the C side hardcodes, and carries the full classification
+   triple — QoS, idempotency, tenant enforcement — identical in
+   presence to the Python dispatch's tables. The C workers enforce
+   admission/tenancy from compiled-in per-method behavior; this check
+   makes a drifted wire id or an unclassified natively-served method a
+   static failure instead of an admission bypass.
 
 Cross-binary service-id reuse (Kv and MonitorCollector both use 5) is
 reported as a note, not a failure — they never share a process.
@@ -612,6 +621,58 @@ def check_twophase_replay(registries: List[_Registry]) -> List[str]:
     return errors
 
 
+# -- native fast-path parity -------------------------------------------------
+
+def check_native_served(registries: List[_Registry]) -> List[str]:
+    """Check 10 — see the module doc. The declaration lives next to the
+    registration code (storage/native_fastpath.py) so growing the C
+    surface without growing the declaration is the visible diff."""
+    from tpu3fs.rpc.idempotency import classify
+    from tpu3fs.storage.native_fastpath import NATIVE_SERVED_METHODS
+    from tpu3fs.tenant.enforcement import enforcement_of
+
+    errors: List[str] = []
+    storage = None
+    for reg in registries:
+        for service in reg.services.values():
+            if service.name == "StorageSerde":
+                storage = service
+                break
+        if storage is not None:
+            break
+    if storage is None:
+        return ["check_native_served: no binary binds StorageSerde"]
+    if not NATIVE_SERVED_METHODS:
+        return ["NATIVE_SERVED_METHODS is empty — the native transport "
+                "declares no served surface; check 10 is dead"]
+    by_name = {m.name: mid for mid, m in storage.methods.items()}
+    for name, wire_id in sorted(NATIVE_SERVED_METHODS.items()):
+        bound_id = by_name.get(name)
+        if bound_id is None:
+            errors.append(
+                f"NATIVE_SERVED_METHODS lists StorageSerde.{name}, which "
+                "the bound table does not carry (stale declaration)")
+            continue
+        if bound_id != wire_id:
+            errors.append(
+                f"StorageSerde.{name}: bound under method id {bound_id} "
+                f"but the C++ fast path hardcodes {wire_id} — the native "
+                "workers would serve a DIFFERENT method's frames")
+        tclass = default_class_for(name)
+        if not isinstance(tclass, TrafficClass) or tclass not in CLASS_ATTRS:
+            errors.append(f"natively served StorageSerde.{name}: no QoS "
+                          "classification (the C admission gate has no "
+                          "class to key on)")
+        if classify("StorageSerde", name) is None:
+            errors.append(f"natively served StorageSerde.{name}: no "
+                          "idempotency classification")
+        if enforcement_of("StorageSerde", name) is None:
+            errors.append(f"natively served StorageSerde.{name}: no "
+                          "tenant enforcement classification (the C "
+                          "tenant gate would charge nothing)")
+    return errors
+
+
 # -- driver ------------------------------------------------------------------
 
 def run_checks() -> Tuple[List[str], List[str]]:
@@ -628,6 +689,7 @@ def run_checks() -> Tuple[List[str], List[str]]:
     errors.extend(check_usrbio_ring(registries))
     errors.extend(check_migration_resume(registries))
     errors.extend(check_twophase_replay(registries))
+    errors.extend(check_native_served(registries))
 
     # cross-binary id reuse (informational)
     by_id: Dict[int, set] = {}

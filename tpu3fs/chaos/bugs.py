@@ -53,6 +53,19 @@ Known bugs:
   stale block must surface as a MISS, never as fabricated bytes).
   Caught by the ``kvcache_stale`` checker on the serving sidecar's
   read records.
+
+- ``native_commit_skip_crc`` — the native-write-path bug shape: the C++
+  head fast path (native/rpc_net.cpp) commits and acks a chain write
+  WITHOUT cross-checking the successor's checksum against the staged
+  CRC — the one guard that catches a payload corrupted in flight or a
+  replica staging divergent bytes (ref StorageOperator.cc :464-482).
+  Armed state is pushed into the .so each target scan
+  (storage/native_fastpath.py -> fastpath_set_skip_crc). With the check
+  skipped, a corrupted forward commits DIFFERENT bytes on head and
+  successor while both report OK. Caught by the ``replica_crc``
+  invariant checker (post-storm: committed replicas of every chunk must
+  agree on CRC), and by ``crc_oracle`` when a read lands on the
+  divergent replica.
 """
 
 from __future__ import annotations
@@ -71,7 +84,7 @@ _armed: Set[str] = set(
 #: arm()/hook pair must fail loudly, not silently never fire)
 KNOWN_BUGS = frozenset({
     "commit_skip", "chain_parity_skip", "peer_fill_stale",
-    "rename_orphan_intent",
+    "rename_orphan_intent", "native_commit_skip_crc",
 })
 
 

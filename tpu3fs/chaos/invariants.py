@@ -116,6 +116,13 @@ class ChaosContext:
     # "actual": {path: inode_id | None}, "dangling": int} after the
     # quiesce-time forced resolution
     meta_audit: Optional[Callable] = None
+    # native-write sidecar probe records: (label, acked, (head committed
+    # ver, head crc), (successor committed ver, successor crc)) per
+    # chain write issued through the C++ head against manufactured
+    # replica divergence
+    native_write_replicas: List[
+        Tuple[str, bool, Tuple[int, int], Tuple[int, int]]] = field(
+        default_factory=list)
 
 
 _REGISTRY: Dict[str, Callable[[ChaosContext], Optional[List[Violation]]]] = {}
@@ -375,6 +382,29 @@ def _check_kvcache_stale(ctx: ChaosContext):
             f"serving get of {key!r} returned {kind} no client ever put "
             f"— a peer served a GC'd block without the staleness "
             f"re-probe (must surface as KVCACHE_STALE/miss)"))
+    return bad
+
+
+@register("replica_crc")
+def _check_replica_crc(ctx: ChaosContext):
+    """An OK-acked chain write must leave every replica it touched
+    committed at the same version with the SAME CRC — the successor
+    cross-check is the guard (planted bug: native_commit_skip_crc skips
+    it in the C++ head and acks divergent replicas as clean)."""
+    if not ctx.native_write_replicas:
+        return None
+    bad: List[Violation] = []
+    for label, acked, (h_ver, h_crc), (s_ver, s_crc) in \
+            ctx.native_write_replicas:
+        if not acked:
+            continue  # refused writes may leave replicas wherever
+        if h_ver == s_ver and h_crc != s_crc:
+            bad.append(Violation(
+                "replica_crc",
+                f"write {label} acked OK but committed DIVERGENT "
+                f"replicas: head crc {h_crc:#x} != successor "
+                f"{s_crc:#x} at ver {h_ver} — the head committed "
+                f"without cross-checking the successor's checksum"))
     return bad
 
 

@@ -140,6 +140,11 @@ class ScheduleSpec:
     # two-phase renames with src-name recycling racing the crash
     # resolver) so the meta_intents checker judges the run too
     meta_shard: bool = False
+    # run the native-write sidecar (a REAL 2-node native-socket chain
+    # beside the fabric — the C++ head write path never runs in-fabric,
+    # the fabric messenger is direct-call) so the replica_crc checker
+    # judges the run too
+    native_write: bool = False
     allow_kill: bool = True
     allow_elastic: bool = False      # join/drain events (need a worker)
     allow_config_push: bool = True

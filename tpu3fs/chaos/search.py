@@ -145,12 +145,15 @@ class FabricRunner:
         self._train = None
         self._serving = None
         self._meta = None
+        self._native = None
         if spec.train_workload:
             self._train_setup()
         if spec.kv_serving:
             self._serving_setup()
         if spec.meta_shard:
             self._metashard_setup()
+        if spec.native_write:
+            self._native_setup()
         report = RunReport(self.schedule)
         by_step: Dict[int, List[ChaosEvent]] = {}
         for e in self.schedule.events:
@@ -168,6 +171,7 @@ class FabricRunner:
                 self._train_tick(step)
                 self._serving_tick(step)
                 self._metashard_tick(step)
+                self._native_tick(step)
                 self._background_tick()
             self._quiesce()
             ctx = self._context()
@@ -195,6 +199,7 @@ class FabricRunner:
                         fleet.close(flush=False)
                     except Exception:
                         pass
+            self._native_cleanup()
             if self._tenants_touched:
                 from tpu3fs.tenant.quota import registry
 
@@ -667,6 +672,168 @@ class FabricRunner:
         return {"expected": dict(ms["expected"]), "actual": actual,
                 "dangling": dangling}
 
+    # -- native-write sidecar (replica_crc checker in the SEARCH) -------------
+    _NATIVE_CHAIN = 730_001
+
+    def _native_setup(self) -> None:
+        """A REAL 2-node native-socket chain beside the fabric: the C++
+        head write path (fp_try_head_write) never runs in-fabric — the
+        fabric messenger is direct-call, no sockets — so exercising the
+        planted ``native_commit_skip_crc`` bug needs its own cluster.
+        Every other step the sidecar manufactures the state an in-flight
+        corruption leaves (both replicas committed, DIFFERENT bytes) and
+        pushes a partial-offset write through the native head: with the
+        cross-check intact the write is REFUSED; with the bug armed the
+        head acks OK over divergent replicas and ``replica_crc`` fires.
+        Setup failures (no libtpu3fs_rpc.so / native engine) leave the
+        sidecar off and the checker SKIPPED — never a false verdict."""
+        import tempfile
+
+        try:
+            from tpu3fs.client.storage_client import (
+                RetryOptions,
+                StorageClient,
+            )
+            from tpu3fs.kv.mem import MemKVEngine
+            from tpu3fs.mgmtd.service import Mgmtd
+            from tpu3fs.mgmtd.types import LocalTargetState, NodeType
+            from tpu3fs.rpc.native_net import NativeRpcClient, NativeRpcServer
+            from tpu3fs.rpc.services import (
+                MgmtdRpcClient,
+                RpcMessenger,
+                bind_mgmtd_service,
+                bind_storage_service,
+            )
+            from tpu3fs.storage.craq import StorageService
+            from tpu3fs.storage.target import StorageTarget
+
+            tmp = tempfile.mkdtemp(prefix="tpu3fs-chaos-native-")
+            nat = {"tmp": tmp, "records": [], "n": 0, "nodes": {},
+                   "servers": [], "chunk": 1 << 12}
+            mgmtd = Mgmtd(1, MemKVEngine())
+            mgmtd.extend_lease()
+            mgmtd_server = NativeRpcServer()
+            bind_mgmtd_service(mgmtd_server, mgmtd)
+            mgmtd_server.start()
+            nat["servers"].append(mgmtd_server)
+            client = NativeRpcClient()
+            nat["client"] = client
+            mcli = MgmtdRpcClient(mgmtd_server.address, client)
+            for node_id, tid in ((210, 7300), (211, 7301)):
+                svc = StorageService(node_id, mcli.refresh_routing)
+                svc.set_messenger(RpcMessenger(mcli.refresh_routing, client))
+                target = StorageTarget(
+                    tid, self._NATIVE_CHAIN, engine="native",
+                    path=os.path.join(tmp, f"t{tid}"),
+                    chunk_size=nat["chunk"])
+                svc.add_target(target)
+                server = NativeRpcServer()
+                bind_storage_service(server, svc)
+                server.start()
+                nat["servers"].append(server)
+                mgmtd.register_node(node_id, NodeType.STORAGE,
+                                    host=server.host, port=server.port)
+                mgmtd.create_target(tid, node_id=node_id)
+                nat["nodes"][node_id] = {"svc": svc, "server": server,
+                                         "target": target}
+            mgmtd.upload_chain(self._NATIVE_CHAIN, [7300, 7301])
+            mgmtd.upload_chain_table(1, [self._NATIVE_CHAIN])
+            for node_id, tid in ((210, 7300), (211, 7301)):
+                mgmtd.heartbeat(node_id, 1,
+                                {tid: LocalTargetState.UPTODATE})
+            nat["sc"] = StorageClient(
+                "chaos-native", mcli.refresh_routing,
+                RpcMessenger(mcli.refresh_routing, client),
+                retry=RetryOptions(max_retries=0, backoff_base_s=0.001))
+            head = nat["nodes"][210]
+            if getattr(head["server"], "fastpath_sync_head", None) is None:
+                raise RuntimeError("no head write fast path in this .so")
+            self._native = nat
+        except Exception:
+            # half-built cluster: tear down whatever started, then run
+            # without the sidecar (replica_crc reports SKIPPED)
+            self._native = locals().get("nat")
+            self._native_cleanup()
+            self._native = None
+
+    def _native_tick(self, step: int) -> None:
+        """Every other step: re-sync the registries (this pushes the
+        plane/bug arm state into the .so — exactly what the production
+        target scan does), then one baseline chain write, manufactured
+        divergence, and a partial-offset probe write through the native
+        head. Only COMPLETED probes are recorded for the checker."""
+        nat = self._native
+        if nat is None or step % 2 == 0:
+            return
+        from tpu3fs.storage.native_fastpath import sync_read_fastpath
+        from tpu3fs.storage.types import ChunkId
+        from tpu3fs.utils.result import FsError
+
+        for n in nat["nodes"].values():
+            sync_read_fastpath(n["server"], n["svc"])
+        nat["n"] += 1
+        k = nat["n"]
+        cid = ChunkId(50, k)
+        sc = nat["sc"]
+        chunk = nat["chunk"]
+        try:
+            if not sc.write_chunk(self._NATIVE_CHAIN, cid, 0,
+                                  bytes([k & 0xFF]) * 1024,
+                                  chunk_size=chunk).ok:
+                return
+        except (FsError, ConnectionError):
+            return
+        # manufactured divergence BELOW the chain: both replicas
+        # committed at the next version with different bytes (there is
+        # no corruption fault kind — this is the state one leaves)
+        try:
+            chain_ver = nat["sc"]._chain(self._NATIVE_CHAIN).chain_version
+            for node_id, fill in ((210, b"H"), (211, b"T")):
+                eng = nat["nodes"][node_id]["target"].engine
+                eng.update(cid, 2, chain_ver, fill * 1024, 0,
+                           chunk_size=chunk)
+                eng.commit(cid, 2, chain_ver)
+        except (FsError, ConnectionError):
+            return
+        try:
+            rep = sc.write_chunk(self._NATIVE_CHAIN, cid, 100, b"x" * 50,
+                                 chunk_size=chunk)
+        except (FsError, ConnectionError):
+            return
+        try:
+            hm = nat["nodes"][210]["target"].engine.get_meta(cid)
+            sm = nat["nodes"][211]["target"].engine.get_meta(cid)
+        except (FsError, ConnectionError):
+            return
+        nat["records"].append((
+            f"probe-{k}", bool(rep.ok),
+            (hm.committed_ver, hm.checksum.value),
+            (sm.committed_ver, sm.checksum.value)))
+
+    def _native_cleanup(self) -> None:
+        import shutil
+
+        nat = self._native
+        if nat is None:
+            return
+        for n in nat.get("nodes", {}).values():
+            try:
+                n["server"].stop()
+                n["svc"].stop_workers()
+            except Exception:
+                pass
+        try:
+            if nat.get("client") is not None:
+                nat["client"].close()
+        except Exception:
+            pass
+        try:
+            if nat.get("servers"):
+                nat["servers"][0].stop()  # mgmtd
+        except Exception:
+            pass
+        shutil.rmtree(nat["tmp"], ignore_errors=True)
+
     # -- quiesce + verdict ----------------------------------------------------
     def _quiesce(self) -> None:
         from tpu3fs.placement.rebalance import DRAINING_TAG
@@ -735,6 +902,8 @@ class FabricRunner:
                            if self._serving is not None else []),
             meta_audit=(self._metashard_audit
                         if self._meta is not None else None),
+            native_write_replicas=(self._native["records"]
+                                   if self._native is not None else []),
             **train,
         )
 

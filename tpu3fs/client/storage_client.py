@@ -396,7 +396,14 @@ class StorageClient:
                 try:
                     reply = self._messenger(node.node_id, "write", req)
                 except FsError as e:
-                    reply = UpdateReply(e.code, message=e.status.message)
+                    # envelope-level sheds (native gates, dispatch
+                    # admission) carry their retry-after only in the
+                    # message: keep it in the typed field, like reads do
+                    from tpu3fs.qos.core import retry_after_ms_of
+
+                    reply = UpdateReply(
+                        e.code, message=e.status.message,
+                        retry_after_ms=retry_after_ms_of(e.status.message))
                 if reply.ok:
                     return reply
                 last = reply

@@ -33,7 +33,7 @@ from tpu3fs.utils.result import Code, FsError, Status
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libtpu3fs_rpc.so")
 
-_ABI_VERSION = 4  # must match tpu3fs_rpc_abi_version() in rpc_net.cpp
+_ABI_VERSION = 5  # must match tpu3fs_rpc_abi_version() in rpc_net.cpp
 
 _HANDLER_T = ctypes.CFUNCTYPE(
     ctypes.c_int64,                      # status
@@ -186,6 +186,48 @@ def _load_lib():
                 ctypes.c_void_p, ctypes.c_uint64]
             lib.tpu3fs_rpc_tenant_shed_count.restype = ctypes.c_uint64
             lib.tpu3fs_rpc_tenant_shed_count.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "tpu3fs_rpc_fastpath_install_head"):  # ABI v5+
+            lib.tpu3fs_rpc_fastpath_install_head.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+            lib.tpu3fs_rpc_fastpath_set_head_chain.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+            lib.tpu3fs_rpc_fastpath_skip_crc.argtypes = [
+                ctypes.c_void_p, ctypes.c_int]
+            lib.tpu3fs_rpc_fastpath_write_stats.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.tpu3fs_rpc_chan_check.restype = ctypes.c_int
+            lib.tpu3fs_rpc_chan_check.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_size_t)]
+            lib.tpu3fs_rpc_chan_store.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_char_p, ctypes.c_size_t]
+            lib.tpu3fs_rpc_chan_prune.restype = ctypes.c_uint64
+            lib.tpu3fs_rpc_chan_prune.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p]
+            lib.tpu3fs_rpc_chan_len.restype = ctypes.c_uint64
+            lib.tpu3fs_rpc_chan_len.argtypes = [ctypes.c_void_p]
+            lib.tpu3fs_rpc_chunk_lock.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+            lib.tpu3fs_rpc_chunk_unlock.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+            lib.tpu3fs_rpc_fastpath_serve.restype = ctypes.c_int
+            lib.tpu3fs_rpc_fastpath_serve.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_size_t), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.c_char_p)]
         _lib = lib
         return lib
 
@@ -433,6 +475,160 @@ class NativeRpcServer:
             self._lib.tpu3fs_rpc_fastpath_stats(
                 self._srv, ctypes.byref(hits), ctypes.byref(fallbacks))
         return hits.value, fallbacks.value
+
+    # -- head-side write fast path (ABI v5: native/rpc_net.cpp) --------------
+    def fastpath_sync_head(self, stage_fn, commit_fn, wanted: dict) -> None:
+        """Install the head-chain registry:
+        {chain_id: (engine_handle, target_id, chain_ver, chunk_size,
+        reject_create, succ_host, succ_port)} — chains whose LOCAL target
+        is the serving head (succ_port 0 = single-member chain, no
+        forward). Call AFTER fastpath_sync (whose clear() drops all three
+        registries)."""
+        if self._srv is None or not hasattr(
+                self._lib, "tpu3fs_rpc_fastpath_install_head"):
+            return
+        if stage_fn is not None and commit_fn is not None:
+            self._lib.tpu3fs_rpc_fastpath_install_head(
+                self._srv, stage_fn, commit_fn)
+        for chain_id, (h, target_id, chain_ver, chunk_size, reject_create,
+                       succ_host, succ_port) in wanted.items():
+            self._lib.tpu3fs_rpc_fastpath_set_head_chain(
+                self._srv, chain_id, h, target_id, chain_ver, chunk_size,
+                1 if reject_create else 0,
+                (succ_host or "").encode(), int(succ_port))
+
+    def fastpath_set_skip_crc(self, enable: bool) -> None:
+        """Arm/disarm the planted chaos bug native_commit_skip_crc: the
+        native head commits + acks without verifying the successor."""
+        if self._srv is not None and hasattr(
+                self._lib, "tpu3fs_rpc_fastpath_skip_crc"):
+            self._lib.tpu3fs_rpc_fastpath_skip_crc(
+                self._srv, 1 if enable else 0)
+
+    def fastpath_write_stats(self):
+        """-> (write_served, write_fallbacks, forward_us)."""
+        served = ctypes.c_uint64(0)
+        fallbacks = ctypes.c_uint64(0)
+        fwd_us = ctypes.c_uint64(0)
+        if self._srv is not None and hasattr(
+                self._lib, "tpu3fs_rpc_fastpath_write_stats"):
+            self._lib.tpu3fs_rpc_fastpath_write_stats(
+                self._srv, ctypes.byref(served), ctypes.byref(fallbacks),
+                ctypes.byref(fwd_us))
+        return served.value, fallbacks.value, fwd_us.value
+
+    # -- shared exactly-once channel table (C mirror of _ChannelTable) -------
+    def chan_check(self, client_id: str, channel_id: int, seqnum: int):
+        """-> (0, None) fresh / (1, reply bytes) cached dup / (2, None)
+        stale seqnum."""
+        if self._srv is None or not hasattr(self._lib,
+                                            "tpu3fs_rpc_chan_check"):
+            return 0, None
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t(0)
+        rc = self._lib.tpu3fs_rpc_chan_check(
+            self._srv, client_id.encode(), channel_id, seqnum,
+            ctypes.byref(out), ctypes.byref(out_len))
+        reply = None
+        if rc == 1:
+            reply = ctypes.string_at(out, out_len.value) \
+                if out_len.value else b""
+            self._lib.tpu3fs_rpc_free(ctypes.cast(out, ctypes.c_void_p))
+        return rc, reply
+
+    def chan_store(self, client_id: str, channel_id: int, seqnum: int,
+                   reply: bytes) -> None:
+        if self._srv is not None and hasattr(self._lib,
+                                             "tpu3fs_rpc_chan_store"):
+            self._lib.tpu3fs_rpc_chan_store(
+                self._srv, client_id.encode(), channel_id, seqnum,
+                reply, len(reply))
+
+    def chan_prune(self, client_id: str) -> int:
+        if self._srv is not None and hasattr(self._lib,
+                                             "tpu3fs_rpc_chan_prune"):
+            return int(self._lib.tpu3fs_rpc_chan_prune(
+                self._srv, client_id.encode()))
+        return 0
+
+    def chan_len(self) -> int:
+        if self._srv is None or not hasattr(self._lib,
+                                            "tpu3fs_rpc_chan_len"):
+            return 0
+        return int(self._lib.tpu3fs_rpc_chan_len(self._srv))
+
+    # -- shared per-chunk write interlock ------------------------------------
+    def chunk_lock(self, keys: bytes) -> None:
+        """Acquire the C-side chunk locks for len(keys)//12 concatenated
+        12-byte keys (all-or-wait; the ctypes call releases the GIL, so
+        blocking on a native worker's hold is safe)."""
+        if self._srv is not None and hasattr(self._lib,
+                                             "tpu3fs_rpc_chunk_lock"):
+            self._lib.tpu3fs_rpc_chunk_lock(self._srv, keys, len(keys) // 12)
+
+    def chunk_unlock(self, keys: bytes) -> None:
+        if self._srv is not None and hasattr(self._lib,
+                                             "tpu3fs_rpc_chunk_unlock"):
+            self._lib.tpu3fs_rpc_chunk_unlock(
+                self._srv, keys, len(keys) // 12)
+
+    # -- out-of-loop serve (dispatch_packet's native hook) -------------------
+    def fastpath_serve(self, pkt, bulk):
+        """First-refusal native serve for frames that arrived outside the
+        C socket loop (the USRBIO ring host routes SQEs through
+        dispatch_packet, which calls this when present). -> None when the
+        Python dispatch must run, else (status, payload bytes, message) —
+        the whole stage/forward/commit runs with the GIL released."""
+        if (self._srv is None or not self._started or not hasattr(
+                self._lib, "tpu3fs_rpc_fastpath_serve")):
+            return None
+        payload = bytes(pkt.payload)
+        buf = (ctypes.c_uint8 * max(len(payload), 1)).from_buffer_copy(
+            payload or b"\x00")
+        n_iovs = -1
+        ptrs = None
+        lens = None
+        keepalive = []
+        if bulk is not None:
+            n_iovs = len(bulk)
+            ptrs = (ctypes.c_void_p * max(n_iovs, 1))()
+            lens = (ctypes.c_size_t * max(n_iovs, 1))()
+            for i, iov in enumerate(bulk):
+                if isinstance(iov, bytes):
+                    ref = ctypes.c_char_p(iov)
+                    keepalive.append((iov, ref))
+                    ptrs[i] = ctypes.cast(ref, ctypes.c_void_p)
+                    lens[i] = len(iov)
+                    continue
+                try:  # writable buffers (shm ring views) borrow in place
+                    arr = (ctypes.c_char * len(iov)).from_buffer(iov)
+                    keepalive.append(arr)
+                    ptrs[i] = ctypes.addressof(arr)
+                    lens[i] = len(iov)
+                except (TypeError, ValueError):
+                    b = bytes(iov)
+                    ref = ctypes.c_char_p(b)
+                    keepalive.append((b, ref))
+                    ptrs[i] = ctypes.cast(ref, ctypes.c_void_p)
+                    lens[i] = len(b)
+        status = ctypes.c_int64(0)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t(0)
+        out_msg = ctypes.c_char_p()
+        rc = self._lib.tpu3fs_rpc_fastpath_serve(
+            self._srv, pkt.service_id, pkt.method_id, pkt.flags,
+            (pkt.message or "").encode(), buf, len(payload),
+            ptrs, lens, n_iovs,
+            ctypes.byref(status), ctypes.byref(out),
+            ctypes.byref(out_len), ctypes.byref(out_msg))
+        del keepalive
+        if rc == 0:
+            return None
+        reply = ctypes.string_at(out, out_len.value) if out_len.value else b""
+        message = (out_msg.value or b"").decode("utf-8", "replace")
+        self._lib.tpu3fs_rpc_free(ctypes.cast(out, ctypes.c_void_p))
+        self._lib.tpu3fs_rpc_free(ctypes.cast(out_msg, ctypes.c_void_p))
+        return int(status.value), reply, message
 
     # -- dispatch (same semantics as RpcServer._dispatch) -------------------
     def _handle(self, service_id, method_id, flags, req_msg, req_ptr,

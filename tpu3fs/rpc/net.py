@@ -369,6 +369,23 @@ def dispatch_packet(server, pkt: MessagePacket, bulk=None):
             pkt, Code.DEADLINE_EXCEEDED,
             f"deadline passed {time.time() - dl:.3f}s before "
             f"{service.name}.{mdef.name} admission"), None
+    # native write fast path for frames that arrived OUTSIDE the C socket
+    # loop (the USRBIO ring host dispatches SQEs through here): a server
+    # exposing fastpath_serve (NativeRpcServer) gets first refusal — the
+    # C side runs its own admission/tenant gates and exactly-once table,
+    # and returns None for anything it can't prove, which then takes the
+    # normal dispatch below exactly as a socket-path fallback would.
+    serve = getattr(server, "fastpath_serve", None)
+    if serve is not None:
+        served = serve(pkt, bulk)
+        if served is not None:
+            status, payload, message = served
+            ts.server_run_start = ts.server_run_end = time.monotonic()
+            return MessagePacket(
+                uuid=pkt.uuid, service_id=pkt.service_id,
+                method_id=pkt.method_id, flags=0, status=status,
+                payload=payload, message=message, timestamps=ts,
+            ), None
     # TENANT resolution + quota admission (tenant/quota.py): every
     # envelope resolves an owner (explicit u1.* token or "default"),
     # and methods the enforcement table classifies bytes/iops charge

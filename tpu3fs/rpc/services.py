@@ -918,10 +918,19 @@ class RpcMessenger:
                 except FsError as e:
                     err = e
                     self._observe(node_id, t_issue, err=err)
+            # envelope-level sheds (native write gates, dispatch
+            # admission) carry their retry-after hint only in the
+            # message: surface it in the typed field, mirroring the
+            # read-side fill above, so client ladders honor the hint
+            # whether the shed came from Python or the C fast path
+            from tpu3fs.qos.core import retry_after_ms_of
+
+            hint = retry_after_ms_of(err.status.message)
             for i in range(lo, min(hi, len(results[gi]))):
                 if results[gi][i] is None:
                     results[gi][i] = UpdateReply(err.code,
-                                                 message=err.status.message)
+                                                 message=err.status.message,
+                                                 retry_after_ms=hint)
         for out in results:
             for i, r in enumerate(out):
                 if r is None:  # short reply list from a confused server

@@ -257,7 +257,12 @@ class ServingHost:
         if req.drop_host:
             self.cache.tier.clear()
         c0 = self._fleet_counters()
-        stride = max(1, int(req.batch)) if req.op == "get" else 1
+        # batch applies to BOTH legs: batched gets ride batch_get's
+        # node-grouped fan-out, batched puts ride batch_put's single
+        # batch_create + striped write + batch_close drain — a put leg
+        # with --batch N must never degrade to N serial create round
+        # trips (the meta-bound half of the write number)
+        stride = max(1, int(req.batch))
         tasks = list(req.keys) * max(1, req.repeat)
         chunks = [tasks[i:i + stride] for i in range(0, len(tasks), stride)]
         nworkers = max(1, min(int(req.concurrency), max(1, len(chunks))))
@@ -287,6 +292,11 @@ class ServingHost:
                         v = self.cache.get(chunk[0])
                         hit = int(v is not None)
                         n = len(v) if v is not None else 0
+                    elif stride > 1:
+                        self.cache.batch_put(
+                            [(k, value) for k in chunk],
+                            write_through=req.write_through)
+                        hit, n = len(chunk), len(value) * len(chunk)
                     else:
                         self.cache.put(chunk[0], value,
                                        write_through=req.write_through)

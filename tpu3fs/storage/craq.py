@@ -343,6 +343,15 @@ class _ChannelTable:
                 del self._slots[k]
             return len(victims)
 
+    def snapshot_slots(self):
+        """-> [(client_id, channel_id, seqnum, reply)] — migration feed
+        when the table is swapped for the native (C-side) channel table
+        (tpu3fs/storage/native_fastpath.py), so retries in flight across
+        the swap still deduplicate."""
+        with self._lock:
+            return [(cid, chan, seq, reply)
+                    for (cid, chan), (seq, reply, _) in self._slots.items()]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._slots)
@@ -480,6 +489,16 @@ class StorageService:
         # the C++ registry honors offline_target's immediate-refusal
         # contract instead of waiting for the next target scan
         self._fastpath_invalidate = None
+        # native WRITE fast path seams (storage/native_fastpath.py): the
+        # chains whose head writes the C++ workers may serve, and the C
+        # chunk-lock pair (lock_fn, unlock_fn) the Python write paths
+        # additionally take for those chains so a native-served and a
+        # fallback-served write to one chunk can never interleave
+        # between stage and commit. Native-head chains are guaranteed
+        # single-local-member, so the C lock (keyed by chunk id alone)
+        # can never be re-entered by an in-process chain forward.
+        self._native_write_chains = frozenset()
+        self._native_lock_fns = None
 
     def set_fastpath_invalidator(self, fn) -> None:
         self._fastpath_invalidate = fn
@@ -925,9 +944,33 @@ class StorageService:
             if lease is not None:
                 lease.release()
 
+    def _native_guard(self, chain_id: int, chunk_ids):
+        """Cross-path interlock: while a chain's head writes may be served
+        by the native (C++) fast path, the Python write paths additionally
+        hold the C chunk locks the native workers use, so the two paths
+        serialize per chunk. Chains outside the registry pay nothing."""
+        import contextlib
+
+        if chain_id not in self._native_write_chains \
+                or self._native_lock_fns is None:
+            return contextlib.nullcontext()
+        lock_fn, unlock_fn = self._native_lock_fns
+        keys = b"".join(sorted({c.to_bytes() for c in chunk_ids}))
+
+        @contextlib.contextmanager
+        def _guard():
+            lock_fn(keys)
+            try:
+                yield
+            finally:
+                unlock_fn(keys)
+
+        return _guard()
+
     # -- the shared brain (ref handleUpdate :333-514) -------------------------
     def _handle_update(self, target: StorageTarget, req: WriteReq) -> UpdateReply:
-        with self._chunk_lock(target.target_id, req.chunk_id):
+        with self._chunk_lock(target.target_id, req.chunk_id), \
+                self._native_guard(req.chain_id, (req.chunk_id,)):
             try:
                 inject("storage.update", node=self.node_id)
                 self._check_target_serving(target)
@@ -1653,6 +1696,14 @@ class StorageService:
                        for r in reqs})
         for key in keys:
             self._locks.acquire(key)
+        # cross-path interlock AFTER the Python locks (same order
+        # everywhere: Python lock -> C lock; native workers take only C)
+        native_keys = None
+        if reqs and reqs[0].chain_id in self._native_write_chains \
+                and self._native_lock_fns is not None:
+            native_keys = b"".join(  # copy-ok: 16B chunk KEYS, not payload
+                sorted({r.chunk_id.to_bytes() for r in reqs}))
+            self._native_lock_fns[0](native_keys)
         try:
             inject("storage.update", node=self.node_id)
             self._check_target_serving(target)
@@ -1816,6 +1867,8 @@ class StorageService:
                 if replies[i] is None:
                     replies[i] = UpdateReply(e.code, message=e.status.message)
         finally:
+            if native_keys is not None:
+                self._native_lock_fns[1](native_keys)
             for key in reversed(keys):
                 self._locks.release(key)
             wall_s = time.perf_counter() - t_wall

@@ -101,7 +101,7 @@ _lib_lock = threading.Lock()
 # native/chunk_engine.cpp. Checked as raw bytes in the .so BEFORE dlopen —
 # once a stale library is dlopen'ed, no in-process rebuild can replace it
 # (dlopen dedups by pathname), so the check has to happen first.
-_ABI_TAG = b"TPU3FS_ENGINE_ABI_5"
+_ABI_TAG = b"TPU3FS_ENGINE_ABI_6"
 
 
 def _abi_matches(path: str) -> bool:

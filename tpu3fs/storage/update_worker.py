@@ -49,6 +49,18 @@ from tpu3fs.tenant import identity as _tenant_id
 from tpu3fs.utils.result import Code
 
 
+# process-wide count of executed rounds — the observable seam that
+# separates the two write paths in tests: writes served by the native
+# C++ fast path never enqueue here, fallback/Python-served writes always
+# do. Monotonic; read-compare around an operation (tests), never reset.
+_ROUNDS_RUN = 0
+_rounds_lock = threading.Lock()
+
+
+def rounds_run() -> int:
+    return _ROUNDS_RUN
+
+
 class _Job:
     __slots__ = ("reqs", "replies", "done", "make_reply", "tclass",
                  "cost", "enq_ts", "sub_ts", "trace", "deadline",
@@ -273,6 +285,9 @@ class UpdateWorker:
         round_jobs = live
         if not round_jobs:
             return
+        global _ROUNDS_RUN
+        with _rounds_lock:
+            _ROUNDS_RUN += 1
         reqs = [r for j in round_jobs for r in j.reqs]
         # trace plumbing: per-job queue-wait stage spans, then the round
         # executes under a round scope so the runner's stage/forward/
