@@ -1,0 +1,183 @@
+"""scale_bench: control-plane latencies at N ∈ {100, 1000} in-process
+nodes driving the REAL mgmtd -> BENCH_SCALE.json.
+
+What a thousand-node deployment pays per heartbeat interval, measured
+against the real management plane (tpu3fs/scale, docs/scale.md) — not
+wall-clock IO:
+
+- heartbeat FAN-IN: one full round of N versioned heartbeats (storage
+  nodes reporting per-target local states) into mgmtd's KV-transacted
+  intake, per-beat mean/p99 and round total;
+- routing FAN-OUT: N pollers pulling getRoutingInfo with the reply
+  serialized, cold (every poller stale: full snapshot re-serialization
+  each) vs warm (every poller current: the version-gated tiny
+  ``changed=False`` reply) — the fast path's fleet-wide value;
+- chain-update SWEEP: one mgmtd.tick() over the full chain table;
+- whole-DOMAIN kill: detection + rotation cycle wall time, plus the
+  A/B — domain-aware placement loses zero chains' quorum, the same
+  kill under domain-blind placement demonstrably breaks chains;
+- REBALANCE planning: plan_rebalance wall time on a 10k-chain live
+  routing table (one dead node evacuated);
+- SLO aggregation at N series: windowed-aggregator ingest + SLO engine
+  evaluation with one series per node.
+
+Usage:
+  python -m benchmarks.scale_bench [--fast] [--out BENCH_SCALE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+from tpu3fs.monitor.agg import WindowedAggregator
+from tpu3fs.monitor.recorder import Sample
+from tpu3fs.monitor.slo import SloEngine
+from tpu3fs.placement.rebalance import TopologyDelta, plan_rebalance
+from tpu3fs.scale import ScaleConfig, ScaleFabric
+
+
+def _pct(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def bench_size(n: int, domains: int) -> dict:
+    sf = ScaleFabric(ScaleConfig(num_nodes=n, num_domains=domains))
+    lat = sorted(sf.heartbeat_round())
+    t0 = time.perf_counter()
+    sf.tick()
+    tick_s = time.perf_counter() - t0
+    cold_b, cold_s = sf.routing_fanout(up_to_date=False)
+    warm_b, warm_s = sf.routing_fanout(up_to_date=True)
+    t0 = time.perf_counter()
+    sf.kill_domain("d0")
+    kill_cycle_s = time.perf_counter() - t0
+    quorum = sf.quorum_report()
+    return {
+        "nodes": n,
+        "domains": domains,
+        "chains": len(sf.chain_ids),
+        "boot_s": round(sf.boot_s, 4),
+        "heartbeat_fanin": {
+            "round_s": round(sum(lat), 5),
+            "mean_us": round(sum(lat) / max(len(lat), 1) * 1e6, 1),
+            "p99_us": round(_pct(lat, 0.99) * 1e6, 1),
+        },
+        "tick_sweep_s": round(tick_s, 5),
+        "routing_fanout": {
+            "cold_bytes": cold_b,
+            "cold_s": round(cold_s, 4),
+            "warm_bytes": warm_b,
+            "warm_s": round(warm_s, 5),
+            "bytes_saved_ratio": round(1 - warm_b / max(cold_b, 1), 6),
+        },
+        "domain_kill": {
+            "cycle_s": round(kill_cycle_s, 4),
+            "chains_ok": quorum["ok"],
+            "chains_broken": quorum["broken"],
+        },
+    }
+
+
+def bench_domain_ab(n: int = 30, domains: int = 3) -> dict:
+    out = {}
+    for label, aware in (("aware", True), ("blind", False)):
+        sf = ScaleFabric(ScaleConfig(num_nodes=n, num_domains=domains,
+                                     domain_aware=aware))
+        violations = len(sf.domain_violations())
+        sf.kill_domain("d0")
+        q = sf.quorum_report()
+        out[label] = {"placement_violations": violations,
+                      "chains_broken": q["broken"],
+                      "chains_ok": q["ok"]}
+    return out
+
+
+def bench_rebalance(chains: int) -> dict:
+    # N=1000 nodes; targets_per_node scales the chain count
+    n = 1000
+    r = chains * 3 // n
+    sf = ScaleFabric(ScaleConfig(num_nodes=n, num_domains=10,
+                                 targets_per_node=r))
+    routing = sf.mgmtd.get_routing_info(-1)
+    dead = sorted(sf.nodes)[0]
+    t0 = time.perf_counter()
+    delta = TopologyDelta(dead=[dead])
+    plan = plan_rebalance(routing, delta)
+    plan_s = time.perf_counter() - t0
+    return {
+        "chains": len(sf.chain_ids),
+        "nodes": n,
+        "boot_s": round(sf.boot_s, 3),
+        "plan_s": round(plan_s, 4),
+        "moves": len(plan.moves),
+        "deferred": len(plan.deferred_chains),
+        "lambda_after": plan.after.lambda_max,
+    }
+
+
+def bench_slo_series(n: int) -> dict:
+    agg = WindowedAggregator(bucket_s=1.0, slots=60, max_series=2 * n + 16)
+    engine = SloEngine(agg)
+    engine.configure("rule=hb_p99,metric=scale.hb,agg=p99,max=100")
+    now = time.time()
+    windows = 5
+    t0 = time.perf_counter()
+    for w in range(windows):
+        samples = [
+            Sample(name="scale.hb", ts=now + w, tags={"node": str(i)},
+                   count=8, min=1.0, max=20.0, mean=5.0,
+                   p50=4.0, p90=9.0, p99=15.0)
+            for i in range(n)
+        ]
+        agg.ingest(samples)
+    ingest_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    verdicts = engine.evaluate(now + windows)
+    eval_s = time.perf_counter() - t0
+    return {
+        "series": n,
+        "windows": windows,
+        "ingest_s": round(ingest_s, 4),
+        "evaluate_s": round(eval_s, 5),
+        "rules_ok": all(v.state != "firing" for v in verdicts.values()),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="N=100 only, 1k-chain rebalance")
+    ap.add_argument("--out", default="BENCH_SCALE.json")
+    args = ap.parse_args()
+
+    sizes = [(100, 5)] if args.fast else [(100, 5), (1000, 10)]
+    rebalance_chains = 1000 if args.fast else 10_000
+    result = {
+        "captured_unix": int(time.time()),
+        "host_cpus": os.cpu_count(),
+        "fast": bool(args.fast),
+        "sizes": {},
+        "slo_series": {},
+    }
+    for n, d in sizes:
+        print(f"== size N={n} ==", flush=True)
+        result["sizes"][str(n)] = bench_size(n, d)
+        result["slo_series"][str(n)] = bench_slo_series(n)
+    print("== domain A/B ==", flush=True)
+    result["domain_ab"] = bench_domain_ab()
+    print(f"== rebalance {rebalance_chains} chains ==", flush=True)
+    result["rebalance"] = bench_rebalance(rebalance_chains)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result, indent=1, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

@@ -321,3 +321,58 @@ class TestElasticBench:
         assert row["drain_wall_s"] > 0
         # no latency acceptance at smoke scale; BENCH_ELASTIC.json
         # carries the measured fg-p99-under-rebalance claim
+
+
+class TestScaleBench:
+    """benchmarks/scale_bench smoke at toy N: the control-plane numbers
+    in BENCH_SCALE.json come from the same functions at N=1000."""
+
+    def test_size_and_ab_smoke(self):
+        from benchmarks.scale_bench import bench_domain_ab, bench_size
+
+        row = bench_size(20, 4)
+        assert row["chains"] == 20
+        assert row["heartbeat_fanin"]["round_s"] > 0
+        assert row["routing_fanout"]["warm_bytes"] \
+            < row["routing_fanout"]["cold_bytes"]
+        assert row["domain_kill"]["chains_broken"] == 0
+        ab = bench_domain_ab(n=12, domains=3)
+        assert ab["aware"]["chains_broken"] == 0
+        assert ab["aware"]["placement_violations"] == 0
+        assert ab["blind"]["placement_violations"] > 0
+
+    def test_rebalance_and_slo_smoke(self):
+        from benchmarks.scale_bench import bench_slo_series
+
+        row = bench_slo_series(16)
+        assert row["rules_ok"] and row["ingest_s"] > 0
+
+
+class TestBenchTrajectory:
+    """tools/bench_trajectory renders every BENCH_*.json into
+    docs/trajectory.md; the committed page must not go stale."""
+
+    def test_render_all_artifacts(self):
+        import glob as _glob
+        import os as _os
+
+        from tools.bench_trajectory import build
+
+        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        text = build(root)
+        for p in _glob.glob(_os.path.join(root, "BENCH_*.json")):
+            assert f"## {_os.path.basename(p)}" in text
+        # BENCH_SOAK's partition trajectory renders as a multi-point series
+        assert "partition_runs (3 points)" in text
+
+    def test_committed_page_current(self):
+        import os as _os
+
+        from tools.bench_trajectory import build
+
+        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        with open(_os.path.join(root, "docs", "trajectory.md")) as f:
+            committed = f.read()
+        assert committed == build(root), (
+            "docs/trajectory.md is stale — regenerate with "
+            "python -m tools.bench_trajectory")

@@ -1,0 +1,259 @@
+"""Partition-class chaos: the explicit event, the lease fence, and the
+guard that keeps partitions out of the fault plane.
+
+Three surfaces, one contract (docs/scale.md "Lease fencing"):
+
+- ``Schedule.validate()`` pins the partition/drop separation: an
+  UNLIMITED error/drop rule is a network partition in disguise, and
+  partitions are only expressible as the explicit, healed ``partition``
+  event. The generator never emits an unlimited hard-failure rule
+  (schedule.py names this file as the pinning test).
+- The fabric proves the fence end to end: a head cut off from mgmtd for
+  T/2 refuses client write acks (WRITE_FENCED) and demotes its targets
+  to ONLINE — BEFORE mgmtd (at T) could promote a successor — and
+  rejoins through WAITING→SYNCING after the heal.
+- ``bugs.bug_fire`` counts an open partition window as a crash window,
+  so a bug whose trigger IS the partition (lease_fence_skip) can fire
+  without any fault-plane rules armed.
+"""
+
+import pytest
+
+from tpu3fs.chaos import bugs
+from tpu3fs.chaos.schedule import (
+    ChaosEvent,
+    Schedule,
+    ScheduleSpec,
+    generate_schedule,
+)
+from tpu3fs.client.storage_client import RetryOptions
+from tpu3fs.fabric import Fabric, SystemSetupConfig
+from tpu3fs.mgmtd.types import LocalTargetState
+from tpu3fs.storage.types import ChunkId
+from tpu3fs.utils.fault_injection import parse_spec
+from tpu3fs.utils.result import Code
+
+
+def _sched(events):
+    return Schedule(seed=0, spec=ScheduleSpec(), events=events)
+
+
+class TestPartitionEventValidation:
+    def test_unlimited_error_rule_rejected(self):
+        for kind in ("error", "drop"):
+            s = _sched([ChaosEvent(0, "fault_set", {
+                "spec": f"point=storage.read,kind={kind},prob=1.0",
+                "seed": 1, "node_idx": -1})])
+            with pytest.raises(ValueError, match="explicit partition event"):
+                s.validate()
+
+    def test_bounded_burst_ok(self):
+        s = _sched([ChaosEvent(0, "fault_set", {
+            "spec": "point=storage.read,kind=error,prob=1.0,times=5;"
+                    "point=rpc.send,kind=drop,prob=0.5,times=3",
+            "seed": 1, "node_idx": -1})])
+        s.validate()
+
+    def test_unlimited_delay_still_ok(self):
+        # a delay is a straggler, not a cut: the retry ladders outlast it
+        s = _sched([ChaosEvent(0, "fault_set", {
+            "spec": "point=rpc.dispatch,kind=delay_ms,prob=0.3,arg=20",
+            "seed": 1, "node_idx": -1})])
+        s.validate()
+
+    @pytest.mark.parametrize("args", [
+        {"a": [0], "b": [0, 1], "heal_after": 3},      # overlap
+        {"a": [], "b": [1], "heal_after": 3},          # empty side a
+        {"a": [0], "b": [1], "heal_after": 0},         # no heal
+        {"a": [0], "b": [1]},                          # missing heal
+        {"a": [0, -1], "b": [], "heal_after": 2},      # negative idx
+        {"a": "0", "b": [], "heal_after": 2},          # not a list
+    ])
+    def test_bad_partition_args_rejected(self, args):
+        with pytest.raises(ValueError):
+            _sched([ChaosEvent(0, "partition", args)]).validate()
+
+    def test_good_partition_event_ok(self):
+        _sched([ChaosEvent(0, "partition",
+                           {"a": [0], "b": [1, 2], "heal_after": 4}),
+                ChaosEvent(2, "partition",
+                           {"a": [1], "b": [], "heal_after": 2})]).validate()
+
+    def test_generator_never_emits_unlimited_hard_failures(self):
+        """The guard schedule.py points at: across many seeds, every
+        generated error/drop rule is times-bounded, and partitions appear
+        only as explicit healed events — never as a disguised drop."""
+        spec = ScheduleSpec(storage_nodes=5, events=12, allow_partition=True)
+        partitions = 0
+        for seed in range(40):
+            sched = generate_schedule(seed, spec)
+            sched.validate()  # would reject an unlimited error/drop rule
+            for e in sched.events:
+                if e.kind == "fault_set":
+                    for rule in parse_spec(e.args["spec"]):
+                        if rule.kind in ("error", "drop"):
+                            assert rule.times >= 0, (seed, e.args["spec"])
+                elif e.kind == "partition":
+                    partitions += 1
+                    assert e.args["heal_after"] >= 1
+        assert partitions > 0  # the event class is actually drawn
+
+    def test_partitions_are_opt_in(self):
+        spec = ScheduleSpec(storage_nodes=5, events=12, allow_partition=False)
+        for seed in range(20):
+            kinds = {e.kind for e in generate_schedule(seed, spec).events}
+            assert "partition" not in kinds
+
+
+@pytest.fixture
+def fenced_fab():
+    fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=1,
+                                   num_replicas=2, chunk_size=4096,
+                                   fencing=True))
+    yield fab
+    fab.close()
+
+
+def _head_node(fab, cid):
+    routing = fab.routing()
+    head = routing.chains[cid].head()
+    return routing.node_of_target(head.target_id).node_id
+
+
+class TestLeaseFencing:
+    def test_partitioned_head_fences_before_promotion(self, fenced_fab):
+        fab = fenced_fab
+        cid = fab.chain_ids[0]
+        sc = fab.storage_client(retry=RetryOptions(
+            max_retries=0, backoff_base_s=0.0, backoff_max_s=0.0))
+        assert sc.write_chunk(cid, ChunkId(1, 0), 0, b"pre",
+                              chunk_size=4096).ok
+
+        head = _head_node(fab, cid)
+        others = [n for n in fab.nodes if n != head]
+        fab.set_partition([head], others + [Fabric.MGMTD_NODE_ID])
+        # T/2 of mgmtd silence: the fence closes strictly before mgmtd
+        # (at T) may declare the head dead and promote its successor
+        fab.clock.advance(fab.cfg.heartbeat_timeout_s / 2 + 1)
+        fab.heartbeat_all()
+
+        reply = sc.write_chunk(cid, ChunkId(1, 0), 0, b"split",
+                               chunk_size=4096)
+        assert not reply.ok
+        assert reply.code == Code.WRITE_FENCED
+        # the fence is retryable — a client with budget rides out the heal
+        from tpu3fs.utils.result import Status
+        assert Status(reply.code).retryable()
+        # mgmtd has NOT promoted yet: the old head is still head in the
+        # routing table while it refuses acks — no split-brain window
+        assert _head_node(fab, cid) == head
+
+    def test_fence_demotes_local_targets(self, fenced_fab):
+        fab = fenced_fab
+        cid = fab.chain_ids[0]
+        head = _head_node(fab, cid)
+        svc = fab.nodes[head].service
+        assert all(t.local_state == LocalTargetState.UPTODATE
+                   for t in svc.targets())
+
+        others = [n for n in fab.nodes if n != head]
+        fab.set_partition([head], others + [Fabric.MGMTD_NODE_ID])
+        fab.clock.advance(fab.cfg.heartbeat_timeout_s / 2 + 1)
+        fab.heartbeat_all()
+        # background duty: a fenced node may no longer claim UPTODATE —
+        # on return the chain state machine readmits it WAITING→SYNCING
+        assert all(t.local_state == LocalTargetState.ONLINE
+                   for t in svc.targets())
+
+    def test_heal_reopens_and_chain_recovers(self, fenced_fab):
+        fab = fenced_fab
+        cid = fab.chain_ids[0]
+        head = _head_node(fab, cid)
+        others = [n for n in fab.nodes if n != head]
+        fab.set_partition([head], others + [Fabric.MGMTD_NODE_ID])
+        fab.clock.advance(fab.cfg.heartbeat_timeout_s / 2 + 1)
+        fab.heartbeat_all()
+
+        fab.heal_partitions()
+        fab.tick()  # heartbeat lands, fence reopens, chain_sm reacts
+        sc = fab.storage_client()
+        assert sc.write_chunk(cid, ChunkId(2, 0), 0, b"post-heal",
+                              chunk_size=4096).ok
+        fab.resync_all()
+        # the once-fenced node is readmitted and converges
+        routing = fab.routing()
+        from tpu3fs.mgmtd.types import PublicTargetState
+        assert all(t.public_state == PublicTargetState.SERVING
+                   for t in routing.chains[cid].targets)
+        assert sc.read_chunk(cid, ChunkId(2, 0)).data == b"post-heal"
+
+    def test_unfenced_fabric_has_no_fence(self):
+        fab = Fabric(SystemSetupConfig(num_storage_nodes=3, num_chains=1,
+                                       num_replicas=2, chunk_size=4096))
+        try:
+            cid = fab.chain_ids[0]
+            head = _head_node(fab, cid)
+            # cut the mgmtd link only — data links stay up (the classic
+            # lease scenario: the control plane can't see a node that
+            # can still serve)
+            fab.set_partition([head], [Fabric.MGMTD_NODE_ID])
+            fab.clock.advance(fab.cfg.heartbeat_timeout_s / 2 + 1)
+            fab.heartbeat_all()
+            sc = fab.storage_client(retry=RetryOptions(max_retries=0))
+            # fencing off (the default): the cut head keeps acking —
+            # exactly the split-brain exposure the fence exists to close
+            assert sc.write_chunk(cid, ChunkId(1, 0), 0, b"x",
+                                  chunk_size=4096).ok
+        finally:
+            fab.close()
+
+
+class TestPartitionBugWindow:
+    def test_partition_window_opens_bug_fire(self):
+        bugs.arm("lease_fence_skip")
+        try:
+            assert not bugs.bug_fire("lease_fence_skip")  # no window
+            bugs.partition_begin()
+            try:
+                assert bugs.partition_window_open()
+                assert bugs.bug_fire("lease_fence_skip")
+            finally:
+                bugs.partition_end()
+            assert not bugs.partition_window_open()
+            assert not bugs.bug_fire("lease_fence_skip")
+        finally:
+            bugs.disarm()
+
+    def test_windows_nest(self):
+        bugs.partition_begin()
+        bugs.partition_begin()
+        bugs.partition_end()
+        assert bugs.partition_window_open()
+        bugs.partition_end()
+        assert not bugs.partition_window_open()
+
+    def test_armed_bug_lies_about_fence_expiry(self, fenced_fab):
+        """Under the planted bug, a partitioned head's fence judgment
+        returns 'not expired' — it keeps acking AND claiming UPTODATE.
+        The chaos seed in tests/chaos_seeds/ catches the downstream
+        divergence via replica_versions; this pins the mechanism."""
+        fab = fenced_fab
+        cid = fab.chain_ids[0]
+        head = _head_node(fab, cid)
+        svc = fab.nodes[head].service
+        bugs.arm("lease_fence_skip")
+        bugs.partition_begin()
+        try:
+            # mgmtd link down, data links up: the head can still reach
+            # its successor, so the lying fence lets the write through
+            fab.set_partition([head], [Fabric.MGMTD_NODE_ID])
+            fab.clock.advance(fab.cfg.heartbeat_timeout_s / 2 + 1)
+            fab.heartbeat_all()
+            sc = fab.storage_client(retry=RetryOptions(max_retries=0))
+            assert sc.write_chunk(cid, ChunkId(1, 0), 0, b"lied",
+                                  chunk_size=4096).ok  # split-brain ack
+            assert all(t.local_state == LocalTargetState.UPTODATE
+                       for t in svc.targets())  # never demoted
+        finally:
+            bugs.partition_end()
+            bugs.disarm()

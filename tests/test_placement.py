@@ -150,3 +150,60 @@ class TestAnnealerOptimality:
             f"on (v={v}, k={k}, r={r})")
         # and the optimum is actually achievable (sanity on the oracle)
         assert got >= opt or k == 1
+
+
+class TestFailureDomains:
+    """Domain-constrained solving (docs/scale.md): max_per_domain bounds
+    any one domain's share of a group — the loss a whole-domain kill
+    must fit inside."""
+
+    def test_contiguous_blocks_solved_clean(self):
+        from tpu3fs.placement.solver import domain_overflow
+
+        # rack-like contiguous labels: the hostile layout for the naive
+        # consecutive-window greedy
+        v, d = 12, 3
+        domains = [f"d{i * d // v}" for i in range(v)]
+        p = PlacementProblem(num_nodes=v, group_size=3, targets_per_node=3,
+                             domains=domains, max_per_domain=2)
+        M = solve_placement(p, steps=0)
+        assert domain_overflow(M, p) == 0
+        assert check_solution(M, p)
+
+    def test_blind_solve_overflows_where_aware_does_not(self):
+        from tpu3fs.placement.solver import domain_overflow
+
+        v, d = 12, 3
+        domains = [f"d{i * d // v}" for i in range(v)]
+        aware = PlacementProblem(num_nodes=v, group_size=3,
+                                 targets_per_node=3,
+                                 domains=domains, max_per_domain=1)
+        blind = PlacementProblem(num_nodes=v, group_size=3,
+                                 targets_per_node=3)
+        Mb = solve_placement(blind, steps=0)
+        # judge the blind table against the aware constraint
+        assert domain_overflow(Mb, aware) > 0
+        Ma = solve_placement(aware, steps=0)
+        assert domain_overflow(Ma, aware) == 0
+
+    def test_annealing_never_regresses_domain_constraint(self):
+        from tpu3fs.placement.solver import domain_overflow
+
+        v, d = 15, 5
+        domains = [f"d{i * d // v}" for i in range(v)]
+        p = PlacementProblem(num_nodes=v, group_size=3, targets_per_node=3,
+                             domains=domains, max_per_domain=1)
+        M = solve_placement(p, steps=300, seed=3)
+        assert domain_overflow(M, p) == 0
+        assert check_solution(M, p)
+
+    def test_check_solution_rejects_overflow(self):
+        v, d = 6, 2
+        domains = [f"d{i * d // v}" for i in range(v)]
+        p = PlacementProblem(num_nodes=v, group_size=3, targets_per_node=1,
+                             domains=domains, max_per_domain=2)
+        # group 0 = nodes {0,1,2}: all of d0 -> 3 > cap 2
+        M = np.zeros((2, 6), dtype=np.int8)
+        M[0, [0, 1, 2]] = 1
+        M[1, [3, 4, 5]] = 1
+        assert not check_solution(M, p)

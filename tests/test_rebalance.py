@@ -377,3 +377,81 @@ class TestFillJoinedFlag:
         plan2 = plan_rebalance(fab.routing(), TopologyDelta(draining=[10]),
                                fill_joined=False)
         assert plan2.empty and plan2.deferred_chains
+
+
+class TestFailureDomainBudget:
+    """Domain-aware planning (docs/scale.md): a destination may never
+    push any domain past the chain's loss budget — width-1 for CR, ec_m
+    for EC — and check_plan preflights the same bound."""
+
+    def _tagged(self, fab, layout):
+        for nid, dom in layout.items():
+            fab.mgmtd.set_node_tags(nid, {"domain": dom})
+        return fab.routing()
+
+    def test_dead_node_replacement_respects_domains(self):
+        fab = _cr_fabric(nodes=4, chains=8, replicas=2)
+        routing = self._tagged(fab, {10: "dA", 11: "dA",
+                                     12: "dB", 13: "dB"})
+        node_dom = {10: "dA", 11: "dA", 12: "dB", 13: "dB"}
+        plan = plan_rebalance(routing, TopologyDelta(dead=[12]))
+        assert plan.moves  # node 12 hosted something
+        for mv in plan.moves:
+            chain = routing.chains[mv.chain_id]
+            stay = [routing.targets[t.target_id].node_id
+                    for t in chain.targets
+                    if t.target_id != mv.out_target]
+            doms = [node_dom[n] for n in stay] + [node_dom[mv.dst_node]]
+            # CR width 2, cap 1: every member in its own domain
+            assert len(set(doms)) == len(doms), (mv, doms)
+        assert check_plan(routing, plan, TopologyDelta(dead=[12])) == []
+
+    def test_no_legal_domain_defers_chain(self):
+        # 3 nodes, two in dA: replacing the lone dB member of any chain
+        # that also holds a dA member would put 2 of 2 in dA (cap 1) —
+        # the planner must defer, never breach
+        fab = _cr_fabric(nodes=3, chains=6, replicas=2)
+        routing = self._tagged(fab, {10: "dA", 11: "dA", 12: "dB"})
+        delta = TopologyDelta(dead=[12])
+        plan = plan_rebalance(routing, delta)
+        assert plan.moves == []
+        hosted = [cid for cid, c in routing.chains.items()
+                  if any(routing.targets[t.target_id].node_id == 12
+                         for t in c.targets)]
+        assert sorted(plan.deferred_chains) == sorted(hosted)
+
+    def test_untagged_cluster_stays_domain_blind(self):
+        fab = _cr_fabric(nodes=3, chains=6, replicas=2)
+        plan = plan_rebalance(fab.routing(), TopologyDelta(dead=[12]))
+        # same shape as above, no tags: every chain gets its replacement
+        assert plan.moves and not plan.deferred_chains
+
+    def test_check_plan_flags_domain_breach(self):
+        from tpu3fs.placement.rebalance import PlannedMove
+
+        fab = _cr_fabric(nodes=4, chains=8, replicas=2)
+        # interleaved tags: the booted pairs {10,11}/{12,13} straddle
+        # domains, so a same-domain landing spot exists outside each
+        doms = {10: "dA", 11: "dB", 12: "dA", 13: "dB"}
+        routing = self._tagged(fab, doms)
+        # hand-craft a breaching move: land a replacement beside a
+        # same-domain member
+        for cid, chain in sorted(routing.chains.items()):
+            members = [routing.targets[t.target_id].node_id
+                       for t in chain.targets]
+            outside = [n for n in (10, 11, 12, 13) if n not in members]
+            bad = [n for n in outside
+                   if any(doms[n] == doms[m]
+                          for m in members[1:])]
+            if not bad:
+                continue
+            out_t = chain.targets[0].target_id
+            mv = PlannedMove(cid, out_t,
+                             routing.targets[out_t].node_id, bad[0])
+            from tpu3fs.placement.rebalance import RebalancePlan
+            plan = RebalancePlan()
+            plan.moves.append(mv)
+            problems = check_plan(routing, plan, TopologyDelta())
+            assert any("domain" in p for p in problems), problems
+            return
+        pytest.fail("no breaching candidate found in the booted table")

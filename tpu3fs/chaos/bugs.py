@@ -66,6 +66,21 @@ Known bugs:
   invariant checker (post-storm: committed replicas of every chunk must
   agree on CRC), and by ``crc_oracle`` when a read lands on the
   divergent replica.
+
+- ``lease_fence_skip`` — the split-brain fencing bug shape: a storage
+  node partitioned away from mgmtd must judge its own lease fence
+  (T/2 of mgmtd silence, docs/design_notes.md "Failure detection") and
+  both STOP acking head writes and demote its targets' local state to
+  ONLINE so the chain state machine resyncs it on return. With the bug
+  armed the fence check lies (``StorageService._fence_expired`` reports
+  False forever), so a partitioned head keeps acking while mgmtd
+  promotes a successor, and on heal it rejoins claiming UPTODATE —
+  skipping resync with writes it never saw. Caught by the
+  ``replica_versions`` invariant checker (the stale replica's committed
+  versions diverge from the serving side) and by ``crc_oracle`` when a
+  read lands on the stale replica. Fires inside partition windows
+  (``partition_begin``/``partition_end``), not only fault-plane windows
+  — partitions are schedule events, not drop rules.
 """
 
 from __future__ import annotations
@@ -84,8 +99,30 @@ _armed: Set[str] = set(
 #: arm()/hook pair must fail loudly, not silently never fire)
 KNOWN_BUGS = frozenset({
     "commit_skip", "chain_parity_skip", "peer_fill_stale",
-    "rename_orphan_intent", "native_commit_skip_crc",
+    "rename_orphan_intent", "native_commit_skip_crc", "lease_fence_skip",
 })
+
+#: open partition windows (chaos ``partition`` events). Partitions are
+#: explicit schedule events, NOT fault-plane rules — so ``bug_fire`` must
+#: also count an open partition as a crash window, else a bug whose
+#: trigger IS the partition (lease_fence_skip) could never fire.
+_partition_depth = 0
+
+
+def partition_begin() -> None:
+    global _partition_depth
+    with _lock:
+        _partition_depth += 1
+
+
+def partition_end() -> None:
+    global _partition_depth
+    with _lock:
+        _partition_depth = max(0, _partition_depth - 1)
+
+
+def partition_window_open() -> bool:
+    return _partition_depth > 0
 
 
 def arm(name: str) -> None:
@@ -110,11 +147,13 @@ def armed(name: str) -> bool:
 
 
 def bug_fire(name: str) -> bool:
-    """The production hook: True iff ``name`` is armed AND the cluster
-    fault plane currently has rules configured (the crash window). Near
-    zero cost disarmed."""
+    """The production hook: True iff ``name`` is armed AND a crash window
+    is open — the cluster fault plane has rules configured, or a chaos
+    partition event is in flight. Near zero cost disarmed."""
     if name not in _armed:
         return False
+    if _partition_depth > 0:
+        return True
     from tpu3fs.utils.fault_injection import plane
 
     return plane().active

@@ -41,6 +41,11 @@ Catalogue (docs/chaos.md):
                       peer serving a GC'd block must surface as a MISS
                       (the KVCACHE_STALE re-probe), never as zeros-as-KV
                       (the planted ``peer_fill_stale`` bug's shape).
+``domain_quorum``     failure-domain placement: when nodes carry a
+                      ``domain`` tag, no chain concentrates more members
+                      in one domain than it can lose (width-1 for CR,
+                      ec_m for EC) — killing a WHOLE domain then never
+                      costs any chain its quorum, by construction.
 ``meta_intents``      metadata two-phase convergence: after quiesce no
                       intent/prepare record survives resolution, and
                       every path the metashard sidecar's ACKED ops left
@@ -405,6 +410,45 @@ def _check_replica_crc(ctx: ChaosContext):
                 f"replicas: head crc {h_crc:#x} != successor "
                 f"{s_crc:#x} at ver {h_ver} — the head committed "
                 f"without cross-checking the successor's checksum"))
+    return bad
+
+
+@register("domain_quorum")
+def _check_domain_quorum(ctx: ChaosContext):
+    """Failure-domain placement invariant: a chain may not concentrate
+    more members in one domain than it survives losing — width-1 for CR
+    (one member must outlive any single-domain kill), ec_m for EC (at
+    most m shards may share a domain's fate). Skips on untagged
+    clusters: domain-blind placement predates the constraint and is
+    still legal there (docs/scale.md)."""
+    if ctx.routing is None:
+        return None
+    routing = ctx.routing()
+    domains = {nid: n.tags.get("domain")
+               for nid, n in routing.nodes.items()
+               if n.tags.get("domain")}
+    if not domains:
+        return None
+    bad: List[Violation] = []
+    for cid in sorted(routing.chains):
+        chain = routing.chains[cid]
+        counts: Dict[str, int] = {}
+        for t in chain.targets:
+            info = routing.targets.get(t.target_id)
+            if info is None:
+                continue
+            dom = domains.get(info.node_id)
+            if dom is not None:
+                counts[dom] = counts.get(dom, 0) + 1
+        width = len(chain.targets)
+        cap = chain.ec_m if chain.is_ec else max(width - 1, 1)
+        for dom, n in sorted(counts.items()):
+            if n > cap:
+                bad.append(Violation(
+                    "domain_quorum",
+                    f"chain {cid}: {n} of {width} members in domain "
+                    f"{dom!r} exceeds the loss budget {cap} — a "
+                    f"single-domain kill would break quorum"))
     return bad
 
 

@@ -35,6 +35,15 @@ Event kinds (``args`` keys per kind):
                        it (planner + worker); executors undo at quiesce
 ``config_push``        ``section`` (qos|tenants|slo), ``spec`` — a mid-
                        flight hot config push (grammar per section)
+``partition``          ``a`` (storage idx list), ``b`` (storage idx list,
+                       may be empty), ``heal_after`` (steps) — cut every
+                       link between side a and side b ∪ {mgmtd}, heal it
+                       ``heal_after`` steps later. THE way a schedule
+                       expresses a network partition: hard cuts are an
+                       explicit, healed, node-set × node-set EVENT, never
+                       an unlimited ``drop`` rule (validate() enforces
+                       the times-bound on error/drop rules; the guard
+                       test in tests/test_chaos_partition.py pins it)
 ====================  =====================================================
 
 Every point named in a generated ``fault_set`` spec comes from
@@ -57,7 +66,7 @@ SCHEDULE_VERSION = 1
 
 KINDS = (
     "fault_set", "fault_clear", "kill", "restart", "join", "drain",
-    "config_push",
+    "config_push", "partition",
 )
 
 ROLES = ("storage", "meta", "worker", "client")
@@ -148,6 +157,11 @@ class ScheduleSpec:
     allow_kill: bool = True
     allow_elastic: bool = False      # join/drain events (need a worker)
     allow_config_push: bool = True
+    # partition events (node-set × node-set cut with mgmtd on side b,
+    # healed after ``heal_after`` steps). Opt-in: partitions stretch the
+    # fabric clock past the lease fence, which only means something on
+    # fabrics running with fencing armed (search.py always does)
+    allow_partition: bool = False
     fault_prob_min: float = 0.2
     fault_prob_max: float = 1.0
     max_fault_rules: int = 2
@@ -201,12 +215,43 @@ class Schedule:
 
     def validate(self) -> None:
         """Raise ValueError on any malformed event (kinds, roles, and
-        every fault_set spec must parse under the plane grammar)."""
+        every fault_set spec must parse under the plane grammar).
+        Enforces the partition/drop separation: error and drop rules in
+        a fault_set must be times-bounded bursts — an UNLIMITED hard-
+        failure rule is a network partition in disguise, and partitions
+        are only expressible as the explicit ``partition`` event (which
+        carries a heal and drives the lease-fence protocol)."""
         for e in self.events:
             if e.kind not in KINDS:
                 raise ValueError(f"unknown event kind {e.kind!r}")
             if e.kind == "fault_set":
-                parse_spec(e.args.get("spec", ""))
+                for rule in parse_spec(e.args.get("spec", "")):
+                    if rule.kind in ("error", "drop") and rule.times < 0:
+                        raise ValueError(
+                            f"unlimited {rule.kind} rule on {rule.point!r}: "
+                            "a hard cut without a heal is a partition — "
+                            "use the explicit partition event")
+            if e.kind == "partition":
+                a = e.args.get("a")
+                b = e.args.get("b", [])
+                heal = e.args.get("heal_after")
+                if (not isinstance(a, list) or not a
+                        or not all(isinstance(i, int) and i >= 0 for i in a)):
+                    raise ValueError(
+                        f"partition side a must be a non-empty storage idx "
+                        f"list, got {a!r}")
+                if (not isinstance(b, list)
+                        or not all(isinstance(i, int) and i >= 0 for i in b)):
+                    raise ValueError(
+                        f"partition side b must be a storage idx list, "
+                        f"got {b!r}")
+                if set(a) & set(b):
+                    raise ValueError(
+                        f"partition sides overlap: {sorted(set(a) & set(b))}")
+                if not isinstance(heal, int) or heal < 1:
+                    raise ValueError(
+                        f"partition heal_after must be an int >= 1, "
+                        f"got {heal!r}")
             if e.kind in ("kill", "restart"):
                 if e.args.get("role") not in ROLES:
                     raise ValueError(
@@ -274,6 +319,8 @@ def generate_schedule(seed: int,
         weights += [("join", 5), ("drain", 5)]
     if spec.allow_config_push:
         weights += [("config_push", 10)]
+    if spec.allow_partition and spec.storage_nodes >= 2:
+        weights += [("partition", 8)]
     for k, w in weights:
         kinds.extend([k] * w)
     events: List[ChaosEvent] = []
@@ -307,6 +354,16 @@ def generate_schedule(seed: int,
             args = {}
         elif kind == "drain":
             args = {"idx": rng.randrange(max(spec.storage_nodes, 1))}
+        elif kind == "partition":
+            # side a: a minority of storage nodes; side b: mgmtd always
+            # (the lease-fence shape) plus, half the time, every other
+            # storage node (the full split). Always healed.
+            a_size = 1 if spec.storage_nodes <= 3 or rng.random() < 0.7 \
+                else rng.randint(1, spec.storage_nodes // 2)
+            a = sorted(rng.sample(range(spec.storage_nodes), a_size))
+            b = (sorted(set(range(spec.storage_nodes)) - set(a))
+                 if rng.random() < 0.5 else [])
+            args = {"a": a, "b": b, "heal_after": rng.randint(2, 6)}
         else:  # config_push
             args = _gen_config_push(rng)
         events.append(ChaosEvent(step, kind, args))

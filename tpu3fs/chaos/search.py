@@ -124,8 +124,11 @@ class FabricRunner:
             ec_k=spec.ec_k, ec_m=spec.ec_m,
             chunk_size=1 << 16,
             heartbeat_timeout_s=60.0,
+            fencing=True,
             qos=QosConfig(),
         ))
+        # step at which the open partition heals, None when whole
+        self._partition_heal: Optional[int] = None
         self.base_nodes = sorted(self.fab.nodes)
         self.rng = random.Random(self.schedule.seed ^ 0x5EED)
         fast = RetryOptions(max_retries=6, backoff_base_s=0.0,
@@ -160,6 +163,9 @@ class FabricRunner:
             by_step.setdefault(e.step, []).append(e)
         try:
             for step in range(spec.steps):
+                if (self._partition_heal is not None
+                        and step >= self._partition_heal):
+                    self._heal_partition()
                 for event in by_step.get(step, ()):
                     if self._apply_event(event):
                         report.events_applied += 1
@@ -173,6 +179,7 @@ class FabricRunner:
                 self._metashard_tick(step)
                 self._native_tick(step)
                 self._background_tick()
+                self._partition_tick()
             self._quiesce()
             ctx = self._context()
             report.outcomes = run_checkers(ctx, self.checkers)
@@ -183,6 +190,12 @@ class FabricRunner:
                         o.status = "violated"
         finally:
             plane().clear()
+            if self._partition_heal is not None:
+                # mid-run abort with a cut still open: balance the bug
+                # window before anything else touches the fabric
+                self.fab.heal_partitions()
+                bugs.partition_end()
+                self._partition_heal = None
             if spec.ec_chain_encode:
                 if env_prev is None:
                     os.environ.pop("TPU3FS_EC_CHAIN_ENCODE", None)
@@ -267,7 +280,67 @@ class FabricRunner:
             return True
         if e.kind == "config_push":
             return self._apply_config_push(e.args)
+        if e.kind == "partition":
+            return self._apply_partition(e)
         raise ValueError(f"unknown event kind {e.kind!r}")
+
+    # -- partitions ----------------------------------------------------------
+    def _apply_partition(self, e: ChaosEvent) -> bool:
+        """Cut side-a nodes off from mgmtd AND side-b peers (mgmtd is
+        always implicitly on side b). The cut heals ``heal_after`` steps
+        later. Side a keeps its data links to unlisted nodes — the
+        interesting partitions are control-plane asymmetric ones, where
+        lease fencing (not connectivity) is what stops split-brain."""
+        base = self.base_nodes
+        if len(base) < 2:
+            return False
+        a_ids = sorted({base[int(i) % len(base)] for i in e.args["a"]})
+        b_ids = sorted({base[int(i) % len(base)] for i in e.args["b"]}
+                       - set(a_ids))
+        if not a_ids or len(a_ids) >= len(base):
+            return False  # degenerate: nothing cut, or no survivor side
+        self.fab.set_partition(a_ids,
+                               b_ids + [self.fab.MGMTD_NODE_ID])
+        heal = e.step + int(e.args["heal_after"])
+        if self._partition_heal is None:
+            bugs.partition_begin()
+            self._partition_heal = heal
+        else:
+            # overlapping cuts share one window; all heal together at
+            # the latest mark (heal_partitions is global)
+            self._partition_heal = max(self._partition_heal, heal)
+        self._partition_tick()
+        return True
+
+    def _partition_tick(self) -> None:
+        """While a cut is open, ripen the failure clocks: T/2 + 1 per
+        step, so the partitioned side's lease fence expires (T/2 of
+        mgmtd silence) one step BEFORE mgmtd declares it dead (T) and
+        the chain updater promotes a successor — the ordering the
+        fencing contract promises (docs/scale.md)."""
+        from tpu3fs.utils.result import FsError
+
+        if self._partition_heal is None:
+            return
+        self.fab.clock.advance(self.fab.cfg.heartbeat_timeout_s / 2 + 1)
+        self.fab.heartbeat_all()
+        try:
+            self.fab.mgmtd.tick()
+        except FsError:
+            pass
+
+    def _heal_partition(self) -> None:
+        self.fab.heal_partitions()
+        bugs.partition_end()
+        self._partition_heal = None
+        self.fab.heartbeat_all()
+        from tpu3fs.utils.result import FsError
+
+        try:
+            self.fab.mgmtd.tick()
+        except FsError:
+            pass
+        self._safe_resync(rounds=4)
 
     def _submit_plan(self, *, joined=None, draining=None) -> bool:
         from tpu3fs.placement.rebalance import (
@@ -840,6 +913,8 @@ class FabricRunner:
         from tpu3fs.utils.result import FsError
 
         plane().clear()
+        if self._partition_heal is not None:
+            self._heal_partition()
         for node in self.fab.nodes.values():
             if not node.alive:
                 self.fab.restart_node(node.node_id)

@@ -30,6 +30,25 @@ from tpu3fs.storage.target import StorageTarget
 from tpu3fs.utils.result import Code, FsError, Status
 
 
+def _freeze_routing(live):
+    """Shallow-freeze a RoutingInfo: copy the container dicts (and the
+    version) so later chain/target/node INSTALLS are invisible, while
+    still sharing the current member objects. mgmtd replaces chain and
+    target records wholesale on every mutation (mgmtd/service.py uses
+    dataclasses.replace before installing), so sharing is safe."""
+    from dataclasses import replace as _replace
+
+    return _replace(
+        live,
+        nodes=dict(live.nodes),
+        chain_tables=dict(live.chain_tables),
+        chains=dict(live.chains),
+        targets=dict(live.targets),
+        serving=dict(live.serving),
+        meta_partitions=dict(live.meta_partitions),
+    )
+
+
 class FabricClock:
     def __init__(self, t: float = 10_000.0):
         self.t = t
@@ -69,6 +88,11 @@ class SystemSetupConfig:
     # (admission + weighted-fair update scheduling + shed recorders);
     # None = legacy unscheduled behavior
     qos: object = None
+    # arm the mgmtd lease fence on every storage service (docs/scale.md):
+    # T/2 of mgmtd silence closes the node's client-write ack path and
+    # demotes its targets to ONLINE. Off by default — most unit tests
+    # drive heartbeats explicitly and predate the fencing contract.
+    fencing: bool = False
 
 
 class _Node:
@@ -77,6 +101,12 @@ class _Node:
         self.service = service
         self.alive = True
         self.hb_version = 0
+        # routing snapshot frozen at partition start: a node cut off from
+        # mgmtd must keep acting on the LAST routing it saw (the live
+        # RoutingInfo is a shared in-process object — without freezing,
+        # a partitioned head would instantly "learn" about its own
+        # replacement, which no real partitioned process could)
+        self.frozen_routing = None
 
 
 class Fabric:
@@ -103,6 +133,9 @@ class Fabric:
         self.nodes: Dict[int, _Node] = {}
         self.chain_ids: List[int] = []
         self._engine_dirs: List[str] = []
+        # symmetric blocked (src, dst) node-id pairs — the chaos
+        # ``partition`` event's wire cut (mgmtd is node MGMTD_NODE_ID)
+        self._blocked: set = set()
         self._boot_topology()
         self.meta = MetaStore(
             self.kv,
@@ -120,8 +153,11 @@ class Fabric:
         for i in range(cfg.num_storage_nodes):
             node_id = self.FIRST_STORAGE_NODE_ID + i
             service = StorageService(
-                node_id, self.routing, self.send
+                node_id, self.node_routing(node_id), self.send_from(node_id)
             )
+            if cfg.fencing:
+                service.enable_fencing(
+                    self.clock, cfg.heartbeat_timeout_s / 2.0)
             if cfg.qos is not None:
                 from tpu3fs.qos.manager import QosManager
 
@@ -195,6 +231,58 @@ class Fabric:
 
     def routing(self):
         return self.mgmtd.get_routing_info()
+
+    def node_routing(self, node_id: int):
+        """Routing provider bound to one storage node: identical to the
+        live view until a partition cuts the node off from mgmtd, then
+        frozen at the snapshot taken when the partition began."""
+        def provider():
+            node = self.nodes.get(node_id)
+            if node is not None and node.frozen_routing is not None \
+                    and not self.can_reach(node_id, self.MGMTD_NODE_ID):
+                return node.frozen_routing
+            return self.mgmtd.get_routing_info()
+
+        return provider
+
+    # -- partitions (chaos ``partition`` events; docs/scale.md) --------------
+    def set_partition(self, side_a: List[int], side_b: List[int]) -> None:
+        """Cut every link between the two node sets (symmetric; node ids,
+        MGMTD_NODE_ID stands for mgmtd). Nodes losing mgmtd reachability
+        freeze their routing view at the current snapshot."""
+        overlap = set(side_a) & set(side_b)
+        if overlap:
+            raise ValueError(f"partition sides overlap: {sorted(overlap)}")
+        for a in side_a:
+            for b in side_b:
+                self._blocked.add((a, b))
+                self._blocked.add((b, a))
+        live = self.mgmtd.get_routing_info()
+        for node in self.nodes.values():
+            if node.frozen_routing is None \
+                    and not self.can_reach(node.node_id, self.MGMTD_NODE_ID):
+                node.frozen_routing = _freeze_routing(live)
+
+    def heal_partitions(self) -> None:
+        self._blocked.clear()
+        for node in self.nodes.values():
+            node.frozen_routing = None
+
+    def can_reach(self, src: int, dst: int) -> bool:
+        return (src, dst) not in self._blocked
+
+    def send_from(self, src_id: int):
+        """Messenger bound to a source node, so chain forwards respect
+        partitions (the plain ``send`` has no source and models client
+        traffic, which partitions never cut)."""
+        def _send(node_id: int, method: str, payload):
+            if self._blocked and not self.can_reach(src_id, node_id):
+                raise FsError(Status(
+                    Code.RPC_CONNECT_FAILED,
+                    f"partitioned: {src_id} -/-> {node_id}"))
+            return self.send(node_id, method, payload)
+
+        return _send
 
     def send(self, node_id: int, method: str, payload):
         """Direct-dispatch messenger with fail-stop semantics."""
@@ -286,7 +374,11 @@ class Fabric:
         treats as a JOIN delta."""
         if node_id is None:
             node_id = max(self.nodes) + 1
-        service = StorageService(node_id, self.routing, self.send)
+        service = StorageService(
+            node_id, self.node_routing(node_id), self.send_from(node_id))
+        if self.cfg.fencing:
+            service.enable_fencing(
+                self.clock, self.cfg.heartbeat_timeout_s / 2.0)
         if self.cfg.qos is not None:
             from tpu3fs.qos.manager import QosManager
 
@@ -374,14 +466,23 @@ class Fabric:
 
     # -- cluster life -------------------------------------------------------
     def heartbeat_all(self) -> None:
+        now = self.clock()
         for node in self.nodes.values():
             if not node.alive:
+                continue
+            if self._blocked \
+                    and not self.can_reach(node.node_id, self.MGMTD_NODE_ID):
+                # partitioned from mgmtd: the heartbeat never lands, and
+                # the node judges its own lease fence on local time
+                node.service.fence_tick()
                 continue
             node.hb_version += 1
             states = {
                 t.target_id: t.local_state for t in node.service.targets()
             }
             self.mgmtd.heartbeat(node.node_id, node.hb_version, states)
+            node.service.note_mgmtd_contact(now)
+            node.service.fence_tick()
 
     def tick(self, *, heartbeat: bool = True) -> None:
         if heartbeat:

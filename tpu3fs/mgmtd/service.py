@@ -130,6 +130,9 @@ class Mgmtd:
         # primacy edge detection for tick(): a standby reloads from KV on
         # promotion before running any background mutator
         self._was_primary = False
+        # version-gated getRoutingInfo fast-path counter (lazy: most unit
+        # tests never poll with a current version)
+        self._not_modified_rec = None
         self._load()
 
     # -- persistence -------------------------------------------------------
@@ -954,8 +957,18 @@ class Mgmtd:
 
     # -- routing distribution -----------------------------------------------
     def get_routing_info(self, known_version: int = -1) -> Optional[RoutingInfo]:
-        """None when the caller is already up to date (version match)."""
+        """None when the caller is already up to date (version match) —
+        the version-gated fast path: the RPC binding turns None into a
+        tiny ``changed=False`` reply instead of re-serializing the full
+        snapshot for every poller each TTL (docs/scale.md)."""
         if known_version == self._routing.version:
+            rec = self._not_modified_rec
+            if rec is None:
+                from tpu3fs.monitor.recorder import CounterRecorder
+
+                rec = CounterRecorder("mgmtd.routing_not_modified")
+                self._not_modified_rec = rec
+            rec.add(1)
             return None
         return self._routing
 

@@ -150,7 +150,11 @@ def incidence_of_routing(
 
 
 def _stats(M: np.ndarray, node_ids: List[int], factor: int) -> PlanStats:
-    C = M.T.astype(np.int32) @ M.astype(np.int32)
+    # float64 BLAS then round: integer matmul has no BLAS path in numpy
+    # and runs ~100x slower at 10k-chain tables (BENCH_SCALE rebalance);
+    # co-occurrence counts are << 2^53 so the float trip is exact
+    Mf = M.astype(np.float64)
+    C = (Mf.T @ Mf).astype(np.int64)
     off = C - np.diag(np.diag(C))
     width = int(M.sum(axis=1).max()) if len(M) else 0
     b = len(M)
@@ -226,12 +230,31 @@ def plan_rebalance(
                 if a != b:
                     C[idx[a], idx[b]] += 1
 
+    # failure-domain labels (mgmtd node tags): a destination may not push
+    # any domain past the chain's loss budget — width-1 for CR, ec_m for
+    # EC (docs/scale.md). Unlabeled clusters stay domain-blind.
+    node_domain = {n.node_id: n.tags["domain"]
+                   for n in routing.nodes.values()
+                   if n.tags.get("domain")}
+
+    def domain_ok(cid: int, members, dst: int) -> bool:
+        dom = node_domain.get(dst)
+        if dom is None:
+            return True
+        chain = chains[cid]
+        cap = chain.ec_m if chain.is_ec \
+            else max(len(chain.targets) - 1, 1)
+        count = 1 + sum(1 for m in members if node_domain.get(m) == dom)
+        return count <= cap
+
     def pick_dst(cid: int) -> Optional[int]:
-        """Least-(λ-spike, load) eligible destination for one chain."""
+        """Least-(λ-spike, load) eligible destination for one chain.
+        None when every candidate is taken or would breach the chain's
+        failure-domain budget — the caller defers the chain."""
         taken = member_nodes[cid]
         best = None
         for n in final_nodes:
-            if n in taken:
+            if n in taken or not domain_ok(cid, taken, n):
                 continue
             i = idx[n]
             spike = max((C[i, idx[m]] + 1 for m in taken), default=1)
@@ -300,6 +323,8 @@ def plan_rebalance(
                     continue
                 if loads[idx[n]] < ceiling or n in delta.joined:
                     continue
+                if not domain_ok(cid, member_nodes[cid] - {n}, dst):
+                    continue
                 spike = max((C[idx[dst], idx[m]] + 1
                              for m in member_nodes[cid] if m != n
                              and m in idx), default=1)
@@ -338,6 +363,9 @@ def check_plan(routing: RoutingInfo, plan: RebalancePlan,
     """
     delta = delta or TopologyDelta.from_routing(routing)
     dead = set(delta.dead)
+    node_domain = {n.node_id: n.tags["domain"]
+                   for n in routing.nodes.values()
+                   if n.tags.get("domain")}
     problems: List[str] = []
     for mv in plan.moves:
         chain = routing.chains.get(mv.chain_id)
@@ -345,6 +373,20 @@ def check_plan(routing: RoutingInfo, plan: RebalancePlan,
             problems.append(f"chain {mv.chain_id}: not in routing")
             continue
         others = [t for t in chain.targets if t.target_id != mv.out_target]
+        dst_dom = node_domain.get(mv.dst_node)
+        if dst_dom is not None:
+            cap = chain.ec_m if chain.is_ec \
+                else max(len(chain.targets) - 1, 1)
+            stay = [routing.targets[t.target_id].node_id for t in others
+                    if t.target_id in routing.targets]
+            count = 1 + sum(1 for n in stay
+                            if node_domain.get(n) == dst_dom)
+            if count > cap:
+                problems.append(
+                    f"chain {mv.chain_id}: landing {mv.out_target}'s "
+                    f"replacement on {mv.dst_node} puts {count} members "
+                    f"in domain {dst_dom!r} (budget {cap}) — a single-"
+                    f"domain kill would break quorum")
         if chain.is_ec:
             bad = [t.target_id for t in others
                    if t.public_state != PublicTargetState.SERVING]

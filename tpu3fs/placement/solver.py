@@ -40,6 +40,12 @@ class PlacementProblem:
     # reads from EVERY surviving group member (factor k-1), CR full-chunk-
     # replace streams one copy (factor 1) — ref data_placement.py:91-92
     chain_table_type: str = "CR"
+    # failure domains: domains[i] labels node i (rack/zone/pod), and no
+    # group may put more than max_per_domain members under one label —
+    # the loss budget a whole-domain kill must fit inside (width-1 for
+    # CR quorum survival, ec_m for EC). None = domain-blind (legacy).
+    domains: Optional[List[str]] = None
+    max_per_domain: Optional[int] = None
 
     def __post_init__(self):
         v, k, r = self.num_nodes, self.group_size, self.targets_per_node
@@ -49,6 +55,29 @@ class PlacementProblem:
             raise ValueError(f"v*r={v*r} not divisible by group size {k}")
         if self.chain_table_type not in ("CR", "EC"):
             raise ValueError(f"chain_table_type {self.chain_table_type!r}")
+        if (self.domains is None) != (self.max_per_domain is None):
+            raise ValueError("domains and max_per_domain go together")
+        if self.domains is not None:
+            if len(self.domains) != v:
+                raise ValueError(
+                    f"{len(self.domains)} domain labels for {v} nodes")
+            cap = int(self.max_per_domain)
+            if cap < 1:
+                raise ValueError(f"max_per_domain {cap} < 1")
+            from collections import Counter
+
+            counts = Counter(self.domains)
+            if sum(min(n, cap) for n in counts.values()) < k:
+                raise ValueError(
+                    f"infeasible: no {k}-group can respect "
+                    f"max_per_domain={cap} over domains {dict(counts)}")
+            b = self.num_groups
+            for d, n in sorted(counts.items()):
+                if n * r > b * cap:
+                    raise ValueError(
+                        f"infeasible: domain {d!r} holds {n} nodes "
+                        f"needing {n * r} group slots, but {b} groups "
+                        f"x cap {cap} allow only {b * cap}")
 
     @property
     def num_groups(self) -> int:  # b
@@ -80,19 +109,54 @@ class PlacementProblem:
 
 def _greedy_incidence(problem: PlacementProblem) -> np.ndarray:
     """Round-robin start: group g holds the k consecutive nodes from a
-    rolling cursor (mod v) — k <= v guarantees distinct members."""
+    rolling cursor (mod v) — k <= v guarantees distinct members. With
+    domains, the cursor walks a domain-INTERLEAVED ordering (rank within
+    domain, then domain) so consecutive windows straddle domains — the
+    annealer then only has to repair the remainder windows."""
     v, k, b = problem.num_nodes, problem.group_size, problem.num_groups
+    order = np.arange(v)
+    if problem.domains is not None:
+        buckets: dict = {}
+        for i, d in enumerate(problem.domains):
+            buckets.setdefault(d, []).append(i)
+        depth = max(len(m) for m in buckets.values())
+        order = np.array(
+            [m[rank] for rank in range(depth)
+             for _d, m in sorted(buckets.items()) if rank < len(m)],
+            dtype=int)
     M = np.zeros((b, v), dtype=np.int8)
     pos = 0
     for g in range(b):
         for i in range(k):
-            M[g, (pos + i) % v] = 1
+            M[g, order[(pos + i) % v]] = 1
         pos += k
     return M
 
 
+def _domain_onehot(problem: PlacementProblem) -> Optional[np.ndarray]:
+    """(v, D) one-hot node->domain incidence, None when domain-blind."""
+    if problem.domains is None:
+        return None
+    labels = sorted(set(problem.domains))
+    idx = np.array([labels.index(d) for d in problem.domains])
+    return np.eye(len(labels), dtype=np.int8)[idx]
+
+
+def domain_overflow(M: np.ndarray, problem: PlacementProblem) -> int:
+    """Total members-over-cap across all (group, domain) cells: 0 iff
+    every group respects max_per_domain."""
+    onehot = _domain_onehot(problem)
+    if onehot is None:
+        return 0
+    counts = np.asarray(M, dtype=np.int32) @ onehot.astype(np.int32)
+    return int(np.maximum(counts - int(problem.max_per_domain), 0).sum())
+
+
 def _score_np(M: np.ndarray) -> Tuple[int, int]:
-    C = M.T.astype(np.int32) @ M.astype(np.int32)
+    # float64 BLAS then round — numpy integer matmul has no BLAS path
+    # and is ~100x slower on 10k-group tables; counts are << 2^53
+    Mf = M.astype(np.float64)
+    C = (Mf.T @ Mf).astype(np.int64)
     off = C - np.diag(np.diag(C))
     return int(off.max()), int((off * off).sum())
 
@@ -128,18 +192,32 @@ def solve_placement(
                          if target_lambda is not None else traffic_tgt)
     tgt = target_lambda if target_lambda is not None else problem.lambda_lower_bound
     best_max, best_ssq = _score_np(M)
-    if best_max <= tgt or b < 2:
+    best_over = domain_overflow(M, problem)
+    if (best_over == 0 and best_max <= tgt) or b < 2:
         return M  # already optimal, or a single group has no swap moves
 
     P = proposals_per_step
+    onehot = _domain_onehot(problem)
+    cap = int(problem.max_per_domain) if onehot is not None else 0
+    onehot_j = (jnp.asarray(onehot, dtype=jnp.int8)
+                if onehot is not None else None)
 
     @jax.jit
     def score_batch(Ms):
-        # Ms: (P, b, v) int8 -> (max offdiag, ssq offdiag) per proposal
+        # Ms: (P, b, v) int8 -> (domain overflow, max offdiag, ssq
+        # offdiag) per proposal. Overflow leads the lexicographic
+        # objective: the domain cap is a constraint, λ a preference.
         C = jnp.einsum("pbv,pbw->pvw", Ms, Ms, preferred_element_type=jnp.int32)
         eye = jnp.eye(v, dtype=jnp.int32)
         off = C * (1 - eye)
-        return off.max(axis=(1, 2)), (off * off).sum(axis=(1, 2))
+        mx = off.max(axis=(1, 2))
+        if onehot_j is None:
+            over = jnp.zeros_like(mx)
+        else:
+            counts = jnp.einsum("pbv,vd->pbd", Ms, onehot_j,
+                                preferred_element_type=jnp.int32)
+            over = jnp.maximum(counts - cap, 0).sum(axis=(1, 2))
+        return over, mx, (off * off).sum(axis=(1, 2))
 
     rng = np.random.default_rng(seed)
     temperature = 1.0
@@ -170,18 +248,21 @@ def solve_placement(
         cand[pi, g1[pi], c[pi]] = 1
         cand[pi, g2[pi], c[pi]] = 0
         cand[pi, g2[pi], a[pi]] = 1
-        maxs, ssqs = jax.device_get(score_batch(jnp.asarray(cand)))
-        order = np.lexsort((ssqs, maxs))
+        overs, maxs, ssqs = jax.device_get(score_batch(jnp.asarray(cand)))
+        order = np.lexsort((ssqs, maxs, overs))
         bi = order[0]
+        # exploration never regresses the hard domain constraint
         accept = (
-            (maxs[bi], ssqs[bi]) < (best_max, best_ssq)
-            or rng.random() < 0.02 * temperature
+            (overs[bi], maxs[bi], ssqs[bi]) < (best_over, best_max, best_ssq)
+            or (overs[bi] <= best_over
+                and rng.random() < 0.02 * temperature)
         )
         if accept:
             M = cand[bi]
-            best_max, best_ssq = int(maxs[bi]), int(ssqs[bi])
+            best_over, best_max, best_ssq = (
+                int(overs[bi]), int(maxs[bi]), int(ssqs[bi]))
         temperature *= 0.99
-        if best_max <= tgt:
+        if best_over == 0 and best_max <= tgt:
             break
     return M
 
@@ -208,6 +289,8 @@ def check_solution(
     if not (M.sum(axis=1) == k).all():
         return False
     if not (M.sum(axis=0) == r).all():
+        return False
+    if domain_overflow(M, problem) > 0:
         return False
     if lambda_max is not None:
         mx, _ = _score_np(M)

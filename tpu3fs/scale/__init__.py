@@ -1,0 +1,6 @@
+"""Scale fabric: thousands of lightweight in-process nodes driving the
+REAL control plane (docs/scale.md)."""
+
+from tpu3fs.scale.fabric import ScaleConfig, ScaleFabric, ScaleNode
+
+__all__ = ["ScaleConfig", "ScaleFabric", "ScaleNode"]

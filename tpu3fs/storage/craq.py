@@ -499,6 +499,82 @@ class StorageService:
         # can never be re-entered by an in-process chain forward.
         self._native_write_chains = frozenset()
         self._native_lock_fns = None
+        # mgmtd lease fence (docs/design_notes.md "Failure detection":
+        # a service must stop serving at T/2 of mgmtd silence, before
+        # mgmtd declares it dead at T and promotes around it). Disabled
+        # (clock None) unless the hosting fabric/binary arms it.
+        self._fence_clock: Optional[Callable[[], float]] = None
+        self._fence_timeout_s = 0.0
+        self._fence_last_contact = 0.0
+        self._fence_demoted = False
+        self._fenced_rec = None
+
+    # -- mgmtd lease fence ---------------------------------------------------
+    def enable_fencing(self, clock: Callable[[], float],
+                       timeout_s: float) -> None:
+        """Arm the self-judged mgmtd lease fence: past ``timeout_s`` of
+        mgmtd silence this node refuses client-entry write acks
+        (WRITE_FENCED) and demotes its targets' local state to ONLINE so
+        the chain state machine resyncs it when it returns. ``timeout_s``
+        must be at most half the mgmtd heartbeat timeout — the fence has
+        to close BEFORE the other side may promote a successor."""
+        from tpu3fs.monitor.recorder import CounterRecorder
+
+        self._fence_clock = clock
+        self._fence_timeout_s = float(timeout_s)
+        self._fence_last_contact = clock()
+        if self._fenced_rec is None:
+            self._fenced_rec = CounterRecorder(
+                "storage.fenced_writes", {"node": str(self.node_id)})
+
+    def note_mgmtd_contact(self, now: Optional[float] = None) -> None:
+        """Record a successful mgmtd round trip (heartbeat reply seen):
+        re-opens the fence."""
+        if self._fence_clock is None:
+            return
+        self._fence_last_contact = (
+            now if now is not None else self._fence_clock())
+        self._fence_demoted = False
+
+    def _fence_expired(self) -> bool:
+        if self._fence_clock is None:
+            return False
+        from tpu3fs.chaos.bugs import bug_fire
+
+        if bug_fire("lease_fence_skip"):
+            # the planted split-brain bug: the fence judgment lies, so a
+            # partitioned head keeps acking AND keeps claiming UPTODATE
+            return False
+        return (self._fence_clock() - self._fence_last_contact
+                > self._fence_timeout_s)
+
+    def fence_tick(self) -> None:
+        """The background half of the fence: on expiry, demote every
+        local target to ONLINE. A fenced node can no longer claim
+        UPTODATE — the surviving side may be accepting writes it will
+        never see — and the chain state machine only readmits a returning
+        target through WAITING→SYNCING when it reports ONLINE
+        (mgmtd/chain_sm.py)."""
+        if self._fence_clock is None or self._fence_demoted:
+            return
+        if not self._fence_expired():
+            return
+        from tpu3fs.mgmtd.types import LocalTargetState
+
+        self._fence_demoted = True
+        for target in self._targets.values():
+            target.local_state = LocalTargetState.ONLINE
+
+    def _fence_refusal(self) -> Optional[UpdateReply]:
+        """Client-entry gate: a fenced node must not ack new writes."""
+        if not self._fence_expired():
+            return None
+        if self._fenced_rec is not None:
+            self._fenced_rec.add(1)
+        return UpdateReply(
+            Code.WRITE_FENCED,
+            message=(f"mgmtd silent > {self._fence_timeout_s:g}s: "
+                     "lease fence closed"))
 
     def set_fastpath_invalidator(self, fn) -> None:
         self._fastpath_invalidate = fn
@@ -909,6 +985,15 @@ class StorageService:
             return UpdateReply(
                 Code.NOT_HEAD, message=f"head target {head.target_id} not local"
             )
+        if not req.from_target:
+            # lease fence: a head that lost mgmtd contact for T/2 must
+            # not ack NEW client writes — mgmtd may already be promoting
+            # a successor on the other side of a partition. Chain-
+            # internal hops (from_target) pass: the upstream head judged
+            # its own fence when it admitted the write.
+            fenced = self._fence_refusal()
+            if fenced is not None:
+                return fenced
         cached = self._channels.check(req)
         if cached is not None:
             return cached
@@ -1307,6 +1392,14 @@ class StorageService:
         target = self._targets.get(req.target_id)
         if target is None:
             return UpdateReply(Code.TARGET_NOT_FOUND, message=str(req.target_id))
+        if req.phase == 1:
+            # lease fence: the two-phase stripe STAGE is the EC client
+            # write entry — a fenced node must not admit new stripes.
+            # Phase-2 commits of already-staged stripes and phase-0
+            # rebuild installs of proven content still land.
+            fenced = self._fence_refusal()
+            if fenced is not None:
+                return fenced
         lease = None
         if req.phase != 2:
             # phase-2 commits are never shed: the shard is already staged
@@ -1539,6 +1632,11 @@ class StorageService:
                 Code.NOT_HEAD,
                 message=f"head target {head.target_id} not local")
                 for _ in range(n)]
+        # lease fence (see _write_admitted): batched head entries are
+        # client writes — a fenced head refuses the whole batch
+        fenced = self._fence_refusal()
+        if fenced is not None:
+            return [fenced for _ in range(n)]
         target = self._targets[head.target_id]
         lease, shed_ms, shed_code = self._admit_write(
             reqs[0], cost=n,

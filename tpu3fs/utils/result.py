@@ -102,6 +102,10 @@ class Code(enum.IntEnum):
     SYNCING = 514                 # target still receiving full-chunk-replace
     ENGINE_ERROR = 515
     NONHEAD_WRITE_REJECTED = 516
+    WRITE_FENCED = 517            # head's mgmtd lease-fence expired: no acks
+    #                               until it re-establishes mgmtd contact —
+    #                               retryable, routing refresh finds the
+    #                               promoted successor (docs/scale.md)
 
     # mgmtd 6xx
     MGMTD_NOT_PRIMARY = 600
@@ -204,6 +208,10 @@ RETRYABLE_CODES = frozenset(
         # with budget left may re-issue (ladders check their own deadline
         # before each retry, so an expired caller stops immediately)
         Code.DEADLINE_EXCEEDED,
+        # lease-fenced head: it cannot ack until it re-establishes mgmtd
+        # contact; mgmtd is (or will be) promoting a successor — clients
+        # refresh routing and the ladder lands on the new head
+        Code.WRITE_FENCED,
         # breaker fail-fast: the peer is suspected sick — refresh routing
         # and retry (the half-open probe re-tests the peer independently)
         Code.PEER_UNHEALTHY,
